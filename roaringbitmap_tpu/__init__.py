@@ -1,0 +1,40 @@
+"""roaringbitmap_tpu — a TPU-native compressed-bitmap framework.
+
+Brand-new JAX/XLA/Pallas implementation with the capabilities of the Java
+RoaringBitmap library (reference: /root/reference, ponder-lab/RoaringBitmap).
+The logical model is preserved — a 32-bit universe split into 2^16-value
+chunks keyed by the high 16 bits, each chunk stored as a sorted-array,
+1024x64-bit-word bitset, or run-length container (reference
+README.md:135-139) — but the physical execution model is inverted for TPU:
+containers are packed into dense ``[N, 1024]``-word device arrays and wide
+aggregations, BSI compare chains and cardinalities run as batched XLA
+reductions and Pallas kernels, with a pure-numpy CPU path for small or
+irregular operations.
+
+Public surface mirrors the reference's L3-L7 layers (SURVEY.md section 1).
+"""
+
+from .models.container import (
+    ArrayContainer,
+    BitmapContainer,
+    RunContainer,
+    container_from_values,
+    container_range_of_ones,
+)
+from .models.roaring import RoaringBitmap
+from .serialization import InvalidRoaringFormat
+from .parallel.aggregation import FastAggregation, ParallelAggregation
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ArrayContainer",
+    "BitmapContainer",
+    "RunContainer",
+    "container_from_values",
+    "container_range_of_ones",
+    "RoaringBitmap",
+    "InvalidRoaringFormat",
+    "FastAggregation",
+    "ParallelAggregation",
+]

@@ -1,0 +1,3 @@
+from . import container, roaring_array, roaring
+
+__all__ = ["container", "roaring_array", "roaring"]

@@ -1,0 +1,669 @@
+"""L1' containers: three chunk formats over a 16-bit sub-universe.
+
+Logical model follows the reference (Container.java:19 and its three
+concrete types): a sorted unique ``uint16`` array (sparse), a 1024x``uint64``
+bitset (dense), and run-length (start, length) pairs — chosen dynamically by
+cardinality / serialized-size thresholds (ArrayContainer.java:27
+``DEFAULT_MAX_SIZE=4096``; RunContainer.java:78 serialized size
+``2 + 4*nruns``; BitmapContainer fixed 8 KiB).
+
+Physical model differs deliberately from the Java triple-dispatch matrix
+(9 type-combinations per op, Container.java:63-98): here every pairwise op is
+computed vectorized in numpy on the natural representation (sorted-array set
+ops for sparse x sparse, word ops otherwise) and the *result* container type
+is chosen by the same cardinality rule the reference converges to
+(<=4096 -> array, else bitmap; runs arise from ``run_optimize``, range
+constructors and deserialization). Value semantics and serialized-form
+validity are identical; the batched device path (parallel/store.py) is where
+the performance lives.
+
+Containers are value-semantic: mutating ops return the (possibly new,
+possibly different-type) container, Java-style (``c = c.add(x)``).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from ..utils import bits
+
+ARRAY_MAX_SIZE = 4096  # ArrayContainer.java:27 DEFAULT_MAX_SIZE
+MAX_CAPACITY = 1 << 16  # BitmapContainer.java:25
+
+ARRAY_TYPE = "array"
+BITMAP_TYPE = "bitmap"
+RUN_TYPE = "run"
+
+
+def _as_u16(values) -> np.ndarray:
+    return np.asarray(values, dtype=np.uint16)
+
+
+class Container:
+    """Abstract chunk over a 16-bit sub-universe (Container.java:19)."""
+
+    TYPE: str = "?"
+
+    # --- representation ---------------------------------------------------
+    @property
+    def cardinality(self) -> int:
+        raise NotImplementedError
+
+    def to_array(self) -> np.ndarray:
+        """Sorted uint16 values."""
+        raise NotImplementedError
+
+    def to_words(self) -> np.ndarray:
+        """1024-word uint64 bitset copy."""
+        raise NotImplementedError
+
+    def num_runs(self) -> int:
+        raise NotImplementedError
+
+    def clone(self) -> "Container":
+        raise NotImplementedError
+
+    # --- size / conversion (Container.java:882 runOptimize) ---------------
+    def serialized_size(self) -> int:
+        """Bytes of the container payload in RoaringFormatSpec."""
+        raise NotImplementedError
+
+    def run_optimize(self) -> "Container":
+        """Convert to the smallest serialized representation
+        (Container.runOptimize, Container.java:882)."""
+        card = self.cardinality
+        nruns = self.num_runs()
+        run_size = RunContainer.serialized_size_for(nruns)
+        current = 8192 if card > ARRAY_MAX_SIZE else 2 + 2 * card
+        if run_size < current:
+            return RunContainer.from_values(self.to_array())
+        return self.to_efficient_non_run()
+
+    def to_efficient_non_run(self) -> "Container":
+        card = self.cardinality
+        if card > ARRAY_MAX_SIZE:
+            if isinstance(self, BitmapContainer):
+                return self
+            return BitmapContainer(bits.words_from_values(self.to_array()), card)
+        if isinstance(self, ArrayContainer):
+            return self
+        return ArrayContainer(self.to_array())
+
+    # --- point ops --------------------------------------------------------
+    def contains(self, x: int) -> bool:
+        raise NotImplementedError
+
+    def add(self, x: int) -> "Container":
+        raise NotImplementedError
+
+    def remove(self, x: int) -> "Container":
+        raise NotImplementedError
+
+    # --- range ops (half-open [start, end) over 0..65536) -----------------
+    def add_range(self, start: int, end: int) -> "Container":
+        if start >= end:
+            return self
+        words = self.to_words()
+        bits.set_bitmap_range(words, start, end)
+        return best_container_of_words(words)
+
+    def remove_range(self, start: int, end: int) -> "Container":
+        if start >= end:
+            return self
+        words = self.to_words()
+        bits.clear_bitmap_range(words, start, end)
+        return best_container_of_words(words)
+
+    def flip_range(self, start: int, end: int) -> "Container":
+        """not(range) (Container.inot/not)."""
+        if start >= end:
+            return self
+        words = self.to_words()
+        bits.flip_bitmap_range(words, start, end)
+        return best_container_of_words(words)
+
+    def contains_range(self, start: int, end: int) -> bool:
+        if start >= end:
+            return True
+        return self.rank(end - 1) - (self.rank(start - 1) if start > 0 else 0) == end - start
+
+    def intersects_range(self, start: int, end: int) -> bool:
+        if start >= end:
+            return False
+        nv = self.next_value(start)
+        return nv >= 0 and nv < end
+
+    # --- pairwise algebra -------------------------------------------------
+    def and_(self, other: "Container") -> "Container":
+        raise NotImplementedError
+
+    def or_(self, other: "Container") -> "Container":
+        raise NotImplementedError
+
+    def xor_(self, other: "Container") -> "Container":
+        raise NotImplementedError
+
+    def andnot(self, other: "Container") -> "Container":
+        raise NotImplementedError
+
+    def intersects(self, other: "Container") -> bool:
+        return self.and_cardinality(other) > 0
+
+    def and_cardinality(self, other: "Container") -> int:
+        return self.and_(other).cardinality
+
+    def contains_container(self, other: "Container") -> bool:
+        """Subset test: other ⊆ self (Container.contains, RoaringBitmap.java:2781)."""
+        if other.cardinality > self.cardinality:
+            return False
+        return other.andnot(self).cardinality == 0
+
+    # --- order statistics -------------------------------------------------
+    def rank(self, x: int) -> int:
+        """Number of values <= x (Container.rank, Container.java:849)."""
+        raise NotImplementedError
+
+    def select(self, j: int) -> int:
+        """j-th smallest value, 0-based (Container.select, Container.java:891)."""
+        raise NotImplementedError
+
+    def first(self) -> int:
+        return self.select(0)
+
+    def last(self) -> int:
+        return self.select(self.cardinality - 1)
+
+    def next_value(self, from_value: int) -> int:
+        """Smallest value >= from_value, or -1 (Container.nextValue)."""
+        raise NotImplementedError
+
+    def previous_value(self, from_value: int) -> int:
+        """Largest value <= from_value, or -1."""
+        raise NotImplementedError
+
+    def next_absent_value(self, from_value: int) -> int:
+        """Smallest absent value >= from_value (65536 when the whole tail is
+        present). Vectorized: a contiguous present run starting at from_value
+        satisfies arr[i+k] == from_value+k; the first mismatch is the gap."""
+        arr = self.to_array().astype(np.int64)
+        i = int(np.searchsorted(arr, from_value))
+        if i == arr.size or arr[i] != from_value:
+            return from_value
+        tail = arr[i:]
+        mismatch = np.nonzero(tail != from_value + np.arange(tail.size))[0]
+        return from_value + (int(mismatch[0]) if mismatch.size else tail.size)
+
+    def previous_absent_value(self, from_value: int) -> int:
+        """Largest absent value <= from_value, or -1 when [0, from_value] is
+        entirely present."""
+        arr = self.to_array().astype(np.int64)
+        i = int(np.searchsorted(arr, from_value, side="right"))
+        if i == 0 or arr[i - 1] != from_value:
+            return from_value
+        head = arr[:i][::-1]  # values ending the run at from_value, descending
+        mismatch = np.nonzero(head != from_value - np.arange(head.size))[0]
+        return from_value - (int(mismatch[0]) if mismatch.size else head.size)
+
+    # --- iteration --------------------------------------------------------
+    def __iter__(self) -> Iterator[int]:
+        return iter(self.to_array().tolist())
+
+    def __len__(self) -> int:
+        return self.cardinality
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, Container):
+            return NotImplemented
+        return (
+            self.cardinality == other.cardinality
+            and np.array_equal(self.to_array(), other.to_array())
+        )
+
+    def __hash__(self):  # containers are not hashable (mutable value semantics)
+        raise TypeError("containers are unhashable")
+
+    def __repr__(self):
+        c = self.cardinality
+        head = ",".join(str(v) for v in self.to_array()[:8].tolist())
+        return f"<{type(self).__name__} card={c} [{head}{'...' if c > 8 else ''}]>"
+
+
+# ---------------------------------------------------------------------------
+
+
+class ArrayContainer(Container):
+    """Sorted unique uint16 values; holds <= 4096 (ArrayContainer.java:27)."""
+
+    TYPE = ARRAY_TYPE
+    __slots__ = ("content",)
+
+    def __init__(self, content=None):
+        self.content = _as_u16(content if content is not None else [])
+
+    @property
+    def cardinality(self) -> int:
+        return int(self.content.size)
+
+    def to_array(self) -> np.ndarray:
+        return self.content
+
+    def to_words(self) -> np.ndarray:
+        return bits.words_from_values(self.content)
+
+    def num_runs(self) -> int:
+        if self.content.size == 0:
+            return 0
+        return int((np.diff(self.content.astype(np.int32)) != 1).sum()) + 1
+
+    def clone(self) -> "ArrayContainer":
+        return ArrayContainer(self.content.copy())
+
+    def serialized_size(self) -> int:
+        return 2 * self.cardinality  # payload: cardinality uint16s
+
+    def contains(self, x: int) -> bool:
+        i = int(np.searchsorted(self.content, np.uint16(x)))
+        return i < self.content.size and self.content[i] == x
+
+    def add(self, x: int) -> Container:
+        i = int(np.searchsorted(self.content, np.uint16(x)))
+        if i < self.content.size and self.content[i] == x:
+            return self
+        if self.content.size >= ARRAY_MAX_SIZE:
+            return self._promote().add(x)  # ArrayContainer.java:158 promotion
+        self.content = np.insert(self.content, i, np.uint16(x))
+        return self
+
+    def remove(self, x: int) -> Container:
+        i = int(np.searchsorted(self.content, np.uint16(x)))
+        if i < self.content.size and self.content[i] == x:
+            self.content = np.delete(self.content, i)
+        return self
+
+    def _promote(self) -> "BitmapContainer":
+        return BitmapContainer(bits.words_from_values(self.content), self.cardinality)
+
+    # pairwise
+    def and_(self, other: Container) -> Container:
+        if isinstance(other, ArrayContainer):
+            return ArrayContainer(bits.intersect_sorted(self.content, other.content))
+        if isinstance(other, BitmapContainer):
+            mask = other.contains_many(self.content)
+            return ArrayContainer(self.content[mask])
+        return other.and_(self)  # run
+
+    def or_(self, other: Container) -> Container:
+        if isinstance(other, ArrayContainer):
+            merged = bits.merge_sorted_unique(self.content, other.content)
+            if merged.size > ARRAY_MAX_SIZE:
+                return BitmapContainer(bits.words_from_values(merged), int(merged.size))
+            return ArrayContainer(merged)
+        return other.or_(self)
+
+    def xor_(self, other: Container) -> Container:
+        if isinstance(other, ArrayContainer):
+            out = bits.xor_sorted(self.content, other.content)
+            if out.size > ARRAY_MAX_SIZE:
+                return BitmapContainer(bits.words_from_values(out), int(out.size))
+            return ArrayContainer(out)
+        return other.xor_(self)
+
+    def andnot(self, other: Container) -> Container:
+        if isinstance(other, ArrayContainer):
+            return ArrayContainer(bits.difference_sorted(self.content, other.content))
+        if isinstance(other, BitmapContainer):
+            mask = other.contains_many(self.content)
+            return ArrayContainer(self.content[~mask])
+        return ArrayContainer(
+            self.content[~_run_contains_many(other, self.content)]
+        )
+
+    def and_cardinality(self, other: Container) -> int:
+        if isinstance(other, BitmapContainer):
+            return int(other.contains_many(self.content).sum())
+        return self.and_(other).cardinality
+
+    def rank(self, x: int) -> int:
+        return int(np.searchsorted(self.content, np.uint16(x), side="right"))
+
+    def select(self, j: int) -> int:
+        return int(self.content[j])
+
+    def next_value(self, from_value: int) -> int:
+        i = int(np.searchsorted(self.content, np.uint16(from_value)))
+        return int(self.content[i]) if i < self.content.size else -1
+
+    def previous_value(self, from_value: int) -> int:
+        i = int(np.searchsorted(self.content, np.uint16(from_value), side="right"))
+        return int(self.content[i - 1]) if i > 0 else -1
+
+
+# ---------------------------------------------------------------------------
+
+
+class BitmapContainer(Container):
+    """1024x uint64 bitset + tracked cardinality (BitmapContainer.java:25)."""
+
+    TYPE = BITMAP_TYPE
+    __slots__ = ("words", "_card")
+
+    def __init__(self, words: Optional[np.ndarray] = None, cardinality: Optional[int] = None):
+        self.words = words if words is not None else bits.new_words()
+        self._card = (
+            cardinality if cardinality is not None else bits.cardinality_of_words(self.words)
+        )
+
+    @property
+    def cardinality(self) -> int:
+        return self._card
+
+    def to_array(self) -> np.ndarray:
+        return bits.values_from_words(self.words)
+
+    def to_words(self) -> np.ndarray:
+        return self.words.copy()
+
+    def num_runs(self) -> int:
+        return bits.num_runs_in_words(self.words)
+
+    def clone(self) -> "BitmapContainer":
+        return BitmapContainer(self.words.copy(), self._card)
+
+    def serialized_size(self) -> int:
+        return 8192
+
+    def contains(self, x: int) -> bool:
+        return bits.get_bit(self.words, x)
+
+    def contains_many(self, values: np.ndarray) -> np.ndarray:
+        """Vectorized membership mask for uint16 values."""
+        v = values.astype(np.uint32)
+        return (
+            (self.words[v >> 6] >> (v & np.uint32(63)).astype(np.uint64)) & np.uint64(1)
+        ).astype(bool)
+
+    def add(self, x: int) -> Container:
+        if not bits.get_bit(self.words, x):
+            bits.set_bit(self.words, x)
+            self._card += 1
+        return self
+
+    def remove(self, x: int) -> Container:
+        if bits.get_bit(self.words, x):
+            bits.clear_bit(self.words, x)
+            self._card -= 1
+            if self._card <= ARRAY_MAX_SIZE:  # demotion (BitmapContainer -> Array)
+                return ArrayContainer(self.to_array())
+        return self
+
+    def _binary(self, other: Container, fn) -> Container:
+        ow = other.words if isinstance(other, BitmapContainer) else other.to_words()
+        return best_container_of_words(fn(self.words, ow))
+
+    def and_(self, other: Container) -> Container:
+        if isinstance(other, ArrayContainer):
+            return other.and_(self)
+        return self._binary(other, np.bitwise_and)
+
+    def or_(self, other: Container) -> Container:
+        if isinstance(other, ArrayContainer):
+            words = self.words.copy()
+            v = other.content.astype(np.uint32)
+            np.bitwise_or.at(words, v >> 6, np.uint64(1) << (v & np.uint32(63)).astype(np.uint64))
+            return BitmapContainer(words)
+        return self._binary(other, np.bitwise_or)
+
+    def xor_(self, other: Container) -> Container:
+        return self._binary(other, np.bitwise_xor)
+
+    def andnot(self, other: Container) -> Container:
+        ow = other.words if isinstance(other, BitmapContainer) else other.to_words()
+        return best_container_of_words(self.words & ~ow)
+
+    def and_cardinality(self, other: Container) -> int:
+        if isinstance(other, ArrayContainer):
+            return other.and_cardinality(self)
+        ow = other.words if isinstance(other, BitmapContainer) else other.to_words()
+        return bits.cardinality_of_words(self.words & ow)
+
+    def rank(self, x: int) -> int:
+        return bits.cardinality_in_range(self.words, 0, x + 1)
+
+    def select(self, j: int) -> int:
+        return bits.select_in_words(self.words, j)
+
+    def next_value(self, from_value: int) -> int:
+        w = from_value >> 6
+        masked = self.words[w] >> np.uint64(from_value & 63)
+        if masked != 0:
+            return from_value + int(masked & (~masked + np.uint64(1))).bit_length() - 1
+        nz = np.nonzero(self.words[w + 1 :])[0]
+        if nz.size == 0:
+            return -1
+        ww = w + 1 + int(nz[0])
+        word = int(self.words[ww])
+        return (ww << 6) + (word & -word).bit_length() - 1
+
+    def previous_value(self, from_value: int) -> int:
+        w = from_value >> 6
+        masked = self.words[w] << np.uint64(63 - (from_value & 63))
+        if masked != 0:
+            return from_value - (64 - int(masked).bit_length())
+        nz = np.nonzero(self.words[:w])[0]
+        if nz.size == 0:
+            return -1
+        ww = int(nz[-1])
+        return (ww << 6) + int(self.words[ww]).bit_length() - 1
+
+
+# ---------------------------------------------------------------------------
+
+
+def _run_contains_many(run: "RunContainer", values: np.ndarray) -> np.ndarray:
+    """Vectorized membership of uint16 values in a RunContainer."""
+    if run.starts.size == 0:
+        return np.zeros(values.size, dtype=bool)
+    v = values.astype(np.int64)
+    idx = np.searchsorted(run.starts.astype(np.int64), v, side="right") - 1
+    valid = idx >= 0
+    idx = np.clip(idx, 0, run.starts.size - 1)
+    s = run.starts.astype(np.int64)[idx]
+    e = s + run.lengths.astype(np.int64)[idx]
+    return valid & (v >= s) & (v <= e)
+
+
+class RunContainer(Container):
+    """Run-length encoded: (start, length) pairs, run = [start, start+length]
+    (RunContainer.java interleaved char pairs; serialized 2 + 4*nruns bytes,
+    RunContainer.java:78)."""
+
+    TYPE = RUN_TYPE
+    __slots__ = ("starts", "lengths")
+
+    def __init__(self, starts=None, lengths=None):
+        self.starts = _as_u16(starts if starts is not None else [])
+        self.lengths = _as_u16(lengths if lengths is not None else [])
+
+    @staticmethod
+    def from_values(values: np.ndarray) -> "RunContainer":
+        s, l = bits.runs_from_values(values)
+        return RunContainer(s, l)
+
+    @staticmethod
+    def serialized_size_for(nruns: int) -> int:
+        return 2 + 4 * nruns  # RunContainer.java:78
+
+    @property
+    def cardinality(self) -> int:
+        return int(self.lengths.astype(np.int64).sum()) + int(self.starts.size)
+
+    def to_array(self) -> np.ndarray:
+        return bits.values_from_runs(self.starts, self.lengths)
+
+    def to_words(self) -> np.ndarray:
+        words = bits.new_words()
+        for s, l in zip(self.starts.tolist(), self.lengths.tolist()):
+            bits.set_bitmap_range(words, s, s + l + 1)
+        return words
+
+    def num_runs(self) -> int:
+        return int(self.starts.size)
+
+    def clone(self) -> "RunContainer":
+        return RunContainer(self.starts.copy(), self.lengths.copy())
+
+    def serialized_size(self) -> int:
+        return self.serialized_size_for(self.num_runs())
+
+    def contains(self, x: int) -> bool:
+        return bool(_run_contains_many(self, np.array([x], dtype=np.uint16))[0])
+
+    def add(self, x: int) -> Container:
+        if self.contains(x):
+            return self
+        return _mutate_via_words(self, lambda w: bits.set_bit(w, x))
+
+    def remove(self, x: int) -> Container:
+        if not self.contains(x):
+            return self
+        return _mutate_via_words(self, lambda w: bits.clear_bit(w, x))
+
+    def run_optimize(self) -> Container:
+        # RunContainer.toEfficientContainer (RunContainer.java:691)
+        card = self.cardinality
+        run_size = self.serialized_size()
+        other = 8192 if card > ARRAY_MAX_SIZE else 2 + 2 * card
+        if run_size <= other:
+            return self
+        return self.to_efficient_non_run()
+
+    def _binary(self, other: Container, fn) -> Container:
+        return best_container_of_words(fn(self.to_words(), other.to_words()))
+
+    def and_(self, other: Container) -> Container:
+        if isinstance(other, ArrayContainer):
+            return ArrayContainer(other.content[_run_contains_many(self, other.content)])
+        return self._binary(other, np.bitwise_and)
+
+    def or_(self, other: Container) -> Container:
+        if isinstance(other, RunContainer):
+            # run-friendly union: merge runs, keep run form if it stays small
+            merged = _merge_runs(self, other)
+            return merged.run_optimize()
+        return self._binary(other, np.bitwise_or)
+
+    def xor_(self, other: Container) -> Container:
+        return self._binary(other, np.bitwise_xor)
+
+    def andnot(self, other: Container) -> Container:
+        return best_container_of_words(self.to_words() & ~other.to_words())
+
+    def and_cardinality(self, other: Container) -> int:
+        if isinstance(other, ArrayContainer):
+            return int(_run_contains_many(self, other.content).sum())
+        return bits.cardinality_of_words(self.to_words() & other.to_words())
+
+    def rank(self, x: int) -> int:
+        s = self.starts.astype(np.int64)
+        e = s + self.lengths.astype(np.int64)
+        full = s <= x
+        contrib = np.where(full, np.minimum(e, x) - s + 1, 0)
+        return int(contrib.sum())
+
+    def select(self, j: int) -> int:
+        lens = self.lengths.astype(np.int64) + 1
+        cum = np.cumsum(lens)
+        r = int(np.searchsorted(cum, j + 1))
+        if r >= self.starts.size:
+            raise IndexError(f"select({j})")
+        prior = int(cum[r - 1]) if r else 0
+        return int(self.starts[r]) + (j - prior)
+
+    def next_value(self, from_value: int) -> int:
+        if self.starts.size == 0:
+            return -1
+        s = self.starts.astype(np.int64)
+        e = s + self.lengths.astype(np.int64)
+        i = int(np.searchsorted(e, from_value))
+        if i >= s.size:
+            return -1
+        return int(max(from_value, s[i]))
+
+    def previous_value(self, from_value: int) -> int:
+        if self.starts.size == 0:
+            return -1
+        s = self.starts.astype(np.int64)
+        e = s + self.lengths.astype(np.int64)
+        i = int(np.searchsorted(s, from_value, side="right")) - 1
+        if i < 0:
+            return -1
+        return int(min(from_value, e[i]))
+
+    def is_full(self) -> bool:
+        return self.num_runs() == 1 and self.starts[0] == 0 and self.lengths[0] == 0xFFFF
+
+
+def _merge_runs(a: RunContainer, b: RunContainer) -> RunContainer:
+    """Union two run containers directly in run space."""
+    s = np.concatenate([a.starts.astype(np.int64), b.starts.astype(np.int64)])
+    e = np.concatenate(
+        [
+            a.starts.astype(np.int64) + a.lengths.astype(np.int64),
+            b.starts.astype(np.int64) + b.lengths.astype(np.int64),
+        ]
+    )
+    order = np.argsort(s, kind="stable")
+    s, e = s[order], e[order]
+    out_s, out_e = [], []
+    for i in range(s.size):
+        if out_s and s[i] <= out_e[-1] + 1:
+            out_e[-1] = max(out_e[-1], e[i])
+        else:
+            out_s.append(int(s[i]))
+            out_e.append(int(e[i]))
+    starts = np.array(out_s, dtype=np.uint16)
+    lengths = (np.array(out_e, dtype=np.int64) - np.array(out_s, dtype=np.int64)).astype(
+        np.uint16
+    )
+    return RunContainer(starts, lengths)
+
+
+def _mutate_via_words(c: Container, fn) -> Container:
+    words = c.to_words()
+    fn(words)
+    new = best_container_of_words(words)
+    if isinstance(c, RunContainer):
+        return new.run_optimize()
+    return new
+
+
+# ---------------------------------------------------------------------------
+
+
+def best_container_of_words(words: np.ndarray) -> Container:
+    """Array if card <= 4096, else Bitmap (the reference's conversion rule)."""
+    card = bits.cardinality_of_words(words)
+    if card <= ARRAY_MAX_SIZE:
+        return ArrayContainer(bits.values_from_words(words))
+    return BitmapContainer(words, card)
+
+
+def container_from_values(values: np.ndarray) -> Container:
+    """Best non-run container from sorted unique uint16 values."""
+    v = _as_u16(values)
+    if v.size > ARRAY_MAX_SIZE:
+        return BitmapContainer(bits.words_from_values(v), int(v.size))
+    return ArrayContainer(v)
+
+
+def container_range_of_ones(start: int, end: int) -> Container:
+    """Container holding [start, end) — Container.rangeOfOnes
+    (Container.java:29-37): array below the 2-value threshold, else run."""
+    n = end - start
+    if n <= 2:
+        return ArrayContainer(np.arange(start, end, dtype=np.uint16))
+    return RunContainer(
+        np.array([start], dtype=np.uint16), np.array([n - 1], dtype=np.uint16)
+    )

@@ -1,0 +1,735 @@
+"""L3' facade: the 32-bit RoaringBitmap.
+
+API parity with the reference facade (RoaringBitmap.java:50): point ops
+(add :1162, contains :1693, remove :2637), range ops (add(long,long) :1181,
+flip :1893), pairwise static algebra (and/or/xor/andNot/orNot
+:377/860/1071/444/1521) plus cardinality-only variants, rank/select
+(:2622/2820), next/previous(+absent) value (:2838-2929), addOffset (:230),
+selectRange (:3095), limit (:2457), runOptimize (:2764), contains-subset
+(:2781), isHammingSimilar (:1831), rangeCardinality (:2590), iterators and
+batch iteration, and the RoaringFormatSpec serialization (:3012-3051).
+
+Values are unsigned 32-bit ints; ranges are half-open ``[start, end)`` with
+``0 <= start <= end <= 2^32``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, List, Optional
+
+import numpy as np
+
+from ..utils import bits
+from .container import (
+    ARRAY_MAX_SIZE,
+    ArrayContainer,
+    BitmapContainer,
+    Container,
+    RunContainer,
+    container_from_values,
+    container_range_of_ones,
+)
+from .roaring_array import RoaringArray
+
+_MAX32 = 1 << 32
+
+
+def _check_value(x: int) -> int:
+    x = int(x)
+    if not 0 <= x < _MAX32:
+        raise ValueError(f"value {x} outside unsigned 32-bit range")
+    return x
+
+
+def _check_range(start: int, end: int):
+    start, end = int(start), int(end)
+    if not 0 <= start <= end <= _MAX32:
+        raise ValueError(f"invalid range [{start}, {end})")
+    return start, end
+
+
+class RoaringBitmap:
+    __slots__ = ("high_low_container",)
+
+    def __init__(self, values: Optional[Iterable[int]] = None):
+        self.high_low_container = RoaringArray()
+        if values is not None:
+            self.add_many(values)
+
+    # ------------------------------------------------------------------
+    # construction
+    # ------------------------------------------------------------------
+    @staticmethod
+    def bitmap_of(*values: int) -> "RoaringBitmap":
+        return RoaringBitmap(values)
+
+    @staticmethod
+    def bitmap_of_range(start: int, end: int) -> "RoaringBitmap":
+        out = RoaringBitmap()
+        out.add_range(start, end)
+        return out
+
+    def clone(self) -> "RoaringBitmap":
+        out = RoaringBitmap()
+        out.high_low_container = self.high_low_container.clone()
+        return out
+
+    # ------------------------------------------------------------------
+    # point ops
+    # ------------------------------------------------------------------
+    def add(self, x: int) -> None:
+        """RoaringBitmap.add (RoaringBitmap.java:1162)."""
+        x = _check_value(x)
+        hb, lb = x >> 16, x & 0xFFFF
+        hlc = self.high_low_container
+        i = hlc.get_index(hb)
+        if i >= 0:
+            hlc.set_container_at_index(i, hlc.get_container_at_index(i).add(lb))
+        else:
+            hlc.insert_new_key_value_at(
+                -i - 1, hb, ArrayContainer(np.array([lb], dtype=np.uint16))
+            )
+
+    def checked_add(self, x: int) -> bool:
+        """Add, returning True if the bitmap changed (RoaringBitmap.java:1610)."""
+        before = self.contains(x)
+        if not before:
+            self.add(x)
+        return not before
+
+    def add_many(self, values: Iterable[int]) -> None:
+        """Bulk add via per-key grouping (the writer path is faster for huge
+        sorted streams; see models/writer.py)."""
+        if not isinstance(values, np.ndarray):
+            values = np.fromiter(iter(values), dtype=np.int64)
+        v = np.asarray(values, dtype=np.int64).ravel()
+        if v.size == 0:
+            return
+        if v.min() < 0 or v.max() >= _MAX32:
+            raise ValueError("values outside unsigned 32-bit range")
+        v = np.unique(v.astype(np.uint32))
+        keys = (v >> 16).astype(np.int64)
+        lows = (v & 0xFFFF).astype(np.uint16)
+        boundaries = np.nonzero(np.diff(keys))[0] + 1
+        key_starts = np.concatenate(([0], boundaries))
+        key_ends = np.concatenate((boundaries, [v.size]))
+        hlc = self.high_low_container
+        for s, e in zip(key_starts.tolist(), key_ends.tolist()):
+            key = int(keys[s])
+            chunk = lows[s:e]
+            i = hlc.get_index(key)
+            if i >= 0:
+                existing = hlc.get_container_at_index(i)
+                hlc.set_container_at_index(
+                    i, existing.or_(container_from_values(chunk))
+                )
+            else:
+                hlc.insert_new_key_value_at(-i - 1, key, container_from_values(chunk))
+
+    def remove(self, x: int) -> None:
+        """RoaringBitmap.remove (RoaringBitmap.java:2637)."""
+        x = _check_value(x)
+        hb, lb = x >> 16, x & 0xFFFF
+        hlc = self.high_low_container
+        i = hlc.get_index(hb)
+        if i < 0:
+            return
+        c = hlc.get_container_at_index(i).remove(lb)
+        if c.cardinality == 0:
+            hlc.remove_at_index(i)
+        else:
+            hlc.set_container_at_index(i, c)
+
+    def checked_remove(self, x: int) -> bool:
+        before = self.contains(x)
+        if before:
+            self.remove(x)
+        return before
+
+    def contains(self, x: int) -> bool:
+        """RoaringBitmap.contains (RoaringBitmap.java:1693)."""
+        x = _check_value(x)
+        c = self.high_low_container.get_container(x >> 16)
+        return c is not None and c.contains(x & 0xFFFF)
+
+    # ------------------------------------------------------------------
+    # range ops
+    # ------------------------------------------------------------------
+    def add_range(self, start: int, end: int) -> None:
+        """Add [start, end) (RoaringBitmap.add(long,long), RoaringBitmap.java:1181)."""
+        start, end = _check_range(start, end)
+        if start == end:
+            return
+        self._apply_range(start, end, "add")
+
+    def remove_range(self, start: int, end: int) -> None:
+        """Remove [start, end) (RoaringBitmap.java:2656)."""
+        start, end = _check_range(start, end)
+        if start == end:
+            return
+        self._apply_range(start, end, "remove")
+
+    def flip_range(self, start: int, end: int) -> None:
+        """In-place flip of [start, end) (RoaringBitmap.flip, RoaringBitmap.java:1893)."""
+        start, end = _check_range(start, end)
+        if start == end:
+            return
+        self._apply_range(start, end, "flip")
+
+    @staticmethod
+    def flip(bm: "RoaringBitmap", start: int, end: int) -> "RoaringBitmap":
+        out = bm.clone()
+        out.flip_range(start, end)
+        return out
+
+    def _apply_range(self, start: int, end: int, mode: str) -> None:
+        hb_start, hb_end = start >> 16, (end - 1) >> 16
+        hlc = self.high_low_container
+        for hb in range(hb_start, hb_end + 1):
+            lo = start & 0xFFFF if hb == hb_start else 0
+            hi = ((end - 1) & 0xFFFF) + 1 if hb == hb_end else 1 << 16
+            i = hlc.get_index(hb)
+            full_chunk = lo == 0 and hi == (1 << 16)
+            if i >= 0:
+                c = hlc.get_container_at_index(i)
+                if mode == "add":
+                    c = (
+                        container_range_of_ones(0, 1 << 16)
+                        if full_chunk
+                        else c.add_range(lo, hi)
+                    )
+                elif mode == "remove":
+                    c = c.remove_range(lo, hi)
+                else:
+                    c = c.flip_range(lo, hi)
+                if c.cardinality == 0:
+                    hlc.remove_at_index(i)
+                else:
+                    hlc.set_container_at_index(i, c)
+            else:
+                if mode == "remove":
+                    continue
+                # add and flip are identical on an absent container
+                c = container_range_of_ones(lo, hi)
+                if c.cardinality:
+                    hlc.insert_new_key_value_at(-hlc.get_index(hb) - 1, hb, c)
+
+    def contains_range(self, start: int, end: int) -> bool:
+        """RoaringBitmap.contains(long,long)."""
+        start, end = _check_range(start, end)
+        if start == end:
+            return True
+        hb_start, hb_end = start >> 16, (end - 1) >> 16
+        hlc = self.high_low_container
+        for hb in range(hb_start, hb_end + 1):
+            lo = start & 0xFFFF if hb == hb_start else 0
+            hi = ((end - 1) & 0xFFFF) + 1 if hb == hb_end else 1 << 16
+            i = hlc.get_index(hb)
+            if i < 0 or not hlc.get_container_at_index(i).contains_range(lo, hi):
+                return False
+        return True
+
+    def range_cardinality(self, start: int, end: int) -> int:
+        """Number of set values in [start, end) (RoaringBitmap.java:2590)."""
+        start, end = _check_range(start, end)
+        if start >= end:
+            return 0
+        return self.rank_long(end - 1) - (self.rank_long(start - 1) if start else 0)
+
+    def intersects_range(self, start: int, end: int) -> bool:
+        start, end = _check_range(start, end)
+        if start >= end:
+            return False
+        nv = self.next_value(start)
+        return nv >= 0 and nv < end
+
+    # ------------------------------------------------------------------
+    # pairwise algebra (static, like the reference)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def and_(x1: "RoaringBitmap", x2: "RoaringBitmap") -> "RoaringBitmap":
+        """RoaringBitmap.and (RoaringBitmap.java:377): intersect keys, drop empties."""
+        out = RoaringBitmap()
+        a, b = x1.high_low_container, x2.high_low_container
+        ia = ib = 0
+        while ia < a.size and ib < b.size:
+            ka, kb = a.keys[ia], b.keys[ib]
+            if ka == kb:
+                c = a.containers[ia].and_(b.containers[ib])
+                if c.cardinality:
+                    out.high_low_container.append(ka, c)
+                ia += 1
+                ib += 1
+            elif ka < kb:
+                ia = a.advance_until(kb, ia)
+            else:
+                ib = b.advance_until(ka, ib)
+        return out
+
+    @staticmethod
+    def or_(x1: "RoaringBitmap", x2: "RoaringBitmap") -> "RoaringBitmap":
+        """RoaringBitmap.or (RoaringBitmap.java:860): two-pointer key merge."""
+        return RoaringBitmap._merge_op(x1, x2, "or")
+
+    @staticmethod
+    def xor(x1: "RoaringBitmap", x2: "RoaringBitmap") -> "RoaringBitmap":
+        return RoaringBitmap._merge_op(x1, x2, "xor")
+
+    @staticmethod
+    def _merge_op(x1, x2, op: str) -> "RoaringBitmap":
+        out = RoaringBitmap()
+        a, b = x1.high_low_container, x2.high_low_container
+        ia = ib = 0
+        while ia < a.size and ib < b.size:
+            ka, kb = a.keys[ia], b.keys[ib]
+            if ka == kb:
+                c = (
+                    a.containers[ia].or_(b.containers[ib])
+                    if op == "or"
+                    else a.containers[ia].xor_(b.containers[ib])
+                )
+                if c.cardinality:
+                    out.high_low_container.append(ka, c)
+                ia += 1
+                ib += 1
+            elif ka < kb:
+                out.high_low_container.append(ka, a.containers[ia].clone())
+                ia += 1
+            else:
+                out.high_low_container.append(kb, b.containers[ib].clone())
+                ib += 1
+        while ia < a.size:
+            out.high_low_container.append(a.keys[ia], a.containers[ia].clone())
+            ia += 1
+        while ib < b.size:
+            out.high_low_container.append(b.keys[ib], b.containers[ib].clone())
+            ib += 1
+        return out
+
+    @staticmethod
+    def andnot(x1: "RoaringBitmap", x2: "RoaringBitmap") -> "RoaringBitmap":
+        """RoaringBitmap.andNot (RoaringBitmap.java:444)."""
+        out = RoaringBitmap()
+        a, b = x1.high_low_container, x2.high_low_container
+        ia = ib = 0
+        while ia < a.size:
+            ka = a.keys[ia]
+            while ib < b.size and b.keys[ib] < ka:
+                ib += 1
+            if ib < b.size and b.keys[ib] == ka:
+                c = a.containers[ia].andnot(b.containers[ib])
+                if c.cardinality:
+                    out.high_low_container.append(ka, c)
+            else:
+                out.high_low_container.append(ka, a.containers[ia].clone())
+            ia += 1
+        return out
+
+    @staticmethod
+    def or_not(x1: "RoaringBitmap", x2: "RoaringBitmap", range_end: int) -> "RoaringBitmap":
+        """x1 | ~x2 over [0, range_end) (RoaringBitmap.orNot, RoaringBitmap.java:1521)."""
+        _, range_end = _check_range(0, range_end)
+        comp = RoaringBitmap.flip(x2, 0, range_end)
+        masked = RoaringBitmap()
+        masked.add_range(0, range_end)
+        comp = RoaringBitmap.and_(comp, masked)
+        return RoaringBitmap.or_(x1, comp)
+
+    @staticmethod
+    def and_cardinality(x1: "RoaringBitmap", x2: "RoaringBitmap") -> int:
+        """RoaringBitmap.andCardinality (RoaringBitmap.java:413)."""
+        total = 0
+        a, b = x1.high_low_container, x2.high_low_container
+        ia = ib = 0
+        while ia < a.size and ib < b.size:
+            ka, kb = a.keys[ia], b.keys[ib]
+            if ka == kb:
+                total += a.containers[ia].and_cardinality(b.containers[ib])
+                ia += 1
+                ib += 1
+            elif ka < kb:
+                ia = a.advance_until(kb, ia)
+            else:
+                ib = b.advance_until(ka, ib)
+        return total
+
+    @staticmethod
+    def or_cardinality(x1: "RoaringBitmap", x2: "RoaringBitmap") -> int:
+        """Inclusion-exclusion (RoaringBitmap.java:916)."""
+        return (
+            x1.get_cardinality()
+            + x2.get_cardinality()
+            - RoaringBitmap.and_cardinality(x1, x2)
+        )
+
+    @staticmethod
+    def xor_cardinality(x1: "RoaringBitmap", x2: "RoaringBitmap") -> int:
+        return (
+            x1.get_cardinality()
+            + x2.get_cardinality()
+            - 2 * RoaringBitmap.and_cardinality(x1, x2)
+        )
+
+    @staticmethod
+    def andnot_cardinality(x1: "RoaringBitmap", x2: "RoaringBitmap") -> int:
+        return x1.get_cardinality() - RoaringBitmap.and_cardinality(x1, x2)
+
+    @staticmethod
+    def intersects(x1: "RoaringBitmap", x2: "RoaringBitmap") -> bool:
+        """RoaringBitmap.intersects (RoaringBitmap.java:698)."""
+        a, b = x1.high_low_container, x2.high_low_container
+        ia = ib = 0
+        while ia < a.size and ib < b.size:
+            ka, kb = a.keys[ia], b.keys[ib]
+            if ka == kb:
+                if a.containers[ia].intersects(b.containers[ib]):
+                    return True
+                ia += 1
+                ib += 1
+            elif ka < kb:
+                ia = a.advance_until(kb, ia)
+            else:
+                ib = b.advance_until(ka, ib)
+        return False
+
+    # in-place variants + operators
+    def ior(self, other: "RoaringBitmap") -> "RoaringBitmap":
+        self.high_low_container = RoaringBitmap.or_(self, other).high_low_container
+        return self
+
+    def iand(self, other: "RoaringBitmap") -> "RoaringBitmap":
+        self.high_low_container = RoaringBitmap.and_(self, other).high_low_container
+        return self
+
+    def ixor(self, other: "RoaringBitmap") -> "RoaringBitmap":
+        self.high_low_container = RoaringBitmap.xor(self, other).high_low_container
+        return self
+
+    def iandnot(self, other: "RoaringBitmap") -> "RoaringBitmap":
+        self.high_low_container = RoaringBitmap.andnot(self, other).high_low_container
+        return self
+
+    __or__ = lambda self, o: RoaringBitmap.or_(self, o)
+    __and__ = lambda self, o: RoaringBitmap.and_(self, o)
+    __xor__ = lambda self, o: RoaringBitmap.xor(self, o)
+    __sub__ = lambda self, o: RoaringBitmap.andnot(self, o)
+    __ior__ = ior
+    __iand__ = iand
+    __ixor__ = ixor
+    __isub__ = iandnot
+
+    # ------------------------------------------------------------------
+    # cardinality / order statistics
+    # ------------------------------------------------------------------
+    def get_cardinality(self) -> int:
+        return sum(c.cardinality for c in self.high_low_container.containers)
+
+    def is_empty(self) -> bool:
+        return self.high_low_container.size == 0
+
+    def rank_long(self, x: int) -> int:
+        """Values <= x (RoaringBitmap.rank, RoaringBitmap.java:2622)."""
+        x = _check_value(x)
+        hb, lb = x >> 16, x & 0xFFFF
+        total = 0
+        hlc = self.high_low_container
+        for k, c in zip(hlc.keys, hlc.containers):
+            if k < hb:
+                total += c.cardinality
+            elif k == hb:
+                total += c.rank(lb)
+            else:
+                break
+        return total
+
+    rank = rank_long
+
+    def select(self, j: int) -> int:
+        """j-th smallest value, 0-based (RoaringBitmap.select, RoaringBitmap.java:2820)."""
+        j = int(j)
+        if j < 0:
+            raise IndexError(j)
+        hlc = self.high_low_container
+        for k, c in zip(hlc.keys, hlc.containers):
+            card = c.cardinality
+            if j < card:
+                return (k << 16) | c.select(j)
+            j -= card
+        raise IndexError("select out of range")
+
+    def first(self) -> int:
+        if self.is_empty():
+            raise ValueError("empty bitmap")
+        hlc = self.high_low_container
+        return (hlc.keys[0] << 16) | hlc.containers[0].first()
+
+    def last(self) -> int:
+        if self.is_empty():
+            raise ValueError("empty bitmap")
+        hlc = self.high_low_container
+        return (hlc.keys[-1] << 16) | hlc.containers[-1].last()
+
+    def next_value(self, from_value: int) -> int:
+        """Smallest value >= from_value, or -1 (RoaringBitmap.java:2838)."""
+        from_value = _check_value(from_value)
+        hb, lb = from_value >> 16, from_value & 0xFFFF
+        hlc = self.high_low_container
+        i = hlc.get_index(hb)
+        start = i if i >= 0 else -i - 1
+        for j in range(start, hlc.size):
+            k = hlc.keys[j]
+            v = hlc.containers[j].next_value(lb if k == hb else 0)
+            if v >= 0:
+                return (k << 16) | v
+        return -1
+
+    def previous_value(self, from_value: int) -> int:
+        from_value = _check_value(from_value)
+        hb, lb = from_value >> 16, from_value & 0xFFFF
+        hlc = self.high_low_container
+        i = hlc.get_index(hb)
+        start = i if i >= 0 else -i - 2
+        for j in range(start, -1, -1):
+            k = hlc.keys[j]
+            v = hlc.containers[j].previous_value(lb if k == hb else 0xFFFF)
+            if v >= 0:
+                return (k << 16) | v
+        return -1
+
+    def next_absent_value(self, from_value: int) -> int:
+        from_value = _check_value(from_value)
+        x = from_value
+        while x < _MAX32:
+            hb, lb = x >> 16, x & 0xFFFF
+            c = self.high_low_container.get_container(hb)
+            if c is None:
+                return x
+            v = c.next_absent_value(lb)
+            if v < (1 << 16):
+                return (hb << 16) | v
+            x = (hb + 1) << 16
+        return -1
+
+    def previous_absent_value(self, from_value: int) -> int:
+        from_value = _check_value(from_value)
+        x = from_value
+        while x >= 0:
+            hb, lb = x >> 16, x & 0xFFFF
+            c = self.high_low_container.get_container(hb)
+            if c is None:
+                return x
+            v = c.previous_absent_value(lb)
+            if v >= 0:
+                return (hb << 16) | v
+            x = (hb << 16) - 1
+        return -1
+
+    # ------------------------------------------------------------------
+    # structural ops
+    # ------------------------------------------------------------------
+    @staticmethod
+    def add_offset(bm: "RoaringBitmap", offset: int) -> "RoaringBitmap":
+        """Shift all values by a (possibly negative) offset, dropping values
+        leaving the 32-bit universe (RoaringBitmap.addOffset, RoaringBitmap.java:230).
+
+        Each shifted container splits into a (low, high) pair
+        (Util.addOffset, Util.java:32-45) — realized here vectorized on the
+        value arrays.
+        """
+        offset = int(offset)
+        out = RoaringBitmap()
+        hlc = bm.high_low_container
+        pieces = {}
+        for k, c in zip(hlc.keys, hlc.containers):
+            vals = c.to_array().astype(np.int64) + (k << 16) + offset
+            vals = vals[(vals >= 0) & (vals < _MAX32)]
+            if vals.size == 0:
+                continue
+            keys = vals >> 16
+            for key in np.unique(keys):
+                chunk = (vals[keys == key] & 0xFFFF).astype(np.uint16)
+                if int(key) in pieces:
+                    pieces[int(key)] = np.concatenate([pieces[int(key)], chunk])
+                else:
+                    pieces[int(key)] = chunk
+        for key in sorted(pieces):
+            out.high_low_container.append(
+                key, container_from_values(np.sort(pieces[key]))
+            )
+        return out
+
+    def limit(self, max_cardinality: int) -> "RoaringBitmap":
+        """Bitmap of the max_cardinality smallest values (RoaringBitmap.java:2457)."""
+        out = RoaringBitmap()
+        remaining = int(max_cardinality)
+        hlc = self.high_low_container
+        for k, c in zip(hlc.keys, hlc.containers):
+            if remaining <= 0:
+                break
+            card = c.cardinality
+            if card <= remaining:
+                out.high_low_container.append(k, c.clone())
+                remaining -= card
+            else:
+                out.high_low_container.append(
+                    k, container_from_values(c.to_array()[:remaining])
+                )
+                remaining = 0
+        return out
+
+    def select_range(self, start: int, end: int) -> "RoaringBitmap":
+        """Bitmap of values with rank in [start, end) (RoaringBitmap.selectRange,
+        RoaringBitmap.java:3095)."""
+        start, end = int(start), int(end)
+        card = self.get_cardinality()
+        if start >= card or start >= end:
+            return RoaringBitmap()
+        end = min(end, card)
+        arr = self.to_array()
+        return RoaringBitmap(arr[start:end])
+
+    def run_optimize(self) -> bool:
+        """Convert containers to their smallest form; True if any became a run
+        (RoaringBitmap.java:2764)."""
+        changed = False
+        hlc = self.high_low_container
+        for i, c in enumerate(hlc.containers):
+            n = c.run_optimize()
+            if isinstance(n, RunContainer) and not isinstance(c, RunContainer):
+                changed = True
+            hlc.set_container_at_index(i, n)
+        return changed
+
+    def remove_run_compression(self) -> bool:
+        changed = False
+        hlc = self.high_low_container
+        for i, c in enumerate(hlc.containers):
+            if isinstance(c, RunContainer):
+                hlc.set_container_at_index(i, c.to_efficient_non_run())
+                changed = True
+        return changed
+
+    def has_run_compression(self) -> bool:
+        return any(
+            isinstance(c, RunContainer) for c in self.high_low_container.containers
+        )
+
+    def contains_bitmap(self, subset: "RoaringBitmap") -> bool:
+        """True if subset ⊆ self (RoaringBitmap.contains(RoaringBitmap),
+        RoaringBitmap.java:2781)."""
+        a, b = self.high_low_container, subset.high_low_container
+        ib = 0
+        for kb, cb in zip(b.keys, b.containers):
+            i = a.get_index(kb)
+            if i < 0 or not a.containers[i].contains_container(cb):
+                return False
+        return True
+
+    def is_hamming_similar(self, other: "RoaringBitmap", tolerance: int) -> bool:
+        """|self XOR other| <= tolerance (RoaringBitmap.java:1831)."""
+        return RoaringBitmap.xor_cardinality(self, other) <= int(tolerance)
+
+    # ------------------------------------------------------------------
+    # iteration / export
+    # ------------------------------------------------------------------
+    def to_array(self) -> np.ndarray:
+        """All values, sorted, as uint32."""
+        hlc = self.high_low_container
+        if hlc.size == 0:
+            return np.empty(0, dtype=np.uint32)
+        parts = [
+            c.to_array().astype(np.uint32) + np.uint32(k << 16)
+            for k, c in zip(hlc.keys, hlc.containers)
+        ]
+        return np.concatenate(parts)
+
+    def __iter__(self) -> Iterator[int]:
+        for k, c in zip(
+            self.high_low_container.keys, self.high_low_container.containers
+        ):
+            base = k << 16
+            for v in c.to_array().tolist():
+                yield base | v
+
+    def __reversed__(self) -> Iterator[int]:
+        for k, c in zip(
+            reversed(self.high_low_container.keys),
+            reversed(self.high_low_container.containers),
+        ):
+            base = k << 16
+            for v in reversed(c.to_array().tolist()):
+                yield base | v
+
+    def batch_iterator(self, batch_size: int = 256) -> Iterator[np.ndarray]:
+        """Buffer-filling iteration (BatchIterator.nextBatch contract,
+        BatchIterator.java:12), yielding uint32 chunks."""
+        buf: List[np.ndarray] = []
+        count = 0
+        for k, c in zip(
+            self.high_low_container.keys, self.high_low_container.containers
+        ):
+            arr = c.to_array().astype(np.uint32) + np.uint32(k << 16)
+            buf.append(arr)
+            count += arr.size
+            while count >= batch_size:
+                joined = np.concatenate(buf) if len(buf) > 1 else buf[0]
+                yield joined[:batch_size]
+                rest = joined[batch_size:]
+                buf = [rest] if rest.size else []
+                count = rest.size
+        if count:
+            yield np.concatenate(buf) if len(buf) > 1 else buf[0]
+
+    # ------------------------------------------------------------------
+    # introspection (SURVEY §5 observability)
+    # ------------------------------------------------------------------
+    def get_container_count(self) -> int:
+        return self.high_low_container.size
+
+    def get_size_in_bytes(self) -> int:
+        from ..serialization import serialized_size_in_bytes
+
+        return serialized_size_in_bytes(self)
+
+    get_long_size_in_bytes = get_size_in_bytes
+
+    # serialization facade (implementation in serialization.py)
+    def serialize(self) -> bytes:
+        from ..serialization import serialize
+
+        return serialize(self)
+
+    @staticmethod
+    def deserialize(data) -> "RoaringBitmap":
+        from ..serialization import deserialize
+
+        return deserialize(data)
+
+    @staticmethod
+    def maximum_serialized_size(cardinality: int, universe_size: int) -> int:
+        from ..serialization import maximum_serialized_size
+
+        return maximum_serialized_size(cardinality, universe_size)
+
+    # ------------------------------------------------------------------
+    def __eq__(self, other):
+        if not isinstance(other, RoaringBitmap):
+            return NotImplemented
+        return self.high_low_container == other.high_low_container
+
+    def __hash__(self):
+        return hash(self.to_array().tobytes())
+
+    def __len__(self) -> int:
+        return self.get_cardinality()
+
+    def __contains__(self, x: int) -> bool:
+        return self.contains(x)
+
+    def __bool__(self) -> bool:
+        return not self.is_empty()
+
+    def __repr__(self) -> str:
+        card = self.get_cardinality()
+        head = ",".join(str(v) for v in self.to_array()[:10].tolist())
+        return f"RoaringBitmap(card={card}, values=[{head}{'...' if card > 10 else ''}])"

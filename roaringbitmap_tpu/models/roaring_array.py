@@ -1,0 +1,85 @@
+"""L2' key -> container index: sorted parallel arrays.
+
+Mirrors RoaringArray.java:22 — parallel sorted ``keys`` (high-16-bit chunk
+keys) and ``containers``. Host-side pure Python/bisect; tiny (at most 65536
+entries) and never on the device hot path.
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_left
+from typing import List, Tuple
+
+from .container import Container
+
+
+class RoaringArray:
+    __slots__ = ("keys", "containers")
+
+    def __init__(self):
+        self.keys: List[int] = []
+        self.containers: List[Container] = []
+
+    @property
+    def size(self) -> int:
+        return len(self.keys)
+
+    def get_index(self, key: int) -> int:
+        """Index of key, or -(insertion_point)-1 if absent (RoaringArray.java:749)."""
+        i = bisect_left(self.keys, key)
+        if i < len(self.keys) and self.keys[i] == key:
+            return i
+        return -i - 1
+
+    def get_container(self, key: int):
+        i = self.get_index(key)
+        return self.containers[i] if i >= 0 else None
+
+    def get_container_at_index(self, i: int) -> Container:
+        return self.containers[i]
+
+    def get_key_at_index(self, i: int) -> int:
+        return self.keys[i]
+
+    def set_container_at_index(self, i: int, c: Container) -> None:
+        self.containers[i] = c
+
+    def insert_new_key_value_at(self, i: int, key: int, c: Container) -> None:
+        self.keys.insert(i, key)
+        self.containers.insert(i, c)
+
+    def remove_at_index(self, i: int) -> None:
+        del self.keys[i]
+        del self.containers[i]
+
+    def remove_index_range(self, begin: int, end: int) -> None:
+        del self.keys[begin:end]
+        del self.containers[begin:end]
+
+    def append(self, key: int, c: Container) -> None:
+        """Append-only builder path (RoaringArray.java:111); key must exceed all
+        existing keys."""
+        if self.keys and key <= self.keys[-1]:
+            raise ValueError(f"append key {key} <= last key {self.keys[-1]}")
+        self.keys.append(key)
+        self.containers.append(c)
+
+    def advance_until(self, key: int, pos: int) -> int:
+        """First index > pos with keys[index] >= key (RoaringArray.java:64)."""
+        return bisect_left(self.keys, key, lo=pos + 1)
+
+    def clone(self) -> "RoaringArray":
+        out = RoaringArray()
+        out.keys = list(self.keys)
+        out.containers = [c.clone() for c in self.containers]
+        return out
+
+    def items(self) -> List[Tuple[int, Container]]:
+        return list(zip(self.keys, self.containers))
+
+    def __eq__(self, other):
+        if not isinstance(other, RoaringArray):
+            return NotImplemented
+        return self.keys == other.keys and all(
+            a == b for a, b in zip(self.containers, other.containers)
+        )

@@ -1,0 +1,3 @@
+from . import device
+
+__all__ = ["device"]

@@ -1,0 +1,174 @@
+"""L0' device kernels: batched word ops on ``[N, W]`` container blocks.
+
+This is the TPU re-expression of the reference's hot loops — the 1024-long
+word loop + popcount pass that underlies every wide aggregation
+(FastAggregation.java:602 naive lazy fold, BitmapContainer.ilazyor
+BitmapContainer.java:657-678, repairAfterLazy Container.java:873). Instead of
+folding bitmap-by-bitmap on one core, thousands of containers are packed into
+a single device array and reduced in one fused XLA computation; the
+"lazy cardinality" protocol (defer popcounts, repair once) is free here
+because popcount fuses into the tail of the reduction.
+
+Device layout: ``uint32 [N, 2048]`` — each row is one container
+(65536 bits); uint32 lanes suit the 8x128 VPU. Host words are ``uint64
+[1024]``; the views are interchangeable little-endian
+(u64 word k == u32[2k] | u32[2k+1] << 32).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+DEVICE_WORDS = 2048  # uint32 words per container row
+HOST_WORDS = 1024  # uint64 words per container
+
+_INIT = {
+    "or": np.uint32(0),
+    "xor": np.uint32(0),
+    "and": np.uint32(0xFFFFFFFF),
+}
+_OPS = {
+    "or": lax.bitwise_or,
+    "xor": lax.bitwise_xor,
+    "and": lax.bitwise_and,
+}
+
+
+def to_device_words(host_words: np.ndarray) -> np.ndarray:
+    """uint64 [..., 1024] host words -> uint32 [..., 2048] device layout."""
+    w = np.ascontiguousarray(host_words, dtype=np.uint64)
+    return w.view(np.uint32).reshape(*w.shape[:-1], DEVICE_WORDS)
+
+
+def from_device_words(dev_words) -> np.ndarray:
+    """uint32 [..., 2048] -> uint64 [..., 1024] host words."""
+    w = np.ascontiguousarray(np.asarray(dev_words), dtype=np.uint32)
+    return w.view(np.uint64).reshape(*w.shape[:-1], HOST_WORDS)
+
+
+# ---------------------------------------------------------------------------
+# elementwise pairwise ops (batched): [N, W] op [N, W]
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def batched_or(a, b):
+    return a | b
+
+
+@jax.jit
+def batched_and(a, b):
+    return a & b
+
+
+@jax.jit
+def batched_xor(a, b):
+    return a ^ b
+
+
+@jax.jit
+def batched_andnot(a, b):
+    return a & ~b
+
+
+@jax.jit
+def popcount_rows(words):
+    """Per-row cardinality: fused population_count + row sum."""
+    return jnp.sum(lax.population_count(words).astype(jnp.int32), axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# wide reductions over the container axis
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("op",))
+def wide_reduce(words, op: str = "or"):
+    """Reduce [N, W] -> [W] with a bitwise op (the wide-OR/AND/XOR kernel)."""
+    return lax.reduce(words, _INIT[op], _OPS[op], dimensions=(0,))
+
+
+@functools.partial(jax.jit, static_argnames=("op",))
+def wide_reduce_with_cardinality(words, op: str = "or"):
+    """Fused reduce + popcount: returns (result [W], cardinality scalar).
+
+    The reference does this as a lazy fold + repairAfterLazy
+    (FastAggregation.java:541-602); XLA fuses the popcount into the
+    reduction epilogue so "lazy mode" needs no protocol here.
+    """
+    red = lax.reduce(words, _INIT[op], _OPS[op], dimensions=(0,))
+    card = jnp.sum(lax.population_count(red).astype(jnp.int32))
+    return red, card
+
+
+@functools.partial(jax.jit, static_argnames=("op",))
+def grouped_reduce(words3, op: str = "or"):
+    """Reduce padded groups: [G, M, W] -> [G, W].
+
+    Pad rows with the op identity (0 for or/xor, all-ones for and). This is
+    the device analogue of ParallelAggregation.groupByKey + per-key reduce
+    (ParallelAggregation.java:136-175): key-groups become the G axis.
+    """
+    return lax.reduce(words3, _INIT[op], _OPS[op], dimensions=(1,))
+
+
+@functools.partial(jax.jit, static_argnames=("op",))
+def grouped_reduce_with_cardinality(words3, op: str = "or"):
+    red = lax.reduce(words3, _INIT[op], _OPS[op], dimensions=(1,))
+    card = jnp.sum(lax.population_count(red).astype(jnp.int32), axis=-1)
+    return red, card
+
+
+@functools.partial(jax.jit, static_argnames=("op",))
+def segmented_reduce(words, seg_start, op: str = "or"):
+    """Segmented reduce over sorted segments without padding.
+
+    ``words``: [N, W]; ``seg_start``: bool [N], True at the first row of each
+    segment. Returns [N, W] where the row at each segment's END holds the
+    segment reduction (gather those rows host-side). Implemented as a
+    flagged ``lax.associative_scan`` — O(N log N) word-ops, fully parallel,
+    for key-group distributions too skewed to pad densely
+    (the reference splits skewed slices across the pool instead,
+    ParallelAggregation.java:222-228).
+    """
+    fn = _OPS[op]
+
+    def combine(a, b):
+        flag_a, val_a = a
+        flag_b, val_b = b
+        val = jnp.where(flag_b[:, None], val_b, fn(val_a, val_b))
+        return flag_a | flag_b, val
+
+    _, vals = lax.associative_scan(combine, (seg_start, words), axis=0)
+    return vals
+
+
+# ---------------------------------------------------------------------------
+# batched rank / select support
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def rank_rows(words, positions):
+    """Per-row rank: number of set bits at index <= position (int32 [N]).
+
+    Batched analogue of BitmapContainer.rank: mask words beyond the position,
+    popcount-sum each row.
+    """
+    n_words = words.shape[-1]
+    word_idx = positions // 32
+    bit_idx = positions % 32
+    iota = jnp.arange(n_words, dtype=jnp.int32)[None, :]
+    full = (iota < word_idx[:, None]).astype(jnp.uint32) * jnp.uint32(0xFFFFFFFF)
+    partial_mask = jnp.where(
+        iota == word_idx[:, None],
+        (jnp.uint32(0xFFFFFFFF) >> (jnp.uint32(31) - bit_idx[:, None].astype(jnp.uint32))),
+        jnp.uint32(0),
+    )
+    masked = words & (full | partial_mask)
+    return jnp.sum(lax.population_count(masked).astype(jnp.int32), axis=-1)

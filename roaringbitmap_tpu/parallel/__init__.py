@@ -1,0 +1,3 @@
+from . import store, aggregation
+
+__all__ = ["store", "aggregation"]

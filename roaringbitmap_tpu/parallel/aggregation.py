@@ -1,0 +1,248 @@
+"""L4' aggregation engines: N-way AND/OR/XOR with algorithm selection.
+
+API parity with FastAggregation (FastAggregation.java:15) and
+ParallelAggregation (ParallelAggregation.java:39). The reference picks among
+fold strategies (naive lazy fold :541, horizontal priority-queue merge :183,
+workShyAnd key-intersection :356); here the strategic choice is CPU vs
+device:
+
+* **CPU path** — key-major transpose, then an in-place word fold per group
+  with one popcount at the end: the direct analogue of the lazy-OR protocol
+  (Container.lazyIOR Container.java:717, repairAfterLazy :873) expressed as
+  vectorized numpy.
+* **Device path** — pack all groups into one ``[N, 2048]`` uint32 device
+  array (parallel/store.py) and run a single fused batched reduction +
+  popcount (ops/device.py, ops/pallas_kernels.py). This is the north-star
+  configuration (BASELINE.md).
+
+`workShyAnd`'s key trick (intersect keys first, only then touch containers,
+FastAggregation.java:356-396) is kept verbatim in spirit: AND packs only the
+key-intersection groups, which also makes every group exactly B rows — a
+dense, padding-free device layout.
+
+ParallelAggregation re-expresses the reference's fork-join per-key reduce as
+a thread pool over key groups on CPU (numpy releases the GIL) and as the
+same single batched kernel on device — the degenerate case where the
+"fork-join pool" is the VPU grid itself.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..models.container import (
+    ArrayContainer,
+    BitmapContainer,
+    Container,
+    RunContainer,
+    best_container_of_words,
+)
+from ..models.roaring import RoaringBitmap
+from ..utils import bits
+from . import store
+
+
+class config:
+    """Dispatcher knobs (the reference's analogue is compile-time constants +
+    the >10-input workShyAnd switch, FastAggregation.java:37-42)."""
+
+    mode: str = "auto"  # 'auto' | 'cpu' | 'device'
+    min_device_containers: int = 64
+
+
+def _use_device(n_containers: int, mode: Optional[str]) -> bool:
+    mode = mode or config.mode
+    if mode == "cpu":
+        return False
+    if mode == "device":
+        return True
+    try:
+        import jax
+
+        backend = jax.default_backend()
+    except Exception:
+        # jax present but no usable backend (e.g. stale JAX_PLATFORMS in the
+        # environment) — the CPU word-fold path needs no jax at all.
+        return False
+    return backend != "cpu" and n_containers >= config.min_device_containers
+
+
+# ---------------------------------------------------------------------------
+# CPU word folds (lazy-OR protocol analogue)
+# ---------------------------------------------------------------------------
+
+
+def _fold_group_words(cs: List[Container], op: str) -> np.ndarray:
+    """In-place word fold of one key group; popcount deferred to the caller."""
+    first = cs[0]
+    acc = first.to_words()  # always a copy
+    if op == "or":
+        for c in cs[1:]:
+            if isinstance(c, BitmapContainer):
+                acc |= c.words
+            elif isinstance(c, ArrayContainer):
+                v = c.content.astype(np.uint32)
+                np.bitwise_or.at(
+                    acc, v >> 6, np.uint64(1) << (v & np.uint32(63)).astype(np.uint64)
+                )
+            else:
+                for s, l in zip(c.starts.tolist(), c.lengths.tolist()):
+                    bits.set_bitmap_range(acc, s, s + l + 1)
+    elif op == "and":
+        for c in cs[1:]:
+            acc &= c.words if isinstance(c, BitmapContainer) else c.to_words()
+    else:  # xor
+        for c in cs[1:]:
+            acc ^= c.words if isinstance(c, BitmapContainer) else c.to_words()
+    return acc
+
+
+def _cpu_aggregate(
+    groups: Dict[int, List[Container]], op: str, pool: Optional[ThreadPoolExecutor] = None
+) -> RoaringBitmap:
+    out = RoaringBitmap()
+    keys = sorted(groups)
+
+    def reduce_key(k: int) -> Container:
+        cs = groups[k]
+        if len(cs) == 1:
+            return cs[0].clone()
+        return best_container_of_words(_fold_group_words(cs, op))
+
+    if pool is None:
+        results = [reduce_key(k) for k in keys]
+    else:
+        results = list(pool.map(reduce_key, keys))
+    for k, c in zip(keys, results):
+        if c.cardinality:
+            out.high_low_container.append(k, c)
+    return out
+
+
+def _device_aggregate(groups: Dict[int, List[Container]], op: str) -> RoaringBitmap:
+    packed = store.pack_groups(groups)
+    words, cards = store.reduce_packed(packed, op=op)
+    return store.unpack_to_bitmap(packed.group_keys, words, cards)
+
+
+def _aggregate(
+    bitmaps: Sequence[RoaringBitmap],
+    op: str,
+    mode: Optional[str] = None,
+    pool: Optional[ThreadPoolExecutor] = None,
+) -> RoaringBitmap:
+    bitmaps = [b for b in bitmaps]
+    if not bitmaps:
+        return RoaringBitmap()
+    if len(bitmaps) == 1:
+        return bitmaps[0].clone()
+    if op == "and":
+        keys = store.intersect_keys(bitmaps)
+        if not keys:
+            return RoaringBitmap()
+        groups = store.group_by_key(bitmaps, keys_filter=keys)
+    else:
+        groups = store.group_by_key(bitmaps)
+    n = sum(len(v) for v in groups.values())
+    if _use_device(n, mode):
+        return _device_aggregate(groups, op)
+    return _cpu_aggregate(groups, op, pool=pool)
+
+
+# ---------------------------------------------------------------------------
+# public engines
+# ---------------------------------------------------------------------------
+
+
+class FastAggregation:
+    """N-way aggregation (FastAggregation.java:15). All strategy entry points
+    of the reference are kept as callable names; they share the batched
+    engine (the strategy distinction that matters here is CPU vs device,
+    chosen by the dispatcher)."""
+
+    @staticmethod
+    def or_(*bitmaps: RoaringBitmap, mode: Optional[str] = None) -> RoaringBitmap:
+        """FastAggregation.or (FastAggregation.java:602)."""
+        return _aggregate(_flatten(bitmaps), "or", mode)
+
+    @staticmethod
+    def and_(*bitmaps: RoaringBitmap, mode: Optional[str] = None) -> RoaringBitmap:
+        """FastAggregation.and — workShy key intersection for many inputs
+        (FastAggregation.java:37-42, :356-396)."""
+        return _aggregate(_flatten(bitmaps), "and", mode)
+
+    @staticmethod
+    def xor(*bitmaps: RoaringBitmap, mode: Optional[str] = None) -> RoaringBitmap:
+        return _aggregate(_flatten(bitmaps), "xor", mode)
+
+    # strategy aliases of the reference (same results by construction)
+    naive_or = or_
+    horizontal_or = or_
+    priorityqueue_or = or_
+    naive_and = and_
+    workshy_and = and_
+    naive_xor = xor
+    horizontal_xor = xor
+
+    @staticmethod
+    def and_cardinality(*bitmaps: RoaringBitmap) -> int:
+        """FastAggregation.andCardinality (FastAggregation.java:71)."""
+        return FastAggregation.and_(*bitmaps).get_cardinality()
+
+    @staticmethod
+    def or_cardinality(*bitmaps: RoaringBitmap) -> int:
+        """FastAggregation.orCardinality (FastAggregation.java:90)."""
+        return FastAggregation.or_(*bitmaps).get_cardinality()
+
+
+def _flatten(bitmaps) -> List[RoaringBitmap]:
+    if len(bitmaps) == 1 and not isinstance(bitmaps[0], RoaringBitmap):
+        return list(bitmaps[0])
+    return list(bitmaps)
+
+
+class ParallelAggregation:
+    """Fork-join N-way OR/XOR (ParallelAggregation.java:39).
+
+    On CPU the per-key reduction runs on a thread pool (numpy word folds
+    release the GIL); on device it is the same single batched kernel as
+    FastAggregation — the TPU grid is the pool. No parallel AND, matching
+    the reference's judgement (ParallelAggregation.java:16-17); `and_`
+    delegates to FastAggregation."""
+
+    _POOL_SIZE = 8
+
+    @staticmethod
+    def group_by_key(*bitmaps: RoaringBitmap) -> Dict[int, List[Container]]:
+        """ParallelAggregation.groupByKey (ParallelAggregation.java:136)."""
+        return store.group_by_key(_flatten(bitmaps))
+
+    @staticmethod
+    def or_(*bitmaps: RoaringBitmap, mode: Optional[str] = None) -> RoaringBitmap:
+        """ParallelAggregation.or (ParallelAggregation.java:160)."""
+        return ParallelAggregation._run(_flatten(bitmaps), "or", mode)
+
+    @staticmethod
+    def xor(*bitmaps: RoaringBitmap, mode: Optional[str] = None) -> RoaringBitmap:
+        """ParallelAggregation.xor (ParallelAggregation.java:180)."""
+        return ParallelAggregation._run(_flatten(bitmaps), "xor", mode)
+
+    @staticmethod
+    def and_(*bitmaps: RoaringBitmap, mode: Optional[str] = None) -> RoaringBitmap:
+        return FastAggregation.and_(*bitmaps, mode=mode)
+
+    @staticmethod
+    def _run(bitmaps, op, mode):
+        if not bitmaps:
+            return RoaringBitmap()
+        if len(bitmaps) == 1:
+            return bitmaps[0].clone()
+        groups = store.group_by_key(bitmaps)
+        n = sum(len(v) for v in groups.values())
+        if _use_device(n, mode):
+            return _device_aggregate(groups, op)
+        with ThreadPoolExecutor(max_workers=ParallelAggregation._POOL_SIZE) as pool:
+            return _cpu_aggregate(groups, op, pool=pool)

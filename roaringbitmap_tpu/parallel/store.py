@@ -1,0 +1,185 @@
+"""Device container store: SoA packing of containers onto the TPU.
+
+The architectural inversion at the heart of this framework (SURVEY §7): the
+reference walks containers pointer-by-pointer per bitmap
+(ParallelAggregation.groupByKey, ParallelAggregation.java:136-153); here all
+containers of a working set are transposed host-side into key-major order and
+packed into ONE dense ``uint32 [N, 2048]`` device array plus small host-side
+key/group tables. Aggregations then run as a single fused XLA/Pallas
+computation over the whole set (ops/device.py) instead of a per-container
+virtual-dispatch fold.
+
+Array and run containers are expanded to bitmap words during packing — the
+``toBitmapContainer`` analogue (Container.java:987) — because on TPU the
+dense form is the only one the VPU can chew on; results are re-compressed to
+the best container form when streamed back (best_container_of_words, the
+``repairAfterLazy`` + conversion step).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..models.container import BitmapContainer, Container
+from ..models.roaring import RoaringBitmap
+from ..ops import device as dev
+from ..utils import bits
+
+
+def container_words_u32(c: Container) -> np.ndarray:
+    """Expand any container to the uint32[2048] device word layout."""
+    if isinstance(c, BitmapContainer):
+        w = c.words
+    else:
+        w = c.to_words()
+    return np.ascontiguousarray(w, dtype=np.uint64).view(np.uint32)
+
+
+@dataclass
+class PackedGroups:
+    """Key-grouped containers packed for device reduction.
+
+    ``words``: device uint32 [N, 2048], rows sorted by group.
+    ``group_keys``: int64 [G] high-16-bit chunk keys, ascending.
+    ``group_offsets``: int64 [G+1] row ranges per group.
+    """
+
+    words: jnp.ndarray
+    group_keys: np.ndarray
+    group_offsets: np.ndarray
+
+    @property
+    def n_rows(self) -> int:
+        return int(self.group_offsets[-1])
+
+    @property
+    def n_groups(self) -> int:
+        return len(self.group_keys)
+
+
+def group_by_key(
+    bitmaps: Sequence[RoaringBitmap], keys_filter: Optional[set] = None
+) -> Dict[int, List[Container]]:
+    """Transpose bitmaps into key-major groups
+    (ParallelAggregation.groupByKey, ParallelAggregation.java:136-153)."""
+    groups: Dict[int, List[Container]] = {}
+    for bm in bitmaps:
+        hlc = bm.high_low_container
+        for k, c in zip(hlc.keys, hlc.containers):
+            if keys_filter is not None and k not in keys_filter:
+                continue
+            groups.setdefault(k, []).append(c)
+    return groups
+
+
+def intersect_keys(bitmaps: Sequence[RoaringBitmap]) -> set:
+    """Keys present in every input (Util.intersectKeys analogue,
+    Util.java:1244-1259) — the workShyAnd pre-filter."""
+    it = iter(bitmaps)
+    first = next(it)
+    keys = set(first.high_low_container.keys)
+    for bm in it:
+        keys &= set(bm.high_low_container.keys)
+        if not keys:
+            break
+    return keys
+
+
+def pack_groups(groups: Dict[int, List[Container]]) -> PackedGroups:
+    """Pack key-major groups into one device array (host -> device marshal)."""
+    group_keys = np.array(sorted(groups), dtype=np.int64)
+    counts = np.array([len(groups[int(k)]) for k in group_keys], dtype=np.int64)
+    offsets = np.concatenate(([0], np.cumsum(counts)))
+    n = int(offsets[-1])
+    host = np.empty((n, dev.DEVICE_WORDS), dtype=np.uint32)
+    row = 0
+    for k in group_keys:
+        for c in groups[int(k)]:
+            host[row] = container_words_u32(c)
+            row += 1
+    return PackedGroups(jnp.asarray(host), group_keys, offsets)
+
+
+def prepare_reduce(packed: PackedGroups, op: str = "or"):
+    """Build the device reduction closure for a packed group set.
+
+    Returns ``(run, layout)`` where ``run()`` -> (reduced [G, 2048] device
+    array, cards [G] device array) and ``layout`` is ``"padded"`` or
+    ``"segmented-scan"``. The choice: dense padded [G, M, 2048] + identity
+    padding when padding waste is bounded, else a flagged associative scan
+    (the reference's answer to skew is splitting slices across the fork-join
+    pool, ParallelAggregation.java:222-228). bench.py times exactly this
+    closure, so the benchmark and production always run the same path.
+    """
+    g = packed.n_groups
+    n = packed.n_rows
+    counts = np.diff(packed.group_offsets)
+    m = int(counts.max()) if g else 0
+    if g * m <= max(2 * n, 1024):
+        fill = dev._INIT[op]
+        host = np.asarray(packed.words)
+        padded = np.full((g, m, dev.DEVICE_WORDS), fill, dtype=np.uint32)
+        for gi in range(g):
+            s, e = int(packed.group_offsets[gi]), int(packed.group_offsets[gi + 1])
+            padded[gi, : e - s] = host[s:e]
+        dev_arr = jnp.asarray(padded)
+
+        def run():
+            return dev.grouped_reduce_with_cardinality(dev_arr, op=op)
+
+        return run, "padded"
+
+    seg_start = np.zeros(n, dtype=bool)
+    seg_start[packed.group_offsets[:-1]] = True
+    seg = jnp.asarray(seg_start)
+    end_rows = jnp.asarray(packed.group_offsets[1:] - 1)
+    words = packed.words
+
+    def run():
+        vals = dev.segmented_reduce(words, seg, op=op)
+        red = vals[end_rows]
+        return red, dev.popcount_rows(red)
+
+    return run, "segmented-scan"
+
+
+def reduce_packed(packed: PackedGroups, op: str = "or"):
+    """Reduce each key group on device; returns (words [G,2048] np.uint32,
+    cards [G] np.int64)."""
+    if packed.n_groups == 0:
+        return (
+            np.empty((0, dev.DEVICE_WORDS), dtype=np.uint32),
+            np.empty((0,), dtype=np.int64),
+        )
+    run, _ = prepare_reduce(packed, op)
+    red, card = run()
+    return np.asarray(red), np.asarray(card).astype(np.int64)
+
+
+def unpack_to_bitmap(
+    group_keys: np.ndarray, words_u32: np.ndarray, cards: np.ndarray
+) -> RoaringBitmap:
+    """Stream device results back into a RoaringBitmap via the append path
+    (RoaringArray.append, RoaringArray.java:111), re-compressing each chunk."""
+    from ..models.container import ArrayContainer, best_container_of_words
+
+    out = RoaringBitmap()
+    words64 = np.ascontiguousarray(words_u32).view(np.uint64)
+    for gi, key in enumerate(group_keys.tolist()):
+        card = int(cards[gi])
+        if card == 0:
+            continue
+        w = words64[gi]
+        if card <= 4096:
+            out.high_low_container.append(
+                int(key), ArrayContainer(bits.values_from_words(w))
+            )
+        else:
+            out.high_low_container.append(
+                int(key), BitmapContainer(w.copy(), card)
+            )
+    return out

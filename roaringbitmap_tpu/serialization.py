@@ -1,0 +1,264 @@
+"""RoaringFormatSpec serialization — the portable wire/checkpoint format.
+
+Byte-exact implementation of the reference's portable format
+(RoaringArray.serialize, RoaringArray.java:851-940; spec README.md:47):
+
+* cookie ``12347`` (``SERIAL_COOKIE``, RoaringArray.java:23) packed with
+  ``size-1`` in the high 16 bits when any run container is present, followed
+  by a run-marker bitset of ``ceil(size/8)`` bytes;
+* cookie ``12346`` (``SERIAL_COOKIE_NO_RUNCONTAINER``) + 4-byte size
+  otherwise;
+* descriptive header: per container ``uint16 key, uint16 cardinality-1``;
+* offset header (4-byte absolute offsets): always present without runs;
+  with runs only when ``size >= NO_OFFSET_THRESHOLD`` (=4,
+  RoaringArray.java:25);
+* payloads in key order: sorted ``uint16`` values (array), 1024 ``uint64``
+  words (bitmap), or ``uint16 n_runs`` + (start, length) pairs (run).
+  Non-run containers with cardinality > 4096 are bitmaps — the same rule
+  readers use to pick the decoder.
+
+All integers little-endian. Untrusted input is validated the way the
+reference's cookie checks are (InvalidRoaringFormat, RoaringArray.java:276+),
+exercised against the reference's ``crashproneinput*.bin`` corpus.
+
+This format is also this framework's checkpoint/resume story (SURVEY §5) and
+the host<->device marshalling boundary: ``parallel/store.py`` packs device
+arrays straight from the parsed container payloads.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Union
+
+import numpy as np
+
+from .models.container import (
+    ARRAY_MAX_SIZE,
+    ArrayContainer,
+    BitmapContainer,
+    Container,
+    RunContainer,
+)
+from .models.roaring import RoaringBitmap
+
+SERIAL_COOKIE = 12347  # RoaringArray.java:23
+SERIAL_COOKIE_NO_RUNCONTAINER = 12346  # RoaringArray.java:24
+NO_OFFSET_THRESHOLD = 4  # RoaringArray.java:25
+_MAX_CONTAINERS = 1 << 16
+
+
+class InvalidRoaringFormat(ValueError):
+    """Raised on malformed serialized input (InvalidRoaringFormat.java)."""
+
+
+def _container_payload(c: Container) -> bytes:
+    # Payload kind follows the spec's reader rule (run marker, else
+    # cardinality > 4096 -> bitmap, else array) — independent of the
+    # in-memory class, so low-cardinality BitmapContainers round-trip.
+    if isinstance(c, RunContainer):
+        n = c.num_runs()
+        out = struct.pack("<H", n)
+        if n:
+            pairs = np.empty(2 * n, dtype=np.uint16)
+            pairs[0::2] = c.starts
+            pairs[1::2] = c.lengths
+            out += pairs.astype("<u2").tobytes()
+        return out
+    if c.cardinality > ARRAY_MAX_SIZE:
+        if isinstance(c, BitmapContainer):
+            return c.words.astype("<u8").tobytes()
+        return c.to_words().astype("<u8").tobytes()
+    return c.to_array().astype("<u2").tobytes()
+
+
+def _payload_size(c: Container) -> int:
+    if isinstance(c, RunContainer):
+        return 2 + 4 * c.num_runs()
+    if c.cardinality > ARRAY_MAX_SIZE:
+        return 8192
+    return 2 * c.cardinality
+
+
+def serialized_size_in_bytes(bm: RoaringBitmap) -> int:
+    """Size of serialize(bm) without materializing it
+    (RoaringBitmap.serializedSizeInBytes)."""
+    hlc = bm.high_low_container
+    size = hlc.size
+    has_run = any(isinstance(c, RunContainer) for c in hlc.containers)
+    if has_run:
+        total = 4 + (size + 7) // 8 + 4 * size
+        if size >= NO_OFFSET_THRESHOLD:
+            total += 4 * size
+    else:
+        total = 8 + 4 * size + 4 * size
+    return total + sum(_payload_size(c) for c in hlc.containers)
+
+
+def serialize(bm: RoaringBitmap) -> bytes:
+    """Portable serialization (RoaringArray.serialize, RoaringArray.java:851-887)."""
+    hlc = bm.high_low_container
+    size = hlc.size
+    containers = hlc.containers
+    keys = hlc.keys
+    has_run = any(isinstance(c, RunContainer) for c in containers)
+
+    parts = []
+    if has_run:
+        parts.append(struct.pack("<I", SERIAL_COOKIE | ((size - 1) << 16)))
+        marker = bytearray((size + 7) // 8)
+        for i, c in enumerate(containers):
+            if isinstance(c, RunContainer):
+                marker[i // 8] |= 1 << (i % 8)
+        parts.append(bytes(marker))
+        header_size = 4 + len(marker) + 4 * size
+        include_offsets = size >= NO_OFFSET_THRESHOLD
+        if include_offsets:
+            header_size += 4 * size
+    else:
+        parts.append(struct.pack("<II", SERIAL_COOKIE_NO_RUNCONTAINER, size))
+        header_size = 8 + 4 * size + 4 * size
+        include_offsets = True
+
+    desc = np.empty(2 * size, dtype="<u2")
+    for i, (k, c) in enumerate(zip(keys, containers)):
+        desc[2 * i] = k
+        desc[2 * i + 1] = c.cardinality - 1
+    parts.append(desc.tobytes())
+
+    if include_offsets:
+        offsets = np.empty(size, dtype="<u4")
+        pos = header_size
+        for i, c in enumerate(containers):
+            offsets[i] = pos
+            pos += _payload_size(c)
+        parts.append(offsets.tobytes())
+
+    for c in containers:
+        parts.append(_container_payload(c))
+    return b"".join(parts)
+
+
+def _need(buf: memoryview, pos: int, n: int) -> None:
+    if pos + n > len(buf):
+        raise InvalidRoaringFormat(
+            f"truncated input: need {n} bytes at offset {pos}, have {len(buf) - pos}"
+        )
+
+
+def deserialize(data: Union[bytes, bytearray, memoryview, np.ndarray]) -> RoaringBitmap:
+    """Parse the portable format (RoaringArray.deserialize,
+    RoaringArray.java:276/361/547), validating untrusted input."""
+    bm = RoaringBitmap()
+    read_into(bm, data)
+    return bm
+
+
+def read_into(bm: RoaringBitmap, data) -> int:
+    """Fill ``bm`` from serialized bytes; returns bytes consumed."""
+    if isinstance(data, np.ndarray):
+        data = data.tobytes()
+    buf = memoryview(data).cast("B")
+    pos = 0
+    _need(buf, pos, 4)
+    (cookie,) = struct.unpack_from("<I", buf, pos)
+    pos += 4
+
+    if (cookie & 0xFFFF) == SERIAL_COOKIE:
+        size = (cookie >> 16) + 1
+        has_run = True
+        _need(buf, pos, (size + 7) // 8)
+        run_marker = bytes(buf[pos : pos + (size + 7) // 8])
+        pos += (size + 7) // 8
+    elif cookie == SERIAL_COOKIE_NO_RUNCONTAINER:
+        _need(buf, pos, 4)
+        (size,) = struct.unpack_from("<I", buf, pos)
+        pos += 4
+        has_run = False
+        run_marker = b""
+    else:
+        raise InvalidRoaringFormat(f"invalid cookie {cookie}")
+
+    if size > _MAX_CONTAINERS:
+        raise InvalidRoaringFormat(f"container count {size} exceeds 65536")
+
+    _need(buf, pos, 4 * size)
+    desc = np.frombuffer(buf, dtype="<u2", count=2 * size, offset=pos)
+    pos += 4 * size
+    keys = desc[0::2].astype(np.int64)
+    cards = desc[1::2].astype(np.int64) + 1
+    if size and np.any(np.diff(keys) <= 0):
+        raise InvalidRoaringFormat("container keys not strictly increasing")
+
+    include_offsets = (not has_run) or size >= NO_OFFSET_THRESHOLD
+    if include_offsets:
+        _need(buf, pos, 4 * size)
+        pos += 4 * size  # offsets are redundant for sequential parse
+
+    hlc = bm.high_low_container
+    hlc.keys = []
+    hlc.containers = []
+    for i in range(size):
+        key = int(keys[i])
+        card = int(cards[i])
+        is_run = has_run and bool(run_marker[i // 8] & (1 << (i % 8)))
+        if is_run:
+            _need(buf, pos, 2)
+            (n_runs,) = struct.unpack_from("<H", buf, pos)
+            pos += 2
+            _need(buf, pos, 4 * n_runs)
+            pairs = np.frombuffer(buf, dtype="<u2", count=2 * n_runs, offset=pos).astype(
+                np.uint16
+            )
+            pos += 4 * n_runs
+            starts, lengths = pairs[0::2], pairs[1::2]
+            s64 = starts.astype(np.int64)
+            ends = s64 + lengths.astype(np.int64)
+            if n_runs and (
+                np.any(s64[1:] <= ends[:-1])  # overlapping/touching runs
+                or np.any(ends > 0xFFFF)
+            ):
+                raise InvalidRoaringFormat("invalid run container")
+            c: Container = RunContainer(starts, lengths)
+        elif card > ARRAY_MAX_SIZE:
+            _need(buf, pos, 8192)
+            words = np.frombuffer(buf, dtype="<u8", count=1024, offset=pos).astype(
+                np.uint64
+            )
+            pos += 8192
+            from .utils import bits as _bits
+
+            actual = _bits.cardinality_of_words(words)
+            if actual != card:
+                raise InvalidRoaringFormat(
+                    f"bitmap container cardinality {card} != popcount {actual}"
+                )
+            c = BitmapContainer(words, card)
+        else:
+            _need(buf, pos, 2 * card)
+            values = np.frombuffer(buf, dtype="<u2", count=card, offset=pos).astype(
+                np.uint16
+            )
+            pos += 2 * card
+            if card > 1 and np.any(np.diff(values.astype(np.int64)) <= 0):
+                raise InvalidRoaringFormat("array container values not sorted/unique")
+            c = ArrayContainer(values)
+        hlc.keys.append(key)
+        hlc.containers.append(c)
+    return pos
+
+
+def maximum_serialized_size(cardinality: int, universe_size: int) -> int:
+    """Upper bound on serialized size for any bitmap of the given cardinality
+    over [0, universe_size) (RoaringBitmap.maximumSerializedSize,
+    RoaringBitmap.java:3030; closed form README.md:486-496)."""
+    cardinality = int(cardinality)
+    universe_size = int(universe_size)
+    contnbr = (universe_size + 65535) // 65536
+    if contnbr > cardinality:
+        contnbr = cardinality
+        # we cannot have more containers than values
+    headermax = max(8, 4 + (contnbr + 7) // 8) + 8 * contnbr
+    valsarray = 2 * cardinality
+    valsbitmap = contnbr * 8192
+    return headermax + min(valsarray, valsbitmap)

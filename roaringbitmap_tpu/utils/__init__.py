@@ -1,0 +1,3 @@
+from . import bits
+
+__all__ = ["bits"]

@@ -1,0 +1,83 @@
+"""Aggregation engines: CPU and device paths must agree with the naive
+pairwise fold (the reference's own oracle pattern — jmh smoke tests assert
+optimized aggregation equals naive before timing)."""
+
+import functools
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import FastAggregation, ParallelAggregation, RoaringBitmap
+
+
+@pytest.fixture
+def bitmap_set(random_bitmap_factory):
+    return [random_bitmap_factory()[0] for _ in range(12)]
+
+
+def naive(bitmaps, op):
+    fn = {
+        "or": RoaringBitmap.or_,
+        "and": RoaringBitmap.and_,
+        "xor": RoaringBitmap.xor,
+    }[op]
+    return functools.reduce(fn, bitmaps[1:], bitmaps[0])
+
+
+@pytest.mark.parametrize("op", ["or", "and", "xor"])
+@pytest.mark.parametrize("mode", ["cpu", "device"])
+def test_fast_aggregation_matches_naive(bitmap_set, op, mode):
+    want = naive(bitmap_set, op)
+    fn = {"or": FastAggregation.or_, "and": FastAggregation.and_, "xor": FastAggregation.xor}[op]
+    got = fn(*bitmap_set, mode=mode)
+    assert got == want, f"{op}/{mode}"
+
+
+@pytest.mark.parametrize("op", ["or", "xor"])
+@pytest.mark.parametrize("mode", ["cpu", "device"])
+def test_parallel_aggregation_matches_naive(bitmap_set, op, mode):
+    want = naive(bitmap_set, op)
+    fn = {"or": ParallelAggregation.or_, "xor": ParallelAggregation.xor}[op]
+    got = fn(*bitmap_set, mode=mode)
+    assert got == want
+
+
+def test_cardinality_shortcuts(bitmap_set):
+    assert FastAggregation.or_cardinality(*bitmap_set) == naive(bitmap_set, "or").get_cardinality()
+    assert FastAggregation.and_cardinality(*bitmap_set) == naive(bitmap_set, "and").get_cardinality()
+
+
+def test_edge_cases():
+    assert FastAggregation.or_().is_empty()
+    assert FastAggregation.and_().is_empty()
+    one = RoaringBitmap([1, 2, 3])
+    assert FastAggregation.or_(one) == one
+    assert FastAggregation.and_(one) == one
+    empty = RoaringBitmap()
+    assert FastAggregation.and_(one, empty).is_empty()
+    assert FastAggregation.or_(one, empty) == one
+
+
+def test_iterable_input():
+    bms = [RoaringBitmap([i, i + 10]) for i in range(5)]
+    got = FastAggregation.or_(bms)  # list form, like the Java iterator overloads
+    assert got.get_cardinality() == len(set(range(5)) | set(range(10, 15)))
+
+
+def test_group_by_key():
+    b1 = RoaringBitmap([1, 1 << 16])
+    b2 = RoaringBitmap([2, 2 << 16])
+    groups = ParallelAggregation.group_by_key(b1, b2)
+    assert set(groups.keys()) == {0, 1, 2}
+    assert len(groups[0]) == 2
+
+
+def test_device_path_with_many_containers(random_bitmap_factory):
+    """Wide-OR across enough containers to exercise padded and skewed paths."""
+    bms = [random_bitmap_factory()[0] for _ in range(30)]
+    # add one bitmap with a unique far key to skew group sizes
+    skew = RoaringBitmap([(1 << 31) + 5])
+    bms.append(skew)
+    want = naive(bms, "or")
+    assert FastAggregation.or_(*bms, mode="device") == want
+    assert FastAggregation.or_(*bms, mode="cpu") == want

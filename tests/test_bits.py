@@ -1,0 +1,102 @@
+"""L0 host word kernels vs naive references (SURVEY §7 step 1)."""
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu.utils import bits
+
+
+def naive_popcount(words):
+    return sum(bin(int(w)).count("1") for w in words)
+
+
+def test_popcount64_random():
+    rng = np.random.default_rng(1)
+    words = rng.integers(0, 1 << 64, size=256, dtype=np.uint64)
+    assert int(bits.popcount64(words).sum()) == naive_popcount(words)
+
+
+def test_popcount64_edges():
+    words = np.array([0, 0xFFFFFFFFFFFFFFFF, 1, 1 << 63], dtype=np.uint64)
+    assert bits.popcount64(words).tolist() == [0, 64, 1, 1]
+
+
+def test_words_values_roundtrip():
+    rng = np.random.default_rng(2)
+    values = np.unique(rng.integers(0, 1 << 16, size=5000)).astype(np.uint16)
+    words = bits.words_from_values(values)
+    assert np.array_equal(bits.values_from_words(words), values)
+    assert bits.cardinality_of_words(words) == values.size
+
+
+def test_set_clear_flip_range():
+    for start, end in [(0, 65536), (0, 1), (65535, 65536), (100, 8000), (63, 65), (64, 128), (5, 5)]:
+        words = bits.new_words()
+        bits.set_bitmap_range(words, start, end)
+        expected = np.arange(start, end, dtype=np.uint16)
+        assert np.array_equal(bits.values_from_words(words), expected), (start, end)
+
+        bits.clear_bitmap_range(words, start, end)
+        assert bits.cardinality_of_words(words) == 0
+
+        bits.flip_bitmap_range(words, start, end)
+        assert np.array_equal(bits.values_from_words(words), expected)
+
+
+def test_cardinality_in_range():
+    rng = np.random.default_rng(3)
+    values = np.unique(rng.integers(0, 1 << 16, size=3000))
+    words = bits.words_from_values(values.astype(np.uint16))
+    for start, end in [(0, 65536), (1000, 2000), (0, 1), (65535, 65536), (500, 500), (63, 64), (64, 65)]:
+        expected = int(((values >= start) & (values < end)).sum())
+        assert bits.cardinality_in_range(words, start, end) == expected, (start, end)
+
+
+def test_select_in_words():
+    rng = np.random.default_rng(4)
+    values = np.unique(rng.integers(0, 1 << 16, size=2000))
+    words = bits.words_from_values(values.astype(np.uint16))
+    for j in [0, 1, len(values) // 2, len(values) - 1]:
+        assert bits.select_in_words(words, j) == values[j]
+    with pytest.raises(IndexError):
+        bits.select_in_words(words, len(values))
+
+
+def test_runs_roundtrip():
+    cases = [
+        np.array([], dtype=np.uint16),
+        np.array([5], dtype=np.uint16),
+        np.array([0, 1, 2, 10, 11, 65535], dtype=np.uint16),
+        np.arange(0, 65536, dtype=np.uint16),
+    ]
+    for values in cases:
+        s, l = bits.runs_from_values(values)
+        assert np.array_equal(bits.values_from_runs(s, l), values)
+
+
+def test_num_runs_in_words():
+    values = np.array([0, 1, 2, 10, 11, 63, 64, 65, 1000], dtype=np.uint16)
+    words = bits.words_from_values(values)
+    # runs: [0-2], [10-11], [63-65], [1000] -> 4
+    assert bits.num_runs_in_words(words) == 4
+    assert bits.num_runs_in_words(bits.new_words()) == 0
+    full = bits.new_words()
+    bits.set_bitmap_range(full, 0, 65536)
+    assert bits.num_runs_in_words(full) == 1
+
+
+def test_sorted_set_ops():
+    rng = np.random.default_rng(5)
+    a = np.unique(rng.integers(0, 1 << 16, size=300)).astype(np.uint16)
+    b = np.unique(rng.integers(0, 1 << 16, size=400)).astype(np.uint16)
+    sa, sb = set(a.tolist()), set(b.tolist())
+    assert set(bits.merge_sorted_unique(a, b).tolist()) == sa | sb
+    assert set(bits.intersect_sorted(a, b).tolist()) == sa & sb
+    assert set(bits.difference_sorted(a, b).tolist()) == sa - sb
+    assert set(bits.xor_sorted(a, b).tolist()) == sa ^ sb
+
+
+def test_high_low_bits():
+    assert bits.highbits(0x12345678) == 0x1234
+    assert bits.lowbits(0x12345678) == 0x5678
+    assert bits.combine(0x1234, 0x5678) == 0x12345678

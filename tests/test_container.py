@@ -1,0 +1,194 @@
+"""Container layer differential tests: every op vs Python-set semantics,
+every container-type pairing (the reference's 9-combination op matrix,
+Container.java:63-98, covered by TestArrayContainer/TestBitmapContainer/
+TestRunContainer)."""
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu.models.container import (
+    ARRAY_MAX_SIZE,
+    ArrayContainer,
+    BitmapContainer,
+    Container,
+    RunContainer,
+    best_container_of_words,
+    container_from_values,
+    container_range_of_ones,
+)
+from roaringbitmap_tpu.utils import bits
+
+
+def make_array(values):
+    return ArrayContainer(np.array(sorted(values), dtype=np.uint16))
+
+
+def make_bitmap(values):
+    return BitmapContainer(bits.words_from_values(np.array(sorted(values), dtype=np.uint16)))
+
+
+def make_run(values):
+    return RunContainer.from_values(np.array(sorted(values), dtype=np.uint16))
+
+
+MAKERS = [make_array, make_bitmap, make_run]
+
+
+def sample_sets(rng):
+    sparse = set(rng.choice(1 << 16, size=500, replace=False).tolist())
+    dense = set(rng.choice(1 << 16, size=9000, replace=False).tolist())
+    runs = set()
+    for s in rng.choice(np.arange(0, 60000, 100), size=40, replace=False).tolist():
+        runs |= set(range(s, s + int(rng.integers(1, 80))))
+    return [sparse, dense, runs, set(), {0}, {65535}, set(range(0, 65536))]
+
+
+@pytest.mark.parametrize("op", ["and", "or", "xor", "andnot"])
+def test_pairwise_matrix(op):
+    rng = np.random.default_rng(10)
+    sets = sample_sets(rng)
+    pairs = [(sets[0], sets[1]), (sets[1], sets[2]), (sets[0], sets[2]),
+             (sets[3], sets[1]), (sets[4], sets[5]), (sets[6], sets[2])]
+    for sa, sb in pairs:
+        for ma in MAKERS:
+            for mb in MAKERS:
+                a, b = ma(sa), mb(sb)
+                if op == "and":
+                    got, want = a.and_(b), sa & sb
+                elif op == "or":
+                    got, want = a.or_(b), sa | sb
+                elif op == "xor":
+                    got, want = a.xor_(b), sa ^ sb
+                else:
+                    got, want = a.andnot(b), sa - sb
+                assert set(got.to_array().tolist()) == want, (op, ma.__name__, mb.__name__)
+                assert got.cardinality == len(want)
+
+
+def test_and_cardinality_and_intersects():
+    rng = np.random.default_rng(11)
+    sets = sample_sets(rng)
+    for sa in sets[:3]:
+        for sb in sets[:3]:
+            for ma in MAKERS:
+                for mb in MAKERS:
+                    a, b = ma(sa), mb(sb)
+                    assert a.and_cardinality(b) == len(sa & sb)
+                    assert a.intersects(b) == bool(sa & sb)
+
+
+def test_add_remove_promotion():
+    c: Container = ArrayContainer()
+    for x in range(ARRAY_MAX_SIZE + 1):
+        c = c.add(2 * x)
+    assert isinstance(c, BitmapContainer)  # promoted past 4096 (ArrayContainer.java:158)
+    assert c.cardinality == ARRAY_MAX_SIZE + 1
+    c = c.remove(0)
+    assert isinstance(c, ArrayContainer)  # demoted at <= 4096
+    assert c.cardinality == ARRAY_MAX_SIZE
+    # idempotent add/remove
+    c2 = c.add(2)
+    assert c2.cardinality == ARRAY_MAX_SIZE
+
+
+def test_rank_select_roundtrip():
+    rng = np.random.default_rng(12)
+    for maker in MAKERS:
+        values = sorted(rng.choice(1 << 16, size=700, replace=False).tolist())
+        c = maker(set(values))
+        for j in [0, 1, 350, 699]:
+            assert c.select(j) == values[j]
+            assert c.rank(values[j]) == j + 1
+        assert c.first() == values[0]
+        assert c.last() == values[-1]
+        # rank of value below the minimum
+        if values[0] > 0:
+            assert c.rank(values[0] - 1) == 0
+
+
+def test_next_previous_value():
+    vals = {10, 11, 12, 100, 200, 65535}
+    for maker in MAKERS:
+        c = maker(vals)
+        assert c.next_value(0) == 10
+        assert c.next_value(10) == 10
+        assert c.next_value(13) == 100
+        assert c.next_value(65535) == 65535
+        assert c.previous_value(65535) == 65535
+        assert c.previous_value(99) == 12
+        assert c.previous_value(9) == -1
+        assert make_array(set()).next_value(0) == -1
+
+
+def test_next_previous_absent_value():
+    vals = set(range(10, 20)) | {30}
+    for maker in MAKERS:
+        c = maker(vals)
+        assert c.next_absent_value(10) == 20
+        assert c.next_absent_value(5) == 5
+        assert c.previous_absent_value(19) == 9
+        assert c.previous_absent_value(25) == 25
+
+
+def test_range_ops():
+    for maker in MAKERS:
+        c = maker({1, 5, 100})
+        c2 = c.add_range(10, 20)
+        assert set(c2.to_array().tolist()) == {1, 5, 100} | set(range(10, 20))
+        c3 = c2.remove_range(0, 6)
+        assert set(c3.to_array().tolist()) == {100} | set(range(10, 20))
+        c4 = c3.flip_range(15, 25)
+        assert set(c4.to_array().tolist()) == {100} | set(range(10, 15)) | set(range(20, 25))
+        assert c2.contains_range(10, 20)
+        assert not c2.contains_range(10, 21)
+        assert c2.intersects_range(19, 30)
+        assert not c2.intersects_range(20, 100)
+
+
+def test_run_optimize_thresholds():
+    # long run -> run container wins
+    c = make_bitmap(set(range(0, 30000)))
+    opt = c.run_optimize()
+    assert isinstance(opt, RunContainer)
+    assert opt.num_runs() == 1
+    # scattered values -> stays array
+    rng = np.random.default_rng(13)
+    scattered = set(rng.choice(1 << 16, size=1000, replace=False).tolist())
+    opt2 = make_array(scattered).run_optimize()
+    assert isinstance(opt2, ArrayContainer) or opt2.num_runs() * 4 + 2 < 2 + 2 * 1000
+    # dense random -> stays bitmap
+    dense = set(rng.choice(1 << 16, size=30000, replace=False).tolist())
+    opt3 = make_bitmap(dense).run_optimize()
+    assert isinstance(opt3, BitmapContainer)
+
+
+def test_range_of_ones():
+    c = container_range_of_ones(5, 7)  # 2 values -> array (Container.java:29-37)
+    assert isinstance(c, ArrayContainer)
+    c2 = container_range_of_ones(5, 9)
+    assert isinstance(c2, RunContainer)
+    assert set(c2.to_array().tolist()) == {5, 6, 7, 8}
+    full = container_range_of_ones(0, 1 << 16)
+    assert full.cardinality == 1 << 16
+    assert full.is_full()
+
+
+def test_contains_container():
+    big = make_bitmap(set(range(0, 10000)))
+    small = make_run(set(range(100, 200)))
+    assert big.contains_container(small)
+    assert not small.contains_container(big)
+    assert big.contains_container(make_array(set()))
+
+
+def test_equality_across_types():
+    vals = set(range(50, 150))
+    assert make_array(vals) == make_bitmap(vals) == make_run(vals)
+    assert make_array(vals) != make_array(vals | {1})
+
+
+def test_best_container_of_words():
+    few = bits.words_from_values(np.arange(10, dtype=np.uint16))
+    assert isinstance(best_container_of_words(few), ArrayContainer)
+    many = bits.words_from_values(np.arange(5000, dtype=np.uint16))
+    assert isinstance(best_container_of_words(many), BitmapContainer)

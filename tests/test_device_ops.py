@@ -1,0 +1,121 @@
+"""Device kernel differential tests vs numpy references (runs on the CPU
+backend with 8 virtual devices; the same code paths execute on TPU)."""
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu.ops import device as dev
+from roaringbitmap_tpu.utils import bits
+
+
+@pytest.fixture
+def word_batch():
+    rng = np.random.default_rng(42)
+    host64 = rng.integers(0, 1 << 64, size=(37, dev.HOST_WORDS), dtype=np.uint64)
+    host64[5] = 0
+    host64[6] = 0xFFFFFFFFFFFFFFFF
+    return host64
+
+
+def test_device_word_layout_roundtrip(word_batch):
+    u32 = dev.to_device_words(word_batch)
+    assert u32.shape == (37, dev.DEVICE_WORDS)
+    assert np.array_equal(dev.from_device_words(u32), word_batch)
+
+
+def test_popcount_rows(word_batch):
+    import jax.numpy as jnp
+
+    u32 = jnp.asarray(dev.to_device_words(word_batch))
+    got = np.asarray(dev.popcount_rows(u32))
+    want = bits.popcount64(word_batch).sum(axis=1)
+    assert np.array_equal(got, want)
+
+
+@pytest.mark.parametrize("op,npop", [("or", np.bitwise_or), ("and", np.bitwise_and), ("xor", np.bitwise_xor)])
+def test_wide_reduce(word_batch, op, npop):
+    import jax.numpy as jnp
+
+    u32 = jnp.asarray(dev.to_device_words(word_batch))
+    got = dev.from_device_words(np.asarray(dev.wide_reduce(u32, op=op))[None])[0]
+    want = npop.reduce(word_batch, axis=0)
+    assert np.array_equal(got, want)
+    red, card = dev.wide_reduce_with_cardinality(u32, op=op)
+    assert int(card) == int(bits.popcount64(want).sum())
+
+
+@pytest.mark.parametrize("op,npop", [("or", np.bitwise_or), ("and", np.bitwise_and), ("xor", np.bitwise_xor)])
+def test_grouped_reduce(op, npop):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(43)
+    host = rng.integers(0, 1 << 64, size=(4, 5, dev.HOST_WORDS), dtype=np.uint64)
+    u32 = jnp.asarray(host.view(np.uint32).reshape(4, 5, dev.DEVICE_WORDS))
+    red, card = dev.grouped_reduce_with_cardinality(u32, op=op)
+    for g in range(4):
+        want = npop.reduce(host[g], axis=0)
+        got = np.asarray(red[g]).view(np.uint64) if False else np.ascontiguousarray(np.asarray(red[g])).view(np.uint64)
+        assert np.array_equal(got, want)
+        assert int(card[g]) == int(bits.popcount64(want).sum())
+
+
+@pytest.mark.parametrize("op,npop", [("or", np.bitwise_or), ("and", np.bitwise_and), ("xor", np.bitwise_xor)])
+def test_segmented_reduce(op, npop):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(44)
+    host = rng.integers(0, 1 << 64, size=(11, dev.HOST_WORDS), dtype=np.uint64)
+    offsets = [0, 3, 4, 9, 11]
+    seg_start = np.zeros(11, dtype=bool)
+    seg_start[offsets[:-1]] = True
+    u32 = jnp.asarray(dev.to_device_words(host))
+    vals = np.asarray(dev.segmented_reduce(u32, jnp.asarray(seg_start), op=op))
+    for s, e in zip(offsets[:-1], offsets[1:]):
+        want = npop.reduce(host[s:e], axis=0)
+        got = np.ascontiguousarray(vals[e - 1]).view(np.uint64)
+        assert np.array_equal(got, want)
+
+
+def test_batched_pairwise(word_batch):
+    import jax.numpy as jnp
+
+    a = jnp.asarray(dev.to_device_words(word_batch))
+    b = jnp.asarray(dev.to_device_words(word_batch[::-1].copy()))
+    an = word_batch
+    bn = word_batch[::-1]
+    assert np.array_equal(dev.from_device_words(np.asarray(dev.batched_or(a, b))), an | bn)
+    assert np.array_equal(dev.from_device_words(np.asarray(dev.batched_and(a, b))), an & bn)
+    assert np.array_equal(dev.from_device_words(np.asarray(dev.batched_xor(a, b))), an ^ bn)
+    assert np.array_equal(dev.from_device_words(np.asarray(dev.batched_andnot(a, b))), an & ~bn)
+
+
+def test_rank_rows():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(45)
+    host = rng.integers(0, 1 << 64, size=(6, dev.HOST_WORDS), dtype=np.uint64)
+    positions = np.array([0, 100, 65535, 32768, 7, 63], dtype=np.int32)
+    u32 = jnp.asarray(dev.to_device_words(host))
+    got = np.asarray(dev.rank_rows(u32, jnp.asarray(positions)))
+    for i in range(6):
+        want = bits.cardinality_in_range(host[i], 0, int(positions[i]) + 1)
+        assert got[i] == want
+
+
+def test_pallas_wide_reduce_interpret():
+    """Pallas kernel correctness via the interpreter (real-TPU execution is
+    exercised by bench.py / __graft_entry__.py on hardware)."""
+    import jax.numpy as jnp
+
+    from roaringbitmap_tpu.ops import pallas_kernels as pk
+
+    if not pk.HAS_PALLAS:
+        pytest.skip("pallas unavailable")
+    rng = np.random.default_rng(46)
+    host = rng.integers(0, 1 << 64, size=(300, dev.HOST_WORDS), dtype=np.uint64)
+    u32 = jnp.asarray(dev.to_device_words(host))
+    for op, npop in [("or", np.bitwise_or), ("and", np.bitwise_and), ("xor", np.bitwise_xor)]:
+        red, card = pk.wide_reduce_cardinality_pallas(u32, op=op, interpret=True)
+        want = npop.reduce(host, axis=0)
+        assert np.array_equal(np.ascontiguousarray(np.asarray(red)).view(np.uint64), want)
+        assert int(card) == int(bits.popcount64(want).sum())
