@@ -22,8 +22,20 @@ from .models.container import (
     container_range_of_ones,
 )
 from .models.roaring import RoaringBitmap
+from .models.roaring64 import Roaring64Bitmap, Roaring64NavigableMap
+from .models.bitset import RoaringBitSet
+from .models.fastrank import FastRankRoaringBitmap
+from .models.immutable import ImmutableRoaringBitmap
+from .models.writer import RoaringBitmapWriter
+from .models.bsi import Operation, RoaringBitmapSliceIndex
 from .serialization import InvalidRoaringFormat
 from .parallel.aggregation import FastAggregation, ParallelAggregation
+from . import insights
+
+# MutableRoaringBitmap: the reference's buffer twin of the mutable facade
+# (buffer/MutableRoaringBitmap.java). Here the heap/buffer split collapses
+# (see models/immutable.py) so it is the same class.
+MutableRoaringBitmap = RoaringBitmap
 
 __version__ = "0.1.0"
 
@@ -34,7 +46,17 @@ __all__ = [
     "container_from_values",
     "container_range_of_ones",
     "RoaringBitmap",
+    "MutableRoaringBitmap",
+    "Roaring64Bitmap",
+    "Roaring64NavigableMap",
+    "RoaringBitSet",
+    "FastRankRoaringBitmap",
+    "ImmutableRoaringBitmap",
+    "RoaringBitmapWriter",
+    "Operation",
+    "RoaringBitmapSliceIndex",
     "InvalidRoaringFormat",
     "FastAggregation",
     "ParallelAggregation",
+    "insights",
 ]

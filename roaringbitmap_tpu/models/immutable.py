@@ -1,0 +1,255 @@
+"""Zero-copy read path: the buffer/memory-map package analogue.
+
+The reference's ``buffer`` package re-implements every container over
+``java.nio`` buffers so serialized bitmaps can be queried without
+deserialization (ImmutableRoaringBitmap: "only metadata in RAM",
+README.md:244-247; ImmutableRoaringArray.java:43-53 parses the cookie and
+computes offsets, containers are buffer slices).
+
+Python/numpy collapses that entire 17k-LoC parallel hierarchy:
+``np.frombuffer`` views over ``bytes``/``mmap`` ARE the Mappeable
+containers — same dtype math as the heap containers, zero copy, no twin
+classes. This module parses only the header (keys, cardinalities, offsets)
+eagerly; container payloads stay views into the source buffer and are
+wrapped lazily on access. This is also the host->device donation path: the
+packed payload of a bitmap container can be shipped to the TPU directly
+from the mapped file.
+"""
+
+from __future__ import annotations
+
+import mmap as _mmap
+import struct
+from typing import Iterator, List, Optional, Union
+
+import numpy as np
+
+from ..serialization import (
+    InvalidRoaringFormat,
+    NO_OFFSET_THRESHOLD,
+    SERIAL_COOKIE,
+    SERIAL_COOKIE_NO_RUNCONTAINER,
+)
+from ..utils import bits
+from .container import ARRAY_MAX_SIZE, ArrayContainer, BitmapContainer, Container, RunContainer
+from .roaring import RoaringBitmap
+
+Source = Union[bytes, bytearray, memoryview, _mmap.mmap, np.ndarray]
+
+
+class ImmutableRoaringBitmap:
+    """Read-only bitmap over a serialized buffer (buffer/ImmutableRoaringBitmap).
+
+    Constructor cost is O(#containers) header parsing; container payloads are
+    zero-copy numpy views into the source buffer.
+    """
+
+    __slots__ = ("_buf", "_keys", "_cards", "_types", "_offsets", "_size")
+
+    ARRAY, BITMAP, RUN = 0, 1, 2
+
+    def __init__(self, source: Source, offset: int = 0):
+        if isinstance(source, np.ndarray):
+            source = source.tobytes()
+        buf = memoryview(source).cast("B")[offset:]
+        self._buf = buf
+        pos = 0
+        if len(buf) < 4:
+            raise InvalidRoaringFormat("truncated input")
+        (cookie,) = struct.unpack_from("<I", buf, pos)
+        pos += 4
+        if (cookie & 0xFFFF) == SERIAL_COOKIE:
+            size = (cookie >> 16) + 1
+            marker_len = (size + 7) // 8
+            if pos + marker_len > len(buf):
+                raise InvalidRoaringFormat("truncated run marker")
+            run_marker = bytes(buf[pos : pos + marker_len])
+            pos += marker_len
+            has_run = True
+        elif cookie == SERIAL_COOKIE_NO_RUNCONTAINER:
+            if pos + 4 > len(buf):
+                raise InvalidRoaringFormat("truncated size")
+            (size,) = struct.unpack_from("<I", buf, pos)
+            pos += 4
+            has_run = False
+            run_marker = b""
+        else:
+            raise InvalidRoaringFormat(f"invalid cookie {cookie}")
+        if size > 1 << 16 or pos + 4 * size > len(buf):
+            raise InvalidRoaringFormat("implausible container count")
+        desc = np.frombuffer(buf, dtype="<u2", count=2 * size, offset=pos)
+        pos += 4 * size
+        self._keys = desc[0::2].astype(np.int64)
+        self._cards = desc[1::2].astype(np.int64) + 1
+        if size and np.any(np.diff(self._keys) <= 0):
+            raise InvalidRoaringFormat("container keys not strictly increasing")
+
+        types = np.empty(size, dtype=np.int8)
+        for i in range(size):
+            if has_run and run_marker[i // 8] & (1 << (i % 8)):
+                types[i] = self.RUN
+            elif self._cards[i] > ARRAY_MAX_SIZE:
+                types[i] = self.BITMAP
+            else:
+                types[i] = self.ARRAY
+        self._types = types
+
+        if (not has_run) or size >= NO_OFFSET_THRESHOLD:
+            if pos + 4 * size > len(buf):
+                raise InvalidRoaringFormat("truncated offset header")
+            self._offsets = np.frombuffer(
+                buf, dtype="<u4", count=size, offset=pos
+            ).astype(np.int64)
+            pos += 4 * size
+        else:
+            # compute offsets sequentially (small: < NO_OFFSET_THRESHOLD)
+            offsets = np.empty(size, dtype=np.int64)
+            p = pos
+            for i in range(size):
+                offsets[i] = p
+                p += self._payload_len(i, p)
+            self._offsets = offsets
+        self._size = size
+        # validate payload extents
+        for i in range(size):
+            end = self._offsets[i] + self._payload_len(i, int(self._offsets[i]))
+            if end > len(buf):
+                raise InvalidRoaringFormat("container payload out of bounds")
+
+    def _payload_len(self, i: int, at: int) -> int:
+        t = self._types[i]
+        if t == self.BITMAP:
+            return 8192
+        if t == self.ARRAY:
+            return 2 * int(self._cards[i])
+        if at + 2 > len(self._buf):
+            raise InvalidRoaringFormat("truncated run container")
+        (n_runs,) = struct.unpack_from("<H", self._buf, at)
+        return 2 + 4 * n_runs
+
+    # ------------------------------------------------------------------
+    def _container(self, i: int) -> Container:
+        """Materialize a zero-copy container view (the Mappeable analogue)."""
+        off = int(self._offsets[i])
+        t = self._types[i]
+        if t == self.BITMAP:
+            words = np.frombuffer(self._buf, dtype="<u8", count=1024, offset=off)
+            return BitmapContainer(words, int(self._cards[i]))
+        if t == self.ARRAY:
+            values = np.frombuffer(
+                self._buf, dtype="<u2", count=int(self._cards[i]), offset=off
+            )
+            return ArrayContainer(values)
+        (n_runs,) = struct.unpack_from("<H", self._buf, off)
+        pairs = np.frombuffer(self._buf, dtype="<u2", count=2 * n_runs, offset=off + 2)
+        return RunContainer(pairs[0::2], pairs[1::2])
+
+    def _key_index(self, key: int) -> int:
+        i = int(np.searchsorted(self._keys, key))
+        return i if i < self._size and self._keys[i] == key else -1
+
+    # ------------------------------------------------------------------
+    # read API (ImmutableBitmapDataProvider surface)
+    # ------------------------------------------------------------------
+    def get_cardinality(self) -> int:
+        return int(self._cards.sum())
+
+    def is_empty(self) -> bool:
+        return self._size == 0
+
+    def get_container_count(self) -> int:
+        return self._size
+
+    def contains(self, x: int) -> bool:
+        x = int(x)
+        if not 0 <= x < 1 << 32:
+            return False
+        i = self._key_index(x >> 16)
+        return i >= 0 and self._container(i).contains(x & 0xFFFF)
+
+    def rank(self, x: int) -> int:
+        x = int(x)
+        hb, lb = x >> 16, x & 0xFFFF
+        before = self._keys < hb
+        total = int(self._cards[before].sum())
+        i = self._key_index(hb)
+        if i >= 0:
+            total += self._container(i).rank(lb)
+        return total
+
+    def select(self, j: int) -> int:
+        j = int(j)
+        if j < 0:
+            raise IndexError(j)
+        cum = np.cumsum(self._cards)
+        i = int(np.searchsorted(cum, j + 1))
+        if i >= self._size:
+            raise IndexError("select out of range")
+        prior = int(cum[i - 1]) if i else 0
+        return (int(self._keys[i]) << 16) | self._container(i).select(j - prior)
+
+    def first(self) -> int:
+        if self.is_empty():
+            raise ValueError("empty bitmap")
+        return (int(self._keys[0]) << 16) | self._container(0).first()
+
+    def last(self) -> int:
+        if self.is_empty():
+            raise ValueError("empty bitmap")
+        return (int(self._keys[-1]) << 16) | self._container(self._size - 1).last()
+
+    def to_array(self) -> np.ndarray:
+        parts = [
+            self._container(i).to_array().astype(np.uint32)
+            + np.uint32(int(self._keys[i]) << 16)
+            for i in range(self._size)
+        ]
+        return np.concatenate(parts) if parts else np.empty(0, dtype=np.uint32)
+
+    def __iter__(self) -> Iterator[int]:
+        for i in range(self._size):
+            base = int(self._keys[i]) << 16
+            for v in self._container(i).to_array().tolist():
+                yield base | v
+
+    def __contains__(self, x) -> bool:
+        return self.contains(x)
+
+    def __len__(self) -> int:
+        return self.get_cardinality()
+
+    def __eq__(self, other):
+        if isinstance(other, (ImmutableRoaringBitmap, RoaringBitmap)):
+            return np.array_equal(self.to_array(), other.to_array())
+        return NotImplemented
+
+    def __hash__(self):
+        return hash(self.to_array().tobytes())
+
+    # ------------------------------------------------------------------
+    def to_mutable(self) -> RoaringBitmap:
+        """Deep copy into a mutable RoaringBitmap
+        (ImmutableRoaringBitmap.toMutableRoaringBitmap)."""
+        out = RoaringBitmap()
+        for i in range(self._size):
+            c = self._container(i)
+            out.high_low_container.append(int(self._keys[i]), c.clone())
+        return out
+
+    def serialize(self) -> bytes:
+        """The serialized form IS the backing buffer (zero cost)."""
+        end = int(self._offsets[-1]) + self._payload_len(
+            self._size - 1, int(self._offsets[-1])
+        ) if self._size else 8
+        return bytes(self._buf[:end])
+
+    @staticmethod
+    def map_file(path: str) -> "ImmutableRoaringBitmap":
+        """Memory-map a serialized bitmap file (MemoryMappingExample
+        analogue): the OS pages container payloads in on demand."""
+        with open(path, "rb") as f:
+            mm = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
+        return ImmutableRoaringBitmap(mm)
+
+    def __repr__(self):
+        return f"ImmutableRoaringBitmap(card={self.get_cardinality()}, containers={self._size})"

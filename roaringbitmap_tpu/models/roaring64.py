@@ -1,0 +1,460 @@
+"""64-bit layer: key-space extension over the 32-bit machinery.
+
+The reference ships two 64-bit designs — ``Roaring64NavigableMap``
+(longlong/Roaring64NavigableMap.java:29: NavigableMap of high-32 bits ->
+32-bit bitmap, cached cumulative cardinalities for rank/select :66-72) and
+the ART-based ``Roaring64Bitmap`` (longlong/Roaring64Bitmap.java:29: high-48
+trie -> 16-bit container). This framework uses one class with the
+NavigableMap decomposition: a sorted high-32 index over full 32-bit
+RoaringBitmaps. Rationale (TPU-first, SURVEY §5 "long-context" analogue):
+every bucket reuses the whole 32-bit stack including the packed device
+aggregation path, so 64-bit wide-ORs batch exactly like 32-bit ones; an ART
+trie is a pointer-chasing CPU structure with nothing to offer the device
+path, and the sorted-dict index has identical asymptotics at the bucket
+counts Python can hold.
+
+Serialization implements the portable 64-bit RoaringFormatSpec
+(Roaring64NavigableMap.java:47 SERIALIZATION_MODE_PORTABLE, validated
+byte-for-byte against the CRoaring-written golden files
+testdata/64map*.bin): uint64 LE bucket count, then per bucket uint32 LE high
+key + standard 32-bit serialization, buckets in unsigned key order.
+
+Values are unsigned 64-bit: [0, 2^64).
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_left, bisect_right
+from typing import Iterable, Iterator, List, Optional
+
+import numpy as np
+
+from .roaring import RoaringBitmap
+from ..serialization import InvalidRoaringFormat
+
+_MAX64 = 1 << 64
+_MAX32 = 1 << 32
+
+
+def _check64(x: int) -> int:
+    x = int(x)
+    if not 0 <= x < _MAX64:
+        raise ValueError(f"value {x} outside unsigned 64-bit range")
+    return x
+
+
+class Roaring64Bitmap:
+    """Unsigned 64-bit Roaring bitmap (Roaring64NavigableMap /
+    Roaring64Bitmap capability union)."""
+
+    __slots__ = ("_buckets", "_keys", "_keys_dirty", "_cum_cards", "_cum_dirty")
+
+    def __init__(self, values: Optional[Iterable[int]] = None):
+        self._buckets: dict = {}  # high32 -> RoaringBitmap
+        self._keys: List[int] = []
+        self._keys_dirty = False
+        self._cum_cards: Optional[np.ndarray] = None
+        self._cum_dirty = True
+        if values is not None:
+            self.add_many(values)
+
+    # ------------------------------------------------------------------
+    def _sorted_keys(self) -> List[int]:
+        if self._keys_dirty:
+            self._keys = sorted(self._buckets)
+            self._keys_dirty = False
+        return self._keys
+
+    def _invalidate(self):
+        self._cum_dirty = True
+
+    def _cum(self) -> np.ndarray:
+        """Cached cumulative cardinalities per bucket
+        (Roaring64NavigableMap.java:66-72)."""
+        if self._cum_dirty:
+            keys = self._sorted_keys()
+            cards = np.array(
+                [self._buckets[k].get_cardinality() for k in keys], dtype=np.int64
+            )
+            self._cum_cards = np.cumsum(cards) if keys else np.empty(0, dtype=np.int64)
+            self._cum_dirty = False
+        return self._cum_cards
+
+    def _bucket_for_add(self, high: int) -> RoaringBitmap:
+        b = self._buckets.get(high)
+        if b is None:
+            b = RoaringBitmap()
+            self._buckets[high] = b
+            self._keys_dirty = True
+        return b
+
+    # ------------------------------------------------------------------
+    # construction / point ops
+    # ------------------------------------------------------------------
+    @staticmethod
+    def bitmap_of(*values: int) -> "Roaring64Bitmap":
+        return Roaring64Bitmap(values)
+
+    def add(self, x: int) -> None:
+        """addLong (Roaring64Bitmap.java:50)."""
+        x = _check64(x)
+        self._bucket_for_add(x >> 32).add(x & 0xFFFFFFFF)
+        self._invalidate()
+
+    def add_many(self, values: Iterable[int]) -> None:
+        if not isinstance(values, np.ndarray):
+            values = np.fromiter(iter(values), dtype=np.uint64)
+        if np.issubdtype(values.dtype, np.signedinteger) and values.size and values.min() < 0:
+            raise ValueError("values outside unsigned 64-bit range")
+        v = np.asarray(values).astype(np.uint64).ravel()
+        if v.size == 0:
+            return
+        highs = (v >> np.uint64(32)).astype(np.int64)
+        lows = (v & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        order = np.argsort(highs, kind="stable")
+        highs, lows = highs[order], lows[order]
+        boundaries = np.nonzero(np.diff(highs))[0] + 1
+        starts = np.concatenate(([0], boundaries))
+        ends = np.concatenate((boundaries, [v.size]))
+        for s, e in zip(starts.tolist(), ends.tolist()):
+            self._bucket_for_add(int(highs[s])).add_many(lows[s:e])
+        self._invalidate()
+
+    def remove(self, x: int) -> None:
+        x = _check64(x)
+        high = x >> 32
+        b = self._buckets.get(high)
+        if b is None:
+            return
+        b.remove(x & 0xFFFFFFFF)
+        if b.is_empty():
+            del self._buckets[high]
+            self._keys_dirty = True
+        self._invalidate()
+
+    def contains(self, x: int) -> bool:
+        x = _check64(x)
+        b = self._buckets.get(x >> 32)
+        return b is not None and b.contains(x & 0xFFFFFFFF)
+
+    @staticmethod
+    def _chunk_ranges(start: int, end: int):
+        """Split a 64-bit half-open range into per-bucket (high, lo, hi)
+        pieces with 32-bit half-open sub-ranges."""
+        start, end = int(start), int(end)
+        if not 0 <= start <= end <= _MAX64:
+            raise ValueError(f"invalid range [{start}, {end})")
+        if start == end:
+            return
+        h_start, h_end = start >> 32, (end - 1) >> 32
+        for h in range(h_start, h_end + 1):
+            lo = start & 0xFFFFFFFF if h == h_start else 0
+            hi = ((end - 1) & 0xFFFFFFFF) + 1 if h == h_end else _MAX32
+            yield h, lo, hi
+
+    def _drop_if_empty(self, h: int) -> None:
+        if h in self._buckets and self._buckets[h].is_empty():
+            del self._buckets[h]
+            self._keys_dirty = True
+
+    def add_range(self, start: int, end: int) -> None:
+        """Add [start, end) (Roaring64NavigableMap range add :1460)."""
+        for h, lo, hi in self._chunk_ranges(start, end):
+            self._bucket_for_add(h).add_range(lo, hi)
+        self._invalidate()
+
+    def remove_range(self, start: int, end: int) -> None:
+        for h, lo, hi in self._chunk_ranges(start, end):
+            b = self._buckets.get(h)
+            if b is not None:
+                b.remove_range(lo, hi)
+                self._drop_if_empty(h)
+        self._invalidate()
+
+    def flip_range(self, start: int, end: int) -> None:
+        """Flip [start, end) (Roaring64NavigableMap.flip :1530)."""
+        for h, lo, hi in self._chunk_ranges(start, end):
+            b = self._bucket_for_add(h)
+            b.flip_range(lo, hi)
+            self._drop_if_empty(h)
+        self._invalidate()
+
+    # ------------------------------------------------------------------
+    # algebra (in-place, Java-style: Roaring64NavigableMap.java:773-935)
+    # ------------------------------------------------------------------
+    def ior(self, other: "Roaring64Bitmap") -> "Roaring64Bitmap":
+        for h, ob in other._buckets.items():
+            mine = self._buckets.get(h)
+            if mine is None:
+                self._buckets[h] = ob.clone()
+                self._keys_dirty = True
+            else:
+                mine.ior(ob)
+        self._invalidate()
+        return self
+
+    def iand(self, other: "Roaring64Bitmap") -> "Roaring64Bitmap":
+        for h in list(self._buckets):
+            ob = other._buckets.get(h)
+            if ob is None:
+                del self._buckets[h]
+                self._keys_dirty = True
+            else:
+                mine = self._buckets[h]
+                mine.iand(ob)
+                if mine.is_empty():
+                    del self._buckets[h]
+                    self._keys_dirty = True
+        self._invalidate()
+        return self
+
+    def ixor(self, other: "Roaring64Bitmap") -> "Roaring64Bitmap":
+        for h, ob in other._buckets.items():
+            mine = self._buckets.get(h)
+            if mine is None:
+                self._buckets[h] = ob.clone()
+                self._keys_dirty = True
+            else:
+                mine.ixor(ob)
+                if mine.is_empty():
+                    del self._buckets[h]
+                    self._keys_dirty = True
+        self._invalidate()
+        return self
+
+    def iandnot(self, other: "Roaring64Bitmap") -> "Roaring64Bitmap":
+        for h in list(self._buckets):
+            ob = other._buckets.get(h)
+            if ob is not None:
+                mine = self._buckets[h]
+                mine.iandnot(ob)
+                if mine.is_empty():
+                    del self._buckets[h]
+                    self._keys_dirty = True
+        self._invalidate()
+        return self
+
+    # Java naming aliases
+    or_inplace = ior
+    and_inplace = iand
+    xor_inplace = ixor
+    andnot_inplace = iandnot
+
+    @staticmethod
+    def or_(a: "Roaring64Bitmap", b: "Roaring64Bitmap") -> "Roaring64Bitmap":
+        return a.clone().ior(b)
+
+    @staticmethod
+    def and_(a: "Roaring64Bitmap", b: "Roaring64Bitmap") -> "Roaring64Bitmap":
+        return a.clone().iand(b)
+
+    @staticmethod
+    def xor(a: "Roaring64Bitmap", b: "Roaring64Bitmap") -> "Roaring64Bitmap":
+        return a.clone().ixor(b)
+
+    @staticmethod
+    def andnot(a: "Roaring64Bitmap", b: "Roaring64Bitmap") -> "Roaring64Bitmap":
+        return a.clone().iandnot(b)
+
+    __or__ = lambda self, o: Roaring64Bitmap.or_(self, o)
+    __and__ = lambda self, o: Roaring64Bitmap.and_(self, o)
+    __xor__ = lambda self, o: Roaring64Bitmap.xor(self, o)
+    __sub__ = lambda self, o: Roaring64Bitmap.andnot(self, o)
+    __ior__ = ior
+    __iand__ = iand
+    __ixor__ = ixor
+    __isub__ = iandnot
+
+    def intersects(self, other: "Roaring64Bitmap") -> bool:
+        for h, b in self._buckets.items():
+            ob = other._buckets.get(h)
+            if ob is not None and RoaringBitmap.intersects(b, ob):
+                return True
+        return False
+
+    # ------------------------------------------------------------------
+    # cardinality / order statistics
+    # ------------------------------------------------------------------
+    def get_cardinality(self) -> int:
+        """getLongCardinality."""
+        return sum(b.get_cardinality() for b in self._buckets.values())
+
+    def is_empty(self) -> bool:
+        return not self._buckets
+
+    def rank(self, x: int) -> int:
+        """rankLong (Roaring64NavigableMap.java:351)."""
+        x = _check64(x)
+        high, low = x >> 32, x & 0xFFFFFFFF
+        keys = self._sorted_keys()
+        i = bisect_left(keys, high)
+        cum = self._cum()
+        total = int(cum[i - 1]) if i > 0 else 0
+        if i < len(keys) and keys[i] == high:
+            total += self._buckets[high].rank(low)
+        return total
+
+    def select(self, j: int) -> int:
+        """selectLong (Roaring64NavigableMap.java:473)."""
+        j = int(j)
+        if j < 0:
+            raise IndexError(j)
+        keys = self._sorted_keys()
+        cum = self._cum()
+        i = int(np.searchsorted(cum, j + 1))
+        if i >= len(keys):
+            raise IndexError("select out of range")
+        prior = int(cum[i - 1]) if i else 0
+        k = keys[i]
+        return (k << 32) | self._buckets[k].select(j - prior)
+
+    def first(self) -> int:
+        if self.is_empty():
+            raise ValueError("empty bitmap")
+        k = self._sorted_keys()[0]
+        return (k << 32) | self._buckets[k].first()
+
+    def last(self) -> int:
+        if self.is_empty():
+            raise ValueError("empty bitmap")
+        k = self._sorted_keys()[-1]
+        return (k << 32) | self._buckets[k].last()
+
+    def next_value(self, from_value: int) -> int:
+        """Smallest value >= from_value, or -1."""
+        from_value = _check64(from_value)
+        high, low = from_value >> 32, from_value & 0xFFFFFFFF
+        keys = self._sorted_keys()
+        for i in range(bisect_left(keys, high), len(keys)):
+            k = keys[i]
+            v = self._buckets[k].next_value(low if k == high else 0)
+            if v >= 0:
+                return (k << 32) | v
+        return -1
+
+    def previous_value(self, from_value: int) -> int:
+        from_value = _check64(from_value)
+        high, low = from_value >> 32, from_value & 0xFFFFFFFF
+        keys = self._sorted_keys()
+        for i in range(bisect_right(keys, high) - 1, -1, -1):
+            k = keys[i]
+            v = self._buckets[k].previous_value(low if k == high else _MAX32 - 1)
+            if v >= 0:
+                return (k << 32) | v
+        return -1
+
+    # ------------------------------------------------------------------
+    # structure
+    # ------------------------------------------------------------------
+    def run_optimize(self) -> bool:
+        changed = False
+        for b in self._buckets.values():
+            changed |= b.run_optimize()
+        return changed
+
+    def clone(self) -> "Roaring64Bitmap":
+        out = Roaring64Bitmap()
+        out._buckets = {h: b.clone() for h, b in self._buckets.items()}
+        out._keys_dirty = True
+        return out
+
+    def to_array(self) -> np.ndarray:
+        """All values, unsigned-sorted, as uint64."""
+        keys = self._sorted_keys()
+        if not keys:
+            return np.empty(0, dtype=np.uint64)
+        parts = [
+            self._buckets[k].to_array().astype(np.uint64) | np.uint64(k << 32)
+            for k in keys
+        ]
+        return np.concatenate(parts)
+
+    def __iter__(self) -> Iterator[int]:
+        for k in self._sorted_keys():
+            base = k << 32
+            for v in self._buckets[k]:
+                yield base | v
+
+    def get_high_to_bitmap_count(self) -> int:
+        """Bucket count (getHighToBitmap().size() analogue)."""
+        return len(self._buckets)
+
+    # ------------------------------------------------------------------
+    # serialization (portable 64-bit spec)
+    # ------------------------------------------------------------------
+    def serialize(self) -> bytes:
+        import struct
+
+        keys = self._sorted_keys()
+        parts = [struct.pack("<Q", len(keys))]
+        for k in keys:
+            parts.append(struct.pack("<I", k))
+            parts.append(self._buckets[k].serialize())
+        return b"".join(parts)
+
+    def serialized_size_in_bytes(self) -> int:
+        from ..serialization import serialized_size_in_bytes
+
+        return 8 + sum(
+            4 + serialized_size_in_bytes(b) for b in self._buckets.values()
+        )
+
+    @staticmethod
+    def deserialize(data) -> "Roaring64Bitmap":
+        import struct
+
+        from ..serialization import read_into
+
+        buf = memoryview(bytes(data) if not isinstance(data, (bytes, bytearray, memoryview)) else data)
+        if len(buf) < 8:
+            raise InvalidRoaringFormat("truncated 64-bit header")
+        (count,) = struct.unpack_from("<Q", buf, 0)
+        if count > len(buf) // 4:  # each bucket needs >= 4 bytes of key alone
+            raise InvalidRoaringFormat(f"implausible bucket count {count}")
+        pos = 8
+        out = Roaring64Bitmap()
+        prev_key = -1
+        for _ in range(count):
+            if pos + 4 > len(buf):
+                raise InvalidRoaringFormat("truncated bucket key")
+            (key,) = struct.unpack_from("<I", buf, pos)
+            pos += 4
+            if key <= prev_key:
+                raise InvalidRoaringFormat("bucket keys not strictly increasing")
+            prev_key = key
+            bm = RoaringBitmap()
+            pos += read_into(bm, buf[pos:])
+            if not bm.is_empty():
+                out._buckets[key] = bm
+        out._keys_dirty = True
+        return out
+
+    # ------------------------------------------------------------------
+    def __eq__(self, other):
+        if not isinstance(other, Roaring64Bitmap):
+            return NotImplemented
+        if set(self._buckets) != set(other._buckets):
+            return False
+        return all(b == other._buckets[h] for h, b in self._buckets.items())
+
+    def __hash__(self):
+        return hash(self.to_array().tobytes())
+
+    def __len__(self) -> int:
+        return self.get_cardinality()
+
+    def __contains__(self, x: int) -> bool:
+        return self.contains(x)
+
+    def __bool__(self) -> bool:
+        return not self.is_empty()
+
+    def __repr__(self) -> str:
+        card = self.get_cardinality()
+        head = ",".join(str(v) for v in self.to_array()[:8].tolist())
+        return f"Roaring64Bitmap(card={card}, values=[{head}{'...' if card > 8 else ''}])"
+
+
+# The reference exposes the same capability under this name with a pluggable
+# backend (longlong/Roaring64NavigableMap.java:29); here it is one class.
+Roaring64NavigableMap = Roaring64Bitmap

@@ -1,0 +1,176 @@
+"""BSI differential tests vs a plain dict column->value model
+(reference oracle: bsi/ test suite + O'Neil semantics,
+RoaringBitmapSliceIndex.java:432-513)."""
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import RoaringBitmap
+from roaringbitmap_tpu.models.bsi import Operation, RoaringBitmapSliceIndex
+
+
+@pytest.fixture
+def column_data(rng):
+    cols = np.unique(rng.integers(0, 300_000, size=4000)).astype(np.uint32)
+    vals = rng.integers(0, 10_000, size=cols.size).astype(np.int64)
+    return cols, vals
+
+
+@pytest.fixture
+def bsi(column_data):
+    cols, vals = column_data
+    b = RoaringBitmapSliceIndex()
+    b.set_values((cols, vals))
+    return b
+
+
+def ref_compare(cols, vals, op, v, end=0):
+    if op == Operation.EQ:
+        m = vals == v
+    elif op == Operation.NEQ:
+        m = vals != v
+    elif op == Operation.LT:
+        m = vals < v
+    elif op == Operation.LE:
+        m = vals <= v
+    elif op == Operation.GT:
+        m = vals > v
+    elif op == Operation.GE:
+        m = vals >= v
+    else:
+        m = (vals >= v) & (vals <= end)
+    return set(cols[m].tolist())
+
+
+def test_set_get(bsi, column_data):
+    cols, vals = column_data
+    assert bsi.get_cardinality() == cols.size
+    for i in [0, cols.size // 2, cols.size - 1]:
+        got, exists = bsi.get_value(int(cols[i]))
+        assert exists and got == vals[i]
+    absent = 299_999
+    while absent in set(cols.tolist()):
+        absent -= 1
+    assert bsi.get_value(absent) == (0, False)
+    assert bsi.min_value == vals.min() and bsi.max_value == vals.max()
+
+
+def test_set_value_overwrite():
+    b = RoaringBitmapSliceIndex()
+    b.set_value(7, 100)
+    b.set_value(7, 3)
+    assert b.get_value(7) == (3, True)
+    # bulk overwrite path
+    b2 = RoaringBitmapSliceIndex()
+    b2.set_values(([1, 2], [10, 20]))
+    b2.set_values(([2, 3], [5, 6]))
+    assert b2.get_value(1) == (10, True)
+    assert b2.get_value(2) == (5, True)
+    assert b2.get_value(3) == (6, True)
+
+
+@pytest.mark.parametrize(
+    "op", [Operation.EQ, Operation.NEQ, Operation.LT, Operation.LE, Operation.GT, Operation.GE]
+)
+@pytest.mark.parametrize("mode", ["cpu", "device"])
+def test_compare_ops(bsi, column_data, op, mode):
+    cols, vals = column_data
+    for v in [0, 1, int(np.median(vals)), int(vals.max()), int(vals.max()) + 5]:
+        got = bsi.compare(op, v, 0, None, mode=mode)
+        want = ref_compare(cols, vals, op, v)
+        assert set(got.to_array().tolist()) == want, (op, v, mode)
+
+
+@pytest.mark.parametrize("mode", ["cpu", "device"])
+def test_range_and_found_set(bsi, column_data, mode):
+    cols, vals = column_data
+    lo, hi = int(np.percentile(vals, 25)), int(np.percentile(vals, 75))
+    got = bsi.compare(Operation.RANGE, lo, hi, None, mode=mode)
+    assert set(got.to_array().tolist()) == ref_compare(cols, vals, Operation.RANGE, lo, hi)
+    # with a found_set filter
+    found = RoaringBitmap(cols[::2])
+    got2 = bsi.compare(Operation.GE, lo, 0, found, mode=mode)
+    want2 = ref_compare(cols, vals, Operation.GE, lo) & set(cols[::2].tolist())
+    assert set(got2.to_array().tolist()) == want2
+
+
+def test_neq_found_set_outside_index(bsi, column_data):
+    """Java semantics: NEQ does not intersect found_set with the ebm, so
+    out-of-index columns qualify."""
+    cols, vals = column_data
+    outside = 400_000
+    found = RoaringBitmap([int(cols[0]), outside])
+    for mode in ("cpu", "device"):
+        got = bsi.compare(Operation.NEQ, int(vals[0]), 0, found, mode=mode)
+        assert outside in set(got.to_array().tolist())
+        assert int(cols[0]) not in set(got.to_array().tolist())
+
+
+def test_sum(bsi, column_data):
+    cols, vals = column_data
+    found = RoaringBitmap(cols[: cols.size // 2])
+    total, count = bsi.sum(found)
+    assert count == cols.size // 2
+    assert total == int(vals[: cols.size // 2].sum())
+    assert bsi.sum(None) == (0, 0)
+
+
+def test_merge_and_add():
+    a = RoaringBitmapSliceIndex()
+    a.set_values(([1, 2], [10, 20]))
+    b = RoaringBitmapSliceIndex()
+    b.set_values(([3, 4], [5, 300]))
+    a.merge(b)
+    assert a.get_value(3) == (5, True) and a.get_value(4) == (300, True)
+    assert a.min_value == 5 and a.max_value == 300
+    with pytest.raises(ValueError):
+        a.merge(b)  # no longer disjoint
+
+    # element-wise add with carry
+    x = RoaringBitmapSliceIndex()
+    x.set_values(([1, 2], [3, 7]))
+    y = RoaringBitmapSliceIndex()
+    y.set_values(([1, 2, 5], [1, 9, 4]))
+    x.add(y)
+    assert x.get_value(1) == (4, True)
+    assert x.get_value(2) == (16, True)  # 7+9 ripples through all bits
+    assert x.get_value(5) == (4, True)
+    assert x.min_value == 4 and x.max_value == 16
+
+
+def test_serialization_roundtrip(bsi):
+    data = bsi.serialize()
+    assert len(data) == bsi.serialized_size_in_bytes()
+    back = RoaringBitmapSliceIndex.deserialize(data)
+    assert back == bsi
+    assert back.min_value == bsi.min_value and back.max_value == bsi.max_value
+    assert back.serialize() == data
+
+
+def test_clone_independent(bsi):
+    c = bsi.clone()
+    assert c == bsi
+    c.set_value(12345678, 42)
+    assert c != bsi or bsi.value_exist(12345678) is False
+
+
+def test_set_values_input_forms():
+    """Pairs vs parallel arrays, duplicates last-wins, empty input
+    (code-review regression)."""
+    b = RoaringBitmapSliceIndex()
+    b.set_values([])  # no-op
+    assert b.get_cardinality() == 0
+    b.set_values([(1, 3), (1, 4)])  # duplicate column: last wins
+    assert b.get_value(1) == (4, True)
+    b2 = RoaringBitmapSliceIndex()
+    b2.set_values([[1, 2], [3, 4]])  # list-of-lists = pairs
+    assert b2.get_value(1) == (2, True) and b2.get_value(3) == (4, True)
+    b3 = RoaringBitmapSliceIndex()
+    b3.set_values(([1, 3], [2, 4]))  # 2-tuple = parallel arrays
+    assert b3.get_value(1) == (2, True) and b3.get_value(3) == (4, True)
+
+
+def test_transpose():
+    b = RoaringBitmapSliceIndex()
+    b.set_values(([1, 2, 3, 4], [7, 7, 0, 12]))
+    assert set(b.transpose().to_array().tolist()) == {0, 7, 12}

@@ -1,0 +1,76 @@
+"""RoaringBitSet, FastRankRoaringBitmap, BitSetUtil conversions."""
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu.models.bitset import (
+    RoaringBitSet,
+    bitmap_of_words,
+    words_of_bitmap,
+)
+from roaringbitmap_tpu.models.fastrank import FastRankRoaringBitmap
+from roaringbitmap_tpu import RoaringBitmap
+
+
+def test_bitset_api():
+    bs = RoaringBitSet()
+    bs.set(5)
+    bs.set(100000)
+    assert bs.get(5) and bs.get(100000) and not bs.get(6)
+    assert bs.cardinality() == 2
+    assert bs.length() == 100001
+    bs.flip(5)
+    assert not bs.get(5)
+    bs.set_range(10, 20)
+    assert bs.next_set_bit(0) == 10
+    assert bs.next_clear_bit(10) == 20
+    assert bs.previous_set_bit(15) == 15
+    bs.clear_range(10, 20)
+    assert bs.cardinality() == 1
+    bs.clear()
+    assert bs.is_empty()
+
+
+def test_bitset_logical_ops():
+    a, b = RoaringBitSet(), RoaringBitSet()
+    a.set_range(0, 100)
+    b.set_range(50, 150)
+    assert a.intersects(b)
+    a.and_(b)
+    assert a.cardinality() == 50
+    a.or_(b)
+    assert a.cardinality() == 100
+    a.xor(b)
+    assert a.cardinality() == 0
+
+
+def test_words_roundtrip(rng):
+    words = rng.integers(0, 1 << 64, size=3000, dtype=np.uint64)
+    bm = bitmap_of_words(words)
+    values = np.nonzero(np.unpackbits(words.view(np.uint8), bitorder="little"))[0]
+    assert np.array_equal(bm.to_array(), values.astype(np.uint32))
+    back = words_of_bitmap(bm)
+    # back is sized to the last set bit; compare set bits
+    assert np.array_equal(
+        np.nonzero(np.unpackbits(back.view(np.uint8), bitorder="little"))[0], values
+    )
+
+
+def test_fastrank_matches_plain(rng):
+    vals = rng.integers(0, 1 << 24, size=20000, dtype=np.uint64)
+    plain = RoaringBitmap(vals)
+    fast = FastRankRoaringBitmap(vals)
+    u = np.unique(vals)
+    for j in [0, 5000, len(u) - 1]:
+        assert fast.select(j) == plain.select(j) == u[j]
+        assert fast.rank(int(u[j])) == plain.rank(int(u[j]))
+    # cache invalidation on mutation
+    fast.add(int(u[0]) + 1) if int(u[0]) + 1 not in set(u.tolist()) else fast.remove(int(u[0]))
+    assert fast.rank(int(u[-1])) == fast.get_cardinality()
+    # range mutation invalidates too
+    fast2 = FastRankRoaringBitmap([1, 2, 3])
+    assert fast2.select(2) == 3
+    fast2.add_range(10, 20)
+    assert fast2.select(12) == 19
+    fast2.remove_range(10, 20)
+    assert fast2.rank(100) == 3

@@ -1,0 +1,172 @@
+"""Writers DSL, zero-copy immutable path, insights
+(reference oracles: TestRoaringBitmapWriter, TestMemoryMapping,
+insights/ suite)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import (
+    ImmutableRoaringBitmap,
+    RoaringBitmap,
+    RoaringBitmapWriter,
+    insights,
+)
+from roaringbitmap_tpu.models.fastrank import FastRankRoaringBitmap
+
+
+def test_writer_sorted_stream(rng):
+    vals = np.sort(rng.choice(1 << 22, size=50000, replace=False))
+    w = RoaringBitmapWriter.writer().get()
+    w.add_many(vals)
+    bm = w.get()
+    assert np.array_equal(bm.to_array(), vals.astype(np.uint32))
+
+
+def test_writer_point_adds_sorted():
+    w = RoaringBitmapWriter.writer().constant_memory().get()
+    for v in [1, 2, 3, 70000, 70001, 200000]:
+        w.add(v)
+    bm = w.get()
+    assert bm.to_array().tolist() == [1, 2, 3, 70000, 70001, 200000]
+
+
+def test_writer_unsorted_input(rng):
+    vals = rng.choice(1 << 22, size=20000, replace=False)
+    w = RoaringBitmapWriter.writer().partially_sort_values().get()
+    w.add_many(vals)
+    # interleave point adds out of order
+    w.add(5)
+    w.add(4)
+    bm = w.get()
+    want = np.unique(np.concatenate([vals, [4, 5]]))
+    assert np.array_equal(bm.to_array(), want.astype(np.uint32))
+
+
+def test_writer_run_optimise():
+    w = RoaringBitmapWriter.writer().optimise_for_runs().get()
+    w.add_many(np.arange(100000))
+    bm = w.get()
+    assert bm.has_run_compression()
+    assert bm.get_cardinality() == 100000
+
+
+def test_writer_fast_rank():
+    w = RoaringBitmapWriter.writer().fast_rank().get()
+    w.add_many([10, 20, 30])
+    bm = w.get()
+    assert isinstance(bm, FastRankRoaringBitmap)
+    assert bm.select(1) == 20
+
+
+def test_writer_flush_midstream():
+    w = RoaringBitmapWriter.writer().get()
+    w.add(100)
+    w.flush()
+    w.add(50)  # goes through the buffered path after flush reset
+    bm = w.get()
+    assert bm.to_array().tolist() == [50, 100]
+
+
+def test_wizard_option_thresholds():
+    # expected_values_per_container picks strategy (RoaringBitmapWriter.java:68-77)
+    w1 = RoaringBitmapWriter.writer().expected_values_per_container(100)
+    assert not w1._optimise_runs
+    w2 = RoaringBitmapWriter.writer().expected_values_per_container(5000)
+    assert w2._constant_memory
+    w3 = RoaringBitmapWriter.writer().expected_values_per_container(1 << 15)
+    assert w3._optimise_runs
+
+
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def serialized_bitmap(random_bitmap_factory):
+    bm, _ = random_bitmap_factory()
+    bm.run_optimize()
+    return bm, bm.serialize()
+
+
+def test_immutable_reads_without_copy(serialized_bitmap):
+    bm, data = serialized_bitmap
+    imm = ImmutableRoaringBitmap(data)
+    assert imm.get_cardinality() == bm.get_cardinality()
+    assert np.array_equal(imm.to_array(), bm.to_array())
+    arr = bm.to_array()
+    for x in [int(arr[0]), int(arr[-1]), int(arr[len(arr) // 2])]:
+        assert imm.contains(x)
+        assert imm.rank(x) == bm.rank(x)
+    assert imm.first() == bm.first() and imm.last() == bm.last()
+    assert imm.select(10) == bm.select(10)
+    assert imm == bm
+    assert imm.serialize() == data
+
+
+def test_immutable_to_mutable(serialized_bitmap):
+    bm, data = serialized_bitmap
+    imm = ImmutableRoaringBitmap(data)
+    mut = imm.to_mutable()
+    assert mut == bm
+    mut.add(0) if not mut.contains(0) else mut.remove(0)
+    # source buffer unchanged
+    assert ImmutableRoaringBitmap(data) == bm
+
+
+def test_immutable_mmap_file(tmp_path, serialized_bitmap):
+    bm, data = serialized_bitmap
+    path = tmp_path / "bitmap.bin"
+    path.write_bytes(data)
+    imm = ImmutableRoaringBitmap.map_file(str(path))
+    assert imm.get_cardinality() == bm.get_cardinality()
+    assert np.array_equal(imm.to_array(), bm.to_array())
+
+
+@pytest.mark.parametrize("name", ["bitmapwithruns.bin", "bitmapwithoutruns.bin"])
+def test_immutable_on_golden_files(name):
+    path = f"/root/reference/RoaringBitmap/src/test/resources/testdata/{name}"
+    if not os.path.isfile(path):
+        pytest.skip("reference not mounted")
+    imm = ImmutableRoaringBitmap.map_file(path)
+    assert imm.get_cardinality() == 200100
+
+
+def test_immutable_rejects_garbage():
+    from roaringbitmap_tpu import InvalidRoaringFormat
+
+    with pytest.raises(InvalidRoaringFormat):
+        ImmutableRoaringBitmap(b"\xde\xad\xbe\xef" * 4)
+
+
+# ---------------------------------------------------------------------------
+
+
+def test_insights_analyse_and_recommend():
+    dense = RoaringBitmap()
+    dense.add_range(0, 300000)
+    dense.remove_run_compression()
+    sparse = RoaringBitmap([1, 5, 100])
+    runs = RoaringBitmap()
+    runs.add_range(0, 100000)
+    runs.run_optimize()
+    stats = insights.analyse([dense, sparse, runs])
+    assert stats.bitmaps_count == 3
+    assert stats.run_containers_count >= 1
+    assert stats.bitmap_containers_count >= 4
+    assert stats.array_stats.containers_count >= 1
+    assert stats.container_count() == (
+        stats.array_stats.containers_count
+        + stats.bitmap_containers_count
+        + stats.run_containers_count
+    )
+    text = insights.recommend(stats)
+    assert isinstance(text, str) and text
+    assert insights.recommend(insights.analyse([])).startswith("No containers")
+
+
+def test_immutable_select_negative_raises(serialized_bitmap):
+    bm, data = serialized_bitmap
+    imm = ImmutableRoaringBitmap(data)
+    with pytest.raises(IndexError):
+        imm.select(-1)
