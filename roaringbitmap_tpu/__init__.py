@@ -28,9 +28,11 @@ from .models.fastrank import FastRankRoaringBitmap
 from .models.immutable import ImmutableRoaringBitmap
 from .models.writer import RoaringBitmapWriter
 from .models.bsi import Operation, RoaringBitmapSliceIndex
+from .models.range_bitmap import RangeBitmap
 from .serialization import InvalidRoaringFormat
 from .parallel.aggregation import FastAggregation, ParallelAggregation
 from . import insights
+from . import fuzz
 
 # MutableRoaringBitmap: the reference's buffer twin of the mutable facade
 # (buffer/MutableRoaringBitmap.java). Here the heap/buffer split collapses
@@ -55,8 +57,10 @@ __all__ = [
     "RoaringBitmapWriter",
     "Operation",
     "RoaringBitmapSliceIndex",
+    "RangeBitmap",
     "InvalidRoaringFormat",
     "FastAggregation",
     "ParallelAggregation",
     "insights",
+    "fuzz",
 ]

@@ -48,7 +48,10 @@ class Operation(enum.Enum):
 
 class config:
     mode: str = "auto"  # 'auto' | 'cpu' | 'device'
-    min_device_cells = 256  # slices x key-chunks below which CPU wins
+    # slices x key-chunks below which the CPU path wins; device dispatch has
+    # a fixed per-call cost (worst on tunneled dev chips) that only pays off
+    # on large indexes (the 100M-row north-star is ~49k cells)
+    min_device_cells = 4096
 
 
 class RoaringBitmapSliceIndex:
@@ -254,6 +257,16 @@ class RoaringBitmapSliceIndex:
         if res is not None:
             return res
         if operation == Operation.RANGE:
+            # clamp the upper bound to the representable bit depth: the slice
+            # walk only sees bit_count() bits, and every stored value fits in
+            # them, so LE(end) == LE(clamped) — without this, an oversized
+            # `end` would be silently truncated to its low bits
+            end = min(int(end), (1 << self.bit_count()) - 1)
+            if self._use_device(mode):
+                # both slice walks + AND fused into one device dispatch
+                return self._o_neil_device(
+                    Operation.RANGE, start_or_value, found_set, end=end
+                )
             left = self._o_neil(Operation.GE, start_or_value, found_set, mode)
             right = self._o_neil(Operation.LE, end, found_set, mode)
             return RoaringBitmap.and_(left, right)
@@ -296,6 +309,12 @@ class RoaringBitmapSliceIndex:
         elif op == Operation.NEQ:
             if mn == mx:
                 return empty if mn == v else all_
+            if v < mn or v > mx:
+                # no stored value can equal v -> NEQ = the raw fixed set
+                # (Java keeps found_set un-intersected for NEQ); avoids the
+                # slice walk seeing a bit-truncated predicate (strictly more
+                # correct than the reference, which truncates here)
+                return self.ebm.clone() if found_set is None else found_set.clone()
         elif op == Operation.RANGE:
             if v <= mn and end >= mx:
                 return all_
@@ -382,11 +401,12 @@ class RoaringBitmapSliceIndex:
         self._pack_cache = (self._version, keys, jnp.asarray(ebm_w), jnp.asarray(slices_w))
         return self._pack_cache[1:]
 
-    def _o_neil_device(self, op, predicate, found_set) -> RoaringBitmap:
+    def _o_neil_device(self, op, predicate, found_set, end: int = 0) -> RoaringBitmap:
         """The whole O'Neil chain — scan, op epilogue and popcount — as ONE
         jitted device call (the SURVEY §3.5 batched-kernel target; a single
         dispatch also matters because device round-trips dominate small
-        queries)."""
+        queries). For RANGE, both slice walks (GE lo, LE hi) and the final
+        AND run inside the same dispatch."""
         import jax.numpy as jnp
 
         from ..parallel import store
@@ -396,6 +416,11 @@ class RoaringBitmapSliceIndex:
         bits_vec = np.array(
             [(predicate >> i) & 1 for i in range(S - 1, -1, -1)], dtype=bool
         )
+        if op == Operation.RANGE:
+            bits_hi = np.array(
+                [(end >> i) & 1 for i in range(S - 1, -1, -1)], dtype=bool
+            )
+            bits_vec = np.stack([bits_vec, bits_hi])
 
         if found_set is None:
             fixed_w, fixed_bm = ebm_w, self.ebm
@@ -555,22 +580,33 @@ def _o_neil_compare_fused(slices_w, bits_rev, ebm_w, fixed_w, op_name: str):
         @functools.partial(jax.jit, static_argnames=("op_name",))
         def run(slices_w, bits_rev, ebm_w, fixed_w, op_name):
             zeros = jnp.zeros_like(ebm_w)
-            (gt, lt, eq), _ = lax.scan(
-                _scan_body, (zeros, zeros, ebm_w), (slices_w[::-1], bits_rev)
-            )
-            eq = eq & fixed_w
-            if op_name == "EQ":
-                out = eq
-            elif op_name == "NEQ":
-                out = fixed_w & ~eq
-            elif op_name == "GT":
-                out = gt & fixed_w
-            elif op_name == "LT":
-                out = lt & fixed_w
-            elif op_name == "LE":
-                out = (lt | eq) & fixed_w
-            else:  # GE
-                out = (gt | eq) & fixed_w
+            rev = slices_w[::-1]
+
+            def walk(bits):
+                (gt, lt, eq), _ = lax.scan(
+                    _scan_body, (zeros, zeros, ebm_w), (rev, bits)
+                )
+                return gt, lt, eq
+
+            if op_name == "RANGE":  # bits_rev is [2, S]: (lo GE, hi LE)
+                gt_lo, _, eq_lo = walk(bits_rev[0])
+                _, lt_hi, eq_hi = walk(bits_rev[1])
+                out = ((gt_lo | eq_lo) & (lt_hi | eq_hi)) & fixed_w
+            else:
+                gt, lt, eq = walk(bits_rev)
+                eq = eq & fixed_w
+                if op_name == "EQ":
+                    out = eq
+                elif op_name == "NEQ":
+                    out = fixed_w & ~eq
+                elif op_name == "GT":
+                    out = gt & fixed_w
+                elif op_name == "LT":
+                    out = lt & fixed_w
+                elif op_name == "LE":
+                    out = (lt | eq) & fixed_w
+                else:  # GE
+                    out = (gt | eq) & fixed_w
             cards = jnp.sum(lax.population_count(out).astype(jnp.int32), axis=-1)
             return out, cards
 

@@ -71,28 +71,24 @@ class FastRankRoaringBitmap(RoaringBitmap):
         return self._cum
 
     def rank_long(self, x: int) -> int:
+        from ..utils.order_stats import bucketed_rank
+
         x = int(x)
         hb, lb = x >> 16, x & 0xFFFF
         hlc = self.high_low_container
-        from bisect import bisect_left
-
-        i = bisect_left(hlc.keys, hb)
-        cum = self._cum_cards()
-        total = int(cum[i - 1]) if i > 0 else 0
-        if i < hlc.size and hlc.keys[i] == hb:
-            total += hlc.containers[i].rank(lb)
-        return total
+        return bucketed_rank(
+            hlc.keys, self._cum_cards(), hb, lambda i: hlc.containers[i].rank(lb)
+        )
 
     rank = rank_long
 
     def select(self, j: int) -> int:
-        j = int(j)
-        if j < 0:
-            raise IndexError(j)
-        cum = self._cum_cards()
-        i = int(np.searchsorted(cum, j + 1))
+        from ..utils.order_stats import bucketed_select
+
         hlc = self.high_low_container
-        if i >= hlc.size:
-            raise IndexError("select out of range")
-        prior = int(cum[i - 1]) if i else 0
-        return (hlc.keys[i] << 16) | hlc.containers[i].select(j - prior)
+        return bucketed_select(
+            hlc.keys,
+            self._cum_cards(),
+            j,
+            lambda i, lj: (hlc.keys[i] << 16) | hlc.containers[i].select(lj),
+        )

@@ -168,25 +168,26 @@ class ImmutableRoaringBitmap:
         return i >= 0 and self._container(i).contains(x & 0xFFFF)
 
     def rank(self, x: int) -> int:
+        from ..utils.order_stats import bucketed_rank
+
         x = int(x)
         hb, lb = x >> 16, x & 0xFFFF
-        before = self._keys < hb
-        total = int(self._cards[before].sum())
-        i = self._key_index(hb)
-        if i >= 0:
-            total += self._container(i).rank(lb)
-        return total
+        return bucketed_rank(
+            self._keys.tolist(),
+            np.cumsum(self._cards),
+            hb,
+            lambda i: self._container(i).rank(lb),
+        )
 
     def select(self, j: int) -> int:
-        j = int(j)
-        if j < 0:
-            raise IndexError(j)
-        cum = np.cumsum(self._cards)
-        i = int(np.searchsorted(cum, j + 1))
-        if i >= self._size:
-            raise IndexError("select out of range")
-        prior = int(cum[i - 1]) if i else 0
-        return (int(self._keys[i]) << 16) | self._container(i).select(j - prior)
+        from ..utils.order_stats import bucketed_select
+
+        return bucketed_select(
+            self._keys.tolist(),
+            np.cumsum(self._cards),
+            j,
+            lambda i, lj: (int(self._keys[i]) << 16) | self._container(i).select(lj),
+        )
 
     def first(self) -> int:
         if self.is_empty():

@@ -284,29 +284,26 @@ class Roaring64Bitmap:
 
     def rank(self, x: int) -> int:
         """rankLong (Roaring64NavigableMap.java:351)."""
+        from ..utils.order_stats import bucketed_rank
+
         x = _check64(x)
         high, low = x >> 32, x & 0xFFFFFFFF
         keys = self._sorted_keys()
-        i = bisect_left(keys, high)
-        cum = self._cum()
-        total = int(cum[i - 1]) if i > 0 else 0
-        if i < len(keys) and keys[i] == high:
-            total += self._buckets[high].rank(low)
-        return total
+        return bucketed_rank(
+            keys, self._cum(), high, lambda i: self._buckets[keys[i]].rank(low)
+        )
 
     def select(self, j: int) -> int:
         """selectLong (Roaring64NavigableMap.java:473)."""
-        j = int(j)
-        if j < 0:
-            raise IndexError(j)
+        from ..utils.order_stats import bucketed_select
+
         keys = self._sorted_keys()
-        cum = self._cum()
-        i = int(np.searchsorted(cum, j + 1))
-        if i >= len(keys):
-            raise IndexError("select out of range")
-        prior = int(cum[i - 1]) if i else 0
-        k = keys[i]
-        return (k << 32) | self._buckets[k].select(j - prior)
+        return bucketed_select(
+            keys,
+            self._cum(),
+            j,
+            lambda i, lj: (keys[i] << 32) | self._buckets[keys[i]].select(lj),
+        )
 
     def first(self) -> int:
         if self.is_empty():

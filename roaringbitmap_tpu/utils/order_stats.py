@@ -1,0 +1,49 @@
+"""Shared bucketed rank/select over (sorted keys, cumulative cardinalities).
+
+One implementation of the cached-cumulative-cardinality pattern the
+reference repeats in FastRankRoaringBitmap (FastRankRoaringBitmap.java:21-39)
+and Roaring64NavigableMap (Roaring64NavigableMap.java:66-72), used here by
+FastRankRoaringBitmap, Roaring64Bitmap and ImmutableRoaringBitmap.
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_left
+from typing import Callable, Sequence
+
+import numpy as np
+
+
+def bucketed_rank(
+    keys: Sequence[int],
+    cum: np.ndarray,
+    high: int,
+    bucket_rank: Callable[[int], int],
+) -> int:
+    """Rank of (high, low) given per-bucket ranks: full buckets below `high`
+    via the cumulative table, plus `bucket_rank(i)` inside the matching
+    bucket (the caller closes over `low`)."""
+    i = bisect_left(keys, high)
+    total = int(cum[i - 1]) if i > 0 else 0
+    if i < len(keys) and keys[i] == high:
+        total += bucket_rank(i)
+    return total
+
+
+def bucketed_select(
+    keys: Sequence[int],
+    cum: np.ndarray,
+    j: int,
+    bucket_select: Callable[[int, int], int],
+) -> int:
+    """Global j-th value: locate the bucket by cumulative cardinality, then
+    `bucket_select(i, local_j)`. The caller combines the returned low value
+    with keys[i]."""
+    j = int(j)
+    if j < 0:
+        raise IndexError(j)
+    i = int(np.searchsorted(cum, j + 1))
+    if i >= len(keys):
+        raise IndexError("select out of range")
+    prior = int(cum[i - 1]) if i else 0
+    return bucket_select(i, j - prior)

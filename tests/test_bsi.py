@@ -174,3 +174,11 @@ def test_transpose():
     b = RoaringBitmapSliceIndex()
     b.set_values(([1, 2, 3, 4], [7, 7, 0, 12]))
     assert set(b.transpose().to_array().tolist()) == {0, 7, 12}
+
+
+def test_neq_predicate_beyond_bit_depth():
+    """NEQ with out-of-range predicate returns everything (code-review
+    regression; stricter than the reference's bit truncation)."""
+    b = RoaringBitmapSliceIndex()
+    b.set_values(([1, 2, 3], [0, 5, 10]))
+    assert set(b.compare(Operation.NEQ, 1 << 20, 0, None).to_array().tolist()) == {1, 2, 3}

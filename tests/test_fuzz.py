@@ -1,0 +1,121 @@
+"""Invariant fuzzing — the reference's fuzz-tests invariants
+(Fuzzer.java: algebraic identities, cardinality consistency, serialization
+round-trip, optimized-vs-naive aggregation equivalence) plus the
+TPU-specific oracle: CPU path == device path."""
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import FastAggregation, RoaringBitmap
+from roaringbitmap_tpu.fuzz import (
+    InvarianceFailure,
+    random_bitmap,
+    reproduce,
+    verify_invariance,
+)
+
+ITER = 24  # per-invariant; full runs crank ROARINGBITMAP_TPU_FUZZ_ITERATIONS
+
+
+def test_de_morgan_and_distributivity():
+    def pred(a, b, c):
+        lhs = RoaringBitmap.and_(a, RoaringBitmap.or_(b, c))
+        rhs = RoaringBitmap.or_(RoaringBitmap.and_(a, b), RoaringBitmap.and_(a, c))
+        return lhs == rhs
+
+    verify_invariance("and-distributes-over-or", pred, arity=3, iterations=ITER, seed=1)
+
+
+def test_xor_identities():
+    def pred(a, b):
+        x = RoaringBitmap.xor(a, b)
+        return (
+            RoaringBitmap.xor(x, b) == a
+            and x == RoaringBitmap.or_(RoaringBitmap.andnot(a, b), RoaringBitmap.andnot(b, a))
+        )
+
+    verify_invariance("xor-involution", pred, arity=2, iterations=ITER, seed=2)
+
+
+def test_cardinality_consistency():
+    def pred(a, b):
+        return (
+            RoaringBitmap.or_cardinality(a, b)
+            == a.get_cardinality() + b.get_cardinality() - RoaringBitmap.and_cardinality(a, b)
+            and RoaringBitmap.or_(a, b).get_cardinality() == RoaringBitmap.or_cardinality(a, b)
+        )
+
+    verify_invariance("inclusion-exclusion", pred, arity=2, iterations=ITER, seed=3)
+
+
+def test_contains_add_remove():
+    def pred(a):
+        x = 123_456_789 % (1 << 32)
+        c = a.clone()
+        c.add(x)
+        if not c.contains(x):
+            return False
+        c.remove(x)
+        return not c.contains(x)
+
+    verify_invariance("contains-after-add", pred, arity=1, iterations=ITER, seed=4)
+
+
+def test_serialization_roundtrip_invariant():
+    def pred(a):
+        data = a.serialize()
+        back = RoaringBitmap.deserialize(data)
+        return back == a and back.serialize() == data
+
+    verify_invariance("serde-roundtrip", pred, arity=1, iterations=ITER, seed=5)
+
+
+def test_rank_select_inverse():
+    def pred(a):
+        card = a.get_cardinality()
+        for j in {0, card // 2, card - 1}:
+            if a.rank(a.select(j)) != j + 1:
+                return False
+        return True
+
+    verify_invariance("rank-select-inverse", pred, arity=1, iterations=ITER, seed=6)
+
+
+def test_flip_involution():
+    def pred(a):
+        c = a.clone()
+        c.flip_range(0, 1 << 22)
+        c.flip_range(0, 1 << 22)
+        return c == a
+
+    verify_invariance("flip-involution", pred, arity=1, iterations=ITER, seed=7)
+
+
+def test_aggregation_cpu_equals_device_and_naive():
+    def pred(a, b, c):
+        naive = RoaringBitmap.or_(RoaringBitmap.or_(a, b), c)
+        return (
+            FastAggregation.or_(a, b, c, mode="cpu") == naive
+            and FastAggregation.or_(a, b, c, mode="device") == naive
+        )
+
+    verify_invariance("wide-or-engines-agree", pred, arity=3, iterations=12, seed=8)
+
+
+def test_failure_report_reproduces():
+    """The harness must emit base64 payloads that reproduce the inputs."""
+    with pytest.raises(InvarianceFailure) as exc_info:
+        verify_invariance("always-false", lambda a: False, arity=1, iterations=1, seed=9)
+    repro = exc_info.value.repro
+    assert len(repro) == 1
+    bm = reproduce(repro[0])
+    rng = np.random.default_rng(9)
+    assert bm == random_bitmap(rng)
+
+
+def test_predicate_crash_is_reported():
+    def boom(a):
+        raise RuntimeError("kaboom")
+
+    with pytest.raises(InvarianceFailure, match="kaboom"):
+        verify_invariance("crash", boom, arity=1, iterations=1, seed=10)

@@ -1,0 +1,159 @@
+"""RangeBitmap differential tests (reference oracle: RangeBitmapTest.java)."""
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import RoaringBitmap
+from roaringbitmap_tpu.models.range_bitmap import RangeBitmap, RangeBitmapAppender
+from roaringbitmap_tpu.serialization import InvalidRoaringFormat
+
+
+@pytest.fixture
+def rows(rng):
+    return rng.integers(0, 1_000_000, size=150_000, dtype=np.uint64)
+
+
+@pytest.fixture
+def range_index(rows):
+    app = RangeBitmap.appender(1_000_000)
+    app.add_many(rows)
+    return app.build()
+
+
+def test_build_and_row_count(range_index, rows):
+    assert range_index.row_count == rows.size
+
+
+@pytest.mark.parametrize("q", [0, 1, 499_999, 999_999, 1_000_000])
+def test_all_query_ops(range_index, rows, q):
+    rids = np.arange(rows.size, dtype=np.int64)
+    assert np.array_equal(range_index.lt(q).to_array().astype(np.int64), rids[rows < q])
+    assert np.array_equal(range_index.lte(q).to_array().astype(np.int64), rids[rows <= q])
+    assert np.array_equal(range_index.gt(q).to_array().astype(np.int64), rids[rows > q])
+    assert np.array_equal(range_index.gte(q).to_array().astype(np.int64), rids[rows >= q])
+    assert np.array_equal(range_index.eq(q).to_array().astype(np.int64), rids[rows == q])
+    assert np.array_equal(range_index.neq(q).to_array().astype(np.int64), rids[rows != q])
+
+
+def test_between_and_cardinalities(range_index, rows):
+    rids = np.arange(rows.size, dtype=np.int64)
+    lo, hi = 250_000, 750_000
+    want = rids[(rows >= lo) & (rows <= hi)]
+    assert np.array_equal(range_index.between(lo, hi).to_array().astype(np.int64), want)
+    assert range_index.between_cardinality(lo, hi) == want.size
+    assert range_index.lt_cardinality(lo) == int((rows < lo).sum())
+    assert range_index.gte_cardinality(hi) == int((rows >= hi).sum())
+    assert range_index.eq_cardinality(int(rows[0])) == int((rows == rows[0]).sum())
+
+
+def test_context_prefilter(range_index, rows):
+    context = RoaringBitmap(np.arange(0, rows.size, 2, dtype=np.uint32))
+    got = range_index.lte(500_000, context)
+    rids = np.arange(rows.size, dtype=np.int64)
+    want = set(rids[rows <= 500_000].tolist()) & set(range(0, rows.size, 2))
+    assert set(got.to_array().tolist()) == want
+    # neq with context never returns rows outside the universe
+    ctx2 = RoaringBitmap([0, 1, rows.size + 100])
+    got2 = range_index.neq(int(rows[0]), ctx2)
+    assert rows.size + 100 not in set(got2.to_array().tolist())
+
+
+def test_serialize_map_roundtrip(range_index, rows):
+    data = range_index.serialize()
+    assert len(data) == range_index.serialized_size_in_bytes()
+    mapped = RangeBitmap.map(data)
+    assert mapped.row_count == rows.size
+    q = 123_456
+    assert np.array_equal(
+        mapped.lte(q).to_array(), range_index.lte(q).to_array()
+    )
+    assert mapped.serialize() == data
+
+
+def test_appender_point_adds():
+    app = RangeBitmap.appender(100)
+    for v in [5, 0, 100, 42]:
+        app.add(v)
+    rb = app.build()
+    assert rb.row_count == 4
+    assert rb.eq(5).to_array().tolist() == [0]
+    assert rb.lte(42).to_array().tolist() == [1, 3] or set(
+        rb.lte(42).to_array().tolist()
+    ) == {0, 1, 3}
+    with pytest.raises(ValueError):
+        app.add(101)
+    with pytest.raises(ValueError):
+        app.add(-1)
+
+
+def test_appender_chunk_boundary():
+    """Values crossing the 2^16-row internal flush boundary."""
+    n = (1 << 16) + 1000
+    app = RangeBitmap.appender(2)
+    vals = np.arange(n) % 3
+    app.add_many(vals)
+    rb = app.build()
+    assert rb.row_count == n
+    assert rb.eq_cardinality(2) == int((vals == 2).sum())
+    assert rb.lt_cardinality(2) == int((vals < 2).sum())
+
+
+def test_large_values_64bit():
+    app = RangeBitmap.appender((1 << 62))
+    vals = [0, 1 << 40, (1 << 62) - 1, 1 << 62, 12345]
+    for v in vals:
+        app.add(v)
+    rb = app.build()
+    assert rb.gte(1 << 40).get_cardinality() == 3
+    assert rb.eq(1 << 62).to_array().tolist() == [3]
+    assert rb.lt(1 << 62).get_cardinality() == 4
+
+
+def test_map_rejects_garbage():
+    with pytest.raises(InvalidRoaringFormat):
+        RangeBitmap.map(b"\x00" * 20)
+    with pytest.raises(InvalidRoaringFormat):
+        RangeBitmap.map(b"\x0d\xf0\x02\x05")  # right cookie, truncated
+
+
+def test_empty_appender():
+    rb = RangeBitmap.appender(10).build()
+    assert rb.row_count == 0
+    assert rb.lte(10).is_empty()
+    assert rb.neq(5).is_empty()
+    data = rb.serialize()
+    assert RangeBitmap.map(data).row_count == 0
+
+
+def test_between_end_beyond_bit_depth():
+    """Oversized upper bounds must not truncate (code-review regression)."""
+    app = RangeBitmap.appender(5)
+    for v in [0, 1, 2, 3, 4, 5]:
+        app.add(v)
+    rb = app.build()
+    assert rb.between(2, 100).to_array().tolist() == [2, 3, 4, 5]
+    assert rb.between_cardinality(2, 1 << 40) == 4
+
+
+def test_interleaved_add_and_add_many():
+    """Row-id order preserved across mixed add()/add_many() (code-review
+    regression)."""
+    app = RangeBitmap.appender(10)
+    app.add(7)
+    app.add_many([1, 2])
+    app.add(9)
+    rb = app.build()
+    assert rb.eq(7).to_array().tolist() == [0]
+    assert rb.eq(1).to_array().tolist() == [1]
+    assert rb.eq(9).to_array().tolist() == [3]
+
+
+def test_full_64bit_values():
+    """No 2^63 clamp: thresholds above 2^63 behave (code-review regression)."""
+    app = RangeBitmap.appender((1 << 64) - 1)
+    app.add((1 << 64) - 1)
+    app.add(5)
+    rb = app.build()
+    assert rb.lt(1 << 63).to_array().tolist() == [1]
+    assert rb.eq((1 << 64) - 1).to_array().tolist() == [0]
+    assert rb.gte(1 << 63).to_array().tolist() == [0]
