@@ -1,0 +1,239 @@
+"""Native host runtime: compiled C++ L0 kernels behind ctypes.
+
+The reference's hot host-side loops are JIT-compiled Java intrinsics
+(Util.java galloping searches, Long.bitCount folds); this framework's
+equivalents are a small C++ library (``kernels.cpp``) compiled on first use
+with the system toolchain and loaded via ctypes — no build-time dependency,
+no pybind11. Every entry point has an identical-semantics numpy fallback in
+``utils/bits.py``; ``utils/bits.py`` transparently dispatches here when the
+library is available (disable with ``ROARINGBITMAP_TPU_NO_NATIVE=1``).
+
+The TPU compute path (ops/) never goes through this module — it exists for
+the CPU fast path, where the reference wins on ns-scale small-container ops
+and Python/numpy call overhead would otherwise dominate.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "kernels.cpp")
+_LIB_NAME = "_rb_kernels.so"
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build(out_path: str) -> bool:
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+        "-fno-exceptions", "-fno-rtti",
+        _SRC, "-o", out_path,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, timeout=120)
+        return proc.returncode == 0 and os.path.exists(out_path)
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    u16p = np.ctypeslib.ndpointer(np.uint16, flags="C_CONTIGUOUS")
+    u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+    u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    i32, i64 = ctypes.c_int32, ctypes.c_int64
+    u16 = ctypes.c_uint16
+
+    lib.rb_advance_until.restype = i32
+    lib.rb_advance_until.argtypes = [u16p, i32, i32, u16]
+    lib.rb_intersect_u16.restype = i32
+    lib.rb_intersect_u16.argtypes = [u16p, i32, u16p, i32, u16p]
+    lib.rb_intersect_card_u16.restype = i32
+    lib.rb_intersect_card_u16.argtypes = [u16p, i32, u16p, i32]
+    for name in ("rb_union_u16", "rb_difference_u16", "rb_xor_u16"):
+        fn = getattr(lib, name)
+        fn.restype = i32
+        fn.argtypes = [u16p, i32, u16p, i32, u16p]
+    lib.rb_contains_many_u16.restype = None
+    lib.rb_contains_many_u16.argtypes = [u16p, i32, u16p, i32, u8p]
+    lib.rb_popcount_words.restype = i64
+    lib.rb_popcount_words.argtypes = [u64p, i64]
+    lib.rb_words_from_values.restype = None
+    lib.rb_words_from_values.argtypes = [u16p, i32, u64p]
+    lib.rb_values_from_words.restype = i32
+    lib.rb_values_from_words.argtypes = [u64p, i32, u16p]
+    lib.rb_num_runs_words.restype = i32
+    lib.rb_num_runs_words.argtypes = [u64p, i32]
+    lib.rb_select_words.restype = i32
+    lib.rb_select_words.argtypes = [u64p, i32, i32]
+    lib.rb_cardinality_in_range.restype = i64
+    lib.rb_cardinality_in_range.argtypes = [u64p, i32, i32]
+    lib.rb_wide_op_words.restype = i64
+    lib.rb_wide_op_words.argtypes = [u64p, i64, i64, i32, u64p]
+    lib.rb_runs_from_values.restype = i32
+    lib.rb_runs_from_values.argtypes = [u16p, i32, u16p, u16p]
+    lib.rb_num_runs_values.restype = i32
+    lib.rb_num_runs_values.argtypes = [u16p, i32]
+
+
+def _load():
+    global _lib, _tried
+    if _tried:
+        return _lib
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("ROARINGBITMAP_TPU_NO_NATIVE"):
+            return None
+        path = os.path.join(_DIR, _LIB_NAME)
+        try:
+            if not os.path.exists(path) or os.path.getmtime(path) < os.path.getmtime(_SRC):
+                if not _build(path):
+                    # package dir may be read-only; fall back to a temp build
+                    path = os.path.join(
+                        tempfile.gettempdir(), f"rb_kernels_{os.getuid()}.so"
+                    )
+                    if not os.path.exists(path) and not _build(path):
+                        return None
+            lib = ctypes.CDLL(path)
+            _declare(lib)
+            _lib = lib
+        except OSError:
+            _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def lib() -> ctypes.CDLL:
+    l = _load()
+    if l is None:
+        raise RuntimeError("native kernels unavailable")
+    return l
+
+
+# ---------------------------------------------------------------------------
+# numpy-facing wrappers (same signatures as the utils/bits.py fallbacks)
+# ---------------------------------------------------------------------------
+
+
+def _c16(a: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(a, dtype=np.uint16)
+
+
+def intersect_sorted(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    a, b = _c16(a), _c16(b)
+    out = np.empty(min(a.size, b.size), dtype=np.uint16)
+    n = lib().rb_intersect_u16(a, a.size, b, b.size, out)
+    return out[:n]
+
+
+def intersect_cardinality(a: np.ndarray, b: np.ndarray) -> int:
+    a, b = _c16(a), _c16(b)
+    return int(lib().rb_intersect_card_u16(a, a.size, b, b.size))
+
+
+def merge_sorted_unique(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    a, b = _c16(a), _c16(b)
+    out = np.empty(a.size + b.size, dtype=np.uint16)
+    n = lib().rb_union_u16(a, a.size, b, b.size, out)
+    return out[:n]
+
+
+def difference_sorted(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    a, b = _c16(a), _c16(b)
+    out = np.empty(a.size, dtype=np.uint16)
+    n = lib().rb_difference_u16(a, a.size, b, b.size, out)
+    return out[:n]
+
+
+def xor_sorted(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    a, b = _c16(a), _c16(b)
+    out = np.empty(a.size + b.size, dtype=np.uint16)
+    n = lib().rb_xor_u16(a, a.size, b, b.size, out)
+    return out[:n]
+
+
+def contains_many(sorted_vals: np.ndarray, queries: np.ndarray) -> np.ndarray:
+    s, q = _c16(sorted_vals), _c16(queries)
+    out = np.empty(q.size, dtype=np.uint8)
+    lib().rb_contains_many_u16(s, s.size, q, q.size, out)
+    return out.astype(bool)
+
+
+def advance_until(a: np.ndarray, pos: int, min_val: int) -> int:
+    a = _c16(a)
+    return int(lib().rb_advance_until(a, a.size, pos, min_val))
+
+
+def cardinality_of_words(words: np.ndarray) -> int:
+    w = np.ascontiguousarray(words, dtype=np.uint64)
+    return int(lib().rb_popcount_words(w, w.size))
+
+
+def words_from_values(values: np.ndarray, n_words: int = 1024) -> np.ndarray:
+    v = _c16(values)
+    words = np.zeros(n_words, dtype=np.uint64)
+    lib().rb_words_from_values(v, v.size, words)
+    return words
+
+
+def values_from_words(words: np.ndarray) -> np.ndarray:
+    w = np.ascontiguousarray(words, dtype=np.uint64)
+    out = np.empty(w.size * 64, dtype=np.uint16)
+    n = lib().rb_values_from_words(w, w.size, out)
+    return out[:n]
+
+
+def num_runs_in_words(words: np.ndarray) -> int:
+    w = np.ascontiguousarray(words, dtype=np.uint64)
+    return int(lib().rb_num_runs_words(w, w.size))
+
+
+def select_in_words(words: np.ndarray, j: int) -> int:
+    w = np.ascontiguousarray(words, dtype=np.uint64)
+    r = int(lib().rb_select_words(w, w.size, j))
+    if r < 0:
+        raise IndexError(f"select({j}) out of range")
+    return r
+
+
+def cardinality_in_range(words: np.ndarray, start: int, end: int) -> int:
+    w = np.ascontiguousarray(words, dtype=np.uint64)
+    return int(lib().rb_cardinality_in_range(w, start, end))
+
+
+def wide_op_words(rows: np.ndarray, op: str = "or"):
+    """Fold an [n_rows, n_words] matrix; returns (out_words, cardinality)."""
+    r = np.ascontiguousarray(rows, dtype=np.uint64)
+    n_rows, n_words = r.shape
+    out = np.empty(n_words, dtype=np.uint64)
+    opc = {"or": 0, "and": 1, "xor": 2}[op]
+    card = lib().rb_wide_op_words(r.reshape(-1), n_rows, n_words, opc, out)
+    return out, int(card)
+
+
+def runs_from_values(values: np.ndarray):
+    v = _c16(values)
+    if v.size == 0:
+        return np.empty(0, dtype=np.uint16), np.empty(0, dtype=np.uint16)
+    starts = np.empty(v.size, dtype=np.uint16)
+    lengths = np.empty(v.size, dtype=np.uint16)
+    n = lib().rb_runs_from_values(v, v.size, starts, lengths)
+    return starts[:n], lengths[:n]
+
+
+def num_runs_in_values(values: np.ndarray) -> int:
+    v = _c16(values)
+    return int(lib().rb_num_runs_values(v, v.size))

@@ -1,0 +1,256 @@
+// Native host-side L0 kernels for roaringbitmap_tpu.
+//
+// C++ re-expression of the reference's JIT-intrinsic word/array kernels
+// (reference: RoaringBitmap/src/main/java/org/roaringbitmap/Util.java —
+// unsignedIntersect2by2 :890 with the galloping variant :934,
+// unsignedUnion2by2 :1116, unsignedDifference, unsignedExclusiveUnion2by2,
+// advanceUntil :64-analogue, select(long,int) :564 — and
+// BitmapContainer.java's Long.bitCount loops). The TPU device path lives in
+// ops/device.py + ops/pallas_kernels.py; this library is the CPU fast path
+// for small/irregular containers, where Python/numpy call overhead dominates.
+//
+// Exposed via ctypes (native/__init__.py); every function has a numpy
+// fallback in utils/bits.py with identical semantics, used as the
+// differential-test oracle (tests/test_native.py).
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// sorted uint16 set algebra
+// ---------------------------------------------------------------------------
+
+// Exponential (galloping) search: smallest index i in [pos, n) with
+// a[i] >= min, else n. Mirrors Util.advanceUntil's exponential+binary probe.
+static int32_t gallop(const uint16_t* a, int32_t pos, int32_t n, uint16_t min) {
+  int32_t lo = pos;
+  if (lo >= n || a[lo] >= min) return lo;
+  int32_t span = 1;
+  while (lo + span < n && a[lo + span] < min) span <<= 1;
+  int32_t hi = (lo + span < n) ? lo + span : n - 1;
+  lo = lo + (span >> 1);
+  if (a[hi] < min) return n;
+  // binary search in (lo, hi]
+  while (lo + 1 < hi) {
+    int32_t mid = lo + ((hi - lo) >> 1);
+    if (a[mid] < min) lo = mid; else hi = mid;
+  }
+  return hi;
+}
+
+int32_t rb_advance_until(const uint16_t* a, int32_t n, int32_t pos, uint16_t min) {
+  return gallop(a, pos + 1, n, min);
+}
+
+// One-sided galloping intersection: |small| * 64 < |large|
+// (Util.java:890-932's THRESHOLD=64 dispatch to the galloping variant :934).
+static int32_t intersect_gallop(const uint16_t* s, int32_t ns, const uint16_t* l,
+                                int32_t nl, uint16_t* out) {
+  int32_t k = 0, j = 0;
+  for (int32_t i = 0; i < ns; ++i) {
+    j = gallop(l, j, nl, s[i]);
+    if (j == nl) break;
+    if (l[j] == s[i]) {
+      if (out) out[k] = s[i];
+      ++k;
+    }
+  }
+  return k;
+}
+
+int32_t rb_intersect_u16(const uint16_t* a, int32_t na, const uint16_t* b,
+                         int32_t nb, uint16_t* out) {
+  if (na == 0 || nb == 0) return 0;
+  if ((int64_t)na * 64 < nb) return intersect_gallop(a, na, b, nb, out);
+  if ((int64_t)nb * 64 < na) return intersect_gallop(b, nb, a, na, out);
+  int32_t i = 0, j = 0, k = 0;
+  while (i < na && j < nb) {
+    uint16_t x = a[i], y = b[j];
+    if (x < y) ++i;
+    else if (y < x) ++j;
+    else { if (out) out[k] = x; ++k; ++i; ++j; }
+  }
+  return k;
+}
+
+int32_t rb_intersect_card_u16(const uint16_t* a, int32_t na, const uint16_t* b,
+                              int32_t nb) {
+  return rb_intersect_u16(a, na, b, nb, nullptr);
+}
+
+int32_t rb_union_u16(const uint16_t* a, int32_t na, const uint16_t* b,
+                     int32_t nb, uint16_t* out) {
+  int32_t i = 0, j = 0, k = 0;
+  while (i < na && j < nb) {
+    uint16_t x = a[i], y = b[j];
+    if (x < y) { out[k++] = x; ++i; }
+    else if (y < x) { out[k++] = y; ++j; }
+    else { out[k++] = x; ++i; ++j; }
+  }
+  while (i < na) out[k++] = a[i++];
+  while (j < nb) out[k++] = b[j++];
+  return k;
+}
+
+int32_t rb_difference_u16(const uint16_t* a, int32_t na, const uint16_t* b,
+                          int32_t nb, uint16_t* out) {
+  int32_t i = 0, j = 0, k = 0;
+  while (i < na && j < nb) {
+    uint16_t x = a[i], y = b[j];
+    if (x < y) { out[k++] = x; ++i; }
+    else if (y < x) ++j;
+    else { ++i; ++j; }
+  }
+  while (i < na) out[k++] = a[i++];
+  return k;
+}
+
+int32_t rb_xor_u16(const uint16_t* a, int32_t na, const uint16_t* b, int32_t nb,
+                   uint16_t* out) {
+  int32_t i = 0, j = 0, k = 0;
+  while (i < na && j < nb) {
+    uint16_t x = a[i], y = b[j];
+    if (x < y) { out[k++] = x; ++i; }
+    else if (y < x) { out[k++] = y; ++j; }
+    else { ++i; ++j; }
+  }
+  while (i < na) out[k++] = a[i++];
+  while (j < nb) out[k++] = b[j++];
+  return k;
+}
+
+// membership of each query value in a sorted array -> byte mask
+void rb_contains_many_u16(const uint16_t* sorted, int32_t n, const uint16_t* q,
+                          int32_t nq, uint8_t* out) {
+  for (int32_t i = 0; i < nq; ++i) {
+    int32_t j = gallop(sorted, 0, n, q[i]);
+    out[i] = (j < n && sorted[j] == q[i]) ? 1 : 0;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// uint64 word-bitset kernels (1024 words per container, but n is generic)
+// ---------------------------------------------------------------------------
+
+int64_t rb_popcount_words(const uint64_t* w, int64_t n) {
+  int64_t c = 0;
+  for (int64_t i = 0; i < n; ++i) c += __builtin_popcountll(w[i]);
+  return c;
+}
+
+void rb_words_from_values(const uint16_t* v, int32_t n, uint64_t* words) {
+  for (int32_t i = 0; i < n; ++i) words[v[i] >> 6] |= 1ULL << (v[i] & 63);
+}
+
+int32_t rb_values_from_words(const uint64_t* words, int32_t n_words,
+                             uint16_t* out) {
+  int32_t k = 0;
+  for (int32_t w = 0; w < n_words; ++w) {
+    uint64_t x = words[w];
+    int32_t base = w << 6;
+    while (x) {
+      out[k++] = (uint16_t)(base + __builtin_ctzll(x));
+      x &= x - 1;
+    }
+  }
+  return k;
+}
+
+// number of runs: popcount(x & ~(x<<1 | carry)) with cross-word carry
+// (BitmapContainer.numberOfRuns' branchless per-word form).
+int32_t rb_num_runs_words(const uint64_t* words, int32_t n_words) {
+  int32_t runs = 0;
+  uint64_t carry = 0;
+  for (int32_t w = 0; w < n_words; ++w) {
+    uint64_t x = words[w];
+    runs += __builtin_popcountll(x & ~((x << 1) | carry));
+    carry = x >> 63;
+  }
+  return runs;
+}
+
+// position of the j-th (0-based) set bit, or -1
+int32_t rb_select_words(const uint64_t* words, int32_t n_words, int32_t j) {
+  for (int32_t w = 0; w < n_words; ++w) {
+    int32_t c = __builtin_popcountll(words[w]);
+    if (j < c) {
+      uint64_t x = words[w];
+      for (; j > 0; --j) x &= x - 1;  // peel j set bits (Util.select :564)
+      return (w << 6) + __builtin_ctzll(x);
+    }
+    j -= c;
+  }
+  return -1;
+}
+
+// popcount of bits [start, end) over the word array
+int64_t rb_cardinality_in_range(const uint64_t* words, int32_t start,
+                                int32_t end) {
+  if (start >= end) return 0;
+  int32_t first = start >> 6, last = (end - 1) >> 6;
+  uint64_t lo = ~0ULL << (start & 63);
+  uint64_t hi = ~0ULL >> (63 - ((end - 1) & 63));
+  if (first == last) return __builtin_popcountll(words[first] & lo & hi);
+  int64_t c = __builtin_popcountll(words[first] & lo) +
+              __builtin_popcountll(words[last] & hi);
+  for (int32_t w = first + 1; w < last; ++w)
+    c += __builtin_popcountll(words[w]);
+  return c;
+}
+
+// fold rows of an [n_rows, n_words] matrix: op 0=OR 1=AND 2=XOR; also returns
+// the popcount of the result (the lazy-cardinality "repair" fused in, cf.
+// Container.lazyIOR/repairAfterLazy Container.java:717/873).
+int64_t rb_wide_op_words(const uint64_t* rows, int64_t n_rows, int64_t n_words,
+                         int32_t op, uint64_t* out) {
+  if (n_rows == 0) {
+    memset(out, 0, (size_t)n_words * 8);
+    return 0;
+  }
+  memcpy(out, rows, (size_t)n_words * 8);
+  for (int64_t r = 1; r < n_rows; ++r) {
+    const uint64_t* row = rows + r * n_words;
+    switch (op) {
+      case 0: for (int64_t i = 0; i < n_words; ++i) out[i] |= row[i]; break;
+      case 1: for (int64_t i = 0; i < n_words; ++i) out[i] &= row[i]; break;
+      default: for (int64_t i = 0; i < n_words; ++i) out[i] ^= row[i]; break;
+    }
+  }
+  return rb_popcount_words(out, n_words);
+}
+
+// ---------------------------------------------------------------------------
+// runs
+// ---------------------------------------------------------------------------
+
+// (starts, lengths) from sorted unique values; returns run count.
+// lengths follow the spec convention: run covers [start, start+length].
+int32_t rb_runs_from_values(const uint16_t* v, int32_t n, uint16_t* starts,
+                            uint16_t* lengths) {
+  if (n == 0) return 0;
+  int32_t r = 0;
+  uint16_t start = v[0], prev = v[0];
+  for (int32_t i = 1; i < n; ++i) {
+    if (v[i] != (uint16_t)(prev + 1)) {
+      starts[r] = start;
+      lengths[r] = (uint16_t)(prev - start);
+      ++r;
+      start = v[i];
+    }
+    prev = v[i];
+  }
+  starts[r] = start;
+  lengths[r] = (uint16_t)(prev - start);
+  return r + 1;
+}
+
+int32_t rb_num_runs_values(const uint16_t* v, int32_t n) {
+  if (n == 0) return 0;
+  int32_t r = 1;
+  for (int32_t i = 1; i < n; ++i) r += (v[i] != (uint16_t)(v[i - 1] + 1));
+  return r;
+}
+
+}  // extern "C"

@@ -255,3 +255,43 @@ def xor_sorted(a: np.ndarray, b: np.ndarray) -> np.ndarray:
         return a.copy()
     out = np.setxor1d(a, b, assume_unique=True)
     return out.astype(np.uint16)
+
+
+# ---------------------------------------------------------------------------
+# native dispatch — when the compiled C++ kernels (native/kernels.cpp) are
+# available, rebind the hot host-path entry points to them. The numpy
+# versions above stay reachable under *_numpy as the differential-test
+# oracle (tests/test_native.py). Semantics are identical by contract.
+# ---------------------------------------------------------------------------
+
+intersect_sorted_numpy = intersect_sorted
+merge_sorted_unique_numpy = merge_sorted_unique
+difference_sorted_numpy = difference_sorted
+xor_sorted_numpy = xor_sorted
+cardinality_of_words_numpy = cardinality_of_words
+values_from_words_numpy = values_from_words
+words_from_values_numpy = words_from_values
+num_runs_in_words_numpy = num_runs_in_words
+select_in_words_numpy = select_in_words
+cardinality_in_range_numpy = cardinality_in_range
+runs_from_values_numpy = runs_from_values
+
+try:  # pragma: no cover - exercised via tests/test_native.py
+    from .. import native as _native
+
+    _NATIVE = _native.available()
+except Exception:  # toolchain missing, sandboxed, etc.
+    _NATIVE = False
+
+if _NATIVE:
+    intersect_sorted = _native.intersect_sorted
+    merge_sorted_unique = _native.merge_sorted_unique
+    difference_sorted = _native.difference_sorted
+    xor_sorted = _native.xor_sorted
+    cardinality_of_words = _native.cardinality_of_words
+    values_from_words = _native.values_from_words
+    words_from_values = _native.words_from_values
+    num_runs_in_words = _native.num_runs_in_words
+    select_in_words = _native.select_in_words
+    cardinality_in_range = _native.cardinality_in_range
+    runs_from_values = _native.runs_from_values
