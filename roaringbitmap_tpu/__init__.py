@@ -22,7 +22,9 @@ from .models.container import (
     container_range_of_ones,
 )
 from .models.roaring import RoaringBitmap
-from .models.roaring64 import Roaring64Bitmap, Roaring64NavigableMap
+from .models.roaring64 import Roaring64NavigableMap
+from .models.roaring64art import Roaring64Bitmap
+from .models.art import Art
 from .models.bitset import RoaringBitSet
 from .models.fastrank import FastRankRoaringBitmap
 from .models.immutable import ImmutableRoaringBitmap
@@ -51,6 +53,7 @@ __all__ = [
     "MutableRoaringBitmap",
     "Roaring64Bitmap",
     "Roaring64NavigableMap",
+    "Art",
     "RoaringBitSet",
     "FastRankRoaringBitmap",
     "ImmutableRoaringBitmap",

@@ -1,25 +1,30 @@
-"""64-bit layer: key-space extension over the 32-bit machinery.
+"""64-bit layer, design 1 of 2: ``Roaring64NavigableMap``.
 
 The reference ships two 64-bit designs — ``Roaring64NavigableMap``
 (longlong/Roaring64NavigableMap.java:29: NavigableMap of high-32 bits ->
-32-bit bitmap, cached cumulative cardinalities for rank/select :66-72) and
-the ART-based ``Roaring64Bitmap`` (longlong/Roaring64Bitmap.java:29: high-48
-trie -> 16-bit container). This framework uses one class with the
-NavigableMap decomposition: a sorted high-32 index over full 32-bit
-RoaringBitmaps. Rationale (TPU-first, SURVEY §5 "long-context" analogue):
-every bucket reuses the whole 32-bit stack including the packed device
-aggregation path, so 64-bit wide-ORs batch exactly like 32-bit ones; an ART
-trie is a pointer-chasing CPU structure with nothing to offer the device
-path, and the sorted-dict index has identical asymptotics at the bucket
-counts Python can hold.
+32-bit bitmap, cached cumulative cardinalities for rank/select :66-72,
+signed/unsigned key ordering :97-100) — this module — and the ART-based
+``Roaring64Bitmap`` (longlong/Roaring64Bitmap.java:29: high-48 trie ->
+16-bit container), built in ``roaring64art.py`` over ``art.py``.
 
-Serialization implements the portable 64-bit RoaringFormatSpec
-(Roaring64NavigableMap.java:47 SERIALIZATION_MODE_PORTABLE, validated
-byte-for-byte against the CRoaring-written golden files
-testdata/64map*.bin): uint64 LE bucket count, then per bucket uint32 LE high
-key + standard 32-bit serialization, buckets in unsigned key order.
+Here the NavigableMap becomes a sorted high-32 index over full 32-bit
+RoaringBitmaps; every bucket reuses the whole 32-bit stack including the
+packed device aggregation path, so 64-bit wide-ORs batch exactly like
+32-bit ones (TPU-first, SURVEY §5 "long-context" analogue).
 
-Values are unsigned 64-bit: [0, 2^64).
+Serialization supports both reference modes
+(Roaring64NavigableMap.java:35/:47/:51 SERIALIZATION_MODE switch):
+
+* **portable** (default here; the cross-language spec, validated against the
+  CRoaring-written golden files testdata/64map*.bin): uint64 LE bucket
+  count, then per bucket uint32 LE high key + standard 32-bit
+  serialization, buckets in unsigned key order.
+* **legacy** (the reference's Java-default, serializeLegacy): uint8 bool
+  signed_longs, int32 BE bucket count, per bucket int32 BE key + 32-bit
+  serialization, buckets in comparator order.
+
+Values are unsigned 64-bit [0, 2^64) by default; ``signed_longs=True``
+orders them as two's-complement longs (negative half first).
 """
 
 from __future__ import annotations
@@ -43,27 +48,105 @@ def _check64(x: int) -> int:
     return x
 
 
-class Roaring64Bitmap:
-    """Unsigned 64-bit Roaring bitmap (Roaring64NavigableMap /
-    Roaring64Bitmap capability union)."""
+def chunk_ranges_64(start: int, end: int, shift: int):
+    """Split a 64-bit half-open range into per-chunk (high, lo, hi) pieces,
+    where chunks are 2^shift wide and (lo, hi) is half-open within a chunk.
+    Shared by both 64-bit designs (shift=32 buckets / shift=16 containers)."""
+    start, end = int(start), int(end)
+    if not 0 <= start <= end <= _MAX64:
+        raise ValueError(f"invalid range [{start}, {end})")
+    if start == end:
+        return
+    mask = (1 << shift) - 1
+    h_start, h_end = start >> shift, (end - 1) >> shift
+    for h in range(h_start, h_end + 1):
+        lo = start & mask if h == h_start else 0
+        hi = ((end - 1) & mask) + 1 if h == h_end else (1 << shift)
+        yield h, lo, hi
 
-    __slots__ = ("_buckets", "_keys", "_keys_dirty", "_cum_cards", "_cum_dirty")
 
-    def __init__(self, values: Optional[Iterable[int]] = None):
+def group_by_high(values, shift: int):
+    """Sort+coerce an iterable of unsigned 64-bit values and yield
+    (high, sorted unique low parts) groups, where high = v >> shift.
+    Shared batching for both 64-bit designs' add_many."""
+    if not isinstance(values, np.ndarray):
+        values = np.fromiter(iter(values), dtype=np.uint64)
+    if np.issubdtype(values.dtype, np.signedinteger) and values.size and values.min() < 0:
+        raise ValueError("values outside unsigned 64-bit range")
+    v = np.sort(np.asarray(values).astype(np.uint64).ravel())
+    if v.size == 0:
+        return
+    mask = np.uint64((1 << shift) - 1)
+    highs = (v >> np.uint64(shift)).astype(np.uint64)
+    lows = v & mask
+    boundaries = np.nonzero(np.diff(highs))[0] + 1
+    starts = np.concatenate(([0], boundaries))
+    ends = np.concatenate((boundaries, [v.size]))
+    for s, e in zip(starts.tolist(), ends.tolist()):
+        yield int(highs[s]), np.unique(lows[s:e])
+
+
+SERIALIZATION_MODE_LEGACY = 0  # Roaring64NavigableMap.java:35
+SERIALIZATION_MODE_PORTABLE = 1  # Roaring64NavigableMap.java:47
+
+
+class Roaring64NavigableMap:
+    """64-bit Roaring bitmap as a sorted map of high-32 buckets
+    (longlong/Roaring64NavigableMap.java:29)."""
+
+    # Mutable global switch like the reference's (:51-52); this framework
+    # defaults to the portable cross-language spec rather than the Java
+    # legacy format.
+    SERIALIZATION_MODE = SERIALIZATION_MODE_PORTABLE
+
+    __slots__ = (
+        "_buckets",
+        "_keys",
+        "_ckeys",
+        "_keys_dirty",
+        "_cum_cards",
+        "_cum_dirty",
+        "signed_longs",
+    )
+
+    def __init__(
+        self,
+        values: Optional[Iterable[int]] = None,
+        signed_longs: bool = False,
+    ):
         self._buckets: dict = {}  # high32 -> RoaringBitmap
         self._keys: List[int] = []
+        self._ckeys: Optional[List[int]] = None
         self._keys_dirty = False
         self._cum_cards: Optional[np.ndarray] = None
         self._cum_dirty = True
+        self.signed_longs = signed_longs  # Roaring64NavigableMap.java:100
         if values is not None:
             self.add_many(values)
 
     # ------------------------------------------------------------------
+    def _key_order(self, k: int) -> int:
+        """Comparator: unsigned by default, two's-complement when signed."""
+        if self.signed_longs and k >= (1 << 31):
+            return k - _MAX32
+        return k
+
     def _sorted_keys(self) -> List[int]:
         if self._keys_dirty:
-            self._keys = sorted(self._buckets)
+            self._keys = sorted(self._buckets, key=self._key_order)
+            self._ckeys = None
             self._keys_dirty = False
         return self._keys
+
+    def _comparator_keys(self) -> List[int]:
+        """_sorted_keys mapped through the comparator, for bisecting; the
+        identity (same list) in unsigned mode, cached in signed mode."""
+        keys = self._sorted_keys()
+        if not self.signed_longs:
+            return keys
+        if self._ckeys is None:
+            self._ckeys = [self._key_order(k) for k in keys]
+        return self._ckeys
 
     def _invalidate(self):
         self._cum_dirty = True
@@ -92,32 +175,18 @@ class Roaring64Bitmap:
     # construction / point ops
     # ------------------------------------------------------------------
     @staticmethod
-    def bitmap_of(*values: int) -> "Roaring64Bitmap":
-        return Roaring64Bitmap(values)
+    def bitmap_of(*values: int) -> "Roaring64NavigableMap":
+        return Roaring64NavigableMap(values)
 
     def add(self, x: int) -> None:
-        """addLong (Roaring64Bitmap.java:50)."""
+        """addLong (Roaring64NavigableMap.java:50)."""
         x = _check64(x)
         self._bucket_for_add(x >> 32).add(x & 0xFFFFFFFF)
         self._invalidate()
 
     def add_many(self, values: Iterable[int]) -> None:
-        if not isinstance(values, np.ndarray):
-            values = np.fromiter(iter(values), dtype=np.uint64)
-        if np.issubdtype(values.dtype, np.signedinteger) and values.size and values.min() < 0:
-            raise ValueError("values outside unsigned 64-bit range")
-        v = np.asarray(values).astype(np.uint64).ravel()
-        if v.size == 0:
-            return
-        highs = (v >> np.uint64(32)).astype(np.int64)
-        lows = (v & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-        order = np.argsort(highs, kind="stable")
-        highs, lows = highs[order], lows[order]
-        boundaries = np.nonzero(np.diff(highs))[0] + 1
-        starts = np.concatenate(([0], boundaries))
-        ends = np.concatenate((boundaries, [v.size]))
-        for s, e in zip(starts.tolist(), ends.tolist()):
-            self._bucket_for_add(int(highs[s])).add_many(lows[s:e])
+        for high, lows in group_by_high(values, 32):
+            self._bucket_for_add(high).add_many(lows.astype(np.uint32))
         self._invalidate()
 
     def remove(self, x: int) -> None:
@@ -139,18 +208,7 @@ class Roaring64Bitmap:
 
     @staticmethod
     def _chunk_ranges(start: int, end: int):
-        """Split a 64-bit half-open range into per-bucket (high, lo, hi)
-        pieces with 32-bit half-open sub-ranges."""
-        start, end = int(start), int(end)
-        if not 0 <= start <= end <= _MAX64:
-            raise ValueError(f"invalid range [{start}, {end})")
-        if start == end:
-            return
-        h_start, h_end = start >> 32, (end - 1) >> 32
-        for h in range(h_start, h_end + 1):
-            lo = start & 0xFFFFFFFF if h == h_start else 0
-            hi = ((end - 1) & 0xFFFFFFFF) + 1 if h == h_end else _MAX32
-            yield h, lo, hi
+        return chunk_ranges_64(start, end, 32)
 
     def _drop_if_empty(self, h: int) -> None:
         if h in self._buckets and self._buckets[h].is_empty():
@@ -182,7 +240,7 @@ class Roaring64Bitmap:
     # ------------------------------------------------------------------
     # algebra (in-place, Java-style: Roaring64NavigableMap.java:773-935)
     # ------------------------------------------------------------------
-    def ior(self, other: "Roaring64Bitmap") -> "Roaring64Bitmap":
+    def ior(self, other: "Roaring64NavigableMap") -> "Roaring64NavigableMap":
         for h, ob in other._buckets.items():
             mine = self._buckets.get(h)
             if mine is None:
@@ -193,7 +251,7 @@ class Roaring64Bitmap:
         self._invalidate()
         return self
 
-    def iand(self, other: "Roaring64Bitmap") -> "Roaring64Bitmap":
+    def iand(self, other: "Roaring64NavigableMap") -> "Roaring64NavigableMap":
         for h in list(self._buckets):
             ob = other._buckets.get(h)
             if ob is None:
@@ -208,8 +266,10 @@ class Roaring64Bitmap:
         self._invalidate()
         return self
 
-    def ixor(self, other: "Roaring64Bitmap") -> "Roaring64Bitmap":
-        for h, ob in other._buckets.items():
+    def ixor(self, other: "Roaring64NavigableMap") -> "Roaring64NavigableMap":
+        # snapshot: other may alias self (x ^= x), and emptied buckets are
+        # deleted from self._buckets during the walk
+        for h, ob in list(other._buckets.items()):
             mine = self._buckets.get(h)
             if mine is None:
                 self._buckets[h] = ob.clone()
@@ -222,7 +282,7 @@ class Roaring64Bitmap:
         self._invalidate()
         return self
 
-    def iandnot(self, other: "Roaring64Bitmap") -> "Roaring64Bitmap":
+    def iandnot(self, other: "Roaring64NavigableMap") -> "Roaring64NavigableMap":
         for h in list(self._buckets):
             ob = other._buckets.get(h)
             if ob is not None:
@@ -241,31 +301,31 @@ class Roaring64Bitmap:
     andnot_inplace = iandnot
 
     @staticmethod
-    def or_(a: "Roaring64Bitmap", b: "Roaring64Bitmap") -> "Roaring64Bitmap":
+    def or_(a: "Roaring64NavigableMap", b: "Roaring64NavigableMap") -> "Roaring64NavigableMap":
         return a.clone().ior(b)
 
     @staticmethod
-    def and_(a: "Roaring64Bitmap", b: "Roaring64Bitmap") -> "Roaring64Bitmap":
+    def and_(a: "Roaring64NavigableMap", b: "Roaring64NavigableMap") -> "Roaring64NavigableMap":
         return a.clone().iand(b)
 
     @staticmethod
-    def xor(a: "Roaring64Bitmap", b: "Roaring64Bitmap") -> "Roaring64Bitmap":
+    def xor(a: "Roaring64NavigableMap", b: "Roaring64NavigableMap") -> "Roaring64NavigableMap":
         return a.clone().ixor(b)
 
     @staticmethod
-    def andnot(a: "Roaring64Bitmap", b: "Roaring64Bitmap") -> "Roaring64Bitmap":
+    def andnot(a: "Roaring64NavigableMap", b: "Roaring64NavigableMap") -> "Roaring64NavigableMap":
         return a.clone().iandnot(b)
 
-    __or__ = lambda self, o: Roaring64Bitmap.or_(self, o)
-    __and__ = lambda self, o: Roaring64Bitmap.and_(self, o)
-    __xor__ = lambda self, o: Roaring64Bitmap.xor(self, o)
-    __sub__ = lambda self, o: Roaring64Bitmap.andnot(self, o)
+    __or__ = lambda self, o: Roaring64NavigableMap.or_(self, o)
+    __and__ = lambda self, o: Roaring64NavigableMap.and_(self, o)
+    __xor__ = lambda self, o: Roaring64NavigableMap.xor(self, o)
+    __sub__ = lambda self, o: Roaring64NavigableMap.andnot(self, o)
     __ior__ = ior
     __iand__ = iand
     __ixor__ = ixor
     __isub__ = iandnot
 
-    def intersects(self, other: "Roaring64Bitmap") -> bool:
+    def intersects(self, other: "Roaring64NavigableMap") -> bool:
         for h, b in self._buckets.items():
             ob = other._buckets.get(h)
             if ob is not None and RoaringBitmap.intersects(b, ob):
@@ -289,8 +349,10 @@ class Roaring64Bitmap:
         x = _check64(x)
         high, low = x >> 32, x & 0xFFFFFFFF
         keys = self._sorted_keys()
+        kt = self._comparator_keys()  # bisect in comparator order
         return bucketed_rank(
-            keys, self._cum(), high, lambda i: self._buckets[keys[i]].rank(low)
+            kt, self._cum(), self._key_order(high),
+            lambda i: self._buckets[keys[i]].rank(low),
         )
 
     def select(self, j: int) -> int:
@@ -322,7 +384,8 @@ class Roaring64Bitmap:
         from_value = _check64(from_value)
         high, low = from_value >> 32, from_value & 0xFFFFFFFF
         keys = self._sorted_keys()
-        for i in range(bisect_left(keys, high), len(keys)):
+        kt = self._comparator_keys()
+        for i in range(bisect_left(kt, self._key_order(high)), len(keys)):
             k = keys[i]
             v = self._buckets[k].next_value(low if k == high else 0)
             if v >= 0:
@@ -333,7 +396,8 @@ class Roaring64Bitmap:
         from_value = _check64(from_value)
         high, low = from_value >> 32, from_value & 0xFFFFFFFF
         keys = self._sorted_keys()
-        for i in range(bisect_right(keys, high) - 1, -1, -1):
+        kt = self._comparator_keys()
+        for i in range(bisect_right(kt, self._key_order(high)) - 1, -1, -1):
             k = keys[i]
             v = self._buckets[k].previous_value(low if k == high else _MAX32 - 1)
             if v >= 0:
@@ -349,8 +413,8 @@ class Roaring64Bitmap:
             changed |= b.run_optimize()
         return changed
 
-    def clone(self) -> "Roaring64Bitmap":
-        out = Roaring64Bitmap()
+    def clone(self) -> "Roaring64NavigableMap":
+        out = Roaring64NavigableMap(signed_longs=self.signed_longs)
         out._buckets = {h: b.clone() for h, b in self._buckets.items()}
         out._keys_dirty = True
         return out
@@ -379,37 +443,78 @@ class Roaring64Bitmap:
     # ------------------------------------------------------------------
     # serialization (portable 64-bit spec)
     # ------------------------------------------------------------------
-    def serialize(self) -> bytes:
+    def serialize(self, mode: Optional[int] = None) -> bytes:
+        """Serialize in the active mode (legacy/portable switch,
+        Roaring64NavigableMap.java:51 + serialize dispatch)."""
+        if mode is None:
+            mode = type(self).SERIALIZATION_MODE
+        if mode == SERIALIZATION_MODE_LEGACY:
+            return self.serialize_legacy()
+        return self.serialize_portable()
+
+    def serialize_portable(self) -> bytes:
+        """Portable 64-bit spec (serializePortable): LE u64 count, per
+        bucket LE u32 key + 32-bit spec bytes, unsigned key order."""
         import struct
 
-        keys = self._sorted_keys()
+        keys = sorted(self._buckets)  # portable order is always unsigned
         parts = [struct.pack("<Q", len(keys))]
         for k in keys:
             parts.append(struct.pack("<I", k))
             parts.append(self._buckets[k].serialize())
         return b"".join(parts)
 
-    def serialized_size_in_bytes(self) -> int:
+    def serialize_legacy(self) -> bytes:
+        """Legacy Java format (serializeLegacy): u8 bool signed_longs,
+        BE i32 count, per bucket BE i32 key + 32-bit spec bytes, buckets in
+        comparator order."""
+        import struct
+
+        keys = self._sorted_keys()
+        parts = [struct.pack(">?i", self.signed_longs, len(keys))]
+        for k in keys:
+            parts.append(struct.pack(">i", k - _MAX32 if k >= (1 << 31) else k))
+            parts.append(self._buckets[k].serialize())
+        return b"".join(parts)
+
+    def serialized_size_in_bytes(self, mode: Optional[int] = None) -> int:
         from ..serialization import serialized_size_in_bytes
 
-        return 8 + sum(
+        if mode is None:
+            mode = type(self).SERIALIZATION_MODE
+        header = 5 if mode == SERIALIZATION_MODE_LEGACY else 8
+        return header + sum(
             4 + serialized_size_in_bytes(b) for b in self._buckets.values()
         )
 
     @staticmethod
-    def deserialize(data) -> "Roaring64Bitmap":
+    def deserialize(data, mode: Optional[int] = None) -> "Roaring64NavigableMap":
+        if mode is None:
+            mode = Roaring64NavigableMap.SERIALIZATION_MODE
+        if mode == SERIALIZATION_MODE_LEGACY:
+            return Roaring64NavigableMap.deserialize_legacy(data)
+        return Roaring64NavigableMap.deserialize_portable(data)
+
+    @staticmethod
+    def _as_view(data) -> memoryview:
+        return memoryview(
+            bytes(data) if not isinstance(data, (bytes, bytearray, memoryview)) else data
+        )
+
+    @staticmethod
+    def deserialize_portable(data) -> "Roaring64NavigableMap":
         import struct
 
         from ..serialization import read_into
 
-        buf = memoryview(bytes(data) if not isinstance(data, (bytes, bytearray, memoryview)) else data)
+        buf = Roaring64NavigableMap._as_view(data)
         if len(buf) < 8:
             raise InvalidRoaringFormat("truncated 64-bit header")
         (count,) = struct.unpack_from("<Q", buf, 0)
         if count > len(buf) // 4:  # each bucket needs >= 4 bytes of key alone
             raise InvalidRoaringFormat(f"implausible bucket count {count}")
         pos = 8
-        out = Roaring64Bitmap()
+        out = Roaring64NavigableMap()
         prev_key = -1
         for _ in range(count):
             if pos + 4 > len(buf):
@@ -426,9 +531,38 @@ class Roaring64Bitmap:
         out._keys_dirty = True
         return out
 
+    @staticmethod
+    def deserialize_legacy(data) -> "Roaring64NavigableMap":
+        import struct
+
+        from ..serialization import read_into
+
+        buf = Roaring64NavigableMap._as_view(data)
+        if len(buf) < 5:
+            raise InvalidRoaringFormat("truncated legacy 64-bit header")
+        signed, count = struct.unpack_from(">?i", buf, 0)
+        if count < 0 or count > len(buf) // 4:
+            raise InvalidRoaringFormat(f"implausible bucket count {count}")
+        pos = 5
+        out = Roaring64NavigableMap(signed_longs=signed)
+        for _ in range(count):
+            if pos + 4 > len(buf):
+                raise InvalidRoaringFormat("truncated bucket key")
+            (key,) = struct.unpack_from(">i", buf, pos)
+            pos += 4
+            key &= 0xFFFFFFFF  # stored two's-complement
+            if key in out._buckets:
+                raise InvalidRoaringFormat("duplicate bucket key")
+            bm = RoaringBitmap()
+            pos += read_into(bm, buf[pos:])
+            if not bm.is_empty():
+                out._buckets[key] = bm
+        out._keys_dirty = True
+        return out
+
     # ------------------------------------------------------------------
     def __eq__(self, other):
-        if not isinstance(other, Roaring64Bitmap):
+        if not isinstance(other, Roaring64NavigableMap):
             return NotImplemented
         if set(self._buckets) != set(other._buckets):
             return False
@@ -449,9 +583,4 @@ class Roaring64Bitmap:
     def __repr__(self) -> str:
         card = self.get_cardinality()
         head = ",".join(str(v) for v in self.to_array()[:8].tolist())
-        return f"Roaring64Bitmap(card={card}, values=[{head}{'...' if card > 8 else ''}])"
-
-
-# The reference exposes the same capability under this name with a pluggable
-# backend (longlong/Roaring64NavigableMap.java:29); here it is one class.
-Roaring64NavigableMap = Roaring64Bitmap
+        return f"Roaring64NavigableMap(card={card}, values=[{head}{'...' if card > 8 else ''}])"

@@ -1,0 +1,491 @@
+"""64-bit layer, design 2 of 2: the ART-based ``Roaring64Bitmap``.
+
+Re-expression of longlong/Roaring64Bitmap.java:29 + HighLowContainer.java:14-17:
+a 64-bit value splits into a 6-byte high-48 key (longlong/LongUtils.java
+high48/low16 helpers) indexed by an adaptive radix tree (``art.py``), and a
+16-bit low part stored in a standard container. Container payloads live in a
+two-level ``Containers`` store addressed by a packed (hi32, lo32) index
+(art/Containers.java:20-32, :63-70) — the ART leaf holds the packed index,
+not the container object, exactly as in the reference; the dense second
+level is also the natural staging layout for packing bitmap containers to
+``[N, 1024]`` device arrays (parallel/store.py).
+
+Serialization: the reference's Roaring64Bitmap writes a private ART dump
+(HighLowContainer.serialize: EMPTY_TAG/NOT_EMPTY_TAG + trie nodes) — a JVM
+implementation detail, not a cross-language spec. This framework serializes
+the portable 64-bit RoaringFormatSpec instead (identical to
+Roaring64NavigableMap.serialize_portable, validated against
+testdata/64map*.bin), grouping high-48 keys by their high 32 bits; the two
+64-bit classes interoperate byte-for-byte.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from .art import Art
+from .container import (
+    ArrayContainer,
+    Container,
+    container_from_values,
+    container_range_of_ones,
+)
+from .roaring import RoaringBitmap
+from .roaring64 import _check64, chunk_ranges_64, group_by_high
+
+
+def high48_key(x: int) -> bytes:
+    """6 big-endian bytes of the high 48 bits (LongUtils.highPart)."""
+    return (x >> 16).to_bytes(6, "big")
+
+
+def key_to_int(key: bytes) -> int:
+    return int.from_bytes(key, "big")
+
+
+class Containers:
+    """Two-level container store addressed by a packed index
+    (art/Containers.java:20-32): high 32 bits pick the first-level page,
+    low 32 bits the slot. Pages are dense lists; freed slots are reused via
+    a free list."""
+
+    PAGE_SHIFT = 16  # 2^16 slots per page keeps pages cache-friendly
+
+    def __init__(self):
+        self._pages: List[List[Optional[Container]]] = []
+        self._free: List[int] = []
+        self._size = 0
+
+    def __len__(self) -> int:
+        return self._size
+
+    def add(self, c: Container) -> int:
+        """Store a container, returning its packed index (Containers.addContainer)."""
+        self._size += 1
+        if self._free:
+            idx = self._free.pop()
+            self._pages[idx >> self.PAGE_SHIFT][idx & 0xFFFF] = c
+            return idx
+        if not self._pages or len(self._pages[-1]) >= (1 << self.PAGE_SHIFT):
+            self._pages.append([])
+        page = len(self._pages) - 1
+        self._pages[page].append(c)
+        return (page << self.PAGE_SHIFT) | (len(self._pages[page]) - 1)
+
+    def get(self, idx: int) -> Container:
+        return self._pages[idx >> self.PAGE_SHIFT][idx & 0xFFFF]
+
+    def replace(self, idx: int, c: Container) -> None:
+        """replaceContainer (HighLowContainer path)."""
+        self._pages[idx >> self.PAGE_SHIFT][idx & 0xFFFF] = c
+
+    def remove(self, idx: int) -> None:
+        self._pages[idx >> self.PAGE_SHIFT][idx & 0xFFFF] = None
+        self._free.append(idx)
+        self._size -= 1
+
+
+class Roaring64Bitmap:
+    """Unsigned 64-bit Roaring bitmap over an ART high-48 index
+    (longlong/Roaring64Bitmap.java:29)."""
+
+    __slots__ = ("_art", "_containers")
+
+    def __init__(self, values: Optional[Iterable[int]] = None):
+        self._art = Art()
+        self._containers = Containers()
+        if values is not None:
+            self.add_many(values)
+
+    # ------------------------------------------------------------------
+    # internals
+    # ------------------------------------------------------------------
+    def _get(self, key: bytes) -> Optional[Container]:
+        idx = self._art.find(key)
+        return None if idx is None else self._containers.get(idx)
+
+    def _put(self, key: bytes, c: Container) -> None:
+        idx = self._art.find(key)
+        if idx is None:
+            self._art.insert(key, self._containers.add(c))
+        else:
+            self._containers.replace(idx, c)
+
+    def _set_or_drop(self, key: bytes, c: Optional[Container]) -> None:
+        idx = self._art.find(key)
+        if c is None or c.cardinality == 0:
+            if idx is not None:
+                self._containers.remove(idx)
+                self._art.remove(key)
+            return
+        if idx is None:
+            self._art.insert(key, self._containers.add(c))
+        else:
+            self._containers.replace(idx, c)
+
+    def _kv(self) -> Iterator[Tuple[bytes, Container]]:
+        for key, idx in self._art.items():
+            yield key, self._containers.get(idx)
+
+    # ------------------------------------------------------------------
+    # construction / point ops (Roaring64Bitmap.addLong :50-61)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def bitmap_of(*values: int) -> "Roaring64Bitmap":
+        return Roaring64Bitmap(values)
+
+    def add(self, x: int) -> None:
+        x = _check64(x)
+        key = high48_key(x)
+        idx = self._art.find(key)
+        if idx is None:
+            self._art.insert(
+                key, self._containers.add(ArrayContainer([x & 0xFFFF]))
+            )
+        else:
+            self._containers.replace(
+                idx, self._containers.get(idx).add(x & 0xFFFF)
+            )
+
+    def add_many(self, values: Iterable[int]) -> None:
+        for high, lows in group_by_high(values, 16):
+            key = high.to_bytes(6, "big")
+            chunk = container_from_values(lows.astype(np.uint16))
+            existing = self._get(key)
+            self._put(key, chunk if existing is None else existing.or_(chunk))
+
+    def remove(self, x: int) -> None:
+        x = _check64(x)
+        key = high48_key(x)
+        c = self._get(key)
+        if c is not None:
+            self._set_or_drop(key, c.remove(x & 0xFFFF))
+
+    def contains(self, x: int) -> bool:
+        x = _check64(x)
+        c = self._get(high48_key(x))
+        return c is not None and c.contains(x & 0xFFFF)
+
+    # ------------------------------------------------------------------
+    # ranges (per-2^16-chunk walk)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _chunk_ranges(start: int, end: int):
+        return chunk_ranges_64(start, end, 16)
+
+    def add_range(self, start: int, end: int) -> None:
+        for h, lo, hi in self._chunk_ranges(start, end):
+            key = h.to_bytes(6, "big")
+            c = self._get(key)
+            if c is None:
+                self._put(key, container_range_of_ones(lo, hi))
+            else:
+                self._put(key, c.add_range(lo, hi))
+
+    def remove_range(self, start: int, end: int) -> None:
+        for h, lo, hi in self._chunk_ranges(start, end):
+            key = h.to_bytes(6, "big")
+            c = self._get(key)
+            if c is not None:
+                self._set_or_drop(key, c.remove_range(lo, hi))
+
+    def flip_range(self, start: int, end: int) -> None:
+        for h, lo, hi in self._chunk_ranges(start, end):
+            key = h.to_bytes(6, "big")
+            c = self._get(key)
+            if c is None:
+                self._put(key, container_range_of_ones(lo, hi))
+            else:
+                self._set_or_drop(key, c.flip_range(lo, hi))
+
+    # ------------------------------------------------------------------
+    # algebra — ordered merge walks over the two tries (the reference
+    # aligns keys via KeyIterator shuttles; or/and/andNot/xor
+    # Roaring64Bitmap.java pairwise container ops)
+    # ------------------------------------------------------------------
+    def _merge_walk(self, other: "Roaring64Bitmap", op: str) -> "Roaring64Bitmap":
+        out = Roaring64Bitmap()
+        it_a, it_b = self._kv(), other._kv()
+        a = next(it_a, None)
+        b = next(it_b, None)
+        while a is not None or b is not None:
+            if b is None or (a is not None and a[0] < b[0]):
+                if op in ("or", "xor", "andnot"):
+                    out._put(a[0], a[1].clone())
+                a = next(it_a, None)
+            elif a is None or b[0] < a[0]:
+                if op in ("or", "xor"):
+                    out._put(b[0], b[1].clone())
+                b = next(it_b, None)
+            else:
+                if op == "or":
+                    c = a[1].or_(b[1])
+                elif op == "and":
+                    c = a[1].and_(b[1])
+                elif op == "xor":
+                    c = a[1].xor_(b[1])
+                else:
+                    c = a[1].andnot(b[1])
+                if c.cardinality:
+                    out._put(a[0], c)
+                a = next(it_a, None)
+                b = next(it_b, None)
+        return out
+
+    @staticmethod
+    def or_(a: "Roaring64Bitmap", b: "Roaring64Bitmap") -> "Roaring64Bitmap":
+        return a._merge_walk(b, "or")
+
+    @staticmethod
+    def and_(a: "Roaring64Bitmap", b: "Roaring64Bitmap") -> "Roaring64Bitmap":
+        return a._merge_walk(b, "and")
+
+    @staticmethod
+    def xor(a: "Roaring64Bitmap", b: "Roaring64Bitmap") -> "Roaring64Bitmap":
+        return a._merge_walk(b, "xor")
+
+    @staticmethod
+    def andnot(a: "Roaring64Bitmap", b: "Roaring64Bitmap") -> "Roaring64Bitmap":
+        return a._merge_walk(b, "andnot")
+
+    def ior(self, other: "Roaring64Bitmap") -> "Roaring64Bitmap":
+        # true in-place: only other's keys are touched; untouched containers
+        # of self are never cloned (mirrors the reference's naivelazyor walk)
+        for k, oc in list(other._kv()):
+            mine = self._get(k)
+            self._put(k, oc.clone() if mine is None else mine.or_(oc))
+        return self
+
+    def ixor(self, other: "Roaring64Bitmap") -> "Roaring64Bitmap":
+        for k, oc in list(other._kv()):
+            mine = self._get(k)
+            self._set_or_drop(k, oc.clone() if mine is None else mine.xor_(oc))
+        return self
+
+    def iandnot(self, other: "Roaring64Bitmap") -> "Roaring64Bitmap":
+        for k, oc in list(other._kv()):
+            mine = self._get(k)
+            if mine is not None:
+                self._set_or_drop(k, mine.andnot(oc))
+        return self
+
+    def iand(self, other: "Roaring64Bitmap") -> "Roaring64Bitmap":
+        # touches every key of self: drop keys absent from other
+        for k, mine in list(self._kv()):
+            oc = other._get(k)
+            self._set_or_drop(k, None if oc is None else mine.and_(oc))
+        return self
+
+    or_inplace = ior
+    and_inplace = iand
+    xor_inplace = ixor
+    andnot_inplace = iandnot
+
+    __or__ = lambda self, o: Roaring64Bitmap.or_(self, o)
+    __and__ = lambda self, o: Roaring64Bitmap.and_(self, o)
+    __xor__ = lambda self, o: Roaring64Bitmap.xor(self, o)
+    __sub__ = lambda self, o: Roaring64Bitmap.andnot(self, o)
+    __ior__ = ior
+    __iand__ = iand
+    __ixor__ = ixor
+    __isub__ = iandnot
+
+    def intersects(self, other: "Roaring64Bitmap") -> bool:
+        it_a, it_b = self._kv(), other._kv()
+        a = next(it_a, None)
+        b = next(it_b, None)
+        while a is not None and b is not None:
+            if a[0] < b[0]:
+                a = next(it_a, None)
+            elif b[0] < a[0]:
+                b = next(it_b, None)
+            else:
+                if a[1].intersects(b[1]):
+                    return True
+                a = next(it_a, None)
+                b = next(it_b, None)
+        return False
+
+    # ------------------------------------------------------------------
+    # cardinality / order statistics
+    # ------------------------------------------------------------------
+    def get_cardinality(self) -> int:
+        return sum(c.cardinality for _, c in self._kv())
+
+    def is_empty(self) -> bool:
+        return self._art.is_empty()
+
+    def rank(self, x: int) -> int:
+        x = _check64(x)
+        key, low = high48_key(x), x & 0xFFFF
+        total = 0
+        for k, c in self._kv():
+            if k < key:
+                total += c.cardinality
+            elif k == key:
+                return total + c.rank(low)
+            else:
+                break
+        return total
+
+    def select(self, j: int) -> int:
+        if j < 0:
+            raise IndexError(f"select({j})")
+        remaining = j
+        for k, c in self._kv():
+            card = c.cardinality
+            if remaining < card:
+                return (key_to_int(k) << 16) | c.select(remaining)
+            remaining -= card
+        raise IndexError(f"select({j}) out of range")
+
+    def first(self) -> int:
+        kv = self._art.first()
+        if kv is None:
+            raise ValueError("empty bitmap")
+        k, idx = kv
+        return (key_to_int(k) << 16) | self._containers.get(idx).first()
+
+    def last(self) -> int:
+        kv = self._art.last()
+        if kv is None:
+            raise ValueError("empty bitmap")
+        k, idx = kv
+        return (key_to_int(k) << 16) | self._containers.get(idx).last()
+
+    def next_value(self, from_value: int) -> int:
+        from_value = _check64(from_value)
+        key, low = high48_key(from_value), from_value & 0xFFFF
+        for k, idx in self._art.items_from(key):
+            c = self._containers.get(idx)
+            v = c.next_value(low) if k == key else c.first()
+            if v >= 0:
+                return (key_to_int(k) << 16) | v
+        return -1
+
+    def previous_value(self, from_value: int) -> int:
+        from_value = _check64(from_value)
+        key, low = high48_key(from_value), from_value & 0xFFFF
+        for k, idx in self._art.items_to(key):
+            c = self._containers.get(idx)
+            v = c.previous_value(low) if k == key else c.last()
+            if v >= 0:
+                return (key_to_int(k) << 16) | v
+        return -1
+
+    # ------------------------------------------------------------------
+    # structure
+    # ------------------------------------------------------------------
+    def run_optimize(self) -> bool:
+        changed = False
+        for key, idx in self._art.items():
+            c = self._containers.get(idx)
+            new = c.run_optimize()
+            if new is not c:
+                self._containers.replace(idx, new)
+                changed = True
+        return changed
+
+    def clone(self) -> "Roaring64Bitmap":
+        out = Roaring64Bitmap()
+        for k, c in self._kv():
+            out._put(k, c.clone())
+        return out
+
+    def to_array(self) -> np.ndarray:
+        parts = [
+            c.to_array().astype(np.uint64) | np.uint64(key_to_int(k) << 16)
+            for k, c in self._kv()
+        ]
+        return np.concatenate(parts) if parts else np.empty(0, dtype=np.uint64)
+
+    def __iter__(self) -> Iterator[int]:
+        for k, c in self._kv():
+            base = key_to_int(k) << 16
+            for v in c:
+                yield base | v
+
+    def get_high_to_bitmap_count(self) -> int:
+        """Container (= high-48 key) count; the ART analogue of the
+        NavigableMap's bucket count."""
+        return len(self._art)
+
+    # ------------------------------------------------------------------
+    # serialization — portable 64-bit spec via high-32 grouping
+    # ------------------------------------------------------------------
+    def _grouped_high32(self) -> Iterator[Tuple[int, RoaringBitmap]]:
+        """(high32, 32-bit view) groups in key order; the view's RoaringArray
+        shares this bitmap's containers (append never mutates them)."""
+        current_high32 = None
+        current: Optional[RoaringBitmap] = None
+        for k, c in self._kv():
+            k_int = key_to_int(k)
+            high32, key16 = k_int >> 16, k_int & 0xFFFF
+            if high32 != current_high32:
+                if current is not None:
+                    yield current_high32, current
+                current_high32 = high32
+                current = RoaringBitmap()
+            current.high_low_container.append(key16, c)
+        if current is not None:
+            yield current_high32, current
+
+    def serialize(self) -> bytes:
+        import struct
+
+        parts = []
+        count = 0
+        for high32, bm in self._grouped_high32():
+            parts.append(struct.pack("<I", high32))
+            parts.append(bm.serialize())
+            count += 1
+        return b"".join([struct.pack("<Q", count)] + parts)
+
+    def serialized_size_in_bytes(self) -> int:
+        from ..serialization import serialized_size_in_bytes
+
+        return 8 + sum(
+            4 + serialized_size_in_bytes(bm) for _, bm in self._grouped_high32()
+        )
+
+    @staticmethod
+    def deserialize(data) -> "Roaring64Bitmap":
+        from .roaring64 import Roaring64NavigableMap
+
+        nav = Roaring64NavigableMap.deserialize_portable(data)
+        out = Roaring64Bitmap()
+        for high32 in sorted(nav._buckets):
+            bm = nav._buckets[high32]
+            arr = bm.high_low_container
+            for i in range(arr.size):
+                key16 = arr.keys[i]
+                k = ((high32 << 16) | int(key16)).to_bytes(6, "big")
+                out._put(k, arr.containers[i])
+        return out
+
+    # ------------------------------------------------------------------
+    def __eq__(self, other):
+        if isinstance(other, Roaring64Bitmap):
+            return np.array_equal(self.to_array(), other.to_array())
+        if hasattr(other, "to_array"):
+            return np.array_equal(self.to_array(), other.to_array())
+        return NotImplemented
+
+    def __hash__(self):
+        return hash(self.to_array().tobytes())
+
+    def __len__(self) -> int:
+        return self.get_cardinality()
+
+    def __contains__(self, x: int) -> bool:
+        return self.contains(x)
+
+    def __bool__(self) -> bool:
+        return not self.is_empty()
+
+    def __repr__(self) -> str:
+        card = self.get_cardinality()
+        head = ",".join(str(v) for v in self.to_array()[:8].tolist())
+        return f"Roaring64Bitmap(card={card}, values=[{head}{'...' if card > 8 else ''}])"

@@ -98,16 +98,17 @@ def _load():
         try:
             if not os.path.exists(path) or os.path.getmtime(path) < os.path.getmtime(_SRC):
                 if not _build(path):
-                    # package dir may be read-only; fall back to a temp build
-                    path = os.path.join(
-                        tempfile.gettempdir(), f"rb_kernels_{os.getuid()}.so"
-                    )
-                    if not os.path.exists(path) and not _build(path):
+                    # Package dir may be read-only; build into a fresh private
+                    # temp dir. Never load a pre-existing library from a
+                    # shared/predictable location — /tmp is world-writable.
+                    path = os.path.join(tempfile.mkdtemp(prefix="rb_kernels_"), _LIB_NAME)
+                    if not _build(path):
                         return None
             lib = ctypes.CDLL(path)
             _declare(lib)
             _lib = lib
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError: a stale .so missing newly-declared symbols
             _lib = None
     return _lib
 

@@ -259,39 +259,57 @@ def xor_sorted(a: np.ndarray, b: np.ndarray) -> np.ndarray:
 
 # ---------------------------------------------------------------------------
 # native dispatch — when the compiled C++ kernels (native/kernels.cpp) are
-# available, rebind the hot host-path entry points to them. The numpy
+# available, the hot host-path entry points rebind to them. The numpy
 # versions above stay reachable under *_numpy as the differential-test
 # oracle (tests/test_native.py). Semantics are identical by contract.
+#
+# Resolution is lazy: the first *call* to any dispatched kernel triggers the
+# (possibly compiling) native load, then rebinds the module attribute to the
+# winner — importing the package never shells out to g++, and the pure
+# device path (ops/) never touches this at all.
 # ---------------------------------------------------------------------------
 
-intersect_sorted_numpy = intersect_sorted
-merge_sorted_unique_numpy = merge_sorted_unique
-difference_sorted_numpy = difference_sorted
-xor_sorted_numpy = xor_sorted
-cardinality_of_words_numpy = cardinality_of_words
-values_from_words_numpy = values_from_words
-words_from_values_numpy = words_from_values
-num_runs_in_words_numpy = num_runs_in_words
-select_in_words_numpy = select_in_words
-cardinality_in_range_numpy = cardinality_in_range
-runs_from_values_numpy = runs_from_values
+_DISPATCHED = (
+    "intersect_sorted",
+    "merge_sorted_unique",
+    "difference_sorted",
+    "xor_sorted",
+    "cardinality_of_words",
+    "values_from_words",
+    "words_from_values",
+    "num_runs_in_words",
+    "select_in_words",
+    "cardinality_in_range",
+    "runs_from_values",
+)
 
-try:  # pragma: no cover - exercised via tests/test_native.py
-    from .. import native as _native
+for _name in _DISPATCHED:
+    globals()[_name + "_numpy"] = globals()[_name]
 
-    _NATIVE = _native.available()
-except Exception:  # toolchain missing, sandboxed, etc.
-    _NATIVE = False
 
-if _NATIVE:
-    intersect_sorted = _native.intersect_sorted
-    merge_sorted_unique = _native.merge_sorted_unique
-    difference_sorted = _native.difference_sorted
-    xor_sorted = _native.xor_sorted
-    cardinality_of_words = _native.cardinality_of_words
-    values_from_words = _native.values_from_words
-    words_from_values = _native.words_from_values
-    num_runs_in_words = _native.num_runs_in_words
-    select_in_words = _native.select_in_words
-    cardinality_in_range = _native.cardinality_in_range
-    runs_from_values = _native.runs_from_values
+def _resolve_native() -> None:
+    """Bind every dispatched name to its native or numpy implementation."""
+    g = globals()
+    try:
+        from .. import native as _native
+
+        use = _native.available()
+    except Exception:  # toolchain missing, sandboxed, etc.
+        use = False
+    for name in _DISPATCHED:
+        g[name] = getattr(_native, name) if use else g[name + "_numpy"]
+
+
+def _make_trampoline(name: str):
+    def trampoline(*args, **kwargs):
+        _resolve_native()
+        return globals()[name](*args, **kwargs)
+
+    trampoline.__name__ = name
+    trampoline.__doc__ = globals()[name + "_numpy"].__doc__
+    return trampoline
+
+
+for _name in _DISPATCHED:
+    globals()[_name] = _make_trampoline(_name)
+del _name

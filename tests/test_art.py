@@ -1,0 +1,217 @@
+"""ART trie unit tests (reference oracles: art/Node4Test, Node16Test,
+Node48Test, Node256Test, plus Art insert/find/remove/iteration behavior,
+art/Art.java:35/:47) and cross-design equivalence of the two 64-bit
+bitmaps (SURVEY §4's cross-implementation oracle pattern)."""
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import Roaring64Bitmap, Roaring64NavigableMap
+from roaringbitmap_tpu.models.art import Art
+
+rng = np.random.default_rng(0xFEEF1F0)
+
+
+def k6(x: int) -> bytes:
+    return int(x).to_bytes(6, "big")
+
+
+class TestArt:
+    def test_insert_find(self):
+        art = Art()
+        assert art.find(k6(1)) is None
+        for i in range(100):
+            art.insert(k6(i * 7919), i)
+        assert len(art) == 100
+        for i in range(100):
+            assert art.find(k6(i * 7919)) == i
+        assert art.find(k6(5)) is None
+
+    def test_replace(self):
+        art = Art()
+        art.insert(k6(42), "a")
+        art.insert(k6(42), "b")
+        assert len(art) == 1
+        assert art.find(k6(42)) == "b"
+
+    @pytest.mark.parametrize("n", [1, 3, 5, 17, 49, 200, 256])
+    def test_node_growth_levels(self, n):
+        """Exercise Node4 -> Node16 -> Node48 -> Node256 upgrades by
+        fanning out n children under one parent byte position."""
+        art = Art()
+        # all keys share the first 5 bytes -> one node with n children
+        for i in range(n):
+            art.insert(bytes([1, 2, 3, 4, 5, i]), i)
+        assert len(art) == n
+        for i in range(n):
+            assert art.find(bytes([1, 2, 3, 4, 5, i])) == i
+        got = [int.from_bytes(k, "big") & 0xFF for k, _ in art.items()]
+        assert got == sorted(got)
+
+    def test_ordered_iteration_random(self):
+        art = Art()
+        keys = rng.integers(0, 1 << 48, size=500, dtype=np.uint64)
+        for k in np.unique(keys):
+            art.insert(k6(int(k)), int(k))
+        seq = [v for _, v in art.items()]
+        assert seq == sorted(seq)
+        rev = [v for _, v in art.items_reverse()]
+        assert rev == sorted(seq, reverse=True)
+        assert art.first()[1] == seq[0]
+        assert art.last()[1] == seq[-1]
+
+    def test_items_from(self):
+        art = Art()
+        vals = sorted({int(x) for x in rng.integers(0, 1 << 20, size=300)})
+        for v in vals:
+            art.insert(k6(v), v)
+        for probe in [0, vals[0], vals[10] + 1, vals[-1], vals[-1] + 5]:
+            want = [v for v in vals if v >= probe]
+            got = [v for _, v in art.items_from(k6(probe))]
+            assert got == want, f"probe {probe}"
+
+    def test_remove_and_path_compression(self):
+        art = Art()
+        vals = sorted({int(x) for x in rng.integers(0, 1 << 30, size=400)})
+        for v in vals:
+            art.insert(k6(v), v)
+        removed = set(vals[::3])
+        for v in removed:
+            assert art.remove(k6(v))
+            assert not art.remove(k6(v))  # second remove is a no-op
+        remaining = [v for v in vals if v not in removed]
+        assert len(art) == len(remaining)
+        assert [v for _, v in art.items()] == remaining
+        for v in remaining:
+            assert art.find(k6(v)) == v
+        for v in removed:
+            assert art.find(k6(v)) is None
+
+    def test_remove_everything(self):
+        art = Art()
+        for i in range(60):
+            art.insert(k6(i), i)
+        for i in range(60):
+            assert art.remove(k6(i))
+        assert art.is_empty()
+        assert art.first() is None
+
+    def test_node_downgrade(self):
+        """Fill past 48 children (table form), then remove back below the
+        downgrade threshold; order and lookups must survive."""
+        art = Art()
+        for i in range(256):
+            art.insert(bytes([9, 9, 9, 9, 9, i]), i)
+        for i in range(0, 256, 2):
+            art.remove(bytes([9, 9, 9, 9, 9, i]))
+        kept = list(range(1, 256, 2))
+        assert [v for _, v in art.items()] == kept
+        for i in kept:
+            assert art.find(bytes([9, 9, 9, 9, 9, i])) == i
+
+
+class TestCrossDesign64:
+    """The two 64-bit designs must agree on everything (the reference's
+    heap-vs-buffer-vs-64-bit agreement oracle, SURVEY §4)."""
+
+    def _pair(self, vals):
+        return Roaring64Bitmap(vals), Roaring64NavigableMap(vals)
+
+    def random_values(self, n=3000):
+        mix = np.concatenate(
+            [
+                rng.integers(0, 1 << 20, size=n // 3, dtype=np.uint64),
+                rng.integers(0, 1 << 48, size=n // 3, dtype=np.uint64),
+                rng.integers(0, 1 << 64, size=n // 3, dtype=np.uint64),
+            ]
+        )
+        return np.unique(mix)
+
+    def test_construction_and_order_stats(self):
+        vals = self.random_values()
+        art_bm, nav_bm = self._pair(vals)
+        assert art_bm.get_cardinality() == nav_bm.get_cardinality() == vals.size
+        assert np.array_equal(art_bm.to_array(), nav_bm.to_array())
+        assert art_bm.first() == nav_bm.first() == int(vals[0])
+        assert art_bm.last() == nav_bm.last() == int(vals[-1])
+        for j in [0, 17, int(vals.size) - 1]:
+            assert art_bm.select(j) == nav_bm.select(j)
+        for probe in vals[::500]:
+            p = int(probe)
+            assert art_bm.rank(p) == nav_bm.rank(p)
+            assert art_bm.contains(p) and nav_bm.contains(p)
+            assert art_bm.next_value(p) == nav_bm.next_value(p) == p
+        assert art_bm.next_value(int(vals[0]) + 1) == nav_bm.next_value(int(vals[0]) + 1)
+        assert art_bm.previous_value(int(vals[-1]) - 1) == nav_bm.previous_value(
+            int(vals[-1]) - 1
+        )
+
+    def test_algebra_agreement(self):
+        a_vals, b_vals = self.random_values(2000), self.random_values(2000)
+        a1, a2 = self._pair(a_vals)
+        b1, b2 = self._pair(b_vals)
+        for op in ("or_", "and_", "xor", "andnot"):
+            r1 = getattr(Roaring64Bitmap, op)(a1, b1)
+            r2 = getattr(Roaring64NavigableMap, op)(a2, b2)
+            assert np.array_equal(r1.to_array(), r2.to_array()), op
+
+    def test_serialization_interop(self):
+        """Both designs speak the portable spec byte-for-byte."""
+        vals = self.random_values(1500)
+        art_bm, nav_bm = self._pair(vals)
+        assert art_bm.serialize() == nav_bm.serialize_portable()
+        back = Roaring64NavigableMap.deserialize_portable(art_bm.serialize())
+        assert np.array_equal(back.to_array(), vals)
+        back2 = Roaring64Bitmap.deserialize(nav_bm.serialize_portable())
+        assert np.array_equal(back2.to_array(), vals)
+
+    def test_ranges_and_mutation(self):
+        art_bm, nav_bm = self._pair([1, 2, 3])
+        for bm in (art_bm, nav_bm):
+            bm.add_range(100, 200_000)
+            bm.remove_range(150, 400)
+            bm.flip_range(190_000, 210_000)
+            bm.add((1 << 50) + 7)
+            bm.remove(2)
+        assert np.array_equal(art_bm.to_array(), nav_bm.to_array())
+        assert art_bm.run_optimize() == nav_bm.run_optimize()
+        assert np.array_equal(art_bm.to_array(), nav_bm.to_array())
+
+
+class TestNavigableMapModes:
+    def test_legacy_round_trip(self):
+        vals = [1, 1 << 33, (1 << 63) + 5, 0xFFFF_FFFF_FFFF_FFFF]
+        bm = Roaring64NavigableMap(vals)
+        data = bm.serialize_legacy()
+        back = Roaring64NavigableMap.deserialize_legacy(data)
+        assert np.array_equal(back.to_array(), bm.to_array())
+        assert data[0] == 0  # unsigned flag
+        assert bm.serialized_size_in_bytes(mode=0) == len(data)
+
+    def test_mode_switch(self):
+        vals = [5, 1 << 40]
+        bm = Roaring64NavigableMap(vals)
+        try:
+            Roaring64NavigableMap.SERIALIZATION_MODE = 0  # legacy
+            data = bm.serialize()
+            back = Roaring64NavigableMap.deserialize(data)
+            assert np.array_equal(back.to_array(), bm.to_array())
+        finally:
+            Roaring64NavigableMap.SERIALIZATION_MODE = 1
+        assert bm.serialize() == bm.serialize_portable()
+
+    def test_signed_ordering(self):
+        vals = [5, (1 << 63) + 1, 10]
+        bm = Roaring64NavigableMap(vals, signed_longs=True)
+        # two's-complement order: negative half first
+        assert bm.first() == (1 << 63) + 1
+        assert bm.last() == 10
+        arr = bm.to_array().tolist()
+        assert arr == [(1 << 63) + 1, 5, 10]
+        assert bm.select(0) == (1 << 63) + 1
+        assert bm.rank(6) == 2  # the negative value and 5
+        legacy = bm.serialize_legacy()
+        assert legacy[0] == 1
+        back = Roaring64NavigableMap.deserialize_legacy(legacy)
+        assert back.signed_longs
+        assert np.array_equal(back.to_array(), bm.to_array())

@@ -6,7 +6,7 @@ import os
 import numpy as np
 import pytest
 
-from roaringbitmap_tpu.models.roaring64 import Roaring64Bitmap
+from roaringbitmap_tpu import Roaring64Bitmap
 from roaringbitmap_tpu import InvalidRoaringFormat
 
 TESTDATA = "/root/reference/RoaringBitmap/src/test/resources/testdata"
