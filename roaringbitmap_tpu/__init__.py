@@ -30,7 +30,16 @@ from .models.fastrank import FastRankRoaringBitmap
 from .models.immutable import ImmutableRoaringBitmap
 from .models.writer import RoaringBitmapWriter
 from .models.bsi import Operation, RoaringBitmapSliceIndex
+from .models.bsi64 import Roaring64BitmapSliceIndex
+from .models.bsi_buffer import ImmutableBitSliceIndex, MutableBitSliceIndex
 from .models.range_bitmap import RangeBitmap
+from .models.iterators import (
+    BatchIntIterator,
+    PeekableIntIterator,
+    PeekableIntRankIterator,
+    ReverseIntIterator,
+    RoaringBatchIterator,
+)
 from .serialization import InvalidRoaringFormat
 from .parallel.aggregation import FastAggregation, ParallelAggregation
 from . import insights
@@ -60,8 +69,16 @@ __all__ = [
     "RoaringBitmapWriter",
     "Operation",
     "RoaringBitmapSliceIndex",
+    "Roaring64BitmapSliceIndex",
+    "MutableBitSliceIndex",
+    "ImmutableBitSliceIndex",
     "RangeBitmap",
     "InvalidRoaringFormat",
+    "PeekableIntIterator",
+    "PeekableIntRankIterator",
+    "ReverseIntIterator",
+    "RoaringBatchIterator",
+    "BatchIntIterator",
     "FastAggregation",
     "ParallelAggregation",
     "insights",

@@ -54,6 +54,17 @@ class config:
     min_device_cells = 4096
 
 
+def values_for_columns(cols: np.ndarray, slices, dtype=np.int64) -> np.ndarray:
+    """Reassemble the stored value of each column from the slice bitmaps:
+    one vectorized membership mask per slice, bits OR'd back together.
+    Shared by every transpose/to_pair_list variant (32/64-bit, buffer)."""
+    values = np.zeros(cols.size, dtype=dtype)
+    for i, s in enumerate(slices):
+        members = np.isin(cols, s.to_array(), assume_unique=True)
+        values |= np.left_shift(members.astype(dtype), dtype(i))
+    return values
+
+
 class RoaringBitmapSliceIndex:
     """32-bit-value BSI over 32-bit column ids (RoaringBitmapSliceIndex.java)."""
 
@@ -464,18 +475,55 @@ class RoaringBitmapSliceIndex:
         )
         return total, count
 
-    def transpose(self) -> RoaringBitmap:
-        """Bitmap of distinct values present in the index (valueZero-style
-        helper exposed by the buffer BSI). Vectorized: one membership mask
-        per slice over the column array, values reassembled bit-by-bit."""
-        cols = self.ebm.to_array()
+    def transpose(self, found_set: Optional[RoaringBitmap] = None) -> RoaringBitmap:
+        """Bitmap of distinct values over the found columns (the buffer
+        base's transpose helper). Vectorized: one membership mask per slice
+        over the column array, values reassembled bit-by-bit."""
+        cols = (
+            self.ebm if found_set is None else RoaringBitmap.and_(self.ebm, found_set)
+        ).to_array()
         if cols.size == 0:
             return RoaringBitmap()
-        values = np.zeros(cols.size, dtype=np.int64)
-        for i, s in enumerate(self.slices):
-            members = np.isin(cols, s.to_array(), assume_unique=True)
-            values |= members.astype(np.int64) << i
-        return RoaringBitmap(np.unique(values))
+        return RoaringBitmap(np.unique(values_for_columns(cols, self.slices)))
+
+    def top_k(self, found_set: Optional[RoaringBitmap], k: int) -> RoaringBitmap:
+        """Columns holding the k largest values — MSB-first slice descent
+        (buffer BitSliceIndexBase.topK, bsi/.../BitSliceIndexBase.java:303).
+        Ties at the cut line are broken by smallest column id."""
+        if found_set is None:
+            found_set = self.ebm
+        if found_set.is_empty() or k <= 0:
+            return RoaringBitmap()
+        if k >= found_set.get_cardinality():
+            return found_set.clone()
+        result = RoaringBitmap()
+        candidates = found_set.clone()
+        for i in range(self.bit_count() - 1, -1, -1):
+            if candidates.is_empty() or k <= 0:
+                break
+            with_bit = RoaringBitmap.and_(candidates, self.slices[i])
+            card = with_bit.get_cardinality()
+            if card > k:
+                candidates = with_bit
+            else:
+                result.ior(with_bit)
+                candidates.iandnot(self.slices[i])
+                k -= card
+        if k > 0 and not candidates.is_empty():
+            result.ior(candidates.limit(k))
+        return result
+
+    def to_pair_list(
+        self, found_set: Optional[RoaringBitmap] = None
+    ) -> List[Tuple[int, int]]:
+        """(column, value) pairs (BitSliceIndexBase.toPairList)."""
+        cols = (
+            self.ebm if found_set is None else RoaringBitmap.and_(self.ebm, found_set)
+        ).to_array()
+        if cols.size == 0:
+            return []
+        values = values_for_columns(cols, self.slices)
+        return list(zip(cols.tolist(), values.tolist()))
 
     # ------------------------------------------------------------------
     # serialization (ByteBuffer layout, little-endian)

@@ -1,0 +1,442 @@
+"""64-bit bit-sliced index: ``Roaring64BitmapSliceIndex``
+(bsi/longlong/Roaring64BitmapSliceIndex.java:16) — 64-bit values over
+64-bit column ids, backed by the ART-based ``Roaring64Bitmap``.
+
+Same vertical layout and O'Neil compare as the 32-bit index (models/bsi.py;
+RoaringBitmapSliceIndex.java:432-469), with up to 64 slices. The compare
+chain runs on the CPU path of the 64-bit bitmaps (whose buckets are full
+32-bit bitmaps, so wide chains still batch per bucket); the 32-bit
+device-fused engine applies per high-32 bucket when indexes grow past the
+dispatch threshold — 64-bit column universes shard naturally along the
+bucket axis (SURVEY §5 long-context analogue).
+
+Also carries the reference's ranking helpers: ``top_k``
+(Roaring64BitmapSliceIndex.java:572 slice-descent), ``transpose`` (:596) and
+``transpose_with_count`` (:603).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..serialization import InvalidRoaringFormat
+from .bsi import Operation
+from .roaring64art import Roaring64Bitmap
+
+_MAX64 = 1 << 64
+
+
+class Roaring64BitmapSliceIndex:
+    """64-bit BSI (bsi/longlong/Roaring64BitmapSliceIndex.java:16)."""
+
+    def __init__(self, min_value: int = 0, max_value: int = 0):
+        if min_value < 0 or max_value < 0:
+            raise ValueError("BSI values must be non-negative")
+        self.min_value = int(min_value)
+        self.max_value = int(max_value)
+        self.ebm = Roaring64Bitmap()
+        self.slices: List[Roaring64Bitmap] = [
+            Roaring64Bitmap() for _ in range(max(0, int(max_value)).bit_length())
+        ]
+        self.run_optimized = False
+
+    # ------------------------------------------------------------------
+    # construction
+    # ------------------------------------------------------------------
+    def bit_count(self) -> int:
+        return len(self.slices)
+
+    def _grow(self, bit_depth: int) -> None:
+        while len(self.slices) < bit_depth:
+            self.slices.append(Roaring64Bitmap())
+
+    def _ensure_capacity(self, lo: int, hi: int) -> None:
+        if self.ebm.is_empty():
+            self.min_value, self.max_value = lo, hi
+            self._grow(max(1, hi.bit_length()))
+        else:
+            if lo < self.min_value:
+                self.min_value = lo
+            if hi > self.max_value:
+                self.max_value = hi
+                self._grow(max(1, hi.bit_length()))
+
+    def set_value(self, column_id: int, value: int) -> None:
+        """setValue (Roaring64BitmapSliceIndex.java:291)."""
+        value = int(value)
+        if value < 0:
+            raise ValueError("BSI values must be non-negative")
+        self._ensure_capacity(value, value)
+        for i in range(self.bit_count()):
+            if (value >> i) & 1:
+                self.slices[i].add(column_id)
+            else:
+                self.slices[i].remove(column_id)
+        self.ebm.add(column_id)
+
+    def set_values(self, pairs) -> None:
+        """Vectorized bulk load (setValues, Roaring64BitmapSliceIndex.java:341);
+        accepts (columns, values) parallel arrays or an iterable of pairs,
+        last-pair-wins on duplicate columns."""
+        if isinstance(pairs, tuple) and len(pairs) == 2:
+            cols, vals = pairs
+        else:
+            seq = list(pairs)
+            if not seq:
+                return
+            cols = [p[0] for p in seq]
+            vals = [p[1] for p in seq]
+        cols = np.asarray(cols, dtype=np.uint64)
+        vals_arr = np.asarray(vals)
+        if np.issubdtype(vals_arr.dtype, np.signedinteger) and vals_arr.size and vals_arr.min() < 0:
+            raise ValueError("BSI values must be non-negative")
+        vals = vals_arr.astype(np.uint64)
+        if cols.size == 0:
+            return
+        _, last_idx = np.unique(cols[::-1], return_index=True)
+        keep = np.sort(cols.size - 1 - last_idx)
+        if keep.size != cols.size:
+            cols, vals = cols[keep], vals[keep]
+        self._ensure_capacity(int(vals.min()), int(vals.max()))
+        if not self.ebm.is_empty():
+            existing = Roaring64Bitmap(cols)
+            overlap = Roaring64Bitmap.and_(self.ebm, existing)
+            if not overlap.is_empty():
+                for s in self.slices:
+                    s.iandnot(overlap)
+        for i in range(self.bit_count()):
+            mask = (vals >> np.uint64(i)) & np.uint64(1) == 1
+            if mask.any():
+                self.slices[i].add_many(cols[mask])
+        self.ebm.add_many(cols)
+
+    def get_value(self, column_id: int) -> Tuple[int, bool]:
+        if not self.ebm.contains(column_id):
+            return 0, False
+        value = 0
+        for i, s in enumerate(self.slices):
+            if s.contains(column_id):
+                value |= 1 << i
+        return value, True
+
+    def value_exist(self, column_id: int) -> bool:
+        return self.ebm.contains(column_id)
+
+    def get_existence_bitmap(self) -> Roaring64Bitmap:
+        return self.ebm
+
+    def get_long_cardinality(self) -> int:
+        return self.ebm.get_cardinality()
+
+    get_cardinality = get_long_cardinality
+
+    def clone(self) -> "Roaring64BitmapSliceIndex":
+        out = Roaring64BitmapSliceIndex()
+        out.min_value, out.max_value = self.min_value, self.max_value
+        out.ebm = self.ebm.clone()
+        out.slices = [s.clone() for s in self.slices]
+        out.run_optimized = self.run_optimized
+        return out
+
+    def run_optimize(self) -> None:
+        self.ebm.run_optimize()
+        for s in self.slices:
+            s.run_optimize()
+        self.run_optimized = True
+
+    def has_run_compression(self) -> bool:
+        return self.run_optimized
+
+    # ------------------------------------------------------------------
+    # combination (add :64 / merge :357)
+    # ------------------------------------------------------------------
+    def merge(self, other: "Roaring64BitmapSliceIndex") -> None:
+        if other is None or other.ebm.is_empty():
+            return
+        if self.ebm.intersects(other.ebm):
+            raise ValueError("merge requires disjoint column sets")
+        depth = max(self.bit_count(), other.bit_count())
+        self._grow(depth)
+        for i in range(other.bit_count()):
+            self.slices[i].ior(other.slices[i])
+        self.ebm.ior(other.ebm)
+        self.min_value = min(self.min_value, other.min_value)
+        self.max_value = max(self.max_value, other.max_value)
+
+    def add(self, other: "Roaring64BitmapSliceIndex") -> None:
+        if other is None or other.ebm.is_empty():
+            return
+        self.ebm.ior(other.ebm)
+        if other.bit_count() > self.bit_count():
+            self._grow(other.bit_count())
+        for i in range(other.bit_count()):
+            self._add_digit(other.slices[i], i)
+        self.min_value = self._min_value()
+        self.max_value = self._max_value()
+
+    add_digit = None  # set below
+
+    def _add_digit(self, found_set: Roaring64Bitmap, i: int) -> None:
+        carry = Roaring64Bitmap.and_(self.slices[i], found_set)
+        self.slices[i].ixor(found_set)
+        if not carry.is_empty():
+            if i + 1 >= self.bit_count():
+                self._grow(self.bit_count() + 1)
+            self._add_digit(carry, i + 1)
+
+    def _min_value(self) -> int:
+        if self.ebm.is_empty():
+            return 0
+        ids = self.ebm
+        for i in range(self.bit_count() - 1, -1, -1):
+            tmp = Roaring64Bitmap.andnot(ids, self.slices[i])
+            if not tmp.is_empty():
+                ids = tmp
+        return self.get_value(ids.first())[0]
+
+    def _max_value(self) -> int:
+        if self.ebm.is_empty():
+            return 0
+        ids = self.ebm
+        for i in range(self.bit_count() - 1, -1, -1):
+            tmp = Roaring64Bitmap.and_(ids, self.slices[i])
+            if not tmp.is_empty():
+                ids = tmp
+        return self.get_value(ids.first())[0]
+
+    # ------------------------------------------------------------------
+    # queries (compare :460, o'neil :398-458)
+    # ------------------------------------------------------------------
+    def compare(
+        self,
+        operation: Operation,
+        start_or_value: int,
+        end: int = 0,
+        found_set: Optional[Roaring64Bitmap] = None,
+    ) -> Roaring64Bitmap:
+        res = self._compare_using_min_max(operation, start_or_value, end, found_set)
+        if res is not None:
+            return res
+        if operation == Operation.RANGE:
+            end = min(int(end), (1 << self.bit_count()) - 1)
+            left = self._o_neil(Operation.GE, start_or_value, found_set)
+            right = self._o_neil(Operation.LE, end, found_set)
+            return Roaring64Bitmap.and_(left, right)
+        return self._o_neil(operation, start_or_value, found_set)
+
+    def _compare_using_min_max(self, op, start_or_value, end, found_set):
+        all_ = (
+            self.ebm.clone()
+            if found_set is None
+            else Roaring64Bitmap.and_(self.ebm, found_set)
+        )
+        empty = Roaring64Bitmap()
+        v, mn, mx = start_or_value, self.min_value, self.max_value
+        if op == Operation.LT:
+            if v > mx:
+                return all_
+            if v <= mn:
+                return empty
+        elif op == Operation.LE:
+            if v >= mx:
+                return all_
+            if v < mn:
+                return empty
+        elif op == Operation.GT:
+            if v < mn:
+                return all_
+            if v >= mx:
+                return empty
+        elif op == Operation.GE:
+            if v <= mn:
+                return all_
+            if v > mx:
+                return empty
+        elif op == Operation.EQ:
+            if mn == mx and mn == v:
+                return all_
+            if v < mn or v > mx:
+                return empty
+        elif op == Operation.NEQ:
+            if mn == mx:
+                return empty if mn == v else all_
+            if v < mn or v > mx:
+                return self.ebm.clone() if found_set is None else found_set.clone()
+        elif op == Operation.RANGE:
+            if v <= mn and end >= mx:
+                return all_
+            if v > mx or end < mn:
+                return empty
+        return None
+
+    def _o_neil(self, op, predicate, found_set) -> Roaring64Bitmap:
+        fixed = self.ebm if found_set is None else found_set
+        gt, lt, eq = Roaring64Bitmap(), Roaring64Bitmap(), self.ebm
+        for i in range(self.bit_count() - 1, -1, -1):
+            if (predicate >> i) & 1:
+                lt = Roaring64Bitmap.or_(lt, Roaring64Bitmap.andnot(eq, self.slices[i]))
+                eq = Roaring64Bitmap.and_(eq, self.slices[i])
+            else:
+                gt = Roaring64Bitmap.or_(gt, Roaring64Bitmap.and_(eq, self.slices[i]))
+                eq = Roaring64Bitmap.andnot(eq, self.slices[i])
+        eq = Roaring64Bitmap.and_(fixed, eq)
+        if op == Operation.EQ:
+            return eq
+        if op == Operation.NEQ:
+            return Roaring64Bitmap.andnot(fixed, eq)
+        if op == Operation.GT:
+            return Roaring64Bitmap.and_(gt, fixed)
+        if op == Operation.LT:
+            return Roaring64Bitmap.and_(lt, fixed)
+        if op == Operation.LE:
+            return Roaring64Bitmap.and_(Roaring64Bitmap.or_(lt, eq), fixed)
+        if op == Operation.GE:
+            return Roaring64Bitmap.and_(Roaring64Bitmap.or_(gt, eq), fixed)
+        raise ValueError(f"unsupported operation {op}")
+
+    def sum(self, found_set: Optional[Roaring64Bitmap] = None) -> Tuple[int, int]:
+        """(sum, count) (Roaring64BitmapSliceIndex.java:559)."""
+        if found_set is None or found_set.is_empty():
+            return 0, 0
+        count = found_set.get_cardinality()
+        total = sum(
+            (1 << i) * Roaring64Bitmap.and_(s, found_set).get_cardinality()
+            for i, s in enumerate(self.slices)
+        )
+        return total, count
+
+    def top_k(self, found_set: Optional[Roaring64Bitmap], k: int) -> Roaring64Bitmap:
+        """Columns holding the k largest values — slice descent from the
+        MSB (Roaring64BitmapSliceIndex.java:572)."""
+        if found_set is None or found_set.is_empty() or k <= 0:
+            return Roaring64Bitmap()
+        if k >= found_set.get_cardinality():
+            return found_set.clone()
+        result = Roaring64Bitmap()
+        candidates = found_set.clone()
+        for i in range(self.bit_count() - 1, -1, -1):
+            if candidates.is_empty() or k <= 0:
+                break
+            with_bit = Roaring64Bitmap.and_(candidates, self.slices[i])
+            card = with_bit.get_cardinality()
+            if card > k:
+                candidates = with_bit
+            else:
+                result.ior(with_bit)
+                candidates.iandnot(self.slices[i])
+                k -= card
+        if k > 0 and not candidates.is_empty():
+            # fill remaining seats from the leftover (equal-valued) pool
+            fill = Roaring64Bitmap()
+            for idx, col in enumerate(candidates):
+                if idx >= k:
+                    break
+                fill.add(col)
+            result.ior(fill)
+        return result
+
+    def transpose(self, found_set: Optional[Roaring64Bitmap] = None) -> Roaring64Bitmap:
+        """Bitmap of distinct values over the found columns
+        (Roaring64BitmapSliceIndex.java:596)."""
+        cols = (
+            self.ebm if found_set is None else Roaring64Bitmap.and_(self.ebm, found_set)
+        ).to_array()
+        if cols.size == 0:
+            return Roaring64Bitmap()
+        from .bsi import values_for_columns
+
+        return Roaring64Bitmap(
+            np.unique(values_for_columns(cols, self.slices, dtype=np.uint64))
+        )
+
+    def transpose_with_count(
+        self, found_set: Optional[Roaring64Bitmap] = None
+    ) -> "Roaring64BitmapSliceIndex":
+        """BSI mapping value -> multiplicity (Roaring64BitmapSliceIndex.java:603)."""
+        cols = (
+            self.ebm if found_set is None else Roaring64Bitmap.and_(self.ebm, found_set)
+        ).to_array()
+        out = Roaring64BitmapSliceIndex()
+        if cols.size == 0:
+            return out
+        from .bsi import values_for_columns
+
+        uniq, counts = np.unique(
+            values_for_columns(cols, self.slices, dtype=np.uint64), return_counts=True
+        )
+        out.set_values((uniq, counts.astype(np.uint64)))
+        return out
+
+    # ------------------------------------------------------------------
+    # serialization (ByteBuffer layout :234-271, little-endian):
+    # int64 minValue, int64 maxValue, byte runOptimized, ebm (portable
+    # 64-bit spec), int32 sliceCount, slices
+    # ------------------------------------------------------------------
+    def serialize(self) -> bytes:
+        parts = [
+            struct.pack(
+                "<QQb", self.min_value, self.max_value, 1 if self.run_optimized else 0
+            ),
+            self.ebm.serialize(),
+            struct.pack("<i", self.bit_count()),
+        ]
+        parts.extend(s.serialize() for s in self.slices)
+        return b"".join(parts)
+
+    def serialized_size_in_bytes(self) -> int:
+        return (
+            8 + 8 + 1 + 4
+            + self.ebm.serialized_size_in_bytes()
+            + sum(s.serialized_size_in_bytes() for s in self.slices)
+        )
+
+    @staticmethod
+    def deserialize(data) -> "Roaring64BitmapSliceIndex":
+        buf = memoryview(
+            data if isinstance(data, (bytes, bytearray, memoryview)) else bytes(data)
+        )
+        if len(buf) < 17:
+            raise InvalidRoaringFormat("truncated 64-bit BSI header")
+        min_v, max_v, ro = struct.unpack_from("<QQb", buf, 0)
+        pos = 17
+        out = Roaring64BitmapSliceIndex()
+        out.min_value, out.max_value = min_v, max_v
+        out.run_optimized = bool(ro)
+        out.ebm, n = _read_r64(buf[pos:])
+        pos += n
+        if pos + 4 > len(buf):
+            raise InvalidRoaringFormat("truncated BSI slice count")
+        (depth,) = struct.unpack_from("<i", buf, pos)
+        pos += 4
+        if depth < 0 or depth > 64:
+            raise InvalidRoaringFormat(f"implausible BSI depth {depth}")
+        out.slices = []
+        for _ in range(depth):
+            s, n = _read_r64(buf[pos:])
+            pos += n
+            out.slices.append(s)
+        return out
+
+    def __eq__(self, other):
+        if not isinstance(other, Roaring64BitmapSliceIndex):
+            return NotImplemented
+        return (
+            self.ebm == other.ebm
+            and len(self.slices) == len(other.slices)
+            and all(a == b for a, b in zip(self.slices, other.slices))
+        )
+
+    def __repr__(self):
+        return (
+            f"Roaring64BitmapSliceIndex(cols={self.get_long_cardinality()}, "
+            f"slices={self.bit_count()}, min={self.min_value}, max={self.max_value})"
+        )
+
+
+Roaring64BitmapSliceIndex.add_digit = Roaring64BitmapSliceIndex._add_digit
+
+# consuming reader shared with Roaring64Bitmap.deserialize
+_read_r64 = Roaring64Bitmap.read_from

@@ -1,0 +1,191 @@
+"""Buffer-package BSI twins: ``MutableBitSliceIndex`` /
+``ImmutableBitSliceIndex`` (bsi/buffer/MutableBitSliceIndex.java:20,
+ImmutableBitSliceIndex.java:17, shared base BitSliceIndexBase.java:30).
+
+In the reference the buffer twins re-run every algorithm over
+ByteBuffer-backed Mappeable containers; in this framework the heap/buffer
+split collapses (models/immutable.py explains why: numpy views already give
+zero-copy over serialized bytes), so the Mutable twin IS the 32-bit BSI
+with the buffer API's method names, and the Immutable twin wraps it behind
+a mutation guard and deserializes lazily from a buffer.
+
+The reference's fork-join variants (``parallelIn``
+BitSliceIndexBase.java:611, ``parallelTransposeWithCount`` :578) map to the
+batched device engine: on TPU the O'Neil chain is already one fused
+dispatch over all key-chunks at once (models/bsi.py), which *is* the
+parallel evaluation — the ``parallelism`` argument is accepted for API
+compatibility and ignored beyond choosing the engine.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .bsi import Operation, RoaringBitmapSliceIndex
+from .roaring import RoaringBitmap
+
+
+class MutableBitSliceIndex(RoaringBitmapSliceIndex):
+    """bsi/buffer/MutableBitSliceIndex.java:20 — the mutable buffer twin."""
+
+    # range* named queries (BitSliceIndexBase.java:351-420)
+    def range_eq(self, found_set: Optional[RoaringBitmap], predicate: int) -> RoaringBitmap:
+        return self.compare(Operation.EQ, predicate, 0, found_set)
+
+    def range_neq(self, found_set: Optional[RoaringBitmap], predicate: int) -> RoaringBitmap:
+        return self.compare(Operation.NEQ, predicate, 0, found_set)
+
+    def range_lt(self, found_set: Optional[RoaringBitmap], predicate: int) -> RoaringBitmap:
+        return self.compare(Operation.LT, predicate, 0, found_set)
+
+    def range_le(self, found_set: Optional[RoaringBitmap], predicate: int) -> RoaringBitmap:
+        return self.compare(Operation.LE, predicate, 0, found_set)
+
+    def range_gt(self, found_set: Optional[RoaringBitmap], predicate: int) -> RoaringBitmap:
+        return self.compare(Operation.GT, predicate, 0, found_set)
+
+    def range_ge(self, found_set: Optional[RoaringBitmap], predicate: int) -> RoaringBitmap:
+        return self.compare(Operation.GE, predicate, 0, found_set)
+
+    def range(self, found_set: Optional[RoaringBitmap], start: int, end: int) -> RoaringBitmap:
+        return self.compare(Operation.RANGE, start, end, found_set)
+
+    def get_mutable_slice(self, i: int) -> RoaringBitmap:
+        """getMutableSlice (MutableBitSliceIndex.java:136)."""
+        return self.slices[i]
+
+    def add_digit(self, found_set: RoaringBitmap, i: int) -> None:
+        """addDigit (MutableBitSliceIndex.java:121)."""
+        self._grow(i + 1)
+        self._add_digit(found_set, i)
+        self._version += 1
+
+    def parallel_in(
+        self,
+        parallelism: int,
+        operation: Operation,
+        start_or_value: int,
+        end: int = 0,
+        found_set: Optional[RoaringBitmap] = None,
+    ) -> RoaringBitmap:
+        """parallelIn (BitSliceIndexBase.java:611). The batched engine
+        evaluates all key-chunks in one dispatch; parallelism is accepted
+        for API compatibility."""
+        return self.compare(operation, start_or_value, end, found_set)
+
+    def parallel_transpose_with_count(
+        self, found_set: Optional[RoaringBitmap] = None, parallelism: int = 0
+    ) -> "MutableBitSliceIndex":
+        """parallelTransposeWithCount (BitSliceIndexBase.java:578):
+        value -> multiplicity BSI."""
+        cols = (
+            self.ebm if found_set is None else RoaringBitmap.and_(self.ebm, found_set)
+        ).to_array()
+        out = MutableBitSliceIndex()
+        if cols.size == 0:
+            return out
+        from .bsi import values_for_columns
+
+        uniq, counts = np.unique(
+            values_for_columns(cols, self.slices), return_counts=True
+        )
+        out.set_values((uniq.astype(np.uint32), counts.astype(np.int64)))
+        return out
+
+    def to_immutable_bit_slice_index(self) -> "ImmutableBitSliceIndex":
+        """toImmutableBitSliceIndex (MutableBitSliceIndex.java:411) — O(1),
+        shares structure (castable like Mutable->ImmutableRoaringBitmap)."""
+        return ImmutableBitSliceIndex(self)
+
+    @staticmethod
+    def deserialize(data) -> "MutableBitSliceIndex":
+        base = RoaringBitmapSliceIndex.deserialize(data)
+        out = MutableBitSliceIndex()
+        out.__dict__.update(base.__dict__)
+        return out
+
+
+class ImmutableBitSliceIndex:
+    """bsi/buffer/ImmutableBitSliceIndex.java:17 — read-only view, either
+    over an existing index (O(1) cast) or parsed from a serialized buffer
+    (ImmutableBitSliceIndex(ByteBuffer), :52)."""
+
+    __slots__ = ("_base",)
+
+    def __init__(self, source):
+        if isinstance(source, RoaringBitmapSliceIndex):
+            self._base = source
+        else:  # serialized buffer
+            self._base = RoaringBitmapSliceIndex.deserialize(source)
+
+    # read surface delegates
+    def bit_count(self) -> int:
+        return self._base.bit_count()
+
+    def get_long_cardinality(self) -> int:
+        return self._base.get_cardinality()
+
+    get_cardinality = get_long_cardinality
+
+    def get_value(self, column_id: int) -> Tuple[int, bool]:
+        return self._base.get_value(column_id)
+
+    def value_exist(self, column_id: int) -> bool:
+        return self._base.value_exist(column_id)
+
+    def get_existence_bitmap(self) -> RoaringBitmap:
+        return self._base.ebm
+
+    @property
+    def min_value(self) -> int:
+        return self._base.min_value
+
+    @property
+    def max_value(self) -> int:
+        return self._base.max_value
+
+    def compare(self, operation, start_or_value, end=0, found_set=None, mode=None):
+        return self._base.compare(operation, start_or_value, end, found_set, mode)
+
+    def sum(self, found_set=None):
+        return self._base.sum(found_set)
+
+    def top_k(self, found_set, k):
+        return self._base.top_k(found_set, k)
+
+    def transpose(self, found_set=None):
+        return self._base.transpose(found_set)
+
+    def to_pair_list(self, found_set=None):
+        return self._base.to_pair_list(found_set)
+
+    def serialize(self) -> bytes:
+        return self._base.serialize()
+
+    def serialized_size_in_bytes(self) -> int:
+        return self._base.serialized_size_in_bytes()
+
+    def to_mutable_bit_slice_index(self) -> MutableBitSliceIndex:
+        """Deep copy back to the mutable twin."""
+        base = self._base.clone()
+        out = MutableBitSliceIndex()
+        out.__dict__.update(base.__dict__)
+        return out
+
+    # mutation guard
+    def _refuse(self, *_a, **_k):
+        raise TypeError("ImmutableBitSliceIndex does not support mutation")
+
+    set_value = set_values = add = merge = run_optimize = add_digit = _refuse
+
+    def __eq__(self, other):
+        if isinstance(other, ImmutableBitSliceIndex):
+            return self._base == other._base
+        if isinstance(other, RoaringBitmapSliceIndex):
+            return self._base == other
+        return NotImplemented
+
+    def __repr__(self):
+        return f"Immutable{self._base!r}"

@@ -660,6 +660,30 @@ class RoaringBitmap:
             for v in reversed(c.to_array().tolist()):
                 yield base | v
 
+    def get_int_iterator(self):
+        """Peekable forward iterator (getIntIterator; PeekableIntIterator)."""
+        from .iterators import PeekableIntIterator
+
+        return PeekableIntIterator(self)
+
+    def get_reverse_int_iterator(self):
+        """Descending iterator (getReverseIntIterator)."""
+        from .iterators import ReverseIntIterator
+
+        return ReverseIntIterator(self)
+
+    def get_int_rank_iterator(self):
+        """Rank-tracking peekable iterator (getIntRankIterator)."""
+        from .iterators import PeekableIntRankIterator
+
+        return PeekableIntRankIterator(self)
+
+    def get_batch_iterator(self):
+        """Buffer-filling iterator (getBatchIterator, BatchIterator.java:12)."""
+        from .iterators import RoaringBatchIterator
+
+        return RoaringBatchIterator(self)
+
     def batch_iterator(self, batch_size: int = 256) -> Iterator[np.ndarray]:
         """Buffer-filling iteration (BatchIterator.nextBatch contract,
         BatchIterator.java:12), yielding uint32 chunks."""

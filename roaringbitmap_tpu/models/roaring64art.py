@@ -451,19 +451,44 @@ class Roaring64Bitmap:
         )
 
     @staticmethod
-    def deserialize(data) -> "Roaring64Bitmap":
-        from .roaring64 import Roaring64NavigableMap
+    def read_from(buf) -> Tuple["Roaring64Bitmap", int]:
+        """Parse one portable-spec 64-bit bitmap from the head of `buf`,
+        returning (bitmap, bytes consumed) — the consuming reader shared by
+        deserialize and embedding formats (64-bit BSI slices)."""
+        import struct
 
-        nav = Roaring64NavigableMap.deserialize_portable(data)
+        from ..serialization import InvalidRoaringFormat, read_into
+
+        buf = memoryview(
+            bytes(buf) if not isinstance(buf, (bytes, bytearray, memoryview)) else buf
+        )
+        if len(buf) < 8:
+            raise InvalidRoaringFormat("truncated 64-bit header")
+        (count,) = struct.unpack_from("<Q", buf, 0)
+        if count > len(buf) // 4:
+            raise InvalidRoaringFormat(f"implausible bucket count {count}")
+        pos = 8
         out = Roaring64Bitmap()
-        for high32 in sorted(nav._buckets):
-            bm = nav._buckets[high32]
+        prev_key = -1
+        for _ in range(count):
+            if pos + 4 > len(buf):
+                raise InvalidRoaringFormat("truncated bucket key")
+            (high32,) = struct.unpack_from("<I", buf, pos)
+            pos += 4
+            if high32 <= prev_key:
+                raise InvalidRoaringFormat("bucket keys not strictly increasing")
+            prev_key = high32
+            bm = RoaringBitmap()
+            pos += read_into(bm, buf[pos:])
             arr = bm.high_low_container
             for i in range(arr.size):
-                key16 = arr.keys[i]
-                k = ((high32 << 16) | int(key16)).to_bytes(6, "big")
+                k = ((high32 << 16) | int(arr.keys[i])).to_bytes(6, "big")
                 out._put(k, arr.containers[i])
-        return out
+        return out, pos
+
+    @staticmethod
+    def deserialize(data) -> "Roaring64Bitmap":
+        return Roaring64Bitmap.read_from(data)[0]
 
     # ------------------------------------------------------------------
     def __eq__(self, other):

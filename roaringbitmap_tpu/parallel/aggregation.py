@@ -75,22 +75,28 @@ def _use_device(n_containers: int, mode: Optional[str]) -> bool:
 # ---------------------------------------------------------------------------
 
 
+def _ior_container_into(acc: np.ndarray, c: Container) -> None:
+    """OR one container into a word accumulator without materializing it
+    (the lazy-OR protocol's per-type fast paths)."""
+    if isinstance(c, BitmapContainer):
+        acc |= c.words
+    elif isinstance(c, ArrayContainer):
+        v = c.content.astype(np.uint32)
+        np.bitwise_or.at(
+            acc, v >> 6, np.uint64(1) << (v & np.uint32(63)).astype(np.uint64)
+        )
+    else:
+        for s, l in zip(c.starts.tolist(), c.lengths.tolist()):
+            bits.set_bitmap_range(acc, s, s + l + 1)
+
+
 def _fold_group_words(cs: List[Container], op: str) -> np.ndarray:
     """In-place word fold of one key group; popcount deferred to the caller."""
     first = cs[0]
     acc = first.to_words()  # always a copy
     if op == "or":
         for c in cs[1:]:
-            if isinstance(c, BitmapContainer):
-                acc |= c.words
-            elif isinstance(c, ArrayContainer):
-                v = c.content.astype(np.uint32)
-                np.bitwise_or.at(
-                    acc, v >> 6, np.uint64(1) << (v & np.uint32(63)).astype(np.uint64)
-                )
-            else:
-                for s, l in zip(c.starts.tolist(), c.lengths.tolist()):
-                    bits.set_bitmap_range(acc, s, s + l + 1)
+            _ior_container_into(acc, c)
     elif op == "and":
         for c in cs[1:]:
             acc &= c.words if isinstance(c, BitmapContainer) else c.to_words()
@@ -178,14 +184,160 @@ class FastAggregation:
     def xor(*bitmaps: RoaringBitmap, mode: Optional[str] = None) -> RoaringBitmap:
         return _aggregate(_flatten(bitmaps), "xor", mode)
 
-    # strategy aliases of the reference (same results by construction)
-    naive_or = or_
-    horizontal_or = or_
-    priorityqueue_or = or_
-    naive_and = and_
-    workshy_and = and_
-    naive_xor = xor
-    horizontal_xor = xor
+    # ---- distinct strategy engines (cross-checking oracles, like the
+    # reference's: equivalence of naive vs horizontal vs priority-queue is a
+    # fuzz invariant, SURVEY §4) -------------------------------------------
+
+    @staticmethod
+    def naive_or(*bitmaps: RoaringBitmap) -> RoaringBitmap:
+        """Sequential lazy fold (FastAggregation.naive_or :541 +
+        Container.lazyIOR protocol): accumulate words per key left to right,
+        popcount once at the end."""
+        bms = _flatten(bitmaps)
+        acc: Dict[int, np.ndarray] = {}
+        for bm in bms:
+            hlc = bm.high_low_container
+            for k, c in zip(hlc.keys, hlc.containers):
+                words = acc.get(k)
+                if words is None:
+                    acc[k] = c.to_words()
+                else:
+                    _ior_container_into(words, c)
+        out = RoaringBitmap()
+        for k in sorted(acc):
+            c = best_container_of_words(acc[k])
+            if c.cardinality:
+                out.high_low_container.append(k, c)
+        return out
+
+    @staticmethod
+    def naive_xor(*bitmaps: RoaringBitmap) -> RoaringBitmap:
+        bms = _flatten(bitmaps)
+        acc: Dict[int, np.ndarray] = {}
+        for bm in bms:
+            hlc = bm.high_low_container
+            for k, c in zip(hlc.keys, hlc.containers):
+                words = acc.get(k)
+                if words is None:
+                    acc[k] = c.to_words()
+                else:
+                    words ^= c.words if isinstance(c, BitmapContainer) else c.to_words()
+        out = RoaringBitmap()
+        for k in sorted(acc):
+            c = best_container_of_words(acc[k])
+            if c.cardinality:
+                out.high_low_container.append(k, c)
+        return out
+
+    @staticmethod
+    def naive_and(*bitmaps: RoaringBitmap) -> RoaringBitmap:
+        """Pairwise left fold (FastAggregation.naive_and)."""
+        bms = _flatten(bitmaps)
+        if not bms:
+            return RoaringBitmap()
+        acc = bms[0].clone()
+        for bm in bms[1:]:
+            acc.iand(bm)
+            if acc.is_empty():
+                break
+        return acc
+
+    @staticmethod
+    def horizontal_or(*bitmaps: RoaringBitmap) -> RoaringBitmap:
+        """Priority-queue merge of ContainerPointer cursors
+        (FastAggregation.horizontal_or :183-230): a heap of (key, cursor)
+        pairs; all same-key containers are folded lazily, repaired once."""
+        import heapq
+
+        bms = _flatten(bitmaps)
+        heap = []  # (key, seq, bitmap_idx, container_idx)
+        for bi, bm in enumerate(bms):
+            hlc = bm.high_low_container
+            if hlc.size:
+                heapq.heappush(heap, (hlc.keys[0], bi, 0))
+        out = RoaringBitmap()
+        while heap:
+            key, bi, ci = heapq.heappop(heap)
+            group = [bms[bi].high_low_container.containers[ci]]
+            hlc = bms[bi].high_low_container
+            if ci + 1 < hlc.size:
+                heapq.heappush(heap, (hlc.keys[ci + 1], bi, ci + 1))
+            while heap and heap[0][0] == key:
+                _, bj, cj = heapq.heappop(heap)
+                hlc_j = bms[bj].high_low_container
+                group.append(hlc_j.containers[cj])
+                if cj + 1 < hlc_j.size:
+                    heapq.heappush(heap, (hlc_j.keys[cj + 1], bj, cj + 1))
+            if len(group) == 1:
+                c = group[0].clone()
+            else:
+                words = group[0].to_words()
+                for c2 in group[1:]:
+                    _ior_container_into(words, c2)
+                c = best_container_of_words(words)
+            if c.cardinality:
+                out.high_low_container.append(key, c)
+        return out
+
+    @staticmethod
+    def horizontal_xor(*bitmaps: RoaringBitmap) -> RoaringBitmap:
+        """Heap-ordered key merge, XOR fold per group (FastAggregation
+        .horizontal_xor :243) — a genuinely independent engine from the
+        transpose-based xor, usable as a cross-checking oracle."""
+        import heapq
+
+        bms = _flatten(bitmaps)
+        heap = []
+        for bi, bm in enumerate(bms):
+            hlc = bm.high_low_container
+            if hlc.size:
+                heapq.heappush(heap, (hlc.keys[0], bi, 0))
+        out = RoaringBitmap()
+        while heap:
+            key, bi, ci = heapq.heappop(heap)
+            hlc = bms[bi].high_low_container
+            acc = hlc.containers[ci].to_words()
+            if ci + 1 < hlc.size:
+                heapq.heappush(heap, (hlc.keys[ci + 1], bi, ci + 1))
+            while heap and heap[0][0] == key:
+                _, bj, cj = heapq.heappop(heap)
+                hlc_j = bms[bj].high_low_container
+                c2 = hlc_j.containers[cj]
+                acc ^= c2.words if isinstance(c2, BitmapContainer) else c2.to_words()
+                if cj + 1 < hlc_j.size:
+                    heapq.heappush(heap, (hlc_j.keys[cj + 1], bj, cj + 1))
+            c = best_container_of_words(acc)
+            if c.cardinality:
+                out.high_low_container.append(key, c)
+        return out
+
+    @staticmethod
+    def priorityqueue_or(*bitmaps: RoaringBitmap) -> RoaringBitmap:
+        """Repeatedly OR the two smallest bitmaps (by serialized size) —
+        FastAggregation.priorityqueue_or (FastAggregation.java:675)."""
+        import heapq
+
+        bms = _flatten(bitmaps)
+        if not bms:
+            return RoaringBitmap()
+        if len(bms) == 1:
+            return bms[0].clone()
+        heap = [(bm.get_size_in_bytes(), i, bm) for i, bm in enumerate(bms)]
+        heapq.heapify(heap)
+        seq = len(bms)
+        while len(heap) > 1:
+            _, _, a = heapq.heappop(heap)
+            _, _, b = heapq.heappop(heap)
+            m = RoaringBitmap.or_(a, b)
+            heapq.heappush(heap, (m.get_size_in_bytes(), seq, m))
+            seq += 1
+        return heap[0][2]
+
+    @staticmethod
+    def workshy_and(*bitmaps: RoaringBitmap, mode: Optional[str] = None) -> RoaringBitmap:
+        """Key-intersection-first AND (FastAggregation.workShyAnd :356-396):
+        only containers whose key survives the key intersection are touched."""
+        return _aggregate(_flatten(bitmaps), "and", mode)
 
     @staticmethod
     def and_cardinality(*bitmaps: RoaringBitmap) -> int:

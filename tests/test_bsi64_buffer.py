@@ -1,0 +1,187 @@
+"""64-bit BSI + buffer BSI twins (reference oracles:
+bsi/longlong/Roaring64BitmapSliceIndexTest, bsi/buffer tests; differential
+oracle: a plain dict of column -> value)."""
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import (
+    ImmutableBitSliceIndex,
+    MutableBitSliceIndex,
+    Operation,
+    Roaring64Bitmap,
+    Roaring64BitmapSliceIndex,
+    RoaringBitmap,
+)
+
+rng = np.random.default_rng(0xFEEF1F0)
+
+
+def build64(n=800):
+    cols = np.unique(rng.integers(0, 1 << 40, size=n, dtype=np.uint64))
+    vals = rng.integers(0, 1 << 36, size=cols.size, dtype=np.uint64)
+    bsi = Roaring64BitmapSliceIndex()
+    bsi.set_values((cols, vals))
+    return bsi, dict(zip(cols.tolist(), vals.tolist()))
+
+
+class TestRoaring64BSI:
+    def test_set_get(self):
+        bsi, model = build64()
+        assert bsi.get_long_cardinality() == len(model)
+        for c, v in list(model.items())[::97]:
+            assert bsi.get_value(c) == (v, True)
+        assert bsi.get_value(123456789) == (0, False) or 123456789 in model
+        assert bsi.min_value == min(model.values())
+        assert bsi.max_value == max(model.values())
+
+    def test_point_updates(self):
+        bsi = Roaring64BitmapSliceIndex()
+        bsi.set_value(1 << 35, 42)
+        bsi.set_value(7, (1 << 50) + 3)
+        assert bsi.get_value(1 << 35) == (42, True)
+        assert bsi.get_value(7) == ((1 << 50) + 3, True)
+        bsi.set_value(7, 9)  # overwrite clears old bits
+        assert bsi.get_value(7) == (9, True)
+
+    @pytest.mark.parametrize(
+        "op", [Operation.EQ, Operation.NEQ, Operation.LT, Operation.LE,
+               Operation.GT, Operation.GE]
+    )
+    def test_compare_vs_model(self, op):
+        bsi, model = build64(400)
+        vals = sorted(model.values())
+        for predicate in [vals[0], vals[len(vals) // 2], vals[-1], vals[-1] + 10]:
+            got = set(bsi.compare(op, predicate).to_array().tolist())
+            pyop = {
+                Operation.EQ: lambda v: v == predicate,
+                Operation.NEQ: lambda v: v != predicate,
+                Operation.LT: lambda v: v < predicate,
+                Operation.LE: lambda v: v <= predicate,
+                Operation.GT: lambda v: v > predicate,
+                Operation.GE: lambda v: v >= predicate,
+            }[op]
+            want = {c for c, v in model.items() if pyop(v)}
+            assert got == want, f"{op} {predicate}"
+
+    def test_range_and_found_set(self):
+        bsi, model = build64(400)
+        vals = sorted(model.values())
+        lo, hi = vals[50], vals[300]
+        got = set(bsi.compare(Operation.RANGE, lo, hi).to_array().tolist())
+        want = {c for c, v in model.items() if lo <= v <= hi}
+        assert got == want
+        some_cols = list(model)[::3]
+        fs = Roaring64Bitmap(np.array(some_cols, dtype=np.uint64))
+        got = set(bsi.compare(Operation.GE, lo, 0, fs).to_array().tolist())
+        want = {c for c in some_cols if model[c] >= lo}
+        assert got == want
+
+    def test_sum_topk_transpose(self):
+        bsi, model = build64(300)
+        fs = bsi.get_existence_bitmap()
+        total, count = bsi.sum(fs)
+        assert count == len(model) and total == sum(model.values())
+        k = 25
+        top = bsi.top_k(fs, k)
+        assert top.get_cardinality() == k
+        kth = sorted(model.values(), reverse=True)[k - 1]
+        assert all(model[c] >= kth for c in top.to_array().tolist())
+        tr = bsi.transpose()
+        assert set(tr.to_array().tolist()) == set(model.values())
+        twc = bsi.transpose_with_count()
+        from collections import Counter
+
+        counts = Counter(model.values())
+        for v, n in list(counts.items())[::29]:
+            assert twc.get_value(v) == (n, True)
+
+    def test_add_merge(self):
+        a, ma = build64(150)
+        b = Roaring64BitmapSliceIndex()
+        cols = np.array([c + (1 << 41) for c in list(ma)[:50]], dtype=np.uint64)
+        b.set_values((cols, np.arange(50, dtype=np.uint64)))
+        a2 = a.clone()
+        a2.merge(b)
+        assert a2.get_long_cardinality() == len(ma) + 50
+        c = a.clone()
+        c.add(a)  # doubles every value
+        for col, v in list(ma.items())[::37]:
+            assert c.get_value(col) == (2 * v, True)
+        with pytest.raises(ValueError):
+            a.clone().merge(a)
+
+    def test_serialization_round_trip(self):
+        bsi, _ = build64(200)
+        bsi.run_optimize()
+        data = bsi.serialize()
+        assert len(data) == bsi.serialized_size_in_bytes()
+        back = Roaring64BitmapSliceIndex.deserialize(data)
+        assert back == bsi
+        assert back.min_value == bsi.min_value and back.max_value == bsi.max_value
+        from roaringbitmap_tpu import InvalidRoaringFormat
+
+        with pytest.raises(InvalidRoaringFormat):
+            Roaring64BitmapSliceIndex.deserialize(b"\x01" * 10)
+
+
+class TestBufferTwins:
+    def build(self, n=500):
+        cols = np.unique(rng.integers(0, 1 << 20, size=n).astype(np.uint32))
+        vals = rng.integers(0, 1 << 24, size=cols.size).astype(np.int64)
+        bsi = MutableBitSliceIndex()
+        bsi.set_values((cols, vals))
+        return bsi, dict(zip(cols.tolist(), vals.tolist()))
+
+    def test_named_ranges(self):
+        bsi, model = self.build()
+        mid = sorted(model.values())[len(model) // 2]
+        assert set(bsi.range_lt(None, mid).to_array().tolist()) == {
+            c for c, v in model.items() if v < mid
+        }
+        assert set(bsi.range_ge(None, mid).to_array().tolist()) == {
+            c for c, v in model.items() if v >= mid
+        }
+        lo, hi = sorted(model.values())[10], sorted(model.values())[-10]
+        assert set(bsi.range(None, lo, hi).to_array().tolist()) == {
+            c for c, v in model.items() if lo <= v <= hi
+        }
+        assert bsi.parallel_in(4, Operation.EQ, mid) == bsi.range_eq(None, mid)
+
+    def test_immutable_cast_and_guard(self):
+        bsi, model = self.build(200)
+        imm = bsi.to_immutable_bit_slice_index()
+        assert imm.get_long_cardinality() == len(model)
+        c = next(iter(model))
+        assert imm.get_value(c) == (model[c], True)
+        with pytest.raises(TypeError):
+            imm.set_value(1, 2)
+        with pytest.raises(TypeError):
+            imm.run_optimize()
+        # buffer-parse constructor
+        imm2 = ImmutableBitSliceIndex(bsi.serialize())
+        assert imm2 == imm
+        back = imm2.to_mutable_bit_slice_index()
+        back.set_value(999999, 7)  # mutable again
+        assert back.get_value(999999) == (7, True)
+
+    def test_topk_and_transpose_with_count(self):
+        bsi, model = self.build(300)
+        k = 10
+        top = bsi.top_k(bsi.get_existence_bitmap(), k)
+        kth = sorted(model.values(), reverse=True)[k - 1]
+        assert top.get_cardinality() == k
+        assert all(model[c] >= kth for c in top.to_array().tolist())
+        twc = bsi.parallel_transpose_with_count(None)
+        from collections import Counter
+
+        counts = Counter(model.values())
+        v = next(iter(counts))
+        assert twc.get_value(v) == (counts[v], True)
+
+    def test_mutable_deserialize(self):
+        bsi, _ = self.build(100)
+        back = MutableBitSliceIndex.deserialize(bsi.serialize())
+        assert isinstance(back, MutableBitSliceIndex)
+        assert back == bsi
+        assert back.range_eq(None, bsi.max_value) == bsi.range_eq(None, bsi.max_value)

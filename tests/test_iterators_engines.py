@@ -1,0 +1,140 @@
+"""Iterator layer + distinct aggregation engines (reference oracles:
+TestRoaringBitmap iterator suites, BatchIterator advanceIfNeeded contract
+BatchIterator.java:72, TestFastAggregation equivalence of strategies)."""
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import FastAggregation, ParallelAggregation, RoaringBitmap
+
+rng = np.random.default_rng(0xFEEF1F0)
+
+
+def shape_diverse_bitmap(seed=0):
+    """Sparse + dense + run regions across several keys (SeededTestData-style)."""
+    r = np.random.default_rng(seed)
+    parts = [
+        r.integers(0, 1 << 16, size=300).astype(np.uint32),  # sparse key 0
+        (1 << 16) + np.arange(50000, dtype=np.uint32),  # run key 1
+        (5 << 16) + r.integers(0, 1 << 16, size=9000).astype(np.uint32),  # dense
+        (1000 << 16) + r.integers(0, 1 << 16, size=77).astype(np.uint32),
+    ]
+    bm = RoaringBitmap(np.concatenate(parts))
+    bm.run_optimize()
+    return bm
+
+
+class TestIterators:
+    def test_peekable_forward(self):
+        bm = shape_diverse_bitmap(1)
+        want = bm.to_array().tolist()
+        it = bm.get_int_iterator()
+        got = []
+        while it.has_next():
+            p = it.peek_next()
+            v = it.next()
+            assert p == v
+            got.append(v)
+        assert got == want
+
+    def test_advance_if_needed(self):
+        bm = shape_diverse_bitmap(2)
+        arr = bm.to_array()
+        for target in [0, int(arr[5]), int(arr[arr.size // 2]) - 1, int(arr[-1])]:
+            it = bm.get_int_iterator()
+            it.advance_if_needed(target)
+            nxt = it.next()
+            want = int(arr[np.searchsorted(arr, target)])
+            assert nxt == want, f"target {target}"
+        it = bm.get_int_iterator()
+        it.advance_if_needed(int(arr[-1]) + 1)
+        assert not it.has_next()
+        # advancing backwards is a no-op
+        it = bm.get_int_iterator()
+        for _ in range(10):
+            it.next()
+        tenth = it.peek_next()
+        it.advance_if_needed(0)
+        assert it.peek_next() == tenth
+
+    def test_reverse(self):
+        bm = shape_diverse_bitmap(3)
+        want = bm.to_array()[::-1].tolist()
+        assert list(bm.get_reverse_int_iterator()) == want
+
+    def test_rank_iterator(self):
+        bm = shape_diverse_bitmap(4)
+        it = bm.get_int_rank_iterator()
+        seen = 0
+        while it.has_next() and seen < 500:
+            r = it.peek_next_rank()
+            it.next()
+            seen += 1
+            assert r == seen
+
+    def test_batch_iterator(self):
+        bm = shape_diverse_bitmap(5)
+        want = bm.to_array()
+        it = bm.get_batch_iterator()
+        buf = np.empty(1000, dtype=np.uint32)
+        got = []
+        while it.has_next():
+            n = it.next_batch(buf)
+            got.append(buf[:n].copy())
+        assert np.array_equal(np.concatenate(got), want)
+
+    def test_batch_advance_and_adapter(self):
+        bm = shape_diverse_bitmap(6)
+        arr = bm.to_array()
+        it = bm.get_batch_iterator()
+        target = int(arr[arr.size // 3])
+        it.advance_if_needed(target)
+        buf = np.empty(8, dtype=np.uint32)
+        n = it.next_batch(buf)
+        assert n and int(buf[0]) == target
+        it2 = bm.get_batch_iterator()
+        it2.advance_if_needed(target)
+        assert list(it2.as_int_iterator())[:3] == arr[
+            np.searchsorted(arr, target) :
+        ][:3].tolist()
+
+
+class TestEngines:
+    """All OR/XOR/AND strategies agree (TestFastAggregation invariants)."""
+
+    def setup_method(self):
+        self.bms = [shape_diverse_bitmap(s) for s in range(8)] + [RoaringBitmap()]
+
+    def test_or_strategies_agree(self):
+        want = FastAggregation.or_(*self.bms, mode="cpu")
+        assert FastAggregation.naive_or(*self.bms) == want
+        assert FastAggregation.horizontal_or(*self.bms) == want
+        assert FastAggregation.priorityqueue_or(*self.bms) == want
+        assert ParallelAggregation.or_(*self.bms, mode="cpu") == want
+
+    def test_xor_strategies_agree(self):
+        want = FastAggregation.xor(*self.bms, mode="cpu")
+        assert FastAggregation.naive_xor(*self.bms) == want
+        assert FastAggregation.horizontal_xor(*self.bms) == want
+        assert ParallelAggregation.xor(*self.bms, mode="cpu") == want
+
+    def test_and_strategies_agree(self):
+        dense = [shape_diverse_bitmap(s) for s in range(4)]
+        want = FastAggregation.and_(*dense, mode="cpu")
+        assert FastAggregation.naive_and(*dense) == want
+        assert FastAggregation.workshy_and(*dense, mode="cpu") == want
+
+    def test_empty_and_single(self):
+        assert FastAggregation.horizontal_or().is_empty()
+        assert FastAggregation.priorityqueue_or().is_empty()
+        one = shape_diverse_bitmap(9)
+        assert FastAggregation.priorityqueue_or(one) == one
+        assert FastAggregation.naive_or(one) == one
+
+    def test_cardinality_shortcuts(self):
+        assert FastAggregation.or_cardinality(*self.bms) == FastAggregation.or_(
+            *self.bms
+        ).get_cardinality()
+        assert FastAggregation.and_cardinality(*self.bms[:3]) == FastAggregation.and_(
+            *self.bms[:3]
+        ).get_cardinality()
