@@ -28,6 +28,11 @@ from .models.art import Art
 from .models.bitset import RoaringBitSet
 from .models.fastrank import FastRankRoaringBitmap
 from .models.immutable import ImmutableRoaringBitmap
+from .models.buffer import (
+    BufferFastAggregation,
+    BufferParallelAggregation,
+    MutableRoaringBitmap,
+)
 from .models.writer import RoaringBitmapWriter
 from .models.bsi import Operation, RoaringBitmapSliceIndex
 from .models.bsi64 import Roaring64BitmapSliceIndex
@@ -44,11 +49,6 @@ from .serialization import InvalidRoaringFormat
 from .parallel.aggregation import FastAggregation, ParallelAggregation
 from . import insights
 from . import fuzz
-
-# MutableRoaringBitmap: the reference's buffer twin of the mutable facade
-# (buffer/MutableRoaringBitmap.java). Here the heap/buffer split collapses
-# (see models/immutable.py) so it is the same class.
-MutableRoaringBitmap = RoaringBitmap
 
 __version__ = "0.1.0"
 
@@ -81,6 +81,8 @@ __all__ = [
     "BatchIntIterator",
     "FastAggregation",
     "ParallelAggregation",
+    "BufferFastAggregation",
+    "BufferParallelAggregation",
     "insights",
     "fuzz",
 ]

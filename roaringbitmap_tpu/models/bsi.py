@@ -65,6 +65,13 @@ def values_for_columns(cols: np.ndarray, slices, dtype=np.int64) -> np.ndarray:
     return values
 
 
+def transpose_value_counts(cols: np.ndarray, slices, dtype=np.int64):
+    """(distinct values, multiplicities) over the given columns — the shared
+    body of every transposeWithCount twin (BitSliceIndexBase.java:578,
+    Roaring64BitmapSliceIndex.java:603)."""
+    return np.unique(values_for_columns(cols, slices, dtype=dtype), return_counts=True)
+
+
 class RoaringBitmapSliceIndex:
     """32-bit-value BSI over 32-bit column ids (RoaringBitmapSliceIndex.java)."""
 

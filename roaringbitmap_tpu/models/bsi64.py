@@ -91,7 +91,11 @@ class Roaring64BitmapSliceIndex:
             vals = [p[1] for p in seq]
         cols = np.asarray(cols, dtype=np.uint64)
         vals_arr = np.asarray(vals)
-        if np.issubdtype(vals_arr.dtype, np.signedinteger) and vals_arr.size and vals_arr.min() < 0:
+        if (
+            vals_arr.size
+            and not np.issubdtype(vals_arr.dtype, np.unsignedinteger)
+            and vals_arr.min() < 0
+        ):
             raise ValueError("BSI values must be non-negative")
         vals = vals_arr.astype(np.uint64)
         if cols.size == 0:
@@ -311,7 +315,9 @@ class Roaring64BitmapSliceIndex:
     def top_k(self, found_set: Optional[Roaring64Bitmap], k: int) -> Roaring64Bitmap:
         """Columns holding the k largest values — slice descent from the
         MSB (Roaring64BitmapSliceIndex.java:572)."""
-        if found_set is None or found_set.is_empty() or k <= 0:
+        if found_set is None:
+            found_set = self.ebm
+        if found_set.is_empty() or k <= 0:
             return Roaring64Bitmap()
         if k >= found_set.get_cardinality():
             return found_set.clone()
@@ -362,11 +368,9 @@ class Roaring64BitmapSliceIndex:
         out = Roaring64BitmapSliceIndex()
         if cols.size == 0:
             return out
-        from .bsi import values_for_columns
+        from .bsi import transpose_value_counts
 
-        uniq, counts = np.unique(
-            values_for_columns(cols, self.slices, dtype=np.uint64), return_counts=True
-        )
+        uniq, counts = transpose_value_counts(cols, self.slices, dtype=np.uint64)
         out.set_values((uniq, counts.astype(np.uint64)))
         return out
 

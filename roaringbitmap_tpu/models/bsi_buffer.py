@@ -86,11 +86,9 @@ class MutableBitSliceIndex(RoaringBitmapSliceIndex):
         out = MutableBitSliceIndex()
         if cols.size == 0:
             return out
-        from .bsi import values_for_columns
+        from .bsi import transpose_value_counts
 
-        uniq, counts = np.unique(
-            values_for_columns(cols, self.slices), return_counts=True
-        )
+        uniq, counts = transpose_value_counts(cols, self.slices)
         out.set_values((uniq.astype(np.uint32), counts.astype(np.int64)))
         return out
 

@@ -1,0 +1,286 @@
+"""Buffer-package twins (org.roaringbitmap.buffer, SURVEY §2.2).
+
+The reference re-implements its whole container hierarchy over ``java.nio``
+buffers (17k LoC: MappeableContainer.java:19, MutableRoaringBitmap.java,
+BufferFastAggregation.java:20, BufferParallelAggregation.java:41) so bitmaps
+can live off-heap / memory-mapped. The TPU-native design collapses the twin
+hierarchy: ``ImmutableRoaringBitmap`` (models/immutable.py) already
+materializes zero-copy numpy views over the serialized buffer that satisfy
+the ordinary ``Container`` protocol, so ONE algebra serves both worlds.
+
+This module supplies the remaining public surface of the buffer package:
+
+* ``MutableRoaringBitmap`` — the writable buffer-world facade
+  (buffer/MutableRoaringBitmap.java), castable to an immutable view in O(1)
+  (README.md:205-207) and constructible from one.
+* Mixed-operand pairwise algebra — ``and_``/``or_``/``xor``/``andnot``/
+  ``or_not`` and the cardinality variants accept any combination of heap
+  ``RoaringBitmap``, ``MutableRoaringBitmap`` and mapped
+  ``ImmutableRoaringBitmap`` operands, exactly like the reference's
+  ImmutableRoaringBitmap static ops (buffer/ImmutableRoaringBitmap.java).
+* ``BufferFastAggregation`` (BufferFastAggregation.java:20) /
+  ``BufferParallelAggregation`` (BufferParallelAggregation.java:41) — the
+  N-way engines over mixed/mapped inputs, including the workShy AND
+  dispatch (BufferFastAggregation.java:29-33). They reuse the batched
+  CPU/TPU engines of parallel/aggregation.py unchanged: mapped containers
+  are packed to the device straight from their buffer views.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Union
+
+from .container import Container
+from .immutable import ImmutableRoaringBitmap
+from .roaring import RoaringBitmap
+
+AnyRoaring = Union[RoaringBitmap, ImmutableRoaringBitmap]
+
+
+def _flatten_mixed(bitmaps) -> List[AnyRoaring]:
+    from ..parallel.aggregation import _flatten
+
+    return _flatten(bitmaps)
+
+
+class MutableRoaringBitmap(RoaringBitmap):
+    """Writable buffer-world bitmap (buffer/MutableRoaringBitmap.java).
+
+    Same algebra and mutation API as :class:`RoaringBitmap` (inherited);
+    adds the buffer-world casts. ``to_immutable`` serializes once and wraps
+    the bytes zero-copy; ``as_immutable_view`` is the reference's O(1) cast
+    (README.md:205-207) — a read-only facade over the *live* containers
+    (safe for concurrent reads while unmutated, the documented contract,
+    README.md:280).
+    """
+
+    @staticmethod
+    def _adopt(rb: RoaringBitmap) -> "MutableRoaringBitmap":
+        out = MutableRoaringBitmap()
+        out.high_low_container = rb.high_low_container
+        return out
+
+    @staticmethod
+    def of(source: AnyRoaring) -> "MutableRoaringBitmap":
+        """Deep-copy construction from heap or mapped bitmap."""
+        if isinstance(source, ImmutableRoaringBitmap):
+            return MutableRoaringBitmap._adopt(source.to_mutable())
+        return MutableRoaringBitmap._adopt(source.clone())
+
+    def to_immutable(self) -> ImmutableRoaringBitmap:
+        """Freeze into a buffer-backed immutable (one serialization pass)."""
+        return ImmutableRoaringBitmap(self.serialize())
+
+    def as_immutable_view(self) -> "ImmutableView":
+        """O(1) cast to a read-only view sharing this bitmap's containers."""
+        return ImmutableView(self)
+
+    @staticmethod
+    def deserialize(data) -> "MutableRoaringBitmap":
+        return MutableRoaringBitmap._adopt(RoaringBitmap.deserialize(data))
+
+    # -- mixed-operand pairwise algebra (ImmutableRoaringBitmap statics) ---
+    @staticmethod
+    def and_(x1: AnyRoaring, x2: AnyRoaring) -> "MutableRoaringBitmap":
+        return MutableRoaringBitmap._adopt(RoaringBitmap.and_(x1, x2))
+
+    @staticmethod
+    def or_(x1: AnyRoaring, x2: AnyRoaring) -> "MutableRoaringBitmap":
+        return MutableRoaringBitmap._adopt(RoaringBitmap.or_(x1, x2))
+
+    @staticmethod
+    def xor(x1: AnyRoaring, x2: AnyRoaring) -> "MutableRoaringBitmap":
+        return MutableRoaringBitmap._adopt(RoaringBitmap.xor(x1, x2))
+
+    @staticmethod
+    def andnot(x1: AnyRoaring, x2: AnyRoaring) -> "MutableRoaringBitmap":
+        return MutableRoaringBitmap._adopt(RoaringBitmap.andnot(x1, x2))
+
+    @staticmethod
+    def or_not(x1: AnyRoaring, x2: AnyRoaring, range_end: int) -> "MutableRoaringBitmap":
+        return MutableRoaringBitmap._adopt(RoaringBitmap.or_not(x1, x2, range_end))
+
+    @staticmethod
+    def and_cardinality(x1: AnyRoaring, x2: AnyRoaring) -> int:
+        return RoaringBitmap.and_cardinality(x1, x2)
+
+    @staticmethod
+    def or_cardinality(x1: AnyRoaring, x2: AnyRoaring) -> int:
+        return RoaringBitmap.or_cardinality(x1, x2)
+
+    @staticmethod
+    def xor_cardinality(x1: AnyRoaring, x2: AnyRoaring) -> int:
+        return RoaringBitmap.xor_cardinality(x1, x2)
+
+    @staticmethod
+    def andnot_cardinality(x1: AnyRoaring, x2: AnyRoaring) -> int:
+        return RoaringBitmap.andnot_cardinality(x1, x2)
+
+    @staticmethod
+    def intersects(x1: AnyRoaring, x2: AnyRoaring) -> bool:
+        return RoaringBitmap.intersects(x1, x2)
+
+    def __repr__(self) -> str:
+        return f"MutableRoaringBitmap(card={self.get_cardinality()})"
+
+
+class ImmutableView:
+    """O(1) read-only cast of a live MutableRoaringBitmap
+    (MutableRoaringBitmap→ImmutableRoaringBitmap upcast, README.md:205-207).
+
+    Shares the underlying containers — no copy, no serialization. Exposes
+    the read API plus ``high_low_container`` so it interoperates with all
+    algebra/aggregation engines as an operand.
+    """
+
+    __slots__ = ("_bm",)
+
+    def __init__(self, bm: RoaringBitmap):
+        self._bm = bm
+
+    @property
+    def high_low_container(self):
+        return self._bm.high_low_container
+
+    def __getattr__(self, name):
+        # read-only delegation: block the mutating surface
+        if name in _MUTATORS:
+            raise AttributeError(f"ImmutableView is read-only (no {name})")
+        return getattr(self._bm, name)
+
+    def __iter__(self):
+        return iter(self._bm)
+
+    def __contains__(self, x):
+        return x in self._bm
+
+    def __len__(self):
+        return len(self._bm)
+
+    def __eq__(self, other):
+        return self._bm == other
+
+    def __hash__(self):
+        return hash(self._bm)
+
+    def __repr__(self):
+        return f"ImmutableView({self._bm!r})"
+
+
+_MUTATORS = frozenset(
+    {
+        "add",
+        "checked_add",
+        "add_many",
+        "remove",
+        "checked_remove",
+        "add_range",
+        "remove_range",
+        "flip_range",
+        "ior",
+        "iand",
+        "ixor",
+        "iandnot",
+        "run_optimize",
+        "remove_run_compression",
+    }
+)
+
+
+class BufferFastAggregation:
+    """N-way aggregation over mixed heap/mapped operands
+    (BufferFastAggregation.java:20). Same engine + dispatch as
+    FastAggregation — including workShy key-intersection AND for many
+    inputs (BufferFastAggregation.java:29-33) and the CPU-vs-TPU batched
+    dispatcher; mapped containers stream to the device from their buffer
+    views without deserialization."""
+
+    @staticmethod
+    def and_(*bitmaps: AnyRoaring, mode: Optional[str] = None) -> MutableRoaringBitmap:
+        from ..parallel.aggregation import _aggregate
+
+        return MutableRoaringBitmap._adopt(_aggregate(_flatten_mixed(bitmaps), "and", mode))
+
+    @staticmethod
+    def or_(*bitmaps: AnyRoaring, mode: Optional[str] = None) -> MutableRoaringBitmap:
+        from ..parallel.aggregation import _aggregate
+
+        return MutableRoaringBitmap._adopt(_aggregate(_flatten_mixed(bitmaps), "or", mode))
+
+    @staticmethod
+    def xor(*bitmaps: AnyRoaring, mode: Optional[str] = None) -> MutableRoaringBitmap:
+        from ..parallel.aggregation import _aggregate
+
+        return MutableRoaringBitmap._adopt(_aggregate(_flatten_mixed(bitmaps), "xor", mode))
+
+    @staticmethod
+    def naive_or(*bitmaps: AnyRoaring) -> MutableRoaringBitmap:
+        from ..parallel.aggregation import FastAggregation
+
+        return MutableRoaringBitmap._adopt(FastAggregation.naive_or(*_flatten_mixed(bitmaps)))
+
+    @staticmethod
+    def naive_and(*bitmaps: AnyRoaring) -> MutableRoaringBitmap:
+        from ..parallel.aggregation import FastAggregation
+
+        return MutableRoaringBitmap._adopt(FastAggregation.naive_and(*_flatten_mixed(bitmaps)))
+
+    @staticmethod
+    def horizontal_or(*bitmaps: AnyRoaring) -> MutableRoaringBitmap:
+        from ..parallel.aggregation import FastAggregation
+
+        return MutableRoaringBitmap._adopt(
+            FastAggregation.horizontal_or(*_flatten_mixed(bitmaps))
+        )
+
+    @staticmethod
+    def priorityqueue_or(*bitmaps: AnyRoaring) -> MutableRoaringBitmap:
+        from ..parallel.aggregation import FastAggregation
+
+        return MutableRoaringBitmap._adopt(
+            FastAggregation.priorityqueue_or(*_flatten_mixed(bitmaps))
+        )
+
+    @staticmethod
+    def workshy_and(*bitmaps: AnyRoaring, mode: Optional[str] = None) -> MutableRoaringBitmap:
+        return BufferFastAggregation.and_(*bitmaps, mode=mode)
+
+    @staticmethod
+    def and_cardinality(*bitmaps: AnyRoaring) -> int:
+        return BufferFastAggregation.and_(*bitmaps).get_cardinality()
+
+    @staticmethod
+    def or_cardinality(*bitmaps: AnyRoaring) -> int:
+        return BufferFastAggregation.or_(*bitmaps).get_cardinality()
+
+
+class BufferParallelAggregation:
+    """Fork-join OR/XOR over mixed/mapped operands
+    (BufferParallelAggregation.java:41): key-major transpose + pooled
+    per-key reduction on CPU, or the single batched device kernel."""
+
+    @staticmethod
+    def group_by_key(*bitmaps: AnyRoaring) -> Dict[int, List[Container]]:
+        from ..parallel import store
+
+        return store.group_by_key(_flatten_mixed(bitmaps))
+
+    @staticmethod
+    def or_(*bitmaps: AnyRoaring, mode: Optional[str] = None) -> MutableRoaringBitmap:
+        from ..parallel.aggregation import ParallelAggregation
+
+        return MutableRoaringBitmap._adopt(
+            ParallelAggregation.or_(*_flatten_mixed(bitmaps), mode=mode)
+        )
+
+    @staticmethod
+    def xor(*bitmaps: AnyRoaring, mode: Optional[str] = None) -> MutableRoaringBitmap:
+        from ..parallel.aggregation import ParallelAggregation
+
+        return MutableRoaringBitmap._adopt(
+            ParallelAggregation.xor(*_flatten_mixed(bitmaps), mode=mode)
+        )
+
+    @staticmethod
+    def and_(*bitmaps: AnyRoaring, mode: Optional[str] = None) -> MutableRoaringBitmap:
+        return BufferFastAggregation.and_(*bitmaps, mode=mode)

@@ -37,6 +37,75 @@ from .roaring import RoaringBitmap
 Source = Union[bytes, bytearray, memoryview, _mmap.mmap, np.ndarray]
 
 
+class ImmutableRoaringArray:
+    """PointableRoaringArray over a mapped bitmap
+    (buffer/ImmutableRoaringArray.java:43, PointableRoaringArray.java:15):
+    the key index lives in the parsed header; containers are materialized
+    lazily as zero-copy buffer views (memoized) so the whole pairwise and
+    N-way algebra runs directly on the serialized form.
+    """
+
+    __slots__ = ("_bm", "keys", "_cache")
+
+    def __init__(self, bm: "ImmutableRoaringBitmap"):
+        self._bm = bm
+        self.keys = bm._keys.tolist()
+        self._cache: dict = {}
+
+    @property
+    def size(self) -> int:
+        return self._bm._size
+
+    @property
+    def containers(self) -> "_LazyContainers":
+        return _LazyContainers(self)
+
+    def get_index(self, key: int) -> int:
+        i = int(np.searchsorted(self._bm._keys, key))
+        if i < self._bm._size and self.keys[i] == key:
+            return i
+        return -i - 1
+
+    def get_key_at_index(self, i: int) -> int:
+        return self.keys[i]
+
+    def get_container_at_index(self, i: int) -> Container:
+        c = self._cache.get(i)
+        if c is None:
+            c = self._bm._container(i)
+            self._cache[i] = c
+        return c
+
+    def advance_until(self, key: int, pos: int) -> int:
+        """Exponential + binary search (ImmutableRoaringArray advanceUntil,
+        PointableRoaringArray.java:25)."""
+        from bisect import bisect_left
+
+        return bisect_left(self.keys, key, pos + 1)
+
+    def items(self):
+        return [(self.keys[i], self.get_container_at_index(i)) for i in range(self.size)]
+
+
+class _LazyContainers:
+    """Sequence view over an ImmutableRoaringArray's containers."""
+
+    __slots__ = ("_arr",)
+
+    def __init__(self, arr: ImmutableRoaringArray):
+        self._arr = arr
+
+    def __len__(self):
+        return self._arr.size
+
+    def __getitem__(self, i):
+        return self._arr.get_container_at_index(i)
+
+    def __iter__(self):
+        for i in range(self._arr.size):
+            yield self._arr.get_container_at_index(i)
+
+
 class ImmutableRoaringBitmap:
     """Read-only bitmap over a serialized buffer (buffer/ImmutableRoaringBitmap).
 
@@ -44,7 +113,7 @@ class ImmutableRoaringBitmap:
     zero-copy numpy views into the source buffer.
     """
 
-    __slots__ = ("_buf", "_keys", "_cards", "_types", "_offsets", "_size")
+    __slots__ = ("_buf", "_keys", "_cards", "_types", "_offsets", "_size", "_hlc")
 
     ARRAY, BITMAP, RUN = 0, 1, 2
 
@@ -110,6 +179,7 @@ class ImmutableRoaringBitmap:
                 p += self._payload_len(i, p)
             self._offsets = offsets
         self._size = size
+        self._hlc = None
         # validate payload extents
         for i in range(size):
             end = self._offsets[i] + self._payload_len(i, int(self._offsets[i]))
@@ -151,6 +221,59 @@ class ImmutableRoaringBitmap:
     # ------------------------------------------------------------------
     # read API (ImmutableBitmapDataProvider surface)
     # ------------------------------------------------------------------
+    @property
+    def high_low_container(self) -> ImmutableRoaringArray:
+        """Zero-copy PointableRoaringArray view — makes a mapped bitmap a
+        first-class operand of every pairwise op and aggregation engine."""
+        if self._hlc is None:
+            self._hlc = ImmutableRoaringArray(self)
+        return self._hlc
+
+    def clone(self) -> RoaringBitmap:
+        """Deep copy; the writable result matches the engines' contract that
+        ``clone()`` of an operand may be mutated."""
+        return self.to_mutable()
+
+    def get_size_in_bytes(self) -> int:
+        if not self._size:
+            return 8
+        return int(self._offsets[-1]) + self._payload_len(
+            self._size - 1, int(self._offsets[-1])
+        )
+
+    def serialized_size_in_bytes(self) -> int:
+        return self.get_size_in_bytes()
+
+    # -- mixed-operand pairwise algebra (buffer/ImmutableRoaringBitmap
+    #    statics; operands may be heap RoaringBitmap or mapped) ----------
+    @staticmethod
+    def and_(x1, x2) -> RoaringBitmap:
+        return RoaringBitmap.and_(x1, x2)
+
+    @staticmethod
+    def or_(x1, x2) -> RoaringBitmap:
+        return RoaringBitmap.or_(x1, x2)
+
+    @staticmethod
+    def xor(x1, x2) -> RoaringBitmap:
+        return RoaringBitmap.xor(x1, x2)
+
+    @staticmethod
+    def andnot(x1, x2) -> RoaringBitmap:
+        return RoaringBitmap.andnot(x1, x2)
+
+    @staticmethod
+    def and_cardinality(x1, x2) -> int:
+        return RoaringBitmap.and_cardinality(x1, x2)
+
+    @staticmethod
+    def or_cardinality(x1, x2) -> int:
+        return RoaringBitmap.or_cardinality(x1, x2)
+
+    @staticmethod
+    def intersects(x1, x2) -> bool:
+        return RoaringBitmap.intersects(x1, x2)
+
     def get_cardinality(self) -> int:
         return int(self._cards.sum())
 

@@ -351,7 +351,8 @@ class FastAggregation:
 
 
 def _flatten(bitmaps) -> List[RoaringBitmap]:
-    if len(bitmaps) == 1 and not isinstance(bitmaps[0], RoaringBitmap):
+    # single non-bitmap argument = an iterable of bitmaps (heap or mapped)
+    if len(bitmaps) == 1 and not hasattr(bitmaps[0], "high_low_container"):
         return list(bitmaps[0])
     return list(bitmaps)
 

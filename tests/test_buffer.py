@@ -1,0 +1,154 @@
+"""Buffer-package twins: mixed-operand algebra over mapped bitmaps.
+
+Oracle: heap vs buffer equivalence (SURVEY §4 — the reference's tests
+assert heap/buffer variants agree; buffer/BufferFastAggregation.java,
+buffer/MutableRoaringBitmap.java).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import (
+    BufferFastAggregation,
+    BufferParallelAggregation,
+    FastAggregation,
+    ImmutableRoaringBitmap,
+    MutableRoaringBitmap,
+    RoaringBitmap,
+)
+from roaringbitmap_tpu.fuzz import random_bitmap
+
+
+def _mapped(bm: RoaringBitmap) -> ImmutableRoaringBitmap:
+    return ImmutableRoaringBitmap(bm.serialize())
+
+
+@pytest.fixture(scope="module")
+def pairs():
+    rng = np.random.default_rng(0xB0FF)
+    return [(random_bitmap(rng), random_bitmap(rng)) for _ in range(8)]
+
+
+@pytest.mark.parametrize("op", ["and_", "or_", "xor", "andnot"])
+def test_mixed_pairwise_matches_heap(pairs, op):
+    for a, b in pairs:
+        want = getattr(RoaringBitmap, op)(a, b)
+        ia, ib = _mapped(a), _mapped(b)
+        # immutable x immutable, immutable x heap, heap x immutable
+        for x, y in ((ia, ib), (ia, b), (a, ib)):
+            got = getattr(MutableRoaringBitmap, op)(x, y)
+            assert got == want
+            assert isinstance(got, MutableRoaringBitmap)
+
+
+@pytest.mark.parametrize(
+    "name", ["and_cardinality", "or_cardinality", "xor_cardinality", "andnot_cardinality"]
+)
+def test_mixed_cardinality_variants(pairs, name):
+    for a, b in pairs:
+        want = getattr(RoaringBitmap, name)(a, b)
+        assert getattr(MutableRoaringBitmap, name)(_mapped(a), _mapped(b)) == want
+
+
+def test_intersects_mixed(pairs):
+    for a, b in pairs:
+        assert MutableRoaringBitmap.intersects(_mapped(a), b) == RoaringBitmap.intersects(a, b)
+
+
+def test_immutable_static_algebra(pairs):
+    a, b = pairs[0]
+    assert ImmutableRoaringBitmap.and_(_mapped(a), _mapped(b)) == RoaringBitmap.and_(a, b)
+    assert ImmutableRoaringBitmap.or_(_mapped(a), b) == RoaringBitmap.or_(a, b)
+
+
+def test_buffer_fast_aggregation_matches_heap(pairs):
+    heap = [bm for pair in pairs for bm in pair]
+    mapped = [_mapped(bm) for bm in heap]
+    mixed = [m if i % 2 else h for i, (h, m) in enumerate(zip(heap, mapped))]
+    for engine, ref in [
+        (BufferFastAggregation.or_, FastAggregation.or_),
+        (BufferFastAggregation.and_, FastAggregation.and_),
+        (BufferFastAggregation.xor, FastAggregation.xor),
+        (BufferFastAggregation.naive_or, FastAggregation.naive_or),
+        (BufferFastAggregation.horizontal_or, FastAggregation.horizontal_or),
+        (BufferFastAggregation.priorityqueue_or, FastAggregation.priorityqueue_or),
+        (BufferFastAggregation.naive_and, FastAggregation.naive_and),
+    ]:
+        want = ref(*heap)
+        assert engine(*mapped) == want
+        assert engine(*mixed) == want
+    assert BufferFastAggregation.or_cardinality(*mapped) == FastAggregation.or_(
+        *heap
+    ).get_cardinality()
+    assert BufferFastAggregation.and_cardinality(*mapped) == FastAggregation.and_(
+        *heap
+    ).get_cardinality()
+
+
+def test_buffer_fast_aggregation_single_iterable_arg(pairs):
+    heap = [a for a, _ in pairs]
+    mapped = [_mapped(bm) for bm in heap]
+    assert BufferFastAggregation.or_(mapped) == FastAggregation.or_(heap)
+    # single mapped operand must not be mis-iterated as a list of bitmaps
+    assert BufferFastAggregation.or_(mapped[0]) == heap[0]
+
+
+def test_buffer_parallel_aggregation(pairs):
+    heap = [bm for pair in pairs for bm in pair]
+    mapped = [_mapped(bm) for bm in heap]
+    assert BufferParallelAggregation.or_(*mapped) == FastAggregation.or_(*heap)
+    assert BufferParallelAggregation.xor(*mapped) == FastAggregation.xor(*heap)
+    groups = BufferParallelAggregation.group_by_key(*mapped)
+    assert sum(len(v) for v in groups.values()) == sum(
+        bm.get_container_count() for bm in heap
+    )
+
+
+def test_buffer_aggregation_device_mode(pairs):
+    heap = [bm for pair in pairs for bm in pair]
+    mapped = [_mapped(bm) for bm in heap]
+    want = FastAggregation.or_(*heap, mode="cpu")
+    assert BufferFastAggregation.or_(*mapped, mode="device") == want
+    assert BufferParallelAggregation.or_(*mapped, mode="device") == want
+
+
+def test_mutable_roundtrip_and_casts(pairs):
+    a, _ = pairs[0]
+    m = MutableRoaringBitmap.of(a)
+    assert m == a
+    m.add(123456789)
+    assert a != m  # deep copy
+    imm = m.to_immutable()
+    assert imm == m
+    assert imm.serialize() == m.serialize()
+    back = MutableRoaringBitmap.deserialize(imm.serialize())
+    assert back == m
+
+
+def test_immutable_view_o1_cast(pairs):
+    a, _ = pairs[0]
+    m = MutableRoaringBitmap.of(a)
+    v = m.as_immutable_view()
+    assert v.get_cardinality() == m.get_cardinality()
+    assert v.contains(next(iter(m)))
+    with pytest.raises(AttributeError):
+        v.add(42)
+    # the view is live: mutations through the mutable are visible
+    m.add(987654321)
+    assert v.contains(987654321)
+    # views interoperate as operands
+    assert RoaringBitmap.and_(v, m) == m
+
+
+def test_mapped_file_algebra(tmp_path, pairs):
+    a, b = pairs[0]
+    pa, pb = tmp_path / "a.bin", tmp_path / "b.bin"
+    pa.write_bytes(a.serialize())
+    pb.write_bytes(b.serialize())
+    ma = ImmutableRoaringBitmap.map_file(str(pa))
+    mb = ImmutableRoaringBitmap.map_file(str(pb))
+    assert MutableRoaringBitmap.or_(ma, mb) == RoaringBitmap.or_(a, b)
+    assert ma.clone() == a
+    assert ma.get_size_in_bytes() == os.path.getsize(pa)
