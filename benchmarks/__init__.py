@@ -1,0 +1,36 @@
+"""Benchmark harnesses mirroring the reference's perf modules.
+
+The reference externalizes all performance work to two modules
+(SURVEY.md §5 "Tracing / profiling"):
+
+* ``jmh/`` — 128 JMH suites (realdata wide-OR/AND, per-op matrices,
+  iteration, serialization, RangeBitmap, ParallelAggregation, writer,
+  runcontainer; jmh/run.sh drives them with ``-wi 5 -i 5 -f 1``).
+* ``simplebenchmark/`` — dependency-free min-of-100-reps nanos harness
+  over the real datasets (simplebenchmark.java:52-112).
+
+This package is the TPU build's twin: one suite module per jmh suite
+family over the same real-roaring-dataset corpora, a ``simplebenchmark``
+clone, and a CLI runner (``python -m benchmarks.run``) that emits one
+JSON line per measurement.  Optional ``--profile`` wraps timed sections
+in ``jax.profiler.trace`` so device work is inspectable in TensorBoard —
+the tracing story the reference delegates to JMH's infra.
+
+Smoke-testing strategy follows jmh/src/test (RealDataBenchmark*Test):
+``tests/test_benchmarks.py`` runs every suite with tiny reps and asserts
+each benchmark's aggregation output matches a naive reference before any
+timing is trusted.
+"""
+
+from . import common  # noqa: F401
+
+SUITES = [
+    "realdata",
+    "ops",
+    "iteration",
+    "serialization",
+    "rangebitmap",
+    "writer",
+    "runcontainer",
+    "bsi",
+]

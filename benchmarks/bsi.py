@@ -1,0 +1,61 @@
+"""BSI suites — the bit-sliced-index range-query workload
+(BASELINE.md: "bsi/ 32-slice range query → TPU AND-chain"; reference
+bsi/.../RoaringBitmapSliceIndex.java:432-513 O'Neil compare, :581 sum).
+
+Builds a BSI over a synthetic int column and times EQ/GT/LT/RANGE
+compares (CPU path vs the fused device O'Neil kernel chain), sum, and
+top_k — the filtered-range-query north-star family.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from roaringbitmap_tpu import RoaringBitmap
+from roaringbitmap_tpu.models.bsi import Operation, RoaringBitmapSliceIndex
+
+from . import common
+from .common import Result
+
+N_ROWS = 1_000_000
+
+
+def _build(seed=0xFEEF1F0):
+    rng = np.random.default_rng(seed)
+    cols = np.arange(N_ROWS, dtype=np.int64)
+    vals = rng.integers(0, 1 << 31, size=N_ROWS).astype(np.int64)
+    bsi = RoaringBitmapSliceIndex()
+    bsi.set_values(list(zip(cols.tolist(), vals.tolist())))
+    found = RoaringBitmap(
+        rng.choice(N_ROWS, size=N_ROWS // 20, replace=False).astype(np.uint32)
+    )
+    return bsi, found, vals
+
+
+def run(reps: int = 5, **_) -> List[Result]:
+    bsi, found, vals = _build()
+    med = int(np.median(vals))
+    out = []
+
+    def bench(name, fn):
+        out.append(
+            Result(name, "synthetic-1M", common.min_of(reps, fn), "ns/op", {"rows": N_ROWS})
+        )
+
+    for mode in ("cpu", "device"):
+        bench(f"compareGE_{mode}", lambda m=mode: bsi.compare(Operation.GE, med, 0, None, mode=m))
+        bench(f"compareLT_{mode}", lambda m=mode: bsi.compare(Operation.LT, med, 0, None, mode=m))
+        bench(
+            f"compareRange_{mode}",
+            lambda m=mode: bsi.compare(Operation.RANGE, med // 2, med * 2, None, mode=m),
+        )
+        bench(
+            f"compareGEFiltered_{mode}",
+            lambda m=mode: bsi.compare(Operation.GE, med, 0, found, mode=m),
+        )
+    bench("compareEQ", lambda: bsi.compare(Operation.EQ, med, 0, None))
+    bench("sum", lambda: bsi.sum(found))
+    bench("topK", lambda: bsi.top_k(found, 100))
+    return out

@@ -1,0 +1,89 @@
+"""Shared benchmark plumbing: timing, corpus cache, result records.
+
+Timing follows simplebenchmark.java:76-83 — take the *minimum* over a
+number of repetitions (noise on a shared machine only ever adds time).
+Suites report nanoseconds per operation like their jmh counterparts.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from roaringbitmap_tpu import RoaringBitmap
+from roaringbitmap_tpu.utils import datasets
+
+DEFAULT_DATASETS = ["census1881", "census1881_srt", "uscensus2000", "wikileaks-noquotes"]
+
+
+@dataclass
+class Result:
+    benchmark: str
+    dataset: str
+    value: float
+    unit: str
+    extra: Dict = field(default_factory=dict)
+
+    def json(self) -> str:
+        rec = {
+            "benchmark": self.benchmark,
+            "dataset": self.dataset,
+            "value": round(self.value, 3),
+            "unit": self.unit,
+        }
+        rec.update(self.extra)
+        return json.dumps(rec)
+
+
+def min_of(reps: int, fn: Callable[[], object]) -> float:
+    """Best-of-reps wall time of fn() in nanoseconds."""
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter_ns()
+        fn()
+        best = min(best, time.perf_counter_ns() - t0)
+    return float(best)
+
+
+_corpus_cache: Dict[str, List[np.ndarray]] = {}
+
+
+def corpus(name: str, limit: Optional[int] = None) -> List[np.ndarray]:
+    """Bit-position arrays of a corpus (real when mounted, else seeded
+    synthetic — datasets.load_or_synthesize)."""
+    if name not in _corpus_cache:
+        _corpus_cache[name], _ = datasets.load_or_synthesize(name)
+    vals = _corpus_cache[name]
+    return vals[:limit] if limit else vals
+
+
+_bitmap_cache: Dict[str, List[RoaringBitmap]] = {}
+
+
+def corpus_bitmaps(name: str, limit: Optional[int] = None, optimize: bool = True):
+    key = f"{name}:{optimize}"
+    if key not in _bitmap_cache:
+        bms = [RoaringBitmap(v) for v in corpus(name)]
+        if optimize:
+            for b in bms:
+                b.run_optimize()
+        _bitmap_cache[key] = bms
+    bms = _bitmap_cache[key]
+    return bms[:limit] if limit else bms
+
+
+@contextlib.contextmanager
+def maybe_profile(enabled: bool, logdir: str = "/tmp/rb_tpu_trace"):
+    """jax.profiler trace around a timed section (SURVEY.md §5 tracing)."""
+    if not enabled:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(logdir):
+        yield

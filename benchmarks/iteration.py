@@ -1,0 +1,55 @@
+"""Iteration suites — twin of jmh iteration benchmarks
+(jmh/src/jmh/.../iteration/: IteratorsBenchmark, BatchIteratorsBenchmark,
+advance/rank iterator suites over realdata).
+
+Measures full forward walk, reverse walk, batch (buffer-filling) walk, and
+to_array bulk extraction, reported as ns per value.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from . import common
+from .common import Result
+
+
+def run(reps: int = 3, datasets=None, **_) -> List[Result]:
+    results = []
+    for ds in datasets or ["census1881"]:
+        bms = common.corpus_bitmaps(ds, limit=100)
+        total = sum(b.get_cardinality() for b in bms)
+
+        def walk_int():
+            n = 0
+            for b in bms:
+                it = b.get_int_iterator()
+                while it.has_next():
+                    it.next()
+                    n += 1
+            return n
+
+        def walk_reverse():
+            for b in bms:
+                it = b.get_reverse_int_iterator()
+                while it.has_next():
+                    it.next()
+
+        def walk_batch():
+            for b in bms:
+                for _batch in b.batch_iterator(256):
+                    pass
+
+        def walk_array():
+            for b in bms:
+                b.to_array()
+
+        for name, fn in [
+            ("intIterator", walk_int),
+            ("reverseIterator", walk_reverse),
+            ("batchIterator", walk_batch),
+            ("toArray", walk_array),
+        ]:
+            ns = common.min_of(reps, fn) / max(1, total)
+            results.append(Result(name, ds, ns, "ns/value", {"values": total}))
+    return results

@@ -1,0 +1,70 @@
+"""Pairwise op matrix suites — twin of the jmh per-op suites
+(jmh/src/jmh/.../{and,or,xor,andnot}/ Bestcase/Identical/Worstcase pairs
+plus the realdata pairwise Ands/Ors/Xors benchmarks).
+
+Shapes:
+* bestcase  — disjoint key ranges (no container overlap; pure key merge)
+* identical — the same bitmap twice (every container pair hits)
+* worstcase — interleaved dense/sparse/run mix over shared keys
+* realdata  — successive pairs of a real corpus (RealDataBenchmarkAnds-style)
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from roaringbitmap_tpu import RoaringBitmap
+
+from . import common
+from .common import Result
+
+OPS = {
+    "and": RoaringBitmap.and_,
+    "or": RoaringBitmap.or_,
+    "xor": RoaringBitmap.xor,
+    "andNot": RoaringBitmap.andnot,
+    "andCardinality": RoaringBitmap.and_cardinality,
+    "orCardinality": RoaringBitmap.or_cardinality,
+}
+
+
+def _shape_pairs(rng):
+    dense = np.flatnonzero(rng.random(1 << 18) < 0.5).astype(np.uint32)
+    sparse = rng.choice(1 << 22, size=3000, replace=False).astype(np.uint32)
+    runs = np.concatenate(
+        [np.arange(b, b + 4000, dtype=np.uint32) for b in range(0, 1 << 21, 1 << 17)]
+    )
+    mixed = np.unique(np.concatenate([dense, sparse, runs]))
+    bestcase = (RoaringBitmap(dense), RoaringBitmap(dense + np.uint32(1 << 24)))
+    ident_b = RoaringBitmap(mixed)
+    worst_a, worst_b = RoaringBitmap(mixed[::2].copy()), RoaringBitmap(mixed[1::2].copy())
+    for b in (*bestcase, ident_b, worst_a, worst_b):
+        b.run_optimize()
+    return {
+        "bestcase": bestcase,
+        "identical": (ident_b, ident_b),
+        "worstcase": (worst_a, worst_b),
+    }
+
+
+def run(reps: int = 20, datasets=None, **_) -> List[Result]:
+    results = []
+    shapes = _shape_pairs(np.random.default_rng(0xFEEF1F0))
+    for shape, (a, b) in shapes.items():
+        for opname, op in OPS.items():
+            ns = common.min_of(reps, lambda: op(a, b))
+            results.append(Result(f"{opname}_{shape}", "synthetic", ns, "ns/op"))
+    for ds in datasets or ["census1881"]:
+        bms = common.corpus_bitmaps(ds, limit=200)
+        for opname in ("and", "or", "xor", "andNot"):
+            op = OPS[opname]
+
+            def all_pairs(op=op):
+                for i in range(len(bms) - 1):
+                    op(bms[i], bms[i + 1])
+
+            ns = common.min_of(max(1, reps // 4), all_pairs) / max(1, len(bms) - 1)
+            results.append(Result(f"pairwise_{opname}", ds, ns, "ns/op"))
+    return results

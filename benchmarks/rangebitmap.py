@@ -1,0 +1,55 @@
+"""RangeBitmap suites — twin of jmh RangeBitmap benchmarks
+(jmh/src/jmh/.../rangebitmap/: RangeBitmapBenchmark lt/lte/gt/gte/between
++Cardinality variants over appended value columns).
+
+Builds a sealed RangeBitmap over a synthetic value column (uniform +
+zipf-ish mix like the jmh states) and times point/range predicates with
+and without a pre-filter context.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from roaringbitmap_tpu import RoaringBitmap
+from roaringbitmap_tpu.models.range_bitmap import RangeBitmap
+
+from . import common
+from .common import Result
+
+N_ROWS = 200_000
+
+
+def _build(seed=0xFEEF1F0):
+    rng = np.random.default_rng(seed)
+    uniform = rng.integers(0, 1 << 24, size=N_ROWS // 2)
+    skewed = (rng.pareto(1.5, size=N_ROWS - N_ROWS // 2) * 1000).astype(np.int64)
+    values = np.concatenate([uniform, np.minimum(skewed, (1 << 24) - 1)])
+    app = RangeBitmap.appender((1 << 24) - 1)
+    app.add_many(values.tolist())
+    rb = app.build()
+    ctx = RoaringBitmap(rng.choice(N_ROWS, size=N_ROWS // 10, replace=False).astype(np.uint32))
+    return rb, ctx, values
+
+
+def run(reps: int = 10, **_) -> List[Result]:
+    rb, ctx, values = _build()
+    med = int(np.median(values))
+    lo, hi = med // 2, med * 2
+    out = []
+
+    def bench(name, fn):
+        out.append(Result(name, "synthetic", common.min_of(reps, fn), "ns/op", {"rows": N_ROWS}))
+
+    bench("lt", lambda: rb.lt(med))
+    bench("lte", lambda: rb.lte(med))
+    bench("gt", lambda: rb.gt(med))
+    bench("gte", lambda: rb.gte(med))
+    bench("eq", lambda: rb.eq(med))
+    bench("between", lambda: rb.between(lo, hi))
+    bench("betweenCardinality", lambda: rb.between_cardinality(lo, hi))
+    bench("ltWithContext", lambda: rb.lt(med, context=ctx))
+    bench("betweenWithContext", lambda: rb.between(lo, hi, context=ctx))
+    return out

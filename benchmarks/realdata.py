@@ -1,0 +1,49 @@
+"""Real-data aggregation suites — twin of jmh realdata
+(jmh/src/jmh/.../realdata/: RealDataBenchmarkWideOrNaive, …WideOr,
+…WideAndNaive, …WideXor, …HorizontalOr, ParallelAggregatorBenchmark).
+
+Each benchmark folds the *whole* corpus (all bitmaps of a dataset) and is
+measured as ns per wide aggregation; the device engines additionally report
+aggregate throughput.  Correctness of every engine against the naive fold is
+asserted by tests/test_benchmarks.py before numbers are trusted, mirroring
+jmh/src/test/.../RealDataBenchmarkOrTest.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from roaringbitmap_tpu.parallel.aggregation import FastAggregation, ParallelAggregation
+
+from . import common
+from .common import Result
+
+
+def _suite(dataset: str, reps: int) -> List[Result]:
+    bms = common.corpus_bitmaps(dataset)
+    out = []
+
+    def bench(name, fn):
+        ns = common.min_of(reps, fn)
+        out.append(Result(name, dataset, ns, "ns/op", {"n_bitmaps": len(bms)}))
+
+    bench("wideOrNaive", lambda: FastAggregation.naive_or(*bms))
+    bench("wideOr", lambda: FastAggregation.or_(*bms, mode="cpu"))
+    bench("wideOrDevice", lambda: FastAggregation.or_(*bms, mode="device"))
+    bench("wideAndNaive", lambda: FastAggregation.naive_and(*bms))
+    bench("wideAnd", lambda: FastAggregation.workshy_and(*bms, mode="cpu"))
+    bench("wideAndDevice", lambda: FastAggregation.workshy_and(*bms, mode="device"))
+    bench("wideXor", lambda: FastAggregation.xor(*bms, mode="cpu"))
+    bench("horizontalOr", lambda: FastAggregation.horizontal_or(*bms))
+    bench("priorityQueueOr", lambda: FastAggregation.priorityqueue_or(*bms))
+    bench("parallelOr", lambda: ParallelAggregation.or_(*bms, mode="cpu"))
+    bench("parallelOrDevice", lambda: ParallelAggregation.or_(*bms, mode="device"))
+    bench("parallelXor", lambda: ParallelAggregation.xor(*bms, mode="cpu"))
+    return out
+
+
+def run(reps: int = 5, datasets=None, **_) -> List[Result]:
+    results = []
+    for ds in datasets or common.DEFAULT_DATASETS:
+        results.extend(_suite(ds, reps))
+    return results

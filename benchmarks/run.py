@@ -1,0 +1,51 @@
+"""Benchmark CLI — the jmh/run.sh analogue.
+
+    python -m benchmarks.run [suite ...] [--reps N] [--datasets a,b]
+                             [--profile] [--json PATH]
+
+Suites: realdata ops iteration serialization rangebitmap writer
+runcontainer bsi simplebenchmark (default: all).  Emits one JSON line per
+measurement (and optionally appends them to --json); --profile wraps the
+run in a jax.profiler trace written to /tmp/rb_tpu_trace.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import sys
+
+from . import SUITES, common
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="benchmarks.run")
+    p.add_argument("suites", nargs="*", default=None)
+    p.add_argument("--reps", type=int, default=None)
+    p.add_argument("--datasets", type=str, default=None)
+    p.add_argument("--profile", action="store_true")
+    p.add_argument("--json", type=str, default=None)
+    args = p.parse_args(argv)
+
+    names = args.suites or SUITES + ["simplebenchmark"]
+    datasets = args.datasets.split(",") if args.datasets else None
+    results = []
+    with common.maybe_profile(args.profile):
+        for name in names:
+            mod = importlib.import_module(f"benchmarks.{name}")
+            kwargs = {"datasets": datasets}
+            if args.reps:
+                kwargs["reps"] = args.reps
+            for r in mod.run(**kwargs):
+                r.extra["suite"] = name
+                print(r.json(), flush=True)
+                results.append(r)
+    if args.json:
+        with open(args.json, "a") as f:
+            for r in results:
+                f.write(r.json() + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

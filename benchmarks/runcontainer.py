@@ -1,0 +1,57 @@
+"""Run-container suites — twin of jmh runcontainer benchmarks
+(jmh/src/jmh/.../runcontainer/: run-heavy AND/OR/contains and
+runOptimize costs over RLE-friendly shapes).
+
+Shapes are long-run bitmaps (interval data) where RunContainer wins, the
+reference's motivating case for RLE (README.md run compression).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from roaringbitmap_tpu import RoaringBitmap
+
+from . import common
+from .common import Result
+
+
+def _run_heavy(rng, n_runs=400, span=1 << 22):
+    starts = np.sort(rng.choice(span, size=n_runs, replace=False)).astype(np.int64)
+    parts = [np.arange(s, s + int(rng.integers(100, 4000)), dtype=np.int64) for s in starts]
+    return np.unique(np.concatenate(parts)).astype(np.uint32)
+
+
+def run(reps: int = 10, **_) -> List[Result]:
+    rng = np.random.default_rng(0xFEEF1F0)
+    a_vals, b_vals = _run_heavy(rng), _run_heavy(rng)
+    a, b = RoaringBitmap(a_vals), RoaringBitmap(b_vals)
+    a_opt, b_opt = a.clone(), b.clone()
+    a_opt.run_optimize()
+    b_opt.run_optimize()
+    probe = rng.integers(0, 1 << 22, size=10_000).astype(np.uint32)
+    out = []
+
+    def bench(name, fn):
+        out.append(Result(name, "run-heavy", common.min_of(reps, fn), "ns/op"))
+
+    bench("runOptimize", lambda: a.clone().run_optimize())
+    bench("andRunRun", lambda: RoaringBitmap.and_(a_opt, b_opt))
+    bench("orRunRun", lambda: RoaringBitmap.or_(a_opt, b_opt))
+    bench("xorRunRun", lambda: RoaringBitmap.xor(a_opt, b_opt))
+    bench("andNoRuns", lambda: RoaringBitmap.and_(a, b))
+    bench("orNoRuns", lambda: RoaringBitmap.or_(a, b))
+    bench("containsRun", lambda: [a_opt.contains(int(v)) for v in probe[:1000]])
+    bench("iterateRun", lambda: a_opt.to_array())
+    out.append(
+        Result(
+            "compressionRatio",
+            "run-heavy",
+            a.get_size_in_bytes() / max(1, a_opt.get_size_in_bytes()),
+            "x",
+            {"plain_bytes": a.get_size_in_bytes(), "run_bytes": a_opt.get_size_in_bytes()},
+        )
+    )
+    return out

@@ -1,0 +1,61 @@
+"""Serialization suites — twin of jmh serialization benchmarks
+(jmh/src/jmh/.../serialization/: SerializationBenchmark,
+DeserializationBenchmark over portable-format bytes) plus the zero-copy
+ImmutableRoaringBitmap map path (buffer package, SURVEY.md §3.4).
+
+Reports ns/op and MB/s over a whole corpus, plus bits per value
+(the compression headline the papers report).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from roaringbitmap_tpu.models.immutable import ImmutableRoaringBitmap
+from roaringbitmap_tpu import RoaringBitmap
+
+from . import common
+from .common import Result
+
+
+def run(reps: int = 5, datasets=None, **_) -> List[Result]:
+    results = []
+    for ds in datasets or common.DEFAULT_DATASETS:
+        bms = common.corpus_bitmaps(ds)
+        blobs = [b.serialize() for b in bms]
+        total_bytes = sum(len(x) for x in blobs)
+        total_vals = sum(b.get_cardinality() for b in bms)
+
+        ns = common.min_of(reps, lambda: [b.serialize() for b in bms])
+        results.append(
+            Result(
+                "serialize",
+                ds,
+                ns / len(bms),
+                "ns/op",
+                {"mb_per_s": round(total_bytes / max(ns, 1) * 1e3, 1)},
+            )
+        )
+        ns = common.min_of(reps, lambda: [RoaringBitmap.deserialize(x) for x in blobs])
+        results.append(
+            Result(
+                "deserialize",
+                ds,
+                ns / len(bms),
+                "ns/op",
+                {"mb_per_s": round(total_bytes / max(ns, 1) * 1e3, 1)},
+            )
+        )
+        # zero-copy map: parse metadata only, containers stay buffer views
+        ns = common.min_of(reps, lambda: [ImmutableRoaringBitmap(x) for x in blobs])
+        results.append(Result("mapImmutable", ds, ns / len(bms), "ns/op"))
+        results.append(
+            Result(
+                "bitsPerValue",
+                ds,
+                total_bytes * 8.0 / max(1, total_vals),
+                "bits/value",
+                {"bytes": total_bytes, "values": total_vals},
+            )
+        )
+    return results

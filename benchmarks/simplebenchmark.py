@@ -1,0 +1,73 @@
+"""simplebenchmark twin — dependency-free timing harness over the real
+datasets (reference simplebenchmark/src/main/java/simplebenchmark.java:52-112).
+
+For each corpus, for both the heap (`RoaringBitmap`) and buffer
+(`ImmutableRoaringBitmap`, zero-copy over serialized bytes) variants,
+reports exactly what the reference reports:
+
+  bits/value · successive 2-by-2 AND ns · 2-by-2 OR ns · wide OR ns ·
+  contains(present value) ns
+
+using the minimum over ``reps`` repetitions (the reference uses 100).
+
+Run standalone: ``python -m benchmarks.simplebenchmark [--reps N]``.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List
+
+from roaringbitmap_tpu import RoaringBitmap
+from roaringbitmap_tpu.models.buffer import BufferFastAggregation
+from roaringbitmap_tpu.models.immutable import ImmutableRoaringBitmap
+from roaringbitmap_tpu.parallel.aggregation import FastAggregation
+
+from . import common
+from .common import Result
+
+
+def _variant_suite(name: str, dataset: str, bms, wide_or, reps: int) -> List[Result]:
+    and_ = type(bms[0]).and_ if hasattr(type(bms[0]), "and_") else RoaringBitmap.and_
+    or_ = type(bms[0]).or_ if hasattr(type(bms[0]), "or_") else RoaringBitmap.or_
+    pairs = list(zip(bms[:-1], bms[1:]))
+    probes = [(b, b.first()) for b in bms[:200]]
+    out = []
+
+    def bench(metric, fn, per):
+        ns = common.min_of(reps, fn) / max(1, per)
+        out.append(Result(f"{name}_{metric}", dataset, ns, "ns/op"))
+
+    bench("and2by2", lambda: [and_(a, b) for a, b in pairs], len(pairs))
+    bench("or2by2", lambda: [or_(a, b) for a, b in pairs], len(pairs))
+    bench("wideOr", wide_or, 1)
+    bench("contains", lambda: [b.contains(v) for b, v in probes], len(probes))
+    return out
+
+
+def run(reps: int = 20, datasets=None, **_) -> List[Result]:
+    results = []
+    for ds in datasets or common.DEFAULT_DATASETS:
+        heap = common.corpus_bitmaps(ds)
+        blobs = [b.serialize() for b in heap]
+        buffer = [ImmutableRoaringBitmap(x) for x in blobs]
+        total_bits = sum(len(x) * 8 for x in blobs)
+        total_vals = sum(b.get_cardinality() for b in heap)
+        results.append(
+            Result("bitsPerValue", ds, total_bits / max(1, total_vals), "bits/value")
+        )
+        results.extend(
+            _variant_suite("heap", ds, heap, lambda: FastAggregation.naive_or(*heap), reps)
+        )
+        results.extend(
+            _variant_suite(
+                "buffer", ds, buffer, lambda: BufferFastAggregation.or_(*buffer), reps
+            )
+        )
+    return results
+
+
+if __name__ == "__main__":
+    reps = int(sys.argv[sys.argv.index("--reps") + 1]) if "--reps" in sys.argv else 20
+    for r in run(reps=reps):
+        print(r.json())

@@ -1,0 +1,68 @@
+"""Writer/ingestion suites — twin of jmh writer benchmarks
+(jmh/src/jmh/.../writer/: WriteSequential, WriteUnordered,
+RoaringBitmapWriterBenchmark wizard configs).
+
+Times bulk construction through each ingest path: naive add loop,
+add_many, the writer wizard (array-optimised, run-optimised,
+constant-memory), and partially-sorted input.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from roaringbitmap_tpu import RoaringBitmap
+from roaringbitmap_tpu.models.writer import RoaringBitmapWriter
+
+from . import common
+from .common import Result
+
+N = 1_000_000
+
+
+def run(reps: int = 3, **_) -> List[Result]:
+    rng = np.random.default_rng(0xFEEF1F0)
+    sequential = np.arange(N, dtype=np.uint32) * 7
+    unordered = rng.permutation(sequential)
+    out = []
+
+    def bench(name, fn):
+        ns = common.min_of(reps, fn) / N
+        out.append(Result(name, "synthetic", ns, "ns/value", {"n": N}))
+
+    def via_writer(cfg, vals):
+        w = cfg.get()
+        w.add_many(vals)
+        return w.get()
+
+    bench("addLoopSequential", lambda: _add_loop(sequential[:100_000]))
+    bench("addManySequential", lambda: RoaringBitmap(sequential))
+    bench("addManyUnordered", lambda: RoaringBitmap(unordered))
+    bench(
+        "writerArrays",
+        lambda: via_writer(RoaringBitmapWriter.writer().optimise_for_arrays(), sequential),
+    )
+    bench(
+        "writerRuns",
+        lambda: via_writer(RoaringBitmapWriter.writer().optimise_for_runs(), sequential),
+    )
+    bench(
+        "writerConstantMemory",
+        lambda: via_writer(RoaringBitmapWriter.writer().constant_memory(), sequential),
+    )
+    bench(
+        "writerPartiallySorted",
+        lambda: via_writer(
+            RoaringBitmapWriter.writer().partially_sort_values(), unordered
+        ),
+    )
+    return out
+
+
+def _add_loop(vals):
+    b = RoaringBitmap()
+    for v in vals:
+        b.add(int(v))
+    return b

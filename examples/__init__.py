@@ -1,0 +1,25 @@
+"""Runnable documentation — twin of the reference ``examples/`` module
+(13 files, run via ``./gradlew :examples:runAll``, README.md:190).
+
+Each module here is a self-contained script with a ``main()`` covering one
+workflow; ``python -m examples.run_all`` executes every one (the runAll
+analogue) and is smoke-tested by tests/test_examples.py.  The
+``device_aggregation`` example is new — it shows the TPU batch path that
+has no reference counterpart.
+"""
+
+EXAMPLES = [
+    "basic",
+    "bitmap64",
+    "compression_results",
+    "for_each",
+    "immutable_example",
+    "interval_check",
+    "memory_mapping",
+    "paged_iterator",
+    "serialize_to_bytes",
+    "serialize_to_disk",
+    "serialize_to_string",
+    "very_large_bitmap",
+    "device_aggregation",
+]

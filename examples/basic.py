@@ -1,0 +1,27 @@
+"""Basic set algebra (reference examples/src/main/java/Basic.java)."""
+
+from roaringbitmap_tpu import RoaringBitmap
+
+
+def main():
+    rr = RoaringBitmap.bitmap_of(1, 2, 3, 1000)
+    rr2 = RoaringBitmap()
+    rr2.add_range(500, 1100)  # add a half-open range [500, 1100)
+
+    print("cardinality:", rr.get_cardinality())
+    print("contains 3:", rr.contains(3))
+
+    rror = RoaringBitmap.or_(rr, rr2)  # new bitmap
+    rr.ior(rr2)  # in-place union
+    assert rror == rr
+    print("union cardinality:", rr.get_cardinality())
+
+    # iteration: python iterator protocol and explicit int-iterator
+    first_five = [v for _, v in zip(range(5), rr)]
+    print("first five:", first_five)
+    it = rr.get_int_iterator()
+    assert it.has_next() and it.next() == 1
+
+
+if __name__ == "__main__":
+    main()

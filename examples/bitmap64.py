@@ -1,0 +1,24 @@
+"""64-bit bitmaps (reference examples/src/main/java/Bitmap64.java):
+both 64-bit designs — the ART-backed Roaring64Bitmap and the
+NavigableMap-of-32-bit-bitmaps Roaring64NavigableMap."""
+
+from roaringbitmap_tpu import Roaring64Bitmap, Roaring64NavigableMap
+
+
+def main():
+    for cls in (Roaring64Bitmap, Roaring64NavigableMap):
+        bm = cls()
+        bm.add_long(1)
+        bm.add_long(2)
+        bm.add_long(1 << 40)  # far beyond the 32-bit universe
+        bm.add_long((1 << 63) - 1)
+        print(cls.__name__, "cardinality:", bm.get_long_cardinality())
+        assert bm.contains(1 << 40)
+        blob = bm.serialize()
+        back = cls.deserialize(blob)
+        assert back.get_long_cardinality() == bm.get_long_cardinality()
+        print(cls.__name__, "serialized bytes:", len(blob))
+
+
+if __name__ == "__main__":
+    main()

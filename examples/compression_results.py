@@ -1,0 +1,33 @@
+"""Compression behavior across value distributions (reference
+examples/src/main/java/CompressionResults.java): bytes per value for
+dense ranges, periodic values, and random scatter — showing where the
+run/array/bitmap container choices win."""
+
+import numpy as np
+
+from roaringbitmap_tpu import RoaringBitmap
+
+
+def report(name, values):
+    bm = RoaringBitmap(values)
+    bm.run_optimize()
+    n = bm.get_cardinality()
+    size = bm.serialized_size_in_bytes() if hasattr(bm, "serialized_size_in_bytes") else len(
+        bm.serialize()
+    )
+    print(f"{name:24s} {n:9d} values  {size:9d} bytes  {size / n:6.3f} bytes/value")
+
+
+def main():
+    report("consecutive [0, 1M)", np.arange(1_000_000, dtype=np.uint32))
+    report("every 2nd", np.arange(0, 2_000_000, 2, dtype=np.uint32))
+    report("every 10th", np.arange(0, 10_000_000, 10, dtype=np.uint32))
+    rng = np.random.default_rng(0)
+    report(
+        "random 1% of 100M",
+        np.unique(rng.integers(0, 100_000_000, size=1_000_000)).astype(np.uint32),
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+"""TPU batch aggregation — the path with no reference counterpart.
+
+Thousands of bitmaps are packed once into a dense [rows, 2048]-uint32
+device array (parallel/store.py), then a wide OR + cardinality runs as a
+single fused XLA reduction with a Pallas popcount; the result streams
+back through the append writer as a normal RoaringBitmap.  This is the
+north-star configuration (BASELINE.md) in ~20 lines."""
+
+import time
+
+import numpy as np
+
+from roaringbitmap_tpu import FastAggregation, RoaringBitmap
+
+N_BITMAPS = 2000
+VALUES_PER_BITMAP = 5000
+
+
+def main():
+    rng = np.random.default_rng(0)
+    bitmaps = [
+        RoaringBitmap(
+            np.unique(rng.integers(0, 1 << 20, size=VALUES_PER_BITMAP)).astype(np.uint32)
+        )
+        for _ in range(N_BITMAPS)
+    ]
+
+    t0 = time.perf_counter()
+    cpu = FastAggregation.or_(*bitmaps, mode="cpu")
+    t_cpu = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    dev = FastAggregation.or_(*bitmaps, mode="device")
+    t_dev = time.perf_counter() - t0
+    assert dev == cpu
+
+    print(f"wide-OR of {len(bitmaps)} bitmaps -> cardinality {cpu.get_cardinality()}")
+    print(f"cpu fold: {t_cpu * 1e3:.1f} ms   device batch: {t_dev * 1e3:.1f} ms")
+    print("(device time includes one-time packing + compile on first call)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,22 @@
+"""Visiting every value (reference examples/src/main/java/ForEachExample.java):
+python iteration, the flyweight int-iterator, and the batch iterator —
+the bulk path that should be preferred for large extractions."""
+
+from roaringbitmap_tpu import RoaringBitmap
+
+
+def main():
+    bm = RoaringBitmap.bitmap_of(1, 2, 3, 100, 1000)
+
+    total = sum(bm)  # python protocol
+    it = bm.get_int_iterator()  # flyweight
+    total2 = 0
+    while it.has_next():
+        total2 += it.next()
+    total3 = sum(int(batch.sum()) for batch in bm.batch_iterator(256))  # batch
+    assert total == total2 == total3
+    print("sum of values:", total)
+
+
+if __name__ == "__main__":
+    main()

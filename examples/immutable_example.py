@@ -1,0 +1,30 @@
+"""Zero-copy immutable bitmaps (reference
+examples/src/main/java/ImmutableRoaringBitmapExample.java): serialize a
+mutable bitmap, map an ImmutableRoaringBitmap over the bytes without
+deserialization, operate on it, and cast back to mutable."""
+
+from roaringbitmap_tpu import ImmutableRoaringBitmap, MutableRoaringBitmap
+
+
+def main():
+    rr1 = MutableRoaringBitmap.bitmap_of(1, 2, 3, 1000)
+    rr2 = MutableRoaringBitmap.bitmap_of(2, 3, 1010)
+    blob1, blob2 = rr1.serialize(), rr2.serialize()
+
+    # map: metadata parsed, containers stay views over the bytes
+    irb1 = ImmutableRoaringBitmap(blob1)
+    irb2 = ImmutableRoaringBitmap(blob2)
+    print("mapped cardinalities:", irb1.get_cardinality(), irb2.get_cardinality())
+
+    both = ImmutableRoaringBitmap.and_(irb1, irb2)
+    print("intersection:", sorted(both))
+
+    # O(1)-spirit cast immutable -> mutable (MutableRoaringBitmap.java toMutable)
+    mutable = MutableRoaringBitmap.of(irb1)
+    mutable.add(7)
+    assert mutable.contains(7) and not irb1.contains(7)
+    print("mutable copy diverged:", mutable.get_cardinality(), irb1.get_cardinality())
+
+
+if __name__ == "__main__":
+    main()

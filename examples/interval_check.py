@@ -1,0 +1,22 @@
+"""Range membership queries (reference
+examples/src/main/java/IntervalCheck.java): contains_range /
+intersects_range answer "is [start, end) fully / partly covered?"
+without materializing the range."""
+
+from roaringbitmap_tpu import RoaringBitmap
+
+
+def main():
+    bm = RoaringBitmap()
+    bm.add_range(100, 200)
+    bm.add(500)
+
+    print("contains [100,200):", bm.contains_range(100, 200))  # True
+    print("contains [100,201):", bm.contains_range(100, 201))  # False
+    print("intersects [150,600):", bm.intersects_range(150, 600))  # True
+    print("intersects [300,400):", bm.intersects_range(300, 400))  # False
+    print("cardinality in [0,512):", bm.range_cardinality(0, 512))
+
+
+if __name__ == "__main__":
+    main()

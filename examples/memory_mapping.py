@@ -1,0 +1,44 @@
+"""Memory-mapped bitmaps from disk (reference
+examples/src/main/java/MemoryMappingExample.java + TestMemoryMapping):
+write several serialized bitmaps into one file, np.memmap it, and map
+ImmutableRoaringBitmaps over slices — no copy, no parse of payloads."""
+
+import os
+import tempfile
+
+import numpy as np
+
+from roaringbitmap_tpu import ImmutableRoaringBitmap, RoaringBitmap
+
+
+def main():
+    bitmaps = [
+        RoaringBitmap(np.arange(i * 1000, i * 1000 + 500, dtype=np.uint32))
+        for i in range(4)
+    ]
+    blobs = [b.serialize() for b in bitmaps]
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "bitmaps.bin")
+        offsets = []
+        with open(path, "wb") as f:
+            for blob in blobs:
+                offsets.append(f.tell())
+                f.write(blob)
+        size = os.path.getsize(path)
+
+        mm = np.memmap(path, dtype=np.uint8, mode="r")
+        mapped = []
+        for i, off in enumerate(offsets):
+            end = offsets[i + 1] if i + 1 < len(offsets) else size
+            mapped.append(ImmutableRoaringBitmap(memoryview(mm)[off:end]))
+
+        for orig, m in zip(bitmaps, mapped):
+            assert m.get_cardinality() == orig.get_cardinality()
+        union = ImmutableRoaringBitmap.or_(mapped[0], mapped[1])
+        print("mapped", len(mapped), "bitmaps from", size, "bytes on disk")
+        print("union of first two:", union.get_cardinality())
+
+
+if __name__ == "__main__":
+    main()

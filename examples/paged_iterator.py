@@ -1,0 +1,24 @@
+"""Paged iteration (reference examples/src/main/java/PagedIterator.java):
+consume a large bitmap page by page with the batch iterator — constant
+memory regardless of cardinality."""
+
+import numpy as np
+
+from roaringbitmap_tpu import RoaringBitmap
+
+PAGE = 4096
+
+
+def main():
+    bm = RoaringBitmap(np.arange(0, 1_000_000, 3, dtype=np.uint32))
+    pages = 0
+    seen = 0
+    for page in bm.batch_iterator(PAGE):
+        pages += 1
+        seen += len(page)
+    assert seen == bm.get_cardinality()
+    print(f"walked {seen} values in {pages} pages of <= {PAGE}")
+
+
+if __name__ == "__main__":
+    main()

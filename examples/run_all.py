@@ -1,0 +1,25 @@
+"""Run every example — the ``./gradlew :examples:runAll`` analogue
+(README.md:190).  ``python -m examples.run_all``."""
+
+import importlib
+import sys
+
+from . import EXAMPLES
+
+
+def main() -> int:
+    failed = []
+    for name in EXAMPLES:
+        print(f"=== {name} " + "=" * max(1, 60 - len(name)))
+        try:
+            importlib.import_module(f"examples.{name}").main()
+        except Exception as e:  # keep going; report at the end
+            failed.append((name, e))
+            print(f"FAILED: {e!r}")
+    print("=" * 66)
+    print(f"{len(EXAMPLES) - len(failed)}/{len(EXAMPLES)} examples ok")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,26 @@
+"""Serialize to a byte array / buffer (reference
+examples/src/main/java/SerializeToByteArrayExample.java +
+SerializeToByteBufferExample.java): the portable RoaringFormatSpec bytes
+round-trip and interoperate with the C/Go/Java implementations."""
+
+from roaringbitmap_tpu import RoaringBitmap
+
+
+def main():
+    mrb = RoaringBitmap.bitmap_of(*range(100000, 200000, 3))
+    print("cardinality:", mrb.get_cardinality())
+
+    blob = mrb.serialize()
+    bound = RoaringBitmap.maximum_serialized_size(mrb.get_cardinality(), 200001)
+    print(f"serialized: {len(blob)} bytes (bound {bound})")
+    assert len(blob) <= bound
+
+    back = RoaringBitmap.deserialize(blob)
+    assert back == mrb
+    # memoryview works too — no copy on the way in
+    assert RoaringBitmap.deserialize(memoryview(blob)) == mrb
+    print("round-trip ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+"""Serialize to disk (reference
+examples/src/main/java/SerializeToDiskExample.java): file round-trip of
+the portable format."""
+
+import os
+import tempfile
+
+from roaringbitmap_tpu import RoaringBitmap
+
+
+def main():
+    rb = RoaringBitmap.bitmap_of(1, 2, 3, 1000)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "bitmap.bin")
+        with open(path, "wb") as f:
+            f.write(rb.serialize())
+        with open(path, "rb") as f:
+            back = RoaringBitmap.deserialize(f.read())
+        assert back == rb
+        print("disk round-trip ok:", os.path.getsize(path), "bytes")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,20 @@
+"""Serialize to a printable string (reference
+examples/src/main/java/SerializeToStringExample.java): base64 text
+round-trip — handy for JSON payloads and the fuzz Reporter's repro dumps."""
+
+import base64
+
+from roaringbitmap_tpu import RoaringBitmap
+
+
+def main():
+    mrb = RoaringBitmap.bitmap_of(*range(100000, 200000, 3))
+    text = base64.b64encode(mrb.serialize()).decode("ascii")
+    print("base64 length:", len(text), "prefix:", text[:32], "...")
+    back = RoaringBitmap.deserialize(base64.b64decode(text))
+    assert back == mrb
+    print("string round-trip ok")
+
+
+if __name__ == "__main__":
+    main()

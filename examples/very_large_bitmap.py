@@ -1,0 +1,23 @@
+"""A bitmap beyond the 32-bit universe (reference
+examples/src/main/java/VeryLargeBitmap.java): ranges over billions of
+values stay tiny thanks to run containers; 64-bit types extend the
+universe past 2^32."""
+
+from roaringbitmap_tpu import Roaring64Bitmap, RoaringBitmap
+
+
+def main():
+    rb = RoaringBitmap()
+    rb.add_range(0, 1 << 31)  # two billion values
+    print("32-bit: cardinality", rb.get_cardinality())
+    rb.run_optimize()
+    print("32-bit: serialized", len(rb.serialize()), "bytes after run_optimize")
+
+    big = Roaring64Bitmap()
+    big.add_range(1 << 40, (1 << 40) + 1_000_000)
+    print("64-bit: cardinality", big.get_long_cardinality(), "starting at 2^40")
+    assert big.contains_long(1 << 40)
+
+
+if __name__ == "__main__":
+    main()

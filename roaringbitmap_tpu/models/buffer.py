@@ -67,6 +67,32 @@ class MutableRoaringBitmap(RoaringBitmap):
             return MutableRoaringBitmap._adopt(source.to_mutable())
         return MutableRoaringBitmap._adopt(source.clone())
 
+    # -- inherited factories re-typed so they stay in the buffer world ----
+    @staticmethod
+    def bitmap_of(*values: int) -> "MutableRoaringBitmap":
+        return MutableRoaringBitmap._adopt(RoaringBitmap.bitmap_of(*values))
+
+    @staticmethod
+    def bitmap_of_range(start: int, end: int) -> "MutableRoaringBitmap":
+        return MutableRoaringBitmap._adopt(RoaringBitmap.bitmap_of_range(start, end))
+
+    @staticmethod
+    def flip(bm: AnyRoaring, start: int, end: int) -> "MutableRoaringBitmap":
+        return MutableRoaringBitmap._adopt(RoaringBitmap.flip(bm, start, end))
+
+    @staticmethod
+    def add_offset(bm: AnyRoaring, offset: int) -> "MutableRoaringBitmap":
+        return MutableRoaringBitmap._adopt(RoaringBitmap.add_offset(bm, offset))
+
+    def clone(self) -> "MutableRoaringBitmap":
+        return MutableRoaringBitmap._adopt(super().clone())
+
+    def limit(self, max_cardinality: int) -> "MutableRoaringBitmap":
+        return MutableRoaringBitmap._adopt(super().limit(max_cardinality))
+
+    def select_range(self, start: int, end: int) -> "MutableRoaringBitmap":
+        return MutableRoaringBitmap._adopt(super().select_range(start, end))
+
     def to_immutable(self) -> ImmutableRoaringBitmap:
         """Freeze into a buffer-backed immutable (one serialization pass)."""
         return ImmutableRoaringBitmap(self.serialize())

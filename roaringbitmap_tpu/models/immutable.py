@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import mmap as _mmap
 import struct
+from bisect import bisect_left
 from typing import Iterator, List, Optional, Union
 
 import numpy as np
@@ -45,23 +46,20 @@ class ImmutableRoaringArray:
     N-way algebra runs directly on the serialized form.
     """
 
-    __slots__ = ("_bm", "keys", "_cache")
+    __slots__ = ("_bm", "keys", "_cache", "containers")
 
     def __init__(self, bm: "ImmutableRoaringBitmap"):
         self._bm = bm
         self.keys = bm._keys.tolist()
         self._cache: dict = {}
+        self.containers = _LazyContainers(self)
 
     @property
     def size(self) -> int:
         return self._bm._size
 
-    @property
-    def containers(self) -> "_LazyContainers":
-        return _LazyContainers(self)
-
     def get_index(self, key: int) -> int:
-        i = int(np.searchsorted(self._bm._keys, key))
+        i = bisect_left(self.keys, key)
         if i < self._bm._size and self.keys[i] == key:
             return i
         return -i - 1
@@ -79,8 +77,6 @@ class ImmutableRoaringArray:
     def advance_until(self, key: int, pos: int) -> int:
         """Exponential + binary search (ImmutableRoaringArray advanceUntil,
         PointableRoaringArray.java:25)."""
-        from bisect import bisect_left
-
         return bisect_left(self.keys, key, pos + 1)
 
     def items(self):

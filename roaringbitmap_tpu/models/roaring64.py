@@ -584,3 +584,10 @@ class Roaring64NavigableMap:
         card = self.get_cardinality()
         head = ",".join(str(v) for v in self.to_array()[:8].tolist())
         return f"Roaring64NavigableMap(card={card}, values=[{head}{'...' if card > 8 else ''}])"
+
+    # reference facade naming aliases (Roaring64NavigableMap.java addLong :50,
+    # removeLong, getLongCardinality) for drop-in familiarity
+    add_long = add
+    remove_long = remove
+    contains_long = contains
+    get_long_cardinality = get_cardinality

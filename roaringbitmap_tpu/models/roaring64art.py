@@ -514,3 +514,10 @@ class Roaring64Bitmap:
         card = self.get_cardinality()
         head = ",".join(str(v) for v in self.to_array()[:8].tolist())
         return f"Roaring64Bitmap(card={card}, values=[{head}{'...' if card > 8 else ''}])"
+
+    # reference facade naming aliases (Roaring64Bitmap.java addLong :50,
+    # removeLong, getLongCardinality) for drop-in familiarity
+    add_long = add
+    remove_long = remove
+    contains_long = contains
+    get_long_cardinality = get_cardinality

@@ -1,0 +1,76 @@
+"""Benchmark smoke tests — twin of jmh/src/test
+(RealDataBenchmark{Or,And,HorizontalOr,...}Test): every suite runs with
+tiny reps, and the realdata engines' outputs are asserted equal to the
+naive fold before any timing is trusted."""
+
+import numpy as np
+import pytest
+
+from benchmarks import SUITES, common
+from roaringbitmap_tpu.models.buffer import BufferFastAggregation
+from roaringbitmap_tpu.models.immutable import ImmutableRoaringBitmap
+from roaringbitmap_tpu.parallel.aggregation import FastAggregation, ParallelAggregation
+
+
+@pytest.fixture(scope="module")
+def small_corpus(monkeypatch_module):
+    # cap corpora so the whole smoke pass stays fast
+    orig = common.corpus
+
+    def capped(name, limit=None):
+        return orig(name, limit=min(limit or 40, 40))
+
+    monkeypatch_module.setattr(common, "corpus", capped)
+    common._bitmap_cache.clear()
+    return capped
+
+
+@pytest.fixture(scope="module")
+def monkeypatch_module():
+    from _pytest.monkeypatch import MonkeyPatch
+
+    mp = MonkeyPatch()
+    yield mp
+    mp.undo()
+
+
+def test_realdata_engines_agree_with_naive(small_corpus):
+    bms = common.corpus_bitmaps("census1881", limit=30)
+    want = FastAggregation.naive_or(*bms)
+    assert FastAggregation.or_(*bms, mode="cpu") == want
+    assert FastAggregation.or_(*bms, mode="device") == want
+    assert FastAggregation.horizontal_or(*bms) == want
+    assert FastAggregation.priorityqueue_or(*bms) == want
+    assert ParallelAggregation.or_(*bms, mode="cpu") == want
+    assert ParallelAggregation.or_(*bms, mode="device") == want
+    want_and = FastAggregation.naive_and(*bms)
+    assert FastAggregation.workshy_and(*bms, mode="cpu") == want_and
+    assert FastAggregation.workshy_and(*bms, mode="device") == want_and
+    blobs = [b.serialize() for b in bms]
+    mapped = [ImmutableRoaringBitmap(x) for x in blobs]
+    assert BufferFastAggregation.or_(*mapped) == want
+
+
+@pytest.mark.parametrize("suite", SUITES + ["simplebenchmark"])
+def test_suite_runs(suite, small_corpus, monkeypatch):
+    import importlib
+
+    mod = importlib.import_module(f"benchmarks.{suite}")
+    # shrink the heavy builders for smoke purposes
+    for attr, small in (("N_ROWS", 5000), ("N", 20_000)):
+        if hasattr(mod, attr):
+            monkeypatch.setattr(mod, attr, small)
+    results = mod.run(reps=1, datasets=["census1881"])
+    assert results, suite
+    for r in results:
+        assert np.isfinite(r.value) and r.value >= 0, (suite, r.benchmark)
+        rec = r.json()
+        assert r.benchmark in rec
+
+
+def test_cli_runs(small_corpus, capsys):
+    from benchmarks import run as runner
+
+    assert runner.main(["ops", "--reps", "1", "--datasets", "census1881"]) == 0
+    out = capsys.readouterr().out
+    assert '"benchmark"' in out

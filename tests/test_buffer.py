@@ -152,3 +152,20 @@ def test_mapped_file_algebra(tmp_path, pairs):
     assert MutableRoaringBitmap.or_(ma, mb) == RoaringBitmap.or_(a, b)
     assert ma.clone() == a
     assert ma.get_size_in_bytes() == os.path.getsize(pa)
+
+
+def test_mutable_factories_stay_in_buffer_world():
+    """Inherited factories must return MutableRoaringBitmap, not the heap
+    base class, so the buffer-world casts stay reachable."""
+    m = MutableRoaringBitmap.bitmap_of(1, 2, 3)
+    for got in (
+        m,
+        m.clone(),
+        m.limit(2),
+        m.select_range(0, 10),
+        MutableRoaringBitmap.bitmap_of_range(5, 50),
+        MutableRoaringBitmap.flip(m, 0, 10),
+        MutableRoaringBitmap.add_offset(m, 100),
+    ):
+        assert type(got) is MutableRoaringBitmap
+        got.to_immutable()  # the buffer-world API the class exists for

@@ -1,0 +1,20 @@
+"""Examples smoke test — the runAll gate (reference runs examples via
+``./gradlew :examples:runAll``; each example asserts internally)."""
+
+import importlib
+
+import pytest
+
+from examples import EXAMPLES
+
+
+@pytest.mark.parametrize("name", EXAMPLES)
+def test_example_runs(name, monkeypatch, capsys):
+    mod = importlib.import_module(f"examples.{name}")
+    # shrink the heavyweight one for smoke purposes
+    if name == "device_aggregation":
+        monkeypatch.setattr(mod, "N_BITMAPS", 50)
+        monkeypatch.setattr(mod, "VALUES_PER_BITMAP", 500)
+    mod.main()
+    out = capsys.readouterr().out
+    assert out.strip(), name
