@@ -94,6 +94,9 @@ class RoaringBitSet:
     def __hash__(self):
         return hash(self.bitmap)
 
+    def __reduce__(self):
+        return _bitset_from_bytes, (self.bitmap.serialize(),)
+
     def __len__(self):
         return self.cardinality()
 
@@ -133,3 +136,7 @@ def words_of_bitmap(bm: RoaringBitmap) -> np.ndarray:
             : max(0, min(bits.WORDS_PER_CONTAINER, n_words - base))
         ]
     return out
+
+
+def _bitset_from_bytes(blob: bytes) -> "RoaringBitSet":
+    return RoaringBitSet(RoaringBitmap.deserialize(blob))

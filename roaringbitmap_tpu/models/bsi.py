@@ -553,6 +553,11 @@ class RoaringBitmapSliceIndex:
             + sum(serialized_size_in_bytes(s) for s in self.slices)
         )
 
+    def __reduce__(self):
+        """Pickle via the BSI wire format; subclasses reconstruct their
+        own type (MutableBitSliceIndex overrides deserialize)."""
+        return type(self).deserialize, (self.serialize(),)
+
     @staticmethod
     def deserialize(data) -> "RoaringBitmapSliceIndex":
         buf = memoryview(data if isinstance(data, (bytes, bytearray, memoryview)) else bytes(data))

@@ -397,6 +397,9 @@ class Roaring64BitmapSliceIndex:
             + sum(s.serialized_size_in_bytes() for s in self.slices)
         )
 
+    def __reduce__(self):
+        return Roaring64BitmapSliceIndex.deserialize, (self.serialize(),)
+
     @staticmethod
     def deserialize(data) -> "Roaring64BitmapSliceIndex":
         buf = memoryview(

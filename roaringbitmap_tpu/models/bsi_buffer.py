@@ -185,5 +185,8 @@ class ImmutableBitSliceIndex:
             return self._base == other
         return NotImplemented
 
+    def __reduce__(self):
+        return ImmutableBitSliceIndex, (self.serialize(),)
+
     def __repr__(self):
         return f"Immutable{self._base!r}"

@@ -371,5 +371,10 @@ class ImmutableRoaringBitmap:
             mm = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
         return ImmutableRoaringBitmap(mm)
 
+    def __reduce__(self):
+        """Pickle as owned serialized bytes (an mmap/view source itself
+        is not picklable)."""
+        return ImmutableRoaringBitmap, (self.serialize(),)
+
     def __repr__(self):
         return f"ImmutableRoaringBitmap(card={self.get_cardinality()}, containers={self._size})"

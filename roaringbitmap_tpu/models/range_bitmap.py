@@ -104,6 +104,9 @@ class RangeBitmap:
 
         return 16 + sum(4 + serialized_size_in_bytes(s) for s in self._index.slices)
 
+    def __reduce__(self):
+        return RangeBitmap.map, (self.serialize(),)
+
     # ------------------------------------------------------------------
     # queries (RangeBitmap.java:111-414)
     # ------------------------------------------------------------------

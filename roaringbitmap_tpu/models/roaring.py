@@ -735,6 +735,13 @@ class RoaringBitmap:
 
         return maximum_serialized_size(cardinality, universe_size)
 
+    def __reduce__(self):
+        """Pickle via the portable wire format — the Externalizable/Kryo
+        analogue (RoaringBitmap.java:2627/3287, README.md:285-312).
+        Subclasses (MutableRoaringBitmap, FastRankRoaringBitmap)
+        round-trip to their own type."""
+        return _roaring_from_bytes, (type(self), self.serialize())
+
     # ------------------------------------------------------------------
     def __eq__(self, other):
         if not isinstance(other, RoaringBitmap):
@@ -757,3 +764,10 @@ class RoaringBitmap:
         card = self.get_cardinality()
         head = ",".join(str(v) for v in self.to_array()[:10].tolist())
         return f"RoaringBitmap(card={card}, values=[{head}{'...' if card > 10 else ''}])"
+
+
+def _roaring_from_bytes(cls, blob: bytes) -> "RoaringBitmap":
+    """Pickle reconstructor: deserialize then adopt into the target class."""
+    out = cls()
+    out.high_low_container = RoaringBitmap.deserialize(blob).high_low_container
+    return out

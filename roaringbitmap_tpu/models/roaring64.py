@@ -107,12 +107,14 @@ class Roaring64NavigableMap:
         "_cum_cards",
         "_cum_dirty",
         "signed_longs",
+        "supplier",
     )
 
     def __init__(
         self,
         values: Optional[Iterable[int]] = None,
         signed_longs: bool = False,
+        supplier=None,
     ):
         self._buckets: dict = {}  # high32 -> RoaringBitmap
         self._keys: List[int] = []
@@ -121,6 +123,10 @@ class Roaring64NavigableMap:
         self._cum_cards: Optional[np.ndarray] = None
         self._cum_dirty = True
         self.signed_longs = signed_longs  # Roaring64NavigableMap.java:100
+        # pluggable per-bucket backend (BitmapDataProviderSupplier,
+        # Roaring64NavigableMap.java:63): any callable returning a
+        # RoaringBitmap-compatible instance, e.g. MutableRoaringBitmap
+        self.supplier = supplier or RoaringBitmap
         if values is not None:
             self.add_many(values)
 
@@ -166,7 +172,7 @@ class Roaring64NavigableMap:
     def _bucket_for_add(self, high: int) -> RoaringBitmap:
         b = self._buckets.get(high)
         if b is None:
-            b = RoaringBitmap()
+            b = self.supplier()
             self._buckets[high] = b
             self._keys_dirty = True
         return b
@@ -414,7 +420,7 @@ class Roaring64NavigableMap:
         return changed
 
     def clone(self) -> "Roaring64NavigableMap":
-        out = Roaring64NavigableMap(signed_longs=self.signed_longs)
+        out = Roaring64NavigableMap(signed_longs=self.signed_longs, supplier=self.supplier)
         out._buckets = {h: b.clone() for h, b in self._buckets.items()}
         out._keys_dirty = True
         return out
@@ -591,3 +597,20 @@ class Roaring64NavigableMap:
     remove_long = remove
     contains_long = contains
     get_long_cardinality = get_cardinality
+
+    def __reduce__(self):
+        """Pickle via the active SERIALIZATION_MODE wire format (the
+        Externalizable analogue, Roaring64NavigableMap.java:35-52).
+        signed_longs and the bucket supplier are config, not wire state,
+        so they ride alongside the bytes."""
+        mode = Roaring64NavigableMap.SERIALIZATION_MODE
+        supplier = None if self.supplier is RoaringBitmap else self.supplier
+        return _r64nm_unpickle, (self.serialize(mode), mode, self.signed_longs, supplier)
+
+
+def _r64nm_unpickle(blob, mode, signed, supplier=None):
+    out = Roaring64NavigableMap.deserialize(blob, mode)
+    out.signed_longs = signed
+    if supplier is not None:
+        out.supplier = supplier
+    return out

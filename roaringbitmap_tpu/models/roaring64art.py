@@ -521,3 +521,7 @@ class Roaring64Bitmap:
     remove_long = remove
     contains_long = contains
     get_long_cardinality = get_cardinality
+
+    def __reduce__(self):
+        """Pickle via the portable 64-bit wire format."""
+        return Roaring64Bitmap.deserialize, (self.serialize(),)

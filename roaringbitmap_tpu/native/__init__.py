@@ -82,6 +82,9 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.rb_runs_from_values.argtypes = [u16p, i32, u16p, u16p]
     lib.rb_num_runs_values.restype = i32
     lib.rb_num_runs_values.argtypes = [u16p, i32]
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    lib.rb_pack_array_rows.restype = None
+    lib.rb_pack_array_rows.argtypes = [i64p, i64p, i64, u16p, u64p]
 
 
 def _load():
@@ -238,3 +241,14 @@ def runs_from_values(values: np.ndarray):
 def num_runs_in_values(values: np.ndarray) -> int:
     v = _c16(values)
     return int(lib().rb_num_runs_values(v, v.size))
+
+
+def pack_array_rows(
+    row_ids: np.ndarray, offsets: np.ndarray, vals: np.ndarray, out64: np.ndarray
+) -> None:
+    """Scatter concatenated array-container values into [n_rows, 1024]-word
+    matrix rows in one native pass (parallel/store.pack_rows_host hot loop)."""
+    rows = np.ascontiguousarray(row_ids, dtype=np.int64)
+    offs = np.ascontiguousarray(offsets, dtype=np.int64)
+    v = _c16(vals)
+    lib().rb_pack_array_rows(rows, offs, rows.size, v, out64.reshape(-1))

@@ -253,4 +253,23 @@ int32_t rb_num_runs_values(const uint16_t* v, int32_t n) {
   return r;
 }
 
+// ---------------------------------------------------------------------------
+// batch packing (device-store marshal)
+// ---------------------------------------------------------------------------
+
+// Scatter many array containers' values into an [n_rows, 1024]-word matrix
+// in one pass: container j (values vals[offsets[j]:offsets[j+1]]) lands in
+// row row_ids[j]. The SoA packing hot loop of parallel/store.pack_rows_host.
+void rb_pack_array_rows(const int64_t* row_ids, const int64_t* offsets,
+                        int64_t n_containers, const uint16_t* vals,
+                        uint64_t* out) {
+  for (int64_t j = 0; j < n_containers; ++j) {
+    uint64_t* row = out + row_ids[j] * 1024;
+    for (int64_t i = offsets[j]; i < offsets[j + 1]; ++i) {
+      uint16_t v = vals[i];
+      row[v >> 6] |= 1ull << (v & 63);
+    }
+  }
+}
+
 }  // extern "C"

@@ -24,7 +24,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 import jax.numpy as jnp
 
-from ..models.container import BitmapContainer, Container
+from ..models.container import ArrayContainer, BitmapContainer, Container
 from ..models.roaring import RoaringBitmap
 from ..ops import device as dev
 from ..utils import bits
@@ -39,6 +39,45 @@ def container_words_u32(c: Container) -> np.ndarray:
     return np.ascontiguousarray(w, dtype=np.uint64).view(np.uint32)
 
 
+def pack_rows_host(containers: Sequence[Container]) -> np.ndarray:
+    """Expand containers into one uint32 [N, 2048] host array.
+
+    Vectorized toBitmapContainer (Container.java:987) for the packing hot
+    path: bitmap rows are bulk-copied, and all array-container values are
+    scattered in a single ``np.bitwise_or.at`` over the flattened word
+    matrix (one C-level pass over every value) instead of a per-container
+    python loop; run rows (rare in working sets that were not
+    run_optimized) fall back to per-container expansion."""
+    n = len(containers)
+    out64 = np.zeros((n, bits.WORDS_PER_CONTAINER), dtype=np.uint64)
+    arr_rows: List[int] = []
+    arr_vals: List[np.ndarray] = []
+    for i, c in enumerate(containers):
+        if isinstance(c, BitmapContainer):
+            out64[i] = c.words
+        elif isinstance(c, ArrayContainer):
+            arr_rows.append(i)
+            arr_vals.append(c.content)
+        else:
+            out64[i] = c.to_words()
+    if arr_rows:
+        from .. import native
+
+        lens = np.fromiter((v.size for v in arr_vals), np.int64, len(arr_vals))
+        vals = np.concatenate(arr_vals)
+        rows_np = np.asarray(arr_rows, dtype=np.int64)
+        if native.available():
+            offsets = np.concatenate(([0], np.cumsum(lens)))
+            native.pack_array_rows(rows_np, offsets, vals, out64)
+        else:
+            rows = np.repeat(rows_np, lens)
+            v = vals.astype(np.int64)
+            flat_idx = rows * bits.WORDS_PER_CONTAINER + (v >> 6)
+            bit = np.uint64(1) << (v & 63).astype(np.uint64)
+            np.bitwise_or.at(out64.reshape(-1), flat_idx, bit)
+    return out64.view(np.uint32)
+
+
 @dataclass
 class PackedGroups:
     """Key-grouped containers packed for device reduction.
@@ -48,7 +87,7 @@ class PackedGroups:
     ``group_offsets``: int64 [G+1] row ranges per group.
     """
 
-    words: jnp.ndarray
+    words: np.ndarray  # host uint32 [N, 2048]; shipped to device at reduce time
     group_keys: np.ndarray
     group_offsets: np.ndarray
 
@@ -59,6 +98,15 @@ class PackedGroups:
     @property
     def n_groups(self) -> int:
         return len(self.group_keys)
+
+    @property
+    def device_words(self) -> jnp.ndarray:
+        """The flat rows on device (transferred once, then cached)."""
+        d = getattr(self, "_device_words", None)
+        if d is None:
+            d = jnp.asarray(self.words)
+            object.__setattr__(self, "_device_words", d)
+        return d
 
 
 def group_by_key(
@@ -90,18 +138,14 @@ def intersect_keys(bitmaps: Sequence[RoaringBitmap]) -> set:
 
 
 def pack_groups(groups: Dict[int, List[Container]]) -> PackedGroups:
-    """Pack key-major groups into one device array (host -> device marshal)."""
+    """Pack key-major groups into one host SoA array; the device transfer
+    happens once in prepare_reduce after the layout choice, so rows are
+    shipped exactly once in whichever layout they'll be reduced in."""
     group_keys = np.array(sorted(groups), dtype=np.int64)
     counts = np.array([len(groups[int(k)]) for k in group_keys], dtype=np.int64)
     offsets = np.concatenate(([0], np.cumsum(counts)))
-    n = int(offsets[-1])
-    host = np.empty((n, dev.DEVICE_WORDS), dtype=np.uint32)
-    row = 0
-    for k in group_keys:
-        for c in groups[int(k)]:
-            host[row] = container_words_u32(c)
-            row += 1
-    return PackedGroups(jnp.asarray(host), group_keys, offsets)
+    rows = [c for k in group_keys for c in groups[int(k)]]
+    return PackedGroups(pack_rows_host(rows), group_keys, offsets)
 
 
 def prepare_reduce(packed: PackedGroups, op: str = "or"):
@@ -121,11 +165,10 @@ def prepare_reduce(packed: PackedGroups, op: str = "or"):
     m = int(counts.max()) if g else 0
     if g * m <= max(2 * n, 1024):
         fill = dev._INIT[op]
-        host = np.asarray(packed.words)
         padded = np.full((g, m, dev.DEVICE_WORDS), fill, dtype=np.uint32)
         for gi in range(g):
             s, e = int(packed.group_offsets[gi]), int(packed.group_offsets[gi + 1])
-            padded[gi, : e - s] = host[s:e]
+            padded[gi, : e - s] = packed.words[s:e]
         dev_arr = jnp.asarray(padded)
 
         def run():
@@ -137,7 +180,7 @@ def prepare_reduce(packed: PackedGroups, op: str = "or"):
     seg_start[packed.group_offsets[:-1]] = True
     seg = jnp.asarray(seg_start)
     end_rows = jnp.asarray(packed.group_offsets[1:] - 1)
-    words = packed.words
+    words = packed.device_words
 
     def run():
         vals = dev.segmented_reduce(words, seg, op=op)

@@ -1,0 +1,105 @@
+"""Pickle round-trips — the Externalizable/Kryo analogue (SURVEY §5
+checkpoint/resume: RoaringBitmap.java:2627/3287, Kryo recipe
+README.md:285-312). Every serializable facade must pickle to its own type
+through the portable wire format."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import (
+    FastRankRoaringBitmap,
+    ImmutableBitSliceIndex,
+    ImmutableRoaringBitmap,
+    MutableBitSliceIndex,
+    MutableRoaringBitmap,
+    RangeBitmap,
+    Roaring64Bitmap,
+    Roaring64BitmapSliceIndex,
+    Roaring64NavigableMap,
+    RoaringBitmap,
+    RoaringBitmapSliceIndex,
+    RoaringBitSet,
+)
+
+
+def roundtrip(obj):
+    back = pickle.loads(pickle.dumps(obj))
+    assert type(back) is type(obj)
+    return back
+
+
+@pytest.mark.parametrize("cls", [RoaringBitmap, MutableRoaringBitmap, FastRankRoaringBitmap])
+def test_roaring_family(cls):
+    b = cls()
+    b.add_many([0, 7, 65536, 1 << 20, (1 << 32) - 1])
+    b.run_optimize()
+    assert roundtrip(b) == b
+
+
+def test_empty():
+    assert roundtrip(RoaringBitmap()) == RoaringBitmap()
+
+
+def test_immutable():
+    src = RoaringBitmap(np.arange(100, 200, dtype=np.uint32))
+    imm = ImmutableRoaringBitmap(src.serialize())
+    back = roundtrip(imm)
+    assert back.get_cardinality() == 100 and back.serialize() == imm.serialize()
+
+
+@pytest.mark.parametrize("cls", [Roaring64Bitmap, Roaring64NavigableMap])
+def test_64bit(cls):
+    b = cls()
+    b.add_many([1, 2, 1 << 40, (1 << 63) + 5])
+    back = roundtrip(b)
+    assert back == b
+
+
+def test_64_signed_flag():
+    b = Roaring64NavigableMap(signed_longs=True)
+    b.add(5)
+    assert roundtrip(b).signed_longs is True
+
+
+@pytest.mark.parametrize(
+    "cls", [RoaringBitmapSliceIndex, MutableBitSliceIndex, Roaring64BitmapSliceIndex]
+)
+def test_bsi(cls):
+    bsi = cls()
+    bsi.set_values([(i, i * 37 % 1000) for i in range(500)])
+    back = roundtrip(bsi)
+    assert back.get_value(3) == bsi.get_value(3)
+    assert back.get_cardinality() == bsi.get_cardinality()
+
+
+def test_immutable_bsi():
+    base = MutableBitSliceIndex()
+    base.set_values([(i, i + 1) for i in range(100)])
+    imm = ImmutableBitSliceIndex(base.serialize())
+    back = roundtrip(imm)
+    assert back.get_value(50) == imm.get_value(50)
+
+
+def test_range_bitmap():
+    app = RangeBitmap.appender(10_000)
+    app.add_many(range(0, 10_000, 3))
+    rb = app.build()
+    back = roundtrip(rb)
+    assert back.lte_cardinality(5000) == rb.lte_cardinality(5000)
+
+
+def test_bitset():
+    bs = RoaringBitSet()
+    bs.set_range(10, 50)
+    assert roundtrip(bs) == bs
+
+
+def test_64_supplier_survives_pickle():
+    m = Roaring64NavigableMap(supplier=MutableRoaringBitmap)
+    m.add(5)
+    back = pickle.loads(pickle.dumps(m))
+    assert back.supplier is MutableRoaringBitmap
+    back.add(1 << 40)
+    assert type(back._buckets[1 << 8]) is MutableRoaringBitmap
