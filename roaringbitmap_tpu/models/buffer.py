@@ -72,6 +72,8 @@ class MutableRoaringBitmap(RoaringBitmap):
     def bitmap_of(*values: int) -> "MutableRoaringBitmap":
         return MutableRoaringBitmap._adopt(RoaringBitmap.bitmap_of(*values))
 
+    bitmap_of_unordered = bitmap_of
+
     @staticmethod
     def bitmap_of_range(start: int, end: int) -> "MutableRoaringBitmap":
         return MutableRoaringBitmap._adopt(RoaringBitmap.bitmap_of_range(start, end))

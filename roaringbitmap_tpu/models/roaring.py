@@ -69,6 +69,21 @@ class RoaringBitmap:
         out.add_range(start, end)
         return out
 
+    # bitmapOfUnordered: add_many sorts internally, so one name serves both
+    bitmap_of_unordered = bitmap_of
+
+    def add_n(self, values, offset: int = 0, n: Optional[int] = None) -> None:
+        """Add a slice of a value array (RoaringBitmap.addN(vals, offset, n))."""
+        v = np.asarray(values).ravel()
+        self.add_many(v[offset : None if n is None else offset + n])
+
+    def to_mutable_roaring_bitmap(self):
+        """Deep-copy into the buffer-world mutable twin
+        (RoaringBitmap.toMutableRoaringBitmap)."""
+        from .buffer import MutableRoaringBitmap
+
+        return MutableRoaringBitmap.of(self)
+
     def clone(self) -> "RoaringBitmap":
         out = RoaringBitmap()
         out.high_low_container = self.high_low_container.clone()
@@ -424,6 +439,8 @@ class RoaringBitmap:
     def get_cardinality(self) -> int:
         return sum(c.cardinality for c in self.high_low_container.containers)
 
+    get_long_cardinality = get_cardinality  # getLongCardinality alias
+
     def is_empty(self) -> bool:
         return self.high_low_container.size == 0
 
@@ -468,6 +485,85 @@ class RoaringBitmap:
             raise ValueError("empty bitmap")
         hlc = self.high_low_container
         return (hlc.keys[-1] << 16) | hlc.containers[-1].last()
+
+    def first_signed(self) -> int:
+        """Smallest value in signed-int32 order (RoaringBitmap.firstSigned):
+        the first value >= 2^31 if any negative-half values exist."""
+        v = self.next_value(1 << 31)
+        if v >= 0:
+            return v - _MAX32
+        return self.first()
+
+    def last_signed(self) -> int:
+        """Largest value in signed-int32 order (RoaringBitmap.lastSigned)."""
+        v = self.previous_value((1 << 31) - 1)
+        if v >= 0:
+            return v
+        return self.last() - _MAX32
+
+    def cardinality_exceeds(self, threshold: int) -> bool:
+        """True once the running cardinality passes threshold, without
+        visiting remaining containers (RoaringBitmap.cardinalityExceeds)."""
+        total = 0
+        for c in self.high_low_container.containers:
+            total += c.cardinality
+            if total > threshold:
+                return True
+        return False
+
+    def clear(self) -> None:
+        """Empty the bitmap in place (RoaringBitmap.clear)."""
+        from .roaring_array import RoaringArray
+
+        self.high_low_container = RoaringArray()
+
+    def trim(self) -> None:
+        """Release excess capacity (RoaringBitmap.trim). Storage here is
+        exact-sized numpy arrays, so this is a documented no-op."""
+
+    def for_each(self, consumer) -> None:
+        """Visit every value in ascending order (RoaringBitmap.forEach,
+        IntConsumer contract)."""
+        for k, c in zip(self.high_low_container.keys, self.high_low_container.containers):
+            base = k << 16
+            for v in c.to_array().tolist():
+                consumer(base | v)
+
+    def _values_in_value_range(self, start: int, end: int) -> "RoaringBitmap":
+        """Members with start <= value < end, as a bitmap (cheap: the range
+        mask is a handful of run containers)."""
+        if start >= end:
+            return RoaringBitmap()
+        return RoaringBitmap.and_(self, RoaringBitmap.bitmap_of_range(start, end))
+
+    def for_each_in_range(self, start: int, end: int, consumer) -> None:
+        """Visit every *present* value in [start, end) ascending
+        (RoaringBitmap.forEachInRange)."""
+        start, end = _check_range(start, end)
+        for v in self._values_in_value_range(start, end):
+            consumer(v)
+
+    def for_all_in_range(self, start: int, end: int, consumer) -> None:
+        """Visit every *position* in [start, end) with its membership —
+        the RelativeRangeConsumer contract (RoaringBitmap.forAllInRange):
+        ``consumer(relative_pos, present)``. Streams per 2^16-chunk so wide
+        ranges stay O(chunk) in memory, like the Java per-container walk."""
+        start, end = _check_range(start, end)
+        for cs in range(start, end, 1 << 16):
+            ce = min(cs + (1 << 16), end)
+            present = self._values_in_value_range(cs, ce)
+            flags = np.zeros(ce - cs, dtype=bool)
+            if present.get_cardinality():
+                flags[present.to_array().astype(np.int64) - cs] = True
+            base = cs - start
+            for pos, flag in enumerate(flags):
+                consumer(base + pos, bool(flag))
+
+    def get_container_pointer(self) -> "ContainerPointer":
+        """Ordered cursor over (key, container) pairs — the SPI used by
+        horizontal aggregation (ContainerPointer.java, RoaringBitmap
+        .getContainerPointer)."""
+        return ContainerPointer(self)
 
     def next_value(self, from_value: int) -> int:
         """Smallest value >= from_value, or -1 (RoaringBitmap.java:2838)."""
@@ -666,6 +762,20 @@ class RoaringBitmap:
 
         return PeekableIntIterator(self)
 
+    def get_signed_int_iterator(self) -> Iterator[int]:
+        """Values in signed-int32 order: negative half (>= 2^31, as
+        negatives) first (RoaringBitmap.getSignedIntIterator). The first
+        pass container-skips straight to the negative half."""
+        half = 1 << 31
+        it = self.get_int_iterator()
+        it.advance_if_needed(half)
+        while it.has_next():
+            yield it.next() - _MAX32
+        for v in self:
+            if v >= half:
+                break
+            yield v
+
     def get_reverse_int_iterator(self):
         """Descending iterator (getReverseIntIterator)."""
         from .iterators import ReverseIntIterator
@@ -723,6 +833,12 @@ class RoaringBitmap:
 
         return serialize(self)
 
+    def serialized_size_in_bytes(self) -> int:
+        """Exact byte size of serialize() (RoaringBitmap.serializedSizeInBytes)."""
+        from ..serialization import serialized_size_in_bytes
+
+        return serialized_size_in_bytes(self)
+
     @staticmethod
     def deserialize(data) -> "RoaringBitmap":
         from ..serialization import deserialize
@@ -771,3 +887,46 @@ def _roaring_from_bytes(cls, blob: bytes) -> "RoaringBitmap":
     out = cls()
     out.high_low_container = RoaringBitmap.deserialize(blob).high_low_container
     return out
+
+
+class ContainerPointer:
+    """Ordered cursor over a bitmap's (key, container) pairs
+    (ContainerPointer.java:62): the SPI horizontal aggregation uses to
+    merge many bitmaps key-by-key. ``key()`` is None when exhausted."""
+
+    __slots__ = ("_hlc", "_i")
+
+    def __init__(self, bm: "RoaringBitmap"):
+        self._hlc = bm.high_low_container
+        self._i = 0
+
+    def key(self) -> Optional[int]:
+        return self._hlc.keys[self._i] if self._i < self._hlc.size else None
+
+    def get_container(self) -> Optional["Container"]:
+        return (
+            self._hlc.get_container_at_index(self._i)
+            if self._i < self._hlc.size
+            else None
+        )
+
+    def get_cardinality(self) -> int:
+        c = self.get_container()
+        return c.cardinality if c is not None else 0
+
+    def is_bitmap_container(self) -> bool:
+        return isinstance(self.get_container(), BitmapContainer)
+
+    def is_run_container(self) -> bool:
+        return isinstance(self.get_container(), RunContainer)
+
+    def advance(self) -> None:
+        self._i += 1
+
+    def __lt__(self, other: "ContainerPointer") -> bool:
+        a, b = self.key(), other.key()
+        if a is None:
+            return False
+        if b is None:
+            return True
+        return a < b

@@ -306,6 +306,16 @@ class Roaring64NavigableMap:
     xor_inplace = ixor
     andnot_inplace = iandnot
 
+    def naive_lazy_or(self, other: "Roaring64NavigableMap") -> "Roaring64NavigableMap":
+        """naivelazyor (Roaring64NavigableMap.java:730). The rank caches
+        here are already invalidated lazily and rebuilt on demand, so the
+        lazy protocol is structurally free: this IS ior."""
+        return self.ior(other)
+
+    def repair_after_lazy(self) -> None:
+        """repairAfterLazy (Roaring64NavigableMap.java:1160) — a no-op:
+        cumulative cardinalities rebuild on next rank/select."""
+
     @staticmethod
     def or_(a: "Roaring64NavigableMap", b: "Roaring64NavigableMap") -> "Roaring64NavigableMap":
         return a.clone().ior(b)
@@ -613,4 +623,10 @@ def _r64nm_unpickle(blob, mode, signed, supplier=None):
     out.signed_longs = signed
     if supplier is not None:
         out.supplier = supplier
+        # re-adopt the deserialized buckets into the supplier's type so the
+        # BitmapDataProviderSupplier contract survives the round trip
+        for k, b in out._buckets.items():
+            nb = supplier()
+            nb.high_low_container = b.high_low_container
+            out._buckets[k] = nb
     return out

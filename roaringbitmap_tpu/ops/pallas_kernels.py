@@ -43,10 +43,15 @@ def _reduce_rows(x, op):
     return x[0]
 
 
-def _make_kernel(op):
+def _make_kernel(op, grouped: bool = False):
+    """Init/accumulate reduction kernel. ``grouped`` blocks are
+    [1, ROW_TILE, W] with the row-tile axis as grid dim 1 (innermost, so
+    the output block is the per-group VMEM accumulator); wide blocks are
+    [ROW_TILE, W] with the tile axis as grid dim 0."""
+
     def kernel(x_ref, o_ref):
-        i = pl.program_id(0)
-        tile = _reduce_rows(x_ref[...], op)
+        i = pl.program_id(1 if grouped else 0)
+        tile = _reduce_rows(x_ref[0] if grouped else x_ref[...], op)
 
         @pl.when(i == 0)
         def _init():
@@ -97,6 +102,46 @@ def wide_reduce_cardinality_pallas(words, op: str = "or", interpret: bool = Fals
     return red, card
 
 
+@functools.partial(jax.jit, static_argnames=("op", "interpret"))
+def grouped_reduce_pallas(words3, op: str = "or", interpret: bool = False):
+    """Padded grouped reduce ``[G, M, 2048] -> [G, 2048]`` as one kernel.
+
+    Grid is (G, M-tiles) with the M axis innermost, so for each group the
+    output block stays resident in VMEM as the accumulator across its row
+    tiles (TPU grids run sequentially). This is the device analogue of
+    ParallelAggregation's per-key fold, all keys in one launch."""
+    fn = {"or": lax.bitwise_or, "and": lax.bitwise_and, "xor": lax.bitwise_xor}[op]
+    g, m, w = words3.shape
+    pad = (-m) % ROW_TILE
+    if pad:
+        fill = dev._INIT[op]
+        words3 = jnp.concatenate(
+            [words3, jnp.full((g, pad, w), fill, dtype=words3.dtype)], axis=1
+        )
+    m_tiles = words3.shape[1] // ROW_TILE
+    out = pl.pallas_call(
+        _make_kernel(fn, grouped=True),
+        out_shape=jax.ShapeDtypeStruct((g, w), words3.dtype),
+        grid=(g, m_tiles),
+        in_specs=[
+            pl.BlockSpec(
+                (1, ROW_TILE, w), lambda gi, mi: (gi, mi, 0), memory_space=pltpu.VMEM
+            )
+        ],
+        out_specs=pl.BlockSpec((1, w), lambda gi, mi: (gi, 0), memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(words3)
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("op", "interpret"))
+def grouped_reduce_cardinality_pallas(words3, op: str = "or", interpret: bool = False):
+    """Fused grouped reduce + per-group cardinality."""
+    red = grouped_reduce_pallas(words3, op=op, interpret=interpret)
+    card = jnp.sum(lax.population_count(red).astype(jnp.int32), axis=-1)
+    return red, card
+
+
 def on_tpu() -> bool:
     return jax.default_backend() not in ("cpu",)
 
@@ -106,3 +151,10 @@ def best_wide_reduce(words, op: str = "or"):
     if HAS_PALLAS and on_tpu():
         return wide_reduce_cardinality_pallas(words, op=op)
     return dev.wide_reduce_with_cardinality(words, op=op)
+
+
+def best_grouped_reduce(words3, op: str = "or"):
+    """Pick the Pallas grouped kernel on TPU, XLA reduce elsewhere."""
+    if HAS_PALLAS and on_tpu():
+        return grouped_reduce_cardinality_pallas(words3, op=op)
+    return dev.grouped_reduce_with_cardinality(words3, op=op)

@@ -172,7 +172,9 @@ def prepare_reduce(packed: PackedGroups, op: str = "or"):
         dev_arr = jnp.asarray(padded)
 
         def run():
-            return dev.grouped_reduce_with_cardinality(dev_arr, op=op)
+            from ..ops import pallas_kernels as pk
+
+            return pk.best_grouped_reduce(dev_arr, op=op)
 
         return run, "padded"
 

@@ -119,3 +119,24 @@ def test_pallas_wide_reduce_interpret():
         want = npop.reduce(host, axis=0)
         assert np.array_equal(np.ascontiguousarray(np.asarray(red)).view(np.uint64), want)
         assert int(card) == int(bits.popcount64(want).sum())
+
+
+def test_pallas_grouped_reduce_interpret():
+    """Grouped Pallas kernel vs numpy per-group fold (interpreter mode)."""
+    from roaringbitmap_tpu.ops import pallas_kernels as pk
+
+    if not pk.HAS_PALLAS:
+        pytest.skip("pallas unavailable")
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(42)
+    g, m = 3, 300  # m not a multiple of the tile -> exercises padding
+    host = rng.integers(0, 1 << 32, size=(g, m, 2048), dtype=np.uint64).astype(np.uint32)
+    for op, fold in [("or", np.bitwise_or), ("and", np.bitwise_and), ("xor", np.bitwise_xor)]:
+        red, card = pk.grouped_reduce_cardinality_pallas(
+            jnp.asarray(host), op=op, interpret=True
+        )
+        want = fold.reduce(host, axis=1)
+        assert np.array_equal(np.asarray(red), want), op
+        want_cards = [int(np.unpackbits(want[i].view(np.uint8)).sum()) for i in range(g)]
+        assert np.asarray(card).tolist() == want_cards, op

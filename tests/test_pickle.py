@@ -101,5 +101,7 @@ def test_64_supplier_survives_pickle():
     m.add(5)
     back = pickle.loads(pickle.dumps(m))
     assert back.supplier is MutableRoaringBitmap
+    # pre-existing buckets are re-adopted into the supplier's type too
+    assert type(back._buckets[0]) is MutableRoaringBitmap
     back.add(1 << 40)
     assert type(back._buckets[1 << 8]) is MutableRoaringBitmap
