@@ -99,6 +99,12 @@ class MutableRoaringBitmap(RoaringBitmap):
         """Freeze into a buffer-backed immutable (one serialization pass)."""
         return ImmutableRoaringBitmap(self.serialize())
 
+    to_immutable_roaring_bitmap = to_immutable  # reference naming
+
+    def get_mappeable_roaring_array(self):
+        """The backing index (MutableRoaringBitmap.getMappeableRoaringArray)."""
+        return self.high_low_container
+
     def as_immutable_view(self) -> "ImmutableView":
         """O(1) cast to a read-only view sharing this bitmap's containers."""
         return ImmutableView(self)

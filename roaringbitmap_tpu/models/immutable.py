@@ -79,6 +79,10 @@ class ImmutableRoaringArray:
         PointableRoaringArray.java:25)."""
         return bisect_left(self.keys, key, pos + 1)
 
+    def get_container(self, key: int) -> Optional[Container]:
+        i = self.get_index(key)
+        return self.get_container_at_index(i) if i >= 0 else None
+
     def items(self):
         return [(self.keys[i], self.get_container_at_index(i)) for i in range(self.size)]
 
@@ -109,9 +113,45 @@ class ImmutableRoaringBitmap:
     zero-copy numpy views into the source buffer.
     """
 
-    __slots__ = ("_buf", "_keys", "_cards", "_types", "_offsets", "_size", "_hlc")
+    __slots__ = ("_buf", "_keys", "_cards", "_types", "_offsets", "_size", "_hlc", "_ro")
 
     ARRAY, BITMAP, RUN = 0, 1, 2
+
+    # Read-only facade methods borrowed from RoaringBitmap via __getattr__:
+    # they run zero-copy over the mapped containers (the high_low_container
+    # duck-type), covering the reference ImmutableRoaringBitmap query
+    # surface without a second 2k-line twin class.
+    _DELEGATED_READS = frozenset(
+        {
+            "rank_long",
+            "next_value",
+            "previous_value",
+            "next_absent_value",
+            "previous_absent_value",
+            "first_signed",
+            "last_signed",
+            "cardinality_exceeds",
+            "contains_range",
+            "intersects_range",
+            "range_cardinality",
+            "limit",
+            "select_range",
+            "has_run_compression",
+            "is_hamming_similar",
+            "contains_bitmap",
+            "get_int_iterator",
+            "get_reverse_int_iterator",
+            "get_int_rank_iterator",
+            "get_batch_iterator",
+            "batch_iterator",
+            "get_signed_int_iterator",
+            "for_each",
+            "for_each_in_range",
+            "for_all_in_range",
+            "get_container_pointer",
+            "trim",
+        }
+    )
 
     def __init__(self, source: Source, offset: int = 0):
         if isinstance(source, np.ndarray):
@@ -347,6 +387,58 @@ class ImmutableRoaringBitmap:
         return hash(self.to_array().tobytes())
 
     # ------------------------------------------------------------------
+    def _readonly_facade(self) -> RoaringBitmap:
+        """A RoaringBitmap whose high_low_container IS the mapped array —
+        shared read-only view, no copy."""
+        try:
+            return self._ro
+        except AttributeError:
+            rb = RoaringBitmap.__new__(RoaringBitmap)
+            rb.high_low_container = self.high_low_container
+            self._ro = rb
+            return rb
+
+    def __getattr__(self, name):
+        if name in ImmutableRoaringBitmap._DELEGATED_READS:
+            return getattr(self._readonly_facade(), name)
+        raise AttributeError(
+            f"{type(self).__name__!r} object has no attribute {name!r}"
+            + (" (immutable: mutators unavailable)" if hasattr(RoaringBitmap(), name) else "")
+        )
+
+    # -- statics mirroring the reference's (results are heap bitmaps) ------
+    @staticmethod
+    def bitmap_of(*values: int) -> "ImmutableRoaringBitmap":
+        return ImmutableRoaringBitmap(RoaringBitmap.bitmap_of(*values).serialize())
+
+    bitmap_of_unordered = bitmap_of
+
+    @staticmethod
+    def flip(bm, start: int, end: int) -> RoaringBitmap:
+        return RoaringBitmap.flip(_heap(bm), start, end)
+
+    @staticmethod
+    def or_not(x1, x2, range_end: int) -> RoaringBitmap:
+        return RoaringBitmap.or_not(_heap(x1), _heap(x2), range_end)
+
+    @staticmethod
+    def xor_cardinality(x1, x2) -> int:
+        return ImmutableRoaringBitmap.xor(x1, x2).get_cardinality()
+
+    @staticmethod
+    def andnot_cardinality(x1, x2) -> int:
+        return ImmutableRoaringBitmap.andnot(x1, x2).get_cardinality()
+
+    def to_roaring_bitmap(self) -> RoaringBitmap:
+        """Deep copy to a heap RoaringBitmap (toRoaringBitmap)."""
+        return self.to_mutable()
+
+    def to_mutable_roaring_bitmap(self):
+        """Deep copy to the buffer-world mutable twin."""
+        from .buffer import MutableRoaringBitmap
+
+        return MutableRoaringBitmap.of(self)
+
     def to_mutable(self) -> RoaringBitmap:
         """Deep copy into a mutable RoaringBitmap
         (ImmutableRoaringBitmap.toMutableRoaringBitmap)."""
@@ -378,3 +470,9 @@ class ImmutableRoaringBitmap:
 
     def __repr__(self):
         return f"ImmutableRoaringBitmap(card={self.get_cardinality()}, containers={self._size})"
+
+
+def _heap(bm) -> RoaringBitmap:
+    """Heap copy of a mapped bitmap (identity for heap operands) for the
+    clone-then-mutate statics (flip, or_not)."""
+    return bm.to_mutable() if isinstance(bm, ImmutableRoaringBitmap) else bm

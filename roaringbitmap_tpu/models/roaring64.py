@@ -312,6 +312,8 @@ class Roaring64NavigableMap:
         lazy protocol is structurally free: this IS ior."""
         return self.ior(other)
 
+    naivelazyor = naive_lazy_or  # exact reference spelling
+
     def repair_after_lazy(self) -> None:
         """repairAfterLazy (Roaring64NavigableMap.java:1160) — a no-op:
         cumulative cardinalities rebuild on next rank/select."""
@@ -455,6 +457,65 @@ class Roaring64NavigableMap:
     def get_high_to_bitmap_count(self) -> int:
         """Bucket count (getHighToBitmap().size() analogue)."""
         return len(self._buckets)
+
+    # -- reference long-tail surface (Roaring64NavigableMap.java) ---------
+    def add_int(self, x: int) -> None:
+        """addInt: the int zero-extended to a long (Util.toUnsignedLong)."""
+        self.add(int(x) & 0xFFFFFFFF)
+
+    def get_int_cardinality(self) -> int:
+        """getIntCardinality (:330): cardinality, if it fits a signed int."""
+        card = self.get_cardinality()
+        if card > (1 << 31) - 1:
+            raise OverflowError("cardinality exceeds 32-bit int")
+        return card
+
+    def get_long_iterator(self) -> Iterator[int]:
+        return iter(self)
+
+    def get_reverse_long_iterator(self) -> Iterator[int]:
+        for k in reversed(self._sorted_keys()):
+            base = k << 32
+            for v in reversed(self._buckets[k]):
+                yield base | v
+
+    def for_each(self, consumer) -> None:
+        for v in self:
+            consumer(v)
+
+    def limit(self, max_cardinality: int) -> "Roaring64NavigableMap":
+        """First max_cardinality values as a new map (limit analogue)."""
+        out = Roaring64NavigableMap(signed_longs=self.signed_longs, supplier=self.supplier)
+        remaining = int(max_cardinality)
+        for k in self._sorted_keys():
+            if remaining <= 0:
+                break
+            b = self._buckets[k]
+            card = b.get_cardinality()
+            take = b.clone() if card <= remaining else b.limit(remaining)
+            out._buckets[k] = take
+            out._keys_dirty = True
+            remaining -= take.get_cardinality()
+        return out
+
+    def clear(self) -> None:
+        """Empty in place (Roaring64NavigableMap.clear)."""
+        self._buckets = {}
+        self._keys = []
+        self._ckeys = None
+        self._keys_dirty = False
+        self._invalidate()
+
+    empty = clear  # reference also exposes empty()
+
+    def trim(self) -> None:
+        """No-op: numpy storage is exact-sized."""
+
+    def get_size_in_bytes(self) -> int:
+        """In-memory estimate: bucket payloads + per-bucket key overhead."""
+        return sum(8 + b.get_size_in_bytes() for b in self._buckets.values())
+
+    get_long_size_in_bytes = get_size_in_bytes
 
     # ------------------------------------------------------------------
     # serialization (portable 64-bit spec)

@@ -412,6 +412,135 @@ class Roaring64Bitmap:
         NavigableMap's bucket count."""
         return len(self._art)
 
+    # -- reference long-tail surface (Roaring64Bitmap.java) ----------------
+    def add_int(self, x: int) -> None:
+        """addInt: the int zero-extended to a long."""
+        self.add(int(x) & 0xFFFFFFFF)
+
+    def get_int_cardinality(self) -> int:
+        card = self.get_cardinality()
+        if card > (1 << 31) - 1:
+            raise OverflowError("cardinality exceeds 32-bit int")
+        return card
+
+    def get_long_iterator(self) -> Iterator[int]:
+        return iter(self)
+
+    def get_long_iterator_from(self, min_value: int) -> Iterator[int]:
+        """Values >= min_value ascending (getLongIteratorFrom)."""
+        min_value = int(min_value)
+        min_key = min_value >> 16
+        for k, c in self._kv():
+            base = key_to_int(k) << 16
+            if (base >> 16) < min_key:
+                continue
+            for v in c:
+                val = base | v
+                if val >= min_value:
+                    yield val
+
+    def get_reverse_long_iterator(self) -> Iterator[int]:
+        for k, c in self._kv_reversed():
+            base = key_to_int(k) << 16
+            for v in reversed(c.to_array().tolist()):
+                yield base | v
+
+    def get_reverse_long_iterator_from(self, max_value: int) -> Iterator[int]:
+        """Values <= max_value descending (getReverseLongIteratorFrom)."""
+        max_value = int(max_value)
+        max_key = max_value >> 16
+        for k, c in self._kv_reversed():
+            base = key_to_int(k) << 16
+            if (base >> 16) > max_key:
+                continue
+            for v in reversed(c.to_array().tolist()):
+                val = base | v
+                if val <= max_value:
+                    yield val
+
+    def _kv_reversed(self):
+        return reversed(list(self._kv()))
+
+    def for_each(self, consumer) -> None:
+        for v in self:
+            consumer(v)
+
+    @staticmethod
+    def _check_range64(start: int, end: int):
+        start, end = int(start), int(end)
+        if not 0 <= start <= end <= (1 << 64):
+            raise ValueError(f"invalid range [{start}, {end})")
+        return start, end
+
+    def for_each_in_range(self, start: int, end: int, consumer) -> None:
+        """Visit present values in [start, end) ascending. NOTE: half-open
+        end, not the reference's (start, length) pair."""
+        start, end = self._check_range64(start, end)
+        for v in self.get_long_iterator_from(start):
+            if v >= end:
+                break
+            consumer(v)
+
+    def for_all_in_range(self, start: int, end: int, consumer) -> None:
+        """consumer(relative_pos, present) for every position in
+        [start, end) — RelativeRangeConsumer contract. Values stream from
+        the from-iterator; positions are a flat walk, so memory stays O(1)."""
+        start, end = self._check_range64(start, end)
+        it = self.get_long_iterator_from(start)
+        nxt = next(it, None)
+        for pos in range(end - start):
+            val = start + pos
+            present = nxt == val
+            if present:
+                nxt = next(it, None)
+            consumer(pos, present)
+
+    def limit(self, max_cardinality: int) -> "Roaring64Bitmap":
+        """First max_cardinality values: whole containers are adopted and
+        only the last partial one is truncated (like the 32-bit limit)."""
+        out = Roaring64Bitmap()
+        remaining = int(max_cardinality)
+        for k, c in self._kv():
+            if remaining <= 0:
+                break
+            if c.cardinality <= remaining:
+                taken = c.clone()
+            else:
+                taken = container_from_values(c.to_array()[:remaining])
+            out._put(k, taken)
+            remaining -= taken.cardinality
+        return out
+
+    def clear(self) -> None:
+        """Empty in place (Roaring64Bitmap.clear)."""
+        self.__init__()
+
+    empty = clear
+
+    def trim(self) -> None:
+        """No-op: numpy storage is exact-sized."""
+
+    def get_size_in_bytes(self) -> int:
+        return sum(8 + c.serialized_size() for _, c in self._kv())
+
+    get_long_size_in_bytes = get_size_in_bytes
+
+    @staticmethod
+    def and_cardinality(a: "Roaring64Bitmap", b: "Roaring64Bitmap") -> int:
+        return Roaring64Bitmap.and_(a, b).get_cardinality()
+
+    @staticmethod
+    def or_cardinality(a: "Roaring64Bitmap", b: "Roaring64Bitmap") -> int:
+        return Roaring64Bitmap.or_(a, b).get_cardinality()
+
+    @staticmethod
+    def xor_cardinality(a: "Roaring64Bitmap", b: "Roaring64Bitmap") -> int:
+        return Roaring64Bitmap.xor(a, b).get_cardinality()
+
+    @staticmethod
+    def andnot_cardinality(a: "Roaring64Bitmap", b: "Roaring64Bitmap") -> int:
+        return Roaring64Bitmap.andnot(a, b).get_cardinality()
+
     # ------------------------------------------------------------------
     # serialization — portable 64-bit spec via high-32 grouping
     # ------------------------------------------------------------------

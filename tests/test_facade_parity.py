@@ -40,20 +40,58 @@ SUBSTITUTIONS = {
 }
 
 
-@pytest.mark.skipif(not os.path.isfile(REF), reason="reference not mounted")
-def test_all_reference_public_methods_have_counterparts():
-    src = open(REF).read()
-    names = sorted(set(re.findall(r"public (?:static )?[\w<>\[\]]+ (\w+)\(", src)))
-    bm = RoaringBitmap()
+def _parity_missing(java_path, obj, extra=None):
+    src = open(java_path).read()
+    names = sorted(
+        set(re.findall(r"public (?:static )?(?:synchronized )?[\w<>\[\],\s]+? (\w+)\(", src))
+    )
+    alias = dict(SUBSTITUTIONS)
+    # nested-class methods and python idioms common to all facades
+    alias.update({"accept": "", "init": ""})
+    if extra:
+        alias.update(extra)
     missing = []
     for n in names:
-        mapped = SUBSTITUTIONS.get(n)
+        mapped = alias.get(n)
         if mapped == "":
             continue
         snake = re.sub(r"(?<!^)(?=[A-Z])", "_", n).lower()
-        if not any(hasattr(bm, c) for c in {mapped or snake, snake}):
+        cands = {mapped or snake, snake, snake.replace("_long", "").replace("long_", "")}
+        if not any(hasattr(obj, c) for c in cands if c):
             missing.append(n)
+    return missing
+
+
+BASE = "/root/reference/RoaringBitmap/src/main/java/org/roaringbitmap/"
+needs_ref = pytest.mark.skipif(not os.path.isfile(REF), reason="reference not mounted")
+
+
+@needs_ref
+def test_all_reference_public_methods_have_counterparts():
+    missing = _parity_missing(REF, RoaringBitmap())
     assert not missing, f"no counterpart for: {missing}"
+
+
+@needs_ref
+def test_buffer_and_64bit_facade_parity():
+    import roaringbitmap_tpu as r
+
+    checks = [
+        (BASE + "buffer/MutableRoaringBitmap.java", r.MutableRoaringBitmap(), None),
+        (
+            BASE + "buffer/ImmutableRoaringBitmap.java",
+            r.ImmutableRoaringBitmap(RoaringBitmap.bitmap_of(1).serialize()),
+            {"andNotCardinality": "andnot_cardinality", "remove": ""},  # Iterator.remove
+        ),
+        (BASE + "longlong/Roaring64NavigableMap.java", r.Roaring64NavigableMap(), None),
+        (BASE + "longlong/Roaring64Bitmap.java", r.Roaring64Bitmap(), None),
+    ]
+    problems = {}
+    for path, obj, extra in checks:
+        missing = _parity_missing(path, obj, extra)
+        if missing:
+            problems[type(obj).__name__] = missing
+    assert not problems, f"no counterpart for: {problems}"
 
 
 @pytest.fixture
@@ -141,3 +179,59 @@ def test_for_all_in_range_chunk_boundary():
     got = []
     b.for_all_in_range(65530, 65540, lambda p, f: got.append((p, f)))
     assert [p for p, f in got if f] == [5, 6] and len(got) == 10
+
+
+def test_immutable_zero_copy_read_surface():
+    import numpy as np
+
+    from roaringbitmap_tpu import ImmutableRoaringBitmap
+
+    src = RoaringBitmap(np.arange(100, 70000, 7, dtype=np.uint32))
+    src.run_optimize()
+    imm = ImmutableRoaringBitmap(src.serialize())
+    assert imm.rank_long(5000) == src.rank_long(5000)
+    assert imm.next_value(101) == src.next_value(101)
+    assert imm.range_cardinality(0, 10000) == src.range_cardinality(0, 10000)
+    assert imm.select_range(3, 10) == src.select_range(3, 10)
+    assert imm.has_run_compression() == src.has_run_compression()
+    it = imm.get_int_iterator()
+    assert it.has_next() and it.next() == 100
+    assert imm.to_roaring_bitmap() == src
+    flipped = ImmutableRoaringBitmap.flip(imm, 0, 10)
+    assert flipped.get_cardinality() == src.get_cardinality() + 10
+    with pytest.raises(AttributeError, match="immutable"):
+        imm.add(5)
+
+
+def test_64bit_iterators_and_limits():
+    from roaringbitmap_tpu import Roaring64Bitmap, Roaring64NavigableMap
+
+    m = Roaring64NavigableMap([1, 2, (1 << 40) + 3])
+    assert list(m.get_reverse_long_iterator()) == [(1 << 40) + 3, 2, 1]
+    assert m.limit(2).to_array().tolist() == [1, 2]
+    m.add_int(0xFFFFFFFF)
+    assert m.get_int_cardinality() == 4
+
+    b = Roaring64Bitmap([5, 70000, (1 << 40) + 9])
+    assert list(b.get_long_iterator_from(70000)) == [70000, (1 << 40) + 9]
+    assert list(b.get_reverse_long_iterator_from(70000)) == [70000, 5]
+    flags = []
+    b.for_all_in_range(4, 8, lambda p, f: flags.append(f))
+    assert flags == [False, True, False, False]
+    assert Roaring64Bitmap.and_cardinality(b, b) == 3
+    b.clear()
+    assert b.is_empty()
+
+
+def test_64bit_range_validation_and_limit():
+    from roaringbitmap_tpu import Roaring64Bitmap
+
+    b = Roaring64Bitmap(range(100, 200))
+    with pytest.raises(ValueError):
+        b.for_all_in_range(1000, 50, lambda p, f: None)
+    with pytest.raises(ValueError):
+        b.for_each_in_range(1000, 50, lambda v: None)
+    assert b.limit(30).to_array().tolist() == list(range(100, 130))
+    big = Roaring64Bitmap()
+    big.add_range(0, 70000)  # spans two containers
+    assert big.limit(65540).get_cardinality() == 65540
