@@ -620,6 +620,45 @@ def _scan_body(carry, xs):
     return (gt_new, lt_new, eq_new), None
 
 
+def o_neil_math(slices_w, bits_rev, ebm_w, fixed_w, op_name: str):
+    """The pure O'Neil slice walk + epilogue: [S, K, 2048] slices ->
+    ([K, 2048] result words, [K] cardinalities). Elementwise over the
+    key-chunk and word axes (the scan carries only along S), so it is
+    directly shard_map-able across a device mesh with no communication
+    except a words-axis psum of the cards (parallel/sharding.py)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    zeros = jnp.zeros_like(ebm_w)
+    rev = slices_w[::-1]
+
+    def walk(bits):
+        (gt, lt, eq), _ = lax.scan(_scan_body, (zeros, zeros, ebm_w), (rev, bits))
+        return gt, lt, eq
+
+    if op_name == "RANGE":  # bits_rev is [2, S]: (lo GE, hi LE)
+        gt_lo, _, eq_lo = walk(bits_rev[0])
+        _, lt_hi, eq_hi = walk(bits_rev[1])
+        out = ((gt_lo | eq_lo) & (lt_hi | eq_hi)) & fixed_w
+    else:
+        gt, lt, eq = walk(bits_rev)
+        eq = eq & fixed_w
+        if op_name == "EQ":
+            out = eq
+        elif op_name == "NEQ":
+            out = fixed_w & ~eq
+        elif op_name == "GT":
+            out = gt & fixed_w
+        elif op_name == "LT":
+            out = lt & fixed_w
+        elif op_name == "LE":
+            out = (lt | eq) & fixed_w
+        else:  # GE
+            out = (gt | eq) & fixed_w
+    cards = jnp.sum(lax.population_count(out).astype(jnp.int32), axis=-1)
+    return out, cards
+
+
 _o_neil_fused_jit = None
 
 
@@ -634,41 +673,8 @@ def _o_neil_compare_fused(slices_w, bits_rev, ebm_w, fixed_w, op_name: str):
         import functools
 
         import jax
-        import jax.numpy as jnp
-        from jax import lax
 
-        @functools.partial(jax.jit, static_argnames=("op_name",))
-        def run(slices_w, bits_rev, ebm_w, fixed_w, op_name):
-            zeros = jnp.zeros_like(ebm_w)
-            rev = slices_w[::-1]
-
-            def walk(bits):
-                (gt, lt, eq), _ = lax.scan(
-                    _scan_body, (zeros, zeros, ebm_w), (rev, bits)
-                )
-                return gt, lt, eq
-
-            if op_name == "RANGE":  # bits_rev is [2, S]: (lo GE, hi LE)
-                gt_lo, _, eq_lo = walk(bits_rev[0])
-                _, lt_hi, eq_hi = walk(bits_rev[1])
-                out = ((gt_lo | eq_lo) & (lt_hi | eq_hi)) & fixed_w
-            else:
-                gt, lt, eq = walk(bits_rev)
-                eq = eq & fixed_w
-                if op_name == "EQ":
-                    out = eq
-                elif op_name == "NEQ":
-                    out = fixed_w & ~eq
-                elif op_name == "GT":
-                    out = gt & fixed_w
-                elif op_name == "LT":
-                    out = lt & fixed_w
-                elif op_name == "LE":
-                    out = (lt | eq) & fixed_w
-                else:  # GE
-                    out = (gt | eq) & fixed_w
-            cards = jnp.sum(lax.population_count(out).astype(jnp.int32), axis=-1)
-            return out, cards
-
-        _o_neil_fused_jit = run
+        _o_neil_fused_jit = functools.partial(
+            jax.jit, static_argnames=("op_name",)
+        )(o_neil_math)
     return _o_neil_fused_jit(slices_w, bits_rev, ebm_w, fixed_w, op_name)

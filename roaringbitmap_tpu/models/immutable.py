@@ -403,7 +403,7 @@ class ImmutableRoaringBitmap:
             return getattr(self._readonly_facade(), name)
         raise AttributeError(
             f"{type(self).__name__!r} object has no attribute {name!r}"
-            + (" (immutable: mutators unavailable)" if hasattr(RoaringBitmap(), name) else "")
+            + (" (immutable: mutators unavailable)" if hasattr(RoaringBitmap, name) else "")
         )
 
     # -- statics mirroring the reference's (results are heap bitmaps) ------
@@ -415,19 +415,20 @@ class ImmutableRoaringBitmap:
 
     @staticmethod
     def flip(bm, start: int, end: int) -> RoaringBitmap:
-        return RoaringBitmap.flip(_heap(bm), start, end)
+        # clone() of a mapped operand is already the heap deep copy
+        return RoaringBitmap.flip(bm, start, end)
 
     @staticmethod
     def or_not(x1, x2, range_end: int) -> RoaringBitmap:
-        return RoaringBitmap.or_not(_heap(x1), _heap(x2), range_end)
+        return RoaringBitmap.or_not(x1, x2, range_end)
 
     @staticmethod
     def xor_cardinality(x1, x2) -> int:
-        return ImmutableRoaringBitmap.xor(x1, x2).get_cardinality()
+        return RoaringBitmap.xor_cardinality(x1, x2)
 
     @staticmethod
     def andnot_cardinality(x1, x2) -> int:
-        return ImmutableRoaringBitmap.andnot(x1, x2).get_cardinality()
+        return RoaringBitmap.andnot_cardinality(x1, x2)
 
     def to_roaring_bitmap(self) -> RoaringBitmap:
         """Deep copy to a heap RoaringBitmap (toRoaringBitmap)."""
@@ -472,7 +473,3 @@ class ImmutableRoaringBitmap:
         return f"ImmutableRoaringBitmap(card={self.get_cardinality()}, containers={self._size})"
 
 
-def _heap(bm) -> RoaringBitmap:
-    """Heap copy of a mapped bitmap (identity for heap operands) for the
-    clone-then-mutate statics (flip, or_not)."""
-    return bm.to_mutable() if isinstance(bm, ImmutableRoaringBitmap) else bm

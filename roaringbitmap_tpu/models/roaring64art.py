@@ -527,19 +527,34 @@ class Roaring64Bitmap:
 
     @staticmethod
     def and_cardinality(a: "Roaring64Bitmap", b: "Roaring64Bitmap") -> int:
-        return Roaring64Bitmap.and_(a, b).get_cardinality()
+        """O(intersection): per-key and_cardinality, nothing materialized."""
+        total = 0
+        it_b = dict(b._kv())
+        for k, ca in a._kv():
+            cb = it_b.get(k)
+            if cb is not None:
+                total += ca.and_cardinality(cb)
+        return total
 
     @staticmethod
     def or_cardinality(a: "Roaring64Bitmap", b: "Roaring64Bitmap") -> int:
-        return Roaring64Bitmap.or_(a, b).get_cardinality()
+        return (
+            a.get_cardinality()
+            + b.get_cardinality()
+            - Roaring64Bitmap.and_cardinality(a, b)
+        )
 
     @staticmethod
     def xor_cardinality(a: "Roaring64Bitmap", b: "Roaring64Bitmap") -> int:
-        return Roaring64Bitmap.xor(a, b).get_cardinality()
+        return (
+            a.get_cardinality()
+            + b.get_cardinality()
+            - 2 * Roaring64Bitmap.and_cardinality(a, b)
+        )
 
     @staticmethod
     def andnot_cardinality(a: "Roaring64Bitmap", b: "Roaring64Bitmap") -> int:
-        return Roaring64Bitmap.andnot(a, b).get_cardinality()
+        return a.get_cardinality() - Roaring64Bitmap.and_cardinality(a, b)
 
     # ------------------------------------------------------------------
     # serialization — portable 64-bit spec via high-32 grouping

@@ -96,3 +96,64 @@ def distributed_grouped_or(mesh: Mesh):
         out_specs=(P(None, "words"), P(None)),
     )
     return jax.jit(mapped)
+
+
+def distributed_bsi_compare(mesh: Mesh, op_name: str):
+    """Sharded O'Neil BSI compare: the [S, K, 2048] slice tensor splits
+    its key-chunk axis over ``containers`` and its word axis over
+    ``words``; the slice walk (models/bsi.o_neil_math) is elementwise in
+    both, so the whole scan runs with ZERO inter-chip traffic — the only
+    collective is a words-axis psum of the per-chunk cardinalities. This
+    is the filtered-range-query north star (BASELINE.md: "bsi/ 32-slice
+    range query -> TPU AND-chain") at multi-chip scale.
+
+    Returns a jitted ``(slices_w [S,K,W], bits_rev, ebm_w [K,W],
+    fixed_w [K,W]) -> (result words [K,W], cards [K])``.
+    """
+    from ..models.bsi import o_neil_math
+
+    def step(slices_w, bits_rev, ebm_w, fixed_w):
+        out, cards = o_neil_math(slices_w, bits_rev, ebm_w, fixed_w, op_name)
+        return out, lax.psum(cards, "words")
+
+    mapped = shard_map(
+        step,
+        mesh,
+        in_specs=(
+            P(None, "containers", "words"),
+            P(),
+            P("containers", "words"),
+            P("containers", "words"),
+        ),
+        out_specs=(P("containers", "words"), P("containers")),
+    )
+    return jax.jit(mapped)
+
+
+def initialize_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> int:
+    """Join a multi-host mesh (the DCN story, SURVEY §5 "distributed
+    communication backend"): wraps ``jax.distributed.initialize`` — GKE/GCE
+    TPU pods auto-discover when no arguments are given — after which
+    ``jax.devices()`` spans every host and the ``make_mesh``/``shard_map``
+    helpers above scale unchanged: container-axis collectives ride ICI
+    within a slice and DCN across slices, exactly where XLA places them.
+    Returns the global device count. Safe to call when already initialized
+    or single-process (returns the local count)."""
+    explicit = coordinator_address is not None or num_processes is not None
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (RuntimeError, ValueError):
+        if explicit:
+            # a configured coordinator that fails must not silently degrade
+            # a multi-host job into a wrong-answer single-host one
+            raise
+        # no-arg probe: already initialized, or a plain single-process run
+    return len(jax.devices())

@@ -26,3 +26,27 @@ def test_entry_compiles():
     for g in range(host.shape[0]):
         want = np.bitwise_or.reduce(host[g], axis=0)
         assert np.array_equal(np.asarray(red[g]), want)
+
+
+def test_distributed_bsi_compare_matches_local():
+    """Sharded O'Neil GE over an 8-device mesh == single-device fused path."""
+    import jax.numpy as jnp
+
+    from roaringbitmap_tpu.models.bsi import o_neil_math
+    from roaringbitmap_tpu.parallel import sharding
+
+    mesh = sharding.make_mesh(8, words_axis=2)
+    rng = np.random.default_rng(9)
+    s, k, w = 5, 2 * mesh.devices.shape[0], 2048
+    slices = rng.integers(0, 1 << 32, size=(s, k, w), dtype=np.uint64).astype(np.uint32)
+    ebm = np.bitwise_or.reduce(slices, axis=0)
+    predicate = 0b10110
+    bits_rev = jnp.asarray([(predicate >> i) & 1 for i in range(s)][::-1], dtype=bool)
+    for op in ("GE", "LT", "EQ"):
+        step = sharding.distributed_bsi_compare(mesh, op)
+        out, cards = step(jnp.asarray(slices), bits_rev, jnp.asarray(ebm), jnp.asarray(ebm))
+        want_out, want_cards = o_neil_math(
+            jnp.asarray(slices), bits_rev, jnp.asarray(ebm), jnp.asarray(ebm), op
+        )
+        assert np.array_equal(np.asarray(out), np.asarray(want_out)), op
+        assert np.array_equal(np.asarray(cards), np.asarray(want_cards)), op
