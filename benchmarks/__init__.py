@@ -33,4 +33,5 @@ SUITES = [
     "writer",
     "runcontainer",
     "bsi",
+    "filtered_ann",
 ]

@@ -25,7 +25,17 @@ def main(argv=None) -> int:
     p.add_argument("--datasets", type=str, default=None)
     p.add_argument("--profile", action="store_true")
     p.add_argument("--json", type=str, default=None)
+    p.add_argument(
+        "--cpu",
+        action="store_true",
+        help="force the CPU backend (e.g. when the TPU tunnel is unreachable)",
+    )
     args = p.parse_args(argv)
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
     names = args.suites or SUITES + ["simplebenchmark"]
     datasets = args.datasets.split(",") if args.datasets else None

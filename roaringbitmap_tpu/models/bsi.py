@@ -419,6 +419,33 @@ class RoaringBitmapSliceIndex:
         self._pack_cache = (self._version, keys, jnp.asarray(ebm_w), jnp.asarray(slices_w))
         return self._pack_cache[1:]
 
+    @staticmethod
+    def _found_words(keys, shape, found_set: RoaringBitmap):
+        """found_set marshalled onto the packed key layout: [K, 2048]."""
+        import jax.numpy as jnp
+
+        from ..parallel import store
+
+        fixed_np = np.zeros(shape, dtype=np.uint32)
+        kidx = {k: i for i, k in enumerate(keys)}
+        hlc = found_set.high_low_container
+        for k, c in zip(hlc.keys, hlc.containers):
+            j = kidx.get(k)
+            if j is not None:
+                fixed_np[j] = store.container_words_u32(c)
+        return jnp.asarray(fixed_np)
+
+    def _sum_device(self, found_set: RoaringBitmap) -> int:
+        """Σ 2^i · |bA[i] ∩ found| in ONE device dispatch: the packed
+        [S, K, 2048] tensor is masked by the found words and per-(slice,
+        chunk) popcounts come back; the 2^i weighting runs host-side in
+        exact python ints (S can exceed 62 bits in theory)."""
+        keys, ebm_w, slices_w = self._pack_dense()
+        found_w = self._found_words(keys, ebm_w.shape, found_set)
+        per_chunk = np.asarray(_slice_masked_popcounts(slices_w, found_w))
+        per_slice = per_chunk.astype(object).sum(axis=1)  # exact python ints
+        return sum(int(c) << i for i, c in enumerate(per_slice.tolist()))
+
     def _o_neil_device(self, op, predicate, found_set, end: int = 0) -> RoaringBitmap:
         """The whole O'Neil chain — scan, op epilogue and popcount — as ONE
         jitted device call (the SURVEY §3.5 batched-kernel target; a single
@@ -444,14 +471,7 @@ class RoaringBitmapSliceIndex:
             fixed_w, fixed_bm = ebm_w, self.ebm
         else:
             fixed_bm = found_set
-            fixed_np = np.zeros(ebm_w.shape, dtype=np.uint32)
-            kidx = {k: i for i, k in enumerate(keys)}
-            hlc = found_set.high_low_container
-            for k, c in zip(hlc.keys, hlc.containers):
-                j = kidx.get(k)
-                if j is not None:
-                    fixed_np[j] = store.container_words_u32(c)
-            fixed_w = jnp.asarray(fixed_np)
+            fixed_w = self._found_words(keys, ebm_w.shape, found_set)
 
         out, cards = _o_neil_compare_fused(
             slices_w, jnp.asarray(bits_vec), ebm_w, fixed_w, op.value
@@ -470,12 +490,16 @@ class RoaringBitmapSliceIndex:
         return result
 
     def sum(
-        self, found_set: Optional[RoaringBitmap] = None
+        self, found_set: Optional[RoaringBitmap] = None, mode: Optional[str] = None
     ) -> Tuple[int, int]:
-        """(sum, count) over found columns (RoaringBitmapSliceIndex.java:581-592)."""
+        """(sum, count) over found columns (RoaringBitmapSliceIndex.java:581-592).
+        On the device path the whole popcount-weighted reduce is one
+        dispatch over the resident [S, K, 2048] pack (SURVEY §7.7)."""
         if found_set is None or found_set.is_empty():
             return 0, 0
         count = found_set.get_cardinality()
+        if self._use_device(mode):
+            return self._sum_device(found_set), count
         total = sum(
             (1 << i) * RoaringBitmap.and_cardinality(s, found_set)
             for i, s in enumerate(self.slices)
@@ -678,3 +702,25 @@ def _o_neil_compare_fused(slices_w, bits_rev, ebm_w, fixed_w, op_name: str):
             jax.jit, static_argnames=("op_name",)
         )(o_neil_math)
     return _o_neil_fused_jit(slices_w, bits_rev, ebm_w, fixed_w, op_name)
+
+
+_slice_popcounts_jit = None
+
+
+def _slice_masked_popcounts(slices_w, found_w):
+    """[S, K, 2048] & [K, 2048] -> per-slice popcounts [S] (device)."""
+    global _slice_popcounts_jit
+    if _slice_popcounts_jit is None:
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        @jax.jit
+        def run(slices_w, found_w):
+            masked = slices_w & found_w[None]
+            # per-(slice, key-chunk) counts: each <= 65536, safely int32;
+            # the cross-chunk sum happens host-side in python ints
+            return jnp.sum(lax.population_count(masked).astype(jnp.int32), axis=2)
+
+        _slice_popcounts_jit = run
+    return _slice_popcounts_jit(slices_w, found_w)

@@ -95,6 +95,11 @@ class Container:
     def contains(self, x: int) -> bool:
         raise NotImplementedError
 
+    def contains_many(self, values: np.ndarray) -> np.ndarray:
+        """Vectorized membership mask for uint16 values (per-type overrides
+        avoid materializing the container)."""
+        return np.isin(values, self.to_array())
+
     def add(self, x: int) -> "Container":
         raise NotImplementedError
 
@@ -266,6 +271,14 @@ class ArrayContainer(Container):
     def contains(self, x: int) -> bool:
         i = int(np.searchsorted(self.content, np.uint16(x)))
         return i < self.content.size and self.content[i] == x
+
+    def contains_many(self, values: np.ndarray) -> np.ndarray:
+        if self.content.size == 0:
+            return np.zeros(values.size, dtype=bool)
+        v = values.astype(np.uint16)
+        idx = np.searchsorted(self.content, v)
+        idx_c = np.minimum(idx, self.content.size - 1)
+        return (idx < self.content.size) & (self.content[idx_c] == v)
 
     def add(self, x: int) -> Container:
         i = int(np.searchsorted(self.content, np.uint16(x)))
@@ -519,6 +532,9 @@ class RunContainer(Container):
 
     def contains(self, x: int) -> bool:
         return bool(_run_contains_many(self, np.array([x], dtype=np.uint16))[0])
+
+    def contains_many(self, values: np.ndarray) -> np.ndarray:
+        return _run_contains_many(self, values)
 
     def add(self, x: int) -> Container:
         if self.contains(x):

@@ -229,6 +229,29 @@ class RoaringBitmap:
                 if c.cardinality:
                     hlc.insert_new_key_value_at(-hlc.get_index(hb) - 1, hb, c)
 
+    def contains_many(self, values) -> np.ndarray:
+        """Vectorized membership: bool array aligned with ``values`` (the
+        batch analogue of contains; what a retrieval stack calls to filter
+        an ANN candidate list)."""
+        v = np.asarray(values, dtype=np.int64).ravel()
+        out = np.zeros(v.size, dtype=bool)
+        if v.size == 0:
+            return out
+        keys = (v >> 16).astype(np.int64)
+        order = np.argsort(keys, kind="stable")
+        sorted_keys = keys[order]
+        boundaries = np.nonzero(np.diff(sorted_keys))[0] + 1
+        starts = np.concatenate(([0], boundaries))
+        ends = np.concatenate((boundaries, [v.size]))
+        hlc = self.high_low_container
+        for s, e in zip(starts.tolist(), ends.tolist()):
+            c = hlc.get_container(int(sorted_keys[s]))
+            if c is None:
+                continue
+            idx = order[s:e]
+            out[idx] = c.contains_many((v[idx] & 0xFFFF).astype(np.uint16))
+        return out
+
     def contains_range(self, start: int, end: int) -> bool:
         """RoaringBitmap.contains(long,long)."""
         start, end = _check_range(start, end)

@@ -143,7 +143,11 @@ def initialize_multihost(
     within a slice and DCN across slices, exactly where XLA places them.
     Returns the global device count. Safe to call when already initialized
     or single-process (returns the local count)."""
-    explicit = coordinator_address is not None or num_processes is not None
+    explicit = (
+        coordinator_address is not None
+        or num_processes is not None
+        or process_id is not None
+    )
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

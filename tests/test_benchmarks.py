@@ -57,7 +57,13 @@ def test_suite_runs(suite, small_corpus, monkeypatch):
 
     mod = importlib.import_module(f"benchmarks.{suite}")
     # shrink the heavy builders for smoke purposes
-    for attr, small in (("N_ROWS", 5000), ("N", 20_000)):
+    for attr, small in (
+        ("N_ROWS", 5000),
+        ("N", 20_000),
+        ("N_DOCS", 50_000),
+        ("N_QUERIES", 8),
+        ("TOP_K", 200),
+    ):
         if hasattr(mod, attr):
             monkeypatch.setattr(mod, attr, small)
     results = mod.run(reps=1, datasets=["census1881"])

@@ -182,3 +182,19 @@ def test_neq_predicate_beyond_bit_depth():
     b = RoaringBitmapSliceIndex()
     b.set_values(([1, 2, 3], [0, 5, 10]))
     assert set(b.compare(Operation.NEQ, 1 << 20, 0, None).to_array().tolist()) == {1, 2, 3}
+
+
+def test_sum_device_matches_cpu_and_oracle():
+    rng = np.random.default_rng(17)
+    bsi = RoaringBitmapSliceIndex()
+    cols = rng.choice(100_000, size=20_000, replace=False)
+    vals = rng.integers(0, 1 << 30, size=20_000)
+    pairs = [(int(c), int(v)) for c, v in zip(cols, vals)]
+    bsi.set_values(pairs)
+    found = RoaringBitmap(rng.choice(100_000, size=8_000, replace=False).astype(np.uint32))
+    cpu = bsi.sum(found, mode="cpu")
+    dev = bsi.sum(found, mode="device")
+    assert cpu == dev
+    lookup = dict(pairs)
+    want = sum(lookup[c] for c in found.to_array().tolist() if c in lookup)
+    assert cpu[0] == want and cpu[1] == found.get_cardinality()

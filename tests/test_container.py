@@ -192,3 +192,18 @@ def test_best_container_of_words():
     assert isinstance(best_container_of_words(few), ArrayContainer)
     many = bits.words_from_values(np.arange(5000, dtype=np.uint16))
     assert isinstance(best_container_of_words(many), BitmapContainer)
+
+
+def test_contains_many_all_types(rng):
+    from roaringbitmap_tpu.models.container import container_from_values
+
+    probe = rng.integers(0, 1 << 16, size=2000).astype(np.uint16)
+    for make in MAKERS:
+        vals = set(rng.choice(1 << 16, size=800, replace=False).tolist())
+        c = make(vals)
+        got = c.contains_many(probe)
+        assert got.tolist() == [int(p) in vals for p in probe.tolist()], make.__name__
+    # run container with adjacent runs
+    run = make_run(set(range(100, 500)) | set(range(60000, 60100)))
+    got = run.contains_many(np.array([99, 100, 499, 500, 60099, 60100], dtype=np.uint16))
+    assert got.tolist() == [False, True, True, False, True, False]
