@@ -67,8 +67,7 @@ def run(reps: int = 5, **_) -> List[Result]:
         return [RoaringBitmap.and_(doc_filter, c) for c in cand_bitmaps]
 
     def contains_path():
-        return [q[doc_filter.contains_many(q)] if hasattr(doc_filter, "contains_many")
-                else q[[doc_filter.contains(int(v)) for v in q]] for q in queries]
+        return [q[doc_filter.contains_many(q)] for q in queries]
 
     # device: keys = union of filter+candidate chunks; pack once, AND+popcount
     keys = sorted({k for c in cand_bitmaps for k in c.high_low_container.keys})

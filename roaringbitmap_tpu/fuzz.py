@@ -95,3 +95,63 @@ def verify_invariance(
             raise InvarianceFailure(name, bitmaps, detail=repr(e)) from e
         if not ok:
             raise InvarianceFailure(name, bitmaps)
+
+
+def verify_buffer_invariance(
+    name: str,
+    predicate: Callable[..., bool],
+    arity: int = 1,
+    iterations: Optional[int] = None,
+    seed: Optional[int] = None,
+) -> None:
+    """Buffer-twin fuzzing (BufferFuzzer.java): each random bitmap is
+    serialized and handed to the predicate as a zero-copy
+    ImmutableRoaringBitmap alongside its heap original —
+    ``predicate(mapped..., heap...)``; report payloads reproduce both."""
+    from .models.immutable import ImmutableRoaringBitmap
+
+    rng = np.random.default_rng(seed)
+    for _ in range(iterations or default_iterations()):
+        heap = [random_bitmap(rng) for _ in range(arity)]
+        mapped = [ImmutableRoaringBitmap(b.serialize()) for b in heap]
+        try:
+            ok = predicate(*mapped, *heap)
+        except Exception as e:
+            raise InvarianceFailure(name, heap, detail=repr(e)) from e
+        if not ok:
+            raise InvarianceFailure(name, heap)
+
+
+def random_bitmap64(rng, max_buckets: int = 3):
+    """Shape-diverse 64-bit bitmap spanning several high-32 buckets."""
+    from .models.roaring64 import Roaring64NavigableMap
+
+    out = Roaring64NavigableMap()
+    buckets = rng.choice(1 << 12, size=int(rng.integers(1, max_buckets + 1)), replace=False)
+    for b in buckets:
+        vals = random_bitmap(rng, max_keys=2).to_array().astype(np.uint64)
+        out.add_many(vals | (np.uint64(int(b)) << np.uint64(32)))
+    return out
+
+
+def verify_invariance64(
+    name: str,
+    predicate: Callable[..., bool],
+    arity: int = 1,
+    iterations: Optional[int] = None,
+    seed: Optional[int] = None,
+) -> None:
+    """64-bit fuzzing over both designs: the predicate gets
+    Roaring64NavigableMap inputs; equivalence with the ART design is
+    itself a good invariant (cross-implementation oracle, SURVEY §4)."""
+    rng = np.random.default_rng(seed)
+    for _ in range(iterations or default_iterations()):
+        bitmaps = [random_bitmap64(rng) for _ in range(arity)]
+        # InvarianceFailure only needs .serialize(), which the 64-bit
+        # facades provide — repro payloads are portable-64 bytes
+        try:
+            ok = predicate(*bitmaps)
+        except Exception as e:
+            raise InvarianceFailure(name, bitmaps, detail=repr(e)) from e
+        if not ok:
+            raise InvarianceFailure(name, bitmaps)

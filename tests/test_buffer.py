@@ -169,3 +169,35 @@ def test_mutable_factories_stay_in_buffer_world():
     ):
         assert type(got) is MutableRoaringBitmap
         got.to_immutable()  # the buffer-world API the class exists for
+
+
+def test_memory_mapped_file_on_disk(tmp_path, random_bitmap_factory):
+    """TestMemoryMapping analogue: serialize many bitmaps into one file,
+    mmap it, query + aggregate the mapped views, byte-identity preserved."""
+    import mmap
+
+    from roaringbitmap_tpu import BufferFastAggregation, FastAggregation
+
+    bitmaps = [random_bitmap_factory()[0] for _ in range(8)]
+    path = tmp_path / "bitmaps.bin"
+    offsets = []
+    with open(path, "wb") as f:
+        for bm in bitmaps:
+            offsets.append(f.tell())
+            f.write(bm.serialize())
+        total = f.tell()
+    with open(path, "rb") as f:
+        mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        mapped = []
+        for i, off in enumerate(offsets):
+            end = offsets[i + 1] if i + 1 < len(offsets) else total
+            mapped.append(ImmutableRoaringBitmap(memoryview(mm)[off:end]))
+        for src, m in zip(bitmaps, mapped):
+            assert m.get_cardinality() == src.get_cardinality()
+            assert m.serialize() == src.serialize()
+            v = src.first()
+            assert m.contains(v) and m.rank_long(v) == src.rank_long(v)
+        assert BufferFastAggregation.or_(*mapped) == FastAggregation.naive_or(*bitmaps)
+        # NOTE: mm.close() would raise BufferError while container views are
+        # alive — the mapped views legitimately pin the mapping (zero-copy
+        # contract); the map is released when the views are garbage collected.

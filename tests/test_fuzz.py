@@ -119,3 +119,40 @@ def test_predicate_crash_is_reported():
 
     with pytest.raises(InvarianceFailure, match="kaboom"):
         verify_invariance("crash", boom, arity=1, iterations=1, seed=10)
+
+
+def test_buffer_invariants():
+    """Mapped bitmaps behave identically to their heap originals
+    (BufferFuzzer equivalence family)."""
+    from roaringbitmap_tpu import BufferFastAggregation, RoaringBitmap
+    from roaringbitmap_tpu.fuzz import verify_buffer_invariance
+
+    def pred(ma, mb, ha, hb):
+        return (
+            BufferFastAggregation.or_(ma, mb) == RoaringBitmap.or_(ha, hb)
+            and RoaringBitmap.and_cardinality(ma, mb) == RoaringBitmap.and_cardinality(ha, hb)
+            and ma.rank_long(123456) == ha.rank_long(123456)
+            and ma.serialize() == ha.serialize()
+        )
+
+    verify_buffer_invariance("buffer-heap-equivalence", pred, arity=2, iterations=12, seed=21)
+
+
+def test_64bit_cross_design_oracle():
+    """NavigableMap and ART designs agree on algebra + serialization."""
+    from roaringbitmap_tpu import Roaring64Bitmap
+    from roaringbitmap_tpu.fuzz import verify_invariance64
+
+    def pred(a, b):
+        aa = Roaring64Bitmap(a.to_array())
+        bb = Roaring64Bitmap(b.to_array())
+        union = a.clone()
+        union.ior(b)
+        art_union = Roaring64Bitmap.or_(aa, bb)
+        return (
+            union.serialize() == art_union.serialize()
+            and union.get_long_cardinality() == art_union.get_long_cardinality()
+            and a.serialize() == aa.serialize()
+        )
+
+    verify_invariance64("64bit-cross-design", pred, arity=2, iterations=8, seed=22)

@@ -1,0 +1,97 @@
+"""Pinned-bug regression suites ported from the reference, driven by the
+same fixture files (TestConcatenation.java, PreviousValueTest.java,
+RangeBitmapTest.betweenRegressionTest, TestRoaringBitmapOrNot.testBigOrNot):
+each fixture reproduces a historical bug in addOffset / previousValue /
+RangeBitmap.between / orNot."""
+
+import base64
+import json
+import os
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import ImmutableRoaringBitmap, RangeBitmap, RoaringBitmap
+
+TESTDATA = "/root/reference/RoaringBitmap/src/test/resources/testdata"
+needs_testdata = pytest.mark.skipif(
+    not os.path.isdir(TESTDATA), reason="reference testdata not mounted"
+)
+
+
+def read_ints(name):
+    with open(os.path.join(TESTDATA, name)) as f:
+        return np.array([int(t) for t in f.read().split(",") if t.strip()], dtype=np.int64)
+
+
+@needs_testdata
+@pytest.mark.parametrize(
+    "fixture,offset",
+    [
+        ("testIssue260.txt", 5950),  # issue #260 data set
+        ("offset_failure_case_1.txt", 20),
+        ("offset_failure_case_2.txt", 20),
+        ("offset_failure_case_3.txt", 20),
+    ],
+)
+def test_add_offset_elementwise(fixture, offset):
+    """addOffset must equal elementwise addition
+    (TestConcatenation.testElementwiseOffsetAppliedCorrectly)."""
+    vals = read_ints(fixture)
+    bm = RoaringBitmap(vals.astype(np.uint32))
+    bm.run_optimize()
+    shifted = RoaringBitmap.add_offset(bm, offset)
+    want = (np.unique(vals) + offset).astype(np.uint64)
+    want = want[want < 1 << 32]
+    assert np.array_equal(shifted.to_array().astype(np.uint64), want), fixture
+
+
+@pytest.mark.parametrize("offset", [20, 1 << 16, -20, -(1 << 16)])
+def test_add_offset_shapes(random_bitmap_factory, offset):
+    """Shaped addOffset sweep incl. negative offsets (the reference's
+    divisor/awkward-offset matrix over mixed container types)."""
+    for _ in range(6):
+        bm, vals = random_bitmap_factory()
+        shifted = RoaringBitmap.add_offset(bm, offset)
+        want = np.unique(vals).astype(np.int64) + offset
+        want = want[(want >= 0) & (want < 1 << 32)]
+        assert np.array_equal(shifted.to_array().astype(np.int64), want)
+
+
+@needs_testdata
+def test_previous_value_regression():
+    """previousValue past the last container (PreviousValueTest.java:14-23)."""
+    test_value = 1828834057
+    bm = RoaringBitmap(read_ints("prevvalue-regression.txt").astype(np.uint32))
+    assert bm.previous_value(test_value) == bm.last()
+    mapped = ImmutableRoaringBitmap(bm.serialize())
+    assert mapped.previous_value(test_value) == mapped.last()
+
+
+@needs_testdata
+def test_rangebitmap_between_regression():
+    """between == eq(l) | eq(l+1) on the regression column
+    (RangeBitmapTest.betweenRegressionTest)."""
+    values = read_ints("rangebitmap_regression.txt")
+    app = RangeBitmap.appender(2175288)
+    app.add_many(values.tolist())
+    rb = app.build()
+    for i in range(4):
+        lower = 263501 + i
+        want = RoaringBitmap.or_(rb.eq(lower), rb.eq(lower + 1))
+        assert rb.between(lower, lower + 1) == want, lower
+
+
+@needs_testdata
+def test_big_ornot_regression():
+    """orNot truncation fuzz failure (TestRoaringBitmapOrNot.testBigOrNot):
+    l.orNot(r, last+1) == l | (range(0, last+1) \\ r)."""
+    with open(os.path.join(TESTDATA, "ornot-fuzz-failure.json")) as f:
+        info = json.load(f)
+    l = RoaringBitmap.deserialize(base64.b64decode(info["bitmaps"][0]))
+    r = RoaringBitmap.deserialize(base64.b64decode(info["bitmaps"][1]))
+    limit = l.last() + 1
+    rng = RoaringBitmap.bitmap_of_range(0, limit)
+    rng.iandnot(r)
+    expected = RoaringBitmap.or_(l, rng)
+    assert RoaringBitmap.or_not(l, r, limit) == expected
