@@ -28,6 +28,7 @@ same single batched kernel on device — the degenerate case where the
 
 from __future__ import annotations
 
+import functools
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence
 
@@ -51,6 +52,10 @@ class config:
 
     mode: str = "auto"  # 'auto' | 'cpu' | 'device'
     min_device_containers: int = 64
+    # Optional jax.sharding.Mesh: when set (e.g. sharding.make_mesh()), the
+    # device OR path runs the mesh-sharded reduction (container axis data
+    # parallel over ICI) instead of the single-chip kernel.
+    mesh = None
 
 
 def _use_device(n_containers: int, mode: Optional[str]) -> bool:
@@ -130,8 +135,43 @@ def _cpu_aggregate(
 
 def _device_aggregate(groups: Dict[int, List[Container]], op: str) -> RoaringBitmap:
     packed = store.pack_groups(groups)
-    words, cards = store.reduce_packed(packed, op=op)
+    if config.mesh is not None and op == "or":
+        words, cards = _sharded_or(packed)
+    else:
+        words, cards = store.reduce_packed(packed, op=op)
     return store.unpack_to_bitmap(packed.group_keys, words, cards)
+
+
+@functools.lru_cache(maxsize=4)
+def _sharded_or_step(mesh):
+    from . import sharding
+
+    return sharding.distributed_grouped_or(mesh)
+
+
+def _sharded_or(packed: "store.PackedGroups"):
+    """Mesh-sharded grouped OR: pad each group's row count to the mesh's
+    container-axis size and run the ICI OR-combine (sharding.py). Group
+    distributions too skewed to pad densely (same guard as
+    prepare_reduce) fall back to the single-device segmented layout
+    rather than materializing a huge padded tensor."""
+    import jax.numpy as jnp
+
+    mesh = config.mesh
+    n_rows_axis = mesh.devices.shape[0]
+    counts = np.diff(packed.group_offsets)
+    g = packed.n_groups
+    n = packed.n_rows
+    m = int(counts.max()) if g else 0
+    m += (-m) % n_rows_axis  # shardable padded row count
+    if g * m > max(2 * n, 1024):
+        return store.reduce_packed(packed, op="or")
+    padded = np.zeros((g, m, packed.words.shape[1]), dtype=np.uint32)
+    for gi in range(g):
+        s, e = int(packed.group_offsets[gi]), int(packed.group_offsets[gi + 1])
+        padded[gi, : e - s] = packed.words[s:e]
+    red, cards = _sharded_or_step(mesh)(jnp.asarray(padded))
+    return np.asarray(red), np.asarray(cards).astype(np.int64)
 
 
 def _aggregate(

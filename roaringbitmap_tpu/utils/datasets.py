@@ -62,6 +62,27 @@ def fetch_bit_positions(name: str) -> List[np.ndarray]:
     return out
 
 
+def fetch_bit_position_ranges(name: str) -> List[np.ndarray]:
+    """Range-format corpora: each zip entry is one line of
+    ``start1-end1,start2-end2,...`` pairs; returns one ``[n, 2]`` int64
+    array of inclusive ranges per entry (ZipRealDataRangeRetriever
+    .fetchNextRange, real-roaring-dataset/.../ZipRealDataRangeRetriever.java:39)."""
+    path = os.path.join(REFERENCE_DATASET_DIR, name + ".zip")
+    out: List[np.ndarray] = []
+    with zipfile.ZipFile(path) as zf:
+        for entry in sorted(zf.namelist()):
+            with zf.open(entry) as f:
+                text = io.TextIOWrapper(f, encoding="ascii").read()
+            pairs = [
+                tok.split("-")
+                for line in text.splitlines()
+                for tok in line.split(",")
+                if tok.strip()
+            ]
+            out.append(np.array([(int(a), int(b)) for a, b in pairs], dtype=np.int64))
+    return out
+
+
 def synthetic_census_like(
     n_bitmaps: int = 200, seed: int = 0xFEEF1F0
 ) -> List[np.ndarray]:

@@ -74,3 +74,17 @@ def test_fastrank_matches_plain(rng):
     assert fast2.select(12) == 19
     fast2.remove_range(10, 20)
     assert fast2.rank(100) == 3
+
+
+def test_fetch_bit_position_ranges_parsing(tmp_path, monkeypatch):
+    """Range-format zip parsing, incl. entries that span multiple lines."""
+    import zipfile
+
+    from roaringbitmap_tpu.utils import datasets
+
+    z = tmp_path / "fake_ranges.zip"
+    with zipfile.ZipFile(z, "w") as zf:
+        zf.writestr("a.txt", "5-9,12-15,\n100-200")
+    monkeypatch.setattr(datasets, "REFERENCE_DATASET_DIR", str(tmp_path))
+    (ranges,) = datasets.fetch_bit_position_ranges("fake_ranges")
+    assert ranges.tolist() == [[5, 9], [12, 15], [100, 200]]

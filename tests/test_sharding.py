@@ -50,3 +50,23 @@ def test_distributed_bsi_compare_matches_local():
         )
         assert np.array_equal(np.asarray(out), np.asarray(want_out)), op
         assert np.array_equal(np.asarray(cards), np.asarray(want_cards)), op
+
+
+def test_engine_dispatch_through_mesh():
+    """FastAggregation rides the mesh-sharded OR when config.mesh is set."""
+    from roaringbitmap_tpu import FastAggregation, RoaringBitmap
+    from roaringbitmap_tpu.parallel import sharding
+    from roaringbitmap_tpu.parallel.aggregation import config
+
+    rng = np.random.default_rng(31)
+    bms = [
+        RoaringBitmap(np.unique(rng.integers(0, 1 << 19, 3000)).astype(np.uint32))
+        for _ in range(40)
+    ]
+    want = FastAggregation.naive_or(*bms)
+    config.mesh = sharding.make_mesh(8, words_axis=2)
+    try:
+        got = FastAggregation.or_(*bms, mode="device")
+    finally:
+        config.mesh = None
+    assert got == want
