@@ -151,25 +151,26 @@ def _sharded_or_step(mesh):
 
 def _sharded_or(packed: "store.PackedGroups"):
     """Mesh-sharded grouped OR: pad each group's row count to the mesh's
-    container-axis size and run the ICI OR-combine (sharding.py). Group
-    distributions too skewed to pad densely (same guard as
-    prepare_reduce) fall back to the single-device segmented layout
-    rather than materializing a huge padded tensor."""
+    container-axis size (store.pad_groups_dense, the shared layout +
+    skew guard) and run the ICI OR-combine (sharding.py). Too-skewed
+    distributions fall back to the single-device segmented layout."""
+    import jax
     import jax.numpy as jnp
 
     mesh = config.mesh
-    n_rows_axis = mesh.devices.shape[0]
-    counts = np.diff(packed.group_offsets)
-    g = packed.n_groups
-    n = packed.n_rows
-    m = int(counts.max()) if g else 0
-    m += (-m) % n_rows_axis  # shardable padded row count
-    if g * m > max(2 * n, 1024):
+    if any(d.process_index != jax.process_index() for d in mesh.devices.flat):
+        # the padded tensor is built process-locally; forming the global
+        # array on a multi-host mesh needs per-process shards
+        # (jax.make_array_from_process_local_data) — route such jobs through
+        # sharding.distributed_grouped_or directly with pre-sharded inputs
+        raise NotImplementedError(
+            "config.mesh routing supports single-host meshes; for multi-host "
+            "use parallel.sharding.distributed_grouped_or with a globally "
+            "formed array"
+        )
+    padded = store.pad_groups_dense(packed, 0, row_multiple=mesh.devices.shape[0])
+    if padded is None:
         return store.reduce_packed(packed, op="or")
-    padded = np.zeros((g, m, packed.words.shape[1]), dtype=np.uint32)
-    for gi in range(g):
-        s, e = int(packed.group_offsets[gi]), int(packed.group_offsets[gi + 1])
-        padded[gi, : e - s] = packed.words[s:e]
     red, cards = _sharded_or_step(mesh)(jnp.asarray(padded))
     return np.asarray(red), np.asarray(cards).astype(np.int64)
 

@@ -148,6 +148,26 @@ def pack_groups(groups: Dict[int, List[Container]]) -> PackedGroups:
     return PackedGroups(pack_rows_host(rows), group_keys, offsets)
 
 
+def pad_groups_dense(
+    packed: PackedGroups, fill: int, row_multiple: int = 1
+) -> Optional[np.ndarray]:
+    """Dense [G, M, W] padding of a packed group set, M rounded up to
+    ``row_multiple``; returns None when the distribution is too skewed to
+    pad (the shared guard: padded cells > max(2*rows, 1024))."""
+    g = packed.n_groups
+    n = packed.n_rows
+    counts = np.diff(packed.group_offsets)
+    m = int(counts.max()) if g else 0
+    m += (-m) % row_multiple
+    if g * m > max(2 * n, 1024):
+        return None
+    padded = np.full((g, m, dev.DEVICE_WORDS), fill, dtype=np.uint32)
+    for gi in range(g):
+        s, e = int(packed.group_offsets[gi]), int(packed.group_offsets[gi + 1])
+        padded[gi, : e - s] = packed.words[s:e]
+    return padded
+
+
 def prepare_reduce(packed: PackedGroups, op: str = "or"):
     """Build the device reduction closure for a packed group set.
 
@@ -159,16 +179,9 @@ def prepare_reduce(packed: PackedGroups, op: str = "or"):
     pool, ParallelAggregation.java:222-228). bench.py times exactly this
     closure, so the benchmark and production always run the same path.
     """
-    g = packed.n_groups
     n = packed.n_rows
-    counts = np.diff(packed.group_offsets)
-    m = int(counts.max()) if g else 0
-    if g * m <= max(2 * n, 1024):
-        fill = dev._INIT[op]
-        padded = np.full((g, m, dev.DEVICE_WORDS), fill, dtype=np.uint32)
-        for gi in range(g):
-            s, e = int(packed.group_offsets[gi]), int(packed.group_offsets[gi + 1])
-            padded[gi, : e - s] = packed.words[s:e]
+    padded = pad_groups_dense(packed, dev._INIT[op])
+    if padded is not None:
         dev_arr = jnp.asarray(padded)
 
         def run():

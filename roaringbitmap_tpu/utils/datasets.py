@@ -73,10 +73,11 @@ def fetch_bit_position_ranges(name: str) -> List[np.ndarray]:
         for entry in sorted(zf.namelist()):
             with zf.open(entry) as f:
                 text = io.TextIOWrapper(f, encoding="ascii").read()
+            # join lines before comma-splitting: entries wrap mid-token,
+            # same as fetch_bit_positions above
             pairs = [
                 tok.split("-")
-                for line in text.splitlines()
-                for tok in line.split(",")
+                for tok in "".join(text.splitlines()).split(",")
                 if tok.strip()
             ]
             out.append(np.array([(int(a), int(b)) for a, b in pairs], dtype=np.int64))
