@@ -1,3 +1,3 @@
-from . import store, aggregation
+from . import aggregation, batch, sharding, store
 
-__all__ = ["store", "aggregation"]
+__all__ = ["aggregation", "batch", "sharding", "store"]

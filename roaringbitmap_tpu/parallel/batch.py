@@ -1,0 +1,133 @@
+"""Batched one-vs-many pairwise algebra on device.
+
+The retrieval/filtered-ANN pattern (BASELINE.md config 5): one filter
+bitmap intersected against MANY small sets at once. The reference can
+only loop pairwise ops; here all right-hand operands marshal into a
+``[Q, K, 2048]`` tensor over the union of their chunk keys and the whole
+batch runs as one fused dispatch (AND/ANDNOT + per-query popcount).
+
+Host marshal is O(total values); results come back either as counts
+(no materialization) or as re-compressed RoaringBitmaps.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from ..models.roaring import RoaringBitmap
+from ..ops import device as dev
+from . import store
+
+
+def _pack_one_vs_many(one: RoaringBitmap, many: Sequence[RoaringBitmap]):
+    """(filter words [K, 2048], batch words [Q, K, 2048], keys) over the
+    union of the right-hand operands' chunk keys."""
+    import jax.numpy as jnp
+
+    keys = sorted({k for c in many for k in c.high_low_container.keys})
+    kidx = {k: i for i, k in enumerate(keys)}
+    filt = np.zeros((max(1, len(keys)), dev.DEVICE_WORDS), dtype=np.uint32)
+    hlc = one.high_low_container
+    fk = {k: c for k, c in zip(hlc.keys, hlc.containers)}
+    present = [k for k in keys if k in fk]
+    if present:
+        filt[[kidx[k] for k in present]] = store.pack_rows_host([fk[k] for k in present])
+    batch = np.zeros((len(many), max(1, len(keys)), dev.DEVICE_WORDS), dtype=np.uint32)
+    for qi, c in enumerate(many):
+        ch = c.high_low_container
+        if ch.size:
+            rows = store.pack_rows_host(list(ch.containers))
+            for j, k in enumerate(ch.keys):
+                batch[qi, kidx[k]] = rows[j]
+    return jnp.asarray(filt), jnp.asarray(batch), np.asarray(keys, dtype=np.int64)
+
+
+_steps = {}
+
+_MASK_FNS = {
+    "and": lambda b, f: b & f[None],
+    "andnot": lambda b, f: b & ~f[None],
+}
+
+
+def _step(op: str, cards_only: bool):
+    """cards_only lets XLA fuse mask+popcount into a reduction without
+    materializing the masked [Q, K, 2048] tensor; the materializing
+    variant also returns per-(query, key) popcounts for unpacking."""
+    fn = _steps.get((op, cards_only))
+    if fn is None:
+        import jax
+        import jax.numpy as jnp
+
+        mask_fn = _MASK_FNS[op]
+
+        if cards_only:
+
+            @jax.jit
+            def run(batch, filt):
+                masked = mask_fn(batch, filt)
+                return jnp.sum(
+                    jax.lax.population_count(masked).astype(jnp.int32), axis=(1, 2)
+                )
+
+        else:
+
+            @jax.jit
+            def run(batch, filt):
+                masked = mask_fn(batch, filt)
+                row_cards = jnp.sum(
+                    jax.lax.population_count(masked).astype(jnp.int32), axis=2
+                )
+                return masked, row_cards
+
+        fn = _steps[(op, cards_only)] = run
+    return fn
+
+
+def prepare_batched_cardinality(
+    one: RoaringBitmap, many: Sequence[RoaringBitmap], op: str = "and"
+):
+    """Marshal once, query repeatedly: returns a closure computing
+    ``[|many[i] OP one|]`` from the resident device tensors (the
+    steady-state retrieval loop; mirror of store.prepare_reduce)."""
+    filt, batch, _ = _pack_one_vs_many(one, many)
+    step = _step(op, cards_only=True)
+
+    def run() -> np.ndarray:
+        return np.asarray(step(batch, filt)).astype(np.int64)
+
+    return run
+
+
+def batched_cardinality(
+    one: RoaringBitmap, many: Sequence[RoaringBitmap], op: str = "and"
+) -> np.ndarray:
+    """``[|many[i] OP one|]`` for every i, one fused dispatch; op in
+    {'and', 'andnot'} (andnot = many[i] minus one)."""
+    if not many:
+        return np.empty(0, dtype=np.int64)
+    return prepare_batched_cardinality(one, many, op)()
+
+
+def batched_intersects(one: RoaringBitmap, many: Sequence[RoaringBitmap]) -> np.ndarray:
+    """Boolean mask: does many[i] intersect the filter?"""
+    return batched_cardinality(one, many, op="and") > 0
+
+
+def batched_op(
+    one: RoaringBitmap, many: Sequence[RoaringBitmap], op: str = "and"
+) -> List[RoaringBitmap]:
+    """Materialized ``many[i] OP one`` for every i (results re-compressed
+    through the append path)."""
+    if not many:
+        return []
+    filt, batch, keys = _pack_one_vs_many(one, many)
+    masked, row_cards = _step(op, cards_only=False)(batch, filt)
+    masked_np = np.asarray(masked)
+    row_cards_np = np.asarray(row_cards).astype(np.int64)
+    return [
+        store.unpack_to_bitmap(keys, masked_np[qi], row_cards_np[qi])
+        for qi in range(len(many))
+    ]

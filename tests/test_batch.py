@@ -1,0 +1,49 @@
+"""One-vs-many batched pairwise algebra (parallel/batch.py) — differential
+vs the pairwise facade ops."""
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import RoaringBitmap
+from roaringbitmap_tpu.parallel import batch
+
+
+@pytest.fixture(scope="module")
+def workload():
+    rng = np.random.default_rng(41)
+    filt = RoaringBitmap(rng.choice(1 << 20, 200_000, replace=False).astype(np.uint32))
+    many = [
+        RoaringBitmap(rng.choice(1 << 20, 1500, replace=False).astype(np.uint32))
+        for _ in range(20)
+    ]
+    return filt, many
+
+
+@pytest.mark.parametrize("op,ref", [("and", RoaringBitmap.and_), ("andnot", RoaringBitmap.andnot)])
+def test_batched_matches_pairwise(workload, op, ref):
+    filt, many = workload
+    want = [ref(m, filt) for m in many]
+    cards = batch.batched_cardinality(filt, many, op=op)
+    assert cards.tolist() == [w.get_cardinality() for w in want]
+    assert batch.batched_op(filt, many, op=op) == want
+
+
+def test_batched_intersects(workload):
+    filt, many = workload
+    got = batch.batched_intersects(filt, many + [RoaringBitmap()])
+    assert got.tolist() == [RoaringBitmap.intersects(m, filt) for m in many] + [False]
+
+
+def test_prepare_reusable(workload):
+    filt, many = workload
+    run = batch.prepare_batched_cardinality(filt, many)
+    first = run()
+    assert np.array_equal(first, run())
+
+
+def test_empty_inputs(workload):
+    filt, _ = workload
+    assert batch.batched_cardinality(filt, []).size == 0
+    assert batch.batched_op(filt, []) == []
+    assert batch.batched_op(filt, [RoaringBitmap()]) == [RoaringBitmap()]
+    assert batch.batched_op(RoaringBitmap(), [RoaringBitmap.bitmap_of(1)]) == [RoaringBitmap()]
