@@ -12,7 +12,7 @@ Host marshal is O(total values); results come back either as counts
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import List, Sequence
 
 import numpy as np
 
@@ -34,13 +34,21 @@ def _pack_one_vs_many(one: RoaringBitmap, many: Sequence[RoaringBitmap]):
     present = [k for k in keys if k in fk]
     if present:
         filt[[kidx[k] for k in present]] = store.pack_rows_host([fk[k] for k in present])
-    batch = np.zeros((len(many), max(1, len(keys)), dev.DEVICE_WORDS), dtype=np.uint32)
+    # one expansion pass over EVERY query container, then scatter rows into
+    # the [Q, K] layout — pack_rows_host's single-dispatch design is the
+    # whole point of the marshal path
+    all_containers: List = []
+    flat_slots: List[int] = []
+    n_keys = max(1, len(keys))
     for qi, c in enumerate(many):
         ch = c.high_low_container
-        if ch.size:
-            rows = store.pack_rows_host(list(ch.containers))
-            for j, k in enumerate(ch.keys):
-                batch[qi, kidx[k]] = rows[j]
+        for k, cont in zip(ch.keys, ch.containers):
+            all_containers.append(cont)
+            flat_slots.append(qi * n_keys + kidx[k])
+    batch = np.zeros((len(many) * n_keys, dev.DEVICE_WORDS), dtype=np.uint32)
+    if all_containers:
+        batch[np.asarray(flat_slots)] = store.pack_rows_host(all_containers)
+    batch = batch.reshape(len(many), n_keys, dev.DEVICE_WORDS)
     return jnp.asarray(filt), jnp.asarray(batch), np.asarray(keys, dtype=np.int64)
 
 
@@ -63,13 +71,16 @@ def _step(op: str, cards_only: bool):
 
         mask_fn = _MASK_FNS[op]
 
+        # per-(query, key) counts are <= 2^16 so int32 is safe; the final
+        # per-query sum happens host-side in int64 — an in-jit (1,2)-axis
+        # int32 sum could overflow past 2^31 set bits per query
         if cards_only:
 
             @jax.jit
             def run(batch, filt):
                 masked = mask_fn(batch, filt)
                 return jnp.sum(
-                    jax.lax.population_count(masked).astype(jnp.int32), axis=(1, 2)
+                    jax.lax.population_count(masked).astype(jnp.int32), axis=2
                 )
 
         else:
@@ -96,7 +107,8 @@ def prepare_batched_cardinality(
     step = _step(op, cards_only=True)
 
     def run() -> np.ndarray:
-        return np.asarray(step(batch, filt)).astype(np.int64)
+        row_cards = np.asarray(step(batch, filt)).astype(np.int64)
+        return row_cards.sum(axis=1)
 
     return run
 
