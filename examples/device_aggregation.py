@@ -17,6 +17,14 @@ VALUES_PER_BITMAP = 5000
 
 
 def main():
+    import bench
+
+    if not bench._probe_backend():
+        import jax
+
+        print("(TPU backend unreachable; running the same path on CPU)")
+        jax.config.update("jax_platforms", "cpu")
+
     rng = np.random.default_rng(0)
     bitmaps = [
         RoaringBitmap(

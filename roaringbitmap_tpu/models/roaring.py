@@ -363,6 +363,14 @@ class RoaringBitmap:
             ia += 1
         return out
 
+    def ior_not(self, other: "RoaringBitmap", range_end: int) -> "RoaringBitmap":
+        """In-place orNot (the reference's member orNot(x2, rangeEnd)):
+        this |= (~other restricted to [0, range_end))."""
+        self.high_low_container = RoaringBitmap.or_not(
+            self, other, range_end
+        ).high_low_container
+        return self
+
     @staticmethod
     def or_not(x1: "RoaringBitmap", x2: "RoaringBitmap", range_end: int) -> "RoaringBitmap":
         """x1 | ~x2 over [0, range_end) (RoaringBitmap.orNot, RoaringBitmap.java:1521)."""

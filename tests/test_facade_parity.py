@@ -235,3 +235,11 @@ def test_64bit_range_validation_and_limit():
     big = Roaring64Bitmap()
     big.add_range(0, 70000)  # spans two containers
     assert big.limit(65540).get_cardinality() == 65540
+
+
+def test_ior_not_matches_static():
+    a = RoaringBitmap.bitmap_of(1, 5, 100)
+    b = RoaringBitmap.bitmap_of(2, 5)
+    want = RoaringBitmap.or_not(a, b, 50)
+    got = a.clone()
+    assert got.ior_not(b, 50) is got and got == want
