@@ -373,13 +373,34 @@ class RoaringBitmap:
 
     @staticmethod
     def or_not(x1: "RoaringBitmap", x2: "RoaringBitmap", range_end: int) -> "RoaringBitmap":
-        """x1 | ~x2 over [0, range_end) (RoaringBitmap.orNot, RoaringBitmap.java:1521)."""
+        """x1 | (~x2 ∩ [0, range_end)) (RoaringBitmap.orNot, RoaringBitmap.java:1521).
+
+        Container walk: every key chunk of [0, range_end) gets the in-chunk
+        complement of x2's container (full-range when absent) OR'd with x1's —
+        no whole-universe bitmap is ever materialized."""
         _, range_end = _check_range(0, range_end)
-        comp = RoaringBitmap.flip(x2, 0, range_end)
-        masked = RoaringBitmap()
-        masked.add_range(0, range_end)
-        comp = RoaringBitmap.and_(comp, masked)
-        return RoaringBitmap.or_(x1, comp)
+        out = RoaringBitmap()
+        if range_end == 0:
+            return RoaringBitmap.or_(x1, out)
+        a, b = x1.high_low_container, x2.high_low_container
+        last_key = (range_end - 1) >> 16
+        for k in range(last_key + 1):
+            range_len = min(1 << 16, range_end - (k << 16))
+            ib = b.get_index(k)
+            comp: Container = container_range_of_ones(0, range_len)
+            if ib >= 0:
+                comp = comp.andnot(b.containers[ib])
+            ia = a.get_index(k)
+            if ia >= 0:
+                comp = comp.or_(a.containers[ia])
+            if comp.cardinality:
+                out.high_low_container.append(k, comp)
+        # x1's chunks beyond the range pass through untouched
+        ia = a.advance_until(last_key + 1, -1)
+        while ia < a.size:
+            out.high_low_container.append(a.keys[ia], a.containers[ia].clone())
+            ia += 1
+        return out
 
     @staticmethod
     def and_cardinality(x1: "RoaringBitmap", x2: "RoaringBitmap") -> int:
@@ -708,12 +729,27 @@ class RoaringBitmap:
         """Bitmap of values with rank in [start, end) (RoaringBitmap.selectRange,
         RoaringBitmap.java:3095)."""
         start, end = int(start), int(end)
-        card = self.get_cardinality()
-        if start >= card or start >= end:
-            return RoaringBitmap()
-        end = min(end, card)
-        arr = self.to_array()
-        return RoaringBitmap(arr[start:end])
+        out = RoaringBitmap()
+        if start >= end:
+            return out
+        seen = 0  # cumulative cardinality before the current container
+        hlc = self.high_low_container
+        for k, c in zip(hlc.keys, hlc.containers):
+            card = c.cardinality
+            if seen + card <= start:
+                seen += card
+                continue
+            if seen >= end:
+                break
+            lo, hi = max(start - seen, 0), min(end - seen, card)
+            if lo == 0 and hi == card:
+                out.high_low_container.append(k, c.clone())
+            else:
+                out.high_low_container.append(
+                    k, container_from_values(c.to_array()[lo:hi])
+                )
+            seen += card
+        return out
 
     def run_optimize(self) -> bool:
         """Convert containers to their smallest form; True if any became a run

@@ -205,6 +205,16 @@ def values_from_runs(starts: np.ndarray, lengths: np.ndarray) -> np.ndarray:
     return np.cumsum(out).astype(np.uint16)
 
 
+def words_from_intervals(starts: np.ndarray, ends: np.ndarray) -> np.ndarray:
+    """1024-word uint64 bitset from disjoint half-open [start, end) intervals,
+    via a boundary-delta cumsum (vectorized; no per-run loop)."""
+    delta = np.zeros((1 << 16) + 1, dtype=np.int8)
+    np.add.at(delta, np.asarray(starts, dtype=np.int64), 1)
+    np.subtract.at(delta, np.asarray(ends, dtype=np.int64), 1)
+    mask = np.cumsum(delta[:-1], dtype=np.int32) > 0
+    return np.packbits(mask, bitorder="little").view(np.uint64)
+
+
 def num_runs_in_words(words: np.ndarray) -> int:
     """Number of runs in a bitset, vectorized.
 
