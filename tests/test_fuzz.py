@@ -159,3 +159,34 @@ def test_64bit_cross_design_oracle():
         )
 
     verify_invariance64("64bit-cross-design", pred, arity=2, iterations=max(1, ITER // 3), seed=22)
+
+
+def test_device_layouts_forced_by_construction():
+    """Both prepare_reduce layouts (padded AND segmented-scan) are exercised
+    by construction and must agree with all CPU OR engines (VERDICT r2 #6:
+    the skewed shapes that trigger the associative-scan path never arose
+    from the generic generator)."""
+    from roaringbitmap_tpu.fuzz import verify_layout_invariance
+
+    verify_layout_invariance("layouts-vs-engines", op="or", iterations=max(4, ITER // 4), seed=31)
+
+
+def test_campaign_runner_smoke():
+    """The CI-mode campaign entry point runs every invariant family."""
+    from roaringbitmap_tpu.fuzz import run_campaign
+
+    res = run_campaign(8, verbose=False)
+    assert len(res) >= 10
+    # full-rate invariants run n; derated families record their true count
+    assert res["and-distributes-over-or"] == 8
+    assert res["64bit-cross-design"] == 1
+    assert all(1 <= v <= 8 for v in res.values())
+
+
+def test_layout_fuzz_rejects_and():
+    """Per-key grouped AND has no multi-bitmap oracle; the harness must say
+    so instead of reporting spurious failures (code-review regression)."""
+    from roaringbitmap_tpu.fuzz import verify_layout_invariance
+
+    with pytest.raises(ValueError, match="decomposable"):
+        verify_layout_invariance("bad", op="and", iterations=1, seed=1)

@@ -157,3 +157,123 @@ def test_full_64bit_values():
     assert rb.lt(1 << 63).to_array().tolist() == [1]
     assert rb.eq((1 << 64) - 1).to_array().tolist() == [0]
     assert rb.gte(1 << 63).to_array().tolist() == [0]
+
+
+def test_appender_bounded_memory_10m_rows():
+    """The appender must hold at most one 2^16-row raw chunk: peak transient
+    memory on a 10M-row ingest stays O(chunk), not O(rows)
+    (RangeBitmap.Appender per-2^16-rid flush, RangeBitmap.java:1378-1520)."""
+    import tracemalloc
+
+    n = 10_000_000
+    app = RangeBitmap.appender((1 << 20) - 1)
+    batch = np.arange(1 << 16, dtype=np.uint64) % 1000  # compresses to runs/arrays
+    tracemalloc.start()
+    base = tracemalloc.get_traced_memory()[0]
+    done = 0
+    while done < n:
+        m = min(1 << 16, n - done)
+        app.add_many(batch[:m])
+        done += m
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    # raw values would be 80 MB; one chunk is 0.5 MB. Allow generous slack
+    # for the compressed containers + numpy transients.
+    assert peak - base < 24 * 2**20, f"peak transient {peak - base} bytes"
+    # structural bound: the raw buffer is a single fixed chunk
+    assert app._buf.nbytes == (1 << 16) * 8
+    rb = app.build()
+    assert rb.row_count == n
+    per_chunk = int((batch == 999).sum())
+    tail = int((batch[: n % (1 << 16)] == 999).sum())
+    assert rb.eq_cardinality(999) == per_chunk * (n // (1 << 16)) + tail
+
+
+def test_context_skips_untouched_chunks():
+    """A context confined to two chunks must evaluate exactly those two
+    chunks (context-masked skipping, RangeBitmap.java:551-620)."""
+    n_chunks = 20
+    app = RangeBitmap.appender(999)
+    vals = (np.arange(n_chunks << 16, dtype=np.uint64) * 7) % 1000
+    app.add_many(vals)
+    rb = app.build()
+    rids = [(5 << 16) + 3, (7 << 16) + 10, (7 << 16) + 11]
+    ctx = RoaringBitmap(np.array(rids, dtype=np.uint32))
+    before = rb.chunks_evaluated
+    got = rb.between(10, 500, context=ctx)
+    assert rb.chunks_evaluated - before == 2  # chunks 5 and 7 only
+    want = {r for r in rids if 10 <= int(vals[r]) <= 500}
+    assert set(got.to_array().tolist()) == want
+    # all query ops honor the context mask
+    for name, pred in [
+        ("lt", vals < 300), ("lte", vals <= 300), ("gt", vals > 300),
+        ("gte", vals >= 300), ("eq", vals == int(vals[rids[0]])),
+        ("neq", vals != int(vals[rids[0]])),
+    ]:
+        q = 300 if name not in ("eq", "neq") else int(vals[rids[0]])
+        got = getattr(rb, name)(q, ctx)
+        want = {r for r in rids if pred[r]}
+        assert set(got.to_array().tolist()) == want, name
+
+
+def test_map_is_lazy_and_serialize_is_zero_decode():
+    """map() must not decode slice payloads; serialize() of a mapped index
+    re-emits stored payload bytes (RangeBitmap.map, RangeBitmap.java:66-96)."""
+    app = RangeBitmap.appender(10_000)
+    rng = np.random.default_rng(9)
+    app.add_many(rng.integers(0, 10_000, size=200_000, dtype=np.uint64))
+    data = app.serialize()
+    mapped = RangeBitmap.map(data)
+    assert all(s is None for s in mapped._slices), "map() decoded a slice"
+    assert mapped.serialize() == data
+    assert all(s is None for s in mapped._slices), "serialize() decoded a slice"
+    # a context query touches containers zero-copy; results match the built index
+    ctx = RoaringBitmap(np.arange(0, 200_000, 3, dtype=np.uint32))
+    a = mapped.between(100, 5_000, context=ctx)
+    b = app.build().between(100, 5_000, context=ctx)
+    assert a == b
+    # context-free query on a mapped index walks chunks lazily and agrees too
+    assert mapped.gte(9_000) == app.build().gte(9_000)
+
+
+def test_mapped_contextfree_equals_built_all_ops():
+    """Differential: mapped (streaming chunk walk) vs built (BSI engine)."""
+    app = RangeBitmap.appender(1 << 20)
+    rng = np.random.default_rng(11)
+    vals = rng.integers(0, 1 << 20, size=150_000, dtype=np.uint64)
+    app.add_many(vals)
+    built = app.build()
+    mapped = RangeBitmap.map(built.serialize())
+    for q in (0, 1, 12_345, (1 << 19), (1 << 20)):
+        for name in ("lt", "lte", "gt", "gte", "eq", "neq"):
+            assert getattr(mapped, name)(q) == getattr(built, name)(q), (name, q)
+    assert mapped.between(1000, 500_000) == built.between(1000, 500_000)
+
+
+def test_appender_usable_after_build():
+    """build()/serialize() must not poison the appender: build, keep
+    appending, build again (code-review regression)."""
+    app = RangeBitmap.appender(100)
+    app.add(1)
+    rb1 = app.build()
+    assert rb1.row_count == 1 and rb1.eq(1).to_array().tolist() == [0]
+    app.add(2)
+    rb2 = app.build()
+    assert rb2.row_count == 2
+    assert rb2.eq(2).to_array().tolist() == [1]
+    # the first build is sealed: later appends must not leak into it
+    assert rb1.row_count == 1
+    assert rb1.eq(2).is_empty()
+    data = app.serialize()  # serialize is also non-destructive
+    app.add(3)
+    rb3 = app.build()
+    assert rb3.row_count == 3 and rb3.eq(3).to_array().tolist() == [2]
+    assert RangeBitmap.map(data).row_count == 2
+    # across a chunk boundary: sealed indexes stay frozen
+    app2 = RangeBitmap.appender(7)
+    app2.add_many(np.full(1 << 16, 5, dtype=np.uint64))
+    first = app2.build()
+    app2.add_many(np.full(100, 6, dtype=np.uint64))
+    second = app2.build()
+    assert first.row_count == 1 << 16 and first.eq_cardinality(6) == 0
+    assert second.eq_cardinality(6) == 100
