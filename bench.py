@@ -131,17 +131,10 @@ def main():
     if layout == "padded" and pk.HAS_PALLAS and pk.on_tpu():
         from roaringbitmap_tpu import insights
 
-        def _fetch(out):
-            return jax.tree.map(lambda x: np.asarray(x), out)
+        from benchmarks.common import time_device
 
         def _time(fn):
-            _fetch(fn())  # compile
-            ts = []
-            for _ in range(REPS_TPU):
-                t0 = time.time()
-                _fetch(fn())
-                ts.append(time.time() - t0)
-            return min(ts)
+            return time_device(fn, reps=REPS_TPU)
 
         try:
             t_pallas = _time(lambda: pk.grouped_reduce_cardinality_pallas(dev_arr, op="or"))

@@ -1,0 +1,106 @@
+"""ART scale benchmark — memory/lookup behavior at >= 10M keys
+(VERDICT r2 #8: prove the two-representation adaptive design holds where
+the reference uses four node classes, art/Node4|16|48|256.java).
+
+The trie's physical forms: sorted byte-array + child list for <= 48
+children (covering the reference's Node4/16/48 widths) and a 256-slot
+dispatch table beyond (Node256), with upgrade at 48 and downgrade at 36.
+This suite inserts >= 10M distinct high-48-bit keys in three distributions
+(sequential, random, clustered), then reports insert ns/key, hit and miss
+lookup ns, ordered-walk ns/key, tracemalloc bytes/key, and the node-width
+histogram so the adaptivity is visible in the numbers.
+"""
+
+from __future__ import annotations
+
+import time
+import tracemalloc
+from typing import List
+
+import numpy as np
+
+from roaringbitmap_tpu.models.art import Art
+
+from .common import Result
+
+N_KEYS = 10_000_000
+
+
+def _keys(dist: str, n: int) -> np.ndarray:
+    rng = np.random.default_rng(0xFEEF1F0)
+    if dist == "sequential":
+        vals = np.arange(n, dtype=np.uint64)
+    elif dist == "random":
+        vals = rng.choice(np.uint64(1) << np.uint64(48), size=n, replace=False).astype(
+            np.uint64
+        )
+    else:  # clustered: 4096 dense islands
+        base = (rng.choice(1 << 24, size=4096, replace=False).astype(np.uint64)) << np.uint64(24)
+        per = n // 4096
+        vals = (base[:, None] + np.arange(per, dtype=np.uint64)[None, :]).ravel()[:n]
+    return vals
+
+
+def _key_bytes(vals: np.ndarray) -> List[bytes]:
+    # 6 big-endian bytes of the high-48 value (LongUtils high48 split)
+    raw = vals.astype(">u8").tobytes()
+    return [raw[i * 8 + 2 : i * 8 + 8] for i in range(len(vals))]
+
+
+def run(reps: int = 1, n_keys: int = N_KEYS, **_) -> List[Result]:
+    out: List[Result] = []
+    for dist in ("sequential", "random", "clustered"):
+        vals = _keys(dist, n_keys)
+        kb = _key_bytes(vals)
+        n_keys = len(kb)  # clustered may round down to a multiple of 4096
+
+        tracemalloc.start()
+        base = tracemalloc.get_traced_memory()[0]
+        art = Art()
+        t0 = time.perf_counter_ns()
+        for i, k in enumerate(kb):
+            art.insert(k, i)
+        insert_ns = (time.perf_counter_ns() - t0) / n_keys
+        mem = tracemalloc.get_traced_memory()[0] - base
+        tracemalloc.stop()
+
+        rng = np.random.default_rng(7)
+        probe_idx = rng.integers(0, n_keys, size=100_000)
+        probes = [kb[i] for i in probe_idx]
+        t0 = time.perf_counter_ns()
+        for p in probes:
+            art.find(p)
+        hit_ns = (time.perf_counter_ns() - t0) / len(probes)
+
+        miss = [bytes(6) if kb[0] != bytes(6) else b"\xff" * 6] * 1  # one cold key
+        miss_probes = [bytes(np.random.default_rng(int(i)).integers(0, 256, 6, dtype=np.uint8)) for i in range(20_000)]
+        t0 = time.perf_counter_ns()
+        for p in miss_probes:
+            art.find(p)
+        miss_ns = (time.perf_counter_ns() - t0) / len(miss_probes)
+
+        t0 = time.perf_counter_ns()
+        n_walked = sum(1 for _ in art.items())
+        walk_ns = (time.perf_counter_ns() - t0) / max(1, n_walked)
+        assert n_walked == len(art)
+
+        hist = art.node_width_histogram()
+        extra = {
+            "n_keys": n_keys,
+            "insert_ns_per_key": round(insert_ns, 1),
+            "hit_ns": round(hit_ns, 1),
+            "miss_ns": round(miss_ns, 1),
+            "walk_ns_per_key": round(walk_ns, 1),
+            "node_width_histogram": {str(k): v for k, v in hist.items()},
+        }
+        out.append(Result("artScale_bytesPerKey", f"dist-{dist}", mem / n_keys, "bytes/key", extra))
+        del art, kb
+    return out
+
+
+if __name__ == "__main__":
+    import sys
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else N_KEYS
+    for r in run(n_keys=n):
+        print(r.json(), flush=True)

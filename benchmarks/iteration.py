@@ -14,9 +14,60 @@ from . import common
 from .common import Result
 
 
+def _shape_bitmaps():
+    """One bitmap per container shape (BasicIteratorBenchmark's run/array/
+    bitmap split)."""
+    import numpy as np
+
+    from roaringbitmap_tpu import RoaringBitmap
+
+    rng = np.random.default_rng(0xFEEF1F0)
+    run_bm = RoaringBitmap(
+        np.concatenate(
+            [np.arange(s, s + 3000, dtype=np.uint32) for s in range(0, 1 << 20, 1 << 17)]
+        )
+    )
+    run_bm.run_optimize()
+    arr_bm = RoaringBitmap(rng.choice(1 << 22, size=30_000, replace=False).astype(np.uint32))
+    dense_bm = RoaringBitmap(np.flatnonzero(rng.random(1 << 19) < 0.4).astype(np.uint32))
+    return {"run": run_bm, "array": arr_bm, "bitmap": dense_bm}
+
+
 def run(reps: int = 3, datasets=None, **_) -> List[Result]:
     results = []
-    for ds in datasets or ["census1881"]:
+
+    # per-container-shape walks + advanceIfNeeded skip iteration
+    # (AdvanceIfNeededBenchmark)
+    for shape, bm in _shape_bitmaps().items():
+        card = bm.get_cardinality()
+
+        def walk(bm=bm):
+            it = bm.get_int_iterator()
+            while it.has_next():
+                it.next()
+
+        results.append(
+            Result("intIterator", f"shape-{shape}", common.min_of(reps, walk) / card, "ns/value")
+        )
+
+        last = bm.last()
+
+        def skip_walk(bm=bm, last=last):
+            import numpy as np
+
+            it = bm.get_batch_iterator()
+            buf = np.empty(256, dtype=np.uint32)
+            step = max(1, last // 64)
+            for target in range(0, last, step):
+                it.advance_if_needed(target)
+                if it.has_next():
+                    it.next_batch(buf)
+
+        results.append(
+            Result("advanceIfNeeded", f"shape-{shape}", common.min_of(reps, skip_walk) / 64, "ns/skip")
+        )
+
+    for ds in datasets or common.DEFAULT_DATASETS:
         bms = common.corpus_bitmaps(ds, limit=100)
         total = sum(b.get_cardinality() for b in bms)
 

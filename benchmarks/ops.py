@@ -56,7 +56,7 @@ def run(reps: int = 20, datasets=None, **_) -> List[Result]:
         for opname, op in OPS.items():
             ns = common.min_of(reps, lambda: op(a, b))
             results.append(Result(f"{opname}_{shape}", "synthetic", ns, "ns/op"))
-    for ds in datasets or ["census1881"]:
+    for ds in datasets or common.DEFAULT_DATASETS:
         bms = common.corpus_bitmaps(ds, limit=200)
         for opname in ("and", "or", "xor", "andNot"):
             op = OPS[opname]

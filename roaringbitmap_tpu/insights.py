@@ -80,6 +80,7 @@ def dispatch_counters() -> dict:
     return {
         "kernel": {f"{k[0]}/{k[1]}": v for k, v in pk.DISPATCH_COUNTS.items()},
         "layout": dict(store.LAYOUT_COUNTS),
+        "transfer_bytes": dict(store.TRANSFER_BYTES),
         "probes": {
             f"{k[0]}/{k[1]}/{'x'.join(map(str, k[2]))}/{k[3]}": v
             for k, v in pk._PROBED.items()
@@ -93,6 +94,7 @@ def reset_dispatch_counters() -> None:
 
     pk.DISPATCH_COUNTS.clear()
     store.LAYOUT_COUNTS.clear()
+    store.TRANSFER_BYTES.clear()
 
 
 def recommend(stats: BitmapStatistics) -> str:

@@ -284,6 +284,23 @@ class Art:
         for _, child in it:
             yield from self._walk(child, reverse)
 
+    def node_width_histogram(self) -> dict:
+        """Count of inner nodes per reference node class (4/16/48/256) —
+        introspection for the adaptive-width design (art/Node4.java etc.;
+        here widths <= 48 share the sorted-array physical form and wider
+        nodes the 256-table form, with upgrade at 48 and downgrade at 36)."""
+        hist = {4: 0, 16: 0, 48: 0, 256: 0, "leaves": 0}
+        stack = [self._root] if self._root is not None else []
+        while stack:
+            node = stack.pop()
+            if isinstance(node, _Leaf):
+                hist["leaves"] += 1
+                continue
+            hist[node.node_width()] += 1
+            for _, child in node.items():
+                stack.append(child)
+        return hist
+
     def first(self) -> Optional[Tuple[bytes, Any]]:
         for kv in self.items():
             return kv

@@ -19,10 +19,12 @@ compatibility and ignored beyond choosing the engine.
 
 from __future__ import annotations
 
+import struct
 from typing import List, Optional, Tuple
 
 import numpy as np
 
+from ..serialization import InvalidRoaringFormat
 from .bsi import Operation, RoaringBitmapSliceIndex
 from .roaring import RoaringBitmap
 
@@ -105,18 +107,90 @@ class MutableBitSliceIndex(RoaringBitmapSliceIndex):
         return out
 
 
+class _LazySlices:
+    """Sequence of slice bitmaps decoded zero-copy on first access — the
+    Mappeable analogue of ImmutableBitSliceIndex's per-slice ByteBuffer
+    views (ImmutableBitSliceIndex.java:52)."""
+
+    def __init__(self, buf: memoryview, extents: List[Tuple[int, int]]):
+        self._buf = buf
+        self._extents = extents
+        self._cache: dict = {}
+
+    def __len__(self) -> int:
+        return len(self._extents)
+
+    def __getitem__(self, i):
+        from .immutable import ImmutableRoaringBitmap
+
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        if i < 0:
+            i += len(self)
+        got = self._cache.get(i)
+        if got is None:
+            off, ln = self._extents[i]
+            got = ImmutableRoaringBitmap(self._buf[off : off + ln])
+            self._cache[i] = got
+        return got
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self)))
+
+
+def _map_bsi(buf: memoryview) -> RoaringBitmapSliceIndex:
+    """Open the BSI wire format (models/bsi.py serialize) as a lazy
+    zero-copy index: the existence bitmap and each slice become
+    ImmutableRoaringBitmap views; construction cost is one O(#containers)
+    header scan per bitmap to find extents, with no payload copies."""
+    from .immutable import ImmutableRoaringBitmap
+
+    if len(buf) < 9:
+        raise InvalidRoaringFormat("truncated BSI header")
+    min_v, max_v, ro = struct.unpack_from("<iib", buf, 0)
+    pos = 9
+    ebm = ImmutableRoaringBitmap(buf[pos:])
+    pos += ebm.serialized_size_in_bytes()
+    if pos + 4 > len(buf):
+        raise InvalidRoaringFormat("truncated BSI slice count")
+    (depth,) = struct.unpack_from("<i", buf, pos)
+    pos += 4
+    if depth < 0 or depth > 64:
+        raise InvalidRoaringFormat(f"implausible BSI depth {depth}")
+    extents: List[Tuple[int, int]] = []
+    for _ in range(depth):
+        # header-only parse to learn this slice's extent; the view is
+        # rebuilt lazily (and cached) on first real access
+        probe = ImmutableRoaringBitmap(buf[pos:])
+        ln = probe.serialized_size_in_bytes()
+        extents.append((pos, ln))
+        pos += ln
+    out = RoaringBitmapSliceIndex()
+    out.min_value, out.max_value = min_v, max_v
+    out.run_optimized = bool(ro)
+    out.ebm = ebm
+    out.slices = _LazySlices(buf, extents)
+    return out
+
+
 class ImmutableBitSliceIndex:
     """bsi/buffer/ImmutableBitSliceIndex.java:17 — read-only view, either
-    over an existing index (O(1) cast) or parsed from a serialized buffer
-    (ImmutableBitSliceIndex(ByteBuffer), :52)."""
+    over an existing index (O(1) cast) or mapped zero-copy from a
+    serialized buffer (ImmutableBitSliceIndex(ByteBuffer), :52): slice
+    payloads stay in the source buffer and are viewed lazily."""
 
     __slots__ = ("_base",)
 
     def __init__(self, source):
         if isinstance(source, RoaringBitmapSliceIndex):
             self._base = source
-        else:  # serialized buffer
-            self._base = RoaringBitmapSliceIndex.deserialize(source)
+        else:  # serialized buffer: lazy zero-copy map
+            buf = memoryview(
+                source
+                if isinstance(source, (bytes, bytearray, memoryview))
+                else bytes(source)
+            )
+            self._base = _map_bsi(buf)
 
     # read surface delegates
     def bit_count(self) -> int:

@@ -248,7 +248,14 @@ class ImmutableRoaringBitmap:
             return ArrayContainer(values)
         (n_runs,) = struct.unpack_from("<H", self._buf, off)
         pairs = np.frombuffer(self._buf, dtype="<u2", count=2 * n_runs, offset=off + 2)
-        return RunContainer(pairs[0::2], pairs[1::2])
+        starts, lengths = pairs[0::2], pairs[1::2]
+        # same hostile-payload checks as the heap deserialize path
+        # (serialization.py): sorted disjoint runs inside the 2^16 universe
+        s64 = starts.astype(np.int64)
+        ends = s64 + lengths.astype(np.int64)
+        if n_runs and (np.any(s64[1:] <= ends[:-1]) or np.any(ends > 0xFFFF)):
+            raise InvalidRoaringFormat("invalid run container")
+        return RunContainer(starts, lengths)
 
     def _key_index(self, key: int) -> int:
         i = int(np.searchsorted(self._keys, key))

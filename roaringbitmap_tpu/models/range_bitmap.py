@@ -137,14 +137,16 @@ class RangeBitmap:
         return self._slice(i).high_low_container.get_container(key)
 
     def _bsi_index(self) -> RoaringBitmapSliceIndex:
-        """The whole-index view used by context-free queries on a *built*
-        index (the fused device/CPU engine); mapped indexes always evaluate
-        via the lazy chunk walk instead."""
+        """The whole-index view used by context-free queries (the fused
+        device/CPU engine). For a mapped index the slices are zero-copy
+        ImmutableRoaringBitmap views — materialized lazily here, cached, and
+        legal operands of the engine's algebra, so a pickled/mapped index
+        keeps the batch path instead of degrading to the chunk walk."""
         if self._bsi is None:
             index = RoaringBitmapSliceIndex()
             index.min_value, index.max_value = 0, self._max_value
             index.ebm = RoaringBitmap.bitmap_of_range(0, self._max_rid)
-            index.slices = list(self._slices)
+            index.slices = [self._slice(i) for i in range(self._slice_count)]
             self._bsi = index
         return self._bsi
 
@@ -189,10 +191,6 @@ class RangeBitmap:
             raise ValueError("RangeBitmap values are unsigned")
         if context is not None:
             return self._chunk_walk(op, value, end, context)
-        if self._payloads is not None:
-            # mapped + context-free: the streaming walk decodes lazily;
-            # evaluate over every chunk without building the whole index
-            return self._chunk_walk(op, value, end, None)
         out = self._bsi_index().compare(op, value, end, None)
         if op is Operation.NEQ:
             # rows outside the appended universe cannot hold a value

@@ -85,6 +85,8 @@ def _declare(lib: ctypes.CDLL) -> None:
     i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
     lib.rb_pack_array_rows.restype = None
     lib.rb_pack_array_rows.argtypes = [i64p, i64p, i64, u16p, u64p]
+    lib.rb_words_from_intervals.restype = None
+    lib.rb_words_from_intervals.argtypes = [i64p, i64p, ctypes.c_int32, u64p]
 
 
 def _load():
@@ -244,6 +246,14 @@ def runs_from_values(values: np.ndarray):
 def num_runs_in_values(values: np.ndarray) -> int:
     v = _c16(values)
     return int(lib().rb_num_runs_values(v, v.size))
+
+
+def words_from_intervals(starts: np.ndarray, ends: np.ndarray) -> np.ndarray:
+    s = np.ascontiguousarray(starts, dtype=np.int64)
+    e = np.ascontiguousarray(ends, dtype=np.int64)
+    words = np.zeros(1024, dtype=np.uint64)
+    lib().rb_words_from_intervals(s, e, np.int32(s.size), words)
+    return words
 
 
 def pack_array_rows(

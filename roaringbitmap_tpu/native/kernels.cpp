@@ -253,6 +253,32 @@ int32_t rb_num_runs_values(const uint16_t* v, int32_t n) {
   return r;
 }
 
+// Fill a 1024-word bitset from disjoint half-open [start, end) intervals —
+// the RunContainer -> words expansion (RunContainer.toBitmapContainer
+// analogue). The numpy boundary-cumsum fallback pays ~200us in the int8 ->
+// int32 cumsum; this is a direct masked-word fill.
+void rb_words_from_intervals(const int64_t* starts, const int64_t* ends,
+                             int32_t n, uint64_t* words) {
+  for (int32_t i = 0; i < n; ++i) {
+    int64_t s = starts[i], e = ends[i];
+    // clamp to the 2^16 sub-universe: a hostile mapped run payload
+    // (e.g. start=0xFFFF, length=0xFFFF) must not write past words[1023]
+    if (s < 0) s = 0;
+    if (e > 65536) e = 65536;
+    if (e <= s) continue;
+    int64_t sw = s >> 6, ew = (e - 1) >> 6;
+    uint64_t first = ~0ULL << (s & 63);
+    uint64_t last = ~0ULL >> (63 - ((e - 1) & 63));
+    if (sw == ew) {
+      words[sw] |= first & last;
+    } else {
+      words[sw] |= first;
+      for (int64_t w = sw + 1; w < ew; ++w) words[w] = ~0ULL;
+      words[ew] |= last;
+    }
+  }
+}
+
 // ---------------------------------------------------------------------------
 // batch packing (device-store marshal)
 // ---------------------------------------------------------------------------

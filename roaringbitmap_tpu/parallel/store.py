@@ -27,6 +27,8 @@ import jax.numpy as jnp
 
 # layout observability: ("padded"|"segmented-scan") -> count (insights.dispatch_counters)
 LAYOUT_COUNTS: Counter = Counter()
+# host->device transfer accounting in bytes (insights.dispatch_counters)
+TRANSFER_BYTES: Counter = Counter()
 
 from ..models.container import ArrayContainer, BitmapContainer, Container
 from ..models.roaring import RoaringBitmap
@@ -52,7 +54,14 @@ def pack_rows_host(containers: Sequence[Container]) -> np.ndarray:
     matrix (one C-level pass over every value) instead of a per-container
     python loop; run rows (rare in working sets that were not
     run_optimized) fall back to per-container expansion."""
+    from .. import tracing
+
     n = len(containers)
+    with tracing.op_timer("store.pack_rows_host"):
+        return _pack_rows_host(containers, n)
+
+
+def _pack_rows_host(containers: Sequence[Container], n: int) -> np.ndarray:
     out64 = np.zeros((n, bits.WORDS_PER_CONTAINER), dtype=np.uint64)
     arr_rows: List[int] = []
     arr_vals: List[np.ndarray] = []
@@ -109,6 +118,7 @@ class PackedGroups:
         d = getattr(self, "_device_words", None)
         if d is None:
             d = jnp.asarray(self.words)
+            TRANSFER_BYTES["flat_rows"] += self.words.nbytes
             object.__setattr__(self, "_device_words", d)
         return d
 
@@ -124,7 +134,11 @@ class PackedGroups:
         key = (int(fill), int(row_multiple))
         if key not in cache:
             host = pad_groups_dense(self, fill, row_multiple)
-            cache[key] = None if host is None else jnp.asarray(host)
+            if host is None:
+                cache[key] = None
+            else:
+                cache[key] = jnp.asarray(host)
+                TRANSFER_BYTES["padded_groups"] += host.nbytes
         return cache[key]
 
 
@@ -246,6 +260,13 @@ def unpack_to_bitmap(
 ) -> RoaringBitmap:
     """Stream device results back into a RoaringBitmap via the append path
     (RoaringArray.append, RoaringArray.java:111), re-compressing each chunk."""
+    from .. import tracing
+
+    with tracing.op_timer("store.unpack_to_bitmap"):
+        return _unpack_to_bitmap(group_keys, words_u32, cards)
+
+
+def _unpack_to_bitmap(group_keys, words_u32, cards) -> RoaringBitmap:
     from ..models.container import ArrayContainer, best_container_of_words
 
     out = RoaringBitmap()

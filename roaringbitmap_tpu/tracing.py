@@ -1,0 +1,79 @@
+"""Profiling/tracing subsystem (SURVEY §5: the reference externalizes all
+performance work to jmh + simplebenchmark; the TPU equivalent is
+``jax.profiler`` traces plus library-level counters).
+
+Three layers:
+
+* ``trace(logdir)`` — context manager around ``jax.profiler.trace``; the
+  resulting TensorBoard/XProf dump shows XLA op timings and HBM transfers
+  for everything inside. ``benchmarks/run.py --profile`` wraps whole suites
+  in this.
+* ``annotate(name)`` — ``jax.profiler.TraceAnnotation`` wrapper so host-side
+  phases (packing, unpack/stream-back) show up as named spans between the
+  device ops. No-ops gracefully when jax is unavailable.
+* ``op_timer(name)`` / ``timings()`` — lightweight wall-clock accounting of
+  host-visible phases, queryable without a profile dump. Combined with
+  ``insights.dispatch_counters()`` (engine/layout/backend choices +
+  host->device transfer bytes) this answers "where did the time go, which
+  path served it, how many bytes moved" — the observability the reference
+  exposes via its introspection API (RoaringBitmap.getSizeInBytes etc.).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict, Iterator
+
+_TIMINGS: Dict[str, list] = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
+
+
+@contextlib.contextmanager
+def trace(logdir: str = "/tmp/rb_tpu_trace") -> Iterator[None]:
+    """jax.profiler trace over the enclosed block (view with TensorBoard)."""
+    import jax
+
+    with jax.profiler.trace(logdir):
+        yield
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named span in the device trace (falls back to a plain timer)."""
+    try:
+        import jax
+
+        ctx = jax.profiler.TraceAnnotation(name)
+    except Exception:  # jax missing or stripped build
+        ctx = contextlib.nullcontext()
+    with ctx, op_timer(name):
+        yield
+
+
+@contextlib.contextmanager
+def op_timer(name: str) -> Iterator[None]:
+    """Accumulate wall time for a named host-side phase."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        rec = _TIMINGS[name]
+        rec[0] += 1
+        rec[1] += time.perf_counter() - t0
+
+
+def timings() -> Dict[str, Dict[str, float]]:
+    """{name: {count, total_s, mean_ms}} for all recorded phases."""
+    return {
+        name: {
+            "count": c,
+            "total_s": round(total, 6),
+            "mean_ms": round(total / c * 1e3, 3) if c else 0.0,
+        }
+        for name, (c, total) in _TIMINGS.items()
+    }
+
+
+def reset_timings() -> None:
+    _TIMINGS.clear()

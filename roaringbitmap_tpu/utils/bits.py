@@ -291,6 +291,7 @@ _DISPATCHED = (
     "select_in_words",
     "cardinality_in_range",
     "runs_from_values",
+    "words_from_intervals",
 )
 
 for _name in _DISPATCHED:

@@ -21,23 +21,13 @@ REPS = 5
 VMEM_BUDGET = 12 * 2**20  # leave headroom under the ~16 MiB/core VMEM
 
 
-def _fetch(out):
-    """Force completion by materializing results on host — through the axon
-    tunnel, block_until_ready alone returns before the remote step finishes
-    (observed: 512 MiB 'reduced' in 0.03 ms = 20x HBM peak, impossible)."""
-    import jax
-
-    return jax.tree.map(lambda x: np.asarray(x), out)
+from benchmarks.common import fetch_device as _fetch  # noqa: E402
 
 
 def _time(fn):
-    _fetch(fn())  # compile
-    ts = []
-    for _ in range(REPS):
-        t0 = time.time()
-        _fetch(fn())
-        ts.append(time.time() - t0)
-    return min(ts)
+    from benchmarks.common import time_device
+
+    return time_device(fn, reps=REPS)
 
 
 def main():

@@ -108,3 +108,31 @@ def test_contains_many_and_advance_until():
         pos = native.advance_until(a, -1, int(a[a.size // 2]))
         assert a[pos] == a[a.size // 2]
         assert native.advance_until(a, -1, int(a[-1]) + 1 if a[-1] < 0xFFFF else 0xFFFF) >= a.size - 1
+
+
+def test_words_from_intervals_differential():
+    """Native masked-word interval fill vs the numpy boundary-cumsum oracle,
+    incl. word-boundary and full-universe edges."""
+    if not native.available():
+        pytest.skip("native unavailable")
+    rng = np.random.default_rng(123)
+    cases = [
+        (np.array([0], dtype=np.int64), np.array([65536], dtype=np.int64)),
+        (np.array([65535], dtype=np.int64), np.array([65536], dtype=np.int64)),
+        (np.array([0], dtype=np.int64), np.array([1], dtype=np.int64)),
+        (np.array([63], dtype=np.int64), np.array([65], dtype=np.int64)),
+        (np.array([0, 64], dtype=np.int64), np.array([64, 128], dtype=np.int64)),
+        (np.empty(0, dtype=np.int64), np.empty(0, dtype=np.int64)),
+    ]
+    for _ in range(20):
+        n = int(rng.integers(1, 200))
+        starts = np.sort(rng.choice(65536 - 1, size=n, replace=False)).astype(np.int64)
+        ends = np.minimum(
+            starts + rng.integers(1, 300, size=n), 
+            np.append(starts[1:], 65536),
+        ).astype(np.int64)
+        cases.append((starts, ends))
+    for starts, ends in cases:
+        got = native.words_from_intervals(starts, ends)
+        want = bits.words_from_intervals_numpy(starts, ends)
+        assert np.array_equal(got, want), (starts[:5], ends[:5])

@@ -237,17 +237,35 @@ def test_map_is_lazy_and_serialize_is_zero_decode():
 
 
 def test_mapped_contextfree_equals_built_all_ops():
-    """Differential: mapped (streaming chunk walk) vs built (BSI engine)."""
+    """Differential: mapped (zero-copy slice views through the BSI engine)
+    vs built, plus the streaming chunk walk vs the fused engine on the same
+    queries (two independent evaluators must agree)."""
+    from roaringbitmap_tpu.models.bsi import Operation
+
     app = RangeBitmap.appender(1 << 20)
     rng = np.random.default_rng(11)
     vals = rng.integers(0, 1 << 20, size=150_000, dtype=np.uint64)
     app.add_many(vals)
     built = app.build()
     mapped = RangeBitmap.map(built.serialize())
+    ops = {
+        "lt": Operation.LT, "lte": Operation.LE, "gt": Operation.GT,
+        "gte": Operation.GE, "eq": Operation.EQ, "neq": Operation.NEQ,
+    }
     for q in (0, 1, 12_345, (1 << 19), (1 << 20)):
-        for name in ("lt", "lte", "gt", "gte", "eq", "neq"):
-            assert getattr(mapped, name)(q) == getattr(built, name)(q), (name, q)
+        for name, op in ops.items():
+            want = getattr(built, name)(q)
+            assert getattr(mapped, name)(q) == want, (name, q)
+            # the chunk walk is a second, independent evaluator
+            assert built._chunk_walk(op, q, 0, None) == want, (name, q)
     assert mapped.between(1000, 500_000) == built.between(1000, 500_000)
+    assert (
+        built._chunk_walk(Operation.RANGE, 1000, 500_000, None)
+        == built.between(1000, 500_000)
+    )
+    # a pickled (mapped) index keeps the batch engine for context-free
+    # queries: the BSI view exists after one query (code-review regression)
+    assert mapped._bsi is not None
 
 
 def test_appender_usable_after_build():

@@ -190,3 +190,63 @@ def test_insights_dispatch_counters():
     cached = packed.padded_device(0)
     store.reduce_packed(packed, op="or")
     assert packed.padded_device(0) is cached
+
+
+def test_tracing_timings_and_transfer_bytes():
+    """Library tracing (SURVEY §5): host phases accumulate timings and
+    device transfers are accounted in bytes."""
+    from roaringbitmap_tpu import insights, tracing
+    from roaringbitmap_tpu.parallel import store
+
+    tracing.reset_timings()
+    insights.reset_dispatch_counters()
+    bms = [RoaringBitmap(np.arange(i, 70000 + i, dtype=np.uint32)) for i in range(3)]
+    packed = store.pack_groups(store.group_by_key(bms))
+    words, cards = store.reduce_packed(packed, op="or")
+    store.unpack_to_bitmap(packed.group_keys, words, cards)
+    t = tracing.timings()
+    assert t["store.pack_rows_host"]["count"] == 1
+    assert t["store.unpack_to_bitmap"]["count"] == 1
+    assert t["store.pack_rows_host"]["total_s"] >= 0
+    # the padded [G, M, 2048] uint32 tensor was shipped exactly once
+    xfer = insights.dispatch_counters()["transfer_bytes"]
+    m = int(np.diff(packed.group_offsets).max())
+    assert xfer["padded_groups"] == packed.n_groups * m * 2048 * 4
+    with tracing.annotate("probe-span"):
+        pass
+    assert tracing.timings()["probe-span"]["count"] == 1
+
+
+def test_immutable_rejects_hostile_run_payload():
+    """A mapped run container whose runs escape the 2^16 universe must raise
+    InvalidRoaringFormat, not corrupt memory via to_words (code-review
+    regression: the native interval fill previously wrote 8 KB past the
+    words buffer on start=0xFFFF, length=0xFFFF)."""
+    import struct
+
+    from roaringbitmap_tpu import InvalidRoaringFormat
+    from roaringbitmap_tpu.serialization import SERIAL_COOKIE
+
+    # hand-built buffer: 1 run container, key 0, cardinality 2 (card-1=1),
+    # runs [(0xFFFF, len 0xFFFF)] -> end 131070, out of universe
+    cookie = SERIAL_COOKIE | (0 << 16)  # size-1=0
+    buf = struct.pack("<I", cookie)
+    buf += bytes([0b1])  # run marker: container 0 is a run
+    buf += struct.pack("<HH", 0, 1)  # key 0, card-1
+    buf += struct.pack("<H", 1)  # n_runs
+    buf += struct.pack("<HH", 0xFFFF, 0xFFFF)  # hostile run
+    imm = ImmutableRoaringBitmap(buf)
+    with pytest.raises(InvalidRoaringFormat):
+        imm.high_low_container.get_container_at_index(0)
+    # the heap path rejects the same bytes
+    with pytest.raises(InvalidRoaringFormat):
+        RoaringBitmap.deserialize(buf)
+    # defense in depth: even if fed directly, the native kernel must clamp
+    from roaringbitmap_tpu import native
+
+    if native.available():
+        got = native.words_from_intervals(
+            np.array([0xFFFF], dtype=np.int64), np.array([0x1FFFE], dtype=np.int64)
+        )
+        assert got.shape == (1024,)
+        assert got[1023] == np.uint64(1) << np.uint64(63)
