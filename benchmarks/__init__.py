@@ -32,6 +32,8 @@ SUITES = [
     "rangebitmap",
     "writer",
     "runcontainer",
+    "micro",
     "bsi",
+    "bitsetutil",
     "filtered_ann",
 ]

@@ -52,7 +52,7 @@ def run(reps: int = 1, n_keys: int = N_KEYS, **_) -> List[Result]:
     for dist in ("sequential", "random", "clustered"):
         vals = _keys(dist, n_keys)
         kb = _key_bytes(vals)
-        n_keys = len(kb)  # clustered may round down to a multiple of 4096
+        n_eff = len(kb)  # clustered may round down to a multiple of 4096
 
         tracemalloc.start()
         base = tracemalloc.get_traced_memory()[0]
@@ -60,19 +60,18 @@ def run(reps: int = 1, n_keys: int = N_KEYS, **_) -> List[Result]:
         t0 = time.perf_counter_ns()
         for i, k in enumerate(kb):
             art.insert(k, i)
-        insert_ns = (time.perf_counter_ns() - t0) / n_keys
+        insert_ns = (time.perf_counter_ns() - t0) / n_eff
         mem = tracemalloc.get_traced_memory()[0] - base
         tracemalloc.stop()
 
         rng = np.random.default_rng(7)
-        probe_idx = rng.integers(0, n_keys, size=100_000)
+        probe_idx = rng.integers(0, n_eff, size=100_000)
         probes = [kb[i] for i in probe_idx]
         t0 = time.perf_counter_ns()
         for p in probes:
             art.find(p)
         hit_ns = (time.perf_counter_ns() - t0) / len(probes)
 
-        miss = [bytes(6) if kb[0] != bytes(6) else b"\xff" * 6] * 1  # one cold key
         miss_probes = [bytes(np.random.default_rng(int(i)).integers(0, 256, 6, dtype=np.uint8)) for i in range(20_000)]
         t0 = time.perf_counter_ns()
         for p in miss_probes:
@@ -86,14 +85,14 @@ def run(reps: int = 1, n_keys: int = N_KEYS, **_) -> List[Result]:
 
         hist = art.node_width_histogram()
         extra = {
-            "n_keys": n_keys,
+            "n_keys": n_eff,
             "insert_ns_per_key": round(insert_ns, 1),
             "hit_ns": round(hit_ns, 1),
             "miss_ns": round(miss_ns, 1),
             "walk_ns_per_key": round(walk_ns, 1),
             "node_width_histogram": {str(k): v for k, v in hist.items()},
         }
-        out.append(Result("artScale_bytesPerKey", f"dist-{dist}", mem / n_keys, "bytes/key", extra))
+        out.append(Result("artScale_bytesPerKey", f"dist-{dist}", mem / n_eff, "bytes/key", extra))
         del art, kb
     return out
 

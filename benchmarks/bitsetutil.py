@@ -1,0 +1,84 @@
+"""BitSetUtil conversion suite — twin of jmh BitSetUtilBenchmark.java over
+the real raw-bitset corpus (real-roaring-dataset/bitsets_1925630_96.gz,
+format documented in its README.md:24).
+
+Measures long[]-bitset -> RoaringBitmap conversion: the naive bit-by-bit
+path vs the block-wise bulk path (BitSetUtil.bitmapOf,
+BitSetUtil.java:174), plus the reverse bitmap -> long[] extraction.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from roaringbitmap_tpu import RoaringBitmap
+from roaringbitmap_tpu.models.bitset import bitmap_of_words
+from roaringbitmap_tpu.utils import datasets
+
+from . import common
+from .common import Result
+
+N_ROWS = 2000
+
+
+def _rows() -> List[np.ndarray]:
+    if datasets.bitset_matrix_available():
+        rows = datasets.fetch_bitset_matrix(limit=N_ROWS)
+        ds = "bitsets_1925630_96"
+    else:  # synthetic fallback keeps the suite runnable without the corpus
+        rng = np.random.default_rng(0xFEEF1F0)
+        rows = [
+            rng.integers(0, 1 << 64, size=int(rng.integers(1, 96)), dtype=np.uint64)
+            for _ in range(N_ROWS)
+        ]
+        ds = "synthetic-bitsets"
+    return rows, ds
+
+
+def run(reps: int = 5, **_) -> List[Result]:
+    rows, ds = _rows()
+    out: List[Result] = []
+
+    def naive(words: np.ndarray) -> RoaringBitmap:
+        bm = RoaringBitmap()
+        for w_i, w in enumerate(words.tolist()):
+            base = w_i << 6
+            while w:
+                bm.add(base + (w & -w).bit_length() - 1)
+                w &= w - 1
+        return bm
+
+    def bench(name, fn):
+        ns = common.min_of(reps, fn) / len(rows)
+        out.append(Result(name, ds, ns, "ns/bitset", {"rows": len(rows)}))
+
+    total_card = sum(
+        int(np.unpackbits(r.view(np.uint8)).sum()) for r in rows
+    )
+
+    def via_util():
+        acc = 0
+        for r in rows:
+            acc += bitmap_of_words(r).get_cardinality()
+        assert acc == total_card
+
+    def via_naive():
+        acc = 0
+        for r in rows[: len(rows) // 10]:  # naive is ~100x slower; sample
+            acc += naive(r).get_cardinality()
+
+    bench("bitsetToRoaringUsingBitSetUtil", via_util)
+    bench("bitsetToRoaringBitByBit(sampled10pct)", via_naive)
+
+    from roaringbitmap_tpu.models.bitset import words_of_bitmap
+
+    bms = [bitmap_of_words(r) for r in rows if r.size]
+
+    def back_to_words():
+        for bm in bms:
+            words_of_bitmap(bm)
+
+    bench("roaringToLongArray", back_to_words)
+    return out

@@ -50,21 +50,27 @@ def run(reps: int = 3, datasets=None, **_) -> List[Result]:
             Result("intIterator", f"shape-{shape}", common.min_of(reps, walk) / card, "ns/value")
         )
 
+        import numpy as np
+
         last = bm.last()
+        buf = np.empty(256, dtype=np.uint32)
+        step = max(1, last // 64)
+        targets = range(0, last, step)
 
-        def skip_walk(bm=bm, last=last):
-            import numpy as np
-
+        def skip_walk(bm=bm, buf=buf, targets=targets):
             it = bm.get_batch_iterator()
-            buf = np.empty(256, dtype=np.uint32)
-            step = max(1, last // 64)
-            for target in range(0, last, step):
+            for target in targets:
                 it.advance_if_needed(target)
                 if it.has_next():
                     it.next_batch(buf)
 
         results.append(
-            Result("advanceIfNeeded", f"shape-{shape}", common.min_of(reps, skip_walk) / 64, "ns/skip")
+            Result(
+                "advanceIfNeeded",
+                f"shape-{shape}",
+                common.min_of(reps, skip_walk) / len(targets),
+                "ns/skip",
+            )
         )
 
     for ds in datasets or common.DEFAULT_DATASETS:

@@ -1,0 +1,94 @@
+"""Facade micro-op suite — twins of the reference's single-op jmh files:
+AddOffsetBenchmark, BitmapOfRangeBenchmark, CheckedAddBenchmark,
+contains/ (ContainsBenchmark), equals/, deserialization/,
+RangeOperationBenchmark, SelectTopValuesBenchmark, AdvanceIfNeededBenchmark
+(jmh/src/jmh/java/org/roaringbitmap/).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from roaringbitmap_tpu import RoaringBitmap
+
+from . import common
+from .common import Result
+
+
+def run(reps: int = 10, datasets=None, **_) -> List[Result]:
+    rng = np.random.default_rng(0xFEEF1F0)
+    out: List[Result] = []
+
+    def bench(name, fn, dataset="synthetic", extra=None):
+        out.append(Result(name, dataset, common.min_of(reps, fn), "ns/op", extra or {}))
+
+    mixed = RoaringBitmap(
+        np.unique(
+            np.concatenate(
+                [
+                    rng.choice(1 << 22, size=50_000, replace=False),
+                    np.arange(1 << 20, (1 << 20) + 30_000),
+                ]
+            )
+        ).astype(np.uint32)
+    )
+    mixed.run_optimize()
+    twin = RoaringBitmap.deserialize(mixed.serialize())
+
+    # addOffset (RoaringBitmap.addOffset, AddOffsetBenchmark)
+    bench("addOffset_aligned", lambda: RoaringBitmap.add_offset(mixed, 1 << 16))
+    bench("addOffset_unaligned", lambda: RoaringBitmap.add_offset(mixed, 12_345))
+    bench("addOffset_negative", lambda: RoaringBitmap.add_offset(mixed, -12_345))
+
+    # bitmapOfRange (BitmapOfRangeBenchmark)
+    bench("bitmapOfRange_small", lambda: RoaringBitmap.bitmap_of_range(1000, 70_000))
+    bench("bitmapOfRange_large", lambda: RoaringBitmap.bitmap_of_range(0, 1 << 26))
+
+    # checkedAdd / checkedRemove (CheckedAddBenchmark)
+    def checked_add():
+        bm = mixed.clone()
+        for v in range(0, 100_000, 997):
+            bm.checked_add(v)
+
+    bench("checkedAdd", checked_add)
+
+    # contains: hit + miss probes (contains/ suite)
+    arr = mixed.to_array()
+    hits = arr[:: max(1, arr.size // 1000)][:1000].tolist()
+    misses = [int(v) for v in rng.integers(1 << 23, 1 << 24, size=1000)]
+    bench("contains_hit_x1000", lambda: [mixed.contains(v) for v in hits])
+    bench("contains_miss_x1000", lambda: [mixed.contains(v) for v in misses])
+    lo, hi = int(arr[100]), int(arr[-100])
+    bench("containsRange", lambda: mixed.contains_range(lo, lo + 1000))
+
+    # equals (equals/ suite): equal pair + first-container mismatch
+    near = mixed.clone()
+    near.flip_range(0, 1)
+    bench("equals_identical", lambda: mixed == twin)
+    bench("equals_differFirst", lambda: mixed == near)
+
+    # serialization + deserialization (deserialization/ suite)
+    data = mixed.serialize()
+    bench("serialize", lambda: mixed.serialize(), extra={"bytes": len(data)})
+    bench("deserialize", lambda: RoaringBitmap.deserialize(data))
+
+    # rangeCardinality + range ops (RangeOperationBenchmark, TestRangeCardinality)
+    bench("rangeCardinality", lambda: mixed.range_cardinality(lo, hi))
+
+    def flip_range():
+        bm = mixed.clone()
+        bm.flip_range(lo, hi)
+
+    bench("flipRange", flip_range)
+
+    # select/top values (SelectTopValuesBenchmark)
+    card = mixed.get_cardinality()
+    bench("select_spread_x100", lambda: [mixed.select(j) for j in range(0, card, max(1, card // 100))])
+    bench("limit_1000", lambda: mixed.limit(1000))
+
+    # first/last/next (BitmapNextBenchmark)
+    bench("nextValue_x1000", lambda: [mixed.next_value(v + 1) for v in hits])
+    bench("nextAbsentValue_x1000", lambda: [mixed.next_absent_value(v) for v in hits])
+    return out

@@ -22,13 +22,10 @@ from roaringbitmap_tpu import FastAggregation, RoaringBitmap
 
 from . import common
 from .common import Result
+from .ops import OPS as _ALL_OPS
 
-OPS = {
-    "and": RoaringBitmap.and_,
-    "or": RoaringBitmap.or_,
-    "xor": RoaringBitmap.xor,
-    "andNot": RoaringBitmap.andnot,
-}
+# the four pairwise ops of the shared benchmark op table (benchmarks/ops.py)
+OPS = {k: _ALL_OPS[k] for k in ("and", "or", "xor", "andNot")}
 
 
 def _run_heavy(rng, n_runs=400, span=1 << 22):

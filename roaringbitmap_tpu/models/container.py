@@ -470,6 +470,36 @@ class BitmapContainer(Container):
         ww = int(nz[-1])
         return (ww << 6) + int(self.words[ww]).bit_length() - 1
 
+    _ALL64 = (1 << 64) - 1
+
+    def next_absent_value(self, from_value: int) -> int:
+        """Word-level (BitmapContainer.nextAbsentValue): first zero bit >=
+        from_value, without the base class's full 65536-bit unpack."""
+        w = from_value >> 6
+        cur = (~int(self.words[w]) & self._ALL64) >> (from_value & 63)
+        if cur:
+            return from_value + (cur & -cur).bit_length() - 1
+        inv = ~self.words[w + 1 :]
+        nz = np.nonzero(inv)[0]
+        if nz.size == 0:
+            return 1 << 16
+        ww = w + 1 + int(nz[0])
+        word = int(inv[nz[0]])
+        return (ww << 6) + (word & -word).bit_length() - 1
+
+    def previous_absent_value(self, from_value: int) -> int:
+        """Last zero bit <= from_value, or -1 when [0, from_value] is full."""
+        w = from_value >> 6
+        cur = (~int(self.words[w]) & self._ALL64) & ((1 << ((from_value & 63) + 1)) - 1)
+        if cur:
+            return (w << 6) + cur.bit_length() - 1
+        inv = ~self.words[:w]
+        nz = np.nonzero(inv)[0]
+        if nz.size == 0:
+            return -1
+        ww = int(nz[-1])
+        return (ww << 6) + int(inv[ww]).bit_length() - 1
+
 
 # ---------------------------------------------------------------------------
 
@@ -687,6 +717,25 @@ class RunContainer(Container):
         if i < 0:
             return -1
         return int(min(from_value, e[i]))
+
+    def next_absent_value(self, from_value: int) -> int:
+        """Run-space (RunContainer.nextAbsentValue): if from_value falls in
+        a run, the answer is one past that run's end — normalized runs never
+        touch, so that position is absent (or 65536 past the universe)."""
+        s = self.starts.astype(np.int64)
+        i = int(np.searchsorted(s, from_value, side="right")) - 1
+        if i >= 0 and from_value <= int(s[i]) + int(self.lengths[i]):
+            return int(s[i]) + int(self.lengths[i]) + 1
+        return from_value
+
+    def previous_absent_value(self, from_value: int) -> int:
+        """Run-space twin: one before the covering run's start (absent by
+        the no-touching invariant), or -1 when that run starts at 0."""
+        s = self.starts.astype(np.int64)
+        i = int(np.searchsorted(s, from_value, side="right")) - 1
+        if i >= 0 and from_value <= int(s[i]) + int(self.lengths[i]):
+            return int(s[i]) - 1
+        return from_value
 
     def is_full(self) -> bool:
         return self.num_runs() == 1 and self.starts[0] == 0 and self.lengths[0] == 0xFFFF

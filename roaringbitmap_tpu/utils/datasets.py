@@ -84,6 +84,34 @@ def fetch_bit_position_ranges(name: str) -> List[np.ndarray]:
     return out
 
 
+def bitset_matrix_available(name: str = "bitsets_1925630_96") -> bool:
+    return os.path.isfile(os.path.join(REFERENCE_DATASET_DIR, name + ".gz"))
+
+
+def fetch_bitset_matrix(
+    name: str = "bitsets_1925630_96", limit: int | None = None
+) -> List[np.ndarray]:
+    """Rows of the gz-compressed raw-bitset corpus as uint64 word arrays.
+
+    Wire format (real-roaring-dataset README.md:24, written with Java's
+    DataOutputStream, so big-endian): int32 row count, then per row an
+    int32 long count followed by that many int64 words. Consumed by the
+    BitSetUtil conversion benchmarks (jmh BitSetUtilBenchmark.java)."""
+    import gzip
+    import struct as _struct
+
+    path = os.path.join(REFERENCE_DATASET_DIR, name + ".gz")
+    out: List[np.ndarray] = []
+    with gzip.open(path, "rb") as f:
+        (n_rows,) = _struct.unpack(">i", f.read(4))
+        take = n_rows if limit is None else min(limit, n_rows)
+        for _ in range(take):
+            (n_longs,) = _struct.unpack(">i", f.read(4))
+            words = np.frombuffer(f.read(8 * n_longs), dtype=">i8")
+            out.append(words.astype(np.int64).view(np.uint64))
+    return out
+
+
 def synthetic_census_like(
     n_bitmaps: int = 200, seed: int = 0xFEEF1F0
 ) -> List[np.ndarray]:

@@ -80,3 +80,25 @@ def test_cli_runs(small_corpus, capsys):
     assert runner.main(["ops", "--reps", "1", "--datasets", "census1881"]) == 0
     out = capsys.readouterr().out
     assert '"benchmark"' in out
+
+
+def test_bitset_matrix_retriever():
+    """gz raw-bitset corpus loader (real-roaring-dataset README.md:24:
+    big-endian int32 row count, then per row int32 long-count + longs)."""
+    from roaringbitmap_tpu.models.bitset import bitmap_of_words, words_of_bitmap
+    from roaringbitmap_tpu.utils import datasets
+
+    if not datasets.bitset_matrix_available():
+        pytest.skip("reference gz corpus not mounted")
+    rows = datasets.fetch_bitset_matrix(limit=200)
+    assert len(rows) == 200
+    assert all(r.dtype == np.uint64 for r in rows)
+    # conversion round-trip against a numpy popcount oracle
+    for r in rows[:20]:
+        if not r.size:
+            continue
+        bm = bitmap_of_words(r)
+        assert bm.get_cardinality() == int(np.unpackbits(r.view(np.uint8)).sum())
+        back = words_of_bitmap(bm)
+        assert np.array_equal(back, r[: back.size])
+        assert not np.any(r[back.size :])
