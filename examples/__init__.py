@@ -15,6 +15,8 @@ EXAMPLES = [
     "for_each",
     "immutable_example",
     "interval_check",
+    "range_index",
+    "observability",
     "memory_mapping",
     "paged_iterator",
     "serialize_to_bytes",

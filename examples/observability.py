@@ -1,0 +1,33 @@
+"""Execution observability: which engine, layout, and backend served each
+aggregation, how many bytes moved host->device, and where host time went
+(insights.dispatch_counters + tracing; the reference's introspection-only
+story extended to the device runtime)."""
+
+import json
+
+import numpy as np
+
+from roaringbitmap_tpu import FastAggregation, RoaringBitmap, insights, tracing
+
+
+def main():
+    tracing.reset_timings()
+    insights.reset_dispatch_counters()
+
+    rng = np.random.default_rng(0)
+    bms = [
+        RoaringBitmap(rng.choice(1 << 21, size=20_000, replace=False).astype(np.uint32))
+        for _ in range(64)
+    ]
+    union = FastAggregation.or_(*bms, mode="device")
+    print("union cardinality:", union.get_cardinality())
+
+    counters = insights.dispatch_counters()
+    print("kernel dispatch:", counters["kernel"])  # pallas vs xla per shape class
+    print("layout chosen:", counters["layout"])  # padded vs segmented-scan
+    print("bytes shipped:", counters["transfer_bytes"])
+    print("host phases:", json.dumps(tracing.timings(), indent=2))
+
+
+if __name__ == "__main__":
+    main()

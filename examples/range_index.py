@@ -1,0 +1,46 @@
+"""RangeBitmap: a sealed range index over a value column
+(reference RangeBitmap.java appender/map; queries lt/lte/gt/gte/eq/neq/
+between with optional context pre-filters that skip untouched 2^16-row
+chunks)."""
+
+import numpy as np
+
+from roaringbitmap_tpu import RoaringBitmap
+from roaringbitmap_tpu.models.range_bitmap import RangeBitmap
+
+
+def main():
+    rng = np.random.default_rng(0)
+    prices = rng.integers(0, 10_000, size=300_000, dtype=np.uint64)
+
+    # append-then-seal: the appender holds at most one 2^16-row chunk of
+    # raw values; chunks flush to compressed per-slice containers
+    app = RangeBitmap.appender(9_999)
+    app.add_many(prices)
+    index = app.build()
+    print("rows:", index.row_count)
+
+    cheap = index.lt(100)
+    print("rows with price < 100:", cheap.get_cardinality())
+    mid = index.between(2_500, 7_500)
+    print("rows in [2500, 7500]:", mid.get_cardinality())
+
+    # context pre-filter: only chunks present in the context are evaluated
+    ctx = RoaringBitmap(np.arange(0, 300_000, 2, dtype=np.uint32))
+    before = index.chunks_evaluated
+    filtered = index.between(2_500, 7_500, context=ctx)
+    print(
+        "filtered rows:", filtered.get_cardinality(),
+        "(chunks evaluated:", index.chunks_evaluated - before,
+        "of", (index.row_count + 65535) // 65536, ")",
+    )
+
+    # serialize -> map: zero-copy reopen; payloads decode on first touch
+    data = index.serialize()
+    mapped = RangeBitmap.map(data)
+    assert mapped.lt(100) == cheap
+    print("sealed bytes:", len(data), "(mapped reopen is O(slice directory))")
+
+
+if __name__ == "__main__":
+    main()

@@ -52,6 +52,10 @@ class config:
     # a fixed per-call cost (worst on tunneled dev chips) that only pays off
     # on large indexes (the 100M-row north-star is ~49k cells)
     min_device_cells = 4096
+    # jax.sharding.Mesh: when set, device compare/sum dispatches run sharded
+    # over the (containers, words) mesh (parallel/sharding.py) — the key-chunk
+    # axis is padded up to the containers-axis size with empty chunks
+    mesh = None
 
 
 def values_for_columns(cols: np.ndarray, slices, dtype=np.int64) -> np.ndarray:
@@ -442,6 +446,13 @@ class RoaringBitmapSliceIndex:
         exact python ints (S can exceed 62 bits in theory)."""
         keys, ebm_w, slices_w = self._pack_dense()
         found_w = self._found_words(keys, ebm_w.shape, found_set)
+        if config.mesh is not None:
+            from ..parallel import sharding
+
+            s3, f2 = _pad_chunk_axis(config.mesh, slices_w, found_w)
+            per_chunk = np.asarray(sharding.distributed_bsi_sum(config.mesh)(s3, f2))
+            per_slice = per_chunk.astype(object).sum(axis=1)  # exact python ints
+            return sum(int(c) << i for i, c in enumerate(per_slice.tolist()))
         per_chunk = np.asarray(_slice_masked_popcounts(slices_w, found_w))
         per_slice = per_chunk.astype(object).sum(axis=1)  # exact python ints
         return sum(int(c) << i for i, c in enumerate(per_slice.tolist()))
@@ -473,9 +484,19 @@ class RoaringBitmapSliceIndex:
             fixed_bm = found_set
             fixed_w = self._found_words(keys, ebm_w.shape, found_set)
 
-        out, cards = _o_neil_compare_fused(
-            slices_w, jnp.asarray(bits_vec), ebm_w, fixed_w, op.value
-        )
+        if config.mesh is not None and op != Operation.RANGE:
+            from ..parallel import sharding
+
+            k_orig = ebm_w.shape[0]
+            s3, e2, f2 = _pad_chunk_axis(config.mesh, slices_w, ebm_w, fixed_w)
+            out, cards = sharding.distributed_bsi_compare(config.mesh, op.value)(
+                s3, jnp.asarray(bits_vec), e2, f2
+            )
+            out, cards = out[:k_orig], cards[:k_orig]
+        else:
+            out, cards = _o_neil_compare_fused(
+                slices_w, jnp.asarray(bits_vec), ebm_w, fixed_w, op.value
+            )
         result = store.unpack_to_bitmap(
             np.asarray(keys, dtype=np.int64),
             np.asarray(out),
@@ -642,6 +663,27 @@ def _scan_body(carry, xs):
     gt_new = jnp.where(bit, gt, gt | (eq & slice_w))
     eq_new = jnp.where(bit, eq & slice_w, eq & ~slice_w)
     return (gt_new, lt_new, eq_new), None
+
+
+def _pad_chunk_axis(mesh, *arrays):
+    """Pad the key-chunk axis (second-to-last of [S,K,W], first of [K,W])
+    up to a multiple of the mesh's containers axis with empty chunks —
+    empty ebm/fixed words make padded chunks contribute nothing."""
+    import jax.numpy as jnp
+
+    n_c = int(mesh.devices.shape[0])
+    out = []
+    for a in arrays:
+        k_axis = a.ndim - 2
+        pad = (-a.shape[k_axis]) % n_c
+        if pad:
+            widths = [(0, 0)] * a.ndim
+            widths[k_axis] = (0, pad)
+            a = jnp.pad(jnp.asarray(a), widths)
+        else:
+            a = jnp.asarray(a)
+        out.append(a)
+    return out if len(out) > 1 else out[0]
 
 
 def o_neil_math(slices_w, bits_rev, ebm_w, fixed_w, op_name: str):

@@ -56,6 +56,7 @@ def make_mesh(n_devices: int | None = None, words_axis: int = 2) -> Mesh:
     return Mesh(devices.reshape(n // words_axis, words_axis), ("containers", "words"))
 
 
+@functools.lru_cache(maxsize=8)
 def distributed_wide_or_cardinality(mesh: Mesh):
     """Build a jitted (words [N, W]) -> (reduced [W], cardinality) step over
     the mesh. N must divide by the containers axis, W by the words axis."""
@@ -77,6 +78,7 @@ def distributed_wide_or_cardinality(mesh: Mesh):
     return jax.jit(mapped)
 
 
+@functools.lru_cache(maxsize=8)
 def distributed_grouped_or(mesh: Mesh):
     """Grouped variant: ([G, M, W]) -> ([G, W], [G]) with groups replicated
     along the containers axis padding dimension M sharded."""
@@ -98,6 +100,7 @@ def distributed_grouped_or(mesh: Mesh):
     return jax.jit(mapped)
 
 
+@functools.lru_cache(maxsize=8)
 def distributed_bsi_compare(mesh: Mesh, op_name: str):
     """Sharded O'Neil BSI compare: the [S, K, 2048] slice tensor splits
     its key-chunk axis over ``containers`` and its word axis over
@@ -126,6 +129,34 @@ def distributed_bsi_compare(mesh: Mesh, op_name: str):
             P("containers", "words"),
         ),
         out_specs=(P("containers", "words"), P("containers")),
+    )
+    return jax.jit(mapped)
+
+
+@functools.lru_cache(maxsize=8)
+def distributed_bsi_sum(mesh: Mesh):
+    """Sharded BSI sum (RoaringBitmapSliceIndex.sum, :581-592): per-slice
+    popcount of ``slice AND foundSet`` — elementwise over key-chunks and
+    words, with one words-axis psum. Per-(slice, chunk) counts (each
+    <= 65536, int32-safe without x64) return to host, where the exact
+    big-int weighting Σ 2^i · count_i runs in python ints — totals can
+    exceed any JAX integer dtype, exactly like the unsharded twin
+    (models/bsi._slice_masked_popcounts).
+
+    Returns a jitted ``(slices_w [S,K,W], found_w [K,W]) -> counts [S,K]``.
+    Cached per mesh so repeat queries reuse the compiled step.
+    """
+
+    def step(slices_w, found_w):
+        masked = slices_w & found_w[None, :, :]
+        counts = jnp.sum(lax.population_count(masked).astype(jnp.int32), axis=-1)
+        return lax.psum(counts, "words")
+
+    mapped = shard_map(
+        step,
+        mesh,
+        in_specs=(P(None, "containers", "words"), P("containers", "words")),
+        out_specs=P(None, "containers"),
     )
     return jax.jit(mapped)
 
