@@ -451,9 +451,8 @@ class RoaringBitmapSliceIndex:
 
             s3, f2 = _pad_chunk_axis(config.mesh, slices_w, found_w)
             per_chunk = np.asarray(sharding.distributed_bsi_sum(config.mesh)(s3, f2))
-            per_slice = per_chunk.astype(object).sum(axis=1)  # exact python ints
-            return sum(int(c) << i for i, c in enumerate(per_slice.tolist()))
-        per_chunk = np.asarray(_slice_masked_popcounts(slices_w, found_w))
+        else:
+            per_chunk = np.asarray(_slice_masked_popcounts(slices_w, found_w))
         per_slice = per_chunk.astype(object).sum(axis=1)  # exact python ints
         return sum(int(c) << i for i, c in enumerate(per_slice.tolist()))
 
@@ -494,8 +493,14 @@ class RoaringBitmapSliceIndex:
             )
             out, cards = out[:k_orig], cards[:k_orig]
         else:
-            out, cards = _o_neil_compare_fused(
-                slices_w, jnp.asarray(bits_vec), ebm_w, fixed_w, op.value
+            from ..ops import pallas_kernels as pk
+
+            out, cards = pk.best_oneil_compare(
+                jnp.asarray(slices_w),
+                jnp.asarray(bits_vec),
+                jnp.asarray(ebm_w),
+                jnp.asarray(fixed_w),
+                op.value,
             )
         result = store.unpack_to_bitmap(
             np.asarray(keys, dtype=np.int64),

@@ -269,6 +269,140 @@ def grouped_reduce_cardinality_pallas(
 
 
 # ---------------------------------------------------------------------------
+# fused O'Neil BSI compare (models/bsi.py o_neil_math as one kernel)
+# ---------------------------------------------------------------------------
+#
+# The XLA version is a lax.scan over the slice axis whose (GT, LT, EQ)
+# [K, 2048] carry round-trips through HBM on every step: ~4 reads + 3
+# writes of the state per slice on top of the slice read itself. Here the
+# state lives in VMEM registers across an unrolled slice loop, so each
+# slice word is read exactly ONCE from HBM and the state never leaves the
+# core — the memory-bound north-star compare approaches the S*K*8KB
+# streaming lower bound.
+
+ONEIL_K_TILE = 8  # key-chunks per grid step
+
+
+def oneil_plan(s: int, k: int, w: int, k_tile: int = ONEIL_K_TILE):
+    """Block layout for the [S, K, w] O'Neil walk; K padded to k_tile."""
+    k_pad = k + (-k) % k_tile
+    return {
+        "pad_chunks": k_pad - k,
+        "grid": (k_pad // k_tile,),
+        "slices_array": (s, k_pad, w),
+        "slices_block": (s, k_tile, w),
+        "slices_index": lambda i: (0, i, 0),
+        "kw_array": (k_pad, w),
+        "kw_block": (k_tile, w),
+        "kw_index": lambda i: (i, 0),
+    }
+
+
+def _make_oneil_kernel(s_count: int, op_name: str, dual: bool):
+    """Unrolled slice walk; ``dual`` runs both RANGE recurrences (GE lo,
+    LE hi) in the same pass over the slices. bits live in SMEM, ordered
+    high slice -> low (bits_rev), lo-walk first when dual."""
+
+    def kernel(bits_ref, slices_ref, ebm_ref, fixed_ref, out_ref):
+        eq = ebm_ref[...]
+        lt = jnp.zeros_like(eq)
+        gt = jnp.zeros_like(eq)
+        if dual:
+            eq2, lt2 = eq, jnp.zeros_like(eq)
+        for j in range(s_count):
+            sl = slices_ref[s_count - 1 - j]
+            bit = bits_ref[j] != 0
+            lt = jnp.where(bit, lt | (eq & ~sl), lt)
+            gt = jnp.where(bit, gt, gt | (eq & sl))
+            eq = jnp.where(bit, eq & sl, eq & ~sl)
+            if dual:
+                bit2 = bits_ref[s_count + j] != 0
+                lt2 = jnp.where(bit2, lt2 | (eq2 & ~sl), lt2)
+                eq2 = jnp.where(bit2, eq2 & sl, eq2 & ~sl)
+        fixed = fixed_ref[...]
+        if dual:  # RANGE = GE(lo) & LE(hi)
+            out = ((gt | eq) & (lt2 | eq2)) & fixed
+        else:
+            eq = eq & fixed
+            if op_name == "EQ":
+                out = eq
+            elif op_name == "NEQ":
+                out = fixed & ~eq
+            elif op_name == "GT":
+                out = gt & fixed
+            elif op_name == "LT":
+                out = lt & fixed
+            elif op_name == "LE":
+                out = (lt | eq) & fixed
+            else:  # GE
+                out = (gt | eq) & fixed
+        out_ref[...] = out
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("op", "interpret", "k_tile"))
+def oneil_compare_pallas(
+    slices_w,
+    bits_rev,
+    ebm_w,
+    fixed_w,
+    op: str = "GE",
+    interpret: bool = False,
+    k_tile: int = ONEIL_K_TILE,
+):
+    """Fused O'Neil compare: ([S, K, 2048], bits, [K, 2048], [K, 2048]) ->
+    ([K, 2048] result, [K] cards). ``bits_rev`` is bool [S] (or [2, S] for
+    op="RANGE", lo-walk first), matching models/bsi.o_neil_math."""
+    s, k, w = slices_w.shape
+    dual = op == "RANGE"
+    plan = oneil_plan(s, k, w, k_tile)
+    if plan["pad_chunks"]:
+        pad = plan["pad_chunks"]
+        slices_w = jnp.pad(slices_w, ((0, 0), (0, pad), (0, 0)))
+        ebm_w = jnp.pad(ebm_w, ((0, pad), (0, 0)))
+        fixed_w = jnp.pad(fixed_w, ((0, pad), (0, 0)))
+    bits_smem = bits_rev.reshape(-1).astype(jnp.int32)
+    out = pl.pallas_call(
+        _make_oneil_kernel(s, op, dual),
+        grid=plan["grid"],
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(
+                plan["slices_block"], plan["slices_index"], memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(plan["kw_block"], plan["kw_index"], memory_space=pltpu.VMEM),
+            pl.BlockSpec(plan["kw_block"], plan["kw_index"], memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            plan["kw_block"], plan["kw_index"], memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((plan["kw_array"][0], w), slices_w.dtype),
+        interpret=interpret,
+    )(bits_smem, slices_w, ebm_w, fixed_w)
+    out = out[:k]
+    cards = jnp.sum(lax.population_count(out).astype(jnp.int32), axis=-1)
+    return out, cards
+
+
+def best_oneil_compare(slices_w, bits_rev, ebm_w, fixed_w, op_name: str):
+    """Pallas O'Neil on TPU (probed, with fallback to the fused XLA scan)."""
+    if HAS_PALLAS and on_tpu():
+
+        def call(s, b, e, f, op):
+            return oneil_compare_pallas(s, b, e, f, op=op)
+
+        out = _probed_call("oneil", call, (slices_w, bits_rev, ebm_w, fixed_w), op_name)
+        if out is not None:
+            DISPATCH_COUNTS[("oneil", "pallas")] += 1
+            return out
+    DISPATCH_COUNTS[("oneil", "xla")] += 1
+    from ..models.bsi import _o_neil_compare_fused
+
+    return _o_neil_compare_fused(slices_w, bits_rev, ebm_w, fixed_w, op_name)
+
+
+# ---------------------------------------------------------------------------
 # dispatch: probe once, fall back to XLA on any failure
 # ---------------------------------------------------------------------------
 

@@ -53,6 +53,30 @@ def main():
         jax.block_until_ready((r3, c3))
         assert np.array_equal(np.asarray(r3), fold.reduce(host3, axis=1)), op
     print("dispatchers: OK")
+
+    # fused O'Neil compare (the BSI north-star kernel), incl. dual RANGE
+    from roaringbitmap_tpu.models.bsi import o_neil_math
+
+    s, k = 32, 66
+    slices = rng.integers(0, 1 << 32, size=(s, k, 2048), dtype=np.uint64).astype(np.uint32)
+    ebm = np.bitwise_or.reduce(slices, axis=0)
+    fixed = rng.integers(0, 1 << 32, size=(k, 2048), dtype=np.uint64).astype(np.uint32)
+    predicate, hi_pred = 0xA5A5A5A5 & ((1 << s) - 1), 0xC3C3C3C3 & ((1 << s) - 1)
+    bits = np.array([(predicate >> i) & 1 for i in range(s - 1, -1, -1)], dtype=bool)
+    bits_hi = np.array([(hi_pred >> i) & 1 for i in range(s - 1, -1, -1)], dtype=bool)
+    for op, b in [("GE", bits), ("EQ", bits), ("RANGE", np.stack([bits, bits_hi]))]:
+        t0 = time.time()
+        got_out, got_cards = pk.oneil_compare_pallas(
+            jnp.asarray(slices), jnp.asarray(b), jnp.asarray(ebm), jnp.asarray(fixed), op=op
+        )
+        got_out, got_cards = np.asarray(got_out), np.asarray(got_cards)
+        print(f"oneil pallas {op}: {time.time()-t0:.1f}s (compile+run)")
+        want_out, want_cards = o_neil_math(
+            jnp.asarray(slices), jnp.asarray(b), jnp.asarray(ebm), jnp.asarray(fixed), op
+        )
+        assert np.array_equal(got_out, np.asarray(want_out)), f"oneil {op} mismatch"
+        assert np.array_equal(got_cards, np.asarray(want_cards)), f"oneil {op} cards"
+    print("oneil pallas: OK")
     print("dispatch counts:", dict(pk.DISPATCH_COUNTS))
 
 

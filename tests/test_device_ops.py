@@ -263,3 +263,55 @@ def test_non_power_of_two_tile_rejected():
     arr = jnp.zeros((8, 2048), dtype=jnp.uint32)
     with pytest.raises(ValueError, match="power of two"):
         pk.wide_reduce_pallas(arr, op="or", interpret=True, row_tile=96)
+
+
+def test_oneil_pallas_interpret_matches_scan():
+    """Fused O'Neil Pallas kernel vs the XLA scan oracle for every op,
+    including the dual-recurrence RANGE, on K not a multiple of the tile."""
+    import jax.numpy as jnp
+
+    from roaringbitmap_tpu.models.bsi import o_neil_math
+    from roaringbitmap_tpu.ops import pallas_kernels as pk
+
+    if not pk.HAS_PALLAS:
+        pytest.skip("pallas unavailable")
+    rng = np.random.default_rng(51)
+    s, k = 6, 11  # k deliberately not a multiple of ONEIL_K_TILE
+    slices = rng.integers(0, 1 << 32, size=(s, k, 2048), dtype=np.uint64).astype(np.uint32)
+    ebm = np.bitwise_or.reduce(slices, axis=0)
+    fixed = rng.integers(0, 1 << 32, size=(k, 2048), dtype=np.uint64).astype(np.uint32)
+    predicate, hi = 0b100110, 0b110101
+    bits = np.array([(predicate >> i) & 1 for i in range(s - 1, -1, -1)], dtype=bool)
+    bits_hi = np.array([(hi >> i) & 1 for i in range(s - 1, -1, -1)], dtype=bool)
+    for op in ("GE", "GT", "LT", "LE", "EQ", "NEQ"):
+        want_out, want_cards = o_neil_math(
+            jnp.asarray(slices), jnp.asarray(bits), jnp.asarray(ebm), jnp.asarray(fixed), op
+        )
+        got_out, got_cards = pk.oneil_compare_pallas(
+            jnp.asarray(slices), jnp.asarray(bits), jnp.asarray(ebm), jnp.asarray(fixed),
+            op=op, interpret=True,
+        )
+        assert np.array_equal(np.asarray(got_out), np.asarray(want_out)), op
+        assert np.array_equal(np.asarray(got_cards), np.asarray(want_cards)), op
+    bits2 = np.stack([bits, bits_hi])
+    want_out, want_cards = o_neil_math(
+        jnp.asarray(slices), jnp.asarray(bits2), jnp.asarray(ebm), jnp.asarray(fixed), "RANGE"
+    )
+    got_out, got_cards = pk.oneil_compare_pallas(
+        jnp.asarray(slices), jnp.asarray(bits2), jnp.asarray(ebm), jnp.asarray(fixed),
+        op="RANGE", interpret=True,
+    )
+    assert np.array_equal(np.asarray(got_out), np.asarray(want_out))
+    assert np.array_equal(np.asarray(got_cards), np.asarray(want_cards))
+
+
+@pytest.mark.parametrize("s,k", [(1, 1), (32, 11), (64, 24), (6, 1526)])
+def test_oneil_plan_blocks_legal(s, k):
+    from roaringbitmap_tpu.ops import pallas_kernels as pk
+
+    plan = pk.oneil_plan(s, k, 2048)
+    assert pk.mosaic_block_ok(plan["slices_block"], plan["slices_array"])
+    assert pk.mosaic_block_ok(plan["kw_block"], plan["kw_array"])
+    # VMEM: double-buffered slices block + 3 kw blocks + state must fit
+    in_bytes = 4 * s * pk.ONEIL_K_TILE * 2048
+    assert 2 * in_bytes + 6 * 4 * pk.ONEIL_K_TILE * 2048 <= 12 * 2**20
