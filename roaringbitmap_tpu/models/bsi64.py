@@ -29,6 +29,13 @@ from .roaring64art import Roaring64Bitmap
 _MAX64 = 1 << 64
 
 
+class config:
+    """Device dispatch knobs for the 64-bit index (mirror of bsi.config)."""
+
+    mode: str = "auto"  # 'auto' | 'cpu' | 'device'
+    min_device_cells = 4096  # slices x key-chunks below which CPU wins
+
+
 class Roaring64BitmapSliceIndex:
     """64-bit BSI (bsi/longlong/Roaring64BitmapSliceIndex.java:16)."""
 
@@ -42,6 +49,8 @@ class Roaring64BitmapSliceIndex:
             Roaring64Bitmap() for _ in range(max(0, int(max_value)).bit_length())
         ]
         self.run_optimized = False
+        self._version = 0
+        self._pack_cache = None
 
     # ------------------------------------------------------------------
     # construction
@@ -76,6 +85,7 @@ class Roaring64BitmapSliceIndex:
             else:
                 self.slices[i].remove(column_id)
         self.ebm.add(column_id)
+        self._version += 1
 
     def set_values(self, pairs) -> None:
         """Vectorized bulk load (setValues, Roaring64BitmapSliceIndex.java:341);
@@ -116,6 +126,7 @@ class Roaring64BitmapSliceIndex:
             if mask.any():
                 self.slices[i].add_many(cols[mask])
         self.ebm.add_many(cols)
+        self._version += 1
 
     def get_value(self, column_id: int) -> Tuple[int, bool]:
         if not self.ebm.contains(column_id):
@@ -150,6 +161,7 @@ class Roaring64BitmapSliceIndex:
         for s in self.slices:
             s.run_optimize()
         self.run_optimized = True
+        self._version += 1
 
     def has_run_compression(self) -> bool:
         return self.run_optimized
@@ -169,6 +181,7 @@ class Roaring64BitmapSliceIndex:
         self.ebm.ior(other.ebm)
         self.min_value = min(self.min_value, other.min_value)
         self.max_value = max(self.max_value, other.max_value)
+        self._version += 1
 
     def add(self, other: "Roaring64BitmapSliceIndex") -> None:
         if other is None or other.ebm.is_empty():
@@ -180,6 +193,7 @@ class Roaring64BitmapSliceIndex:
             self._add_digit(other.slices[i], i)
         self.min_value = self._min_value()
         self.max_value = self._max_value()
+        self._version += 1
 
     add_digit = None  # set below
 
@@ -220,16 +234,131 @@ class Roaring64BitmapSliceIndex:
         start_or_value: int,
         end: int = 0,
         found_set: Optional[Roaring64Bitmap] = None,
+        mode: Optional[str] = None,
     ) -> Roaring64Bitmap:
         res = self._compare_using_min_max(operation, start_or_value, end, found_set)
         if res is not None:
             return res
         if operation == Operation.RANGE:
             end = min(int(end), (1 << self.bit_count()) - 1)
+            if self._use_device(mode):
+                return self._o_neil_device(operation, start_or_value, found_set, end=end)
             left = self._o_neil(Operation.GE, start_or_value, found_set)
             right = self._o_neil(Operation.LE, end, found_set)
             return Roaring64Bitmap.and_(left, right)
+        if self._use_device(mode):
+            return self._o_neil_device(operation, start_or_value, found_set)
         return self._o_neil(operation, start_or_value, found_set)
+
+    def _use_device(self, mode: Optional[str]) -> bool:
+        mode = mode or config.mode
+        if mode == "cpu":
+            return False
+        if mode == "device":
+            return True
+        # auto: same guard as the 32-bit engine (bsi._use_device) — no jax
+        # or a CPU-only backend means the device marshal never pays off
+        try:
+            import jax
+
+            backend = jax.default_backend()
+        except Exception:
+            return False
+        cells = self.bit_count() * self._key_count()
+        return backend != "cpu" and cells >= config.min_device_cells
+
+    def _key_count(self) -> int:
+        # O(1): the Containers store tracks its live count
+        return len(self.ebm._containers)
+
+    def _pack_dense64(self):
+        """[S, K, 2048] slice tensor + [K, 2048] ebm over the ebm's high-48
+        chunk keys — the 64-bit twin of bsi._pack_dense; the K axis IS the
+        long-context scaling axis (SURVEY §5: 64-bit universes shard along
+        the key axis). Cached until the next mutation."""
+        if self._pack_cache is not None and self._pack_cache[0] == self._version:
+            return self._pack_cache[1:]
+        import jax.numpy as jnp
+
+        from ..ops import device as dev
+        from ..parallel.store import container_words_u32
+
+        kv = list(self.ebm._kv())
+        keys = [k for k, _ in kv]
+        kidx = {k: i for i, k in enumerate(keys)}
+        K, S = len(keys), self.bit_count()
+        ebm_w = np.zeros((K, dev.DEVICE_WORDS), dtype=np.uint32)
+        for k, c in kv:
+            ebm_w[kidx[k]] = container_words_u32(c)
+        slices_w = np.zeros((S, K, dev.DEVICE_WORDS), dtype=np.uint32)
+        for i, sl in enumerate(self.slices):
+            for k, c in sl._kv():
+                ki = kidx.get(k)
+                if ki is not None:  # slice columns are always ebm columns
+                    slices_w[i, ki] = container_words_u32(c)
+        self._pack_cache = (
+            self._version,
+            keys,
+            jnp.asarray(ebm_w),
+            jnp.asarray(slices_w),
+        )
+        return self._pack_cache[1:]
+
+    def _found_words(self, keys, shape, found_set) -> np.ndarray:
+        from ..parallel.store import container_words_u32
+
+        kidx = {k: i for i, k in enumerate(keys)}
+        out = np.zeros(shape, dtype=np.uint32)
+        for k, c in found_set._kv():
+            ki = kidx.get(k)
+            if ki is not None:
+                out[ki] = container_words_u32(c)
+        return out
+
+    def _o_neil_device(
+        self, op, predicate, found_set, end: int = 0
+    ) -> Roaring64Bitmap:
+        """The fused device O'Neil over high-48 chunk keys (the 32-bit
+        engine's kernels, ops/pallas_kernels.best_oneil_compare, apply
+        unchanged — the key width only changes the host-side directory)."""
+        import jax.numpy as jnp
+
+        from ..models.container import best_container_of_words
+        from ..ops import pallas_kernels as pk
+
+        keys, ebm_w, slices_w = self._pack_dense64()
+        S = self.bit_count()
+        bits_vec = np.array(
+            [(predicate >> i) & 1 for i in range(S - 1, -1, -1)], dtype=bool
+        )
+        if op == Operation.RANGE:
+            bits_hi = np.array(
+                [(end >> i) & 1 for i in range(S - 1, -1, -1)], dtype=bool
+            )
+            bits_vec = np.stack([bits_vec, bits_hi])
+        if found_set is None:
+            fixed_w = ebm_w
+        else:
+            fixed_w = jnp.asarray(
+                self._found_words(keys, (len(keys), ebm_w.shape[1]), found_set)
+            )
+        out, cards = pk.best_oneil_compare(
+            slices_w, jnp.asarray(bits_vec), ebm_w, fixed_w, op.value
+        )
+        out_np = np.ascontiguousarray(np.asarray(out)).view(np.uint64)
+        cards_np = np.asarray(cards)
+        result = Roaring64Bitmap()
+        for ki, key in enumerate(keys):
+            if int(cards_np[ki]):
+                result._put(key, best_container_of_words(out_np[ki].copy()))
+        if op == Operation.NEQ and found_set is not None:
+            # foundSet columns in chunks outside the ebm cannot be EQ, so
+            # they all qualify (same Java semantics as the 32-bit engine)
+            kset = set(keys)
+            for k, c in found_set._kv():
+                if k not in kset:
+                    result._put(k, c.clone())
+        return result
 
     def _compare_using_min_max(self, op, start_or_value, end, found_set):
         all_ = (

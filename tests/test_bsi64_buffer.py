@@ -225,3 +225,50 @@ def test_immutable_bsi_maps_lazily_zero_copy():
     mut = imm.to_mutable_bit_slice_index()
     mut.set_value(0, 123)
     assert imm.get_value(0)[0] != 123 or bsi.get_value(0)[0] == 123
+
+
+def test_bsi64_device_path_matches_cpu():
+    """The 64-bit index's fused device O'Neil (over high-48 chunk keys) must
+    agree with the CPU whole-bitmap walk for every op, across multiple
+    high-32 buckets, with and without found sets."""
+    import numpy as np
+
+    from roaringbitmap_tpu.models.bsi64 import config
+    from roaringbitmap_tpu.models.roaring64art import Roaring64Bitmap
+
+    rng = np.random.default_rng(23)
+    # columns spread over three high-32 buckets (and several high-48 chunks)
+    cols = np.unique(
+        np.concatenate(
+            [
+                rng.integers(0, 1 << 20, size=30_000, dtype=np.uint64),
+                (np.uint64(5) << np.uint64(32)) + rng.integers(0, 1 << 18, size=20_000, dtype=np.uint64),
+                (np.uint64(1) << np.uint64(60)) + rng.integers(0, 1 << 17, size=10_000, dtype=np.uint64),
+            ]
+        )
+    )
+    vals = rng.integers(0, 1 << 40, size=cols.size, dtype=np.uint64)
+    bsi = Roaring64BitmapSliceIndex()
+    bsi.set_values((cols, vals))
+    found = Roaring64Bitmap(cols[::3].copy())
+    med = int(np.median(vals))
+
+    for op in (Operation.GE, Operation.LT, Operation.EQ, Operation.NEQ):
+        for fs in (None, found):
+            cpu = bsi.compare(op, med, 0, fs, mode="cpu")
+            dev = bsi.compare(op, med, 0, fs, mode="device")
+            assert dev.serialize() == cpu.serialize(), (op, fs is not None)
+    cpu = bsi.compare(Operation.RANGE, med // 2, med * 2, found, mode="cpu")
+    dev = bsi.compare(Operation.RANGE, med // 2, med * 2, found, mode="device")
+    assert dev.serialize() == cpu.serialize()
+    # NEQ with found-set columns outside the ebm's chunks
+    stray = Roaring64Bitmap(np.array([1 << 50, (1 << 50) + 7], dtype=np.uint64))
+    fs2 = Roaring64Bitmap.or_(found, stray)
+    cpu = bsi.compare(Operation.NEQ, med, 0, fs2, mode="cpu")
+    dev = bsi.compare(Operation.NEQ, med, 0, fs2, mode="device")
+    assert dev.serialize() == cpu.serialize()
+    # the pack is cached until mutation
+    assert bsi._pack_cache is not None
+    v = bsi._pack_cache[0]
+    bsi.set_value(int(cols[0]), 7)
+    assert bsi._version != v
