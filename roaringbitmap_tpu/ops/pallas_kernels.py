@@ -269,6 +269,105 @@ def grouped_reduce_cardinality_pallas(
 
 
 # ---------------------------------------------------------------------------
+# segmented reduce (the skewed-group layout, ops/device.segmented_reduce)
+# ---------------------------------------------------------------------------
+#
+# The XLA path is a flagged lax.associative_scan: O(N log N) word-ops and
+# ~2·log2(N) full passes over the [N, 2048] array through HBM. TPU grids
+# execute sequentially, so a Pallas kernel can instead carry the running
+# segment accumulator in a VMEM scratch across row tiles: one read and one
+# write per row — the O(N) streaming bound. Same contract as the XLA
+# version: out[i] = inclusive segment prefix at row i (callers gather the
+# segment-end rows host-side via group_offsets).
+
+SEG_ROW_TILE = 128
+
+
+def seg_plan(n: int, w: int, row_tile: int = SEG_ROW_TILE):
+    n_pad = n + (-n) % row_tile
+    return {
+        "pad_rows": n_pad - n,
+        "grid": (n_pad // row_tile,),
+        "rows_array": (n_pad, w),
+        "rows_block": (row_tile, w),
+        "rows_index": lambda i: (i, 0),
+        "flags_array": (n_pad,),
+        "flags_block": (row_tile,),
+        "flags_index": lambda i: (i,),
+    }
+
+
+def _make_seg_kernel(op, fill, row_tile: int):
+    def kernel(flags_ref, words_ref, out_ref, acc_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _init():
+            # op identity, so rows before the first True flag fold to the
+            # same result as the XLA associative scan (seg_start[0]=False
+            # is legal input even though prepare_reduce never produces it)
+            acc_ref[...] = jnp.full_like(acc_ref, fill)
+
+        acc = acc_ref[0]
+        for r in range(row_tile):
+            row = words_ref[r]
+            start = flags_ref[r] != 0
+            acc = jnp.where(start, row, op(acc, row))
+            out_ref[r] = acc
+        acc_ref[0] = acc
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("op", "interpret", "row_tile"))
+def segmented_reduce_pallas(
+    words, seg_start, op: str = "or", interpret: bool = False, row_tile: int = SEG_ROW_TILE
+):
+    """Segmented inclusive scan ``[N, 2048] -> [N, 2048]`` in one HBM pass.
+
+    ``seg_start``: bool [N], True at each segment's first row. Rows are
+    padded to the tile with flag=True so padding never leaks into a real
+    segment (each padded row restarts its own segment)."""
+    fn = {"or": lax.bitwise_or, "and": lax.bitwise_and, "xor": lax.bitwise_xor}[op]
+    n, w = words.shape
+    plan = seg_plan(n, w, row_tile)
+    if plan["pad_rows"]:
+        words = jnp.pad(words, ((0, plan["pad_rows"]), (0, 0)))
+        seg_start = jnp.pad(seg_start, (0, plan["pad_rows"]), constant_values=True)
+    out = pl.pallas_call(
+        _make_seg_kernel(fn, dev._INIT[op], row_tile),
+        grid=plan["grid"],
+        in_specs=[
+            pl.BlockSpec(
+                plan["flags_block"], plan["flags_index"], memory_space=pltpu.SMEM
+            ),
+            pl.BlockSpec(
+                plan["rows_block"], plan["rows_index"], memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            plan["rows_block"], plan["rows_index"], memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct(plan["rows_array"], words.dtype),
+        scratch_shapes=[pltpu.VMEM((1, w), words.dtype)],
+        interpret=interpret,
+    )(seg_start.astype(jnp.int32), words)
+    return out[:n]
+
+
+def best_segmented_reduce(words, seg_start, op: str = "or"):
+    """Pallas one-pass segmented scan on TPU (probed, with fallback to the
+    XLA associative scan)."""
+    if HAS_PALLAS and on_tpu():
+        out = _probed_call("segmented", segmented_reduce_pallas, (words, seg_start), op)
+        if out is not None:
+            DISPATCH_COUNTS[("segmented", "pallas")] += 1
+            return out
+    DISPATCH_COUNTS[("segmented", "xla")] += 1
+    return dev.segmented_reduce(words, seg_start, op=op)
+
+
+# ---------------------------------------------------------------------------
 # fused O'Neil BSI compare (models/bsi.py o_neil_math as one kernel)
 # ---------------------------------------------------------------------------
 #
@@ -388,11 +487,9 @@ def oneil_compare_pallas(
 def best_oneil_compare(slices_w, bits_rev, ebm_w, fixed_w, op_name: str):
     """Pallas O'Neil on TPU (probed, with fallback to the fused XLA scan)."""
     if HAS_PALLAS and on_tpu():
-
-        def call(s, b, e, f, op):
-            return oneil_compare_pallas(s, b, e, f, op=op)
-
-        out = _probed_call("oneil", call, (slices_w, bits_rev, ebm_w, fixed_w), op_name)
+        out = _probed_call(
+            "oneil", oneil_compare_pallas, (slices_w, bits_rev, ebm_w, fixed_w), op_name
+        )
         if out is not None:
             DISPATCH_COUNTS[("oneil", "pallas")] += 1
             return out

@@ -234,7 +234,9 @@ def prepare_reduce(packed: PackedGroups, op: str = "or"):
     words = packed.device_words
 
     def run():
-        vals = dev.segmented_reduce(words, seg, op=op)
+        from ..ops import pallas_kernels as pk
+
+        vals = pk.best_segmented_reduce(words, seg, op=op)
         red = vals[end_rows]
         return red, dev.popcount_rows(red)
 

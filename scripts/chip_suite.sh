@@ -1,0 +1,19 @@
+#!/bin/bash
+# Everything that needs the real chip, in one run — executed automatically
+# by scripts/tunnel_watch.sh when the axon tunnel comes back.
+set -u
+cd /root/repo
+export PYTHONPATH=/root/repo:${PYTHONPATH:-}
+LOG=${1:-/tmp/chip_suite.log}
+{
+  echo "=== chip suite start: $(date -u +%FT%TZ)"
+  echo "--- kernel check (wide/grouped/oneil pallas on chip)"
+  timeout 900 python -u scripts/tpu_kernel_check.py 2>&1 | grep -v WARNING
+  echo "--- tile sweep (honest fetch-forced timing)"
+  timeout 900 python -u scripts/tile_sweep.py 2>&1 | grep -v WARNING
+  echo "--- bench.py (north star)"
+  timeout 900 python -u bench.py 2>&1 | grep -v WARNING
+  echo "--- BSI north star on chip (10M rows to bound build time)"
+  timeout 1800 python -u -m benchmarks.bsi 10000000 2>&1 | grep -v WARNING
+  echo "=== chip suite done: $(date -u +%FT%TZ)"
+} >> "$LOG" 2>&1

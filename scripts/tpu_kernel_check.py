@@ -77,6 +77,21 @@ def main():
         assert np.array_equal(got_out, np.asarray(want_out)), f"oneil {op} mismatch"
         assert np.array_equal(got_cards, np.asarray(want_cards)), f"oneil {op} cards"
     print("oneil pallas: OK")
+
+    # one-pass segmented scan (the skewed-layout kernel)
+    n = 5_000
+    rows = rng.integers(0, 1 << 32, size=(n, 2048), dtype=np.uint64).astype(np.uint32)
+    offs = np.unique(np.concatenate([[0], rng.integers(1, n, size=60)]))
+    seg = np.zeros(n, dtype=bool)
+    seg[offs] = True
+    t0 = time.time()
+    vals = np.asarray(pk.segmented_reduce_pallas(jnp.asarray(rows), jnp.asarray(seg), op="or"))
+    print(f"segmented pallas compile+run: {time.time()-t0:.1f}s")
+    bounds = np.append(offs, n)
+    for s_i, e_i in zip(bounds[:-1], bounds[1:]):
+        want = np.bitwise_or.reduce(rows[s_i:e_i], axis=0)
+        assert np.array_equal(vals[e_i - 1], want), ("segmented", s_i, e_i)
+    print("segmented pallas: OK")
     print("dispatch counts:", dict(pk.DISPATCH_COUNTS))
 
 

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Probe the axon tunnel every ~4 minutes; when it answers, run the chip
+# suite once and exit. Leaves a heartbeat in /tmp/tunnel_watch.log.
+set -u
+cd /root/repo
+export PYTHONPATH=/root/repo:${PYTHONPATH:-}
+for i in $(seq 1 200); do
+  if timeout 60 python -c "import jax; jax.devices()" > /dev/null 2>&1; then
+    echo "$(date -u +%FT%TZ) tunnel UP (probe $i) — running chip suite" >> /tmp/tunnel_watch.log
+    bash scripts/chip_suite.sh /tmp/chip_suite.log
+    echo "$(date -u +%FT%TZ) chip suite finished" >> /tmp/tunnel_watch.log
+    exit 0
+  fi
+  echo "$(date -u +%FT%TZ) tunnel down (probe $i)" >> /tmp/tunnel_watch.log
+  sleep 240
+done
+echo "$(date -u +%FT%TZ) gave up after 200 probes" >> /tmp/tunnel_watch.log
+exit 1

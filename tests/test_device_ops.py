@@ -315,3 +315,61 @@ def test_oneil_plan_blocks_legal(s, k):
     # VMEM: double-buffered slices block + 3 kw blocks + state must fit
     in_bytes = 4 * s * pk.ONEIL_K_TILE * 2048
     assert 2 * in_bytes + 6 * 4 * pk.ONEIL_K_TILE * 2048 <= 12 * 2**20
+
+
+@pytest.mark.parametrize("op,npop", [("or", np.bitwise_or), ("and", np.bitwise_and), ("xor", np.bitwise_xor)])
+def test_segmented_pallas_interpret(op, npop):
+    """One-pass Pallas segmented scan vs numpy per-segment folds, with
+    segment boundaries straddling row tiles (interpret mode)."""
+    import jax.numpy as jnp
+
+    from roaringbitmap_tpu.ops import pallas_kernels as pk
+
+    if not pk.HAS_PALLAS:
+        pytest.skip("pallas unavailable")
+    rng = np.random.default_rng(61)
+    n = 300  # not a multiple of SEG_ROW_TILE; several segments per tile
+    host = rng.integers(0, 1 << 32, size=(n, 2048), dtype=np.uint64).astype(np.uint32)
+    offsets = [0, 1, 5, 130, 131, 250, n]
+    seg_start = np.zeros(n, dtype=bool)
+    seg_start[offsets[:-1]] = True
+    vals = np.asarray(
+        pk.segmented_reduce_pallas(
+            jnp.asarray(host), jnp.asarray(seg_start), op=op, interpret=True
+        )
+    )
+    for s, e in zip(offsets[:-1], offsets[1:]):
+        want = npop.reduce(host[s:e], axis=0)
+        assert np.array_equal(vals[e - 1], want), (op, s, e)
+
+
+def test_seg_plan_blocks_legal():
+    from roaringbitmap_tpu.ops import pallas_kernels as pk
+
+    for n in (1, 127, 128, 300, 4096):
+        plan = pk.seg_plan(n, 2048)
+        assert pk.mosaic_block_ok(plan["rows_block"], plan["rows_array"])
+        assert plan["grid"][0] * pk.SEG_ROW_TILE == n + plan["pad_rows"]
+
+
+def test_segmented_pallas_unflagged_prefix_matches_xla():
+    """seg_start[0]=False is legal: rows before the first flag must fold
+    from the op identity exactly like the XLA scan (code-review regression:
+    a zero-initialized accumulator broke op='and')."""
+    import jax.numpy as jnp
+
+    from roaringbitmap_tpu.ops import pallas_kernels as pk
+
+    if not pk.HAS_PALLAS:
+        pytest.skip("pallas unavailable")
+    rng = np.random.default_rng(62)
+    n = 10
+    host = rng.integers(0, 1 << 32, size=(n, 2048), dtype=np.uint64).astype(np.uint32)
+    seg = np.zeros(n, dtype=bool)
+    seg[4] = True
+    for op in ("and", "or", "xor"):
+        want = np.asarray(dev.segmented_reduce(jnp.asarray(host), jnp.asarray(seg), op=op))
+        got = np.asarray(
+            pk.segmented_reduce_pallas(jnp.asarray(host), jnp.asarray(seg), op=op, interpret=True)
+        )
+        assert np.array_equal(got, want), op
