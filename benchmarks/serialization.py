@@ -49,6 +49,26 @@ def run(reps: int = 5, datasets=None, **_) -> List[Result]:
         # zero-copy map: parse metadata only, containers stay buffer views
         ns = common.min_of(reps, lambda: [ImmutableRoaringBitmap(x) for x in blobs])
         results.append(Result("mapImmutable", ds, ns / len(bms), "ns/op"))
+
+        # query THROUGH the mapped form (jmh map/ suite: mapped operands in
+        # pairwise algebra + point probes, no materialization)
+        mapped = [ImmutableRoaringBitmap(x) for x in blobs]
+
+        def mapped_pairwise():
+            for i in range(len(mapped) - 1):
+                RoaringBitmap.and_(mapped[i], mapped[i + 1])
+
+        ns = common.min_of(max(1, reps // 2), mapped_pairwise) / max(1, len(mapped) - 1)
+        results.append(Result("mappedPairwiseAnd", ds, ns, "ns/op"))
+
+        probes = [int(b.first()) for b in bms[:200]]
+
+        def mapped_contains():
+            for m, p in zip(mapped, probes):
+                m.contains(p)
+
+        ns = common.min_of(reps, mapped_contains) / max(1, len(probes))
+        results.append(Result("mappedContains", ds, ns, "ns/op"))
         results.append(
             Result(
                 "bitsPerValue",

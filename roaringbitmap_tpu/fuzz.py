@@ -321,6 +321,39 @@ def run_campaign(iterations: Optional[int] = None, verbose: bool = True) -> dict
             arity=3, iterations=n, seed=8,
         ),
     )
+    _run(
+        "addOffset-roundtrip",
+        lambda: verify_invariance(
+            "addOffset-roundtrip",
+            lambda a: RB.add_offset(RB.add_offset(a, 1 << 20), -(1 << 20)) == a,
+            arity=1, iterations=n, seed=41,
+        ),
+    )
+    _run(
+        "selectRange-matches-slice",
+        lambda: verify_invariance(
+            "selectRange-matches-slice",
+            _select_range_pred,
+            arity=1, iterations=n, seed=42,
+        ),
+    )
+    _run(
+        "iterators-agree",
+        lambda: verify_invariance(
+            "iterators-agree", _iterators_pred, arity=1, iterations=max(1, n // 4), seed=43
+        ),
+        actual=max(1, n // 4),
+    )
+    _run(
+        "subset-and-intersects",
+        lambda: verify_invariance(
+            "subset-and-intersects",
+            lambda a, b: a.contains_bitmap(RB.and_(a, b))
+            and RB.or_(a, b).contains_bitmap(a)
+            and RB.intersects(a, b) == (RB.and_cardinality(a, b) > 0),
+            arity=2, iterations=n, seed=44,
+        ),
+    )
     # device-layout invariance: both layouts by construction, all CPU engines
     # (segmented-scan fuzzed by construction on odd iterations)
     _run(
@@ -356,6 +389,29 @@ def run_campaign(iterations: Optional[int] = None, verbose: bool = True) -> dict
         actual=max(1, n // 8),
     )
     return results
+
+
+def _select_range_pred(a) -> bool:
+    arr = a.to_array()
+    card = arr.size
+    lo, hi = card // 4, max(card // 4 + 1, (3 * card) // 4)
+    got = a.select_range(lo, hi)
+    return np.array_equal(got.to_array(), arr[lo:hi])
+
+
+def _iterators_pred(a) -> bool:
+    arr = a.to_array()
+    it = a.get_int_iterator()
+    fwd = []
+    while it.has_next():
+        fwd.append(it.next())
+    if not np.array_equal(np.array(fwd, dtype=np.int64), arr.astype(np.int64)):
+        return False
+    batches = []
+    for b in a.batch_iterator(257):
+        batches.append(b)
+    got = np.concatenate(batches) if batches else np.empty(0, dtype=np.uint32)
+    return np.array_equal(got, arr)
 
 
 def _cross64(a, b) -> bool:

@@ -70,7 +70,7 @@ class ImmutableRoaringArray:
     def get_container_at_index(self, i: int) -> Container:
         c = self._cache.get(i)
         if c is None:
-            c = self._bm._container(i)
+            c = self._bm._build_container(i)
             self._cache[i] = c
         return c
 
@@ -235,7 +235,13 @@ class ImmutableRoaringBitmap:
 
     # ------------------------------------------------------------------
     def _container(self, i: int) -> Container:
-        """Materialize a zero-copy container view (the Mappeable analogue)."""
+        """Zero-copy container view (the Mappeable analogue), memoized via
+        the high_low_container cache — rebuilding the numpy views per call
+        cost ~4x on point probes."""
+        return self.high_low_container.get_container_at_index(i)
+
+    def _build_container(self, i: int) -> Container:
+        """Materialize a fresh zero-copy container view (cache fill path)."""
         off = int(self._offsets[i])
         t = self._types[i]
         if t == self.BITMAP:

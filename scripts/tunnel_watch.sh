@@ -5,7 +5,7 @@ set -u
 cd /root/repo
 export PYTHONPATH=/root/repo:${PYTHONPATH:-}
 for i in $(seq 1 200); do
-  if timeout 60 python -c "import jax; jax.devices()" > /dev/null 2>&1; then
+  if timeout 60 python -c "import jax; assert jax.default_backend() != 'cpu', 'cpu fallback is not the tunnel'" > /dev/null 2>&1; then
     echo "$(date -u +%FT%TZ) tunnel UP (probe $i) — running chip suite" >> /tmp/tunnel_watch.log
     bash scripts/chip_suite.sh /tmp/chip_suite.log
     echo "$(date -u +%FT%TZ) chip suite finished" >> /tmp/tunnel_watch.log
