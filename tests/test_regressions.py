@@ -95,3 +95,68 @@ def test_big_ornot_regression():
     rng.iandnot(r)
     expected = RoaringBitmap.or_(l, rng)
     assert RoaringBitmap.or_not(l, r, limit) == expected
+
+
+def test_ornot_truncation_matrix():
+    """orNot with a range end below existing values must never truncate them
+    (OrNotTruncationTest.java:56-63, across the container-shape matrix)."""
+    from roaringbitmap_tpu import RoaringBitmap
+
+    rng = np.random.default_rng(0xFEEF1F0)
+
+    def shape(kind, key):
+        base = key << 16
+        if kind == "array":
+            return rng.choice(1 << 16, size=2000, replace=False).astype(np.int64) + base
+        if kind == "bitmap":
+            return rng.choice(1 << 16, size=9000, replace=False).astype(np.int64) + base
+        return np.arange(0, 40000, dtype=np.int64) + base  # run
+
+    others = [
+        RoaringBitmap(),
+        RoaringBitmap([2]),
+        RoaringBitmap.bitmap_of_range(2, 5),
+        RoaringBitmap.bitmap_of_range(3, 5),
+        RoaringBitmap([2, 3, 4]),
+        RoaringBitmap(list(range(7))),
+    ]
+    for kinds in (("array",), ("run",), ("bitmap",), ("array", "run"),
+                  ("run", "run"), ("bitmap", "run")):
+        for first_key in (0, 1):
+            vals = np.concatenate(
+                [shape(k, first_key + i) for i, k in enumerate(kinds)]
+            )
+            bm = RoaringBitmap(vals.astype(np.uint32))
+            bm.run_optimize()
+            others.append(bm)
+    for other in others:
+        one = RoaringBitmap([0, 10])
+        one.ior_not(other, 7)
+        assert one.contains(10), other
+
+
+def test_concatenation_via_add_offset():
+    """Concatenating bitmaps with addOffset keeps all values and cardinality
+    (TestConcatenation.java's elementwise/cardinality families) across
+    container-boundary offsets."""
+    from roaringbitmap_tpu import RoaringBitmap
+
+    rng = np.random.default_rng(0xFEEF1F0)
+    vals = np.unique(rng.integers(0, 1 << 20, size=40_000, dtype=np.int64)).astype(np.uint32)
+    bm = RoaringBitmap(vals)
+    for offset in (0, 1, 1 << 16, (1 << 16) - 1, (1 << 16) + 1, 3 << 16, 1 << 20):
+        shifted = RoaringBitmap.add_offset(bm, offset)
+        assert shifted.get_cardinality() == bm.get_cardinality(), offset
+        assert np.array_equal(
+            shifted.to_array().astype(np.int64), vals.astype(np.int64) + offset
+        ), offset
+        # serialized round-trip of the shifted form stays byte-stable
+        assert RoaringBitmap.deserialize(shifted.serialize()) == shifted
+    # concatenation: disjoint shifted copies OR'd together
+    parts = [RoaringBitmap.add_offset(bm, k << 21) for k in range(4)]
+    cat = RoaringBitmap.or_many(parts) if hasattr(RoaringBitmap, "or_many") else None
+    if cat is None:
+        from roaringbitmap_tpu import FastAggregation
+
+        cat = FastAggregation.or_(*parts)
+    assert cat.get_cardinality() == 4 * bm.get_cardinality()
