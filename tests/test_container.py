@@ -244,3 +244,87 @@ def test_absent_value_overrides_match_base():
             want_prev = Container.previous_absent_value(c, p)
             assert c.next_absent_value(p) == want_next, (type(c).__name__, p)
             assert c.previous_absent_value(p) == want_prev, (type(c).__name__, p)
+
+
+def test_full_container_op_type_matrix():
+    """All 9 operand-type combinations x and/or/xor/andNot/andCardinality,
+    each checked against a numpy set oracle — the one-sweep analogue of the
+    reference's per-type suites (TestArrayContainer/TestBitmapContainer/
+    TestRunContainer op matrices)."""
+    import numpy as np
+
+    from roaringbitmap_tpu.models.container import container_from_values
+
+    seeds = {("array", 1): 11, ("array", 2): 12, ("bitmap", 1): 21,
+             ("bitmap", 2): 22, ("run", 1): 31, ("run", 2): 32}
+
+    def mk(kind, seed):
+        r = np.random.default_rng(seed)
+        if kind == "array":
+            vals = np.sort(r.choice(1 << 16, size=3000, replace=False))
+        elif kind == "bitmap":
+            vals = np.sort(r.choice(1 << 16, size=20_000, replace=False))
+        else:  # run
+            starts = np.sort(r.choice(600, size=40, replace=False)) * 100
+            vals = np.unique(
+                np.concatenate([np.arange(s, s + 80) for s in starts])
+            )
+        c = container_from_values(vals.astype(np.uint16))
+        if kind == "run":
+            c = c.run_optimize()
+        return c, set(vals.tolist())
+
+    kinds = ("array", "bitmap", "run")
+    for ka in kinds:
+        for kb in kinds:
+            a, sa = mk(ka, seeds[(ka, 1)])
+            b, sb = mk(kb, seeds[(kb, 2)])
+            cases = {
+                "and": (a.and_(b), sa & sb),
+                "or": (a.or_(b), sa | sb),
+                "xor": (a.xor_(b), sa ^ sb),
+                "andnot": (a.andnot(b), sa - sb),
+            }
+            for name, (got, want) in cases.items():
+                assert set(got.to_array().tolist()) == want, (ka, kb, name)
+                assert got.cardinality == len(want), (ka, kb, name)
+            assert a.and_cardinality(b) == len(sa & sb), (ka, kb)
+            assert a.intersects(b) == bool(sa & sb), (ka, kb)
+            # operands unchanged (value semantics)
+            assert set(a.to_array().tolist()) == sa, (ka, kb)
+            assert set(b.to_array().tolist()) == sb, (ka, kb)
+
+
+def test_container_range_ops_matrix():
+    """add/remove/flip range across all three container kinds vs a numpy
+    oracle, including promotions/demotions at the 4096 boundary."""
+    import numpy as np
+
+    from roaringbitmap_tpu.models.container import container_from_values
+
+    def mk(kind):
+        if kind == "array":
+            vals = np.arange(0, 3000, 7, dtype=np.uint16)
+        elif kind == "bitmap":
+            vals = np.arange(0, 50000, 3, dtype=np.uint16)
+        else:
+            vals = np.concatenate(
+                [np.arange(s, s + 500, dtype=np.uint16) for s in range(0, 60000, 4000)]
+            )
+        c = container_from_values(vals)
+        if kind == "run":
+            c = c.run_optimize()
+        return c, set(int(v) for v in vals)
+
+    ranges = [(0, 1), (100, 5000), (4000, 4100), (0, 65536), (65000, 65536)]
+    for kind in ("array", "bitmap", "run"):
+        for start, end in ranges:
+            c, s = mk(kind)
+            rng_set = set(range(start, end))
+            got = c.add_range(start, end)
+            assert set(got.to_array().tolist()) == s | rng_set, (kind, start, end, "add")
+            got = c.remove_range(start, end)
+            assert set(got.to_array().tolist()) == s - rng_set, (kind, start, end, "rm")
+            got = c.flip_range(start, end)
+            assert set(got.to_array().tolist()) == s ^ rng_set, (kind, start, end, "flip")
+            assert set(c.to_array().tolist()) == s  # value semantics
