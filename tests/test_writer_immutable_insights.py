@@ -250,3 +250,20 @@ def test_immutable_rejects_hostile_run_payload():
         )
         assert got.shape == (1024,)
         assert got[1023] == np.uint64(1) << np.uint64(63)
+
+
+def test_tracing_profile_writes_trace(tmp_path):
+    """tracing.trace wraps jax.profiler and produces a trace dump."""
+    import os
+
+    from roaringbitmap_tpu import tracing
+
+    logdir = str(tmp_path / "trace")
+    import jax.numpy as jnp
+
+    with tracing.trace(logdir):
+        (jnp.arange(8) * 2).block_until_ready()
+    found = []
+    for root, _dirs, files in os.walk(logdir):
+        found.extend(files)
+    assert found, "no profiler artifacts written"
