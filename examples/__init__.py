@@ -16,6 +16,7 @@ EXAMPLES = [
     "immutable_example",
     "interval_check",
     "range_index",
+    "bsi_queries",
     "observability",
     "memory_mapping",
     "paged_iterator",
