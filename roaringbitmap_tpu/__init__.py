@@ -47,6 +47,7 @@ from .models.iterators import (
 )
 from .serialization import InvalidRoaringFormat
 from .parallel.aggregation import FastAggregation, ParallelAggregation
+from .parallel.aggregation64 import FastAggregation64
 from . import insights
 from . import fuzz
 
@@ -80,6 +81,7 @@ __all__ = [
     "RoaringBatchIterator",
     "BatchIntIterator",
     "FastAggregation",
+    "FastAggregation64",
     "ParallelAggregation",
     "BufferFastAggregation",
     "BufferParallelAggregation",

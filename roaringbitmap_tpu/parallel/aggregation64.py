@@ -1,0 +1,133 @@
+"""64-bit N-way aggregation: the flagship batched reduction extended to the
+ART-backed ``Roaring64Bitmap`` layer.
+
+The reference aggregates 64-bit bitmaps only pairwise/naively
+(Roaring64NavigableMap.java:730 ``naivelazyor`` fold; no 64-bit
+FastAggregation exists). Here the same SoA device engine that serves the
+32-bit layer applies unchanged: containers of all inputs are transposed
+into high-48-key-major groups (the long-context scaling axis, SURVEY §5),
+packed into one ``[N, 2048]`` device tensor, and reduced per key group in
+a single fused dispatch (parallel/store.py + ops/pallas_kernels.py) —
+key width only changes the host-side directory.
+
+CPU mode folds per key group with the shared word kernels, so the two
+engines cross-check each other (tests/test_roaring64.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..models.container import (
+    ArrayContainer,
+    BitmapContainer,
+    Container,
+    best_container_of_words,
+)
+from ..models.roaring64art import Roaring64Bitmap, key_to_int
+from ..utils import bits
+from . import store
+from .aggregation import _fold_group_words, _use_device
+
+
+def _group_by_key64(
+    bitmaps: Sequence[Roaring64Bitmap], keys_filter: Optional[set] = None
+) -> Dict[int, List[Container]]:
+    """Transpose inputs into high-48-key-major groups (the 64-bit
+    ParallelAggregation.groupByKey analogue; keys become ints so the
+    shared packing path applies). ``keys_filter`` keeps the workShy AND
+    from gathering containers outside the key intersection."""
+    groups: Dict[int, List[Container]] = {}
+    for bm in bitmaps:
+        for key, c in bm._kv():
+            k = key_to_int(key)
+            if keys_filter is not None and k not in keys_filter:
+                continue
+            groups.setdefault(k, []).append(c)
+    return groups
+
+
+def _rebuild(group_keys: np.ndarray, words_u32: np.ndarray, cards: np.ndarray) -> Roaring64Bitmap:
+    """Card-driven container construction, mirroring store._unpack_to_bitmap
+    — the device already popcounted each group."""
+    out = Roaring64Bitmap()
+    words64 = np.ascontiguousarray(words_u32).view(np.uint64)
+    for gi, key in enumerate(group_keys.tolist()):
+        card = int(cards[gi])
+        if card == 0:
+            continue
+        w = words64[gi]
+        if card <= 4096:
+            c: Container = ArrayContainer(bits.values_from_words(w))
+        else:
+            c = BitmapContainer(w.copy(), card)
+        out._put(int(key).to_bytes(6, "big"), c)
+    return out
+
+
+class FastAggregation64:
+    """N-way or/xor/and over ``Roaring64Bitmap`` inputs with the shared
+    CPU/device dispatcher (``mode``: 'auto' | 'cpu' | 'device')."""
+
+    @staticmethod
+    def or_(*bitmaps: Roaring64Bitmap, mode: Optional[str] = None) -> Roaring64Bitmap:
+        return _aggregate64(bitmaps, "or", mode)
+
+    @staticmethod
+    def xor(*bitmaps: Roaring64Bitmap, mode: Optional[str] = None) -> Roaring64Bitmap:
+        return _aggregate64(bitmaps, "xor", mode)
+
+    @staticmethod
+    def and_(*bitmaps: Roaring64Bitmap, mode: Optional[str] = None) -> Roaring64Bitmap:
+        """workShy AND: intersect the key sets first, then reduce only the
+        surviving groups (Util.intersectKeys / workShyAnd analogue)."""
+        bms = _flatten64(bitmaps)
+        if not bms:
+            return Roaring64Bitmap()
+        if len(bms) == 1:
+            return bms[0].clone()
+        keys = set(key_to_int(k) for k, _ in bms[0]._kv())
+        for bm in bms[1:]:
+            keys &= set(key_to_int(k) for k, _ in bm._kv())
+            if not keys:
+                return Roaring64Bitmap()
+        # every surviving key appears in all inputs (one container per key
+        # per bitmap), so the filtered grouping is exactly the AND work set
+        return _reduce_groups(_group_by_key64(bms, keys_filter=keys), "and", mode)
+
+
+def _flatten64(bitmaps) -> List[Roaring64Bitmap]:
+    if len(bitmaps) == 1 and not isinstance(bitmaps[0], Roaring64Bitmap):
+        return list(bitmaps[0])
+    return list(bitmaps)
+
+
+def _aggregate64(bitmaps, op: str, mode: Optional[str]) -> Roaring64Bitmap:
+    bms = _flatten64(bitmaps)
+    if not bms:
+        return Roaring64Bitmap()
+    if len(bms) == 1:
+        return bms[0].clone()
+    return _reduce_groups(_group_by_key64(bms), op, mode)
+
+
+def _reduce_groups(groups, op: str, mode: Optional[str]) -> Roaring64Bitmap:
+    if not groups:
+        return Roaring64Bitmap()
+    n = sum(len(v) for v in groups.values())
+    if _use_device(n, mode):
+        packed = store.pack_groups(groups)
+        words, cards = store.reduce_packed(packed, op=op)
+        return _rebuild(packed.group_keys, words, cards)
+    # CPU: per-group word fold with the shared engine helpers
+    out = Roaring64Bitmap()
+    for key in sorted(groups):
+        cs = groups[key]
+        c = cs[0].clone() if len(cs) == 1 else best_container_of_words(
+            _fold_group_words(cs, op)
+        )
+        if c.cardinality:
+            out._put(int(key).to_bytes(6, "big"), c)
+    return out

@@ -154,3 +154,44 @@ def test_add_many_rejects_negative():
     with pytest.raises((ValueError, OverflowError)):
         bm.add_many([5, -3])
     assert bm.is_empty()
+
+
+def test_fast_aggregation64_engines_agree():
+    """64-bit N-way or/xor/and: device-batched groups == CPU word folds ==
+    pairwise reference folds, across several high-48 chunks and buckets."""
+    import numpy as np
+
+    from roaringbitmap_tpu import FastAggregation64, Roaring64Bitmap
+
+    rng = np.random.default_rng(29)
+    bms = []
+    for i in range(12):
+        parts = [
+            rng.integers(0, 1 << 18, size=4000, dtype=np.uint64),
+            (np.uint64(i % 3) << np.uint64(33))
+            + rng.integers(0, 1 << 17, size=3000, dtype=np.uint64),
+            (np.uint64(1) << np.uint64(55))
+            + rng.integers(0, 1 << 16, size=2000, dtype=np.uint64),
+        ]
+        bms.append(Roaring64Bitmap(np.concatenate(parts)))
+
+    # pairwise oracle
+    want_or = bms[0].clone()
+    for b in bms[1:]:
+        want_or = Roaring64Bitmap.or_(want_or, b)
+    want_xor = bms[0].clone()
+    for b in bms[1:]:
+        want_xor = Roaring64Bitmap.xor(want_xor, b)
+    want_and = bms[0].clone()
+    for b in bms[1:]:
+        want_and = Roaring64Bitmap.and_(want_and, b)
+
+    for mode in ("cpu", "device"):
+        assert FastAggregation64.or_(*bms, mode=mode).serialize() == want_or.serialize(), mode
+        assert FastAggregation64.xor(*bms, mode=mode).serialize() == want_xor.serialize(), mode
+        assert FastAggregation64.and_(*bms, mode=mode).serialize() == want_and.serialize(), mode
+    # edge cases
+    assert FastAggregation64.or_().is_empty()
+    assert FastAggregation64.and_(bms[0]).serialize() == bms[0].serialize()
+    disjoint = Roaring64Bitmap(np.array([1 << 60], dtype=np.uint64))
+    assert FastAggregation64.and_(bms[0], disjoint).is_empty()
