@@ -18,16 +18,8 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence
 
-import numpy as np
-
-from ..models.container import (
-    ArrayContainer,
-    BitmapContainer,
-    Container,
-    best_container_of_words,
-)
+from ..models.container import Container, best_container_of_words
 from ..models.roaring64art import Roaring64Bitmap, key_to_int
-from ..utils import bits
 from . import store
 from .aggregation import _fold_group_words, _use_device
 
@@ -49,21 +41,27 @@ def _group_by_key64(
     return groups
 
 
-def _rebuild(group_keys: np.ndarray, words_u32: np.ndarray, cards: np.ndarray) -> Roaring64Bitmap:
-    """Card-driven container construction, mirroring store._unpack_to_bitmap
-    — the device already popcounted each group."""
-    out = Roaring64Bitmap()
-    words64 = np.ascontiguousarray(words_u32).view(np.uint64)
-    for gi, key in enumerate(group_keys.tolist()):
-        card = int(cards[gi])
-        if card == 0:
-            continue
-        w = words64[gi]
-        if card <= 4096:
-            c: Container = ArrayContainer(bits.values_from_words(w))
-        else:
-            c = BitmapContainer(w.copy(), card)
-        out._put(int(key).to_bytes(6, "big"), c)
+def _reduce_to_pairs(groups, op: str, mode: Optional[str]):
+    """Reduce key groups to sorted ``(key, Container)`` pairs on the shared
+    CPU/device engines; key composition is the caller's concern (48-bit
+    chunk keys for the ART design, (bucket << 16) | chunk for the
+    NavigableMap), so every 64-bit aggregation is ONE dispatch regardless
+    of how many buckets it spans."""
+    if not groups:
+        return []
+    n = sum(len(v) for v in groups.values())
+    if _use_device(n, mode):
+        packed = store.pack_groups(groups)
+        words, cards = store.reduce_packed(packed, op=op)
+        return list(store.iter_group_containers(packed.group_keys, words, cards))
+    out = []
+    for key in sorted(groups):
+        cs = groups[key]
+        c = cs[0].clone() if len(cs) == 1 else best_container_of_words(
+            _fold_group_words(cs, op)
+        )
+        if c.cardinality:
+            out.append((key, c))
     return out
 
 
@@ -98,6 +96,42 @@ class FastAggregation64:
         return _reduce_groups(_group_by_key64(bms, keys_filter=keys), "and", mode)
 
 
+def or_navigable(*maps, mode: Optional[str] = None):
+    """N-way OR over ``Roaring64NavigableMap`` inputs: every (high-32
+    bucket, chunk-key) pair becomes one composed group key, so the whole
+    map set reduces in a single engine dispatch no matter how many buckets
+    it spans; results reassemble bucket-wise through the append path.
+    Output config (signed order, bucket supplier) follows the first
+    operand, like the reference's instance or()."""
+    from ..models.roaring64 import Roaring64NavigableMap
+
+    ms: List[Roaring64NavigableMap] = (
+        list(maps[0])
+        if len(maps) == 1 and not isinstance(maps[0], Roaring64NavigableMap)
+        else list(maps)
+    )
+    if not ms:
+        return Roaring64NavigableMap()
+    out = Roaring64NavigableMap(
+        signed_longs=ms[0].signed_longs, supplier=ms[0].supplier
+    )
+    groups: Dict[int, List[Container]] = {}
+    for m in ms:
+        for hb, bm in m._buckets.items():
+            hlc = bm.high_low_container
+            for k, c in zip(hlc.keys, hlc.containers):
+                groups.setdefault((hb << 16) | k, []).append(c)
+    for gkey, c in _reduce_to_pairs(groups, "or", mode):
+        hb, chunk = gkey >> 16, gkey & 0xFFFF
+        bucket = out._buckets.get(hb)
+        if bucket is None:
+            bucket = out.supplier()
+            out._buckets[hb] = bucket
+        bucket.high_low_container.append(chunk, c)
+    out._keys_dirty = True
+    return out
+
+
 def _flatten64(bitmaps) -> List[Roaring64Bitmap]:
     if len(bitmaps) == 1 and not isinstance(bitmaps[0], Roaring64Bitmap):
         return list(bitmaps[0])
@@ -114,20 +148,7 @@ def _aggregate64(bitmaps, op: str, mode: Optional[str]) -> Roaring64Bitmap:
 
 
 def _reduce_groups(groups, op: str, mode: Optional[str]) -> Roaring64Bitmap:
-    if not groups:
-        return Roaring64Bitmap()
-    n = sum(len(v) for v in groups.values())
-    if _use_device(n, mode):
-        packed = store.pack_groups(groups)
-        words, cards = store.reduce_packed(packed, op=op)
-        return _rebuild(packed.group_keys, words, cards)
-    # CPU: per-group word fold with the shared engine helpers
     out = Roaring64Bitmap()
-    for key in sorted(groups):
-        cs = groups[key]
-        c = cs[0].clone() if len(cs) == 1 else best_container_of_words(
-            _fold_group_words(cs, op)
-        )
-        if c.cardinality:
-            out._put(int(key).to_bytes(6, "big"), c)
+    for key, c in _reduce_to_pairs(groups, op, mode):
+        out._put(int(key).to_bytes(6, "big"), c)
     return out

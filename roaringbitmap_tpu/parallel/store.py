@@ -268,10 +268,12 @@ def unpack_to_bitmap(
         return _unpack_to_bitmap(group_keys, words_u32, cards)
 
 
-def _unpack_to_bitmap(group_keys, words_u32, cards) -> RoaringBitmap:
-    from ..models.container import ArrayContainer, best_container_of_words
+def iter_group_containers(group_keys: np.ndarray, words_u32: np.ndarray, cards: np.ndarray):
+    """Yield ``(key, Container)`` per non-empty group with card-driven
+    construction (the device already popcounted each group) — shared by the
+    32-bit unpack, the 64-bit ART rebuild, and the NavigableMap rebuild."""
+    from ..models.container import ArrayContainer
 
-    out = RoaringBitmap()
     words64 = np.ascontiguousarray(words_u32).view(np.uint64)
     for gi, key in enumerate(group_keys.tolist()):
         card = int(cards[gi])
@@ -279,11 +281,13 @@ def _unpack_to_bitmap(group_keys, words_u32, cards) -> RoaringBitmap:
             continue
         w = words64[gi]
         if card <= 4096:
-            out.high_low_container.append(
-                int(key), ArrayContainer(bits.values_from_words(w))
-            )
+            yield int(key), ArrayContainer(bits.values_from_words(w))
         else:
-            out.high_low_container.append(
-                int(key), BitmapContainer(w.copy(), card)
-            )
+            yield int(key), BitmapContainer(w.copy(), card)
+
+
+def _unpack_to_bitmap(group_keys, words_u32, cards) -> RoaringBitmap:
+    out = RoaringBitmap()
+    for key, c in iter_group_containers(group_keys, words_u32, cards):
+        out.high_low_container.append(key, c)
     return out
