@@ -33,6 +33,7 @@ SUITES = [
     "writer",
     "runcontainer",
     "micro",
+    "aggregation64",
     "bsi",
     "bitsetutil",
     "filtered_ann",

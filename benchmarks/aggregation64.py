@@ -1,0 +1,66 @@
+"""64-bit wide-aggregation suite: FastAggregation64 / or_navigable vs the
+pairwise folds the reference is limited to (Roaring64NavigableMap
+naivelazyor), across multi-bucket synthetic working sets."""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from roaringbitmap_tpu import FastAggregation64, Roaring64NavigableMap
+from roaringbitmap_tpu.models.roaring64art import Roaring64Bitmap
+from roaringbitmap_tpu.parallel.aggregation64 import or_navigable
+
+from . import common
+from .common import Result
+
+N_BITMAPS = 64
+
+
+def _build(rng):
+    arts, navs = [], []
+    for i in range(N_BITMAPS):
+        parts = [
+            rng.integers(0, 1 << 20, size=20_000, dtype=np.uint64),
+            (np.uint64(3 + (i % 4)) << np.uint64(32))
+            + rng.integers(0, 1 << 20, size=15_000, dtype=np.uint64),
+            (np.uint64(9) << np.uint64(40))
+            + rng.integers(0, 1 << 18, size=5_000, dtype=np.uint64),
+        ]
+        vals = np.concatenate(parts)
+        arts.append(Roaring64Bitmap(vals))
+        navs.append(Roaring64NavigableMap(vals))
+    return arts, navs
+
+
+def run(reps: int = 5, **_) -> List[Result]:
+    rng = np.random.default_rng(0xFEEF1F0)
+    arts, navs = _build(rng)
+    out: List[Result] = []
+
+    def bench(name, fn):
+        out.append(
+            Result(name, "synthetic-64bit", common.min_of(reps, fn), "ns/op", {"n_bitmaps": N_BITMAPS})
+        )
+
+    def pairwise_art():
+        acc = arts[0].clone()
+        for b in arts[1:]:
+            acc.ior(b)
+        return acc
+
+    def pairwise_nav():
+        acc = navs[0].clone()
+        for b in navs[1:]:
+            acc.ior(b)
+        return acc
+
+    bench("wideOr64Pairwise(art)", pairwise_art)
+    bench("wideOr64(art,cpu)", lambda: FastAggregation64.or_(*arts, mode="cpu"))
+    bench("wideOr64(art,device)", lambda: FastAggregation64.or_(*arts, mode="device"))
+    bench("wideAnd64(art,cpu)", lambda: FastAggregation64.and_(*arts, mode="cpu"))
+    bench("wideOr64Pairwise(navigable)", pairwise_nav)
+    bench("wideOr64(navigable,cpu)", lambda: or_navigable(*navs, mode="cpu"))
+    bench("wideOr64(navigable,device)", lambda: or_navigable(*navs, mode="device"))
+    return out
