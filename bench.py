@@ -100,11 +100,12 @@ def main():
     assert tpu_card == cpu_card, f"device {tpu_card} != cpu {cpu_card}"
     assert tpu_result == cpu_result, "device result mismatch"
 
-    # steady-state device timing: exactly the production reduction closure.
-    # Results are materialized on host each rep: through the axon tunnel,
+    # per-dispatch timing: exactly the production reduction closure, result
+    # materialized on host each rep. Through the axon tunnel,
     # block_until_ready returns before the remote step completes (observed
     # 512 MiB "reduced" in 0.03 ms = 20x HBM peak), so only a host fetch
-    # gives a truthful timestamp — and stream-back is part of the workload.
+    # gives a truthful timestamp. This number is RPC-bound (~25-75 ms tunnel
+    # round trip vs ~1.5 ms of kernel), so it is reported as meta only.
     reduce_fn, layout = store.prepare_reduce(packed, op="or")
 
     def run():
@@ -117,7 +118,22 @@ def main():
         t0 = time.time()
         run()
         tpu_times.append(time.time() - t0)
-    tpu_s = min(tpu_times)
+    dispatch_s = min(tpu_times)
+
+    # headline: steady-state device throughput — K reductions inside one
+    # jitted scan, amortizing the tunnel's per-dispatch RPC latency (which a
+    # real deployment does not pay per aggregation). See
+    # benchmarks/common.steady_state_grouped for the anti-hoisting contract.
+    # CPU-fallback runs keep the per-dispatch number: there is no RPC
+    # latency to amortize, and 256 host reductions of 784 MB cost minutes.
+    if layout == "padded" and pk.on_tpu():
+        from benchmarks.common import steady_state_grouped
+
+        k_reps = 64
+        tpu_s, total = steady_state_grouped(packed.padded_device(0), op="or", k=k_reps)
+        assert total == k_reps * cpu_card, f"steady-state total {total} != {k_reps}x{cpu_card}"
+    else:  # segmented working sets keep the per-dispatch number
+        tpu_s = dispatch_s
 
     value = 1.0 / tpu_s  # wide-OR aggregations of the 10k working set per sec
     vs_baseline = cpu_s / tpu_s
@@ -127,7 +143,7 @@ def main():
     # read / kernel time, against ~800 GB/s on v5e-1
     dev_arr = packed.padded_device(0) if layout == "padded" else packed.device_words
     bytes_read = int(np.prod(dev_arr.shape)) * dev_arr.dtype.itemsize
-    hbm = {"layout_bytes": bytes_read, "hbm_gbps": round(bytes_read / tpu_s / 1e9, 1)}
+    hbm = {"layout_bytes": bytes_read, "hbm_gbps": round(bytes_read / tpu_s / 1e9, 1)}  # vs ~800 GB/s v5e peak
     if layout == "padded" and pk.HAS_PALLAS and pk.on_tpu():
         from roaringbitmap_tpu import insights
 
@@ -136,15 +152,16 @@ def main():
         def _time(fn):
             return time_device(fn, reps=REPS_TPU)
 
+        # per-dispatch comparison only: both are tunnel-RPC-bound (~25-75ms
+        # floor), so this tells you the kernels tie at single-shot latency,
+        # not their throughput — hbm_gbps above is the steady-state number
         try:
             t_pallas = _time(lambda: pk.grouped_reduce_cardinality_pallas(dev_arr, op="or"))
-            hbm["pallas_s"] = round(t_pallas, 6)
-            hbm["hbm_gbps_pallas"] = round(bytes_read / t_pallas / 1e9, 1)
+            hbm["pallas_dispatch_s"] = round(t_pallas, 6)
         except Exception as e:  # lowering failure must not kill the bench
             hbm["pallas_error"] = repr(e)[:200]
         t_xla = _time(lambda: dev.grouped_reduce_with_cardinality(dev_arr, op="or"))
-        hbm["xla_s"] = round(t_xla, 6)
-        hbm["hbm_gbps_xla"] = round(bytes_read / t_xla / 1e9, 1)
+        hbm["xla_dispatch_s"] = round(t_xla, 6)
         hbm["dispatch"] = insights.dispatch_counters()["kernel"]
 
     meta = {
@@ -156,6 +173,7 @@ def main():
         "cardinality": int(cpu_card),
         "cpu_fold_s": round(cpu_s, 4),
         "tpu_reduce_s": round(tpu_s, 6),
+        "tpu_dispatch_s": round(dispatch_s, 6),
         "pack_s": round(pack_s, 4),
         "build_s": round(build_s, 2),
         "backend": jax.default_backend(),

@@ -74,6 +74,62 @@ def time_device(fn, reps: int = 10) -> float:
     return best
 
 
+def steady_state_reduce(words, reduce_with_seed, k: int = 64, reps: int = 3):
+    """Seconds per aggregation at steady state: ``k`` reductions run inside
+    ONE jitted ``lax.scan`` so the tunnel's per-dispatch RPC latency
+    (~25-75 ms, >10x the kernel itself) is amortized out of the measurement.
+
+    ``reduce_with_seed(words, seed) -> (reduced, cards)`` must mix the
+    carry-dependent uint32 ``seed`` (always zero at runtime, but opaque to
+    the compiler: popcount-sum >> 31) into its input read — XLA paths XOR it
+    outside (fuses into the reduction read), Pallas kernels take it as an
+    SMEM operand — making the loop body carry-dependent so XLA cannot hoist
+    it while leaving HBM traffic unchanged. Returns
+    (seconds_per_aggregation, total_cardinality_sum) — the caller should
+    check ``total == k * expected_cardinality``."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def multi(w, k):
+        # per-iteration sums come back as scan outputs and are totalled
+        # host-side in int64: an int32 carry would wrap at k*cardinality
+        # >= 2^31 (each iteration's own sum is bounded by 32 bits per word
+        # x the reduced row count, well inside int32)
+        def body(seed, _):
+            red, cards = reduce_with_seed(w, seed)
+            c = cards.sum()
+            return (c >> 31).astype(jnp.uint32), c
+
+        _, cs = lax.scan(body, jnp.uint32(0), None, length=k)
+        return cs
+
+    def total_of(cs):  # fetching all k sums forces every iteration
+        return int(np.asarray(cs).astype(np.int64).sum())
+
+    total = total_of(multi(words, k))  # compile + warm + correctness
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        total_of(multi(words, k))
+        best = min(best, time.perf_counter() - t0)
+    return best / k, total
+
+
+def steady_state_grouped(words3, op: str = "or", k: int = 64, reps: int = 3):
+    """Steady-state seconds per grouped aggregation on the XLA path (the
+    bench.py headline). See steady_state_reduce for the methodology."""
+    from roaringbitmap_tpu.ops import device as dev
+
+    def with_seed(w3, seed):
+        return dev.grouped_reduce_with_cardinality(w3 ^ seed, op=op)
+
+    return steady_state_reduce(words3, with_seed, k=k, reps=reps)
+
+
 _corpus_cache: Dict[str, List[np.ndarray]] = {}
 
 

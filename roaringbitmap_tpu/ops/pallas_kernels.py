@@ -139,9 +139,12 @@ def _fold_axis(x, op, axis: int):
 
 
 def _make_wide_kernel(op):
-    def kernel(x_ref, o_ref):
+    # seed_ref: SMEM (1,) uint32 XOR'd into every loaded word — the fused
+    # input-perturbation hook (production passes 0; steady-state timing
+    # passes a carry-dependent 0 so XLA cannot hoist the loop body)
+    def kernel(seed_ref, x_ref, o_ref):
         i = pl.program_id(0)
-        tile = _fold_axis(x_ref[...], op, axis=0)
+        tile = _fold_axis(x_ref[...] ^ seed_ref[0], op, axis=0)
 
         @pl.when(i == 0)
         def _init():
@@ -155,9 +158,9 @@ def _make_wide_kernel(op):
 
 
 def _make_grouped_kernel(op):
-    def kernel(x_ref, o_ref):
+    def kernel(seed_ref, x_ref, o_ref):
         mi = pl.program_id(1)
-        tile = _fold_axis(x_ref[...], op, axis=1)  # [G_TILE, w]
+        tile = _fold_axis(x_ref[...] ^ seed_ref[0], op, axis=1)  # [G_TILE, w]
 
         @pl.when(mi == 0)
         def _init():
@@ -176,12 +179,17 @@ def _make_grouped_kernel(op):
 
 
 @functools.partial(jax.jit, static_argnames=("op", "interpret", "row_tile"))
-def wide_reduce_pallas(words, op: str = "or", interpret: bool = False, row_tile: int = ROW_TILE):
+def wide_reduce_pallas(
+    words, op: str = "or", interpret: bool = False, row_tile: int = ROW_TILE, seed=None
+):
     """Reduce ``[N, 2048]`` uint32 -> ``[2048]`` with a Pallas kernel.
 
     Pads N up to a row_tile multiple with the op identity so every grid step
-    sees a full block.
-    """
+    sees a full block. ``seed`` (uint32 scalar, runtime value must be 0) is
+    the steady-state-timing hook: it is XOR'd into every loaded word inside
+    the kernel, making a timing loop's body carry-dependent without an extra
+    HBM pass (padded rows are perturbed too, so a nonzero seed would break
+    and/xor identity padding — hence the must-be-0 contract)."""
     fn = {"or": lax.bitwise_or, "and": lax.bitwise_and, "xor": lax.bitwise_xor}[op]
     n, w = words.shape
     plan = wide_plan(n, w, row_tile)
@@ -189,27 +197,32 @@ def wide_reduce_pallas(words, op: str = "or", interpret: bool = False, row_tile:
         words = jnp.pad(
             words, ((0, plan["pad_rows"]), (0, 0)), constant_values=dev._INIT[op]
         )
+    if seed is None:
+        seed = jnp.uint32(0)
     out = pl.pallas_call(
         _make_wide_kernel(fn),
         out_shape=jax.ShapeDtypeStruct(plan["out_array"], words.dtype),
         grid=plan["grid"],
         in_specs=[
-            pl.BlockSpec(plan["in_block"], plan["in_index"], memory_space=pltpu.VMEM)
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(plan["in_block"], plan["in_index"], memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(
             plan["out_block"], plan["out_index"], memory_space=pltpu.VMEM
         ),
         interpret=interpret,
-    )(words)
+    )(jnp.reshape(seed.astype(words.dtype), (1,)), words)
     return out[0]
 
 
 @functools.partial(jax.jit, static_argnames=("op", "interpret", "row_tile"))
 def wide_reduce_cardinality_pallas(
-    words, op: str = "or", interpret: bool = False, row_tile: int = ROW_TILE
+    words, op: str = "or", interpret: bool = False, row_tile: int = ROW_TILE, seed=None
 ):
     """Fused wide reduce + cardinality (popcount of the reduced row)."""
-    red = wide_reduce_pallas(words, op=op, interpret=interpret, row_tile=row_tile)
+    red = wide_reduce_pallas(
+        words, op=op, interpret=interpret, row_tile=row_tile, seed=seed
+    )
     card = jnp.sum(lax.population_count(red).astype(jnp.int32))
     return red, card
 
@@ -221,13 +234,15 @@ def grouped_reduce_pallas(
     interpret: bool = False,
     g_tile: int = G_TILE,
     row_tile: int = G_ROW_TILE,
+    seed=None,
 ):
     """Padded grouped reduce ``[G, M, 2048] -> [G, 2048]`` as one kernel.
 
     Grid is (G-tiles, M-tiles) with the M axis innermost, so for each tile of
     g_tile groups the output block stays resident in VMEM as the accumulator
     across its row tiles (TPU grids run sequentially). This is the device
-    analogue of ParallelAggregation's per-key fold, all keys in one launch."""
+    analogue of ParallelAggregation's per-key fold, all keys in one launch.
+    ``seed``: see wide_reduce_pallas (runtime value must be 0)."""
     fn = {"or": lax.bitwise_or, "and": lax.bitwise_and, "xor": lax.bitwise_xor}[op]
     g, m, w = words3.shape
     plan = grouped_plan(g, m, w, g_tile, row_tile)
@@ -237,18 +252,21 @@ def grouped_reduce_pallas(
             ((0, plan["pad_groups"]), (0, plan["pad_rows"]), (0, 0)),
             constant_values=dev._INIT[op],
         )
+    if seed is None:
+        seed = jnp.uint32(0)
     out = pl.pallas_call(
         _make_grouped_kernel(fn),
         out_shape=jax.ShapeDtypeStruct(plan["out_array"], words3.dtype),
         grid=plan["grid"],
         in_specs=[
-            pl.BlockSpec(plan["in_block"], plan["in_index"], memory_space=pltpu.VMEM)
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(plan["in_block"], plan["in_index"], memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(
             plan["out_block"], plan["out_index"], memory_space=pltpu.VMEM
         ),
         interpret=interpret,
-    )(words3)
+    )(jnp.reshape(seed.astype(words3.dtype), (1,)), words3)
     return out[:g]
 
 
@@ -259,10 +277,11 @@ def grouped_reduce_cardinality_pallas(
     interpret: bool = False,
     g_tile: int = G_TILE,
     row_tile: int = G_ROW_TILE,
+    seed=None,
 ):
     """Fused grouped reduce + per-group cardinality."""
     red = grouped_reduce_pallas(
-        words3, op=op, interpret=interpret, g_tile=g_tile, row_tile=row_tile
+        words3, op=op, interpret=interpret, g_tile=g_tile, row_tile=row_tile, seed=seed
     )
     card = jnp.sum(lax.population_count(red).astype(jnp.int32), axis=-1)
     return red, card
@@ -284,16 +303,22 @@ SEG_ROW_TILE = 128
 
 
 def seg_plan(n: int, w: int, row_tile: int = SEG_ROW_TILE):
+    # flags ride in SMEM as one whole [n_tiles, row_tile] int32 array
+    # (block == array, indexed by program_id in the kernel): a blocked 1-D
+    # s32[n_pad] operand hits an XLA(T(1024)) vs Mosaic(T(128)) layout
+    # mismatch on real chips, and a (1, row_tile) block violates the (8,128)
+    # rule, which Mosaic enforces for SMEM operands too
     n_pad = n + (-n) % row_tile
+    n_tiles = n_pad // row_tile
     return {
         "pad_rows": n_pad - n,
-        "grid": (n_pad // row_tile,),
+        "grid": (n_tiles,),
         "rows_array": (n_pad, w),
         "rows_block": (row_tile, w),
         "rows_index": lambda i: (i, 0),
-        "flags_array": (n_pad,),
-        "flags_block": (row_tile,),
-        "flags_index": lambda i: (i,),
+        "flags_array": (n_tiles, row_tile),
+        "flags_block": (n_tiles, row_tile),
+        "flags_index": lambda i: (0, 0),
     }
 
 
@@ -311,7 +336,7 @@ def _make_seg_kernel(op, fill, row_tile: int):
         acc = acc_ref[0]
         for r in range(row_tile):
             row = words_ref[r]
-            start = flags_ref[r] != 0
+            start = flags_ref[i, r] != 0
             acc = jnp.where(start, row, op(acc, row))
             out_ref[r] = acc
         acc_ref[0] = acc
@@ -334,6 +359,7 @@ def segmented_reduce_pallas(
     if plan["pad_rows"]:
         words = jnp.pad(words, ((0, plan["pad_rows"]), (0, 0)))
         seg_start = jnp.pad(seg_start, (0, plan["pad_rows"]), constant_values=True)
+    flags = seg_start.astype(jnp.int32).reshape(plan["flags_array"])
     out = pl.pallas_call(
         _make_seg_kernel(fn, dev._INIT[op], row_tile),
         grid=plan["grid"],
@@ -351,7 +377,7 @@ def segmented_reduce_pallas(
         out_shape=jax.ShapeDtypeStruct(plan["rows_array"], words.dtype),
         scratch_shapes=[pltpu.VMEM((1, w), words.dtype)],
         interpret=interpret,
-    )(seg_start.astype(jnp.int32), words)
+    )(flags, words)
     return out[:n]
 
 
@@ -545,10 +571,21 @@ def best_wide_reduce(words, op: str = "or"):
     return dev.wide_reduce_with_cardinality(words, op=op)
 
 
+# Measured on v5e-1 (scripts/tile_sweep.py steady-state, BENCH_NOTES.md):
+# the XLA grouped reduce sustains 423 GB/s at the flagship [66,1450,2048]
+# shape vs 137 GB/s for the Pallas kernel (and 112.7 vs 83.1 at [66,512];
+# tie at [512,64]) — XLA's reduction schedule pipelines the small-G shapes
+# better than the (G/8, M/rt) sequential grid. The dispatcher therefore
+# prefers XLA for grouped reduces; the Pallas kernel stays available
+# explicitly and as the probe-validated alternative.
+GROUPED_PREFER_XLA = True
+
+
 def best_grouped_reduce(words3, op: str = "or"):
-    """Pick the Pallas grouped kernel on TPU (with lowering probe + automatic
-    XLA fallback), XLA reduce elsewhere."""
-    if HAS_PALLAS and on_tpu():
+    """Measured-best grouped reduce: XLA by default (see GROUPED_PREFER_XLA),
+    the Pallas kernel (with lowering probe + automatic XLA fallback) when
+    preferred."""
+    if not GROUPED_PREFER_XLA and HAS_PALLAS and on_tpu():
         out = _probed_call("grouped", grouped_reduce_cardinality_pallas, (words3,), op)
         if out is not None:
             DISPATCH_COUNTS[("grouped", "pallas")] += 1

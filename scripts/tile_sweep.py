@@ -5,6 +5,11 @@ sustains the highest achieved HBM bandwidth (v5e-1 peak ~800 GB/s). Results
 are recorded in BENCH_NOTES.md and justify the ROW_TILE / G_TILE /
 G_ROW_TILE defaults in ops/pallas_kernels.py (VERDICT r2 #3).
 
+Timing is steady-state: K reductions inside one jitted scan
+(benchmarks.common.steady_state_reduce), because per-dispatch timing through
+the axon tunnel is RPC-bound (~25-75 ms floor) and cannot distinguish
+tilings — the first sweep measured every config at an identical ~1-2 GB/s.
+
 Configs whose double-buffered input blocks exceed the ~16 MiB/core VMEM are
 skipped up front: a first sweep showed every such config (e.g. g_tile=8
 row_tile=128 -> 2x8 MiB) fails remote compile with tpu_compile_helper
@@ -13,21 +18,25 @@ errors, and each failure costs minutes of retry through the tunnel.
 Run:  PYTHONPATH=/root/repo:$PYTHONPATH timeout 900 python -u scripts/tile_sweep.py
 """
 
-import time
+import os
+import sys
 
 import numpy as np
 
-REPS = 5
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+K = 32
+REPS = 3
 VMEM_BUDGET = 12 * 2**20  # leave headroom under the ~16 MiB/core VMEM
 
 
 from benchmarks.common import fetch_device as _fetch  # noqa: E402
+from benchmarks.common import steady_state_reduce  # noqa: E402
 
 
-def _time(fn):
-    from benchmarks.common import time_device
-
-    return time_device(fn, reps=REPS)
+def _time(with_seed, arr):
+    s, _total = steady_state_reduce(arr, with_seed, k=K, reps=REPS)
+    return s
 
 
 def main():
@@ -38,6 +47,7 @@ def main():
     from roaringbitmap_tpu.ops import pallas_kernels as pk
 
     print("backend:", jax.default_backend(), flush=True)
+    print(f"steady-state timing: best of {REPS} x (scan of K={K} reductions)", flush=True)
     rng = np.random.default_rng(0)
 
     # ---- wide: [N, 2048] ----
@@ -47,11 +57,14 @@ def main():
     _fetch(arr.sum())  # flush the transfer before timing anything
     nbytes = arr.size * 4
     print(f"\nwide [N={n}, 2048] ({nbytes/2**20:.0f} MiB)", flush=True)
-    t = _time(lambda: dev.wide_reduce_with_cardinality(arr, op="or"))
+    t = _time(lambda w, s: dev.wide_reduce_with_cardinality(w ^ s, op="or"), arr)
     print(f"  xla            {t*1e3:8.3f} ms  {nbytes/t/1e9:7.1f} GB/s", flush=True)
     for row_tile in (128, 256, 512):
         t = _time(
-            lambda: pk.wide_reduce_cardinality_pallas(arr, op="or", row_tile=row_tile)
+            lambda w, s, rt=row_tile: pk.wide_reduce_cardinality_pallas(
+                w, op="or", row_tile=rt, seed=s
+            ),
+            arr,
         )
         print(
             f"  pallas rt={row_tile:<5} {t*1e3:8.3f} ms  {nbytes/t/1e9:7.1f} GB/s",
@@ -67,7 +80,7 @@ def main():
         _fetch(arr3.sum())
         nbytes = arr3.size * 4
         print(f"\ngrouped [G={g}, M={m}, 2048] ({nbytes/2**20:.0f} MiB)", flush=True)
-        t = _time(lambda: dev.grouped_reduce_with_cardinality(arr3, op="or"))
+        t = _time(lambda w, s: dev.grouped_reduce_with_cardinality(w ^ s, op="or"), arr3)
         print(f"  xla                    {t*1e3:8.3f} ms  {nbytes/t/1e9:7.1f} GB/s", flush=True)
         for g_tile in (8, 16):
             for row_tile in (32, 64):
@@ -76,9 +89,10 @@ def main():
                     print(f"  pallas gt={g_tile:<3} rt={row_tile:<5} skipped (VMEM)", flush=True)
                     continue
                 t = _time(
-                    lambda: pk.grouped_reduce_cardinality_pallas(
-                        arr3, op="or", g_tile=g_tile, row_tile=row_tile
-                    )
+                    lambda w, s, gt=g_tile, rt=row_tile: pk.grouped_reduce_cardinality_pallas(
+                        w, op="or", g_tile=gt, row_tile=rt, seed=s
+                    ),
+                    arr3,
                 )
                 print(
                     f"  pallas gt={g_tile:<3} rt={row_tile:<5} {t*1e3:8.3f} ms  {nbytes/t/1e9:7.1f} GB/s",

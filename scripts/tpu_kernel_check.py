@@ -3,9 +3,13 @@
 Run on the default (axon/TPU) backend:  timeout 600 python scripts/tpu_kernel_check.py
 """
 
+import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():

@@ -239,6 +239,8 @@ def test_probed_call_marks_bad_kernel_and_falls_back(monkeypatch):
     monkeypatch.setattr(pk, "grouped_reduce_cardinality_pallas", boom)
     monkeypatch.setattr(pk, "on_tpu", lambda: True)
     monkeypatch.setattr(pk, "HAS_PALLAS", True)
+    # the probe mechanism under test only engages when Pallas is preferred
+    monkeypatch.setattr(pk, "GROUPED_PREFER_XLA", False)
     pk._PROBED.clear()
     rng = np.random.default_rng(48)
     host = rng.integers(0, 1 << 32, size=(4, 2, 2048), dtype=np.uint64).astype(np.uint32)
