@@ -33,6 +33,7 @@ SUITES = [
     "writer",
     "runcontainer",
     "micro",
+    "containers",
     "aggregation64",
     "bsi",
     "bitsetutil",

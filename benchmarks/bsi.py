@@ -118,6 +118,65 @@ def run_northstar(n_rows: int = 100_000_000, reps: int = 3) -> List[Result]:
         assert (
             results_by_mode[("cpu", qname)] == results_by_mode[("device", qname)]
         ), f"cpu/device mismatch on {qname}"
+
+    out.extend(
+        _northstar_steady_state(
+            bsi, med, n_rows, extra_base, results_by_mode[("cpu", "GE_med")]
+        )
+    )
+    return out
+
+
+def _northstar_steady_state(bsi, med, n_rows, extra_base, expected_card):
+    """On TPU, also report the O'Neil kernel's steady-state throughput:
+    through the axon tunnel the end-to-end numbers above are fetch-bound
+    (~0.3 s per query regardless of size while the kernel itself is ~1 ms),
+    so K compares run inside one jitted scan with the carry-dependent seed
+    XOR'd into the EQ init (whole walk depends on it — perturbing only the
+    final mask would let XLA hoist the slice scan). XLA fused scan and the
+    Pallas VMEM-resident kernel are both measured."""
+    from roaringbitmap_tpu.ops import pallas_kernels as pk
+
+    if not pk.on_tpu():
+        return []
+    import jax.numpy as jnp
+
+    from roaringbitmap_tpu.models.bsi import o_neil_math
+
+    from .common import steady_state_reduce
+
+    keys, ebm_w, slices_w = bsi._pack_dense()
+    s_count = bsi.bit_count()
+    bits = np.array([(med >> i) & 1 for i in range(s_count - 1, -1, -1)], dtype=bool)
+    sl, bv, eb = jnp.asarray(slices_w), jnp.asarray(bits), jnp.asarray(ebm_w)
+    nbytes = sl.size * 4
+    out = []
+    for impl, fn in (
+        ("xla", lambda w, s: o_neil_math(w, bv, eb ^ s, eb, "GE")),
+        ("pallas", lambda w, s: pk.oneil_compare_pallas(w, bv, eb, eb, op="GE", seed=s)),
+    ):
+        k_reps = 32
+        try:
+            t, total = steady_state_reduce(sl, fn, k=k_reps)
+        except Exception as e:  # a lowering failure must not kill the suite
+            print(f"# steady-state {impl} failed: {e!r}"[:200], flush=True)
+            continue
+        assert total == k_reps * expected_card, (
+            f"steady-state {impl} total {total} != {k_reps}x{expected_card}"
+        )
+        out.append(
+            Result(
+                f"northstar_GE_kernel_steady_{impl}",
+                f"synthetic-{n_rows//1_000_000}M",
+                t * 1e9,
+                "ns/op",
+                {
+                    **extra_base,
+                    "rows_per_s": round(n_rows / t),
+                    "hbm_gbps": round(nbytes / t / 1e9, 1),
+                },
+            )
+        )
     return out
 
 

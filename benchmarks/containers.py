@@ -1,0 +1,126 @@
+"""Container + L0-kernel micro suite — twins of the reference's
+jmh `arraycontainer/AddBenchmark`, `bitmapcontainer/SelectBenchmark`,
+`bithacking/SelectBenchmark`+`UnsignedVSFlip`, `UtilBenchmark` (galloping
+intersect / union kernels), and `cardinality64/` groups
+(jmh/src/jmh/java/org/roaringbitmap/).
+
+Each L0 kernel is timed twice where a native (C) implementation exists:
+the numpy fallback and the ctypes path, so the native speedups claimed in
+BENCH_NOTES stay measured, not asserted.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from roaringbitmap_tpu.models.container import (
+    ArrayContainer,
+    BitmapContainer,
+    RunContainer,
+)
+from roaringbitmap_tpu.utils import bits
+from roaringbitmap_tpu import native
+
+from . import common
+from .common import Result
+
+
+def run(reps: int = 10, **_) -> List[Result]:
+    rng = np.random.default_rng(0xFEEF1F0)
+    out: List[Result] = []
+
+    def bench(name, fn, extra=None):
+        out.append(Result(name, "synthetic", common.min_of(reps, fn), "ns/op", extra or {}))
+
+    # ---- arraycontainer/AddBenchmark: range iadds into a fresh container ----
+    ranges = []
+    pos = 0
+    while pos < (1 << 16) - 512 and len(ranges) < 128:
+        width = int(rng.integers(1, 256))
+        ranges.append((pos, pos + width))
+        pos += width + int(rng.integers(1, 512))
+
+    def array_add_ranges():
+        c = ArrayContainer()
+        for s, e in ranges:
+            c = c.add_range(s, e)
+        return c
+
+    bench("arrayContainerAddRanges", array_add_ranges, {"n_ranges": len(ranges)})
+
+    sparse_vals = np.sort(
+        rng.choice(1 << 16, size=2048, replace=False).astype(np.uint16)
+    )
+
+    def array_add_points():
+        c = ArrayContainer()
+        for v in sparse_vals[:256]:
+            c = c.add(int(v))
+        return c
+
+    bench("arrayContainerAddPoints", array_add_points, {"n": 256})
+
+    # ---- bitmapcontainer/SelectBenchmark + rank ----
+    dense = BitmapContainer(bits.words_from_values(
+        np.sort(rng.choice(1 << 16, size=40_000, replace=False).astype(np.uint16))
+    ))
+    js = rng.integers(0, dense.cardinality, size=64)
+
+    def bitmap_select():
+        t = 0
+        for j in js:
+            t += dense.select(int(j))
+        return t
+
+    bench("bitmapContainerSelect", bitmap_select, {"n_queries": len(js)})
+    xs = rng.integers(0, 1 << 16, size=64)
+    bench("bitmapContainerRank", lambda: sum(dense.rank(int(x)) for x in xs))
+
+    # ---- bithacking/SelectBenchmark: select-in-word over 1024 words ----
+    words = dense.words
+    ks = rng.integers(0, 1000, size=64)
+
+    def select_in_words():
+        t = 0
+        for k in ks:
+            t += bits.select_in_words(words, int(k))
+        return t
+
+    bench("selectInWords", select_in_words, {"n_queries": len(ks)})
+
+    # ---- UtilBenchmark: the sorted-set kernels, numpy vs native ----
+    a = np.sort(rng.choice(1 << 16, size=4096, replace=False).astype(np.uint16))
+    b = np.sort(rng.choice(1 << 16, size=512, replace=False).astype(np.uint16))
+    kernels = [
+        ("intersectSorted", bits.intersect_sorted, native.intersect_sorted),
+        ("mergeSortedUnique", bits.merge_sorted_unique, native.merge_sorted_unique),
+        ("differenceSorted", bits.difference_sorted, native.difference_sorted),
+        ("xorSorted", bits.xor_sorted, native.xor_sorted),
+    ]
+    for name, np_fn, nat_fn in kernels:
+        bench(f"util{name}_numpy", lambda f=np_fn: f(a, b))
+        if native.available():
+            got, want = nat_fn(a, b), np_fn(a, b)
+            assert np.array_equal(got, want), name
+            bench(f"util{name}_native", lambda f=nat_fn: f(a, b))
+
+    # ---- runcontainer interval kernel at container level (micro twin) ----
+    starts = np.arange(0, 1 << 16, 1024, dtype=np.uint16)[:32]
+    rc = RunContainer(starts, np.full(32, 255, dtype=np.uint16))
+    rc2 = RunContainer(starts + 128, np.full(32, 255, dtype=np.uint16))
+    bench("runContainerAndRun", lambda: rc.and_(rc2))
+    bench("runContainerOrRun", lambda: rc.or_(rc2))
+
+    # ---- cardinality64: Roaring64 cardinality after wide construction ----
+    from roaringbitmap_tpu.models.roaring64 import Roaring64NavigableMap
+
+    vals64 = (rng.integers(0, 1 << 40, size=100_000, dtype=np.uint64)).astype(np.int64)
+    r64 = Roaring64NavigableMap()
+    r64.add_many(vals64)
+    bench("cardinality64", r64.get_long_cardinality, {"n": len(vals64)})
+    probe = vals64[rng.integers(0, len(vals64), size=64)]
+    bench("contains64", lambda: sum(r64.contains(int(v)) for v in probe))
+
+    return out

@@ -354,8 +354,11 @@ class Roaring64NavigableMap:
     # cardinality / order statistics
     # ------------------------------------------------------------------
     def get_cardinality(self) -> int:
-        """getLongCardinality."""
-        return sum(b.get_cardinality() for b in self._buckets.values())
+        """getLongCardinality — served from the cached cumulative
+        cardinalities (Roaring64NavigableMap.java:66-72), so repeat calls
+        between writes are O(1)."""
+        cum = self._cum()
+        return int(cum[-1]) if len(cum) else 0
 
     def is_empty(self) -> bool:
         return not self._buckets

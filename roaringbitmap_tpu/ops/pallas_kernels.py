@@ -303,11 +303,16 @@ SEG_ROW_TILE = 128
 
 
 def seg_plan(n: int, w: int, row_tile: int = SEG_ROW_TILE):
-    # flags ride in SMEM as one whole [n_tiles, row_tile] int32 array
-    # (block == array, indexed by program_id in the kernel): a blocked 1-D
-    # s32[n_pad] operand hits an XLA(T(1024)) vs Mosaic(T(128)) layout
-    # mismatch on real chips, and a (1, row_tile) block violates the (8,128)
-    # rule, which Mosaic enforces for SMEM operands too
+    # flags ride in SMEM as one whole [n_tiles, row_tile/32] uint32
+    # bit-mask array (block == array, indexed by program_id in the kernel;
+    # bit r%32 of word [i, r/32] flags row r of tile i). Why this shape: a
+    # blocked 1-D s32[n_pad] operand hits an XLA(T(1024)) vs Mosaic(T(128))
+    # layout mismatch on real chips, a (1, row_tile) block violates the
+    # (8,128) rule (enforced for SMEM operands too), and an unpacked
+    # whole-array int32 would keep O(4*n) bytes resident in the small SMEM —
+    # the bit-pack keeps the whole-array layout at n/8 bytes
+    if row_tile % 32:
+        raise ValueError(f"row_tile {row_tile} must be a multiple of 32")
     n_pad = n + (-n) % row_tile
     n_tiles = n_pad // row_tile
     return {
@@ -316,8 +321,8 @@ def seg_plan(n: int, w: int, row_tile: int = SEG_ROW_TILE):
         "rows_array": (n_pad, w),
         "rows_block": (row_tile, w),
         "rows_index": lambda i: (i, 0),
-        "flags_array": (n_tiles, row_tile),
-        "flags_block": (n_tiles, row_tile),
+        "flags_array": (n_tiles, row_tile // 32),
+        "flags_block": (n_tiles, row_tile // 32),
         "flags_index": lambda i: (0, 0),
     }
 
@@ -336,7 +341,7 @@ def _make_seg_kernel(op, fill, row_tile: int):
         acc = acc_ref[0]
         for r in range(row_tile):
             row = words_ref[r]
-            start = flags_ref[i, r] != 0
+            start = ((flags_ref[i, r // 32] >> (r % 32)) & 1) != 0
             acc = jnp.where(start, row, op(acc, row))
             out_ref[r] = acc
         acc_ref[0] = acc
@@ -359,7 +364,12 @@ def segmented_reduce_pallas(
     if plan["pad_rows"]:
         words = jnp.pad(words, ((0, plan["pad_rows"]), (0, 0)))
         seg_start = jnp.pad(seg_start, (0, plan["pad_rows"]), constant_values=True)
-    flags = seg_start.astype(jnp.int32).reshape(plan["flags_array"])
+    # bit-pack the flags: word [i, j] carries rows i*row_tile + 32j .. +31
+    bits32 = seg_start.astype(jnp.uint32).reshape(-1, 32)
+    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))[None, :]
+    flags = jnp.sum(bits32 * weights, axis=1, dtype=jnp.uint32).reshape(
+        plan["flags_array"]
+    )
     out = pl.pallas_call(
         _make_seg_kernel(fn, dev._INIT[op], row_tile),
         grid=plan["grid"],
@@ -426,10 +436,12 @@ def oneil_plan(s: int, k: int, w: int, k_tile: int = ONEIL_K_TILE):
 def _make_oneil_kernel(s_count: int, op_name: str, dual: bool):
     """Unrolled slice walk; ``dual`` runs both RANGE recurrences (GE lo,
     LE hi) in the same pass over the slices. bits live in SMEM, ordered
-    high slice -> low (bits_rev), lo-walk first when dual."""
+    high slice -> low (bits_rev), lo-walk first when dual. ``seed_ref``:
+    SMEM (1,) uint32 XOR'd into the EQ initialization — the steady-state
+    timing hook (runtime value must be 0; see wide_reduce_pallas)."""
 
-    def kernel(bits_ref, slices_ref, ebm_ref, fixed_ref, out_ref):
-        eq = ebm_ref[...]
+    def kernel(seed_ref, bits_ref, slices_ref, ebm_ref, fixed_ref, out_ref):
+        eq = ebm_ref[...] ^ seed_ref[0]
         lt = jnp.zeros_like(eq)
         gt = jnp.zeros_like(eq)
         if dual:
@@ -475,6 +487,7 @@ def oneil_compare_pallas(
     op: str = "GE",
     interpret: bool = False,
     k_tile: int = ONEIL_K_TILE,
+    seed=None,
 ):
     """Fused O'Neil compare: ([S, K, 2048], bits, [K, 2048], [K, 2048]) ->
     ([K, 2048] result, [K] cards). ``bits_rev`` is bool [S] (or [2, S] for
@@ -488,10 +501,13 @@ def oneil_compare_pallas(
         ebm_w = jnp.pad(ebm_w, ((0, pad), (0, 0)))
         fixed_w = jnp.pad(fixed_w, ((0, pad), (0, 0)))
     bits_smem = bits_rev.reshape(-1).astype(jnp.int32)
+    if seed is None:
+        seed = jnp.uint32(0)
     out = pl.pallas_call(
         _make_oneil_kernel(s, op, dual),
         grid=plan["grid"],
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(
                 plan["slices_block"], plan["slices_index"], memory_space=pltpu.VMEM
@@ -504,7 +520,7 @@ def oneil_compare_pallas(
         ),
         out_shape=jax.ShapeDtypeStruct((plan["kw_array"][0], w), slices_w.dtype),
         interpret=interpret,
-    )(bits_smem, slices_w, ebm_w, fixed_w)
+    )(jnp.reshape(seed.astype(slices_w.dtype), (1,)), bits_smem, slices_w, ebm_w, fixed_w)
     out = out[:k]
     cards = jnp.sum(lax.population_count(out).astype(jnp.int32), axis=-1)
     return out, cards

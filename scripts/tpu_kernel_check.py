@@ -96,6 +96,24 @@ def main():
         want = np.bitwise_or.reduce(rows[s_i:e_i], axis=0)
         assert np.array_equal(vals[e_i - 1], want), ("segmented", s_i, e_i)
     print("segmented pallas: OK")
+
+    # large-N segmented: exercises the bit-packed whole-array SMEM flags
+    # (n/8 bytes resident) well past the old unpacked layout's comfort zone
+    n = 200_000
+    rows = rng.integers(0, 1 << 32, size=(n, 2048), dtype=np.uint64).astype(np.uint32)
+    offs = np.unique(np.concatenate([[0], rng.integers(1, n, size=500)]))
+    seg = np.zeros(n, dtype=bool)
+    seg[offs] = True
+    t0 = time.time()
+    vals = np.asarray(pk.segmented_reduce_pallas(jnp.asarray(rows), jnp.asarray(seg), op="or"))
+    print(f"segmented pallas large-N ({n} rows) compile+run: {time.time()-t0:.1f}s")
+    bounds = np.append(offs, n)
+    ends = bounds[1:] - 1
+    want_ends = np.stack(
+        [np.bitwise_or.reduce(rows[s_i:e_i], axis=0) for s_i, e_i in zip(bounds[:-1], bounds[1:])]
+    )
+    assert np.array_equal(vals[ends], want_ends), "segmented large-N mismatch"
+    print("segmented pallas large-N: OK")
     print("dispatch counts:", dict(pk.DISPATCH_COUNTS))
 
 
