@@ -491,7 +491,7 @@ class RoaringBitmapSliceIndex:
             fixed_bm = found_set
             fixed_w = self._found_words(keys, ebm_w.shape, found_set)
 
-        if config.mesh is not None and op != Operation.RANGE:
+        if config.mesh is not None:
             from ..parallel import sharding
 
             k_orig = ebm_w.shape[0]

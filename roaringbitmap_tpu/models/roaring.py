@@ -573,6 +573,12 @@ class RoaringBitmap:
         """Release excess capacity (RoaringBitmap.trim). Storage here is
         exact-sized numpy arrays, so this is a documented no-op."""
 
+    def append(self, key: int, container) -> None:
+        """Append a (key, container) pair; ``key`` must exceed the current
+        maximum key (RoaringBitmap.append, RoaringBitmap.java:3237 — the
+        expert bulk-construction hook used by the writers)."""
+        self.high_low_container.append(int(key), container)
+
     def for_each(self, consumer) -> None:
         """Visit every value in ascending order (RoaringBitmap.forEach,
         IntConsumer contract)."""

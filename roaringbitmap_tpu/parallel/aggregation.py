@@ -28,7 +28,6 @@ same single batched kernel on device — the degenerate case where the
 
 from __future__ import annotations
 
-import functools
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence
@@ -136,43 +135,42 @@ def _cpu_aggregate(
 
 def _device_aggregate(groups: Dict[int, List[Container]], op: str) -> RoaringBitmap:
     packed = store.pack_groups(groups)
-    if config.mesh is not None and op == "or":
-        words, cards = _sharded_or(packed)
+    if config.mesh is not None:
+        words, cards = _sharded_reduce(packed, op)
     else:
         words, cards = store.reduce_packed(packed, op=op)
     return store.unpack_to_bitmap(packed.group_keys, words, cards)
 
 
-@functools.lru_cache(maxsize=4)
-def _sharded_or_step(mesh):
-    from . import sharding
-
-    return sharding.distributed_grouped_or(mesh)
-
-
-def _sharded_or(packed: "store.PackedGroups"):
-    """Mesh-sharded grouped OR: pad each group's row count to the mesh's
-    container-axis size (store.pad_groups_dense, the shared layout +
-    skew guard) and run the ICI OR-combine (sharding.py). Too-skewed
-    distributions fall back to the single-device segmented layout."""
+def _sharded_reduce(packed: "store.PackedGroups", op: str):
+    """Mesh-sharded grouped reduce (or/and/xor): pad each group's row count
+    to the mesh's container-axis size with the op identity
+    (store.pad_groups_dense, the shared layout + skew guard) and run the
+    ICI combine (sharding.py). Too-skewed distributions fall back to the
+    single-device segmented layout."""
     import jax
     import jax.numpy as jnp
+
+    from ..ops import device as dev
+    from . import sharding
 
     mesh = config.mesh
     if any(d.process_index != jax.process_index() for d in mesh.devices.flat):
         # the padded tensor is built process-locally; forming the global
         # array on a multi-host mesh needs per-process shards
         # (jax.make_array_from_process_local_data) — route such jobs through
-        # sharding.distributed_grouped_or directly with pre-sharded inputs
+        # sharding.distributed_grouped_reduce directly with pre-sharded inputs
         raise NotImplementedError(
             "config.mesh routing supports single-host meshes; for multi-host "
-            "use parallel.sharding.distributed_grouped_or with a globally "
+            "use parallel.sharding.distributed_grouped_reduce with a globally "
             "formed array"
         )
-    padded = store.pad_groups_dense(packed, 0, row_multiple=mesh.devices.shape[0])
+    padded = store.pad_groups_dense(
+        packed, int(dev._INIT[op]), row_multiple=mesh.devices.shape[0]
+    )
     if padded is None:
-        return store.reduce_packed(packed, op="or")
-    red, cards = _sharded_or_step(mesh)(jnp.asarray(padded))
+        return store.reduce_packed(packed, op=op)
+    red, cards = sharding.distributed_grouped_reduce(mesh, op)(jnp.asarray(padded))
     return np.asarray(red), np.asarray(cards).astype(np.int64)
 
 

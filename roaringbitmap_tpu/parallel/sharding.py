@@ -79,14 +79,20 @@ def distributed_wide_or_cardinality(mesh: Mesh):
 
 
 @functools.lru_cache(maxsize=8)
-def distributed_grouped_or(mesh: Mesh):
-    """Grouped variant: ([G, M, W]) -> ([G, W], [G]) with groups replicated
-    along the containers axis padding dimension M sharded."""
+def distributed_grouped_reduce(mesh: Mesh, op: str = "or"):
+    """Grouped variant: ([G, M, W]) -> ([G, W], [G]) with groups replicated,
+    the row axis M sharded along ``containers``. The caller pads M with the
+    op identity (store.pad_groups_dense fill = dev._INIT[op]) — the same
+    table the fold below uses, so identity rows fold harmlessly on every
+    chip for all three ops."""
+    from ..ops import device as dev
+
+    fn, init = dev._OPS[op], dev._INIT[op]
 
     def step(words3):
-        red = lax.reduce(words3, np.uint32(0), lax.bitwise_or, (1,))  # [G, W_shard]
+        red = lax.reduce(words3, init, fn, (1,))  # [G, W_shard]
         partials = lax.all_gather(red, "containers", axis=0)  # [n, G, W_shard]
-        total = lax.reduce(partials, np.uint32(0), lax.bitwise_or, (0,))
+        total = lax.reduce(partials, init, fn, (0,))
         card_shard = jnp.sum(lax.population_count(total).astype(jnp.int32), axis=-1)
         card = lax.psum(card_shard, "words")
         return total, card

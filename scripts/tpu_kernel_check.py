@@ -100,7 +100,7 @@ def main():
     # large-N segmented: exercises the bit-packed whole-array SMEM flags
     # (n/8 bytes resident) well past the old unpacked layout's comfort zone
     n = 200_000
-    rows = rng.integers(0, 1 << 32, size=(n, 2048), dtype=np.uint64).astype(np.uint32)
+    rows = rng.integers(0, 1 << 32, size=(n, 2048), dtype=np.uint32)
     offs = np.unique(np.concatenate([[0], rng.integers(1, n, size=500)]))
     seg = np.zeros(n, dtype=bool)
     seg[offs] = True

@@ -33,7 +33,6 @@ SUBSTITUTIONS = {
     "advanceIfNeeded": "",  # PeekableIntIterator.advance_if_needed
     "readExternal": "",  # pickle
     "writeExternal": "",
-    "append": "",  # high_low_container.append (internal builder SPI)
     "forEach": "for_each",
     "forEachInRange": "for_each_in_range",
     "forAllInRange": "for_all_in_range",

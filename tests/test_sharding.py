@@ -53,7 +53,9 @@ def test_distributed_bsi_compare_matches_local():
 
 
 def test_engine_dispatch_through_mesh():
-    """FastAggregation rides the mesh-sharded OR when config.mesh is set."""
+    """FastAggregation rides the mesh-sharded reduce for all three ops when
+    config.mesh is set (AND's identity padding is all-ones, the shape most
+    likely to break if the fill is ever wrong)."""
     from roaringbitmap_tpu import FastAggregation, RoaringBitmap
     from roaringbitmap_tpu.parallel import sharding
     from roaringbitmap_tpu.parallel.aggregation import config
@@ -63,12 +65,39 @@ def test_engine_dispatch_through_mesh():
         RoaringBitmap(np.unique(rng.integers(0, 1 << 19, 3000)).astype(np.uint32))
         for _ in range(40)
     ]
-    want = FastAggregation.naive_or(*bms)
-    config.mesh = sharding.make_mesh(8, words_axis=2)
+    for op, engine, naive in (
+        ("or", FastAggregation.or_, FastAggregation.naive_or),
+        ("and", FastAggregation.and_, FastAggregation.naive_and),
+        ("xor", FastAggregation.xor, FastAggregation.naive_xor),
+    ):
+        want = naive(*bms)
+        config.mesh = sharding.make_mesh(8, words_axis=2)
+        try:
+            got = engine(*bms, mode="device")
+        finally:
+            config.mesh = None
+        assert got == want, op
+
+
+def test_distributed_bsi_range_through_mesh():
+    """BSI RANGE compares ride the mesh too (dual-walk bits [2, S])."""
+    from roaringbitmap_tpu.models.bsi import Operation, RoaringBitmapSliceIndex
+    from roaringbitmap_tpu.models.bsi import config as bsi_config
+    from roaringbitmap_tpu.parallel import sharding
+
+    rng = np.random.default_rng(77)
+    n = 200_000
+    cols = np.arange(n, dtype=np.uint32)
+    vals = rng.integers(0, 1 << 20, size=n, dtype=np.uint64).astype(np.int64)
+    bsi = RoaringBitmapSliceIndex()
+    bsi.set_values((cols, vals))
+    lo, hi = 1 << 18, 3 << 18
+    want = bsi.compare(Operation.RANGE, lo, hi, None, mode="cpu")
+    bsi_config.mesh = sharding.make_mesh(8, words_axis=2)
     try:
-        got = FastAggregation.or_(*bms, mode="device")
+        got = bsi.compare(Operation.RANGE, lo, hi, None, mode="device")
     finally:
-        config.mesh = None
+        bsi_config.mesh = None
     assert got == want
 
 
