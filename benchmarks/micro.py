@@ -91,4 +91,31 @@ def run(reps: int = 10, datasets=None, **_) -> List[Result]:
     # first/last/next (BitmapNextBenchmark)
     bench("nextValue_x1000", lambda: [mixed.next_value(v + 1) for v in hits])
     bench("nextAbsentValue_x1000", lambda: [mixed.next_absent_value(v) for v in hits])
+
+    # combined cardinalities (inclusion-exclusion over one and_cardinality
+    # walk, like the reference) vs materialize-then-count baselines
+    # (combinedcardinality/CombinedCardinalityBenchmark)
+    other = RoaringBitmap(
+        np.unique(rng.integers(0, 1 << 22, size=60_000)).astype(np.uint32)
+    )
+    for name, fused, baseline in (
+        (
+            "orCardinality",
+            lambda: RoaringBitmap.or_cardinality(mixed, other),
+            lambda: RoaringBitmap.or_(mixed, other).get_cardinality(),
+        ),
+        (
+            "xorCardinality",
+            lambda: RoaringBitmap.xor_cardinality(mixed, other),
+            lambda: RoaringBitmap.xor(mixed, other).get_cardinality(),
+        ),
+        (
+            "andNotCardinality",
+            lambda: RoaringBitmap.andnot_cardinality(mixed, other),
+            lambda: RoaringBitmap.andnot(mixed, other).get_cardinality(),
+        ),
+    ):
+        assert fused() == baseline(), name
+        bench(name, fused)
+        bench(f"{name}Materialized", baseline)
     return out

@@ -59,6 +59,14 @@ def main():
     print(f"\nwide [N={n}, 2048] ({nbytes/2**20:.0f} MiB)", flush=True)
     t = _time(lambda w, s: dev.wide_reduce_with_cardinality(w ^ s, op="or"), arr)
     print(f"  xla            {t*1e3:8.3f} ms  {nbytes/t/1e9:7.1f} GB/s", flush=True)
+    for g in (32, 128, 512):
+        t = _time(
+            lambda w, s, g=g: dev.wide_reduce_two_stage(w ^ s, op="or", stage_groups=g),
+            arr,
+        )
+        print(
+            f"  xla 2stage g={g:<4} {t*1e3:7.3f} ms  {nbytes/t/1e9:7.1f} GB/s", flush=True
+        )
     for row_tile in (128, 256, 512):
         t = _time(
             lambda w, s, rt=row_tile: pk.wide_reduce_cardinality_pallas(

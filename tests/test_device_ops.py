@@ -45,6 +45,20 @@ def test_wide_reduce(word_batch, op, npop):
 
 
 @pytest.mark.parametrize("op,npop", [("or", np.bitwise_or), ("and", np.bitwise_and), ("xor", np.bitwise_xor)])
+@pytest.mark.parametrize("stage_groups", [1, 3, 128])
+def test_wide_reduce_two_stage(word_batch, op, npop, stage_groups):
+    """Two-stage == flat, incl. N not a multiple of stage_groups (identity
+    padding) and stage_groups > N (clamped)."""
+    import jax.numpy as jnp
+
+    u32 = jnp.asarray(dev.to_device_words(word_batch))
+    red, card = dev.wide_reduce_two_stage(u32, op=op, stage_groups=stage_groups)
+    want = npop.reduce(np.asarray(dev.to_device_words(word_batch)), axis=0)
+    assert np.array_equal(np.asarray(red), want), (op, stage_groups)
+    assert int(card) == int(np.unpackbits(want.view(np.uint8)).sum())
+
+
+@pytest.mark.parametrize("op,npop", [("or", np.bitwise_or), ("and", np.bitwise_and), ("xor", np.bitwise_xor)])
 def test_grouped_reduce(op, npop):
     import jax.numpy as jnp
 
