@@ -35,10 +35,15 @@ _tried = False
 def _build(out_path: str) -> bool:
     cmd = [
         "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-        "-fno-exceptions", "-fno-rtti",
+        "-fno-exceptions", "-fno-rtti", "-fopenmp",
         _SRC, "-o", out_path,
     ]
     try:
+        proc = subprocess.run(cmd, capture_output=True, timeout=120)
+        if proc.returncode == 0 and os.path.exists(out_path):
+            return True
+        # toolchains without libgomp still get the serial build
+        cmd.remove("-fopenmp")
         proc = subprocess.run(cmd, capture_output=True, timeout=120)
         return proc.returncode == 0 and os.path.exists(out_path)
     except (OSError, subprocess.SubprocessError):

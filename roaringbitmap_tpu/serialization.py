@@ -212,10 +212,10 @@ def read_into(bm: RoaringBitmap, data) -> int:
             )
             pos += 4 * n_runs
             starts, lengths = pairs[0::2], pairs[1::2]
-            s64 = starts.astype(np.int64)
-            ends = s64 + lengths.astype(np.int64)
+            s32 = starts.astype(np.int32)
+            ends = s32 + lengths  # int32: no uint16 overflow
             if n_runs and (
-                np.any(s64[1:] <= ends[:-1])  # overlapping/touching runs
+                np.any(s32[1:] <= ends[:-1])  # overlapping/touching runs
                 or np.any(ends > 0xFFFF)
             ):
                 raise InvalidRoaringFormat("invalid run container")
@@ -240,7 +240,9 @@ def read_into(bm: RoaringBitmap, data) -> int:
                 np.uint16
             )
             pos += 2 * card
-            if card > 1 and np.any(np.diff(values.astype(np.int64)) <= 0):
+            # uint16 comparison (no subtraction) — strictly-increasing check
+            # without the diff/astype temporaries the profile showed dominating
+            if card > 1 and np.any(values[1:] <= values[:-1]):
                 raise InvalidRoaringFormat("array container values not sorted/unique")
             c = ArrayContainer(values)
         hlc.keys.append(key)
