@@ -39,6 +39,9 @@ def _suite(dataset: str, reps: int) -> List[Result]:
     bench("parallelOr", lambda: ParallelAggregation.or_(*bms, mode="cpu"))
     bench("parallelOrDevice", lambda: ParallelAggregation.or_(*bms, mode="device"))
     bench("parallelXor", lambda: ParallelAggregation.xor(*bms, mode="cpu"))
+    # cardinality-only N-way (device path fetches only per-group popcounts)
+    bench("wideOrCardinalityDevice", lambda: FastAggregation.or_cardinality(*bms, mode="device"))
+    bench("wideAndCardinalityDevice", lambda: FastAggregation.and_cardinality(*bms, mode="device"))
     return out
 
 

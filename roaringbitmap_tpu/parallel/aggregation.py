@@ -174,6 +174,21 @@ def _sharded_reduce(packed: "store.PackedGroups", op: str):
     return np.asarray(red), np.asarray(cards).astype(np.int64)
 
 
+def _prepare_groups(bitmaps: Sequence[RoaringBitmap], op: str):
+    """Shared dispatch prelude for the materializing and cardinality-only
+    engines: key-major transpose (AND pre-filtered through the key
+    intersection, FastAggregation.workShyAnd). Returns (groups, n_rows), or
+    None when the AND key intersection is empty (trivially empty result)."""
+    if op == "and":
+        keys = store.intersect_keys(bitmaps)
+        if not keys:
+            return None
+        groups = store.group_by_key(bitmaps, keys_filter=keys)
+    else:
+        groups = store.group_by_key(bitmaps)
+    return groups, sum(len(v) for v in groups.values())
+
+
 def _aggregate(
     bitmaps: Sequence[RoaringBitmap],
     op: str,
@@ -185,14 +200,10 @@ def _aggregate(
         return RoaringBitmap()
     if len(bitmaps) == 1:
         return bitmaps[0].clone()
-    if op == "and":
-        keys = store.intersect_keys(bitmaps)
-        if not keys:
-            return RoaringBitmap()
-        groups = store.group_by_key(bitmaps, keys_filter=keys)
-    else:
-        groups = store.group_by_key(bitmaps)
-    n = sum(len(v) for v in groups.values())
+    prepared = _prepare_groups(bitmaps, op)
+    if prepared is None:
+        return RoaringBitmap()
+    groups, n = prepared
     if _use_device(n, mode):
         return _device_aggregate(groups, op)
     return _cpu_aggregate(groups, op, pool=pool)
@@ -380,14 +391,20 @@ class FastAggregation:
         return _aggregate(_flatten(bitmaps), "and", mode)
 
     @staticmethod
-    def and_cardinality(*bitmaps: RoaringBitmap) -> int:
-        """FastAggregation.andCardinality (FastAggregation.java:71)."""
-        return FastAggregation.and_(*bitmaps).get_cardinality()
+    def and_cardinality(*bitmaps: RoaringBitmap, mode: Optional[str] = None) -> int:
+        """FastAggregation.andCardinality (FastAggregation.java:71). On the
+        device path only the per-group popcounts come back to host — no
+        result words, no container rebuild."""
+        return _aggregate_cardinality(_flatten(bitmaps), "and", mode)
 
     @staticmethod
-    def or_cardinality(*bitmaps: RoaringBitmap) -> int:
+    def or_cardinality(*bitmaps: RoaringBitmap, mode: Optional[str] = None) -> int:
         """FastAggregation.orCardinality (FastAggregation.java:90)."""
-        return FastAggregation.or_(*bitmaps).get_cardinality()
+        return _aggregate_cardinality(_flatten(bitmaps), "or", mode)
+
+    @staticmethod
+    def xor_cardinality(*bitmaps: RoaringBitmap, mode: Optional[str] = None) -> int:
+        return _aggregate_cardinality(_flatten(bitmaps), "xor", mode)
 
 
 def _flatten(bitmaps) -> List[RoaringBitmap]:
@@ -395,6 +412,30 @@ def _flatten(bitmaps) -> List[RoaringBitmap]:
     if len(bitmaps) == 1 and not hasattr(bitmaps[0], "high_low_container"):
         return list(bitmaps[0])
     return list(bitmaps)
+
+
+def _aggregate_cardinality(bitmaps: List[RoaringBitmap], op: str, mode) -> int:
+    """N-way cardinality without materializing the result on the device
+    path: the group reduction's popcounts (ints, one per key group) are the
+    ONLY thing fetched — no [G, 2048] stream-back, no container rebuild.
+    The aggregate cardinality is their sum because key groups partition the
+    universe. CPU-path calls fold and count like the reference."""
+    if not bitmaps:
+        return 0
+    if len(bitmaps) == 1:
+        return bitmaps[0].get_cardinality()
+    prepared = _prepare_groups(bitmaps, op)
+    if prepared is None:
+        return 0
+    groups, n = prepared
+    if _use_device(n, mode):
+        packed = store.pack_groups(groups)
+        if config.mesh is not None:  # same ICI-sharded reduce as _device_aggregate
+            _red, cards = _sharded_reduce(packed, op)
+        else:
+            cards = store.reduce_packed_cardinality(packed, op=op)
+        return int(cards.sum())
+    return _cpu_aggregate(groups, op).get_cardinality()
 
 
 class ParallelAggregation:

@@ -257,6 +257,17 @@ def reduce_packed(packed: PackedGroups, op: str = "or"):
     return np.asarray(red), np.asarray(card).astype(np.int64)
 
 
+def reduce_packed_cardinality(packed: PackedGroups, op: str = "or") -> np.ndarray:
+    """Per-group cardinalities only: the reduced words stay on device — the
+    host fetch is G ints, which is what makes N-way cardinality-only
+    aggregation cheaper than materialize-then-count."""
+    if packed.n_groups == 0:
+        return np.empty((0,), dtype=np.int64)
+    run, _ = prepare_reduce(packed, op)
+    _red, card = run()
+    return np.asarray(card).astype(np.int64)
+
+
 def unpack_to_bitmap(
     group_keys: np.ndarray, words_u32: np.ndarray, cards: np.ndarray
 ) -> RoaringBitmap:

@@ -42,9 +42,21 @@ def test_parallel_aggregation_matches_naive(bitmap_set, op, mode):
     assert got == want
 
 
-def test_cardinality_shortcuts(bitmap_set):
-    assert FastAggregation.or_cardinality(*bitmap_set) == naive(bitmap_set, "or").get_cardinality()
-    assert FastAggregation.and_cardinality(*bitmap_set) == naive(bitmap_set, "and").get_cardinality()
+@pytest.mark.parametrize("mode", ["cpu", "device"])
+def test_cardinality_shortcuts(bitmap_set, mode):
+    """Cardinality-only N-way engines (device path fetches only per-group
+    popcounts — no materialized result) match materialize-then-count."""
+    for op, fn in (
+        ("or", FastAggregation.or_cardinality),
+        ("and", FastAggregation.and_cardinality),
+        ("xor", FastAggregation.xor_cardinality),
+    ):
+        want = naive(bitmap_set, op).get_cardinality()
+        assert fn(*bitmap_set, mode=mode) == want, (op, mode)
+    assert FastAggregation.or_cardinality() == 0
+    assert FastAggregation.and_cardinality() == 0
+    one = RoaringBitmap([5, 9])
+    assert FastAggregation.and_cardinality(one) == 2
 
 
 def test_edge_cases():

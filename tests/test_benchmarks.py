@@ -46,6 +46,9 @@ def test_realdata_engines_agree_with_naive(small_corpus):
     want_and = FastAggregation.naive_and(*bms)
     assert FastAggregation.workshy_and(*bms, mode="cpu") == want_and
     assert FastAggregation.workshy_and(*bms, mode="device") == want_and
+    # cardinality-only engines on the same real-data group distributions
+    assert FastAggregation.or_cardinality(*bms, mode="device") == want.get_cardinality()
+    assert FastAggregation.and_cardinality(*bms, mode="device") == want_and.get_cardinality()
     blobs = [b.serialize() for b in bms]
     mapped = [ImmutableRoaringBitmap(x) for x in blobs]
     assert BufferFastAggregation.or_(*mapped) == want
