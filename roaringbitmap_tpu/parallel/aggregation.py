@@ -142,12 +142,13 @@ def _device_aggregate(groups: Dict[int, List[Container]], op: str) -> RoaringBit
     return store.unpack_to_bitmap(packed.group_keys, words, cards)
 
 
-def _sharded_reduce(packed: "store.PackedGroups", op: str):
+def _sharded_reduce(packed: "store.PackedGroups", op: str, cards_only: bool = False):
     """Mesh-sharded grouped reduce (or/and/xor): pad each group's row count
     to the mesh's container-axis size with the op identity
     (store.pad_groups_dense, the shared layout + skew guard) and run the
     ICI combine (sharding.py). Too-skewed distributions fall back to the
-    single-device segmented layout."""
+    single-device segmented layout. With ``cards_only`` the reduced words
+    stay on device (returned as None) and only the [G] counts transfer."""
     import jax
     import jax.numpy as jnp
 
@@ -169,8 +170,12 @@ def _sharded_reduce(packed: "store.PackedGroups", op: str):
         packed, int(dev._INIT[op]), row_multiple=mesh.devices.shape[0]
     )
     if padded is None:
+        if cards_only:
+            return None, store.reduce_packed_cardinality(packed, op=op)
         return store.reduce_packed(packed, op=op)
     red, cards = sharding.distributed_grouped_reduce(mesh, op)(jnp.asarray(padded))
+    if cards_only:
+        return None, np.asarray(cards).astype(np.int64)
     return np.asarray(red), np.asarray(cards).astype(np.int64)
 
 
@@ -431,7 +436,7 @@ def _aggregate_cardinality(bitmaps: List[RoaringBitmap], op: str, mode) -> int:
     if _use_device(n, mode):
         packed = store.pack_groups(groups)
         if config.mesh is not None:  # same ICI-sharded reduce as _device_aggregate
-            _red, cards = _sharded_reduce(packed, op)
+            _none, cards = _sharded_reduce(packed, op, cards_only=True)
         else:
             cards = store.reduce_packed_cardinality(packed, op=op)
         return int(cards.sum())

@@ -86,14 +86,24 @@ class FastAggregation64:
             return Roaring64Bitmap()
         if len(bms) == 1:
             return bms[0].clone()
-        keys = set(key_to_int(k) for k, _ in bms[0]._kv())
-        for bm in bms[1:]:
-            keys &= set(key_to_int(k) for k, _ in bm._kv())
-            if not keys:
-                return Roaring64Bitmap()
+        keys = _workshy_keys(bms)
+        if not keys:
+            return Roaring64Bitmap()
         # every surviving key appears in all inputs (one container per key
         # per bitmap), so the filtered grouping is exactly the AND work set
         return _reduce_groups(_group_by_key64(bms, keys_filter=keys), "and", mode)
+
+    @staticmethod
+    def or_cardinality(*bitmaps: Roaring64Bitmap, mode: Optional[str] = None) -> int:
+        return _aggregate64_cardinality(bitmaps, "or", mode)
+
+    @staticmethod
+    def xor_cardinality(*bitmaps: Roaring64Bitmap, mode: Optional[str] = None) -> int:
+        return _aggregate64_cardinality(bitmaps, "xor", mode)
+
+    @staticmethod
+    def and_cardinality(*bitmaps: Roaring64Bitmap, mode: Optional[str] = None) -> int:
+        return _aggregate64_cardinality(bitmaps, "and", mode)
 
 
 def or_navigable(*maps, mode: Optional[str] = None):
@@ -152,3 +162,38 @@ def _reduce_groups(groups, op: str, mode: Optional[str]) -> Roaring64Bitmap:
     for key, c in _reduce_to_pairs(groups, op, mode):
         out._put(int(key).to_bytes(6, "big"), c)
     return out
+
+
+def _workshy_keys(bms) -> set:
+    """Intersect the high-48 key sets (Util.intersectKeys analogue); the
+    shared workShy-AND prelude for the materializing and cardinality-only
+    engines. Empty set = trivially empty result."""
+    keys = set(key_to_int(k) for k, _ in bms[0]._kv())
+    for bm in bms[1:]:
+        keys &= set(key_to_int(k) for k, _ in bm._kv())
+        if not keys:
+            return set()
+    return keys
+
+
+def _aggregate64_cardinality(bitmaps, op: str, mode: Optional[str]) -> int:
+    """64-bit twin of aggregation._aggregate_cardinality: on the device
+    path only the per-group popcounts come back (key groups partition the
+    64-bit universe, so their sum is the aggregate cardinality)."""
+    bms = _flatten64(bitmaps)
+    if not bms:
+        return 0
+    if len(bms) == 1:
+        return bms[0].get_cardinality()
+    if op == "and":
+        keys = _workshy_keys(bms)
+        if not keys:
+            return 0
+        groups = _group_by_key64(bms, keys_filter=keys)
+    else:
+        groups = _group_by_key64(bms)
+    n = sum(len(v) for v in groups.values())
+    if _use_device(n, mode):
+        packed = store.pack_groups(groups)
+        return int(store.reduce_packed_cardinality(packed, op=op).sum())
+    return sum(c.cardinality for _, c in _reduce_to_pairs(groups, op, "cpu"))

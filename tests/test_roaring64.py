@@ -190,11 +190,18 @@ def test_fast_aggregation64_engines_agree():
         assert FastAggregation64.or_(*bms, mode=mode).serialize() == want_or.serialize(), mode
         assert FastAggregation64.xor(*bms, mode=mode).serialize() == want_xor.serialize(), mode
         assert FastAggregation64.and_(*bms, mode=mode).serialize() == want_and.serialize(), mode
+    # cardinality-only engines (device path fetches only per-group counts)
+    for mode in ("cpu", "device"):
+        assert FastAggregation64.or_cardinality(*bms, mode=mode) == want_or.get_cardinality()
+        assert FastAggregation64.xor_cardinality(*bms, mode=mode) == want_xor.get_cardinality()
+        assert FastAggregation64.and_cardinality(*bms, mode=mode) == want_and.get_cardinality()
     # edge cases
     assert FastAggregation64.or_().is_empty()
     assert FastAggregation64.and_(bms[0]).serialize() == bms[0].serialize()
     disjoint = Roaring64Bitmap(np.array([1 << 60], dtype=np.uint64))
     assert FastAggregation64.and_(bms[0], disjoint).is_empty()
+    assert FastAggregation64.and_cardinality(bms[0], disjoint) == 0
+    assert FastAggregation64.or_cardinality() == 0
 
 
 def test_or_navigable_bucketwise_engines():
