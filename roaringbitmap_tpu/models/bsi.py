@@ -302,55 +302,66 @@ class RoaringBitmapSliceIndex:
             return RoaringBitmap.and_(left, right)
         return self._o_neil(operation, start_or_value, found_set, mode)
 
-    def _compare_using_min_max(self, op, start_or_value, end, found_set):
-        # compareUsingMinMax (RoaringBitmapSliceIndex.java:515-578)
-        all_ = (
-            self.ebm.clone()
-            if found_set is None
-            else RoaringBitmap.and_(self.ebm, found_set)
-        )
-        empty = RoaringBitmap()
+    def _min_max_verdict(self, op, start_or_value, end):
+        """compareUsingMinMax (RoaringBitmapSliceIndex.java:515-578) as a
+        pure symbol — 'all' | 'empty' | 'fixed' | None — so the
+        materializing and count-only callers each pay only for what they
+        return (no eager ebm clone on the no-shortcut path)."""
         v, mn, mx = start_or_value, self.min_value, self.max_value
         if op == Operation.LT:
             if v > mx:
-                return all_
+                return "all"
             if v <= mn:
-                return empty
+                return "empty"
         elif op == Operation.LE:
             if v >= mx:
-                return all_
+                return "all"
             if v < mn:
-                return empty
+                return "empty"
         elif op == Operation.GT:
             if v < mn:
-                return all_
+                return "all"
             if v >= mx:
-                return empty
+                return "empty"
         elif op == Operation.GE:
             if v <= mn:
-                return all_
+                return "all"
             if v > mx:
-                return empty
+                return "empty"
         elif op == Operation.EQ:
             if mn == mx and mn == v:
-                return all_
+                return "all"
             if v < mn or v > mx:
-                return empty
+                return "empty"
         elif op == Operation.NEQ:
             if mn == mx:
-                return empty if mn == v else all_
+                return "empty" if mn == v else "all"
             if v < mn or v > mx:
                 # no stored value can equal v -> NEQ = the raw fixed set
                 # (Java keeps found_set un-intersected for NEQ); avoids the
                 # slice walk seeing a bit-truncated predicate (strictly more
                 # correct than the reference, which truncates here)
-                return self.ebm.clone() if found_set is None else found_set.clone()
+                return "fixed"
         elif op == Operation.RANGE:
             if v <= mn and end >= mx:
-                return all_
+                return "all"
             if v > mx or end < mn:
-                return empty
+                return "empty"
         return None
+
+    def _compare_using_min_max(self, op, start_or_value, end, found_set):
+        verdict = self._min_max_verdict(op, start_or_value, end)
+        if verdict is None:
+            return None
+        if verdict == "empty":
+            return RoaringBitmap()
+        if verdict == "fixed":
+            return self.ebm.clone() if found_set is None else found_set.clone()
+        return (
+            self.ebm.clone()
+            if found_set is None
+            else RoaringBitmap.and_(self.ebm, found_set)
+        )
 
     def _use_device(self, mode: Optional[str]) -> bool:
         mode = mode or config.mode
@@ -464,15 +475,53 @@ class RoaringBitmapSliceIndex:
         per_slice = per_chunk.astype(object).sum(axis=1)  # exact python ints
         return sum(int(c) << i for i, c in enumerate(per_slice.tolist()))
 
-    def _o_neil_device(self, op, predicate, found_set, end: int = 0) -> RoaringBitmap:
-        """The whole O'Neil chain — scan, op epilogue and popcount — as ONE
-        jitted device call (the SURVEY §3.5 batched-kernel target; a single
-        dispatch also matters because device round-trips dominate small
-        queries). For RANGE, both slice walks (GE lo, LE hi) and the final
-        AND run inside the same dispatch."""
-        import jax.numpy as jnp
+    def compare_cardinality(
+        self,
+        operation: Operation,
+        start_or_value: int,
+        end: int = 0,
+        found_set: Optional[RoaringBitmap] = None,
+        mode: Optional[str] = None,
+    ) -> int:
+        """Count-only compare: the device path fetches ONLY the per-chunk
+        popcounts — no result words, no container rebuild. This generalizes
+        the reference RangeBitmap's *Cardinality query family
+        (RangeBitmap.java:111-414) to the BSI, where the reference has no
+        count-only variant."""
+        verdict = self._min_max_verdict(operation, start_or_value, end)
+        if verdict == "empty":
+            return 0
+        if verdict == "fixed":
+            return (self.ebm if found_set is None else found_set).get_cardinality()
+        if verdict == "all":
+            if found_set is None:
+                return self.ebm.get_cardinality()
+            return RoaringBitmap.and_cardinality(self.ebm, found_set)
+        if self._use_device(mode):
+            if operation == Operation.RANGE:
+                end = min(int(end), (1 << self.bit_count()) - 1)
+            keys, _out, cards, fixed_bm = self._o_neil_device_walk(
+                operation, start_or_value, found_set, end
+            )
+            total = int(np.asarray(cards).astype(np.int64).sum())
+            if operation == Operation.NEQ and found_set is not None:
+                # chunks outside the packed ebm keys qualify wholesale
+                # (disjoint from every packed chunk, so plain addition)
+                missing = RoaringBitmap.andnot(
+                    fixed_bm, _keys_subset(fixed_bm, set(keys))
+                )
+                total += missing.get_cardinality()
+            return total
+        return self.compare(
+            operation, start_or_value, end, found_set, mode="cpu"
+        ).get_cardinality()
 
-        from ..parallel import store
+    def _o_neil_device_walk(self, op, predicate, found_set, end: int = 0):
+        """Run the fused device O'Neil walk; returns (keys, out_device,
+        cards_device, fixed_bm) with NOTHING fetched to host — callers
+        decide whether to pull the result words (compare) or only the
+        popcounts (compare_cardinality)."""
+        import jax.numpy as jnp
 
         keys, ebm_w, slices_w = self._pack_dense()
         S = self.bit_count()
@@ -510,6 +559,19 @@ class RoaringBitmapSliceIndex:
                 jnp.asarray(fixed_w),
                 op.value,
             )
+        return keys, out, cards, fixed_bm
+
+    def _o_neil_device(self, op, predicate, found_set, end: int = 0) -> RoaringBitmap:
+        """The whole O'Neil chain — scan, op epilogue and popcount — as ONE
+        jitted device call (the SURVEY §3.5 batched-kernel target; a single
+        dispatch also matters because device round-trips dominate small
+        queries). For RANGE, both slice walks (GE lo, LE hi) and the final
+        AND run inside the same dispatch."""
+        from ..parallel import store
+
+        keys, out, cards, fixed_bm = self._o_neil_device_walk(
+            op, predicate, found_set, end
+        )
         result = store.unpack_to_bitmap(
             np.asarray(keys, dtype=np.int64),
             np.asarray(out),

@@ -327,27 +327,37 @@ class RangeBitmap:
     ) -> RoaringBitmap:
         return self._compare(Operation.RANGE, lo, hi, context)
 
-    # Cardinality overloads (RangeBitmap.java lteCardinality etc.)
+    # Cardinality overloads (RangeBitmap.java lteCardinality etc.) — the
+    # count never materializes a result bitmap on the context-free path:
+    # the BSI's compare_cardinality fetches only per-chunk popcounts
+    def _compare_cardinality(self, op: Operation, value: int, end: int, context) -> int:
+        value = int(value)
+        if value < 0:  # validated before the context branch, like _compare
+            raise ValueError("RangeBitmap values are unsigned")
+        if context is not None:
+            return self._chunk_walk(op, value, end, context).get_cardinality()
+        return self._bsi_index().compare_cardinality(op, value, end, None)
+
     def lt_cardinality(self, value: int, context=None) -> int:
-        return self.lt(value, context).get_cardinality()
+        return self._compare_cardinality(Operation.LT, value, 0, context)
 
     def lte_cardinality(self, value: int, context=None) -> int:
-        return self.lte(value, context).get_cardinality()
+        return self._compare_cardinality(Operation.LE, value, 0, context)
 
     def gt_cardinality(self, value: int, context=None) -> int:
-        return self.gt(value, context).get_cardinality()
+        return self._compare_cardinality(Operation.GT, value, 0, context)
 
     def gte_cardinality(self, value: int, context=None) -> int:
-        return self.gte(value, context).get_cardinality()
+        return self._compare_cardinality(Operation.GE, value, 0, context)
 
     def eq_cardinality(self, value: int, context=None) -> int:
-        return self.eq(value, context).get_cardinality()
+        return self._compare_cardinality(Operation.EQ, value, 0, context)
 
     def neq_cardinality(self, value: int, context=None) -> int:
-        return self.neq(value, context).get_cardinality()
+        return self._compare_cardinality(Operation.NEQ, value, 0, context)
 
     def between_cardinality(self, lo: int, hi: int, context=None) -> int:
-        return self.between(lo, hi, context).get_cardinality()
+        return self._compare_cardinality(Operation.RANGE, lo, hi, context)
 
     # ------------------------------------------------------------------
     @property

@@ -80,18 +80,18 @@ class FastAggregation64:
     @staticmethod
     def and_(*bitmaps: Roaring64Bitmap, mode: Optional[str] = None) -> Roaring64Bitmap:
         """workShy AND: intersect the key sets first, then reduce only the
-        surviving groups (Util.intersectKeys / workShyAnd analogue)."""
+        surviving groups (Util.intersectKeys / workShyAnd analogue; every
+        surviving key appears in all inputs, so the filtered grouping is
+        exactly the AND work set)."""
         bms = _flatten64(bitmaps)
         if not bms:
             return Roaring64Bitmap()
         if len(bms) == 1:
             return bms[0].clone()
-        keys = _workshy_keys(bms)
-        if not keys:
+        prepared = _prepare_groups64(bms, "and")
+        if prepared is None:
             return Roaring64Bitmap()
-        # every surviving key appears in all inputs (one container per key
-        # per bitmap), so the filtered grouping is exactly the AND work set
-        return _reduce_groups(_group_by_key64(bms, keys_filter=keys), "and", mode)
+        return _reduce_groups(prepared[0], "and", mode)
 
     @staticmethod
     def or_cardinality(*bitmaps: Roaring64Bitmap, mode: Optional[str] = None) -> int:
@@ -176,6 +176,20 @@ def _workshy_keys(bms) -> set:
     return keys
 
 
+def _prepare_groups64(bms, op: str):
+    """Shared grouping prelude (the 32-bit _prepare_groups twin): AND goes
+    through the key intersection; returns (groups, n_rows) or None when the
+    result is trivially empty."""
+    if op == "and":
+        keys = _workshy_keys(bms)
+        if not keys:
+            return None
+        groups = _group_by_key64(bms, keys_filter=keys)
+    else:
+        groups = _group_by_key64(bms)
+    return groups, sum(len(v) for v in groups.values())
+
+
 def _aggregate64_cardinality(bitmaps, op: str, mode: Optional[str]) -> int:
     """64-bit twin of aggregation._aggregate_cardinality: on the device
     path only the per-group popcounts come back (key groups partition the
@@ -185,14 +199,10 @@ def _aggregate64_cardinality(bitmaps, op: str, mode: Optional[str]) -> int:
         return 0
     if len(bms) == 1:
         return bms[0].get_cardinality()
-    if op == "and":
-        keys = _workshy_keys(bms)
-        if not keys:
-            return 0
-        groups = _group_by_key64(bms, keys_filter=keys)
-    else:
-        groups = _group_by_key64(bms)
-    n = sum(len(v) for v in groups.values())
+    prepared = _prepare_groups64(bms, op)
+    if prepared is None:
+        return 0
+    groups, n = prepared
     if _use_device(n, mode):
         packed = store.pack_groups(groups)
         return int(store.reduce_packed_cardinality(packed, op=op).sum())

@@ -198,3 +198,31 @@ def test_sum_device_matches_cpu_and_oracle():
     lookup = dict(pairs)
     want = sum(lookup[c] for c in found.to_array().tolist() if c in lookup)
     assert cpu[0] == want and cpu[1] == found.get_cardinality()
+
+
+def test_compare_cardinality_matches_materialized():
+    """Count-only compare == compare().get_cardinality() across every op,
+    mode, and found-set shape (incl. NEQ's outside-ebm chunks, the path
+    where the device count must add the unpacked remainder)."""
+    rng = np.random.default_rng(23)
+    bsi = RoaringBitmapSliceIndex()
+    cols = np.sort(rng.choice(500_000, size=60_000, replace=False))
+    vals = rng.integers(0, 1 << 24, size=60_000)
+    bsi.set_values((cols, vals))
+    med = int(np.median(vals))
+    found = RoaringBitmap(
+        rng.choice(900_000, size=40_000, replace=False).astype(np.uint32)
+    )
+    cases = [
+        (Operation.GE, med, 0, None),
+        (Operation.LT, med, 0, found),
+        (Operation.EQ, int(vals[0]), 0, None),
+        (Operation.NEQ, int(vals[1]), 0, found),
+        (Operation.RANGE, med // 2, med * 2, None),
+        (Operation.GT, 1 << 30, 0, None),  # min/max short-circuit
+    ]
+    for op, a, b, fs in cases:
+        want = bsi.compare(op, a, b, fs, mode="cpu").get_cardinality()
+        for mode in ("cpu", "device"):
+            got = bsi.compare_cardinality(op, a, b, fs, mode=mode)
+            assert got == want, (op, mode)

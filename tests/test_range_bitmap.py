@@ -295,3 +295,31 @@ def test_appender_usable_after_build():
     second = app2.build()
     assert first.row_count == 1 << 16 and first.eq_cardinality(6) == 0
     assert second.eq_cardinality(6) == 100
+
+
+def test_cardinality_overloads_count_only():
+    """*_cardinality == materialized count for built and mapped indexes,
+    with and without context (context path walks chunks; context-free path
+    is the count-only BSI fetch)."""
+    rng = np.random.default_rng(31)
+    vals = rng.integers(0, 1 << 20, size=200_000)
+    ap = RangeBitmap.appender(int(vals.max()))
+    ap.add_many(vals)
+    built = ap.build()
+    mapped = RangeBitmap.map(built.serialize())
+    med = int(np.median(vals))
+    ctx = RoaringBitmap(np.arange(0, 200_000, 3, dtype=np.uint32))
+    for rb in (built, mapped):
+        for name, args in (
+            ("lt", (med,)), ("lte", (med,)), ("gt", (med,)), ("gte", (med,)),
+            ("eq", (int(vals[7]),)), ("neq", (int(vals[7]),)),
+            ("between", (med // 2, med + med // 2)),
+        ):
+            for context in (None, ctx):
+                want = getattr(rb, name)(*args, context).get_cardinality()
+                got = getattr(rb, f"{name}_cardinality")(*args, context)
+                assert got == want, (name, context is not None, rb is mapped)
+    with pytest.raises(ValueError):
+        built.lt_cardinality(-1, ctx)
+    with pytest.raises(ValueError):
+        built.lt_cardinality(-1)
