@@ -58,6 +58,54 @@ class config:
     mesh = None
 
 
+def min_max_verdict(op, start_or_value, end, mn, mx):
+    """compareUsingMinMax (RoaringBitmapSliceIndex.java:515-578) as a pure
+    symbol — 'all' | 'empty' | 'fixed' | None — shared by the 32- and
+    64-bit indexes so the materializing and count-only callers each pay
+    only for what they return (no eager ebm clone on the no-shortcut
+    path). 'fixed' = the raw fixed set for out-of-range NEQ (Java keeps
+    found_set un-intersected there); avoids the slice walk seeing a
+    bit-truncated predicate (strictly more correct than the reference,
+    which truncates)."""
+    v = start_or_value
+    if op == Operation.LT:
+        if v > mx:
+            return "all"
+        if v <= mn:
+            return "empty"
+    elif op == Operation.LE:
+        if v >= mx:
+            return "all"
+        if v < mn:
+            return "empty"
+    elif op == Operation.GT:
+        if v < mn:
+            return "all"
+        if v >= mx:
+            return "empty"
+    elif op == Operation.GE:
+        if v <= mn:
+            return "all"
+        if v > mx:
+            return "empty"
+    elif op == Operation.EQ:
+        if mn == mx and mn == v:
+            return "all"
+        if v < mn or v > mx:
+            return "empty"
+    elif op == Operation.NEQ:
+        if mn == mx:
+            return "empty" if mn == v else "all"
+        if v < mn or v > mx:
+            return "fixed"
+    elif op == Operation.RANGE:
+        if v <= mn and end >= mx:
+            return "all"
+        if v > mx or end < mn:
+            return "empty"
+    return None
+
+
 def values_for_columns(cols: np.ndarray, slices, dtype=np.int64) -> np.ndarray:
     """Reassemble the stored value of each column from the slice bitmaps:
     one vectorized membership mask per slice, bits OR'd back together.
@@ -303,51 +351,7 @@ class RoaringBitmapSliceIndex:
         return self._o_neil(operation, start_or_value, found_set, mode)
 
     def _min_max_verdict(self, op, start_or_value, end):
-        """compareUsingMinMax (RoaringBitmapSliceIndex.java:515-578) as a
-        pure symbol — 'all' | 'empty' | 'fixed' | None — so the
-        materializing and count-only callers each pay only for what they
-        return (no eager ebm clone on the no-shortcut path)."""
-        v, mn, mx = start_or_value, self.min_value, self.max_value
-        if op == Operation.LT:
-            if v > mx:
-                return "all"
-            if v <= mn:
-                return "empty"
-        elif op == Operation.LE:
-            if v >= mx:
-                return "all"
-            if v < mn:
-                return "empty"
-        elif op == Operation.GT:
-            if v < mn:
-                return "all"
-            if v >= mx:
-                return "empty"
-        elif op == Operation.GE:
-            if v <= mn:
-                return "all"
-            if v > mx:
-                return "empty"
-        elif op == Operation.EQ:
-            if mn == mx and mn == v:
-                return "all"
-            if v < mn or v > mx:
-                return "empty"
-        elif op == Operation.NEQ:
-            if mn == mx:
-                return "empty" if mn == v else "all"
-            if v < mn or v > mx:
-                # no stored value can equal v -> NEQ = the raw fixed set
-                # (Java keeps found_set un-intersected for NEQ); avoids the
-                # slice walk seeing a bit-truncated predicate (strictly more
-                # correct than the reference, which truncates here)
-                return "fixed"
-        elif op == Operation.RANGE:
-            if v <= mn and end >= mx:
-                return "all"
-            if v > mx or end < mn:
-                return "empty"
-        return None
+        return min_max_verdict(op, start_or_value, end, self.min_value, self.max_value)
 
     def _compare_using_min_max(self, op, start_or_value, end, found_set):
         verdict = self._min_max_verdict(op, start_or_value, end)

@@ -23,7 +23,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from ..serialization import InvalidRoaringFormat
-from .bsi import Operation
+from .bsi import Operation, min_max_verdict
 from .roaring64art import Roaring64Bitmap
 
 _MAX64 = 1 << 64
@@ -250,6 +250,32 @@ class Roaring64BitmapSliceIndex:
             return self._o_neil_device(operation, start_or_value, found_set)
         return self._o_neil(operation, start_or_value, found_set)
 
+    def compare_cardinality(
+        self,
+        operation: Operation,
+        start_or_value: int,
+        end: int = 0,
+        found_set: Optional[Roaring64Bitmap] = None,
+        mode: Optional[str] = None,
+    ) -> int:
+        """Count-only compare (the 32-bit compare_cardinality twin): the
+        min/max verdicts resolve without materializing, everything else
+        counts the compared result."""
+        verdict = min_max_verdict(
+            operation, start_or_value, end, self.min_value, self.max_value
+        )
+        if verdict == "empty":
+            return 0
+        if verdict == "fixed":
+            return (self.ebm if found_set is None else found_set).get_cardinality()
+        if verdict == "all":
+            if found_set is None:
+                return self.ebm.get_cardinality()
+            return Roaring64Bitmap.and_cardinality(self.ebm, found_set)
+        return self.compare(
+            operation, start_or_value, end, found_set, mode
+        ).get_cardinality()
+
     def _use_device(self, mode: Optional[str]) -> bool:
         mode = mode or config.mode
         if mode == "cpu":
@@ -361,49 +387,20 @@ class Roaring64BitmapSliceIndex:
         return result
 
     def _compare_using_min_max(self, op, start_or_value, end, found_set):
-        all_ = (
+        verdict = min_max_verdict(
+            op, start_or_value, end, self.min_value, self.max_value
+        )
+        if verdict is None:
+            return None
+        if verdict == "empty":
+            return Roaring64Bitmap()
+        if verdict == "fixed":
+            return self.ebm.clone() if found_set is None else found_set.clone()
+        return (
             self.ebm.clone()
             if found_set is None
             else Roaring64Bitmap.and_(self.ebm, found_set)
         )
-        empty = Roaring64Bitmap()
-        v, mn, mx = start_or_value, self.min_value, self.max_value
-        if op == Operation.LT:
-            if v > mx:
-                return all_
-            if v <= mn:
-                return empty
-        elif op == Operation.LE:
-            if v >= mx:
-                return all_
-            if v < mn:
-                return empty
-        elif op == Operation.GT:
-            if v < mn:
-                return all_
-            if v >= mx:
-                return empty
-        elif op == Operation.GE:
-            if v <= mn:
-                return all_
-            if v > mx:
-                return empty
-        elif op == Operation.EQ:
-            if mn == mx and mn == v:
-                return all_
-            if v < mn or v > mx:
-                return empty
-        elif op == Operation.NEQ:
-            if mn == mx:
-                return empty if mn == v else all_
-            if v < mn or v > mx:
-                return self.ebm.clone() if found_set is None else found_set.clone()
-        elif op == Operation.RANGE:
-            if v <= mn and end >= mx:
-                return all_
-            if v > mx or end < mn:
-                return empty
-        return None
 
     def _o_neil(self, op, predicate, found_set) -> Roaring64Bitmap:
         fixed = self.ebm if found_set is None else found_set

@@ -83,15 +83,7 @@ class FastAggregation64:
         surviving groups (Util.intersectKeys / workShyAnd analogue; every
         surviving key appears in all inputs, so the filtered grouping is
         exactly the AND work set)."""
-        bms = _flatten64(bitmaps)
-        if not bms:
-            return Roaring64Bitmap()
-        if len(bms) == 1:
-            return bms[0].clone()
-        prepared = _prepare_groups64(bms, "and")
-        if prepared is None:
-            return Roaring64Bitmap()
-        return _reduce_groups(prepared[0], "and", mode)
+        return _aggregate64(bitmaps, "and", mode)
 
     @staticmethod
     def or_cardinality(*bitmaps: Roaring64Bitmap, mode: Optional[str] = None) -> int:
@@ -154,7 +146,10 @@ def _aggregate64(bitmaps, op: str, mode: Optional[str]) -> Roaring64Bitmap:
         return Roaring64Bitmap()
     if len(bms) == 1:
         return bms[0].clone()
-    return _reduce_groups(_group_by_key64(bms), op, mode)
+    prepared = _prepare_groups64(bms, op)
+    if prepared is None:
+        return Roaring64Bitmap()
+    return _reduce_groups(prepared[0], op, mode)
 
 
 def _reduce_groups(groups, op: str, mode: Optional[str]) -> Roaring64Bitmap:

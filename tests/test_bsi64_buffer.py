@@ -272,3 +272,26 @@ def test_bsi64_device_path_matches_cpu():
     v = bsi._pack_cache[0]
     bsi.set_value(int(cols[0]), 7)
     assert bsi._version != v
+
+
+def test_bsi64_compare_cardinality():
+    import numpy as np
+
+    from roaringbitmap_tpu.models.bsi import Operation
+    from roaringbitmap_tpu.models.bsi64 import Roaring64BitmapSliceIndex
+
+    rng = np.random.default_rng(43)
+    b = Roaring64BitmapSliceIndex()
+    cols = rng.choice(1 << 40, size=5_000, replace=False).astype(np.int64)
+    vals = rng.integers(0, 1 << 30, size=5_000).astype(np.int64)
+    b.set_values(list(zip(cols.tolist(), vals.tolist())))
+    med = int(np.median(vals))
+    for op, a, e in (
+        (Operation.GE, med, 0),
+        (Operation.LT, med, 0),
+        (Operation.RANGE, med // 2, med * 2),
+        (Operation.GE, 0, 0),  # min/max verdict 'all' — no materialization
+        (Operation.GT, 1 << 40, 0),  # verdict 'empty'
+    ):
+        want = b.compare(op, a, e, None).get_cardinality()
+        assert b.compare_cardinality(op, a, e, None) == want, op
