@@ -28,6 +28,13 @@ def main():
     mid = index.compare(Operation.RANGE, 250_000, 750_000, cohort)
     print("cohort rows in [250k, 750k]:", mid.get_cardinality())
 
+    # count-only query: on the device path only per-chunk popcounts come
+    # back to host — for "how many?" questions this skips the result
+    # stream-back and container rebuild entirely
+    n_high = index.compare_cardinality(Operation.GE, 900_000, 0, None)
+    assert n_high == high.get_cardinality()
+    print("scores >= 900k (count-only):", n_high)
+
     # aggregates ride the same packed tensor
     total, count = index.sum(cohort)
     print(f"cohort sum={total} over {count} rows (mean {total // count})")

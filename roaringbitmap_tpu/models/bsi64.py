@@ -259,8 +259,9 @@ class Roaring64BitmapSliceIndex:
         mode: Optional[str] = None,
     ) -> int:
         """Count-only compare (the 32-bit compare_cardinality twin): the
-        min/max verdicts resolve without materializing, everything else
-        counts the compared result."""
+        min/max verdicts resolve without materializing, and the device path
+        fetches only per-chunk popcounts — no result words, no container
+        rebuild."""
         verdict = min_max_verdict(
             operation, start_or_value, end, self.min_value, self.max_value
         )
@@ -272,8 +273,21 @@ class Roaring64BitmapSliceIndex:
             if found_set is None:
                 return self.ebm.get_cardinality()
             return Roaring64Bitmap.and_cardinality(self.ebm, found_set)
+        if self._use_device(mode):
+            if operation == Operation.RANGE:
+                end = min(int(end), (1 << self.bit_count()) - 1)
+            keys, _out, cards, = self._o_neil_device_walk(
+                operation, start_or_value, found_set, end
+            )
+            total = int(np.asarray(cards).astype(np.int64).sum())
+            if operation == Operation.NEQ and found_set is not None:
+                kset = set(keys)  # outside-ebm chunks qualify wholesale
+                total += sum(
+                    c.cardinality for k, c in found_set._kv() if k not in kset
+                )
+            return total
         return self.compare(
-            operation, start_or_value, end, found_set, mode
+            operation, start_or_value, end, found_set, mode="cpu"
         ).get_cardinality()
 
     def _use_device(self, mode: Optional[str]) -> bool:
@@ -341,15 +355,13 @@ class Roaring64BitmapSliceIndex:
                 out[ki] = container_words_u32(c)
         return out
 
-    def _o_neil_device(
-        self, op, predicate, found_set, end: int = 0
-    ) -> Roaring64Bitmap:
-        """The fused device O'Neil over high-48 chunk keys (the 32-bit
-        engine's kernels, ops/pallas_kernels.best_oneil_compare, apply
-        unchanged — the key width only changes the host-side directory)."""
+    def _o_neil_device_walk(self, op, predicate, found_set, end: int = 0):
+        """Fused device walk over high-48 chunk keys; returns (keys,
+        out_device, cards_device) with nothing fetched — compare pulls the
+        words, compare_cardinality only the popcounts (32-bit twin:
+        bsi._o_neil_device_walk)."""
         import jax.numpy as jnp
 
-        from ..models.container import best_container_of_words
         from ..ops import pallas_kernels as pk
 
         keys, ebm_w, slices_w = self._pack_dense64()
@@ -371,6 +383,17 @@ class Roaring64BitmapSliceIndex:
         out, cards = pk.best_oneil_compare(
             slices_w, jnp.asarray(bits_vec), ebm_w, fixed_w, op.value
         )
+        return keys, out, cards
+
+    def _o_neil_device(
+        self, op, predicate, found_set, end: int = 0
+    ) -> Roaring64Bitmap:
+        """The fused device O'Neil over high-48 chunk keys (the 32-bit
+        engine's kernels, ops/pallas_kernels.best_oneil_compare, apply
+        unchanged — the key width only changes the host-side directory)."""
+        from ..models.container import best_container_of_words
+
+        keys, out, cards = self._o_neil_device_walk(op, predicate, found_set, end)
         out_np = np.ascontiguousarray(np.asarray(out)).view(np.uint64)
         cards_np = np.asarray(cards)
         result = Roaring64Bitmap()

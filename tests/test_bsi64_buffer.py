@@ -295,3 +295,34 @@ def test_bsi64_compare_cardinality():
     ):
         want = b.compare(op, a, e, None).get_cardinality()
         assert b.compare_cardinality(op, a, e, None) == want, op
+
+
+def test_bsi64_compare_cardinality_device_paths():
+    """Device count-only == CPU materialized count, incl. NEQ's
+    outside-ebm chunk remainder (the path the device sum must add back)."""
+    import numpy as np
+
+    from roaringbitmap_tpu.models.bsi import Operation
+    from roaringbitmap_tpu.models.bsi64 import Roaring64BitmapSliceIndex
+    from roaringbitmap_tpu.models.roaring64art import Roaring64Bitmap
+
+    rng = np.random.default_rng(47)
+    b = Roaring64BitmapSliceIndex()
+    base = np.uint64(1) << np.uint64(35)
+    cols = (
+        base + rng.choice(1 << 18, size=20_000, replace=False).astype(np.uint64)
+    ).astype(np.int64)
+    vals = rng.integers(0, 1 << 24, size=20_000).astype(np.int64)
+    b.set_values(list(zip(cols.tolist(), vals.tolist())))
+    med = int(np.median(vals))
+    outside = (base + np.uint64(1 << 20)) + np.arange(1500, dtype=np.uint64)
+    fs = Roaring64Bitmap(
+        np.sort(np.concatenate([cols[:4000].astype(np.uint64), outside]))
+    )
+    for op, a, e in (
+        (Operation.GE, med, 0),
+        (Operation.NEQ, int(vals[3]), 0),
+        (Operation.RANGE, med // 2, med * 2),
+    ):
+        want = b.compare(op, a, e, fs, mode="cpu").get_cardinality()
+        assert b.compare_cardinality(op, a, e, fs, mode="device") == want, op
