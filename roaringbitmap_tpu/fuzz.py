@@ -321,6 +321,26 @@ def run_campaign(iterations: Optional[int] = None, verbose: bool = True) -> dict
             arity=3, iterations=n, seed=8,
         ),
     )
+    def _card_engines_agree(a, b, c):
+        for fn, naive in (
+            (FA.or_cardinality, FA.naive_or),
+            (FA.and_cardinality, FA.naive_and),
+            (FA.xor_cardinality, FA.naive_xor),
+        ):
+            want = naive(a, b, c).get_cardinality()  # one oracle per op
+            if any(fn(a, b, c, mode=m) != want for m in ("cpu", "device")):
+                return False
+        return True
+
+    _run(
+        "cardinality-only-engines-agree",
+        lambda: verify_invariance(
+            "cardinality-only-engines-agree",
+            _card_engines_agree,
+            arity=3, iterations=max(1, n // 4), seed=9,
+        ),
+        actual=max(1, n // 4),
+    )
     _run(
         "addOffset-roundtrip",
         lambda: verify_invariance(

@@ -85,7 +85,16 @@ def dispatch_counters() -> dict:
             f"{k[0]}/{k[1]}/{'x'.join(map(str, k[2]))}/{k[3]}": v
             for k, v in pk._PROBED.items()
         },
+        "native": native_backend(),
     }
+
+
+def native_backend() -> str:
+    """Which host-kernel tier is serving the CPU fast path:
+    'ext' (CPython C extension), 'ctypes', or 'numpy'."""
+    from . import native
+
+    return native.backend_tier()
 
 
 def reset_dispatch_counters() -> None:

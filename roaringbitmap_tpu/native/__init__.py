@@ -130,6 +130,14 @@ def available() -> bool:
     return ok
 
 
+def backend_tier() -> str:
+    """Which host-kernel tier serves the CPU fast path: 'ext' (CPython C
+    extension), 'ctypes', or 'numpy' (pure fallback)."""
+    if not available():
+        return "numpy"
+    return "ext" if _ext is not None else "ctypes"
+
+
 def lib() -> ctypes.CDLL:
     l = _load()
     if l is None:
@@ -150,7 +158,16 @@ def lib() -> ctypes.CDLL:
 # ---------------------------------------------------------------------------
 
 _EXT_SRC = os.path.join(_DIR, "ext.cpp")
-_EXT_NAME = "_rb_ext.so"
+
+
+def _ext_name() -> str:
+    # ABI-tagged (e.g. _rb_ext.cpython-312-x86_64-linux-gnu.so) so multiple
+    # interpreters sharing this checkout each build and load their own
+    import sysconfig
+
+    return "_rb_ext" + (sysconfig.get_config_var("EXT_SUFFIX") or ".so")
+
+
 _ext = None
 _ext_tried = False
 _ext_bound = False
@@ -188,29 +205,41 @@ def _load_ext():
             "ROARINGBITMAP_TPU_NO_EXT"
         ):
             return None
-        path = os.path.join(_DIR, _EXT_NAME)
+        name = _ext_name()
+        path = os.path.join(_DIR, name)
         try:
             src_m = max(os.path.getmtime(_EXT_SRC), os.path.getmtime(_SRC))
             if not os.path.exists(path) or os.path.getmtime(path) < src_m:
                 if not _build_ext(path):
-                    path = os.path.join(tempfile.mkdtemp(prefix="rb_ext_"), _EXT_NAME)
+                    path = os.path.join(tempfile.mkdtemp(prefix="rb_ext_"), name)
                     if not _build_ext(path):
                         return None
-            import importlib.util
-
-            spec = importlib.util.spec_from_file_location(
-                "roaringbitmap_tpu.native._rb_ext", path
-            )
-            mod = importlib.util.module_from_spec(spec)
-            spec.loader.exec_module(mod)
-            # smoke-test: a stale ABI or missing symbol surfaces now (a
-            # plain if, not assert — must fire under python -O too)
-            if int(mod.cardinality_of_words(np.ones(1, dtype=np.uint64))) != 1:
-                raise ImportError("_rb_ext smoke-test failed")
-            _ext = mod
+            _ext = _import_ext(path)
         except Exception:
-            _ext = None
+            # a cached build that fails to load (stale toolchain output,
+            # read-only dir race) gets one fresh private rebuild before
+            # the process settles on the ctypes tier
+            try:
+                path = os.path.join(tempfile.mkdtemp(prefix="rb_ext_"), name)
+                _ext = _import_ext(path) if _build_ext(path) else None
+            except Exception:
+                _ext = None
     return _ext
+
+
+def _import_ext(path: str):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "roaringbitmap_tpu.native._rb_ext", path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # smoke-test: a stale ABI or missing symbol surfaces now (a plain if,
+    # not assert — must fire under python -O too)
+    if int(mod.cardinality_of_words(np.ones(1, dtype=np.uint64))) != 1:
+        raise ImportError("_rb_ext smoke-test failed")
+    return mod
 
 
 def _bind_ext_once() -> None:

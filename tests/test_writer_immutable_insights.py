@@ -185,6 +185,8 @@ def test_insights_dispatch_counters():
     counters = insights.dispatch_counters()
     assert sum(counters["layout"].values()) == 1
     assert sum(counters["kernel"].values()) >= 0  # xla on cpu backend
+    # the serving host-kernel tier is attributable too
+    assert counters["native"] in ("ext", "ctypes", "numpy")
     # repeat aggregation on the same working set must not re-pad: the cached
     # padded device array object is reused identically (VERDICT r2 weak #8)
     cached = packed.padded_device(0)
