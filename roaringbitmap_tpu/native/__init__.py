@@ -1,12 +1,17 @@
-"""Native host runtime: compiled C++ L0 kernels behind ctypes.
+"""Native host runtime: compiled C++ L0 kernels in three tiers.
 
 The reference's hot host-side loops are JIT-compiled Java intrinsics
 (Util.java galloping searches, Long.bitCount folds); this framework's
 equivalents are a small C++ library (``kernels.cpp``) compiled on first use
-with the system toolchain and loaded via ctypes — no build-time dependency,
-no pybind11. Every entry point has an identical-semantics numpy fallback in
-``utils/bits.py``; ``utils/bits.py`` transparently dispatches here when the
-library is available (disable with ``ROARINGBITMAP_TPU_NO_NATIVE=1``).
+with the system toolchain — no build-time dependency, no pybind11. Two
+bindings serve it: a CPython/numpy C-API extension (``ext.cpp``,
+~0.2-1 us/call — the tier that matters at container sizes) and ctypes
+(~4-13 us/call, the portable fallback and the batch entry points). Every
+entry point also has an identical-semantics numpy fallback in
+``utils/bits.py``; ``utils/bits.py`` transparently dispatches here when a
+native tier is available (disable with ``ROARINGBITMAP_TPU_NO_NATIVE=1``;
+``ROARINGBITMAP_TPU_NO_EXT=1`` pins ctypes). ``backend_tier()`` reports
+which tier is live.
 
 The TPU compute path (ops/) never goes through this module — it exists for
 the CPU fast path, where the reference wins on ns-scale small-container ops
@@ -130,12 +135,45 @@ def available() -> bool:
     return ok
 
 
+def validate_sorted_u16(values: np.ndarray) -> bool:
+    """True iff strictly increasing (deserialization's array-container
+    check; single C pass when the extension is built, else the shared
+    numpy fallback in utils/bits)."""
+    e = _load_ext()
+    if e is not None:
+        try:
+            return bool(e.is_strictly_increasing(values))
+        except TypeError:
+            return bool(e.is_strictly_increasing(_c16(values)))
+    from ..utils import bits as _bits
+
+    return _bits.validate_sorted_u16_numpy(values)
+
+
+def validate_runs_u16(pairs: np.ndarray) -> bool:
+    """True iff interleaved (start, length) runs are sorted, disjoint,
+    non-touching, and end inside the 2^16 universe."""
+    e = _load_ext()
+    if e is not None:
+        try:
+            return bool(e.runs_valid(pairs))
+        except TypeError:
+            return bool(e.runs_valid(_c16(pairs)))
+    from ..utils import bits as _bits
+
+    return _bits.validate_runs_u16_numpy(pairs)
+
+
 def backend_tier() -> str:
     """Which host-kernel tier serves the CPU fast path: 'ext' (CPython C
-    extension), 'ctypes', or 'numpy' (pure fallback)."""
-    if not available():
-        return "numpy"
-    return "ext" if _ext is not None else "ctypes"
+    extension), 'ctypes', 'numpy' (pure fallback), or 'unloaded' (nothing
+    has triggered the lazy resolution yet). Reports state only — a
+    read-only observability call must never block on a g++ build."""
+    if _ext is not None:
+        return "ext"
+    if _lib is not None:
+        return "ctypes"
+    return "numpy" if _tried else "unloaded"
 
 
 def lib() -> ctypes.CDLL:
@@ -216,14 +254,21 @@ def _load_ext():
                         return None
             _ext = _import_ext(path)
         except Exception:
-            # a cached build that fails to load (stale toolchain output,
-            # read-only dir race) gets one fresh private rebuild before
-            # the process settles on the ctypes tier
-            try:
-                path = os.path.join(tempfile.mkdtemp(prefix="rb_ext_"), name)
-                _ext = _import_ext(path) if _build_ext(path) else None
-            except Exception:
-                _ext = None
+            # a cached build that fails to load gets a rebuild IN PLACE
+            # first (self-healing the package-dir cache so later processes
+            # don't re-pay this), then one private-dir attempt (read-only
+            # checkouts), before the process settles on the ctypes tier
+            _ext = None
+            for retry in (
+                os.path.join(_DIR, name),
+                os.path.join(tempfile.mkdtemp(prefix="rb_ext_"), name),
+            ):
+                try:
+                    if _build_ext(retry):
+                        _ext = _import_ext(retry)
+                        break
+                except Exception:
+                    continue
     return _ext
 
 

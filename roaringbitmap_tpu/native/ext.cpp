@@ -190,6 +190,41 @@ static PyObject* cardinality_in_range(PyObject*, PyObject* args) {
   return PyLong_FromLongLong(rb_cardinality_in_range(w, start, end));
 }
 
+// Deserialization validators (single pass, no temporaries) ----------------
+
+static PyObject* is_strictly_increasing(PyObject*, PyObject* args) {
+  PyObject* vo;
+  if (!PyArg_ParseTuple(args, "O", &vo)) return nullptr;
+  const uint16_t* v;
+  int32_t n;
+  if (!as_u16(vo, &v, &n)) return nullptr;
+  for (int32_t i = 1; i < n; ++i)
+    if (v[i] <= v[i - 1]) Py_RETURN_FALSE;
+  Py_RETURN_TRUE;
+}
+
+static PyObject* runs_valid(PyObject*, PyObject* args) {
+  // interleaved (start, length) pairs: sorted, disjoint, non-touching,
+  // ends within the 2^16 universe (serialization.py's run checks)
+  PyObject* po;
+  if (!PyArg_ParseTuple(args, "O", &po)) return nullptr;
+  const uint16_t* p;
+  int32_t n2;
+  if (!as_u16(po, &p, &n2)) return nullptr;
+  if (n2 % 2) {
+    PyErr_SetString(PyExc_ValueError, "odd-length pair array");
+    return nullptr;
+  }
+  int32_t prev_end = -1;
+  for (int32_t i = 0; i < n2 / 2; ++i) {
+    int32_t s = p[2 * i];
+    int32_t e = s + p[2 * i + 1];
+    if (s <= prev_end || e > 0xFFFF) Py_RETURN_FALSE;
+    prev_end = e;
+  }
+  Py_RETURN_TRUE;
+}
+
 static PyMethodDef Methods[] = {
     {"intersect_sorted", setop<rb_intersect_u16, CAP_MIN>, METH_VARARGS, nullptr},
     {"merge_sorted_unique", setop<rb_union_u16, CAP_SUM>, METH_VARARGS, nullptr},
@@ -204,6 +239,8 @@ static PyMethodDef Methods[] = {
     {"num_runs_in_words", num_runs_in_words, METH_VARARGS, nullptr},
     {"select_in_words", select_in_words, METH_VARARGS, nullptr},
     {"cardinality_in_range", cardinality_in_range, METH_VARARGS, nullptr},
+    {"is_strictly_increasing", is_strictly_increasing, METH_VARARGS, nullptr},
+    {"runs_valid", runs_valid, METH_VARARGS, nullptr},
     {nullptr, nullptr, 0, nullptr}};
 
 static struct PyModuleDef Module = {PyModuleDef_HEAD_INIT, "_rb_ext",

@@ -33,6 +33,7 @@ from typing import Union
 
 import numpy as np
 
+from .utils import bits as _bits
 from .models.container import (
     ARRAY_MAX_SIZE,
     ArrayContainer,
@@ -212,12 +213,8 @@ def read_into(bm: RoaringBitmap, data) -> int:
             )
             pos += 4 * n_runs
             starts, lengths = pairs[0::2], pairs[1::2]
-            s32 = starts.astype(np.int32)
-            ends = s32 + lengths  # int32: no uint16 overflow
-            if n_runs and (
-                np.any(s32[1:] <= ends[:-1])  # overlapping/touching runs
-                or np.any(ends > 0xFFFF)
-            ):
+            if n_runs and not _bits.validate_runs_u16(pairs):
+                # overlapping/touching runs, or an end past the universe
                 raise InvalidRoaringFormat("invalid run container")
             c: Container = RunContainer(starts, lengths)
         elif card > ARRAY_MAX_SIZE:
@@ -226,8 +223,6 @@ def read_into(bm: RoaringBitmap, data) -> int:
                 np.uint64
             )
             pos += 8192
-            from .utils import bits as _bits
-
             actual = _bits.cardinality_of_words(words)
             if actual != card:
                 raise InvalidRoaringFormat(
@@ -240,9 +235,7 @@ def read_into(bm: RoaringBitmap, data) -> int:
                 np.uint16
             )
             pos += 2 * card
-            # uint16 comparison (no subtraction) — strictly-increasing check
-            # without the diff/astype temporaries the profile showed dominating
-            if card > 1 and np.any(values[1:] <= values[:-1]):
+            if card > 1 and not _bits.validate_sorted_u16(values):
                 raise InvalidRoaringFormat("array container values not sorted/unique")
             c = ArrayContainer(values)
         hlc.keys.append(key)

@@ -267,6 +267,24 @@ def xor_sorted(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     return out.astype(np.uint16)
 
 
+def validate_sorted_u16(values: np.ndarray) -> bool:
+    """True iff strictly increasing (deserialization's array-container
+    check)."""
+    return not (values.size > 1 and bool(np.any(values[1:] <= values[:-1])))
+
+
+def validate_runs_u16(pairs: np.ndarray) -> bool:
+    """True iff interleaved (start, length) runs are sorted, disjoint,
+    non-touching, and end inside the 2^16 universe."""
+    starts, lengths = pairs[0::2], pairs[1::2]
+    s32 = starts.astype(np.int32)
+    ends = s32 + lengths  # int32: no uint16 overflow
+    return not (
+        starts.size
+        and (bool(np.any(s32[1:] <= ends[:-1])) or bool(np.any(ends > 0xFFFF)))
+    )
+
+
 # ---------------------------------------------------------------------------
 # native dispatch — when the compiled C++ kernels (native/kernels.cpp) are
 # available, the hot host-path entry points rebind to them. The numpy
@@ -292,6 +310,8 @@ _DISPATCHED = (
     "cardinality_in_range",
     "runs_from_values",
     "words_from_intervals",
+    "validate_sorted_u16",
+    "validate_runs_u16",
 )
 
 for _name in _DISPATCHED:
