@@ -179,8 +179,12 @@ class BitmapWriter:
 
     def _flush_current(self) -> None:
         if self._current_key is not None and self._words_dirty:
+            # hand the buffer off: best_container_of_words keeps a reference
+            # when it builds a dense container, so zeroing it in place would
+            # clobber the just-emitted chunk (and any bitmap already
+            # returned by get()) — allocate fresh instead of aliasing
             self._emit(self._current_key, best_container_of_words(self._words))
-            self._words[:] = 0
+            self._words = bits.new_words()
             self._words_dirty = False
 
     def flush(self) -> None:
@@ -201,6 +205,19 @@ class BitmapWriter:
         return self._bitmap
 
     get_underlying = get
+
+    def reset(self) -> None:
+        """Discard buffered state and start a fresh underlying bitmap
+        (RoaringBitmapWriter.reset — reuse one writer across bitmaps)."""
+        self._pending.clear()
+        self._current_key = None
+        self._words = bits.new_words()  # never zero in place: see _flush_current
+        self._words_dirty = False
+        self._bitmap = (
+            FastRankRoaringBitmap()
+            if isinstance(self._bitmap, FastRankRoaringBitmap)
+            else RoaringBitmap()
+        )
 
 
 def writer() -> RoaringBitmapWriter:

@@ -269,3 +269,30 @@ def test_tracing_profile_writes_trace(tmp_path):
     for root, _dirs, files in os.walk(logdir):
         found.extend(files)
     assert found, "no profiler artifacts written"
+
+
+def test_writer_reset_reuse():
+    """reset() (RoaringBitmapWriter.reset): one writer, many bitmaps —
+    earlier results must not alias the post-reset state, INCLUDING dense
+    (>4096 per key) containers emitted from the streaming word buffer
+    (code-review regression: the buffer was zeroed in place while emitted
+    BitmapContainers still referenced it)."""
+    from roaringbitmap_tpu import RoaringBitmapWriter
+
+    w = RoaringBitmapWriter.writer().get()
+    for v in range(5000):  # point adds: the streaming word-buffer path
+        w.add(v)
+    first = w.get()
+    assert first.get_cardinality() == 5000
+    assert first.to_array().size == 5000  # container must own its words
+    w.reset()
+    w.add(7)
+    second = w.get()
+    assert second.to_array().tolist() == [7]
+    assert first.get_cardinality() == 5000
+    assert first.to_array().size == 5000  # untouched by post-reset adds
+    # constant-memory path resets its word buffer too
+    cw = RoaringBitmapWriter.writer().constant_memory().get()
+    cw.add(70000)
+    cw.reset()
+    assert cw.get().is_empty()
