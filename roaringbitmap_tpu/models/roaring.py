@@ -912,11 +912,33 @@ class RoaringBitmap:
 
         return serialized_size_in_bytes(self)
 
+    def serialize_into(self, stream) -> int:
+        """Write the portable format to a binary file-like object; returns
+        bytes written (the DataOutput/stream overloads of
+        RoaringBitmap.serialize, RoaringBitmap.java:3012)."""
+        data = self.serialize()
+        stream.write(data)
+        return len(data)
+
     @staticmethod
     def deserialize(data) -> "RoaringBitmap":
         from ..serialization import deserialize
 
         return deserialize(data)
+
+    @classmethod
+    def deserialize_from(cls, stream) -> "RoaringBitmap":
+        """Read one bitmap from a binary file-like object positioned at its
+        start; forward-only reads consume exactly the bitmap's bytes, so
+        consecutive bitmaps stream back-to-back and non-seekable sources
+        (sockets, pipes) work (the DataInput overload of
+        RoaringBitmap.deserialize). Classmethod: subclasses deserialize to
+        their own type."""
+        from ..serialization import read_from_stream
+
+        bm = cls()
+        read_from_stream(bm, stream)
+        return bm
 
     @staticmethod
     def maximum_serialized_size(cardinality: int, universe_size: int) -> int:

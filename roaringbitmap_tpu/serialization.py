@@ -155,6 +155,58 @@ def deserialize(data: Union[bytes, bytearray, memoryview, np.ndarray]) -> Roarin
     return bm
 
 
+def read_from_stream(bm: RoaringBitmap, stream) -> int:
+    """Fill ``bm`` from a binary file-like object, consuming EXACTLY one
+    serialized bitmap with forward-only reads (works on sockets/pipes; no
+    seek). The wire format's own descriptors bound every read: cookie ->
+    container count + run marker -> per-container cardinalities -> payload
+    sizes. Bytes are then re-validated through read_into. Returns bytes
+    consumed."""
+
+    def need(n: int) -> bytes:
+        b = stream.read(n)
+        if len(b) != n:
+            raise InvalidRoaringFormat(
+                f"truncated stream: wanted {n} bytes, got {len(b)}"
+            )
+        return b
+
+    head = need(4)
+    (cookie,) = struct.unpack("<I", head)
+    chunks = [head]
+    if (cookie & 0xFFFF) == SERIAL_COOKIE:
+        size = (cookie >> 16) + 1
+        marker = need((size + 7) // 8)
+        chunks.append(marker)
+        has_run = True
+    elif cookie == SERIAL_COOKIE_NO_RUNCONTAINER:
+        b = need(4)
+        chunks.append(b)
+        (size,) = struct.unpack("<I", b)
+        has_run = False
+        marker = b""
+    else:
+        raise InvalidRoaringFormat(f"invalid cookie {cookie}")
+    if size > _MAX_CONTAINERS:
+        raise InvalidRoaringFormat(f"container count {size} exceeds 65536")
+    desc = need(4 * size)
+    chunks.append(desc)
+    cards = np.frombuffer(desc, dtype="<u2")[1::2].astype(np.int64) + 1
+    if (not has_run) or size >= NO_OFFSET_THRESHOLD:
+        chunks.append(need(4 * size))  # offset table
+    for i in range(size):
+        if has_run and marker[i // 8] & (1 << (i % 8)):
+            nb = need(2)
+            chunks.append(nb)
+            (n_runs,) = struct.unpack("<H", nb)
+            chunks.append(need(4 * n_runs))
+        elif cards[i] > ARRAY_MAX_SIZE:
+            chunks.append(need(8192))
+        else:
+            chunks.append(need(2 * int(cards[i])))
+    return read_into(bm, b"".join(chunks))
+
+
 def read_into(bm: RoaringBitmap, data) -> int:
     """Fill ``bm`` from serialized bytes; returns bytes consumed."""
     if isinstance(data, np.ndarray):

@@ -155,3 +155,57 @@ def test_lying_bitmap_cardinality_rejected():
     )
     with pytest.raises(InvalidRoaringFormat):
         RoaringBitmap.deserialize(payload)
+
+
+def test_stream_serialize_roundtrip(tmp_path):
+    """serialize_into/deserialize_from (the DataOutput/DataInput overloads):
+    consecutive bitmaps stream back-to-back through one file."""
+    import io
+
+    bms = [
+        RoaringBitmap([1, 2, 3]),
+        RoaringBitmap(np.arange(100_000, dtype=np.uint32)),
+        RoaringBitmap([7]),
+    ]
+    bms[1].run_optimize()
+    buf = io.BytesIO()
+    written = [b.serialize_into(buf) for b in bms]
+    assert buf.tell() == sum(written)
+    buf.seek(0)
+    back = [RoaringBitmap.deserialize_from(buf) for _ in bms]
+    assert back == bms
+    assert buf.tell() == sum(written)  # consumed exactly, no overread
+    # file-backed too
+    path = tmp_path / "bitmaps.bin"
+    with open(path, "wb") as f:
+        for b in bms:
+            b.serialize_into(f)
+    with open(path, "rb") as f:
+        assert [RoaringBitmap.deserialize_from(f) for _ in bms] == bms
+
+    # forward-only: non-seekable sources (sockets/pipes) must work
+    class NoSeek:
+        def __init__(self, data):
+            self._b = io.BytesIO(data)
+
+        def read(self, n):
+            return self._b.read(n)
+
+    src = NoSeek(b"".join(b.serialize() for b in bms))
+    assert [RoaringBitmap.deserialize_from(src) for _ in bms] == bms
+
+    # classmethod: subclasses deserialize to their own type
+    from roaringbitmap_tpu import MutableRoaringBitmap
+
+    buf2 = io.BytesIO(bms[0].serialize())
+    m = MutableRoaringBitmap.deserialize_from(buf2)
+    assert isinstance(m, MutableRoaringBitmap) and m == bms[0]
+
+    # truncated stream fails cleanly
+    import pytest as _pytest
+
+    from roaringbitmap_tpu import InvalidRoaringFormat
+
+    blob = bms[1].serialize()
+    with _pytest.raises(InvalidRoaringFormat):
+        RoaringBitmap.deserialize_from(io.BytesIO(blob[: len(blob) - 3]))
