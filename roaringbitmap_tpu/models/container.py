@@ -269,8 +269,9 @@ class ArrayContainer(Container):
         return 2 * self.cardinality  # payload: cardinality uint16s
 
     def contains(self, x: int) -> bool:
-        i = int(np.searchsorted(self.content, np.uint16(x)))
-        return i < self.content.size and self.content[i] == x
+        c = self.content
+        i = bits.lower_bound(c, x)
+        return i < c.size and c[i] == x
 
     def contains_many(self, values: np.ndarray) -> np.ndarray:
         if self.content.size == 0:
@@ -339,7 +340,8 @@ class ArrayContainer(Container):
         return self.and_(other).cardinality
 
     def rank(self, x: int) -> int:
-        return int(np.searchsorted(self.content, np.uint16(x), side="right"))
+        # values <= x == first index with content[i] >= x+1
+        return bits.lower_bound(self.content, int(x) + 1) if x < 0xFFFF else self.content.size
 
     def select(self, j: int) -> int:
         return int(self.content[j])

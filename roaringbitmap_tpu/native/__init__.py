@@ -135,6 +135,14 @@ def available() -> bool:
     return ok
 
 
+def lower_bound(a: np.ndarray, x: int) -> int:
+    """First index with a[i] >= x (sorted uint16); rides whichever
+    advance_until binding is live (ext preferred). pos=-1 because
+    advance_until searches strictly AFTER pos (Util.advanceUntil
+    semantics) — pos=0 would skip index 0."""
+    return globals()["advance_until"](a, -1, x)
+
+
 def validate_sorted_u16(values: np.ndarray) -> bool:
     """True iff strictly increasing (deserialization's array-container
     check; single C pass when the extension is built, else the shared

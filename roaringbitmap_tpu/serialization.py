@@ -164,12 +164,19 @@ def read_from_stream(bm: RoaringBitmap, stream) -> int:
     consumed."""
 
     def need(n: int) -> bytes:
-        b = stream.read(n)
-        if len(b) != n:
-            raise InvalidRoaringFormat(
-                f"truncated stream: wanted {n} bytes, got {len(b)}"
-            )
-        return b
+        # unbuffered sources (raw sockets/pipes) may legally return fewer
+        # than n bytes per read; only b"" means EOF (the io contract)
+        parts = []
+        got = 0
+        while got < n:
+            b = stream.read(n - got)
+            if not b:
+                raise InvalidRoaringFormat(
+                    f"truncated stream: wanted {n} bytes, got {got}"
+                )
+            parts.append(b)
+            got += len(b)
+        return b"".join(parts) if len(parts) != 1 else parts[0]
 
     head = need(4)
     (cookie,) = struct.unpack("<I", head)

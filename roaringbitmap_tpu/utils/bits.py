@@ -267,6 +267,13 @@ def xor_sorted(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     return out.astype(np.uint16)
 
 
+def lower_bound(a: np.ndarray, x: int) -> int:
+    """Index of the first element >= x in a sorted uint16 array (the
+    unsignedBinarySearch/gallop primitive behind point contains/rank/add;
+    Util.java:697)."""
+    return int(np.searchsorted(a, np.uint16(x)))
+
+
 def validate_sorted_u16(values: np.ndarray) -> bool:
     """True iff strictly increasing (deserialization's array-container
     check)."""
@@ -312,6 +319,7 @@ _DISPATCHED = (
     "words_from_intervals",
     "validate_sorted_u16",
     "validate_runs_u16",
+    "lower_bound",
 )
 
 for _name in _DISPATCHED:

@@ -7,7 +7,9 @@ export PYTHONPATH=/root/repo:${PYTHONPATH:-}
 for i in $(seq 1 200); do
   if timeout 60 python -c "import jax; assert jax.default_backend() != 'cpu', 'cpu fallback is not the tunnel'" > /dev/null 2>&1; then
     echo "$(date -u +%FT%TZ) tunnel UP (probe $i) — running chip suite" >> /tmp/tunnel_watch.log
-    bash scripts/chip_suite.sh /tmp/chip_suite.log
+    # log INSIDE the repo: the round driver commits uncommitted files, so
+    # on-chip results survive even if the session ends before a human commit
+    bash scripts/chip_suite.sh /root/repo/CHIP_SUITE.log
     echo "$(date -u +%FT%TZ) chip suite finished" >> /tmp/tunnel_watch.log
     exit 0
   fi

@@ -136,3 +136,21 @@ def test_words_from_intervals_differential():
         got = native.words_from_intervals(starts, ends)
         want = bits.words_from_intervals_numpy(starts, ends)
         assert np.array_equal(got, want), (starts[:5], ends[:5])
+
+
+def test_lower_bound_matches_searchsorted():
+    """lower_bound (ext advance_until at pos=-1) == np.searchsorted on
+    randomized edge shapes incl. first/last/absent/0xFFFF probes
+    (regression: pos=0 skipped index 0 under Util.advanceUntil's
+    strictly-after semantics)."""
+    from roaringbitmap_tpu.utils import bits
+
+    rng = np.random.default_rng(5)
+    for _ in range(200):
+        n = int(rng.integers(1, 40))
+        a = np.unique(rng.integers(0, 1 << 16, size=n).astype(np.uint16))
+        probes = [0, int(a[0]), int(a[-1]), 0xFFFF] + [
+            int(v) for v in rng.integers(0, 1 << 16, 4)
+        ]
+        for x in probes:
+            assert bits.lower_bound(a, x) == int(np.searchsorted(a, np.uint16(x)))

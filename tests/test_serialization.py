@@ -183,15 +183,16 @@ def test_stream_serialize_roundtrip(tmp_path):
     with open(path, "rb") as f:
         assert [RoaringBitmap.deserialize_from(f) for _ in bms] == bms
 
-    # forward-only: non-seekable sources (sockets/pipes) must work
-    class NoSeek:
+    # forward-only: non-seekable, SHORT-READING sources (raw sockets/pipes
+    # may return fewer bytes than asked per read) must work
+    class NoSeekShortReads:
         def __init__(self, data):
             self._b = io.BytesIO(data)
 
         def read(self, n):
-            return self._b.read(n)
+            return self._b.read(min(n, 7))  # pathological 7-byte segments
 
-    src = NoSeek(b"".join(b.serialize() for b in bms))
+    src = NoSeekShortReads(b"".join(b.serialize() for b in bms))
     assert [RoaringBitmap.deserialize_from(src) for _ in bms] == bms
 
     # classmethod: subclasses deserialize to their own type
