@@ -282,7 +282,7 @@ class ArrayContainer(Container):
         return (idx < self.content.size) & (self.content[idx_c] == v)
 
     def add(self, x: int) -> Container:
-        i = int(np.searchsorted(self.content, np.uint16(x)))
+        i = bits.lower_bound(self.content, x)
         if i < self.content.size and self.content[i] == x:
             return self
         if self.content.size >= ARRAY_MAX_SIZE:
@@ -291,7 +291,7 @@ class ArrayContainer(Container):
         return self
 
     def remove(self, x: int) -> Container:
-        i = int(np.searchsorted(self.content, np.uint16(x)))
+        i = bits.lower_bound(self.content, x)
         if i < self.content.size and self.content[i] == x:
             self.content = np.delete(self.content, i)
         return self
@@ -347,7 +347,7 @@ class ArrayContainer(Container):
         return int(self.content[j])
 
     def next_value(self, from_value: int) -> int:
-        i = int(np.searchsorted(self.content, np.uint16(from_value)))
+        i = bits.lower_bound(self.content, from_value)
         return int(self.content[i]) if i < self.content.size else -1
 
     def previous_value(self, from_value: int) -> int:

@@ -136,11 +136,20 @@ def available() -> bool:
 
 
 def lower_bound(a: np.ndarray, x: int) -> int:
-    """First index with a[i] >= x (sorted uint16); rides whichever
-    advance_until binding is live (ext preferred). pos=-1 because
-    advance_until searches strictly AFTER pos (Util.advanceUntil
-    semantics) — pos=0 would skip index 0."""
-    return globals()["advance_until"](a, -1, x)
+    """First index with a[i] >= x (sorted uint16). Ext-or-numpy ONLY (the
+    validate_* pattern): through ctypes the call overhead exceeds the
+    np.searchsorted this replaces, so the ctypes tier is never a win here.
+    pos=-1 because advance_until searches strictly AFTER pos
+    (Util.advanceUntil semantics) — pos=0 would skip index 0."""
+    e = _load_ext()
+    if e is not None:
+        try:
+            return e.advance_until(a, -1, int(x))
+        except TypeError:
+            return e.advance_until(_c16(a), -1, int(x))
+    from ..utils import bits as _bits
+
+    return _bits.lower_bound_numpy(a, x)
 
 
 def validate_sorted_u16(values: np.ndarray) -> bool:

@@ -170,6 +170,10 @@ def read_from_stream(bm: RoaringBitmap, stream) -> int:
         got = 0
         while got < n:
             b = stream.read(n - got)
+            if b is None:  # non-blocking source with no data YET — not EOF
+                raise BlockingIOError(
+                    "deserialize_from needs a blocking stream (read returned None)"
+                )
             if not b:
                 raise InvalidRoaringFormat(
                     f"truncated stream: wanted {n} bytes, got {got}"
