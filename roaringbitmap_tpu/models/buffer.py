@@ -280,12 +280,22 @@ class BufferFastAggregation:
         return BufferFastAggregation.and_(*bitmaps, mode=mode)
 
     @staticmethod
-    def and_cardinality(*bitmaps: AnyRoaring) -> int:
-        return BufferFastAggregation.and_(*bitmaps).get_cardinality()
+    def and_cardinality(*bitmaps: AnyRoaring, mode: Optional[str] = None) -> int:
+        from ..parallel.aggregation import FastAggregation
+
+        return FastAggregation.and_cardinality(*_flatten_mixed(bitmaps), mode=mode)
 
     @staticmethod
-    def or_cardinality(*bitmaps: AnyRoaring) -> int:
-        return BufferFastAggregation.or_(*bitmaps).get_cardinality()
+    def or_cardinality(*bitmaps: AnyRoaring, mode: Optional[str] = None) -> int:
+        from ..parallel.aggregation import FastAggregation
+
+        return FastAggregation.or_cardinality(*_flatten_mixed(bitmaps), mode=mode)
+
+    @staticmethod
+    def xor_cardinality(*bitmaps: AnyRoaring, mode: Optional[str] = None) -> int:
+        from ..parallel.aggregation import FastAggregation
+
+        return FastAggregation.xor_cardinality(*_flatten_mixed(bitmaps), mode=mode)
 
 
 class BufferParallelAggregation:

@@ -201,3 +201,23 @@ def test_memory_mapped_file_on_disk(tmp_path, random_bitmap_factory):
         # NOTE: mm.close() would raise BufferError while container views are
         # alive — the mapped views legitimately pin the mapping (zero-copy
         # contract); the map is released when the views are garbage collected.
+
+
+def test_buffer_cardinality_only_mixed_operands():
+    """Count-only N-way engines accept mixed heap/mapped operands and match
+    materialize-then-count on both dispatch modes."""
+    rng = np.random.default_rng(53)
+    heap = [
+        RoaringBitmap(np.unique(rng.integers(0, 1 << 20, 4000)).astype(np.uint32))
+        for _ in range(6)
+    ]
+    mapped = [ImmutableRoaringBitmap(b.serialize()) for b in heap[:3]]
+    operands = mapped + heap[3:]
+    want_or = BufferFastAggregation.or_(*operands).get_cardinality()
+    want_and = BufferFastAggregation.and_(*operands).get_cardinality()
+    for mode in ("cpu", "device"):
+        assert BufferFastAggregation.or_cardinality(*operands, mode=mode) == want_or
+        assert BufferFastAggregation.and_cardinality(*operands, mode=mode) == want_and
+        assert BufferFastAggregation.xor_cardinality(*operands, mode=mode) == (
+            BufferFastAggregation.xor(*operands).get_cardinality()
+        )
