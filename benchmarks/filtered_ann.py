@@ -75,4 +75,27 @@ def run(reps: int = 5, **_) -> List[Result]:
     bench("cpuAndPerQuery", cpu_path)
     bench("deviceBatchedAnd", device_path)
     bench("containsMany", contains_path)
+
+    # many-vs-many: the all-pairs overlap matrix (similarity join). The
+    # reference's only expression of this is an n*m pairwise loop.
+    # (smoke configs may carry fewer than 48 candidates — halve whatever
+    # is there so n_pairs is never zero)
+    half = max(1, min(24, len(cand_bitmaps) // 2))
+    pair_left = cand_bitmaps[:half]
+    pair_right = cand_bitmaps[half : 2 * half]
+
+    def matrix_device():
+        return batch.pairwise_and_cardinality(pair_left, pair_right)
+
+    def matrix_cpu_loop():
+        return [
+            [RoaringBitmap.and_cardinality(a, b) for b in pair_right]
+            for a in pair_left
+        ]
+
+    got = matrix_device()
+    assert got.tolist() == matrix_cpu_loop(), "pairwise matrix mismatch"
+    n_pairs = len(pair_left) * len(pair_right)
+    bench("pairwiseMatrixDevice24x24", matrix_device, per=n_pairs)
+    bench("pairwiseMatrixCpuLoop24x24", matrix_cpu_loop, per=n_pairs)
     return out

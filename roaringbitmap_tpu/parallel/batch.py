@@ -36,19 +36,8 @@ def _pack_one_vs_many(one: RoaringBitmap, many: Sequence[RoaringBitmap]):
         filt[[kidx[k] for k in present]] = store.pack_rows_host([fk[k] for k in present])
     # one expansion pass over EVERY query container, then scatter rows into
     # the [Q, K] layout — pack_rows_host's single-dispatch design is the
-    # whole point of the marshal path
-    all_containers: List = []
-    flat_slots: List[int] = []
-    n_keys = max(1, len(keys))
-    for qi, c in enumerate(many):
-        ch = c.high_low_container
-        for k, cont in zip(ch.keys, ch.containers):
-            all_containers.append(cont)
-            flat_slots.append(qi * n_keys + kidx[k])
-    batch = np.zeros((len(many) * n_keys, dev.DEVICE_WORDS), dtype=np.uint32)
-    if all_containers:
-        batch[np.asarray(flat_slots)] = store.pack_rows_host(all_containers)
-    batch = batch.reshape(len(many), n_keys, dev.DEVICE_WORDS)
+    # whole point of the marshal path (shared with the pairwise matrices)
+    batch = _pack_sets(many, keys, kidx)
     return jnp.asarray(filt), jnp.asarray(batch), np.asarray(keys, dtype=np.int64)
 
 
@@ -143,3 +132,173 @@ def batched_op(
         store.unpack_to_bitmap(keys, masked_np[qi], row_cards_np[qi])
         for qi in range(len(many))
     ]
+
+
+# ---------------------------------------------------------------------------
+# many-vs-many: pairwise intersection matrices (similarity analytics)
+# ---------------------------------------------------------------------------
+
+
+def _pack_sets(sets: Sequence[RoaringBitmap], keys, kidx):
+    n_keys = max(1, len(keys))
+    containers: List = []
+    slots: List[int] = []
+    for si, bm in enumerate(sets):
+        hlc = bm.high_low_container
+        for k, cont in zip(hlc.keys, hlc.containers):
+            slot = kidx.get(k)
+            if slot is None:  # outside the shared key set: cannot intersect
+                continue
+            containers.append(cont)
+            slots.append(si * n_keys + slot)
+    out = np.zeros((len(sets) * n_keys, dev.DEVICE_WORDS), dtype=np.uint32)
+    if containers:
+        out[np.asarray(slots)] = store.pack_rows_host(containers)
+    return out.reshape(len(sets), n_keys, dev.DEVICE_WORDS)
+
+
+_pair_step = None
+
+
+def _pairwise_step():
+    """[nb, K, W] x [m, K, W] -> [nb, m] intersection cardinalities, one
+    fused dispatch per left tile (broadcast AND + popcount reduction —
+    every pair computed in parallel on the VPU lanes)."""
+    global _pair_step
+    if _pair_step is None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def run(left, right):
+            masked = left[:, None] & right[None, :]  # [nb, m, K, W]
+            # per-(pair, key) counts are <= 65536 so int32 is safe; the
+            # key-axis sum happens host-side in int64 (same overflow
+            # discipline as _step above — int64 is unavailable in-jit
+            # without the x64 flag)
+            return jnp.sum(
+                jax.lax.population_count(masked).astype(jnp.int32), axis=3
+            )
+
+        _pair_step = run
+    return _pair_step
+
+
+_pair_mxu_step = None
+
+
+def _pairwise_mxu_step():
+    """The MXU formulation: popcount(a AND b) over 0/1 bit-vectors IS the
+    dot product bits(a) . bits(b) — so the whole overlap matrix is a chain
+    of [n, 65536] @ [65536, m] bf16 matmuls, one per key chunk, on the
+    systolic array. Exactness: 0/1 are exact in bf16; per-chunk partial
+    sums <= 65536 and f32 accumulation stays exact below 2^24 (callers
+    enforce the cardinality bound)."""
+    global _pair_mxu_step
+    if _pair_mxu_step is None:
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        shifts = jnp.arange(32, dtype=jnp.uint32)
+
+        @jax.jit
+        def run(left, right):  # [n, K, W] u32, [m, K, W] u32
+            def bits_of(x):  # [s, W] -> [s, W*32] 0/1 bf16
+                b = (x[..., None] >> shifts) & jnp.uint32(1)
+                return b.reshape(x.shape[0], -1).astype(jnp.bfloat16)
+
+            def body(acc, kslice):
+                lk, rk = kslice
+                return (
+                    acc
+                    + jnp.dot(
+                        bits_of(lk),
+                        bits_of(rk).T,
+                        preferred_element_type=jnp.float32,
+                    ),
+                    None,
+                )
+
+            init = jnp.zeros((left.shape[0], right.shape[0]), jnp.float32)
+            acc, _ = lax.scan(
+                body, init, (left.transpose(1, 0, 2), right.transpose(1, 0, 2))
+            )
+            return acc.astype(jnp.int32)
+
+        _pair_mxu_step = run
+    return _pair_mxu_step
+
+
+def pairwise_and_cardinality(
+    lefts: Sequence[RoaringBitmap],
+    rights: Sequence[RoaringBitmap],
+    tile_bytes: int = 256 << 20,
+    impl: str = "auto",
+) -> np.ndarray:
+    """``out[i, j] = |lefts[i] AND rights[j]|`` as one batched device
+    computation — the all-pairs overlap matrix behind similarity joins and
+    Jaccard analytics, which the reference can only assemble with n*m
+    pairwise andCardinality calls.
+
+    ``impl``: 'vpu' broadcasts AND + popcount (left axis tiled so the
+    [nb, m, K, 2048] intermediate stays under ``tile_bytes``); 'mxu'
+    expresses popcounts as 0/1 bf16 matmuls over the systolic array —
+    the shape that makes this matrix a native TPU workload. 'auto' picks
+    mxu on accelerators (when every cardinality is inside the exact-f32
+    bound), vpu on CPU."""
+    if impl not in ("auto", "vpu", "mxu"):
+        raise ValueError(f"impl must be 'auto', 'vpu', or 'mxu', got {impl!r}")
+    n, m = len(lefts), len(rights)
+    if n == 0 or m == 0:
+        return np.zeros((n, m), dtype=np.int64)
+    import jax
+    import jax.numpy as jnp
+
+    keys = sorted(
+        {k for c in lefts for k in c.high_low_container.keys}
+        & {k for c in rights for k in c.high_low_container.keys}
+    )
+    if not keys:  # no shared chunk: every intersection is empty
+        return np.zeros((n, m), dtype=np.int64)
+    if impl == "auto":
+        try:
+            on_acc = jax.default_backend() != "cpu"
+        except Exception:
+            on_acc = False
+        exact = all(
+            b.get_cardinality() < (1 << 24) for b in (*lefts, *rights)
+        )  # f32 accumulation exactness bound
+        impl = "mxu" if (on_acc and exact) else "vpu"
+    kidx = {k: i for i, k in enumerate(keys)}
+    lw = _pack_sets(lefts, keys, kidx)
+    rw_host = _pack_sets(rights, keys, kidx)
+    if impl == "mxu":
+        return (
+            np.asarray(_pairwise_mxu_step()(jnp.asarray(lw), jnp.asarray(rw_host)))
+            .astype(np.int64)
+        )
+    rw = jnp.asarray(rw_host)
+    step = _pairwise_step()
+    per_row = 4 * m * len(keys) * dev.DEVICE_WORDS
+    nb = max(1, min(n, tile_bytes // max(1, per_row)))
+    out = np.empty((n, m), dtype=np.int64)
+    for s in range(0, n, nb):
+        per_key = np.asarray(step(jnp.asarray(lw[s : s + nb]), rw))
+        out[s : s + nb] = per_key.astype(np.int64).sum(axis=2)
+    return out
+
+
+def pairwise_jaccard(
+    lefts: Sequence[RoaringBitmap], rights: Sequence[RoaringBitmap]
+) -> np.ndarray:
+    """``out[i, j] = |L_i & R_j| / |L_i | R_j|`` (0 for two empty sets):
+    the similarity matrix via one intersection-matrix dispatch plus
+    inclusion-exclusion from the per-set cardinalities."""
+    inter = pairwise_and_cardinality(lefts, rights).astype(np.float64)
+    lc = np.array([b.get_cardinality() for b in lefts], dtype=np.float64)
+    rc = np.array([b.get_cardinality() for b in rights], dtype=np.float64)
+    union = lc[:, None] + rc[None, :] - inter
+    with np.errstate(invalid="ignore"):
+        sim = np.where(union > 0, inter / np.maximum(union, 1e-300), 0.0)
+    return sim

@@ -114,6 +114,26 @@ def main():
     )
     assert np.array_equal(vals[ends], want_ends), "segmented large-N mismatch"
     print("segmented pallas large-N: OK")
+
+    # pairwise overlap matrix: the MXU bit-matmul vs the VPU broadcast
+    from roaringbitmap_tpu import RoaringBitmap
+    from roaringbitmap_tpu.parallel import batch
+
+    srng = np.random.default_rng(7)
+    sets = [
+        RoaringBitmap(np.unique(srng.integers(0, 1 << 22, 5000)).astype(np.uint32))
+        for _ in range(128)
+    ]
+    L, R = sets[:64], sets[64:]
+    t0 = time.time()
+    mx = batch.pairwise_and_cardinality(L, R, impl="mxu")
+    print(f"pairwise MXU 64x64 compile+run: {time.time()-t0:.1f}s")
+    t0 = time.time()
+    mx2 = batch.pairwise_and_cardinality(L, R, impl="mxu")
+    t_mxu = time.time() - t0
+    vp = batch.pairwise_and_cardinality(L, R, impl="vpu")
+    assert mx.tolist() == vp.tolist() == mx2.tolist(), "pairwise matrix mismatch"
+    print(f"pairwise matrix MXU==VPU: OK (mxu steady {t_mxu*1e3:.0f} ms per dispatch)")
     print("dispatch counts:", dict(pk.DISPATCH_COUNTS))
 
 

@@ -79,6 +79,27 @@ def test_engine_dispatch_through_mesh():
         assert got == want, op
 
 
+def test_cardinality_only_through_mesh():
+    """Count-only engines ride the ICI-sharded reduce when a mesh is set,
+    fetching only the per-group counts (cards_only)."""
+    from roaringbitmap_tpu import FastAggregation, RoaringBitmap
+    from roaringbitmap_tpu.parallel import sharding
+    from roaringbitmap_tpu.parallel.aggregation import config
+
+    rng = np.random.default_rng(59)
+    bms = [
+        RoaringBitmap(np.unique(rng.integers(0, 1 << 19, 3000)).astype(np.uint32))
+        for _ in range(24)
+    ]
+    want = FastAggregation.naive_or(*bms).get_cardinality()
+    config.mesh = sharding.make_mesh(8, words_axis=2)
+    try:
+        got = FastAggregation.or_cardinality(*bms, mode="device")
+    finally:
+        config.mesh = None
+    assert got == want
+
+
 def test_distributed_bsi_range_through_mesh():
     """BSI RANGE compares ride the mesh too (dual-walk bits [2, S])."""
     from roaringbitmap_tpu.models.bsi import Operation, RoaringBitmapSliceIndex
