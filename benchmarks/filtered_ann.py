@@ -78,24 +78,25 @@ def run(reps: int = 5, **_) -> List[Result]:
 
     # many-vs-many: the all-pairs overlap matrix (similarity join). The
     # reference's only expression of this is an n*m pairwise loop.
-    # (smoke configs may carry fewer than 48 candidates — halve whatever
-    # is there so n_pairs is never zero)
-    half = max(1, min(24, len(cand_bitmaps) // 2))
-    pair_left = cand_bitmaps[:half]
-    pair_right = cand_bitmaps[half : 2 * half]
+    # (needs at least two candidates to form a left/right split)
+    half = min(24, len(cand_bitmaps) // 2)
+    if half >= 1:
+        pair_left = cand_bitmaps[:half]
+        pair_right = cand_bitmaps[half : 2 * half]
 
-    def matrix_device():
-        return batch.pairwise_and_cardinality(pair_left, pair_right)
+        def matrix_device():
+            return batch.pairwise_and_cardinality(pair_left, pair_right)
 
-    def matrix_cpu_loop():
-        return [
-            [RoaringBitmap.and_cardinality(a, b) for b in pair_right]
-            for a in pair_left
-        ]
+        def matrix_cpu_loop():
+            return [
+                [RoaringBitmap.and_cardinality(a, b) for b in pair_right]
+                for a in pair_left
+            ]
 
-    got = matrix_device()
-    assert got.tolist() == matrix_cpu_loop(), "pairwise matrix mismatch"
-    n_pairs = len(pair_left) * len(pair_right)
-    bench("pairwiseMatrixDevice24x24", matrix_device, per=n_pairs)
-    bench("pairwiseMatrixCpuLoop24x24", matrix_cpu_loop, per=n_pairs)
+        got = matrix_device()
+        assert got.tolist() == matrix_cpu_loop(), "pairwise matrix mismatch"
+        n_pairs = len(pair_left) * len(pair_right)
+        shape = f"{half}x{half}"
+        bench(f"pairwiseMatrixDevice{shape}", matrix_device, per=n_pairs)
+        bench(f"pairwiseMatrixCpuLoop{shape}", matrix_cpu_loop, per=n_pairs)
     return out

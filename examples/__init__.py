@@ -17,6 +17,7 @@ EXAMPLES = [
     "interval_check",
     "range_index",
     "bsi_queries",
+    "similarity_matrix",
     "observability",
     "memory_mapping",
     "paged_iterator",

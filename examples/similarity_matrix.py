@@ -1,0 +1,48 @@
+"""All-pairs overlap and Jaccard similarity matrices over bitmap sets —
+the similarity-join workload. The reference library can only assemble
+this with n*m pairwise andCardinality calls; here the whole matrix is one
+batched computation, and on TPU the counts are literally matmuls on the
+systolic array (popcount(a AND b) == bits(a)·bits(b) over 0/1 vectors)."""
+
+import numpy as np
+
+from roaringbitmap_tpu import RoaringBitmap
+from roaringbitmap_tpu.parallel.batch import (
+    pairwise_and_cardinality,
+    pairwise_jaccard,
+)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    # users-per-tag bitmaps: heavy overlap inside topic clusters
+    n_users = 200_000
+    clusters = [rng.choice(n_users, size=30_000, replace=False) for _ in range(3)]
+    tags = []
+    for t in range(12):
+        base = clusters[t % 3]
+        take = rng.random(base.size) < 0.6
+        extra = rng.choice(n_users, size=2_000, replace=False)
+        tags.append(
+            RoaringBitmap(np.unique(np.concatenate([base[take], extra])).astype(np.uint32))
+        )
+
+    overlap = pairwise_and_cardinality(tags, tags)
+    sim = pairwise_jaccard(tags, tags)
+    print("overlap diagonal == cardinalities:",
+          bool(np.all(overlap.diagonal() == [t.get_cardinality() for t in tags])))
+
+    # most similar distinct pair
+    np.fill_diagonal(sim, 0.0)
+    i, j = np.unravel_index(np.argmax(sim), sim.shape)
+    print(f"most similar tags: {i} ~ {j} (jaccard {sim[i, j]:.3f}, "
+          f"same cluster: {i % 3 == j % 3})")
+
+    # sanity vs a pairwise loop on one row
+    want = [RoaringBitmap.and_cardinality(tags[0], t) for t in tags]
+    assert overlap[0].tolist() == want
+    print("row 0 matches pairwise loop:", overlap[0, :4].tolist(), "...")
+
+
+if __name__ == "__main__":
+    main()

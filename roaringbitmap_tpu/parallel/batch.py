@@ -261,15 +261,20 @@ def pairwise_and_cardinality(
     )
     if not keys:  # no shared chunk: every intersection is empty
         return np.zeros((n, m), dtype=np.int64)
+    def _exact():  # f32 accumulation exactness bound for the bit-matmul
+        return all(b.get_cardinality() < (1 << 24) for b in (*lefts, *rights))
+
     if impl == "auto":
         try:
             on_acc = jax.default_backend() != "cpu"
         except Exception:
             on_acc = False
-        exact = all(
-            b.get_cardinality() < (1 << 24) for b in (*lefts, *rights)
-        )  # f32 accumulation exactness bound
-        impl = "mxu" if (on_acc and exact) else "vpu"
+        impl = "mxu" if (on_acc and _exact()) else "vpu"
+    elif impl == "mxu" and not _exact():
+        raise ValueError(
+            "impl='mxu' needs every cardinality < 2^24 (f32 accumulation "
+            "exactness); use impl='vpu' or 'auto' for larger sets"
+        )
     kidx = {k: i for i, k in enumerate(keys)}
     lw = _pack_sets(lefts, keys, kidx)
     rw_host = _pack_sets(rights, keys, kidx)
