@@ -48,6 +48,14 @@ from .models.iterators import (
 from .serialization import InvalidRoaringFormat
 from .parallel.aggregation import FastAggregation, ParallelAggregation
 from .parallel.aggregation64 import FastAggregation64
+from .parallel.batch import (
+    batched_cardinality,
+    batched_intersects,
+    batched_op,
+    pairwise_and_cardinality,
+    pairwise_jaccard,
+    prepare_batched_cardinality,
+)
 from . import insights
 from . import fuzz
 
@@ -85,6 +93,12 @@ __all__ = [
     "ParallelAggregation",
     "BufferFastAggregation",
     "BufferParallelAggregation",
+    "batched_cardinality",
+    "batched_intersects",
+    "batched_op",
+    "prepare_batched_cardinality",
+    "pairwise_and_cardinality",
+    "pairwise_jaccard",
     "insights",
     "fuzz",
 ]
