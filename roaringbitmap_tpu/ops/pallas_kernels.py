@@ -157,10 +157,21 @@ def _make_wide_kernel(op):
     return kernel
 
 
-def _make_grouped_kernel(op):
+def _make_grouped_kernel(op, fold: str = "log"):
+    # fold="log": halving fold (log2(row_tile) vector ops over shrinking
+    # temporaries). fold="linear": straight accumulate (row_tile-1 ops, no
+    # temporaries) — staged to measure whether the log-fold's VMEM
+    # temporaries are what keeps the Pallas grid behind XLA's reduce
+    # (BENCH_NOTES per-tile table: 137 vs 423 GB/s at the flagship shape).
     def kernel(seed_ref, x_ref, o_ref):
         mi = pl.program_id(1)
-        tile = _fold_axis(x_ref[...] ^ seed_ref[0], op, axis=1)  # [G_TILE, w]
+        x = x_ref[...] ^ seed_ref[0]
+        if fold == "linear":
+            tile = x[:, 0]
+            for r in range(1, x.shape[1]):
+                tile = op(tile, x[:, r])
+        else:
+            tile = _fold_axis(x, op, axis=1)  # [G_TILE, w]
 
         @pl.when(mi == 0)
         def _init():
@@ -227,7 +238,9 @@ def wide_reduce_cardinality_pallas(
     return red, card
 
 
-@functools.partial(jax.jit, static_argnames=("op", "interpret", "g_tile", "row_tile"))
+@functools.partial(
+    jax.jit, static_argnames=("op", "interpret", "g_tile", "row_tile", "fold")
+)
 def grouped_reduce_pallas(
     words3,
     op: str = "or",
@@ -235,6 +248,7 @@ def grouped_reduce_pallas(
     g_tile: int = G_TILE,
     row_tile: int = G_ROW_TILE,
     seed=None,
+    fold: str = "log",
 ):
     """Padded grouped reduce ``[G, M, 2048] -> [G, 2048]`` as one kernel.
 
@@ -243,6 +257,8 @@ def grouped_reduce_pallas(
     across its row tiles (TPU grids run sequentially). This is the device
     analogue of ParallelAggregation's per-key fold, all keys in one launch.
     ``seed``: see wide_reduce_pallas (runtime value must be 0)."""
+    if fold not in ("log", "linear"):
+        raise ValueError(f"fold must be 'log' or 'linear', got {fold!r}")
     fn = {"or": lax.bitwise_or, "and": lax.bitwise_and, "xor": lax.bitwise_xor}[op]
     g, m, w = words3.shape
     plan = grouped_plan(g, m, w, g_tile, row_tile)
@@ -255,7 +271,7 @@ def grouped_reduce_pallas(
     if seed is None:
         seed = jnp.uint32(0)
     out = pl.pallas_call(
-        _make_grouped_kernel(fn),
+        _make_grouped_kernel(fn, fold),
         out_shape=jax.ShapeDtypeStruct(plan["out_array"], words3.dtype),
         grid=plan["grid"],
         in_specs=[
@@ -270,7 +286,9 @@ def grouped_reduce_pallas(
     return out[:g]
 
 
-@functools.partial(jax.jit, static_argnames=("op", "interpret", "g_tile", "row_tile"))
+@functools.partial(
+    jax.jit, static_argnames=("op", "interpret", "g_tile", "row_tile", "fold")
+)
 def grouped_reduce_cardinality_pallas(
     words3,
     op: str = "or",
@@ -278,10 +296,17 @@ def grouped_reduce_cardinality_pallas(
     g_tile: int = G_TILE,
     row_tile: int = G_ROW_TILE,
     seed=None,
+    fold: str = "log",
 ):
     """Fused grouped reduce + per-group cardinality."""
     red = grouped_reduce_pallas(
-        words3, op=op, interpret=interpret, g_tile=g_tile, row_tile=row_tile, seed=seed
+        words3,
+        op=op,
+        interpret=interpret,
+        g_tile=g_tile,
+        row_tile=row_tile,
+        seed=seed,
+        fold=fold,
     )
     card = jnp.sum(lax.population_count(red).astype(jnp.int32), axis=-1)
     return red, card

@@ -96,16 +96,17 @@ def main():
                 if 2 * block > VMEM_BUDGET:
                     print(f"  pallas gt={g_tile:<3} rt={row_tile:<5} skipped (VMEM)", flush=True)
                     continue
-                t = _time(
-                    lambda w, s, gt=g_tile, rt=row_tile: pk.grouped_reduce_cardinality_pallas(
-                        w, op="or", g_tile=gt, row_tile=rt, seed=s
-                    ),
-                    arr3,
-                )
-                print(
-                    f"  pallas gt={g_tile:<3} rt={row_tile:<5} {t*1e3:8.3f} ms  {nbytes/t/1e9:7.1f} GB/s",
-                    flush=True,
-                )
+                for fold in ("log", "linear"):
+                    t = _time(
+                        lambda w, s, gt=g_tile, rt=row_tile, f=fold: pk.grouped_reduce_cardinality_pallas(
+                            w, op="or", g_tile=gt, row_tile=rt, seed=s, fold=f
+                        ),
+                        arr3,
+                    )
+                    print(
+                        f"  pallas gt={g_tile:<3} rt={row_tile:<3} {fold:<6} {t*1e3:8.3f} ms  {nbytes/t/1e9:7.1f} GB/s",
+                        flush=True,
+                    )
 
 
 if __name__ == "__main__":

@@ -389,3 +389,27 @@ def test_segmented_pallas_unflagged_prefix_matches_xla():
             pk.segmented_reduce_pallas(jnp.asarray(host), jnp.asarray(seg), op=op, interpret=True)
         )
         assert np.array_equal(got, want), op
+
+
+@pytest.mark.parametrize("op,npop", [("or", np.bitwise_or), ("and", np.bitwise_and), ("xor", np.bitwise_xor)])
+def test_grouped_pallas_linear_fold_interpret(op, npop):
+    """fold='linear' (the staged accumulate variant) == fold='log' == numpy
+    (interpret mode; the on-chip comparison lives in scripts/tile_sweep.py)."""
+    import jax.numpy as jnp
+
+    from roaringbitmap_tpu.ops import pallas_kernels as pk
+
+    if not pk.HAS_PALLAS:
+        pytest.skip("pallas unavailable")
+    rng = np.random.default_rng(71)
+    host = rng.integers(0, 1 << 32, size=(5, 9, 2048), dtype=np.uint64).astype(np.uint32)
+    want = npop.reduce(host, axis=1)
+    want_cards = [int(np.unpackbits(want[g].view(np.uint8)).sum()) for g in range(5)]
+    for fold in ("log", "linear"):
+        red, cards = pk.grouped_reduce_cardinality_pallas(
+            jnp.asarray(host), op=op, interpret=True, fold=fold
+        )
+        assert np.array_equal(np.asarray(red), want), (op, fold)
+        assert np.asarray(cards).tolist() == want_cards, (op, fold)
+    with pytest.raises(ValueError):
+        pk.grouped_reduce_pallas(jnp.asarray(host), op=op, interpret=True, fold="lin")
