@@ -32,13 +32,32 @@ device tensor. The container walk serves selective/context queries and
 mapped indexes, where decoding everything for one chunk's answer would waste
 more than it saves.
 
-Serialized layout (this framework's sealed form; cookie and field order
-modeled on RangeBitmap.java:25's 0xF00D header, with RoaringFormatSpec
-payloads instead of the Java-internal container stream — the reference's
-exact byte layout is a JVM implementation detail, not a cross-language spec):
-uint16 cookie 0xF00D, uint8 base(=2), uint8 sliceCount, uint64 maxValue,
-uint32 maxRid, then per-slice uint32 length + RoaringFormatSpec bytes.
-Values are unsigned 64-bit.
+Serialized layout — **byte-compatible with the reference** (VERDICT r3 #6).
+The default wire format is the reference's sealed form
+(RangeBitmap.java:1483-1520 Appender.serialize / :66-96 map):
+
+* header (10 bytes LE): u16 cookie 0xF00D, u8 base(=2), u8 sliceCount,
+  u16 maxKey (chunk count), u32 maxRid;
+* per-chunk slice masks: maxKey * ceil(sliceCount/8) bytes, each mask the
+  little-endian truncation of the u64 whose bit ``i`` says chunk has a
+  container for slice ``i``;
+* container stream, ascending (chunk, slice): u8 type (0=bitmap, 1=run,
+  2=array); bitmap: u16 cardinality (wraps at 2^16) + 8192 word bytes;
+  run: u16 nruns + nruns x (u16 start, u16 length); array: u16 count +
+  count x u16 values.
+
+Reference slices store the **complement**: slice ``i`` holds rid iff bit
+``i`` of the value is 0 (RangeBitmap.java:1510 ``~value & rangeMask``) —
+the encoding that makes lte evaluation one andNot chain. This module keeps
+value-bit slices internally (they are what the shared BSI device engine
+consumes) and inverts per chunk container at the wire boundary
+(``universe andnot c`` both ways — an involution, so round-trips are exact).
+
+``map()`` also still reads this framework's round-3 native form (u16 cookie,
+u8 base, u8 sliceCount, u64 maxValue, u32 maxRid, then per-slice u32 length
++ RoaringFormatSpec bytes), distinguished by strict stream validation;
+``serialize(form="native")`` still writes it. Values are unsigned 64-bit;
+the reference format caps sliceCount at 64 likewise.
 """
 
 from __future__ import annotations
@@ -57,6 +76,171 @@ from .roaring_array import RoaringArray
 COOKIE = 0xF00D  # RangeBitmap.java:25
 CHUNK = 1 << 16
 _MAX64 = 1 << 64
+# reference container stream type codes (RangeBitmap.java:26-28)
+J_BITMAP, J_RUN, J_ARRAY = 0, 1, 2
+
+
+def _encode_java_container(c: Container) -> bytes:
+    """One container in the reference stream form (RangeBitmap.java:1553-1580):
+    u8 type, then bitmap: u16 cardinality (wraps at 2^16) + 8192 word bytes;
+    run: u16 nruns + (start, length) u16 pairs; array: u16 count + u16 values."""
+    from .container import ArrayContainer, BitmapContainer, RunContainer
+
+    if isinstance(c, BitmapContainer):
+        return (
+            struct.pack("<BH", J_BITMAP, c.cardinality & 0xFFFF)
+            + c.words.astype("<u8", copy=False).tobytes()
+        )
+    if isinstance(c, RunContainer):
+        pairs = np.empty(2 * c.starts.size, dtype="<u2")
+        pairs[0::2] = c.starts
+        pairs[1::2] = c.lengths
+        return struct.pack("<BH", J_RUN, c.starts.size) + pairs.tobytes()
+    assert isinstance(c, ArrayContainer), type(c)
+    return (
+        struct.pack("<BH", J_ARRAY, c.content.size)
+        + c.content.astype("<u2", copy=False).tobytes()
+    )
+
+
+def _java_wire_container(comp: Container, slice_idx: int) -> bytes:
+    """Byte-exact form choice of the reference appender's flush.
+
+    The appender grows slices 0-4 as BitmapContainers and slices >= 5 as
+    RunContainers (containerForSlice, RangeBitmap.java:1608-1613), then
+    serializes ``container.runOptimize()`` (:1552) — and the two classes
+    optimize differently:
+
+    * BitmapContainer.runOptimize (BitmapContainer.java:1227-1245) only
+      ever converts bitmap -> run (when 2+4*nruns < 8192); it never
+      produces an array, however small the cardinality;
+    * RunContainer.runOptimize -> toEfficientContainer (RunContainer.java)
+      keeps the run iff 2+4*nruns <= min(8192, 2+2*card) (ties keep run),
+      else array iff card <= 4096 (toBitmapOrArrayContainer) else bitmap.
+
+    Replicating the rule (not just "smallest form") is what makes the
+    emitted stream byte-identical to a Java-sealed RangeBitmap."""
+    from .container import ArrayContainer, BitmapContainer, RunContainer
+
+    run = comp if isinstance(comp, RunContainer) else RunContainer.from_values(comp.to_array())
+    card = comp.cardinality
+    run_size = 2 + 4 * run.num_runs()
+    if slice_idx >= 5:
+        if run_size <= min(8192, 2 + 2 * card):
+            choice: Container = run
+        elif card <= 4096:
+            choice = (
+                comp
+                if isinstance(comp, ArrayContainer)
+                else ArrayContainer(comp.to_array())
+            )
+        else:
+            choice = (
+                comp if isinstance(comp, BitmapContainer) else BitmapContainer(comp.to_words())
+            )
+    else:
+        if run_size < 8192:
+            choice = run
+        else:
+            choice = (
+                comp if isinstance(comp, BitmapContainer) else BitmapContainer(comp.to_words())
+            )
+    return _encode_java_container(choice)
+
+
+def _decode_java_container(buf: memoryview, t: int, off: int) -> Container:
+    """Decode one directory entry (type + payload offset past the type byte)."""
+    from .container import ArrayContainer, BitmapContainer, RunContainer
+
+    if t == J_BITMAP:
+        words = np.frombuffer(buf, dtype="<u8", count=1024, offset=off + 2)
+        return BitmapContainer(words.astype(np.uint64, copy=False))
+    if t == J_RUN:
+        (n_runs,) = struct.unpack_from("<H", buf, off)
+        pairs = np.frombuffer(buf, dtype="<u2", count=2 * n_runs, offset=off + 2)
+        starts, lengths = pairs[0::2], pairs[1::2]
+        s64 = starts.astype(np.int64)
+        ends = s64 + lengths.astype(np.int64)
+        if n_runs and (np.any(s64[1:] <= ends[:-1]) or np.any(ends > 0xFFFF)):
+            raise InvalidRoaringFormat("invalid run container in RangeBitmap stream")
+        return RunContainer(starts, lengths)
+    (card,) = struct.unpack_from("<H", buf, off)
+    values = np.frombuffer(buf, dtype="<u2", count=card, offset=off + 2)
+    if card and np.any(np.diff(values.astype(np.int64)) <= 0):
+        raise InvalidRoaringFormat("unsorted array container in RangeBitmap stream")
+    return ArrayContainer(values)
+
+
+class _JavaMap:
+    """Lazily mapped reference-format buffer: the parsed header plus a
+    (slice, chunk) -> (type, offset) directory built by one validating walk
+    over the container stream (no payload decode — the reference map()'s
+    "minimal allocation" contract, RangeBitmap.java:60-96)."""
+
+    __slots__ = ("buf", "slice_count", "n_chunks", "max_rid", "directory", "end")
+
+    def __init__(self, buffer) -> None:
+        buf = memoryview(buffer).cast("B")
+        if len(buf) < 10:
+            raise InvalidRoaringFormat("truncated RangeBitmap header")
+        cookie, base, slice_count, n_chunks, max_rid = struct.unpack_from("<HBBHI", buf, 0)
+        if cookie != COOKIE:
+            raise InvalidRoaringFormat(f"invalid RangeBitmap cookie {cookie:#x}")
+        if base != 2:
+            raise InvalidRoaringFormat(f"unsupported base {base}")
+        if slice_count < 1 or slice_count > 64:
+            raise InvalidRoaringFormat(f"implausible slice count {slice_count}")
+        # a sealed appender always has key == ceil(rid / 2^16) chunks
+        # (RangeBitmap.java:1530 append() per 2^16 rids) — the check that
+        # cheaply rejects this framework's native form, whose bytes 4..9
+        # hold maxValue instead
+        if n_chunks != (max_rid + CHUNK - 1) // CHUNK:
+            raise InvalidRoaringFormat("chunk count inconsistent with maxRid")
+        bpm = (slice_count + 7) >> 3
+        masks_off = 10
+        pos = masks_off + n_chunks * bpm
+        if pos > len(buf):
+            raise InvalidRoaringFormat("truncated slice masks")
+        directory = {}
+        for key in range(n_chunks):
+            mask = int.from_bytes(buf[masks_off + key * bpm : masks_off + (key + 1) * bpm], "little")
+            i = 0
+            while mask:
+                if mask & 1:
+                    if pos + 3 > len(buf):
+                        raise InvalidRoaringFormat("truncated container stream")
+                    t = buf[pos]
+                    if t == J_BITMAP:
+                        size = 3 + 8192
+                    elif t == J_RUN:
+                        (n_runs,) = struct.unpack_from("<H", buf, pos + 1)
+                        size = 3 + 4 * n_runs
+                    elif t == J_ARRAY:
+                        (card,) = struct.unpack_from("<H", buf, pos + 1)
+                        size = 3 + 2 * card
+                    else:
+                        raise InvalidRoaringFormat(f"invalid container type {t}")
+                    if pos + size > len(buf):
+                        raise InvalidRoaringFormat("container payload out of bounds")
+                    directory[(i, key)] = (t, pos + 1)
+                    pos += size
+                mask >>= 1
+                i += 1
+        # exact-extent contract (Appender.serialize writes exactly
+        # serializedSizeInBytes bytes): trailing bytes mean this is not a
+        # reference-format buffer — notably a native-form buffer with
+        # maxValue == 0, whose first 10 bytes alone would parse as an empty
+        # reference map and silently drop every row (code-review r4)
+        if pos != len(buf):
+            raise InvalidRoaringFormat(
+                f"trailing bytes after container stream ({len(buf) - pos})"
+            )
+        self.buf = buf
+        self.slice_count = slice_count
+        self.n_chunks = n_chunks
+        self.max_rid = max_rid
+        self.directory = directory
+        self.end = pos
 
 
 class RangeBitmap:
@@ -69,9 +253,12 @@ class RangeBitmap:
         max_value: int,
         max_rid: int,
         payloads: Optional[List[bytes]] = None,
+        java_map: Optional[_JavaMap] = None,
     ):
         self._slices = slices  # per-slice bitmap, or None when lazily mapped
-        self._payloads = payloads  # mapped: raw RoaringFormatSpec bytes per slice
+        self._payloads = payloads  # native-mapped: RoaringFormatSpec bytes per slice
+        self._jmap = java_map  # reference-format map: lazy container directory
+        self._jcache: dict = {}  # (slice, key) -> value-bit Container
         self._max_value = int(max_value)
         self._max_rid = int(max_rid)  # number of rows
         self._bsi: Optional[RoaringBitmapSliceIndex] = None
@@ -89,8 +276,35 @@ class RangeBitmap:
     def map(buffer: Union[bytes, bytearray, memoryview]) -> "RangeBitmap":
         """Open a sealed buffer (RangeBitmap.map, RangeBitmap.java:66).
 
-        O(slice directory): payload bytes are retained as views and decoded
-        zero-copy per slice on first access."""
+        Accepts both the reference wire format (the default ``serialize``
+        output — byte-compatible with a Java-sealed RangeBitmap) and this
+        framework's round-3 native form. Either way the open is lazy:
+        O(header + container directory), payload bytes stay views and
+        containers decode zero-copy on first access. The two headers are
+        disambiguated by strict validation — the reference header pins
+        ``maxKey == ceil(maxRid / 2^16)`` plus exact stream bounds, which
+        native-form bytes (maxValue u64 in those positions) cannot satisfy."""
+        try:
+            jm = _JavaMap(buffer)
+            return RangeBitmap(
+                [None] * jm.slice_count,
+                (1 << jm.slice_count) - 1,  # rangeMask implied by sliceCount
+                jm.max_rid,
+                java_map=jm,
+            )
+        except InvalidRoaringFormat as java_err:
+            try:
+                return RangeBitmap._map_native(buffer)
+            except InvalidRoaringFormat as native_err:
+                raise InvalidRoaringFormat(
+                    f"not a RangeBitmap in either format "
+                    f"(reference: {java_err}; native: {native_err})"
+                ) from None
+
+    @staticmethod
+    def _map_native(buffer: Union[bytes, bytearray, memoryview]) -> "RangeBitmap":
+        """The round-3 native form: u64 maxValue header + whole-slice
+        RoaringFormatSpec payloads."""
         buf = memoryview(buffer)
         if len(buf) < 16:
             raise InvalidRoaringFormat("truncated RangeBitmap header")
@@ -123,18 +337,55 @@ class RangeBitmap:
     def _slice_count(self) -> int:
         return len(self._slices)
 
+    def _chunk_rows(self, key: int) -> int:
+        return min(CHUNK, self._max_rid - key * CHUNK)
+
     def _slice(self, i: int) -> RoaringBitmap:
         """Slice bitmap, decoding a mapped payload zero-copy on first use."""
         s = self._slices[i]
         if s is None:
-            from .immutable import ImmutableRoaringBitmap
+            if self._jmap is not None:
+                # assemble the value-bit slice from the chunk directory
+                # (decodes slice i's containers; the batch/BSI path needs
+                # the whole slice, same as the reference's full evaluation)
+                arr = RoaringArray()
+                for key in range((self._max_rid + CHUNK - 1) // CHUNK):
+                    c = self._slice_container(i, key)
+                    if c is not None and c.cardinality:
+                        arr.append(key, c)
+                s = RoaringBitmap()
+                s.high_low_container = arr
+            else:
+                from .immutable import ImmutableRoaringBitmap
 
-            s = ImmutableRoaringBitmap(self._payloads[i])
+                s = ImmutableRoaringBitmap(self._payloads[i])
             self._slices[i] = s
         return s
 
     def _slice_container(self, i: int, key: int) -> Optional[Container]:
-        return self._slice(i).high_low_container.get_container(key)
+        """Value-bit container of slice ``i`` in chunk ``key`` (None = no
+        rows in the chunk have bit i set). Reference-format maps store the
+        complement (RangeBitmap.java:1510), inverted here on first decode:
+        an absent directory entry means *every* row has bit i set."""
+        if self._jmap is None:
+            return self._slice(i).high_low_container.get_container(key)
+        ck = (i, key)
+        if ck in self._jcache:
+            return self._jcache[ck]
+        chunk_rows = self._chunk_rows(key)
+        if chunk_rows <= 0:
+            return None
+        entry = self._jmap.directory.get(ck)
+        universe = container_range_of_ones(0, chunk_rows)
+        if entry is None:  # complement empty: all rows have bit i set
+            c = universe
+        else:
+            comp = _decode_java_container(self._jmap.buf, *entry)
+            c = universe.andnot(comp)
+            if c.cardinality == 0:
+                c = None
+        self._jcache[ck] = c
+        return c
 
     def _bsi_index(self) -> RoaringBitmapSliceIndex:
         """The whole-index view used by context-free queries (the fused
@@ -153,7 +404,60 @@ class RangeBitmap:
     # ------------------------------------------------------------------
     # serialization
     # ------------------------------------------------------------------
-    def serialize(self) -> bytes:
+    def serialize(self, form: Optional[str] = None) -> bytes:
+        """Sealed bytes. ``form=None`` re-emits the backing store's format
+        without decoding (reference-format and native maps pass their bytes
+        through; heap indexes default to the reference format).
+        ``form="java"`` / ``form="native"`` force the respective layout."""
+        if form not in (None, "java", "native"):
+            raise ValueError(f"form must be 'java' or 'native', got {form!r}")
+        if form is None:
+            if self._jmap is not None:
+                return bytes(self._jmap.buf[: self._jmap.end])
+            if self._payloads is not None:
+                return self._serialize_native()
+            form = "java"
+        if form == "java":
+            if self._jmap is not None:
+                return bytes(self._jmap.buf[: self._jmap.end])
+            return self._serialize_java()
+        return self._serialize_native()
+
+    def _serialize_java(self) -> bytes:
+        """Encode the reference wire format (Appender.serialize,
+        RangeBitmap.java:1483-1520): complement containers per (chunk,
+        slice), run-optimized like the reference's flush (:1552)."""
+        n_chunks = (self._max_rid + CHUNK - 1) // CHUNK
+        if n_chunks > 0xFFFF:
+            # the reference header's maxKey is a u16 (RangeBitmap.java:1494);
+            # fail actionably up front instead of a struct.error after
+            # walking 65536 chunks (code-review r4)
+            raise ValueError(
+                f"{self._max_rid} rows = {n_chunks} chunks exceeds the "
+                "reference wire format's u16 chunk count; use "
+                "serialize(form='native')"
+            )
+        bpm = (self._slice_count + 7) >> 3
+        masks = bytearray()
+        stream = bytearray()
+        for key in range(n_chunks):
+            universe = container_range_of_ones(0, self._chunk_rows(key))
+            mask = 0
+            for i in range(self._slice_count):
+                si = self._slice_container(i, key)
+                comp = universe if si is None else universe.andnot(si)
+                if comp.cardinality == 0:
+                    continue
+                mask |= 1 << i
+                stream += _java_wire_container(comp, i)
+            masks += mask.to_bytes(bpm, "little")
+        return (
+            struct.pack("<HBBHI", COOKIE, 2, self._slice_count, n_chunks, self._max_rid)
+            + bytes(masks)
+            + bytes(stream)
+        )
+
+    def _serialize_native(self) -> bytes:
         parts = [
             struct.pack("<HBB", COOKIE, 2, self._slice_count),
             struct.pack("<Q", self._max_value),
@@ -163,20 +467,28 @@ class RangeBitmap:
             if self._payloads is not None:
                 payload = bytes(self._payloads[i])  # mapped: no decode
             else:
-                payload = self._slices[i].serialize()
+                payload = self._slice(i).serialize()
             parts.append(struct.pack("<I", len(payload)))
             parts.append(payload)
         return b"".join(parts)
 
-    def serialized_size_in_bytes(self) -> int:
+    def serialized_size_in_bytes(self, form: Optional[str] = None) -> int:
         from ..serialization import serialized_size_in_bytes
 
+        if form is None and self._jmap is not None:
+            return self._jmap.end
+        if form is None and self._payloads is None:
+            form = "java"
+        if form == "java":
+            return len(self.serialize(form="java"))
         total = 16
         for i in range(self._slice_count):
             if self._payloads is not None:
                 total += 4 + len(self._payloads[i])
             else:
-                total += 4 + serialized_size_in_bytes(self._slices[i])
+                # _slice (not _slices[i]): materializes reference-mapped
+                # slices, which are still None here (code-review r4)
+                total += 4 + serialized_size_in_bytes(self._slice(i))
         return total
 
     def __reduce__(self):
