@@ -60,58 +60,122 @@ _PROBED: Dict[Tuple, bool] = {}
 # ---------------------------------------------------------------------------
 
 
-def mosaic_block_ok(block_shape, array_shape) -> bool:
+def mosaic_block_ok(block_shape, array_shape, memory_space: str = "vmem") -> bool:
     """Mosaic's TPU block-mapping rule: the last two dims of a block shape
     must be divisible by (8, 128) respectively, or equal the corresponding
     overall array dim. (The round-2 BENCH crash was a (1, 2048) output block
-    over a [66, 2048] array violating exactly this.)"""
+    over a [66, 2048] array violating exactly this.)
+
+    ``memory_space="smem"`` additionally rejects *blocked* 1-D SMEM
+    operands: a 1-D s32[n] SMEM operand whose block is a strict slice of
+    the array passed this divisibility rule yet failed on real hardware
+    with an XLA(T(1024)) vs Mosaic(T(128)) tiled-layout mismatch (the
+    round-3 segmented-scan saga, BENCH_NOTES.md). SMEM operands must be
+    whole-array (block == array) — stream via program_id indexing inside
+    the kernel instead, as seg_plan's bit-packed flags do."""
     if len(block_shape) != len(array_shape):
         return False
     if len(block_shape) == 0:
         return True
     if len(block_shape) == 1:
+        if memory_space == "smem":
+            return tuple(block_shape) == tuple(array_shape)
         return block_shape[0] % 128 == 0 or block_shape[0] == array_shape[0]
     bs, bl = block_shape[-2], block_shape[-1]
     as_, al = array_shape[-2], array_shape[-1]
     return (bs % 8 == 0 or bs == as_) and (bl % 128 == 0 or bl == al)
 
 
-def wide_plan(n: int, w: int, row_tile: int = ROW_TILE):
-    """Block layout for the flat [N, w] -> [w] reduction."""
+def _check_w_tile(w_tile: int, w: int) -> None:
+    """A word-axis split must both divide the width and stay Mosaic-legal
+    as a block minor dim (% 128 — the round-2 crash class; catching it here
+    costs nothing, catching it on chip costs minutes of remote compile)."""
+    if w % w_tile:
+        raise ValueError(f"w_tile {w_tile} must divide the word width {w}")
+    if w_tile % 128:
+        raise ValueError(f"w_tile {w_tile} must be a multiple of 128 (Mosaic minor dim)")
+
+
+def wide_plan(n: int, w: int, row_tile: int = ROW_TILE, w_tile: int | None = None):
+    """Block layout for the flat [N, w] -> [w] reduction.
+
+    ``w_tile`` splits the word axis into an extra *outer* (parallel) grid
+    dim: smaller blocks pipeline DMA better on shapes where the one-column
+    grid stalls (the wide family's measured ~58 GB/s plateau, BENCH_NOTES)."""
     n_pad = n + (-n) % row_tile
+    if w_tile is None or w_tile >= w:
+        return {
+            "pad_rows": n_pad - n,
+            "grid": (n_pad // row_tile,),
+            "in_array": (n_pad, w),
+            "in_block": (row_tile, w),
+            "in_index": lambda i: (i, 0),
+            "out_array": (1, w),
+            "out_block": (1, w),  # block == array: legal by the full-dim clause
+            "out_index": lambda i: (0, 0),
+            "m_dim": 0,
+        }
+    _check_w_tile(w_tile, w)
     return {
         "pad_rows": n_pad - n,
-        "grid": (n_pad // row_tile,),
+        "grid": (w // w_tile, n_pad // row_tile),  # N innermost: accumulator
         "in_array": (n_pad, w),
-        "in_block": (row_tile, w),
-        "in_index": lambda i: (i, 0),
+        "in_block": (row_tile, w_tile),
+        "in_index": lambda wi, ni: (ni, wi),
         "out_array": (1, w),
-        "out_block": (1, w),  # block == array: legal by the full-dim clause
-        "out_index": lambda i: (0, 0),
+        "out_block": (1, w_tile),
+        "out_index": lambda wi, ni: (0, wi),
+        "m_dim": 1,
     }
 
 
 def grouped_plan(
-    g: int, m: int, w: int, g_tile: int = G_TILE, row_tile: int = G_ROW_TILE
+    g: int,
+    m: int,
+    w: int,
+    g_tile: int = G_TILE,
+    row_tile: int = G_ROW_TILE,
+    w_tile: int | None = None,
 ):
     """Block layout for the padded grouped [G, M, w] -> [G, w] reduction.
 
     The group axis is padded to a multiple of ``g_tile`` (8) so the output
     block (g_tile, w) satisfies Mosaic divisibility for any G; the M axis is
     innermost in the grid so each group-tile's output block stays resident
-    in VMEM as the accumulator across its row tiles."""
+    in VMEM as the accumulator across its row tiles.
+
+    ``w_tile`` adds a word-axis grid dim between G and M (both outer dims
+    are embarrassingly parallel; only M carries the accumulator), shrinking
+    each block by w/w_tile — staged against the measured 3x XLA gap at the
+    flagship [66, 1450, 2048] shape (VERDICT r3 #2: smaller double-buffered
+    blocks may pipeline HBM reads where the full-width grid could not)."""
     g_pad = g + (-g) % g_tile
     m_pad = m + (-m) % row_tile
+    if w_tile is None or w_tile >= w:
+        return {
+            "pad_groups": g_pad - g,
+            "pad_rows": m_pad - m,
+            "grid": (g_pad // g_tile, m_pad // row_tile),
+            "in_array": (g_pad, m_pad, w),
+            "in_block": (g_tile, row_tile, w),
+            "in_index": lambda gi, mi: (gi, mi, 0),
+            "out_array": (g_pad, w),
+            "out_block": (g_tile, w),
+            "out_index": lambda gi, mi: (gi, 0),
+            "m_dim": 1,
+        }
+    _check_w_tile(w_tile, w)
     return {
         "pad_groups": g_pad - g,
         "pad_rows": m_pad - m,
-        "grid": (g_pad // g_tile, m_pad // row_tile),
+        "grid": (g_pad // g_tile, w // w_tile, m_pad // row_tile),
         "in_array": (g_pad, m_pad, w),
-        "in_block": (g_tile, row_tile, w),
-        "in_index": lambda gi, mi: (gi, mi, 0),
+        "in_block": (g_tile, row_tile, w_tile),
+        "in_index": lambda gi, wi, mi: (gi, mi, wi),
         "out_array": (g_pad, w),
-        "out_block": (g_tile, w),
-        "out_index": lambda gi, mi: (gi, 0),
+        "out_block": (g_tile, w_tile),
+        "out_index": lambda gi, wi, mi: (gi, wi),
+        "m_dim": 2,
     }
 
 
@@ -138,13 +202,21 @@ def _fold_axis(x, op, axis: int):
     return lax.squeeze(x, (axis,))
 
 
-def _make_wide_kernel(op):
+def _make_wide_kernel(op, m_dim: int = 0, fold: str = "log"):
     # seed_ref: SMEM (1,) uint32 XOR'd into every loaded word — the fused
     # input-perturbation hook (production passes 0; steady-state timing
-    # passes a carry-dependent 0 so XLA cannot hoist the loop body)
+    # passes a carry-dependent 0 so XLA cannot hoist the loop body).
+    # m_dim: which grid dim walks the reduced (N) axis — 0 for the classic
+    # one-column grid, 1 when wide_plan splits the word axis.
     def kernel(seed_ref, x_ref, o_ref):
-        i = pl.program_id(0)
-        tile = _fold_axis(x_ref[...] ^ seed_ref[0], op, axis=0)
+        i = pl.program_id(m_dim)
+        x = x_ref[...] ^ seed_ref[0]
+        if fold == "linear":
+            tile = x[0]
+            for r in range(1, x.shape[0]):
+                tile = op(tile, x[r])
+        else:
+            tile = _fold_axis(x, op, axis=0)
 
         @pl.when(i == 0)
         def _init():
@@ -157,14 +229,16 @@ def _make_wide_kernel(op):
     return kernel
 
 
-def _make_grouped_kernel(op, fold: str = "log"):
+def _make_grouped_kernel(op, fold: str = "log", m_dim: int = 1):
     # fold="log": halving fold (log2(row_tile) vector ops over shrinking
     # temporaries). fold="linear": straight accumulate (row_tile-1 ops, no
     # temporaries) — staged to measure whether the log-fold's VMEM
     # temporaries are what keeps the Pallas grid behind XLA's reduce
     # (BENCH_NOTES per-tile table: 137 vs 423 GB/s at the flagship shape).
+    # m_dim: which grid dim walks the reduced (M) axis — 1 for the classic
+    # (G, M) grid, 2 when grouped_plan splits the word axis into (G, W, M).
     def kernel(seed_ref, x_ref, o_ref):
-        mi = pl.program_id(1)
+        mi = pl.program_id(m_dim)
         x = x_ref[...] ^ seed_ref[0]
         if fold == "linear":
             tile = x[:, 0]
@@ -184,14 +258,40 @@ def _make_grouped_kernel(op, fold: str = "log"):
     return kernel
 
 
+def _grid_compiler_params(plan, dimsem: bool):
+    """Optional Mosaic dimension-semantics hint: every grid dim except the
+    reduced (accumulator-carrying) one is embarrassingly parallel — output
+    blocks at different positions are disjoint. Staged as an opt-in so the
+    round-3-validated default lowering is untouched until the sweep measures
+    it (VERDICT r3 #2)."""
+    if not dimsem:
+        return None
+    sem = [
+        pltpu.GridDimensionSemantics.ARBITRARY
+        if d == plan["m_dim"]
+        else pltpu.GridDimensionSemantics.PARALLEL
+        for d in range(len(plan["grid"]))
+    ]
+    return pltpu.CompilerParams(dimension_semantics=sem)
+
+
 # ---------------------------------------------------------------------------
 # kernels
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("op", "interpret", "row_tile"))
+@functools.partial(
+    jax.jit, static_argnames=("op", "interpret", "row_tile", "w_tile", "fold", "dimsem")
+)
 def wide_reduce_pallas(
-    words, op: str = "or", interpret: bool = False, row_tile: int = ROW_TILE, seed=None
+    words,
+    op: str = "or",
+    interpret: bool = False,
+    row_tile: int = ROW_TILE,
+    seed=None,
+    w_tile: int | None = None,
+    fold: str = "log",
+    dimsem: bool = False,
 ):
     """Reduce ``[N, 2048]`` uint32 -> ``[2048]`` with a Pallas kernel.
 
@@ -200,10 +300,15 @@ def wide_reduce_pallas(
     the steady-state-timing hook: it is XOR'd into every loaded word inside
     the kernel, making a timing loop's body carry-dependent without an extra
     HBM pass (padded rows are perturbed too, so a nonzero seed would break
-    and/xor identity padding — hence the must-be-0 contract)."""
+    and/xor identity padding — hence the must-be-0 contract).
+
+    ``w_tile``/``fold``/``dimsem`` are the sweep-staged variants (wide_plan,
+    _make_wide_kernel, _grid_compiler_params)."""
+    if fold not in ("log", "linear"):
+        raise ValueError(f"fold must be 'log' or 'linear', got {fold!r}")
     fn = {"or": lax.bitwise_or, "and": lax.bitwise_and, "xor": lax.bitwise_xor}[op]
     n, w = words.shape
-    plan = wide_plan(n, w, row_tile)
+    plan = wide_plan(n, w, row_tile, w_tile)
     if plan["pad_rows"]:
         words = jnp.pad(
             words, ((0, plan["pad_rows"]), (0, 0)), constant_values=dev._INIT[op]
@@ -211,7 +316,7 @@ def wide_reduce_pallas(
     if seed is None:
         seed = jnp.uint32(0)
     out = pl.pallas_call(
-        _make_wide_kernel(fn),
+        _make_wide_kernel(fn, m_dim=plan["m_dim"], fold=fold),
         out_shape=jax.ShapeDtypeStruct(plan["out_array"], words.dtype),
         grid=plan["grid"],
         in_specs=[
@@ -221,25 +326,43 @@ def wide_reduce_pallas(
         out_specs=pl.BlockSpec(
             plan["out_block"], plan["out_index"], memory_space=pltpu.VMEM
         ),
+        compiler_params=_grid_compiler_params(plan, dimsem),
         interpret=interpret,
     )(jnp.reshape(seed.astype(words.dtype), (1,)), words)
     return out[0]
 
 
-@functools.partial(jax.jit, static_argnames=("op", "interpret", "row_tile"))
+@functools.partial(
+    jax.jit, static_argnames=("op", "interpret", "row_tile", "w_tile", "fold", "dimsem")
+)
 def wide_reduce_cardinality_pallas(
-    words, op: str = "or", interpret: bool = False, row_tile: int = ROW_TILE, seed=None
+    words,
+    op: str = "or",
+    interpret: bool = False,
+    row_tile: int = ROW_TILE,
+    seed=None,
+    w_tile: int | None = None,
+    fold: str = "log",
+    dimsem: bool = False,
 ):
     """Fused wide reduce + cardinality (popcount of the reduced row)."""
     red = wide_reduce_pallas(
-        words, op=op, interpret=interpret, row_tile=row_tile, seed=seed
+        words,
+        op=op,
+        interpret=interpret,
+        row_tile=row_tile,
+        seed=seed,
+        w_tile=w_tile,
+        fold=fold,
+        dimsem=dimsem,
     )
     card = jnp.sum(lax.population_count(red).astype(jnp.int32))
     return red, card
 
 
 @functools.partial(
-    jax.jit, static_argnames=("op", "interpret", "g_tile", "row_tile", "fold")
+    jax.jit,
+    static_argnames=("op", "interpret", "g_tile", "row_tile", "fold", "w_tile", "dimsem"),
 )
 def grouped_reduce_pallas(
     words3,
@@ -249,6 +372,8 @@ def grouped_reduce_pallas(
     row_tile: int = G_ROW_TILE,
     seed=None,
     fold: str = "log",
+    w_tile: int | None = None,
+    dimsem: bool = False,
 ):
     """Padded grouped reduce ``[G, M, 2048] -> [G, 2048]`` as one kernel.
 
@@ -256,12 +381,14 @@ def grouped_reduce_pallas(
     g_tile groups the output block stays resident in VMEM as the accumulator
     across its row tiles (TPU grids run sequentially). This is the device
     analogue of ParallelAggregation's per-key fold, all keys in one launch.
-    ``seed``: see wide_reduce_pallas (runtime value must be 0)."""
+    ``seed``: see wide_reduce_pallas (runtime value must be 0).
+    ``w_tile``/``dimsem``: sweep-staged variants against the 3x XLA gap
+    (grouped_plan, _grid_compiler_params)."""
     if fold not in ("log", "linear"):
         raise ValueError(f"fold must be 'log' or 'linear', got {fold!r}")
     fn = {"or": lax.bitwise_or, "and": lax.bitwise_and, "xor": lax.bitwise_xor}[op]
     g, m, w = words3.shape
-    plan = grouped_plan(g, m, w, g_tile, row_tile)
+    plan = grouped_plan(g, m, w, g_tile, row_tile, w_tile)
     if plan["pad_groups"] or plan["pad_rows"]:
         words3 = jnp.pad(
             words3,
@@ -271,7 +398,7 @@ def grouped_reduce_pallas(
     if seed is None:
         seed = jnp.uint32(0)
     out = pl.pallas_call(
-        _make_grouped_kernel(fn, fold),
+        _make_grouped_kernel(fn, fold, m_dim=plan["m_dim"]),
         out_shape=jax.ShapeDtypeStruct(plan["out_array"], words3.dtype),
         grid=plan["grid"],
         in_specs=[
@@ -281,13 +408,15 @@ def grouped_reduce_pallas(
         out_specs=pl.BlockSpec(
             plan["out_block"], plan["out_index"], memory_space=pltpu.VMEM
         ),
+        compiler_params=_grid_compiler_params(plan, dimsem),
         interpret=interpret,
     )(jnp.reshape(seed.astype(words3.dtype), (1,)), words3)
     return out[:g]
 
 
 @functools.partial(
-    jax.jit, static_argnames=("op", "interpret", "g_tile", "row_tile", "fold")
+    jax.jit,
+    static_argnames=("op", "interpret", "g_tile", "row_tile", "fold", "w_tile", "dimsem"),
 )
 def grouped_reduce_cardinality_pallas(
     words3,
@@ -297,6 +426,8 @@ def grouped_reduce_cardinality_pallas(
     row_tile: int = G_ROW_TILE,
     seed=None,
     fold: str = "log",
+    w_tile: int | None = None,
+    dimsem: bool = False,
 ):
     """Fused grouped reduce + per-group cardinality."""
     red = grouped_reduce_pallas(
@@ -307,6 +438,8 @@ def grouped_reduce_cardinality_pallas(
         row_tile=row_tile,
         seed=seed,
         fold=fold,
+        w_tile=w_tile,
+        dimsem=dimsem,
     )
     card = jnp.sum(lax.population_count(red).astype(jnp.int32), axis=-1)
     return red, card
