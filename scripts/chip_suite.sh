@@ -1,19 +1,80 @@
 #!/bin/bash
 # Everything that needs the real chip, in one run — executed automatically
 # by scripts/tunnel_watch.sh when the axon tunnel comes back.
+#
+# Round-4 contract (VERDICT r3 #1): every hardware claim must leave a
+# machine-readable artifact in git. Each tool writes JSON into
+# chip_artifacts/<utc-stamp>/ and this script commits the directory, so a
+# completed (or even partially completed) chip session is reproducible
+# evidence from the repo alone.
 set -u
 cd /root/repo
 export PYTHONPATH=/root/repo:${PYTHONPATH:-}
-LOG=${1:-/tmp/chip_suite.log}
+STAMP=$(date -u +%Y%m%dT%H%M%SZ)
+ART=chip_artifacts/$STAMP
+mkdir -p "$ART"
+LOG=${1:-$ART/chip_suite.log}
+# CHIP_SUITE.log must exist from the start: git commit (unlike git diff)
+# fatals on a pathspec matching no file known to git, which would turn
+# every intermediate commit into a silent no-op until the final cp
+# (code-review r4)
+touch CHIP_SUITE.log
+
+python - "$ART/meta.json" <<'EOF'
+import json, subprocess, sys, time
+meta = {"generated_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_head": subprocess.run(["git", "rev-parse", "HEAD"], capture_output=True,
+                                   text=True).stdout.strip()}
+try:
+    import jax
+    meta["jax_version"] = jax.__version__
+    meta["backend"] = jax.default_backend()
+    meta["devices"] = [str(d) for d in jax.devices()]
+    meta["device_kind"] = jax.devices()[0].device_kind if jax.devices() else None
+except Exception as e:
+    meta["backend_error"] = repr(e)[:300]
+json.dump(meta, open(sys.argv[1], "w"), indent=1)
+print(meta)
+EOF
+
+commit_artifacts() {
+  # commit whatever has landed so far; artifacts are generated data, so the
+  # verification gate does not apply (scripts/ci.sh covers the code).
+  # pathspecs added separately: one unmatched pathspec (CHIP_SUITE.log
+  # before the final cp) would otherwise fatal the whole add and turn every
+  # intermediate commit into a silent no-op (code-review r4)
+  git add -A chip_artifacts/ 2>/dev/null
+  git add CHIP_SUITE.log 2>/dev/null || true
+  # pathspec-limited commit: an operator's unrelated staged WIP must not be
+  # swept into this automated artifact commit (code-review r4)
+  if ! git diff --cached --quiet -- chip_artifacts CHIP_SUITE.log; then
+    git commit -q -m "Record on-chip validation artifacts ($STAMP)
+
+Machine-readable chip evidence: kernel-check family results, tile-sweep
+table, bench.py meta+result, BSI north-star suite — written by
+scripts/chip_suite.sh on the real TPU backend.
+
+No-Verification-Needed: machine-generated benchmark artifacts, no code change" \
+      -- chip_artifacts CHIP_SUITE.log \
+      && echo "committed $ART"
+  fi
+}
+trap commit_artifacts EXIT
+
 {
-  echo "=== chip suite start: $(date -u +%FT%TZ)"
-  echo "--- kernel check (wide/grouped/oneil pallas on chip)"
-  timeout 900 python -u scripts/tpu_kernel_check.py 2>&1 | grep -v WARNING
-  echo "--- tile sweep (honest fetch-forced timing)"
-  timeout 900 python -u scripts/tile_sweep.py 2>&1 | grep -v WARNING
+  echo "=== chip suite start: $(date -u +%FT%TZ) -> $ART"
+  echo "--- kernel check (all pallas + MXU families on chip)"
+  timeout 1200 python -u scripts/tpu_kernel_check.py --json "$ART/kernel_check.json" 2>&1 | grep -v WARNING
+  commit_artifacts
+  echo "--- tile sweep (incl. flagship [66,1450,2048] + gap-closing variants)"
+  timeout 2400 python -u scripts/tile_sweep.py --json "$ART/tile_sweep.json" 2>&1 | grep -v WARNING
+  commit_artifacts
   echo "--- bench.py (north star)"
-  timeout 900 python -u bench.py 2>&1 | grep -v WARNING
+  timeout 900 env BENCH_JSON_OUT="$ART/bench_tpu.json" python -u bench.py 2>&1 | grep -v WARNING
+  commit_artifacts
   echo "--- BSI north star on chip (10M rows to bound build time)"
-  timeout 1800 python -u -m benchmarks.bsi 10000000 2>&1 | grep -v WARNING
+  timeout 1800 python -u -m benchmarks.bsi 10000000 2>&1 | grep -v WARNING | tee "$ART/bsi_northstar.jsonl"
   echo "=== chip suite done: $(date -u +%FT%TZ)"
 } >> "$LOG" 2>&1
+cp -f "$LOG" CHIP_SUITE.log 2>/dev/null || true
+commit_artifacts

@@ -1,9 +1,16 @@
-"""Sweep Pallas tile sizes on the real chip and print a GB/s table.
+"""Sweep Pallas tile configurations on the real chip and emit a GB/s table.
 
 The wide/grouped reduces are memory-bound; the winner is whichever tiling
 sustains the highest achieved HBM bandwidth (v5e-1 peak ~800 GB/s). Results
-are recorded in BENCH_NOTES.md and justify the ROW_TILE / G_TILE /
-G_ROW_TILE defaults in ops/pallas_kernels.py (VERDICT r2 #3).
+justify the ROW_TILE / G_TILE / G_ROW_TILE / GROUPED_PREFER_XLA defaults in
+ops/pallas_kernels.py and are committed as a JSON artifact (VERDICT r3 #1/#2).
+
+Round-4 additions over the round-3 sweep:
+  * the flagship [66, 1450, 2048] shape (the bench.py working set) — the
+    shape where XLA beat the Pallas grid 423 vs 137 GB/s in round 3;
+  * the staged variants attacking that gap: fold="linear" (no halving
+    temporaries), w_tile (word-axis grid split -> smaller double-buffered
+    blocks), dimsem (Mosaic parallel/arbitrary dimension semantics).
 
 Timing is steady-state: K reductions inside one jitted scan
 (benchmarks.common.steady_state_reduce), because per-dispatch timing through
@@ -11,15 +18,18 @@ the axon tunnel is RPC-bound (~25-75 ms floor) and cannot distinguish
 tilings — the first sweep measured every config at an identical ~1-2 GB/s.
 
 Configs whose double-buffered input blocks exceed the ~16 MiB/core VMEM are
-skipped up front: a first sweep showed every such config (e.g. g_tile=8
-row_tile=128 -> 2x8 MiB) fails remote compile with tpu_compile_helper
-errors, and each failure costs minutes of retry through the tunnel.
+skipped up front: each remote-compile failure costs minutes through the
+tunnel.
 
-Run:  PYTHONPATH=/root/repo:$PYTHONPATH timeout 900 python -u scripts/tile_sweep.py
+Run:  PYTHONPATH=/root/repo:$PYTHONPATH timeout 2400 python -u scripts/tile_sweep.py --json chip_artifacts/<ts>/tile_sweep.json
 """
 
+import argparse
+import json
 import os
 import sys
+import time
+import traceback
 
 import numpy as np
 
@@ -29,24 +39,47 @@ K = 32
 REPS = 3
 VMEM_BUDGET = 12 * 2**20  # leave headroom under the ~16 MiB/core VMEM
 
-
 from benchmarks.common import fetch_device as _fetch  # noqa: E402
 from benchmarks.common import steady_state_reduce  # noqa: E402
 
+RECORDS = []
 
-def _time(with_seed, arr):
-    s, _total = steady_state_reduce(arr, with_seed, k=K, reps=REPS)
-    return s
+
+def _run(kind, shape, config, params, with_seed, arr, nbytes, k=K):
+    # k recorded per row: the flagship shape runs a shorter scan (k=16)
+    # than the top-level default, and the artifact must say so
+    rec = {"kind": kind, "shape": list(shape), "config": config, "params": params, "k": k}
+    try:
+        t0 = time.time()
+        s, _total = steady_state_reduce(arr, with_seed, k=k, reps=REPS)
+        rec.update(
+            ms=round(s * 1e3, 3),
+            gbps=round(nbytes / s / 1e9, 1),
+            wall_s=round(time.time() - t0, 1),
+        )
+        print(f"  {config:<34} {s*1e3:8.3f} ms  {rec['gbps']:7.1f} GB/s", flush=True)
+    except Exception as e:
+        rec["error"] = repr(e)[:300]
+        rec["traceback"] = traceback.format_exc()[-800:]
+        print(f"  {config:<34} ERROR {rec['error'][:120]}", flush=True)
+    RECORDS.append(rec)
+    return rec
 
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", help="write the sweep table to this path")
+    ap.add_argument("--skip-flagship", action="store_true", help="skip the 784 MB shape")
+    args = ap.parse_args()
+
     import jax
     import jax.numpy as jnp
 
     from roaringbitmap_tpu.ops import device as dev
     from roaringbitmap_tpu.ops import pallas_kernels as pk
 
-    print("backend:", jax.default_backend(), flush=True)
+    backend = jax.default_backend()
+    print("backend:", backend, flush=True)
     print(f"steady-state timing: best of {REPS} x (scan of K={K} reductions)", flush=True)
     rng = np.random.default_rng(0)
 
@@ -56,57 +89,104 @@ def main():
     arr = jnp.asarray(host)
     _fetch(arr.sum())  # flush the transfer before timing anything
     nbytes = arr.size * 4
+    shape = (n, 2048)
     print(f"\nwide [N={n}, 2048] ({nbytes/2**20:.0f} MiB)", flush=True)
-    t = _time(lambda w, s: dev.wide_reduce_with_cardinality(w ^ s, op="or"), arr)
-    print(f"  xla            {t*1e3:8.3f} ms  {nbytes/t/1e9:7.1f} GB/s", flush=True)
+    _run("wide", shape, "xla", {}, lambda w, s: dev.wide_reduce_with_cardinality(w ^ s, op="or"), arr, nbytes)
     for g in (32, 128, 512):
-        t = _time(
+        _run(
+            "wide", shape, f"xla 2stage g={g}", {"stage_groups": g},
             lambda w, s, g=g: dev.wide_reduce_two_stage(w ^ s, op="or", stage_groups=g),
-            arr,
+            arr, nbytes,
         )
-        print(
-            f"  xla 2stage g={g:<4} {t*1e3:7.3f} ms  {nbytes/t/1e9:7.1f} GB/s", flush=True
-        )
-    for row_tile in (128, 256, 512):
-        t = _time(
-            lambda w, s, rt=row_tile: pk.wide_reduce_cardinality_pallas(
-                w, op="or", row_tile=rt, seed=s
-            ),
-            arr,
-        )
-        print(
-            f"  pallas rt={row_tile:<5} {t*1e3:8.3f} ms  {nbytes/t/1e9:7.1f} GB/s",
-            flush=True,
+    wide_cfgs = [
+        {"row_tile": 128},
+        {"row_tile": 256},
+        {"row_tile": 512},
+        {"row_tile": 256, "fold": "linear"},
+        {"row_tile": 256, "w_tile": 512},
+        {"row_tile": 256, "w_tile": 512, "fold": "linear"},
+        {"row_tile": 512, "w_tile": 1024, "dimsem": True},
+        {"row_tile": 256, "w_tile": 512, "fold": "linear", "dimsem": True},
+    ]
+    for kw in wide_cfgs:
+        label = "pallas " + " ".join(f"{k_}={v}" for k_, v in kw.items())
+        _run(
+            "wide", shape, label, kw,
+            lambda w, s, kw=kw: pk.wide_reduce_cardinality_pallas(w, op="or", seed=s, **kw),
+            arr, nbytes,
         )
 
-    # ---- grouped: [G, M, 2048]: census-like and skewed-wide shapes ----
-    for g, m in ((66, 512), (512, 64)):
-        host3 = rng.integers(0, 1 << 32, size=(g, m, 2048), dtype=np.uint64).astype(
-            np.uint32
-        )
+    # ---- grouped: [G, M, 2048] ----
+    # census-like, skewed-wide, and (unless skipped) the flagship bench shape
+    shapes = [(66, 512), (512, 64)]
+    if not args.skip_flagship:
+        shapes.append((66, 1450))
+    for g, m in shapes:
+        host3 = rng.integers(0, 1 << 32, size=(g, m, 2048), dtype=np.uint64).astype(np.uint32)
         arr3 = jnp.asarray(host3)
         _fetch(arr3.sum())
         nbytes = arr3.size * 4
-        print(f"\ngrouped [G={g}, M={m}, 2048] ({nbytes/2**20:.0f} MiB)", flush=True)
-        t = _time(lambda w, s: dev.grouped_reduce_with_cardinality(w ^ s, op="or"), arr3)
-        print(f"  xla                    {t*1e3:8.3f} ms  {nbytes/t/1e9:7.1f} GB/s", flush=True)
-        for g_tile in (8, 16):
-            for row_tile in (32, 64):
-                block = 4 * g_tile * row_tile * 2048
-                if 2 * block > VMEM_BUDGET:
-                    print(f"  pallas gt={g_tile:<3} rt={row_tile:<5} skipped (VMEM)", flush=True)
-                    continue
-                for fold in ("log", "linear"):
-                    t = _time(
-                        lambda w, s, gt=g_tile, rt=row_tile, f=fold: pk.grouped_reduce_cardinality_pallas(
-                            w, op="or", g_tile=gt, row_tile=rt, seed=s, fold=f
-                        ),
-                        arr3,
-                    )
-                    print(
-                        f"  pallas gt={g_tile:<3} rt={row_tile:<3} {fold:<6} {t*1e3:8.3f} ms  {nbytes/t/1e9:7.1f} GB/s",
-                        flush=True,
-                    )
+        shape = (g, m, 2048)
+        flagship = (g, m) == (66, 1450)
+        k = 16 if flagship else K  # bound the 784 MB shape's wall clock
+        print(f"\ngrouped [G={g}, M={m}, 2048] ({nbytes/2**20:.0f} MiB) K={k}", flush=True)
+        _run(
+            "grouped", shape, "xla", {},
+            lambda w, s: dev.grouped_reduce_with_cardinality(w ^ s, op="or"),
+            arr3, nbytes, k=k,
+        )
+        if flagship:
+            cfgs = [
+                {"g_tile": 8, "row_tile": 64},  # round-3 default: the 137 GB/s row
+                {"g_tile": 8, "row_tile": 64, "fold": "linear"},
+                {"g_tile": 8, "row_tile": 64, "dimsem": True},
+                {"g_tile": 8, "row_tile": 64, "w_tile": 512},
+                {"g_tile": 8, "row_tile": 128, "w_tile": 512},
+                {"g_tile": 8, "row_tile": 128, "w_tile": 512, "fold": "linear"},
+                {"g_tile": 8, "row_tile": 128, "w_tile": 512, "dimsem": True},
+                {"g_tile": 8, "row_tile": 256, "w_tile": 256, "fold": "linear"},
+                {"g_tile": 8, "row_tile": 128, "w_tile": 1024, "dimsem": True},
+                {"g_tile": 16, "row_tile": 64, "w_tile": 512, "dimsem": True},
+            ]
+        else:
+            cfgs = [
+                {"g_tile": 8, "row_tile": 32},
+                {"g_tile": 8, "row_tile": 64},
+                {"g_tile": 16, "row_tile": 32},
+                {"g_tile": 16, "row_tile": 64},
+                {"g_tile": 8, "row_tile": 64, "fold": "linear"},
+                {"g_tile": 8, "row_tile": 64, "w_tile": 512},
+            ]
+        for kw in cfgs:
+            block = 4 * kw["g_tile"] * kw["row_tile"] * kw.get("w_tile", 2048)
+            label = "pallas " + " ".join(f"{k_}={v}" for k_, v in kw.items())
+            if 2 * block > VMEM_BUDGET:
+                RECORDS.append(
+                    {"kind": "grouped", "shape": list(shape), "config": label,
+                     "params": kw, "skipped": "VMEM"}
+                )
+                print(f"  {label:<34} skipped (VMEM)", flush=True)
+                continue
+            _run(
+                "grouped", shape, label, kw,
+                lambda w, s, kw=kw: pk.grouped_reduce_cardinality_pallas(w, op="or", seed=s, **kw),
+                arr3, nbytes, k=k,
+            )
+
+    result = {
+        "generated_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "backend": backend,
+        "devices": [str(d) for d in jax.devices()],
+        "jax_version": jax.__version__,
+        "steady_state_k": K,
+        "reps": REPS,
+        "records": RECORDS,
+    }
+    if args.json:
+        os.makedirs(os.path.dirname(args.json) or ".", exist_ok=True)
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=1)
+        print("wrote", args.json, flush=True)
 
 
 if __name__ == "__main__":

@@ -1,140 +1,268 @@
-"""Validate the Pallas kernels lower and run correctly on the real chip.
+"""Validate every Pallas/MXU kernel family on the real chip, with a
+machine-readable record per family.
 
-Run on the default (axon/TPU) backend:  timeout 600 python scripts/tpu_kernel_check.py
+Run on the default (axon/TPU) backend:
+    timeout 900 python scripts/tpu_kernel_check.py --json chip_artifacts/<ts>/kernel_check.json
+
+Each family runs under try/except so one failure cannot hide the others'
+results (the round-2 lesson: a single bad lowering took the whole bench
+down). The JSON artifact is the repo-committed evidence that the kernels
+executed on hardware (VERDICT r3 #1/#4).
 """
 
+import argparse
+import json
 import os
 import sys
 import time
+import traceback
 
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+RECORDS = []
+
+
+def family(name):
+    """Decorator: run the check, record {family, ok, seconds, detail|error}."""
+
+    def deco(fn):
+        def run():
+            t0 = time.time()
+            try:
+                detail = fn() or {}
+                rec = {"family": name, "ok": True, "seconds": round(time.time() - t0, 1), **detail}
+            except Exception as e:
+                rec = {
+                    "family": name,
+                    "ok": False,
+                    "seconds": round(time.time() - t0, 1),
+                    "error": repr(e)[:500],
+                    "traceback": traceback.format_exc()[-1500:],
+                }
+            RECORDS.append(rec)
+            print(f"{name}: {'OK' if rec['ok'] else 'FAIL ' + rec.get('error', '')}", flush=True)
+            return rec
+
+        return run
+
+    return deco
+
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", help="write machine-readable results to this path")
+    args = ap.parse_args()
+
     import jax
     import jax.numpy as jnp
 
-    from roaringbitmap_tpu.ops import device as dev
+    from roaringbitmap_tpu.ops import device as dev  # noqa: F401
     from roaringbitmap_tpu.ops import pallas_kernels as pk
 
-    print("backend:", jax.default_backend(), jax.devices())
+    backend = jax.default_backend()
+    devices = [str(d) for d in jax.devices()]
+    print("backend:", backend, devices, flush=True)
     rng = np.random.default_rng(0)
 
-    # wide: N=10_000 rows
-    host = rng.integers(0, 1 << 32, size=(10_000, 2048), dtype=np.uint64).astype(np.uint32)
-    arr = jnp.asarray(host)
-    t0 = time.time()
-    red, card = pk.wide_reduce_cardinality_pallas(arr, op="or")
-    jax.block_until_ready((red, card))
-    print(f"wide pallas compile+run: {time.time()-t0:.1f}s")
-    want = np.bitwise_or.reduce(host, axis=0)
-    assert np.array_equal(np.asarray(red), want), "wide mismatch"
-    assert int(card) == int(np.unpackbits(want.view(np.uint8)).sum())
-    print("wide pallas: OK")
-
-    # grouped: G=66 (the round-2 crash shape class), M=151
-    g, m = 66, 151
-    host3 = rng.integers(0, 1 << 32, size=(g, m, 2048), dtype=np.uint64).astype(np.uint32)
-    arr3 = jnp.asarray(host3)
-    t0 = time.time()
-    red3, cards = pk.grouped_reduce_cardinality_pallas(arr3, op="or")
-    jax.block_until_ready((red3, cards))
-    print(f"grouped pallas compile+run: {time.time()-t0:.1f}s")
-    want3 = np.bitwise_or.reduce(host3, axis=1)
-    assert np.array_equal(np.asarray(red3), want3), "grouped mismatch"
-    want_cards = [int(np.unpackbits(want3[i].view(np.uint8)).sum()) for i in range(g)]
-    assert np.asarray(cards).tolist() == want_cards
-    print("grouped pallas: OK")
-
-    # all three ops, both kernels, via the probing dispatchers
-    for op, fold in [("or", np.bitwise_or), ("and", np.bitwise_and), ("xor", np.bitwise_xor)]:
-        r, c = pk.best_wide_reduce(arr, op=op)
-        jax.block_until_ready((r, c))
-        assert np.array_equal(np.asarray(r), fold.reduce(host, axis=0)), op
-        r3, c3 = pk.best_grouped_reduce(arr3, op=op)
-        jax.block_until_ready((r3, c3))
-        assert np.array_equal(np.asarray(r3), fold.reduce(host3, axis=1)), op
-    print("dispatchers: OK")
-
-    # fused O'Neil compare (the BSI north-star kernel), incl. dual RANGE
-    from roaringbitmap_tpu.models.bsi import o_neil_math
-
-    s, k = 32, 66
-    slices = rng.integers(0, 1 << 32, size=(s, k, 2048), dtype=np.uint64).astype(np.uint32)
-    ebm = np.bitwise_or.reduce(slices, axis=0)
-    fixed = rng.integers(0, 1 << 32, size=(k, 2048), dtype=np.uint64).astype(np.uint32)
-    predicate, hi_pred = 0xA5A5A5A5 & ((1 << s) - 1), 0xC3C3C3C3 & ((1 << s) - 1)
-    bits = np.array([(predicate >> i) & 1 for i in range(s - 1, -1, -1)], dtype=bool)
-    bits_hi = np.array([(hi_pred >> i) & 1 for i in range(s - 1, -1, -1)], dtype=bool)
-    for op, b in [("GE", bits), ("EQ", bits), ("RANGE", np.stack([bits, bits_hi]))]:
+    @family("wide_pallas")
+    def check_wide():
+        host = rng.integers(0, 1 << 32, size=(10_000, 2048), dtype=np.uint64).astype(np.uint32)
+        arr = jnp.asarray(host)
         t0 = time.time()
-        got_out, got_cards = pk.oneil_compare_pallas(
-            jnp.asarray(slices), jnp.asarray(b), jnp.asarray(ebm), jnp.asarray(fixed), op=op
+        red, card = pk.wide_reduce_cardinality_pallas(arr, op="or")
+        jax.block_until_ready((red, card))
+        compile_s = time.time() - t0
+        want = np.bitwise_or.reduce(host, axis=0)
+        assert np.array_equal(np.asarray(red), want), "wide mismatch"
+        assert int(card) == int(np.unpackbits(want.view(np.uint8)).sum())
+        return {"compile_s": round(compile_s, 1), "shape": [10_000, 2048]}
+
+    @family("wide_pallas_variants")
+    def check_wide_variants():
+        # the sweep-staged w-split / linear-fold / dimsem variants must also
+        # lower and run correctly on the real chip, not just in interpret mode
+        host = rng.integers(0, 1 << 32, size=(2048, 2048), dtype=np.uint64).astype(np.uint32)
+        arr = jnp.asarray(host)
+        want = np.bitwise_or.reduce(host, axis=0)
+        variants = [
+            {"w_tile": 512},
+            {"fold": "linear"},
+            {"w_tile": 1024, "fold": "linear", "dimsem": True},
+        ]
+        ok = []
+        for kw in variants:
+            red, _ = pk.wide_reduce_cardinality_pallas(arr, op="or", **kw)
+            assert np.array_equal(np.asarray(red), want), f"wide variant {kw} mismatch"
+            ok.append(kw)
+        return {"variants": ok}
+
+    @family("grouped_pallas")
+    def check_grouped():
+        g, m = 66, 151  # the round-2 crash shape class
+        host3 = rng.integers(0, 1 << 32, size=(g, m, 2048), dtype=np.uint64).astype(np.uint32)
+        arr3 = jnp.asarray(host3)
+        t0 = time.time()
+        red3, cards = pk.grouped_reduce_cardinality_pallas(arr3, op="or")
+        jax.block_until_ready((red3, cards))
+        compile_s = time.time() - t0
+        want3 = np.bitwise_or.reduce(host3, axis=1)
+        assert np.array_equal(np.asarray(red3), want3), "grouped mismatch"
+        want_cards = [int(np.unpackbits(want3[i].view(np.uint8)).sum()) for i in range(g)]
+        assert np.asarray(cards).tolist() == want_cards
+        return {"compile_s": round(compile_s, 1), "shape": [g, m, 2048]}
+
+    @family("grouped_pallas_variants")
+    def check_grouped_variants():
+        g, m = 66, 151
+        host3 = rng.integers(0, 1 << 32, size=(g, m, 2048), dtype=np.uint64).astype(np.uint32)
+        arr3 = jnp.asarray(host3)
+        want3 = np.bitwise_or.reduce(host3, axis=1)
+        variants = [
+            {"fold": "linear"},
+            {"w_tile": 512},
+            {"w_tile": 512, "fold": "linear", "dimsem": True},
+        ]
+        ok = []
+        for kw in variants:
+            red3, _ = pk.grouped_reduce_cardinality_pallas(arr3, op="or", **kw)
+            assert np.array_equal(np.asarray(red3), want3), f"grouped variant {kw} mismatch"
+            ok.append(kw)
+        return {"variants": ok}
+
+    @family("dispatchers")
+    def check_dispatchers():
+        host = rng.integers(0, 1 << 32, size=(10_000, 2048), dtype=np.uint64).astype(np.uint32)
+        arr = jnp.asarray(host)
+        host3 = rng.integers(0, 1 << 32, size=(66, 151, 2048), dtype=np.uint64).astype(np.uint32)
+        arr3 = jnp.asarray(host3)
+        for op, fold in [("or", np.bitwise_or), ("and", np.bitwise_and), ("xor", np.bitwise_xor)]:
+            r, c = pk.best_wide_reduce(arr, op=op)
+            jax.block_until_ready((r, c))
+            assert np.array_equal(np.asarray(r), fold.reduce(host, axis=0)), op
+            r3, c3 = pk.best_grouped_reduce(arr3, op=op)
+            jax.block_until_ready((r3, c3))
+            assert np.array_equal(np.asarray(r3), fold.reduce(host3, axis=1)), op
+        return {"ops": ["or", "and", "xor"]}
+
+    @family("oneil_pallas")
+    def check_oneil():
+        from roaringbitmap_tpu.models.bsi import o_neil_math
+
+        s, k = 32, 66
+        slices = rng.integers(0, 1 << 32, size=(s, k, 2048), dtype=np.uint64).astype(np.uint32)
+        ebm = np.bitwise_or.reduce(slices, axis=0)
+        fixed = rng.integers(0, 1 << 32, size=(k, 2048), dtype=np.uint64).astype(np.uint32)
+        predicate, hi_pred = 0xA5A5A5A5 & ((1 << s) - 1), 0xC3C3C3C3 & ((1 << s) - 1)
+        bits = np.array([(predicate >> i) & 1 for i in range(s - 1, -1, -1)], dtype=bool)
+        bits_hi = np.array([(hi_pred >> i) & 1 for i in range(s - 1, -1, -1)], dtype=bool)
+        times = {}
+        for op, b in [("GE", bits), ("EQ", bits), ("RANGE", np.stack([bits, bits_hi]))]:
+            t0 = time.time()
+            got_out, got_cards = pk.oneil_compare_pallas(
+                jnp.asarray(slices), jnp.asarray(b), jnp.asarray(ebm), jnp.asarray(fixed), op=op
+            )
+            got_out, got_cards = np.asarray(got_out), np.asarray(got_cards)
+            times[op] = round(time.time() - t0, 1)
+            want_out, want_cards = o_neil_math(
+                jnp.asarray(slices), jnp.asarray(b), jnp.asarray(ebm), jnp.asarray(fixed), op
+            )
+            assert np.array_equal(got_out, np.asarray(want_out)), f"oneil {op} mismatch"
+            assert np.array_equal(got_cards, np.asarray(want_cards)), f"oneil {op} cards"
+        return {"compile_s_per_op": times, "shape": [s, k, 2048]}
+
+    @family("segmented_pallas")
+    def check_segmented():
+        n = 5_000
+        rows = rng.integers(0, 1 << 32, size=(n, 2048), dtype=np.uint64).astype(np.uint32)
+        offs = np.unique(np.concatenate([[0], rng.integers(1, n, size=60)]))
+        seg = np.zeros(n, dtype=bool)
+        seg[offs] = True
+        vals = np.asarray(pk.segmented_reduce_pallas(jnp.asarray(rows), jnp.asarray(seg), op="or"))
+        bounds = np.append(offs, n)
+        for s_i, e_i in zip(bounds[:-1], bounds[1:]):
+            want = np.bitwise_or.reduce(rows[s_i:e_i], axis=0)
+            assert np.array_equal(vals[e_i - 1], want), ("segmented", s_i, e_i)
+        return {"shape": [n, 2048], "segments": len(offs)}
+
+    @family("segmented_pallas_large_n")
+    def check_segmented_large():
+        # exercises the bit-packed whole-array SMEM flags (n/8 bytes resident)
+        n = 200_000
+        rows = rng.integers(0, 1 << 32, size=(n, 2048), dtype=np.uint32)
+        offs = np.unique(np.concatenate([[0], rng.integers(1, n, size=500)]))
+        seg = np.zeros(n, dtype=bool)
+        seg[offs] = True
+        vals = np.asarray(pk.segmented_reduce_pallas(jnp.asarray(rows), jnp.asarray(seg), op="or"))
+        bounds = np.append(offs, n)
+        ends = bounds[1:] - 1
+        want_ends = np.stack(
+            [np.bitwise_or.reduce(rows[s_i:e_i], axis=0) for s_i, e_i in zip(bounds[:-1], bounds[1:])]
         )
-        got_out, got_cards = np.asarray(got_out), np.asarray(got_cards)
-        print(f"oneil pallas {op}: {time.time()-t0:.1f}s (compile+run)")
-        want_out, want_cards = o_neil_math(
-            jnp.asarray(slices), jnp.asarray(b), jnp.asarray(ebm), jnp.asarray(fixed), op
-        )
-        assert np.array_equal(got_out, np.asarray(want_out)), f"oneil {op} mismatch"
-        assert np.array_equal(got_cards, np.asarray(want_cards)), f"oneil {op} cards"
-    print("oneil pallas: OK")
+        assert np.array_equal(vals[ends], want_ends), "segmented large-N mismatch"
+        return {"shape": [n, 2048], "segments": len(offs)}
 
-    # one-pass segmented scan (the skewed-layout kernel)
-    n = 5_000
-    rows = rng.integers(0, 1 << 32, size=(n, 2048), dtype=np.uint64).astype(np.uint32)
-    offs = np.unique(np.concatenate([[0], rng.integers(1, n, size=60)]))
-    seg = np.zeros(n, dtype=bool)
-    seg[offs] = True
-    t0 = time.time()
-    vals = np.asarray(pk.segmented_reduce_pallas(jnp.asarray(rows), jnp.asarray(seg), op="or"))
-    print(f"segmented pallas compile+run: {time.time()-t0:.1f}s")
-    bounds = np.append(offs, n)
-    for s_i, e_i in zip(bounds[:-1], bounds[1:]):
-        want = np.bitwise_or.reduce(rows[s_i:e_i], axis=0)
-        assert np.array_equal(vals[e_i - 1], want), ("segmented", s_i, e_i)
-    print("segmented pallas: OK")
+    @family("mxu_pairwise")
+    def check_mxu():
+        # the MXU bit-matmul overlap engine vs the VPU broadcast engine
+        # (VERDICT r3 #4: the one kernel family with zero hardware evidence)
+        from roaringbitmap_tpu import RoaringBitmap
+        from roaringbitmap_tpu.parallel import batch
 
-    # large-N segmented: exercises the bit-packed whole-array SMEM flags
-    # (n/8 bytes resident) well past the old unpacked layout's comfort zone
-    n = 200_000
-    rows = rng.integers(0, 1 << 32, size=(n, 2048), dtype=np.uint32)
-    offs = np.unique(np.concatenate([[0], rng.integers(1, n, size=500)]))
-    seg = np.zeros(n, dtype=bool)
-    seg[offs] = True
-    t0 = time.time()
-    vals = np.asarray(pk.segmented_reduce_pallas(jnp.asarray(rows), jnp.asarray(seg), op="or"))
-    print(f"segmented pallas large-N ({n} rows) compile+run: {time.time()-t0:.1f}s")
-    bounds = np.append(offs, n)
-    ends = bounds[1:] - 1
-    want_ends = np.stack(
-        [np.bitwise_or.reduce(rows[s_i:e_i], axis=0) for s_i, e_i in zip(bounds[:-1], bounds[1:])]
-    )
-    assert np.array_equal(vals[ends], want_ends), "segmented large-N mismatch"
-    print("segmented pallas large-N: OK")
+        srng = np.random.default_rng(7)
+        sets = [
+            RoaringBitmap(np.unique(srng.integers(0, 1 << 22, 5000)).astype(np.uint32))
+            for _ in range(128)
+        ]
+        L, R = sets[:64], sets[64:]
+        t0 = time.time()
+        mx = batch.pairwise_and_cardinality(L, R, impl="mxu")
+        compile_s = time.time() - t0
+        t0 = time.time()
+        mx2 = batch.pairwise_and_cardinality(L, R, impl="mxu")
+        t_mxu = time.time() - t0
+        vp = batch.pairwise_and_cardinality(L, R, impl="vpu")
+        # exactness: int32 accumulation over <= 2^22-bit universes is exact on
+        # the MXU path (guarded in batch.py); any drift is a real bug
+        assert mx.tolist() == vp.tolist() == mx2.tolist(), "pairwise matrix mismatch"
+        jac = batch.pairwise_jaccard(L, R)
+        assert np.all((np.asarray(jac) >= 0) & (np.asarray(jac) <= 1)), "jaccard out of range"
+        return {
+            "matrix": [64, 64],
+            "compile_s": round(compile_s, 1),
+            "mxu_dispatch_ms": round(t_mxu * 1e3, 1),
+        }
 
-    # pairwise overlap matrix: the MXU bit-matmul vs the VPU broadcast
-    from roaringbitmap_tpu import RoaringBitmap
-    from roaringbitmap_tpu.parallel import batch
+    for run in (
+        check_wide,
+        check_wide_variants,
+        check_grouped,
+        check_grouped_variants,
+        check_dispatchers,
+        check_oneil,
+        check_segmented,
+        check_segmented_large,
+        check_mxu,
+    ):
+        run()
 
-    srng = np.random.default_rng(7)
-    sets = [
-        RoaringBitmap(np.unique(srng.integers(0, 1 << 22, 5000)).astype(np.uint32))
-        for _ in range(128)
-    ]
-    L, R = sets[:64], sets[64:]
-    t0 = time.time()
-    mx = batch.pairwise_and_cardinality(L, R, impl="mxu")
-    print(f"pairwise MXU 64x64 compile+run: {time.time()-t0:.1f}s")
-    t0 = time.time()
-    mx2 = batch.pairwise_and_cardinality(L, R, impl="mxu")
-    t_mxu = time.time() - t0
-    vp = batch.pairwise_and_cardinality(L, R, impl="vpu")
-    assert mx.tolist() == vp.tolist() == mx2.tolist(), "pairwise matrix mismatch"
-    print(f"pairwise matrix MXU==VPU: OK (mxu steady {t_mxu*1e3:.0f} ms per dispatch)")
-    print("dispatch counts:", dict(pk.DISPATCH_COUNTS))
+    result = {
+        "generated_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "backend": backend,
+        "devices": devices,
+        "jax_version": jax.__version__,
+        "ok": all(r["ok"] for r in RECORDS),
+        "families": RECORDS,
+        "dispatch_counts": {f"{k[0]}/{k[1]}": v for k, v in pk.DISPATCH_COUNTS.items()},
+    }
+    print("all families ok:" if result["ok"] else "FAILURES:", result["ok"], flush=True)
+    if args.json:
+        os.makedirs(os.path.dirname(args.json) or ".", exist_ok=True)
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=1)
+        print("wrote", args.json, flush=True)
+    sys.exit(0 if result["ok"] else 1)
 
 
 if __name__ == "__main__":

@@ -1,15 +1,16 @@
 #!/bin/bash
 # Probe the axon tunnel every ~4 minutes; when it answers, run the chip
 # suite once and exit. Leaves a heartbeat in /tmp/tunnel_watch.log.
+# chip_suite.sh commits its chip_artifacts/<stamp>/ directory itself (in
+# stages, so a tunnel that dies mid-suite still leaves the completed
+# artifacts in git — VERDICT r3 #1).
 set -u
 cd /root/repo
 export PYTHONPATH=/root/repo:${PYTHONPATH:-}
 for i in $(seq 1 200); do
   if timeout 60 python -c "import jax; assert jax.default_backend() != 'cpu', 'cpu fallback is not the tunnel'" > /dev/null 2>&1; then
     echo "$(date -u +%FT%TZ) tunnel UP (probe $i) — running chip suite" >> /tmp/tunnel_watch.log
-    # log INSIDE the repo: the round driver commits uncommitted files, so
-    # on-chip results survive even if the session ends before a human commit
-    bash scripts/chip_suite.sh /root/repo/CHIP_SUITE.log
+    bash scripts/chip_suite.sh
     echo "$(date -u +%FT%TZ) chip suite finished" >> /tmp/tunnel_watch.log
     exit 0
   fi
