@@ -241,7 +241,18 @@ class ImmutableRoaringBitmap:
         return self.high_low_container.get_container_at_index(i)
 
     def _build_container(self, i: int) -> Container:
-        """Materialize a fresh zero-copy container view (cache fill path)."""
+        """Materialize a fresh zero-copy container view (cache fill path).
+
+        All three payload kinds stay views into the source buffer — bitmap
+        words, array values, AND run (start, length) slices (the strided
+        pairs[0::2]/[1::2] views below): the buffer-view contract of
+        MappeableRunContainer.java, whose run algebra operates off the
+        buffer. The run-space interval kernels (container.py
+        _interval_binary, _run_contains_many, run-space rank/select/next)
+        consume these views directly, so a mapped run-heavy bitmap answers
+        and/contains/rank without materializing words or copying payloads
+        (pinned by tests/test_buffer.py::test_mapped_run_views_zero_copy).
+        Only the one-time hostile-payload validation reads the pages."""
         off = int(self._offsets[i])
         t = self._types[i]
         if t == self.BITMAP:

@@ -55,6 +55,23 @@ def test_set_get(bsi, column_data):
     assert bsi.min_value == vals.min() and bsi.max_value == vals.max()
 
 
+def test_get_values_bulk(bsi, column_data):
+    """The vectorized bulk read must agree with per-column get_value,
+    including absent columns reading as (0, False)."""
+    cols, vals = column_data
+    absent = np.array([299_999, 299_998], dtype=np.uint32)
+    present = set(cols.tolist())
+    absent = absent[[a not in present for a in absent.tolist()]]
+    query = np.concatenate([cols[:100], absent, cols[-3:]])
+    values, exists = bsi.get_values(query)
+    assert values.dtype == np.int64 and exists.dtype == bool
+    for q, v, e in zip(query.tolist(), values.tolist(), exists.tolist()):
+        assert (v, e) == bsi.get_value(q), q
+    # all-absent fast path
+    values, exists = bsi.get_values(absent)
+    assert not exists.any() and not values.any()
+
+
 def test_set_value_overwrite():
     b = RoaringBitmapSliceIndex()
     b.set_value(7, 100)
@@ -226,3 +243,15 @@ def test_compare_cardinality_matches_materialized():
         for mode in ("cpu", "device"):
             got = bsi.compare_cardinality(op, a, b, fs, mode=mode)
             assert got == want, (op, mode)
+
+
+def test_get_values_beyond_int63():
+    """Values at/above 2^63 (which set_value accepts) must read back exactly
+    from the bulk path too (code-review r4: int64 accumulator wrapped)."""
+    b = RoaringBitmapSliceIndex()
+    b.set_value(1, 1 << 63)
+    b.set_value(2, (1 << 64) + 5)
+    values, exists = b.get_values([1, 2, 3])
+    assert exists.tolist() == [True, True, False]
+    assert list(values) == [1 << 63, (1 << 64) + 5, 0]
+    assert b.get_value(1) == (1 << 63, True)

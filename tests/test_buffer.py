@@ -221,3 +221,62 @@ def test_buffer_cardinality_only_mixed_operands():
         assert BufferFastAggregation.xor_cardinality(*operands, mode=mode) == (
             BufferFastAggregation.xor(*operands).get_cardinality()
         )
+
+
+def test_mapped_run_views_zero_copy(tmp_path):
+    """VERDICT r3 #5: a mapped run-heavy bitmap must answer and/contains/
+    rank operating off the (start, length) buffer slices — run payloads are
+    strided views into the map (MappeableRunContainer.java's buffer-view
+    contract), never materialized to words or copied to the heap.
+
+    Two proofs: (a) the container's starts/lengths share memory with the
+    mapping; (b) tracemalloc over the whole query mix stays far below the
+    word-materialized footprint (~8 KB x containers)."""
+    import mmap
+    import tracemalloc
+
+    from roaringbitmap_tpu.models.container import RunContainer
+
+    # run-heavy: 48 containers of long runs -> ~66 runs per container
+    vals = np.concatenate(
+        [np.arange(s, s + 900, dtype=np.uint32) for s in range(0, 3_000_000, 1000)]
+    )
+    rb = RoaringBitmap(vals)
+    rb.run_optimize()
+    other = RoaringBitmap(
+        np.concatenate(
+            [np.arange(s, s + 500, dtype=np.uint32) for s in range(400, 3_000_000, 1000)]
+        )
+    )
+    other.run_optimize()
+    path = tmp_path / "runs.bin"
+    path.write_bytes(rb.serialize())
+    imm = ImmutableRoaringBitmap.map_file(str(path))
+    n_containers = imm.get_container_count()
+
+    # (a) payload arrays are views into the mapping, run-typed throughout
+    buf = np.frombuffer(imm._buf, dtype=np.uint8)
+    for i in range(n_containers):
+        c = imm.high_low_container.get_container_at_index(i)
+        assert isinstance(c, RunContainer), i
+        assert np.shares_memory(c.starts, buf), i
+        assert np.shares_memory(c.lengths, buf), i
+
+    # (b) the query mix allocates nowhere near the 8 KB/container word form
+    probe = [int(v) for v in vals[:: len(vals) // 97]]
+    tracemalloc.start()
+    tracemalloc.reset_peak()
+    inter = RoaringBitmap.and_(imm, other)
+    inter_card = inter.get_cardinality()
+    hits = sum(imm.contains(p) for p in probe)
+    ranks = [imm.rank(p) for p in probe]
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    word_form = 8192 * n_containers
+    assert peak < word_form // 2, (peak, word_form)
+
+    # correctness oracle vs the heap path
+    want = RoaringBitmap.and_(rb, other)
+    assert inter_card == want.get_cardinality() and inter == want
+    assert hits == len(probe)
+    assert ranks == [rb.rank(p) for p in probe]
