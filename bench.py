@@ -27,6 +27,16 @@ N_BITMAPS = 10_000
 REPS_CPU = 3
 REPS_TPU = 20
 
+# --smoke (the scripts/ci.sh gate): same end-to-end path — build, pack,
+# device reduce, unpack, CPU-vs-device equality assert — at 1/10 the
+# working set and minimal reps so the whole bench finishes in well under a
+# minute on the CPU backend. Smoke numbers are for the gate's pass/fail
+# only; they are not comparable to the full run's.
+if "--smoke" in sys.argv:
+    N_BITMAPS = 1_000
+    REPS_CPU = 2
+    REPS_TPU = 3
+
 
 def build_working_set():
     from roaringbitmap_tpu import RoaringBitmap

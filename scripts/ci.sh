@@ -1,0 +1,35 @@
+#!/bin/bash
+# One-command CI gate (VERDICT r3 #8) — the analogue of the reference's
+# per-push workflow (.github/workflows/java-all-versions.yml: tests x 4
+# JDKs + analysis). Everything runs on the CPU backend (tests/conftest.py
+# forces an 8-virtual-device CPU mesh; the chip-only suite lives in
+# scripts/chip_suite.sh), exits nonzero on the first failure, and finishes
+# in well under 10 minutes.
+#
+#   bash scripts/ci.sh            # full gate
+#   bash scripts/ci.sh --fast     # skip the pytest suite (pre-push sanity)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export PYTHONPATH=$PWD:${PYTHONPATH:-}
+
+t0=$SECONDS
+step() { echo; echo "=== ci: $1 (t+$((SECONDS - t0))s)"; }
+
+if [[ "${1:-}" != "--fast" ]]; then
+  step "pytest (full suite incl. Mosaic block-rule checks)"
+  python -m pytest tests/ -q
+fi
+
+step "fuzz smoke (500 iterations x 15 invariant families)"
+python -m roaringbitmap_tpu.fuzz 500 > /tmp/ci_fuzz.log 2>&1 \
+  || { tail -20 /tmp/ci_fuzz.log; exit 1; }
+tail -1 /tmp/ci_fuzz.log
+
+step "bench.py --smoke (end-to-end north-star path, CPU)"
+JAX_PLATFORMS=cpu python bench.py --smoke
+
+step "graft entry + 8-device virtual-mesh dryrun"
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python __graft_entry__.py
+
+step "all green (total $((SECONDS - t0))s)"
