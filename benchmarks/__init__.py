@@ -38,4 +38,5 @@ SUITES = [
     "bsi",
     "bitsetutil",
     "filtered_ann",
+    "formats",
 ]
