@@ -117,6 +117,29 @@ def values_for_columns(cols: np.ndarray, slices, dtype=np.int64) -> np.ndarray:
     return values
 
 
+def _bulk_get_values(index, cols: np.ndarray):
+    """Shared bulk-read core for both BSI widths (32-bit get_values and
+    bsi64's twin): one ``contains_many`` membership pass per slice into an
+    int64 accumulator. Above 63 slices, bit 63+ would wrap the accumulator
+    (and numpy shifts >= 64 are undefined), so that domain — which
+    set_value accepts as arbitrary Python ints — falls back to exact
+    per-column object-dtype reads."""
+    exists = index.ebm.contains_many(cols)
+    if index.bit_count() > 63:
+        values = np.array(
+            [index.get_value(int(c))[0] if e else 0 for c, e in zip(cols, exists)],
+            dtype=object,
+        )
+        return values, exists
+    values = np.zeros(cols.shape, dtype=np.int64)
+    if not exists.any():
+        return values, exists
+    for i, s in enumerate(index.slices):
+        values |= s.contains_many(cols).astype(np.int64) << i
+    values[~exists] = 0
+    return values, exists
+
+
 def transpose_value_counts(cols: np.ndarray, slices, dtype=np.int64):
     """(distinct values, multiplicities) over the given columns — the shared
     body of every transposeWithCount twin (BitSliceIndexBase.java:578,
@@ -251,24 +274,7 @@ class RoaringBitmapSliceIndex:
         vectorized passes instead of O(bit_count * len(columns)) point
         probes. Columns absent from the index read as value 0 with
         ``exists`` False."""
-        cols = np.asarray(columns, dtype=np.uint32)
-        exists = self.ebm.contains_many(cols)
-        if self.bit_count() > 63:
-            # bit 63+ would wrap the int64 accumulator (and numpy shifts
-            # >= 64 are undefined); exact Python-int fallback for the
-            # arbitrary-precision domain set_value accepts
-            values = np.array(
-                [self.get_value(int(c))[0] if e else 0 for c, e in zip(cols, exists)],
-                dtype=object,
-            )
-            return values, exists
-        values = np.zeros(cols.shape, dtype=np.int64)
-        if not exists.any():
-            return values, exists
-        for i, s in enumerate(self.slices):
-            values |= s.contains_many(cols).astype(np.int64) << i
-        values[~exists] = 0
-        return values, exists
+        return _bulk_get_values(self, np.asarray(columns, dtype=np.uint32).ravel())
 
     def value_exist(self, column_id: int) -> bool:
         return self.ebm.contains(column_id)
@@ -292,6 +298,13 @@ class RoaringBitmapSliceIndex:
             s.run_optimize()
         self.run_optimized = True
         self._version += 1
+
+    def has_run_compression(self) -> bool:
+        """True when any member bitmap holds a run container
+        (hasRunCompression, MutableBitSliceIndex.java:117)."""
+        return self.ebm.has_run_compression() or any(
+            s.has_run_compression() for s in self.slices
+        )
 
     # ------------------------------------------------------------------
     # combination
@@ -712,6 +725,40 @@ class RoaringBitmapSliceIndex:
             + serialized_size_in_bytes(self.ebm)
             + sum(serialized_size_in_bytes(s) for s in self.slices)
         )
+
+    def serialize_into(self, fileobj) -> int:
+        """Stream overload (the reference's DataOutput path,
+        MutableBitSliceIndex.java:331 serialize(DataOutput)); BSIs written
+        back-to-back deserialize back with :meth:`deserialize_from`.
+        Returns the byte count written."""
+        data = self.serialize()
+        fileobj.write(data)
+        return len(data)
+
+    @classmethod
+    def deserialize_from(cls, fileobj):
+        """Stream twin of :meth:`serialize_into`
+        (MutableBitSliceIndex.java:379 deserialize(DataInput)): consumes
+        exactly one BSI from the stream, leaving the position at the next
+        byte, so back-to-back indexes read sequentially. Subclasses
+        (MutableBitSliceIndex) return their own type."""
+        header = fileobj.read(9)
+        if len(header) < 9:
+            raise InvalidRoaringFormat("truncated BSI header")
+        ebm = RoaringBitmap.deserialize_from(fileobj)
+        count_raw = fileobj.read(4)
+        if len(count_raw) < 4:
+            raise InvalidRoaringFormat("truncated BSI slice count")
+        (depth,) = struct.unpack("<i", count_raw)
+        if depth < 0 or depth > 64:
+            raise InvalidRoaringFormat(f"implausible BSI depth {depth}")
+        min_v, max_v, ro = struct.unpack("<iib", header)
+        out = cls()
+        out.min_value, out.max_value = min_v, max_v
+        out.run_optimized = bool(ro)
+        out.ebm = ebm
+        out.slices = [RoaringBitmap.deserialize_from(fileobj) for _ in range(depth)]
+        return out
 
     def __reduce__(self):
         """Pickle via the BSI wire format; subclasses reconstruct their

@@ -129,6 +129,8 @@ class Roaring64BitmapSliceIndex:
         self._version += 1
 
     def get_value(self, column_id: int) -> Tuple[int, bool]:
+        """Single-column read; batch reads should use :meth:`get_values`
+        (one vectorized membership pass per slice)."""
         if not self.ebm.contains(column_id):
             return 0, False
         value = 0
@@ -136,6 +138,15 @@ class Roaring64BitmapSliceIndex:
             if s.contains(column_id):
                 value |= 1 << i
         return value, True
+
+    def get_values(self, columns) -> Tuple[np.ndarray, np.ndarray]:
+        """Vectorized bulk read: ``(values, exists)`` parallel to
+        ``columns`` — the 64-bit twin of the 32-bit BSI ``get_values``
+        (shared core: bsi._bulk_get_values; object-dtype exact above 63
+        slices, int64 otherwise)."""
+        from .bsi import _bulk_get_values
+
+        return _bulk_get_values(self, np.asarray(columns).astype(np.uint64, copy=False).ravel())
 
     def value_exist(self, column_id: int) -> bool:
         return self.ebm.contains(column_id)

@@ -29,8 +29,17 @@ from .bsi import Operation, RoaringBitmapSliceIndex
 from .roaring import RoaringBitmap
 
 
-class MutableBitSliceIndex(RoaringBitmapSliceIndex):
-    """bsi/buffer/MutableBitSliceIndex.java:20 — the mutable buffer twin."""
+class _RangeQueryAPI:
+    """The shared query surface of BitSliceIndexBase.java:351-620 — the
+    reference defines rangeEQ..range, parallelIn, and
+    parallelTransposeWithCount once on the base both twins extend; this
+    mixin is that base. Requires ``compare``/``get_existence_bitmap``/
+    ``slices`` on self."""
+
+    # no state: keeps ImmutableBitSliceIndex's __slots__ effective (a
+    # slotless base would silently hand it a __dict__ and let attribute
+    # assignment bypass the immutability guard — code-review r4)
+    __slots__ = ()
 
     # range* named queries (BitSliceIndexBase.java:351-420)
     def range_eq(self, found_set: Optional[RoaringBitmap], predicate: int) -> RoaringBitmap:
@@ -54,16 +63,6 @@ class MutableBitSliceIndex(RoaringBitmapSliceIndex):
     def range(self, found_set: Optional[RoaringBitmap], start: int, end: int) -> RoaringBitmap:
         return self.compare(Operation.RANGE, start, end, found_set)
 
-    def get_mutable_slice(self, i: int) -> RoaringBitmap:
-        """getMutableSlice (MutableBitSliceIndex.java:136)."""
-        return self.slices[i]
-
-    def add_digit(self, found_set: RoaringBitmap, i: int) -> None:
-        """addDigit (MutableBitSliceIndex.java:121)."""
-        self._grow(i + 1)
-        self._add_digit(found_set, i)
-        self._version += 1
-
     def parallel_in(
         self,
         parallelism: int,
@@ -82,8 +81,9 @@ class MutableBitSliceIndex(RoaringBitmapSliceIndex):
     ) -> "MutableBitSliceIndex":
         """parallelTransposeWithCount (BitSliceIndexBase.java:578):
         value -> multiplicity BSI."""
+        ebm = self.get_existence_bitmap()
         cols = (
-            self.ebm if found_set is None else RoaringBitmap.and_(self.ebm, found_set)
+            ebm if found_set is None else RoaringBitmap.and_(ebm, found_set)
         ).to_array()
         out = MutableBitSliceIndex()
         if cols.size == 0:
@@ -93,6 +93,22 @@ class MutableBitSliceIndex(RoaringBitmapSliceIndex):
         uniq, counts = transpose_value_counts(cols, self.slices)
         out.set_values((uniq.astype(np.uint32), counts.astype(np.int64)))
         return out
+
+
+class MutableBitSliceIndex(_RangeQueryAPI, RoaringBitmapSliceIndex):
+    """bsi/buffer/MutableBitSliceIndex.java:20 — the mutable buffer twin."""
+
+    get_long_cardinality = RoaringBitmapSliceIndex.get_cardinality
+
+    def get_mutable_slice(self, i: int) -> RoaringBitmap:
+        """getMutableSlice (MutableBitSliceIndex.java:136)."""
+        return self.slices[i]
+
+    def add_digit(self, found_set: RoaringBitmap, i: int) -> None:
+        """addDigit (MutableBitSliceIndex.java:121)."""
+        self._grow(i + 1)
+        self._add_digit(found_set, i)
+        self._version += 1
 
     def to_immutable_bit_slice_index(self) -> "ImmutableBitSliceIndex":
         """toImmutableBitSliceIndex (MutableBitSliceIndex.java:411) — O(1),
@@ -173,11 +189,14 @@ def _map_bsi(buf: memoryview) -> RoaringBitmapSliceIndex:
     return out
 
 
-class ImmutableBitSliceIndex:
+class ImmutableBitSliceIndex(_RangeQueryAPI):
     """bsi/buffer/ImmutableBitSliceIndex.java:17 — read-only view, either
     over an existing index (O(1) cast) or mapped zero-copy from a
     serialized buffer (ImmutableBitSliceIndex(ByteBuffer), :52): slice
-    payloads stay in the source buffer and are viewed lazily."""
+    payloads stay in the source buffer and are viewed lazily. Query
+    surface (rangeEQ..range, parallelIn, parallelTransposeWithCount) is
+    the shared _RangeQueryAPI, exactly as the reference defines it on the
+    base class both twins extend."""
 
     __slots__ = ("_base",)
 
@@ -200,6 +219,14 @@ class ImmutableBitSliceIndex:
         return self._base.get_cardinality()
 
     get_cardinality = get_long_cardinality
+
+    @property
+    def slices(self):
+        """Read-only slice views (consumed by the shared query mixin)."""
+        return self._base.slices
+
+    def has_run_compression(self) -> bool:
+        return self._base.has_run_compression()
 
     def get_value(self, column_id: int) -> Tuple[int, bool]:
         return self._base.get_value(column_id)

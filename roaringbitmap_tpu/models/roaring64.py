@@ -86,6 +86,30 @@ def group_by_high(values, shift: int):
         yield int(highs[s]), np.unique(lows[s:e])
 
 
+def bucketed_membership(values, shift: int, probe) -> np.ndarray:
+    """Shared vectorized-membership scaffold for both 64-bit designs'
+    ``contains_many``: bucket the queries by ``high = v >> shift`` (one
+    stable argsort), then ask ``probe(high, lows) -> bool array`` once per
+    distinct bucket. Negative ints are taken as their two's-complement
+    bit patterns (Java long semantics)."""
+    vals = np.asarray(values).astype(np.uint64, copy=False).ravel()
+    out = np.zeros(vals.shape, dtype=bool)
+    if vals.size == 0:
+        return out
+    highs = vals >> np.uint64(shift)
+    order = np.argsort(highs, kind="stable")
+    sh = highs[order]
+    bounds = np.flatnonzero(np.concatenate([[True], sh[1:] != sh[:-1]]))
+    bounds = np.append(bounds, sh.size)
+    mask = np.uint64((1 << shift) - 1)
+    for s, e in zip(bounds[:-1], bounds[1:]):
+        idx = order[s:e]
+        got = probe(int(sh[s]), vals[idx] & mask)
+        if got is not None:
+            out[idx] = got
+    return out
+
+
 SERIALIZATION_MODE_LEGACY = 0  # Roaring64NavigableMap.java:35
 SERIALIZATION_MODE_PORTABLE = 1  # Roaring64NavigableMap.java:47
 
@@ -211,6 +235,17 @@ class Roaring64NavigableMap:
         x = _check64(x)
         b = self._buckets.get(x >> 32)
         return b is not None and b.contains(x & 0xFFFFFFFF)
+
+    def contains_many(self, values) -> np.ndarray:
+        """Vectorized membership: bool array parallel to ``values`` — the
+        64-bit twin of ``RoaringBitmap.contains_many``, one vectorized
+        bucket probe per distinct high-32 key (bucketed_membership)."""
+
+        def probe(high, lows):
+            b = self._buckets.get(high)
+            return None if b is None else b.contains_many(lows.astype(np.uint32))
+
+        return bucketed_membership(values, 32, probe)
 
     @staticmethod
     def _chunk_ranges(start: int, end: int):

@@ -33,7 +33,7 @@ from .container import (
     container_range_of_ones,
 )
 from .roaring import RoaringBitmap
-from .roaring64 import _check64, chunk_ranges_64, group_by_high
+from .roaring64 import _check64, bucketed_membership, chunk_ranges_64, group_by_high
 
 
 def high48_key(x: int) -> bytes:
@@ -167,6 +167,21 @@ class Roaring64Bitmap:
         x = _check64(x)
         c = self._get(high48_key(x))
         return c is not None and c.contains(x & 0xFFFF)
+
+    def contains_many(self, values) -> np.ndarray:
+        """Vectorized membership: bool array parallel to ``values``.
+
+        The 64-bit twin of the 32-bit ``RoaringBitmap.contains_many`` (the
+        reference answers batch membership one contains() at a time,
+        Roaring64Bitmap.java): one container-level vectorized probe per
+        distinct high-48 chunk, not a trie descent per value
+        (roaring64.bucketed_membership)."""
+
+        def probe(high, lows):
+            c = self._get(high.to_bytes(6, "big"))
+            return None if c is None else c.contains_many(lows.astype(np.uint16))
+
+        return bucketed_membership(values, 16, probe)
 
     # ------------------------------------------------------------------
     # ranges (per-2^16-chunk walk)

@@ -326,3 +326,77 @@ def test_bsi64_compare_cardinality_device_paths():
     ):
         want = b.compare(op, a, e, fs, mode="cpu").get_cardinality()
         assert b.compare_cardinality(op, a, e, fs, mode="device") == want, op
+
+
+def test_immutable_range_api_and_parallel_surface():
+    """The reference defines rangeEQ..range / parallelIn /
+    parallelTransposeWithCount on the base BOTH buffer twins extend
+    (BitSliceIndexBase.java:351-620); the Immutable twin must expose the
+    whole family, including over a lazily mapped buffer."""
+    from roaringbitmap_tpu.models.bsi import Operation
+    from roaringbitmap_tpu.models.bsi_buffer import (
+        ImmutableBitSliceIndex,
+        MutableBitSliceIndex,
+    )
+
+    rng = np.random.default_rng(0xB51)
+    cols = np.unique(rng.integers(0, 200_000, 3000)).astype(np.uint32)
+    vals = rng.integers(0, 5000, cols.size).astype(np.int64)
+    mut = MutableBitSliceIndex()
+    mut.set_values((cols, vals))
+    med = int(np.median(vals))
+    found = __import__("roaringbitmap_tpu").RoaringBitmap(cols[::3])
+    for imm in (ImmutableBitSliceIndex(mut), ImmutableBitSliceIndex(mut.serialize())):
+        assert imm.range_ge(found, med) == mut.range_ge(found, med)
+        assert imm.range_lt(None, med) == mut.compare(Operation.LT, med, 0, None)
+        assert imm.range(found, med // 2, med * 2) == mut.range(found, med // 2, med * 2)
+        assert imm.parallel_in(4, Operation.EQ, med) == mut.range_eq(None, med)
+        t_imm = imm.parallel_transpose_with_count(found)
+        t_mut = mut.parallel_transpose_with_count(found)
+        assert t_imm == t_mut and isinstance(t_imm, MutableBitSliceIndex)
+    assert imm.has_run_compression() == mut.has_run_compression()
+
+
+def test_bsi_stream_serialization_roundtrip():
+    """Stream overloads (the reference's DataOutput path,
+    MutableBitSliceIndex.java:331/:379): back-to-back BSIs read back
+    sequentially, and the Mutable subclass reconstructs its own type."""
+    import io
+
+    from roaringbitmap_tpu.models.bsi import RoaringBitmapSliceIndex
+    from roaringbitmap_tpu.models.bsi_buffer import MutableBitSliceIndex
+
+    a = RoaringBitmapSliceIndex()
+    a.set_values(([1, 5, 9], [10, 20, 30]))
+    b = MutableBitSliceIndex()
+    b.set_values(([2, 4], [7, 1 << 20]))
+    b.run_optimize()
+    buf = io.BytesIO()
+    n_a = a.serialize_into(buf)
+    n_b = b.serialize_into(buf)
+    assert buf.tell() == n_a + n_b
+    buf.seek(0)
+    back_a = RoaringBitmapSliceIndex.deserialize_from(buf)
+    back_b = MutableBitSliceIndex.deserialize_from(buf)
+    assert back_a == a and back_b == b
+    assert isinstance(back_b, MutableBitSliceIndex)
+    assert back_b.run_optimized and buf.read() == b""
+
+
+def test_bsi64_get_values_bulk():
+    """64-bit bulk read agrees with per-column get_value, including values
+    above 2^63 (object-dtype exact path) and absent columns."""
+    from roaringbitmap_tpu.models.bsi64 import Roaring64BitmapSliceIndex
+
+    b = Roaring64BitmapSliceIndex()
+    cols = [1, (1 << 40) + 3, 7]
+    vals = [10, (1 << 35) + 1, 99]
+    b.set_values((cols, vals))
+    values, exists = b.get_values(np.array(cols + [12345], dtype=np.uint64))
+    assert exists.tolist() == [True, True, True, False]
+    assert values.tolist() == vals + [0]
+    # >63-slice exact path
+    big = Roaring64BitmapSliceIndex()
+    big.set_value(5, (1 << 63) + 7)
+    v, e = big.get_values([5, 6])
+    assert list(v) == [(1 << 63) + 7, 0] and e.tolist() == [True, False]

@@ -243,3 +243,24 @@ def test_or_navigable_bucketwise_engines():
     swant.ior(b)
     assert sgot.serialize() == swant.serialize()
     assert sgot.first() == swant.first()  # signed order: negative first
+
+
+def test_contains_many_64bit_both_designs():
+    """Vectorized membership on both 64-bit designs agrees with per-value
+    contains, across buckets, absent chunks, and 2^63+ values."""
+    from roaringbitmap_tpu.models.roaring64 import Roaring64NavigableMap
+    from roaringbitmap_tpu.models.roaring64art import Roaring64Bitmap
+
+    vals = np.array(
+        [1, 2, (1 << 40) + 5, (1 << 63) + 9, (1 << 16) + 1, 1 << 48], dtype=np.uint64
+    )
+    for cls in (Roaring64Bitmap, Roaring64NavigableMap):
+        bm = cls(vals)
+        probe = np.concatenate([vals, vals + np.uint64(1), np.array([0, 1 << 50], dtype=np.uint64)])
+        got = bm.contains_many(probe)
+        want = np.array([bm.contains(int(p)) for p in probe])
+        assert np.array_equal(got, want), cls.__name__
+        assert bm.contains_many(np.array([], dtype=np.uint64)).size == 0
+        # negative ints = two's-complement bit patterns (Java long semantics)
+        neg = bm.contains_many(np.array([-1], dtype=np.int64))
+        assert neg[0] == bm.contains((1 << 64) - 1)
