@@ -167,6 +167,43 @@ def distributed_bsi_sum(mesh: Mesh):
     return jax.jit(mapped)
 
 
+def collective_details(hlo_text: str) -> list:
+    """Collective instructions in optimized HLO text: one record per
+    instruction (start/done pairs counted once) with its replica groups —
+    the observable evidence behind "the mesh ops are ICI-efficient"
+    (scripts/hlo_report.py commits the full per-family report;
+    tests/test_sharding.py pins the wide-OR layout)."""
+    import re
+
+    out = []
+    for line in hlo_text.splitlines():
+        m = re.search(
+            r"\b(all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute)(-start)?\b",
+            line,
+        )
+        if not m or "-done" in line:
+            continue
+        # three syntaxes: nested {{0,1},{2,3}}, flat {0,1,2,3}, and the
+        # iota form [4,2]<=[8] (optionally T(...)-transposed). A lazy
+        # single-brace capture truncated nested groups (code-review r4).
+        groups = re.search(
+            r"replica_groups=(\{\{.*?\}\}|\{[^{}]*\}|\[[^\]]*\](?:<=\[[^\]]*\])?(?:T\([^)]*\))?)",
+            line,
+        )
+        out.append({"op": m.group(1), "replica_groups": groups.group(1) if groups else None})
+    return out
+
+
+def collective_summary(jitted, *args) -> dict:
+    """Compile ``jitted`` for the example args and count the collectives
+    XLA placed (see collective_details)."""
+    hlo = jitted.lower(*args).compile().as_text()
+    counts: dict = {}
+    for c in collective_details(hlo):
+        counts[c["op"]] = counts.get(c["op"], 0) + 1
+    return counts
+
+
 def initialize_multihost(
     coordinator_address: str | None = None,
     num_processes: int | None = None,

@@ -1,0 +1,148 @@
+"""Collective-layout evidence for the multi-chip path (VERDICT r3 weak #7).
+
+"The sharded ops are ICI-efficient" was a design claim with no artifact
+behind it: the dryrun proves the ops compile and agree with host oracles,
+but nothing in the repo showed WHERE XLA placed the collectives. This
+script compiles every distributed op family on the 8-device virtual CPU
+mesh (4 containers x 2 words — make_mesh(8)'s default split, the same
+shape the driver dryrun uses), extracts the optimized HLO, and records the collective instructions
+per family: op kind, count, and replica groups.
+
+What the design predicts (parallel/sharding.py):
+  * wide/grouped reduce: one all-gather on the containers axis (the OR
+    tree has no psum primitive) + one all-reduce (psum) of popcounts on
+    the words axis; no all-to-all, no collective-permute anywhere;
+  * BSI compare/sum: zero container-axis collectives (chunks are
+    independent) + one words-axis all-reduce for the cardinalities.
+
+Run:  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python scripts/hlo_report.py --json MULTICHIP_HLO_r04.json
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", help="write the report to this path")
+    args = ap.parse_args()
+
+    import jax
+
+    # force CPU BEFORE any device query: this report is virtual-mesh-only
+    # by design, and with a hung TPU tunnel even jax.default_backend()
+    # blocks forever (env vars are too late once the axon site hook
+    # pre-imports jax — the benchmarks/bsi.py __main__ pattern)
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    if jax.device_count() < 8:
+        raise SystemExit(
+            "need 8 virtual devices: run with "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8"
+        )
+    import jax.numpy as jnp
+
+    from roaringbitmap_tpu.parallel import sharding
+    from roaringbitmap_tpu.parallel.sharding import collective_details
+
+    mesh = sharding.make_mesh(8)
+    w = 8 * 128  # tiny words axis, divisible by the 2-way words mesh dim
+    rng = np.random.default_rng(0)
+    families = {}
+
+    def record(name, jitted, *arg_arrays, expect=None):
+        lowered = jitted.lower(*arg_arrays)
+        hlo = lowered.compile().as_text()
+        cols = collective_details(hlo)
+        counts = {}
+        for c in cols:
+            counts[c["op"]] = counts.get(c["op"], 0) + 1
+        families[name] = {
+            "collectives": cols,
+            "counts": counts,
+            "hlo_instructions": hlo.count("\n"),
+        }
+        print(f"{name:<28} {counts or 'NO COLLECTIVES'}")
+        if expect is not None:
+            missing = {k: v for k, v in expect.items() if counts.get(k, 0) != v}
+            forbidden = {
+                k for k in ("all-to-all", "collective-permute") if counts.get(k)
+            }
+            families[name]["expected"] = expect
+            families[name]["ok"] = not missing and not forbidden
+            if missing or forbidden:
+                print(f"  MISMATCH: missing={missing} forbidden={forbidden}")
+        return cols
+
+    rows = jnp.asarray(rng.integers(0, 1 << 32, (16, w), dtype=np.uint64).astype(np.uint32))
+    record(
+        "wide_or_cardinality",
+        sharding.distributed_wide_or_cardinality(mesh),
+        rows,
+        expect={"all-gather": 1, "all-reduce": 1},
+    )
+    g3 = jnp.asarray(rng.integers(0, 1 << 32, (4, 16, w), dtype=np.uint64).astype(np.uint32))
+    for op in ("or", "and", "xor"):
+        record(
+            f"grouped_{op}",
+            sharding.distributed_grouped_reduce(mesh, op),
+            g3,
+            expect={"all-gather": 1, "all-reduce": 1},
+        )
+    s, k = 8, 16
+    slices = jnp.asarray(rng.integers(0, 1 << 32, (s, k, w), dtype=np.uint64).astype(np.uint32))
+    ebm = jnp.asarray(np.bitwise_or.reduce(np.asarray(slices), axis=0))
+    fixed = jnp.ones_like(ebm)
+    bits = jnp.asarray(np.ones(s, dtype=bool))
+    bits2 = jnp.asarray(np.stack([np.ones(s, dtype=bool)] * 2))
+    record(
+        "bsi_compare_GE",
+        sharding.distributed_bsi_compare(mesh, "GE"),
+        slices, bits, ebm, fixed,
+        expect={"all-reduce": 1},
+    )
+    record(
+        "bsi_compare_RANGE",
+        sharding.distributed_bsi_compare(mesh, "RANGE"),
+        slices, bits2, ebm, fixed,
+        expect={"all-reduce": 1},
+    )
+    record(
+        "bsi_sum",
+        sharding.distributed_bsi_sum(mesh),
+        slices, fixed,
+        expect={"all-reduce": 1},
+    )
+
+    ok = all(f.get("ok", True) for f in families.values())
+    report = {
+        "generated_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "backend": jax.default_backend(),
+        "n_devices": jax.device_count(),
+        "mesh": {"containers": int(mesh.shape["containers"]), "words": int(mesh.shape["words"])},
+        "jax_version": jax.__version__,
+        "note": (
+            "virtual CPU mesh (no TPU in this environment); the collective "
+            "placement shown is what XLA compiles for this mesh shape — on "
+            "real hardware the same program rides ICI. all-to-all and "
+            "collective-permute are forbidden by design in every family."
+        ),
+        "ok": ok,
+        "families": families,
+    }
+    print("all families match design:", ok)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=1)
+        print("wrote", args.json)
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

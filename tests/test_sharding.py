@@ -176,3 +176,21 @@ def test_bsi_facade_mesh_routing():
             bsi.compare(Operation.RANGE, med // 2, med, found, mode="cpu")
     finally:
         config.mesh = None
+
+
+def test_wide_or_collective_layout():
+    """Pin the compiled collective layout (VERDICT r3 weak #7): the sharded
+    wide-OR must lower to exactly one containers-axis all-gather (the OR
+    tree) plus one words-axis all-reduce (the popcount psum), and must
+    never introduce all-to-all or collective-permute. The full per-family
+    report is committed by scripts/hlo_report.py."""
+    import jax.numpy as jnp
+
+    from roaringbitmap_tpu.parallel import sharding
+
+    mesh = sharding.make_mesh(8)
+    rng = np.random.default_rng(3)
+    rows = jnp.asarray(rng.integers(0, 1 << 32, (16, 1024), dtype=np.uint64).astype(np.uint32))
+    counts = sharding.collective_summary(sharding.distributed_wide_or_cardinality(mesh), rows)
+    assert counts.get("all-gather") == 1 and counts.get("all-reduce") == 1, counts
+    assert "all-to-all" not in counts and "collective-permute" not in counts
