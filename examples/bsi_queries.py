@@ -48,6 +48,13 @@ def main():
     small.set_values((np.arange(6, dtype=np.uint32), np.array([3, 1, 3, 2, 3, 1])))
     print("distinct values:", sorted(small.transpose().to_array().tolist()))
 
+    # bulk point reads: one vectorized membership pass per slice answers a
+    # whole batch of columns (vs one get_value walk per column)
+    probe = np.arange(0, 1000, 7, dtype=np.uint32)
+    values, exists = index.get_values(probe)
+    assert (values[exists] == scores[probe[exists]]).all()
+    print(f"bulk-read {probe.size} columns, {int(exists.sum())} present")
+
 
 if __name__ == "__main__":
     main()

@@ -408,6 +408,35 @@ def run_campaign(iterations: Optional[int] = None, verbose: bool = True) -> dict
         ),
         actual=max(1, n // 8),
     )
+    # round-4 surfaces: reference wire-format roundtrip, bulk reads,
+    # 64-bit vectorized membership
+    _run(
+        "rangebitmap-wire-roundtrip",
+        lambda: verify_invariance(
+            "rangebitmap-wire-roundtrip",
+            _rangebitmap_wire_pred,
+            arity=1, iterations=max(1, n // 8), seed=45,
+        ),
+        actual=max(1, n // 8),
+    )
+    _run(
+        "bsi-bulk-reads-agree",
+        lambda: verify_invariance(
+            "bsi-bulk-reads-agree",
+            _bsi_bulk_pred,
+            arity=1, iterations=max(1, n // 8), seed=46,
+        ),
+        actual=max(1, n // 8),
+    )
+    _run(
+        "contains-many-64-agrees",
+        lambda: verify_invariance(
+            "contains-many-64-agrees",
+            _contains_many64_pred,
+            arity=1, iterations=max(1, n // 8), seed=47,
+        ),
+        actual=max(1, n // 8),
+    )
     return results
 
 
@@ -432,6 +461,86 @@ def _iterators_pred(a) -> bool:
         batches.append(b)
     got = np.concatenate(batches) if batches else np.empty(0, dtype=np.uint32)
     return np.array_equal(got, arr)
+
+
+def _rangebitmap_wire_pred(a) -> bool:
+    """RangeBitmap reference-format invariants: the bitmap's values become
+    a value column; the sealed index must answer identically through the
+    builder, the mapped reference bytes, the mapped native bytes, and a
+    native->reference re-encode (the wire inversion is an involution)."""
+    from .models.range_bitmap import RangeBitmap
+
+    arr = a.to_array()
+    if arr.size == 0:
+        return True
+    vals = (arr.astype(np.uint64) * np.uint64(2654435761)) % np.uint64(100_000)
+    app = RangeBitmap.appender(99_999)
+    app.add_many(vals)
+    built = app.build()
+    q = int(vals[len(vals) // 2])
+    want = built.lte(q).to_array()
+    want_between = built.between_cardinality(q // 2, q)
+    java, native = built.serialize(form="java"), built.serialize(form="native")
+    for data in (java, native, RangeBitmap.map(native).serialize(form="java")):
+        m = RangeBitmap.map(data)
+        if not np.array_equal(m.lte(q).to_array(), want):
+            return False
+        if m.between_cardinality(q // 2, q) != want_between:
+            return False
+    return RangeBitmap.map(java).serialize() == java
+
+
+def _bsi_bulk_pred(a) -> bool:
+    """BSI bulk get_values must agree with per-column get_value on a probe
+    mix of present and absent columns (and the 64-bit twin likewise)."""
+    from .models.bsi import RoaringBitmapSliceIndex
+    from .models.bsi64 import Roaring64BitmapSliceIndex
+
+    cols = a.to_array()
+    if cols.size == 0:
+        return True
+    vals = (cols.astype(np.int64) * 7919) % (1 << 20)
+    b = RoaringBitmapSliceIndex()
+    b.set_values((cols, vals))
+    probe = np.concatenate([cols[::7][:64], (cols[:32].astype(np.int64) + 1).astype(np.uint32)])
+    got_v, got_e = b.get_values(probe)
+    for p, v, e in zip(probe.tolist(), got_v.tolist(), got_e.tolist()):
+        if (v, e) != b.get_value(p):
+            return False
+    b64 = Roaring64BitmapSliceIndex()
+    cols64 = cols[:128].astype(np.uint64) << np.uint64(17)
+    b64.set_values((cols64, vals[:128]))
+    probe64 = np.concatenate([cols64[::3], cols64[:8] + np.uint64(1)])
+    v64, e64 = b64.get_values(probe64)
+    for p, v, e in zip(probe64.tolist(), list(v64), e64.tolist()):
+        if (v, e) != b64.get_value(int(p)):
+            return False
+    return True
+
+
+def _contains_many64_pred(a) -> bool:
+    """Vectorized 64-bit membership agrees with scalar contains on both
+    designs, over hits, misses, and cross-bucket probes."""
+    from .models.roaring64 import Roaring64NavigableMap
+    from .models.roaring64art import Roaring64Bitmap
+
+    arr = a.to_array()
+    if arr.size == 0:
+        return True
+    # size-capped: the <<33 spread scatters values across thousands of
+    # high-48 chunks, so uncapped construction (not the probes) dominated
+    # the family's wall clock; diversity across iterations matters more
+    vals = (arr.astype(np.uint64) | (arr.astype(np.uint64) << np.uint64(33)))[:2048]
+    probe = np.concatenate(
+        [vals[::11][:32], vals[:16] ^ np.uint64(1 << 63), vals[:8] + np.uint64(1)]
+    )
+    for cls in (Roaring64Bitmap, Roaring64NavigableMap):
+        bm = cls(vals)
+        got = bm.contains_many(probe)
+        for p, g in zip(probe.tolist(), got.tolist()):
+            if g != bm.contains(int(p)):
+                return False
+    return True
 
 
 def _cross64(a, b) -> bool:
