@@ -742,14 +742,11 @@ class RoaringBitmapSliceIndex:
         exactly one BSI from the stream, leaving the position at the next
         byte, so back-to-back indexes read sequentially. Subclasses
         (MutableBitSliceIndex) return their own type."""
-        header = fileobj.read(9)
-        if len(header) < 9:
-            raise InvalidRoaringFormat("truncated BSI header")
+        from ..serialization import read_exact
+
+        header = read_exact(fileobj, 9)
         ebm = RoaringBitmap.deserialize_from(fileobj)
-        count_raw = fileobj.read(4)
-        if len(count_raw) < 4:
-            raise InvalidRoaringFormat("truncated BSI slice count")
-        (depth,) = struct.unpack("<i", count_raw)
+        (depth,) = struct.unpack("<i", read_exact(fileobj, 4))
         if depth < 0 or depth > 64:
             raise InvalidRoaringFormat(f"implausible BSI depth {depth}")
         min_v, max_v, ro = struct.unpack("<iib", header)

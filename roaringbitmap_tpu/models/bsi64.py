@@ -587,6 +587,31 @@ class Roaring64BitmapSliceIndex:
             out.slices.append(s)
         return out
 
+    def serialize_into(self, fileobj) -> int:
+        """Stream overload (the reference's WritableUtils DataOutput path);
+        returns bytes written."""
+        data = self.serialize()
+        fileobj.write(data)
+        return len(data)
+
+    @staticmethod
+    def deserialize_from(fileobj) -> "Roaring64BitmapSliceIndex":
+        """Stream twin: consumes exactly one 64-bit BSI (header, ebm,
+        depth, slices — each member through Roaring64Bitmap's
+        exact-consumption stream reader)."""
+        from ..serialization import read_exact
+
+        min_v, max_v, ro = struct.unpack("<QQb", read_exact(fileobj, 17))
+        out = Roaring64BitmapSliceIndex()
+        out.min_value, out.max_value = min_v, max_v
+        out.run_optimized = bool(ro)
+        out.ebm = Roaring64Bitmap.deserialize_from(fileobj)
+        (depth,) = struct.unpack("<i", read_exact(fileobj, 4))
+        if depth < 0 or depth > 64:
+            raise InvalidRoaringFormat(f"implausible BSI depth {depth}")
+        out.slices = [Roaring64Bitmap.deserialize_from(fileobj) for _ in range(depth)]
+        return out
+
     def __eq__(self, other):
         if not isinstance(other, Roaring64BitmapSliceIndex):
             return NotImplemented

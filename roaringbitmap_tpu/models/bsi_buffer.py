@@ -263,6 +263,15 @@ class ImmutableBitSliceIndex(_RangeQueryAPI):
     def serialize(self) -> bytes:
         return self._base.serialize()
 
+    def serialize_into(self, fileobj) -> int:
+        return self._base.serialize_into(fileobj)
+
+    @staticmethod
+    def deserialize_from(fileobj) -> "ImmutableBitSliceIndex":
+        """Consume one BSI from the stream and wrap it read-only (the O(1)
+        cast; a stream cannot be lazily mapped the way a buffer can)."""
+        return ImmutableBitSliceIndex(RoaringBitmapSliceIndex.deserialize_from(fileobj))
+
     def serialized_size_in_bytes(self) -> int:
         return self._base.serialized_size_in_bytes()
 

@@ -646,6 +646,56 @@ class Roaring64NavigableMap:
         out._keys_dirty = True
         return out
 
+    def serialize_into(self, fileobj, mode: Optional[int] = None) -> int:
+        """Stream overload (the Externalizable/DataOutput path,
+        Roaring64NavigableMap.java writeExternal/serialize); returns bytes
+        written. ``mode`` as in :meth:`serialize`."""
+        data = self.serialize(mode)
+        fileobj.write(data)
+        return len(data)
+
+    @staticmethod
+    def deserialize_from(fileobj, mode: Optional[int] = None) -> "Roaring64NavigableMap":
+        """Stream twin: consumes exactly one 64-bit map in the given (or
+        active) mode, leaving the stream at the next byte — bucket payloads
+        ride RoaringBitmap.deserialize_from's exact-consumption contract."""
+        import struct
+
+        from ..serialization import read_exact
+
+        if mode is None:
+            mode = Roaring64NavigableMap.SERIALIZATION_MODE
+        legacy = mode == SERIALIZATION_MODE_LEGACY
+        header = read_exact(fileobj, 5 if legacy else 8)
+        if legacy:
+            signed, count = struct.unpack(">?i", header)
+            if count < 0:
+                raise InvalidRoaringFormat(f"implausible bucket count {count}")
+            out = Roaring64NavigableMap(signed_longs=signed)
+        else:
+            (count,) = struct.unpack("<Q", header)
+            if count > (1 << 32):
+                raise InvalidRoaringFormat(f"implausible bucket count {count}")
+            out = Roaring64NavigableMap()
+        prev_key = -1
+        for _ in range(count):
+            key_raw = read_exact(fileobj, 4)
+            if legacy:
+                (key,) = struct.unpack(">i", key_raw)
+                key &= 0xFFFFFFFF  # stored two's-complement
+                if key in out._buckets:
+                    raise InvalidRoaringFormat("duplicate bucket key")
+            else:
+                (key,) = struct.unpack("<I", key_raw)
+                if key <= prev_key:
+                    raise InvalidRoaringFormat("bucket keys not strictly increasing")
+                prev_key = key
+            bm = RoaringBitmap.deserialize_from(fileobj)
+            if not bm.is_empty():
+                out._buckets[key] = bm
+        out._keys_dirty = True
+        return out
+
     @staticmethod
     def deserialize_legacy(data) -> "Roaring64NavigableMap":
         import struct

@@ -609,6 +609,14 @@ class Roaring64Bitmap:
             4 + serialized_size_in_bytes(bm) for _, bm in self._grouped_high32()
         )
 
+    def _absorb_spec_bucket(self, high32: int, bm: RoaringBitmap) -> None:
+        """Adopt a decoded 32-bit bucket's containers under their high-48
+        chunk keys (shared by the buffer and stream readers)."""
+        arr = bm.high_low_container
+        for i in range(arr.size):
+            k = ((high32 << 16) | int(arr.keys[i])).to_bytes(6, "big")
+            self._put(k, arr.containers[i])
+
     @staticmethod
     def read_from(buf) -> Tuple["Roaring64Bitmap", int]:
         """Parse one portable-spec 64-bit bitmap from the head of `buf`,
@@ -639,15 +647,41 @@ class Roaring64Bitmap:
             prev_key = high32
             bm = RoaringBitmap()
             pos += read_into(bm, buf[pos:])
-            arr = bm.high_low_container
-            for i in range(arr.size):
-                k = ((high32 << 16) | int(arr.keys[i])).to_bytes(6, "big")
-                out._put(k, arr.containers[i])
+            out._absorb_spec_bucket(high32, bm)
         return out, pos
 
     @staticmethod
     def deserialize(data) -> "Roaring64Bitmap":
         return Roaring64Bitmap.read_from(data)[0]
+
+    def serialize_into(self, fileobj) -> int:
+        """Stream overload (Roaring64Bitmap.serialize(DataOutput),
+        longlong/Roaring64Bitmap.java:880); returns bytes written."""
+        data = self.serialize()
+        fileobj.write(data)
+        return len(data)
+
+    @staticmethod
+    def deserialize_from(fileobj) -> "Roaring64Bitmap":
+        """Stream twin: consumes exactly one portable-spec 64-bit bitmap,
+        leaving the stream at the next byte (bucket payloads stream through
+        RoaringBitmap.deserialize_from's exact-consumption contract)."""
+        import struct
+
+        from ..serialization import InvalidRoaringFormat, read_exact
+
+        (count,) = struct.unpack("<Q", read_exact(fileobj, 8))
+        if count > (1 << 32):  # u32 strictly-increasing keys cap the count
+            raise InvalidRoaringFormat(f"implausible bucket count {count}")
+        out = Roaring64Bitmap()
+        prev_key = -1
+        for _ in range(count):
+            (high32,) = struct.unpack("<I", read_exact(fileobj, 4))
+            if high32 <= prev_key:
+                raise InvalidRoaringFormat("bucket keys not strictly increasing")
+            prev_key = high32
+            out._absorb_spec_bucket(high32, RoaringBitmap.deserialize_from(fileobj))
+        return out
 
     # ------------------------------------------------------------------
     def __eq__(self, other):

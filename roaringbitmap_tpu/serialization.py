@@ -155,6 +155,27 @@ def deserialize(data: Union[bytes, bytearray, memoryview, np.ndarray]) -> Roarin
     return bm
 
 
+def read_exact(stream, n: int) -> bytes:
+    """Read exactly ``n`` bytes from a binary file-like object, looping
+    over short reads: unbuffered sources (raw sockets/pipes) may legally
+    return fewer than n bytes per read; only b"" means EOF (the io
+    contract). Shared by every stream deserializer — a single bare
+    ``read(n)`` would spuriously report truncation mid-packet."""
+    parts = []
+    got = 0
+    while got < n:
+        b = stream.read(n - got)
+        if b is None:  # non-blocking source with no data YET — not EOF
+            raise BlockingIOError(
+                "deserialize_from needs a blocking stream (read returned None)"
+            )
+        if not b:
+            raise InvalidRoaringFormat(f"truncated stream: wanted {n} bytes, got {got}")
+        parts.append(b)
+        got += len(b)
+    return b"".join(parts) if len(parts) != 1 else parts[0]
+
+
 def read_from_stream(bm: RoaringBitmap, stream) -> int:
     """Fill ``bm`` from a binary file-like object, consuming EXACTLY one
     serialized bitmap with forward-only reads (works on sockets/pipes; no
@@ -164,23 +185,7 @@ def read_from_stream(bm: RoaringBitmap, stream) -> int:
     consumed."""
 
     def need(n: int) -> bytes:
-        # unbuffered sources (raw sockets/pipes) may legally return fewer
-        # than n bytes per read; only b"" means EOF (the io contract)
-        parts = []
-        got = 0
-        while got < n:
-            b = stream.read(n - got)
-            if b is None:  # non-blocking source with no data YET — not EOF
-                raise BlockingIOError(
-                    "deserialize_from needs a blocking stream (read returned None)"
-                )
-            if not b:
-                raise InvalidRoaringFormat(
-                    f"truncated stream: wanted {n} bytes, got {got}"
-                )
-            parts.append(b)
-            got += len(b)
-        return b"".join(parts) if len(parts) != 1 else parts[0]
+        return read_exact(stream, n)
 
     head = need(4)
     (cookie,) = struct.unpack("<I", head)

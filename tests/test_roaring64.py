@@ -264,3 +264,65 @@ def test_contains_many_64bit_both_designs():
         # negative ints = two's-complement bit patterns (Java long semantics)
         neg = bm.contains_many(np.array([-1], dtype=np.int64))
         assert neg[0] == bm.contains((1 << 64) - 1)
+
+
+def test_stream_serialization_64bit():
+    """Stream overloads on both 64-bit designs and the 64-bit BSI: mixed
+    objects written back-to-back on one stream read back exactly, leaving
+    the position at the next byte (the reference's DataOutput/DataInput
+    path; Roaring64Bitmap.java:880, Roaring64NavigableMap Externalizable)."""
+    import io
+
+    from roaringbitmap_tpu.models.bsi64 import Roaring64BitmapSliceIndex
+    from roaringbitmap_tpu.models.roaring64 import (
+        SERIALIZATION_MODE_LEGACY,
+        Roaring64NavigableMap,
+    )
+    from roaringbitmap_tpu.models.roaring64art import Roaring64Bitmap
+
+    vals = np.array([1, (1 << 40) + 5, (1 << 63) + 9], dtype=np.uint64)
+    art = Roaring64Bitmap(vals)
+    nav = Roaring64NavigableMap(vals)
+    bsi = Roaring64BitmapSliceIndex()
+    bsi.set_values(([3, (1 << 45) + 1], [7, (1 << 33) + 2]))
+    buf = io.BytesIO()
+    n1 = art.serialize_into(buf)
+    n2 = nav.serialize_into(buf)
+    n3 = nav.serialize_into(buf, mode=SERIALIZATION_MODE_LEGACY)
+    n4 = bsi.serialize_into(buf)
+    assert buf.tell() == n1 + n2 + n3 + n4
+    buf.seek(0)
+    assert Roaring64Bitmap.deserialize_from(buf) == art
+    assert Roaring64NavigableMap.deserialize_from(buf) == nav
+    assert (
+        Roaring64NavigableMap.deserialize_from(buf, mode=SERIALIZATION_MODE_LEGACY)
+        == nav
+    )
+    back = Roaring64BitmapSliceIndex.deserialize_from(buf)
+    assert back == bsi and buf.read() == b""
+
+
+def test_stream_deserialize_survives_short_reads():
+    """Socket/pipe semantics: read(n) may legally return fewer bytes; the
+    stream readers must loop, not report truncation (code-review r4)."""
+    import io
+
+    from roaringbitmap_tpu.models.bsi64 import Roaring64BitmapSliceIndex
+    from roaringbitmap_tpu.models.roaring64art import Roaring64Bitmap
+
+    class Dribble(io.RawIOBase):
+        def __init__(self, data):
+            self._b = io.BytesIO(data)
+
+        def read(self, n=-1):
+            return self._b.read(min(n, 1) if n and n > 0 else n)
+
+    art = Roaring64Bitmap(np.array([1, (1 << 40) + 5], dtype=np.uint64))
+    bsi = Roaring64BitmapSliceIndex()
+    bsi.set_values(([2, (1 << 33)], [5, 1 << 20]))
+    buf = io.BytesIO()
+    art.serialize_into(buf)
+    bsi.serialize_into(buf)
+    stream = Dribble(buf.getvalue())
+    assert Roaring64Bitmap.deserialize_from(stream) == art
+    assert Roaring64BitmapSliceIndex.deserialize_from(stream) == bsi
