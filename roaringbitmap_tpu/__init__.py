@@ -53,6 +53,7 @@ from .parallel.batch import (
     batched_intersects,
     batched_op,
     pairwise_and_cardinality,
+    pairwise_cardinality,
     pairwise_jaccard,
     prepare_batched_cardinality,
 )
@@ -98,6 +99,7 @@ __all__ = [
     "batched_op",
     "prepare_batched_cardinality",
     "pairwise_and_cardinality",
+    "pairwise_cardinality",
     "pairwise_jaccard",
     "insights",
     "fuzz",

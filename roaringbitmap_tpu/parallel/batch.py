@@ -307,3 +307,31 @@ def pairwise_jaccard(
     with np.errstate(invalid="ignore"):
         sim = np.where(union > 0, inter / np.maximum(union, 1e-300), 0.0)
     return sim
+
+
+def pairwise_cardinality(
+    lefts: Sequence[RoaringBitmap],
+    rights: Sequence[RoaringBitmap],
+    op: str = "and",
+    impl: str = "auto",
+) -> np.ndarray:
+    """All-pairs cardinality matrix for any of the four ops — the batched
+    twin of the reference's scalar ``andCardinality/orCardinality/...``
+    statics (RoaringBitmap.java:413-944), which can only assemble a matrix
+    with n*m pairwise calls.
+
+    One device dispatch computes the AND matrix; OR/XOR/ANDNOT follow by
+    inclusion-exclusion from the per-set cardinalities (|A|+|B|-|A&B|,
+    |A|+|B|-2|A&B|, |A|-|A&B|) — exact in int64, no second dispatch."""
+    if op not in ("and", "or", "xor", "andnot"):
+        raise ValueError(f"op must be one of and/or/xor/andnot, got {op!r}")
+    inter = pairwise_and_cardinality(lefts, rights, impl=impl)
+    if op == "and":
+        return inter
+    lc = np.array([b.get_cardinality() for b in lefts], dtype=np.int64)
+    if op == "andnot":
+        return lc[:, None] - inter
+    rc = np.array([b.get_cardinality() for b in rights], dtype=np.int64)
+    if op == "or":
+        return lc[:, None] + rc[None, :] - inter
+    return lc[:, None] + rc[None, :] - 2 * inter

@@ -98,3 +98,32 @@ def test_pairwise_matrix_impls_agree():
     a = pairwise_and_cardinality(L, R, impl="vpu")
     b = pairwise_and_cardinality(L, R, impl="mxu")
     assert a.tolist() == b.tolist()
+
+
+def test_pairwise_cardinality_all_ops():
+    """The four-op matrix family agrees with the scalar pairwise statics
+    (the oracle the reference computes one cell at a time)."""
+    from roaringbitmap_tpu.parallel.batch import pairwise_cardinality
+
+    rng = np.random.default_rng(0xCA2D)
+    lefts = [
+        RoaringBitmap(np.unique(rng.integers(0, 1 << 18, 2000)).astype(np.uint32))
+        for _ in range(5)
+    ]
+    rights = [
+        RoaringBitmap(np.unique(rng.integers(0, 1 << 18, 3000)).astype(np.uint32))
+        for _ in range(4)
+    ] + [RoaringBitmap()]  # empty operand edge
+    scalar = {
+        "and": RoaringBitmap.and_cardinality,
+        "or": RoaringBitmap.or_cardinality,
+        "xor": RoaringBitmap.xor_cardinality,
+        "andnot": RoaringBitmap.andnot_cardinality,
+    }
+    for op, fn in scalar.items():
+        got = pairwise_cardinality(lefts, rights, op=op)
+        for i, l in enumerate(lefts):
+            for j, r in enumerate(rights):
+                assert got[i, j] == fn(l, r), (op, i, j)
+    with pytest.raises(ValueError, match="op must be"):
+        pairwise_cardinality(lefts, rights, op="nand")
