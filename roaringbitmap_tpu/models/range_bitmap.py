@@ -204,6 +204,13 @@ class _JavaMap:
         directory = {}
         for key in range(n_chunks):
             mask = int.from_bytes(buf[masks_off + key * bpm : masks_off + (key + 1) * bpm], "little")
+            if mask >> slice_count:
+                # a flagged slice past sliceCount would smuggle an orphan
+                # container through the walk (queries never read it, but
+                # accepting it would bless malformed input)
+                raise InvalidRoaringFormat(
+                    f"chunk {key} mask flags slices past sliceCount {slice_count}"
+                )
             i = 0
             while mask:
                 if mask & 1:

@@ -294,16 +294,26 @@ def pairwise_and_cardinality(
     return out
 
 
+def _inclusion_exclusion(op: str, inter: np.ndarray, lefts, rights) -> np.ndarray:
+    """Derive an or/xor/andnot cardinality matrix from the AND matrix and
+    the per-set cardinalities — exact in int64 (|A|+|B|-|A&B|,
+    |A|+|B|-2|A&B|, |A|-|A&B|). One formula source for pairwise_cardinality
+    and pairwise_jaccard."""
+    lc = np.array([b.get_cardinality() for b in lefts], dtype=np.int64)
+    if op == "andnot":
+        return lc[:, None] - inter
+    rc = np.array([b.get_cardinality() for b in rights], dtype=np.int64)
+    return lc[:, None] + rc[None, :] - (2 if op == "xor" else 1) * inter
+
+
 def pairwise_jaccard(
     lefts: Sequence[RoaringBitmap], rights: Sequence[RoaringBitmap]
 ) -> np.ndarray:
     """``out[i, j] = |L_i & R_j| / |L_i | R_j|`` (0 for two empty sets):
     the similarity matrix via one intersection-matrix dispatch plus
     inclusion-exclusion from the per-set cardinalities."""
-    inter = pairwise_and_cardinality(lefts, rights).astype(np.float64)
-    lc = np.array([b.get_cardinality() for b in lefts], dtype=np.float64)
-    rc = np.array([b.get_cardinality() for b in rights], dtype=np.float64)
-    union = lc[:, None] + rc[None, :] - inter
+    inter = pairwise_and_cardinality(lefts, rights)
+    union = _inclusion_exclusion("or", inter, lefts, rights).astype(np.float64)
     with np.errstate(invalid="ignore"):
         sim = np.where(union > 0, inter / np.maximum(union, 1e-300), 0.0)
     return sim
@@ -321,17 +331,10 @@ def pairwise_cardinality(
     with n*m pairwise calls.
 
     One device dispatch computes the AND matrix; OR/XOR/ANDNOT follow by
-    inclusion-exclusion from the per-set cardinalities (|A|+|B|-|A&B|,
-    |A|+|B|-2|A&B|, |A|-|A&B|) — exact in int64, no second dispatch."""
+    exact int64 inclusion-exclusion — no second dispatch."""
     if op not in ("and", "or", "xor", "andnot"):
         raise ValueError(f"op must be one of and/or/xor/andnot, got {op!r}")
     inter = pairwise_and_cardinality(lefts, rights, impl=impl)
     if op == "and":
         return inter
-    lc = np.array([b.get_cardinality() for b in lefts], dtype=np.int64)
-    if op == "andnot":
-        return lc[:, None] - inter
-    rc = np.array([b.get_cardinality() for b in rights], dtype=np.int64)
-    if op == "or":
-        return lc[:, None] + rc[None, :] - inter
-    return lc[:, None] + rc[None, :] - 2 * inter
+    return _inclusion_exclusion(op, inter, lefts, rights)
