@@ -191,9 +191,11 @@ def _pairwise_mxu_step():
     """The MXU formulation: popcount(a AND b) over 0/1 bit-vectors IS the
     dot product bits(a) . bits(b) — so the whole overlap matrix is a chain
     of [n, 65536] @ [65536, m] bf16 matmuls, one per key chunk, on the
-    systolic array. Exactness: 0/1 are exact in bf16; per-chunk partial
-    sums <= 65536 and f32 accumulation stays exact below 2^24 (callers
-    enforce the cardinality bound)."""
+    systolic array. Exactness: 0/1 are exact in bf16, each per-chunk
+    partial is <= 65536 (exact in f32), and the CROSS-chunk accumulation
+    runs in int32 after casting each exact partial — so the bound is the
+    int32 range (intersections < 2^31), not f32's 2^24 (round 4: the old
+    f32 accumulator capped usable cardinalities at 16.7M)."""
     global _pair_mxu_step
     if _pair_mxu_step is None:
         import jax
@@ -210,21 +212,18 @@ def _pairwise_mxu_step():
 
             def body(acc, kslice):
                 lk, rk = kslice
-                return (
-                    acc
-                    + jnp.dot(
-                        bits_of(lk),
-                        bits_of(rk).T,
-                        preferred_element_type=jnp.float32,
-                    ),
-                    None,
+                part = jnp.dot(
+                    bits_of(lk),
+                    bits_of(rk).T,
+                    preferred_element_type=jnp.float32,
                 )
+                return acc + part.astype(jnp.int32), None
 
-            init = jnp.zeros((left.shape[0], right.shape[0]), jnp.float32)
+            init = jnp.zeros((left.shape[0], right.shape[0]), jnp.int32)
             acc, _ = lax.scan(
                 body, init, (left.transpose(1, 0, 2), right.transpose(1, 0, 2))
             )
-            return acc.astype(jnp.int32)
+            return acc
 
         _pair_mxu_step = run
     return _pair_mxu_step
@@ -245,8 +244,8 @@ def pairwise_and_cardinality(
     [nb, m, K, 2048] intermediate stays under ``tile_bytes``); 'mxu'
     expresses popcounts as 0/1 bf16 matmuls over the systolic array —
     the shape that makes this matrix a native TPU workload. 'auto' picks
-    mxu on accelerators (when every cardinality is inside the exact-f32
-    bound), vpu on CPU."""
+    mxu on accelerators (when every cardinality is inside the exact
+    int32-accumulation bound, 2^31), vpu on CPU."""
     if impl not in ("auto", "vpu", "mxu"):
         raise ValueError(f"impl must be 'auto', 'vpu', or 'mxu', got {impl!r}")
     n, m = len(lefts), len(rights)
@@ -261,8 +260,12 @@ def pairwise_and_cardinality(
     )
     if not keys:  # no shared chunk: every intersection is empty
         return np.zeros((n, m), dtype=np.int64)
-    def _exact():  # f32 accumulation exactness bound for the bit-matmul
-        return all(b.get_cardinality() < (1 << 24) for b in (*lefts, *rights))
+    def _exact():
+        # int32 accumulation exactness bound for the bit-matmul: each
+        # per-chunk partial is exact in f32 (<= 65536) and cross-chunk
+        # sums run in int32, so only intersections >= 2^31 could wrap —
+        # impossible when every operand is smaller than that
+        return all(b.get_cardinality() < (1 << 31) for b in (*lefts, *rights))
 
     if impl == "auto":
         try:
@@ -272,7 +275,7 @@ def pairwise_and_cardinality(
         impl = "mxu" if (on_acc and _exact()) else "vpu"
     elif impl == "mxu" and not _exact():
         raise ValueError(
-            "impl='mxu' needs every cardinality < 2^24 (f32 accumulation "
+            "impl='mxu' needs every cardinality < 2^31 (int32 accumulation "
             "exactness); use impl='vpu' or 'auto' for larger sets"
         )
     kidx = {k: i for i, k in enumerate(keys)}

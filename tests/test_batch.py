@@ -85,8 +85,8 @@ def test_pairwise_and_cardinality_matrix():
 
 def test_pairwise_matrix_impls_agree():
     """VPU broadcast and MXU bit-matmul formulations produce identical
-    matrices (the matmul is exact: 0/1 bf16 operands, f32 accumulation
-    under the 2^24 cardinality bound)."""
+    matrices (the matmul is exact: 0/1 bf16 operands, per-chunk f32
+    partials cast to an int32 accumulator — bound 2^31)."""
     from roaringbitmap_tpu.parallel.batch import pairwise_and_cardinality
 
     rng = np.random.default_rng(67)
@@ -127,3 +127,20 @@ def test_pairwise_cardinality_all_ops():
                 assert got[i, j] == fn(l, r), (op, i, j)
     with pytest.raises(ValueError, match="op must be"):
         pairwise_cardinality(lefts, rights, op="nand")
+
+
+def test_pairwise_mxu_exact_beyond_f32():
+    """Intersections past f32's 2^24 integer range must stay exact — the
+    case the old f32 cross-chunk accumulator silently rounded (round 4:
+    per-chunk partials now cast to an int32 accumulator)."""
+    from roaringbitmap_tpu.parallel.batch import pairwise_and_cardinality
+
+    n = (1 << 24) + 3  # 16777219: not representable in f32
+    a = RoaringBitmap.bitmap_of_range(0, n)
+    b = RoaringBitmap.bitmap_of_range(0, n)
+    got = pairwise_and_cardinality([a], [b], impl="mxu")
+    assert got[0, 0] == n
+    # and the raised guard rejects only truly unrepresentable operands
+    with pytest.raises(ValueError, match="2\\^31"):
+        huge = RoaringBitmap.bitmap_of_range(0, 1 << 31)
+        pairwise_and_cardinality([huge], [huge], impl="mxu")
