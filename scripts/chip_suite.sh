@@ -68,6 +68,10 @@ trap commit_artifacts EXIT
   commit_artifacts
   echo "--- tile sweep (incl. flagship [66,1450,2048] + gap-closing variants)"
   timeout 2400 python -u scripts/tile_sweep.py --json "$ART/tile_sweep.json" 2>&1 | grep -v WARNING
+  if [ -f "$ART/tile_sweep.json" ]; then
+    echo "--- sweep digest (flagship Pallas-vs-XLA verdict)"
+    python scripts/sweep_digest.py "$ART/tile_sweep.json" --json "$ART/sweep_digest.json" || true
+  fi
   commit_artifacts
   echo "--- bench.py (north star)"
   timeout 900 env BENCH_JSON_OUT="$ART/bench_tpu.json" python -u bench.py 2>&1 | grep -v WARNING

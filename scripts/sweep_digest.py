@@ -1,0 +1,98 @@
+"""Digest a tile_sweep.json artifact into the decision VERDICT r3 #2 asks
+for: at each swept shape, the best Pallas config vs the XLA reduce, with
+the flagship [66,1450,2048] verdict called out — the input to either
+flipping GROUPED_PREFER_XLA (Pallas wins) or writing the why-XLA-wins
+post-mortem (it doesn't).
+
+Run:  python scripts/sweep_digest.py chip_artifacts/<stamp>/tile_sweep.json [--json OUT]
+"""
+
+import argparse
+import json
+
+
+def digest(sweep: dict) -> dict:
+    by_shape: dict = {}
+    for rec in sweep.get("records", []):
+        if "gbps" not in rec:
+            continue
+        key = (rec["kind"], tuple(rec["shape"]))
+        entry = by_shape.setdefault(key, {"xla": None, "best_pallas": None})
+        if rec["config"].startswith("xla") and "2stage" not in rec["config"]:
+            entry["xla"] = rec
+        elif rec["config"].startswith("pallas"):
+            if entry["best_pallas"] is None or rec["gbps"] > entry["best_pallas"]["gbps"]:
+                entry["best_pallas"] = rec
+        elif rec["config"].startswith("xla 2stage"):
+            if entry.get("best_2stage") is None or rec["gbps"] > entry["best_2stage"]["gbps"]:
+                entry["best_2stage"] = rec
+    rows = []
+    for (kind, shape), entry in sorted(by_shape.items()):
+        xla, pal = entry["xla"], entry["best_pallas"]
+        row = {
+            "kind": kind,
+            "shape": list(shape),
+            "xla_gbps": xla and xla["gbps"],
+            "best_pallas_gbps": pal and pal["gbps"],
+            "best_pallas_config": pal and pal["config"],
+            "pallas_over_xla": (
+                round(pal["gbps"] / xla["gbps"], 3) if pal and xla and xla["gbps"] else None
+            ),
+        }
+        if entry.get("best_2stage"):
+            row["best_2stage_gbps"] = entry["best_2stage"]["gbps"]
+            row["best_2stage_config"] = entry["best_2stage"]["config"]
+        rows.append(row)
+    flagship = next(
+        (r for r in rows if r["kind"] == "grouped" and r["shape"] == [66, 1450, 2048]),
+        None,
+    )
+    verdict = None
+    if flagship and flagship["pallas_over_xla"] is not None:
+        # decide on the raw GB/s, not the display-rounded ratio: a
+        # 0.9996 ratio rounds to 1.0 and must NOT read as a Pallas win
+        # (code-review r4)
+        if flagship["best_pallas_gbps"] >= flagship["xla_gbps"]:
+            verdict = (
+                f"PALLAS WINS the flagship shape ({flagship['best_pallas_config']}, "
+                f"{flagship['pallas_over_xla']}x XLA): flip GROUPED_PREFER_XLA to "
+                "False and cite this artifact"
+            )
+        else:
+            verdict = (
+                f"XLA holds the flagship shape ({flagship['pallas_over_xla']}x); "
+                "record the per-variant table as the VERDICT r3 #2 post-mortem "
+                "evidence and keep GROUPED_PREFER_XLA=True"
+            )
+    return {
+        "generated_from": sweep.get("generated_utc"),
+        "backend": sweep.get("backend"),
+        "shapes": rows,
+        "flagship": flagship,
+        "flagship_verdict": verdict,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("sweep_json")
+    ap.add_argument("--json", help="write the digest here")
+    args = ap.parse_args()
+    with open(args.sweep_json) as f:
+        out = digest(json.load(f))
+    for r in out["shapes"]:
+        print(
+            f"{r['kind']:<8} {str(r['shape']):<18} xla {r['xla_gbps'] or '-':>7} "
+            f"best-pallas {r['best_pallas_gbps'] or '-':>7} "
+            f"ratio {r['pallas_over_xla'] or '-'}  ({r['best_pallas_config'] or '-'})"
+        )
+    if out["flagship_verdict"]:
+        print("\n" + out["flagship_verdict"])
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=1)
+        print("wrote", args.json)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+"""The chip-suite sweep digest must call the flagship Pallas-vs-XLA
+verdict correctly (it is the decision input for VERDICT r3 #2)."""
+
+import importlib.util
+import os
+
+spec = importlib.util.spec_from_file_location(
+    "sweep_digest",
+    os.path.join(os.path.dirname(__file__), "..", "scripts", "sweep_digest.py"),
+)
+sweep_digest = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(sweep_digest)
+
+
+def _sweep(flagship_pallas_gbps):
+    return {
+        "generated_utc": "2026-07-30T00:00:00Z",
+        "backend": "tpu",
+        "records": [
+            {"kind": "wide", "shape": [16384, 2048], "config": "xla", "gbps": 59.0, "ms": 1.0},
+            {"kind": "wide", "shape": [16384, 2048], "config": "xla 2stage g=128", "gbps": 140.0, "ms": 0.5},
+            {"kind": "wide", "shape": [16384, 2048], "config": "pallas row_tile=256", "gbps": 80.0, "ms": 0.9},
+            {"kind": "grouped", "shape": [66, 1450, 2048], "config": "xla", "gbps": 423.0, "ms": 1.9},
+            {"kind": "grouped", "shape": [66, 1450, 2048], "config": "pallas g_tile=8 row_tile=64", "gbps": 137.0, "ms": 5.7},
+            {"kind": "grouped", "shape": [66, 1450, 2048], "config": "pallas g_tile=8 row_tile=128 w_tile=512", "gbps": flagship_pallas_gbps, "ms": 1.0},
+            {"kind": "grouped", "shape": [66, 1450, 2048], "config": "pallas broken", "error": "boom"},
+        ],
+    }
+
+
+def test_digest_xla_holds():
+    out = sweep_digest.digest(_sweep(300.0))
+    f = out["flagship"]
+    assert f["xla_gbps"] == 423.0 and f["best_pallas_gbps"] == 300.0
+    assert f["pallas_over_xla"] == round(300.0 / 423.0, 3)
+    assert "XLA holds" in out["flagship_verdict"]
+    wide = next(r for r in out["shapes"] if r["kind"] == "wide")
+    assert wide["best_2stage_gbps"] == 140.0
+
+
+def test_digest_pallas_wins():
+    out = sweep_digest.digest(_sweep(460.0))
+    assert "PALLAS WINS" in out["flagship_verdict"]
+    assert "w_tile=512" in out["flagship"]["best_pallas_config"]
+
+
+def test_digest_handles_missing_flagship():
+    sweep = _sweep(1.0)
+    sweep["records"] = [r for r in sweep["records"] if r["kind"] == "wide"]
+    out = sweep_digest.digest(sweep)
+    assert out["flagship"] is None and out["flagship_verdict"] is None
+
+
+def test_digest_near_parity_is_not_a_win():
+    """A sub-parity ratio that display-rounds to 1.0 must not advise
+    flipping the dispatcher (code-review r4)."""
+    out = sweep_digest.digest(_sweep(422.9))  # vs xla 423.0: ratio 0.99976
+    assert out["flagship"]["pallas_over_xla"] == 1.0  # display rounding
+    assert "XLA holds" in out["flagship_verdict"]
