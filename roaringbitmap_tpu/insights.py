@@ -75,12 +75,13 @@ def dispatch_counters() -> dict:
         outcomes (True = kernel serves this shape, False = fell back).
     """
     from .ops import pallas_kernels as pk
-    from .parallel import store
+    from .parallel import batch, store
 
     return {
         "kernel": {f"{k[0]}/{k[1]}": v for k, v in pk.DISPATCH_COUNTS.items()},
         "layout": dict(store.LAYOUT_COUNTS),
         "transfer_bytes": dict(store.TRANSFER_BYTES),
+        "pairwise": dict(batch.PAIRWISE_COUNTS),
         "probes": {
             f"{k[0]}/{k[1]}/{'x'.join(map(str, k[2]))}/{k[3]}": v
             for k, v in pk._PROBED.items()
@@ -99,11 +100,12 @@ def native_backend() -> str:
 
 def reset_dispatch_counters() -> None:
     from .ops import pallas_kernels as pk
-    from .parallel import store
+    from .parallel import batch, store
 
     pk.DISPATCH_COUNTS.clear()
     store.LAYOUT_COUNTS.clear()
     store.TRANSFER_BYTES.clear()
+    batch.PAIRWISE_COUNTS.clear()
 
 
 def recommend(stats: BitmapStatistics) -> str:

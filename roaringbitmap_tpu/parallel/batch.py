@@ -12,6 +12,7 @@ Host marshal is O(total values); results come back either as counts
 
 from __future__ import annotations
 
+from collections import Counter
 from typing import List, Sequence
 
 import numpy as np
@@ -19,6 +20,10 @@ import numpy as np
 from ..models.roaring import RoaringBitmap
 from ..ops import device as dev
 from . import store
+
+# observability: which engine served each pairwise-matrix dispatch
+# ("mxu" | "vpu"), surfaced via insights.dispatch_counters()["pairwise"]
+PAIRWISE_COUNTS: Counter = Counter()
 
 
 def _pack_one_vs_many(one: RoaringBitmap, many: Sequence[RoaringBitmap]):
@@ -281,6 +286,7 @@ def pairwise_and_cardinality(
     kidx = {k: i for i, k in enumerate(keys)}
     lw = _pack_sets(lefts, keys, kidx)
     rw_host = _pack_sets(rights, keys, kidx)
+    PAIRWISE_COUNTS[impl] += 1
     if impl == "mxu":
         return (
             np.asarray(_pairwise_mxu_step()(jnp.asarray(lw), jnp.asarray(rw_host)))

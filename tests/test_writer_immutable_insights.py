@@ -187,6 +187,11 @@ def test_insights_dispatch_counters():
     assert sum(counters["kernel"].values()) >= 0  # xla on cpu backend
     # the serving host-kernel tier is attributable too
     assert counters["native"] in ("ext", "ctypes", "numpy")
+    # pairwise-matrix dispatches are attributable to their engine
+    from roaringbitmap_tpu.parallel.batch import pairwise_and_cardinality
+
+    pairwise_and_cardinality(bms[:2], bms[1:], impl="vpu")
+    assert insights.dispatch_counters()["pairwise"] == {"vpu": 1}
     # repeat aggregation on the same working set must not re-pad: the cached
     # padded device array object is reused identically (VERDICT r2 weak #8)
     cached = packed.padded_device(0)
