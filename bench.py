@@ -143,8 +143,10 @@ def main():
         k_reps = 64
         tpu_s, total = steady_state_grouped(packed.padded_device(0), op="or", k=k_reps)
         assert total == k_reps * cpu_card, f"steady-state total {total} != {k_reps}x{cpu_card}"
+        timing_mode = "steady_state_k64"
     else:  # segmented working sets keep the per-dispatch number
         tpu_s = dispatch_s
+        timing_mode = "per_dispatch"
 
     value = 1.0 / tpu_s  # wide-OR aggregations of the 10k working set per sec
     vs_baseline = cpu_s / tpu_s
@@ -183,6 +185,10 @@ def main():
         "layout": layout,
         "cardinality": int(cpu_card),
         "cpu_fold_s": round(cpu_s, 4),
+        # which methodology produced tpu_reduce_s (VERDICT r3 weak #4: the
+        # steady-state/per-dispatch asymmetry between backends must be
+        # visible in the artifact, not only in prose)
+        "timing_mode": timing_mode,
         "tpu_reduce_s": round(tpu_s, 6),
         "tpu_dispatch_s": round(dispatch_s, 6),
         "pack_s": round(pack_s, 4),

@@ -19,7 +19,9 @@ VALUES_PER_BITMAP = 5000
 def main():
     import bench
 
-    if not bench._probe_backend():
+    # short probe: an example should fall back within a minute, not hold
+    # run_all hostage for bench.py's full 180 s patience
+    if not bench._probe_backend(timeout_s=60):
         import jax
 
         print("(TPU backend unreachable; running the same path on CPU)")
