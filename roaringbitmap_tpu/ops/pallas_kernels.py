@@ -711,14 +711,16 @@ def on_tpu() -> bool:
         return False
 
 
-def _probed_call(kind: str, fn, args, op: str):
+def _probed_call(kind: str, fn, args, op: str, key_extra: Tuple = ()):
     """Run a Pallas entry point with a one-time per-shape lowering probe.
 
     Mosaic lowering errors surface at (synchronous) compile time on the
     first call; the probe also blocks on the result once to flush deferred
-    runtime failures. Any failure marks the (kind, op, shape, backend) key
-    bad so subsequent calls go straight to XLA."""
-    key = (kind, op, tuple(args[0].shape), jax.default_backend())
+    runtime failures. Any failure marks the (kind, op, shape, backend[,
+    key_extra]) key bad so subsequent calls go straight to XLA —
+    ``key_extra`` carries the dispatcher's tiling config so changing it
+    re-probes instead of reusing a stale verdict."""
+    key = (kind, op, tuple(args[0].shape), jax.default_backend(), *key_extra)
     ok = _PROBED.get(key)
     if ok is False:
         return None
@@ -754,13 +756,40 @@ def best_wide_reduce(words, op: str = "or"):
 # explicitly and as the probe-validated alternative.
 GROUPED_PREFER_XLA = True
 
+# When a sweep crowns a non-default Pallas config (scripts/sweep_digest.py
+# flagship verdict), set the winning kwargs here alongside flipping
+# GROUPED_PREFER_XLA — the dispatcher applies them on every probed call,
+# so the flip actually serves the measured-best variant, not the default
+# tiling (e.g. {"row_tile": 128, "w_tile": 512, "fold": "linear"}).
+GROUPED_PALLAS_CONFIG: Dict = {}
+
 
 def best_grouped_reduce(words3, op: str = "or"):
     """Measured-best grouped reduce: XLA by default (see GROUPED_PREFER_XLA),
-    the Pallas kernel (with lowering probe + automatic XLA fallback) when
-    preferred."""
+    the Pallas kernel — at GROUPED_PALLAS_CONFIG's tiling — with lowering
+    probe + automatic XLA fallback when preferred."""
     if not GROUPED_PREFER_XLA and HAS_PALLAS and on_tpu():
-        out = _probed_call("grouped", grouped_reduce_cardinality_pallas, (words3,), op)
+        # validate loudly BEFORE the probe: a typo'd kwarg would otherwise
+        # raise inside the probed call, be recorded as a lowering failure,
+        # and permanently pin the XLA fallback with no signal
+        bad = set(GROUPED_PALLAS_CONFIG) - {"g_tile", "row_tile", "w_tile", "fold", "dimsem"}
+        if bad:
+            raise ValueError(
+                f"GROUPED_PALLAS_CONFIG has unknown keys {sorted(bad)}; "
+                "valid: g_tile, row_tile, w_tile, fold, dimsem"
+            )
+        key_extra = (tuple(sorted(GROUPED_PALLAS_CONFIG.items())),)
+        try:
+            hash(key_extra)
+        except TypeError as e:
+            raise ValueError(f"GROUPED_PALLAS_CONFIG values must be hashable: {e}") from None
+        out = _probed_call(
+            "grouped",
+            functools.partial(grouped_reduce_cardinality_pallas, **GROUPED_PALLAS_CONFIG),
+            (words3,),
+            op,
+            key_extra=key_extra,
+        )
         if out is not None:
             DISPATCH_COUNTS[("grouped", "pallas")] += 1
             return out

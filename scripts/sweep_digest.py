@@ -35,6 +35,7 @@ def digest(sweep: dict) -> dict:
             "xla_gbps": xla and xla["gbps"],
             "best_pallas_gbps": pal and pal["gbps"],
             "best_pallas_config": pal and pal["config"],
+            "best_pallas_params": pal and pal.get("params"),
             "pallas_over_xla": (
                 round(pal["gbps"] / xla["gbps"], 3) if pal and xla and xla["gbps"] else None
             ),
@@ -56,7 +57,9 @@ def digest(sweep: dict) -> dict:
             verdict = (
                 f"PALLAS WINS the flagship shape ({flagship['best_pallas_config']}, "
                 f"{flagship['pallas_over_xla']}x XLA): flip GROUPED_PREFER_XLA to "
-                "False and cite this artifact"
+                f"False AND set GROUPED_PALLAS_CONFIG = "
+                f"{flagship['best_pallas_params']} (flipping alone serves the "
+                "default tiling, not this winner), citing this artifact"
             )
         else:
             verdict = (

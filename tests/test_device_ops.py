@@ -524,3 +524,64 @@ def test_w_tile_must_be_mosaic_legal():
         pk.wide_plan(256, 2048, w_tile=64)
     with pytest.raises(ValueError, match="128"):
         pk.grouped_plan(8, 64, 2048, w_tile=64)
+
+
+def test_grouped_pallas_config_reaches_kernel(monkeypatch):
+    """A sweep-crowned tiling in GROUPED_PALLAS_CONFIG must be applied by
+    the dispatcher (flipping GROUPED_PREFER_XLA alone would otherwise
+    serve the default tiling, not the measured winner), and changing the
+    config must re-probe rather than reuse a stale verdict."""
+    import jax.numpy as jnp
+
+    from roaringbitmap_tpu.ops import pallas_kernels as pk
+
+    seen = []
+
+    def fake_kernel(words3, op="or", **kw):
+        seen.append(kw)
+        import numpy as _np
+
+        host = _np.asarray(words3)
+        red = _np.bitwise_or.reduce(host, axis=1)
+        cards = _np.unpackbits(red.view(_np.uint8), axis=-1).sum(axis=-1)
+        return jnp.asarray(red), jnp.asarray(cards.astype(_np.int32))
+
+    monkeypatch.setattr(pk, "grouped_reduce_cardinality_pallas", fake_kernel)
+    monkeypatch.setattr(pk, "on_tpu", lambda: True)
+    monkeypatch.setattr(pk, "HAS_PALLAS", True)
+    monkeypatch.setattr(pk, "GROUPED_PREFER_XLA", False)
+    cfg = {"row_tile": 128, "w_tile": 512, "fold": "linear"}
+    monkeypatch.setattr(pk, "GROUPED_PALLAS_CONFIG", cfg)
+    pk._PROBED.clear()
+    rng = np.random.default_rng(71)
+    host = rng.integers(0, 1 << 32, size=(4, 3, 2048), dtype=np.uint64).astype(np.uint32)
+    arr = jnp.asarray(host)
+    red, _ = pk.best_grouped_reduce(arr, op="or")
+    assert np.array_equal(np.asarray(red), np.bitwise_or.reduce(host, axis=1))
+    assert seen[-1] == cfg
+    # a different config is a different probe key: the kernel is probed again
+    monkeypatch.setattr(pk, "GROUPED_PALLAS_CONFIG", {"row_tile": 64})
+    n_before = len(seen)
+    pk.best_grouped_reduce(arr, op="or")
+    assert len(seen) > n_before and seen[-1] == {"row_tile": 64}
+    pk._PROBED.clear()
+
+
+def test_grouped_pallas_config_validated_loudly(monkeypatch):
+    """Misconfiguration must raise, not silently pin the XLA fallback via
+    a probe marked bad (code-review r4)."""
+    import jax.numpy as jnp
+
+    from roaringbitmap_tpu.ops import pallas_kernels as pk
+
+    monkeypatch.setattr(pk, "on_tpu", lambda: True)
+    monkeypatch.setattr(pk, "HAS_PALLAS", True)
+    monkeypatch.setattr(pk, "GROUPED_PREFER_XLA", False)
+    arr = jnp.zeros((2, 2, 2048), dtype=jnp.uint32)
+    monkeypatch.setattr(pk, "GROUPED_PALLAS_CONFIG", {"rowtile": 128})  # typo
+    with pytest.raises(ValueError, match="unknown keys"):
+        pk.best_grouped_reduce(arr, op="or")
+    monkeypatch.setattr(pk, "GROUPED_PALLAS_CONFIG", {"w_tile": [512]})  # unhashable
+    with pytest.raises(ValueError, match="hashable"):
+        pk.best_grouped_reduce(arr, op="or")
+    pk._PROBED.clear()

@@ -42,6 +42,8 @@ def test_digest_pallas_wins():
     out = sweep_digest.digest(_sweep(460.0))
     assert "PALLAS WINS" in out["flagship_verdict"]
     assert "w_tile=512" in out["flagship"]["best_pallas_config"]
+    # the verdict must name the config to set, not just the flag to flip
+    assert "GROUPED_PALLAS_CONFIG" in out["flagship_verdict"]
 
 
 def test_digest_handles_missing_flagship():
