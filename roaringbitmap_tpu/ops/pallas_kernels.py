@@ -735,14 +735,76 @@ def _probed_call(kind: str, fn, args, op: str, key_extra: Tuple = ()):
         return None
 
 
+# Wide-family dispatch policy (the measured winner at the flat [N, 2048]
+# shape — the family stuck at ~58 GB/s in round 3, with the two-stage XLA
+# reduce and the w-split/linear Pallas variants staged to be measured):
+#   "pallas"    — the Pallas kernel at WIDE_CONFIG's tiling, probed, XLA
+#                 fallback (the round-3 default);
+#   "two_stage" — dev.wide_reduce_two_stage at WIDE_CONFIG (stage_groups=);
+#   "xla"       — the stock one-shot XLA reduce.
+# Set both the policy and WIDE_CONFIG per the sweep digest, as with
+# GROUPED_PREFER_XLA / GROUPED_PALLAS_CONFIG.
+WIDE_DISPATCH = "pallas"
+WIDE_CONFIG: Dict = {}
+
+_WIDE_CONFIG_KEYS = {
+    "pallas": {"row_tile", "w_tile", "fold", "dimsem"},
+    "two_stage": {"stage_groups"},
+    "xla": set(),
+}
+GROUPED_CONFIG_KEYS = {"g_tile", "row_tile", "w_tile", "fold", "dimsem"}
+
+
+def _validated_key_extra(cfg: Dict, valid_keys, name: str) -> Tuple:
+    """Validate a dispatcher config loudly and derive its probe-key token.
+    A typo'd key or unhashable value must raise here, BEFORE the probed
+    call — inside it, the blanket probe except would record the TypeError
+    as a lowering failure and silently pin the XLA fallback."""
+    bad = set(cfg) - set(valid_keys)
+    if bad:
+        raise ValueError(
+            f"{name} has unknown keys {sorted(bad)}; valid: {sorted(valid_keys)}"
+        )
+    key_extra = (tuple(sorted(cfg.items())),)
+    try:
+        hash(key_extra)
+    except TypeError as e:
+        raise ValueError(f"{name} values must be hashable: {e}") from None
+    return key_extra
+
+
 def best_wide_reduce(words, op: str = "or"):
-    """Pick the Pallas kernel on TPU (with lowering probe + automatic XLA
-    fallback), XLA reduce elsewhere."""
-    if HAS_PALLAS and on_tpu():
-        out = _probed_call("wide", wide_reduce_cardinality_pallas, (words,), op)
-        if out is not None:
-            DISPATCH_COUNTS[("wide", "pallas")] += 1
-            return out
+    """Measured-best wide reduce per WIDE_DISPATCH: the Pallas kernel (with
+    lowering probe + automatic XLA fallback) by default, the two-stage or
+    one-shot XLA reduce when the sweep crowns them. Off-TPU always serves
+    the XLA reduce."""
+    policy = WIDE_DISPATCH
+    if policy not in _WIDE_CONFIG_KEYS:
+        raise ValueError(f"WIDE_DISPATCH must be pallas/two_stage/xla, got {policy!r}")
+    bad = set(WIDE_CONFIG) - _WIDE_CONFIG_KEYS[policy]
+    if bad:
+        raise ValueError(
+            f"WIDE_CONFIG has keys {sorted(bad)} invalid for policy {policy!r}; "
+            f"valid: {sorted(_WIDE_CONFIG_KEYS[policy])}"
+        )
+    if on_tpu():
+        if policy == "pallas" and HAS_PALLAS:
+            key_extra = _validated_key_extra(
+                WIDE_CONFIG, _WIDE_CONFIG_KEYS["pallas"], "WIDE_CONFIG"
+            )
+            out = _probed_call(
+                "wide",
+                functools.partial(wide_reduce_cardinality_pallas, **WIDE_CONFIG),
+                (words,),
+                op,
+                key_extra=key_extra,
+            )
+            if out is not None:
+                DISPATCH_COUNTS[("wide", "pallas")] += 1
+                return out
+        elif policy == "two_stage":
+            DISPATCH_COUNTS[("wide", "two_stage")] += 1
+            return dev.wide_reduce_two_stage(words, op=op, **WIDE_CONFIG)
     DISPATCH_COUNTS[("wide", "xla")] += 1
     return dev.wide_reduce_with_cardinality(words, op=op)
 
@@ -769,20 +831,9 @@ def best_grouped_reduce(words3, op: str = "or"):
     the Pallas kernel — at GROUPED_PALLAS_CONFIG's tiling — with lowering
     probe + automatic XLA fallback when preferred."""
     if not GROUPED_PREFER_XLA and HAS_PALLAS and on_tpu():
-        # validate loudly BEFORE the probe: a typo'd kwarg would otherwise
-        # raise inside the probed call, be recorded as a lowering failure,
-        # and permanently pin the XLA fallback with no signal
-        bad = set(GROUPED_PALLAS_CONFIG) - {"g_tile", "row_tile", "w_tile", "fold", "dimsem"}
-        if bad:
-            raise ValueError(
-                f"GROUPED_PALLAS_CONFIG has unknown keys {sorted(bad)}; "
-                "valid: g_tile, row_tile, w_tile, fold, dimsem"
-            )
-        key_extra = (tuple(sorted(GROUPED_PALLAS_CONFIG.items())),)
-        try:
-            hash(key_extra)
-        except TypeError as e:
-            raise ValueError(f"GROUPED_PALLAS_CONFIG values must be hashable: {e}") from None
+        key_extra = _validated_key_extra(
+            GROUPED_PALLAS_CONFIG, GROUPED_CONFIG_KEYS, "GROUPED_PALLAS_CONFIG"
+        )
         out = _probed_call(
             "grouped",
             functools.partial(grouped_reduce_cardinality_pallas, **GROUPED_PALLAS_CONFIG),

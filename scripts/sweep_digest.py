@@ -43,7 +43,38 @@ def digest(sweep: dict) -> dict:
         if entry.get("best_2stage"):
             row["best_2stage_gbps"] = entry["best_2stage"]["gbps"]
             row["best_2stage_config"] = entry["best_2stage"]["config"]
+            row["best_2stage_params"] = entry["best_2stage"].get("params")
         rows.append(row)
+    # largest wide shape = the flagship flat workload the WIDE_DISPATCH
+    # knob targets (not whichever shape happens to sort first)
+    import math
+
+    wides = [r for r in rows if r["kind"] == "wide"]
+    wide = max(wides, key=lambda r: math.prod(r["shape"]), default=None)
+    wide_verdict = None
+    if wide and wide["xla_gbps"]:
+        candidates = {"xla": wide["xla_gbps"]}
+        if wide["best_pallas_gbps"]:
+            candidates["pallas"] = wide["best_pallas_gbps"]
+        if wide.get("best_2stage_gbps"):
+            candidates["two_stage"] = wide["best_2stage_gbps"]
+        winner = max(candidates, key=candidates.get)
+        # near-parity guard (same rule as the flagship verdict): do not
+        # recommend an engine switch on a within-noise edge over xla
+        if winner != "xla" and candidates[winner] < candidates["xla"] * 1.02:
+            winner = "xla"
+        cfg = {
+            "pallas": wide.get("best_pallas_params") or wide.get("best_pallas_config"),
+            "two_stage": wide.get("best_2stage_params") or wide.get("best_2stage_config"),
+            "xla": None,
+        }[winner]
+        wide_verdict = (
+            f"wide family winner at {wide['shape']}: {winner} at "
+            f"{candidates[winner]} GB/s (candidates: {candidates}"
+            + (f"; others within 2% of xla treated as parity" if winner == "xla" and len(candidates) > 1 else "")
+            + f") — set WIDE_DISPATCH={winner!r}"
+            + (f" with WIDE_CONFIG per {cfg}" if cfg else "")
+        )
     flagship = next(
         (r for r in rows if r["kind"] == "grouped" and r["shape"] == [66, 1450, 2048]),
         None,
@@ -58,8 +89,9 @@ def digest(sweep: dict) -> dict:
                 f"PALLAS WINS the flagship shape ({flagship['best_pallas_config']}, "
                 f"{flagship['pallas_over_xla']}x XLA): flip GROUPED_PREFER_XLA to "
                 f"False AND set GROUPED_PALLAS_CONFIG = "
-                f"{flagship['best_pallas_params']} (flipping alone serves the "
-                "default tiling, not this winner), citing this artifact"
+                f"{flagship['best_pallas_params'] or flagship['best_pallas_config']} "
+                "(flipping alone serves the default tiling, not this winner), "
+                "citing this artifact"
             )
         else:
             verdict = (
@@ -71,6 +103,7 @@ def digest(sweep: dict) -> dict:
         "generated_from": sweep.get("generated_utc"),
         "backend": sweep.get("backend"),
         "shapes": rows,
+        "wide_verdict": wide_verdict,
         "flagship": flagship,
         "flagship_verdict": verdict,
     }
@@ -89,6 +122,8 @@ def main():
             f"best-pallas {r['best_pallas_gbps'] or '-':>7} "
             f"ratio {r['pallas_over_xla'] or '-'}  ({r['best_pallas_config'] or '-'})"
         )
+    if out["wide_verdict"]:
+        print("\n" + out["wide_verdict"])
     if out["flagship_verdict"]:
         print("\n" + out["flagship_verdict"])
     if args.json:

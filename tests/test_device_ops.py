@@ -585,3 +585,37 @@ def test_grouped_pallas_config_validated_loudly(monkeypatch):
     with pytest.raises(ValueError, match="hashable"):
         pk.best_grouped_reduce(arr, op="or")
     pk._PROBED.clear()
+
+
+def test_wide_dispatch_policies(monkeypatch):
+    """WIDE_DISPATCH must route to the crowned engine with WIDE_CONFIG
+    applied, validate configs per policy, and keep the off-TPU default."""
+    import jax.numpy as jnp
+
+    from roaringbitmap_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.default_rng(72)
+    host = rng.integers(0, 1 << 32, size=(10, 2048), dtype=np.uint64).astype(np.uint32)
+    arr = jnp.asarray(host)
+    want = np.bitwise_or.reduce(host, axis=0)
+
+    # off-TPU: XLA serves regardless of policy
+    red, _ = pk.best_wide_reduce(arr, op="or")
+    assert np.array_equal(np.asarray(red), want)
+
+    # two_stage policy with its config
+    monkeypatch.setattr(pk, "on_tpu", lambda: True)
+    monkeypatch.setattr(pk, "WIDE_DISPATCH", "two_stage")
+    monkeypatch.setattr(pk, "WIDE_CONFIG", {"stage_groups": 4})
+    red, card = pk.best_wide_reduce(arr, op="or")
+    assert np.array_equal(np.asarray(red), want)
+    assert int(card) == int(np.unpackbits(want.view(np.uint8)).sum())
+    assert pk.DISPATCH_COUNTS[("wide", "two_stage")] >= 1
+
+    # config keys are policy-scoped
+    monkeypatch.setattr(pk, "WIDE_CONFIG", {"row_tile": 128})
+    with pytest.raises(ValueError, match="invalid for policy"):
+        pk.best_wide_reduce(arr, op="or")
+    monkeypatch.setattr(pk, "WIDE_DISPATCH", "warp")
+    with pytest.raises(ValueError, match="WIDE_DISPATCH"):
+        pk.best_wide_reduce(arr, op="or")

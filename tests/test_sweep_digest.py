@@ -59,3 +59,29 @@ def test_digest_near_parity_is_not_a_win():
     out = sweep_digest.digest(_sweep(422.9))  # vs xla 423.0: ratio 0.99976
     assert out["flagship"]["pallas_over_xla"] == 1.0  # display rounding
     assert "XLA holds" in out["flagship_verdict"]
+
+
+def test_digest_wide_family_verdict():
+    """The wide family's winner (xla / two-stage / pallas) is called with
+    the dispatch knobs to set."""
+    out = sweep_digest.digest(_sweep(300.0))
+    assert "two_stage" in out["wide_verdict"] and "WIDE_DISPATCH" in out["wide_verdict"]
+    # without a 2stage row, xla wins the fixture's wide shape
+    sweep = _sweep(300.0)
+    sweep["records"] = [r for r in sweep["records"] if "2stage" not in r["config"]]
+    out2 = sweep_digest.digest(sweep)
+    assert "WIDE_DISPATCH='pallas'" in out2["wide_verdict"]  # pallas 80 vs xla 59
+
+
+def test_wide_verdict_near_parity_and_shape_choice():
+    """Within-2% edges over xla are parity (no engine-switch advice), and
+    the verdict targets the largest wide shape."""
+    sweep = _sweep(300.0)
+    sweep["records"] = [
+        {"kind": "wide", "shape": [4096, 2048], "config": "xla", "gbps": 500.0, "ms": 1.0},
+        {"kind": "wide", "shape": [16384, 2048], "config": "xla", "gbps": 59.0, "ms": 1.0},
+        {"kind": "wide", "shape": [16384, 2048], "config": "xla 2stage g=32", "gbps": 59.9, "ms": 1.0},
+    ]
+    out = sweep_digest.digest(sweep)
+    assert "[16384, 2048]" in out["wide_verdict"]  # largest shape, not first sorted
+    assert "WIDE_DISPATCH='xla'" in out["wide_verdict"]  # 59.9 < 59*1.02
