@@ -39,4 +39,6 @@ SUITES = [
     "bitsetutil",
     "filtered_ann",
     "formats",
+    "bithacking",
+    "longlong",
 ]

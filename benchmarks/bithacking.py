@@ -1,0 +1,174 @@
+"""L0 word/array kernel suite — twin of the reference's bithacking/ and
+UtilBenchmark families (jmh/src/jmh/java/org/roaringbitmap/bithacking/,
+UtilBenchmark.java), which time the static Util.java kernels the whole
+library stands on (unsignedIntersect2by2 Util.java:890, unsignedUnion2by2
+:1116, select(long,int) :564, cardinalityInBitmapRange :415,
+setBitmapRange :616).
+
+Here the same kernels exist in two host tiers (`utils/bits.py` numpy and
+the compiled `native/` tier that actually serves the CPU fast path), so
+every row is measured twice: the dispatched kernel as the library runs it
+and the `_numpy` twin, making the native tier's win (or loss — see
+lower_bound, where ctypes overhead loses to np.searchsorted) a recorded
+number instead of a docstring claim.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from roaringbitmap_tpu.utils import bits
+
+from . import common
+from .common import Result
+
+
+def _sorted_u16(rng, n: int) -> np.ndarray:
+    return np.sort(rng.choice(1 << 16, size=n, replace=False)).astype(np.uint16)
+
+
+def run(reps: int = 20, datasets=None, **_) -> List[Result]:
+    rng = np.random.default_rng(0xFEEF1F0)
+    out: List[Result] = []
+    # touch a dispatched kernel once so the trampoline resolves and
+    # backend_tier() reports the tier that actually served the timings
+    bits.cardinality_of_words(bits.new_words())
+    from roaringbitmap_tpu import native
+
+    tier = native.backend_tier()
+
+    def bench(name, fn, check=None, extra=None):
+        if check is not None:
+            assert check(fn()), name
+        meta = {"tier": tier}
+        meta.update(extra or {})
+        out.append(Result(name, "synthetic", common.min_of(reps, fn), "ns/op", meta))
+
+    def both(name, native_fn, numpy_fn, check=None, extra=None):
+        # the two tiers must compute the same thing before their timings
+        # are published as comparable rows
+        res_native, res_numpy = native_fn(), numpy_fn()
+        if isinstance(res_native, np.ndarray):
+            assert np.array_equal(res_native, res_numpy), name
+        elif isinstance(res_native, tuple):
+            assert all(np.array_equal(a, b) for a, b in zip(res_native, res_numpy)), name
+        else:
+            assert res_native == res_numpy, name
+        if check is not None:
+            assert check(res_numpy), name + "_numpy"
+        bench(name, native_fn, check=check, extra=extra)
+        out.append(
+            Result(
+                name + "_numpy",
+                "synthetic",
+                common.min_of(reps, numpy_fn),
+                "ns/op",
+                dict(extra or {}, tier="numpy"),
+            )
+        )
+
+    # --- sorted-array kernels (galloping intersect / merges), two density
+    # regimes like the reference's best/worst-case matrices: similar-sized
+    # operands and a 50x size skew (where galloping pays off)
+    a = _sorted_u16(rng, 4000)
+    b = _sorted_u16(rng, 3000)
+    tiny = _sorted_u16(rng, 80)
+    expect_and = np.intersect1d(a.astype(np.int64), b.astype(np.int64)).size
+
+    both(
+        "intersect_balanced",
+        lambda: bits.intersect_sorted(a, b),
+        lambda: bits.intersect_sorted_numpy(a, b),
+        check=lambda r: r.size == expect_and,
+        extra={"n": int(a.size + b.size)},
+    )
+    both(
+        "intersect_skewed",
+        lambda: bits.intersect_sorted(tiny, a),
+        lambda: bits.intersect_sorted_numpy(tiny, a),
+    )
+    both(
+        "union2by2",
+        lambda: bits.merge_sorted_unique(a, b),
+        lambda: bits.merge_sorted_unique_numpy(a, b),
+        check=lambda r: r.size == np.union1d(a.astype(np.int64), b.astype(np.int64)).size,
+    )
+    both(
+        "xor2by2",
+        lambda: bits.xor_sorted(a, b),
+        lambda: bits.xor_sorted_numpy(a, b),
+    )
+    both(
+        "difference2by2",
+        lambda: bits.difference_sorted(a, b),
+        lambda: bits.difference_sorted_numpy(a, b),
+    )
+    both(
+        "lower_bound",
+        lambda: bits.lower_bound(a, 30_000),
+        lambda: bits.lower_bound_numpy(a, 30_000),
+    )
+
+    # --- word-bitmap kernels over the 1024-word container form
+    dense_vals = np.sort(rng.choice(1 << 16, size=40_000, replace=False)).astype(np.uint16)
+    words = bits.words_from_values(dense_vals)
+
+    both(
+        "popcount_container",
+        lambda: bits.cardinality_of_words(words),
+        lambda: bits.cardinality_of_words_numpy(words),
+        check=lambda c: c == dense_vals.size,
+    )
+    both(
+        "cardinalityInBitmapRange",
+        lambda: bits.cardinality_in_range(words, 5_000, 60_000),
+        lambda: bits.cardinality_in_range_numpy(words, 5_000, 60_000),
+    )
+    both(
+        "select_in_words",
+        lambda: bits.select_in_words(words, dense_vals.size // 2),
+        lambda: bits.select_in_words_numpy(words, dense_vals.size // 2),
+        check=lambda v: v == int(dense_vals[dense_vals.size // 2]),
+    )
+    both(
+        "words_from_values",
+        lambda: bits.words_from_values(dense_vals),
+        lambda: bits.words_from_values_numpy(dense_vals),
+    )
+    both(
+        "values_from_words",
+        lambda: bits.values_from_words(words),
+        lambda: bits.values_from_words_numpy(words),
+    )
+    both(
+        "num_runs_in_words",
+        lambda: bits.num_runs_in_words(words),
+        lambda: bits.num_runs_in_words_numpy(words),
+    )
+
+    def set_range():
+        w = bits.new_words()
+        bits.set_bitmap_range(w, 3_000, 61_000)
+        return w
+
+    bench("setBitmapRange", set_range, check=lambda w: bits.cardinality_of_words(w) == 58_000)
+
+    # --- run kernels (interval -> words fill: the 20x native win recorded
+    # in BENCH_NOTES; runs_from_values extraction)
+    starts = np.sort(rng.choice(1 << 15, size=500, replace=False)).astype(np.uint16) * 2
+    ends = starts + 2  # disjoint half-open [start, start+2) intervals, 2 values each
+    both(
+        "words_from_intervals",
+        lambda: bits.words_from_intervals(starts, ends),
+        lambda: bits.words_from_intervals_numpy(starts, ends),
+        check=lambda w: bits.cardinality_of_words(w) == 1000,
+    )
+    runny = bits.values_from_words(bits.words_from_intervals(starts, ends)).astype(np.uint16)
+    both(
+        "runs_from_values",
+        lambda: bits.runs_from_values(runny),
+        lambda: bits.runs_from_values_numpy(runny),
+    )
+    return out

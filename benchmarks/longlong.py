@@ -1,0 +1,124 @@
+"""64-bit micro-op suite — twin of the reference's jmh longlong/ and
+cardinality64/ families (jmh/src/jmh/java/org/roaringbitmap/longlong/,
+cardinality64/), which compare the two 64-bit designs on point ops, bulk
+algebra, rank/select (the cardinality64 suite exists because
+Roaring64NavigableMap caches cumulative cardinalities,
+Roaring64NavigableMap.java:66-72, while the ART design recomputes), and
+both wire formats.
+
+Every pair of rows "<op>_navmap" / "<op>_art" measures the same logical
+operation on Roaring64NavigableMap (high-32 bucketing) and Roaring64Bitmap
+(ART, high-48 keying); outputs are asserted equal before timing.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from roaringbitmap_tpu.models.roaring64 import Roaring64NavigableMap
+from roaringbitmap_tpu.models.roaring64art import Roaring64Bitmap
+
+from . import common
+from .common import Result
+
+N = 80_000  # values per operand; the benchmark smoke test shrinks this
+
+
+def _values(rng, n: int) -> np.ndarray:
+    """64-bit values spanning many high buckets: a dense band, a sparse
+    scatter across 2^40, and a cluster above 2^63 (unsigned-order edge)."""
+    parts = [
+        rng.integers(0, 1 << 20, size=n // 2, dtype=np.uint64),
+        rng.integers(0, 1 << 40, size=n // 2, dtype=np.uint64),
+        (np.uint64(1 << 63) + rng.integers(0, 1 << 18, size=n // 8, dtype=np.uint64)),
+    ]
+    return np.unique(np.concatenate(parts))
+
+
+def run(reps: int = 10, datasets=None, **_) -> List[Result]:
+    rng = np.random.default_rng(0xFEEF1F0)
+    out: List[Result] = []
+
+    def bench(name, fn, extra=None):
+        out.append(Result(name, "synthetic", common.min_of(reps, fn), "ns/op", extra or {}))
+
+    vals_a = _values(rng, N)
+    vals_b = _values(np.random.default_rng(42), N)
+
+    # --- bulk ingest
+    bench("addMany_navmap", lambda: Roaring64NavigableMap.bitmap_of(*[]).add_many(vals_a))
+    bench("addMany_art", lambda: Roaring64Bitmap.bitmap_of(*[]).add_many(vals_a))
+
+    nav_a, nav_b = Roaring64NavigableMap(), Roaring64NavigableMap()
+    art_a, art_b = Roaring64Bitmap(), Roaring64Bitmap()
+    nav_a.add_many(vals_a), nav_b.add_many(vals_b)
+    art_a.add_many(vals_a), art_b.add_many(vals_b)
+    assert nav_a.get_cardinality() == art_a.get_cardinality() == vals_a.size
+
+    # --- pairwise algebra (outputs cross-checked between designs)
+    for op in ("or_", "and_", "xor", "andnot"):
+        nav_res = getattr(Roaring64NavigableMap, op)(nav_a, nav_b)
+        art_res = getattr(Roaring64Bitmap, op)(art_a, art_b)
+        assert np.array_equal(nav_res.to_array(), art_res.to_array()), op
+        bench(f"{op.rstrip('_')}_navmap", lambda op=op: getattr(Roaring64NavigableMap, op)(nav_a, nav_b))
+        bench(f"{op.rstrip('_')}_art", lambda op=op: getattr(Roaring64Bitmap, op)(art_a, art_b))
+
+    # --- point probes: bulk contains (one bucket probe per distinct high
+    # key) and scalar contains
+    probes = np.concatenate([vals_a[:2000], vals_b[:2000]])
+    want_hits = int(np.isin(probes, vals_a).sum())
+    assert int(nav_a.contains_many(probes).sum()) == want_hits
+    assert int(art_a.contains_many(probes).sum()) == want_hits
+    bench("containsMany_navmap", lambda: nav_a.contains_many(probes), extra={"n": probes.size})
+    bench("containsMany_art", lambda: art_a.contains_many(probes), extra={"n": probes.size})
+    scalar_probes = [int(v) for v in probes[:500]]
+    bench("contains_x500_navmap", lambda: [nav_a.contains(v) for v in scalar_probes])
+    bench("contains_x500_art", lambda: [art_a.contains(v) for v in scalar_probes])
+
+    # --- rank/select (cardinality64 twin: navmap's cached cumulative
+    # cardinalities vs the ART walk)
+    card = nav_a.get_cardinality()
+    rank_pts = [int(v) for v in vals_a[:: max(1, vals_a.size // 200)][:200]]
+    want_ranks = [nav_a.rank(v) for v in rank_pts]
+    assert [art_a.rank(v) for v in rank_pts] == want_ranks
+    bench("rank_x200_navmap", lambda: [nav_a.rank(v) for v in rank_pts])
+    bench("rank_x200_art", lambda: [art_a.rank(v) for v in rank_pts])
+    sel_pts = list(range(0, card, max(1, card // 200)))[:200]
+    assert [nav_a.select(j) for j in sel_pts] == [art_a.select(j) for j in sel_pts]
+    bench("select_x200_navmap", lambda: [nav_a.select(j) for j in sel_pts])
+    bench("select_x200_art", lambda: [art_a.select(j) for j in sel_pts])
+    bench("nextValue_x200_navmap", lambda: [nav_a.next_value(v + 1) for v in rank_pts])
+    bench("nextValue_x200_art", lambda: [art_a.next_value(v + 1) for v in rank_pts])
+
+    # --- materialization + iteration
+    assert np.array_equal(nav_a.to_array(), art_a.to_array())
+    bench("toArray_navmap", lambda: nav_a.to_array())
+    bench("toArray_art", lambda: art_a.to_array())
+
+    def iterate_navmap():
+        it = nav_a.get_long_iterator()
+        return sum(1 for _ in zip(range(20_000), it))
+
+    def iterate_art():
+        it = art_a.get_long_iterator()
+        return sum(1 for _ in zip(range(20_000), it))
+
+    bench("iterate_20k_navmap", iterate_navmap)
+    bench("iterate_20k_art", iterate_art)
+
+    # --- both wire formats (legacy + portable, Roaring64NavigableMap.java:35-52)
+    portable = nav_a.serialize_portable()
+    legacy = nav_a.serialize_legacy()
+    art_bytes = art_a.serialize()
+    assert Roaring64NavigableMap.deserialize_portable(portable) == nav_a
+    assert Roaring64NavigableMap.deserialize_legacy(legacy) == nav_a
+    assert Roaring64Bitmap.deserialize(art_bytes) == art_a
+    bench("serialize_portable_navmap", lambda: nav_a.serialize_portable(), extra={"bytes": len(portable)})
+    bench("serialize_legacy_navmap", lambda: nav_a.serialize_legacy(), extra={"bytes": len(legacy)})
+    bench("serialize_art", lambda: art_a.serialize(), extra={"bytes": len(art_bytes)})
+    bench("deserialize_portable_navmap", lambda: Roaring64NavigableMap.deserialize_portable(portable))
+    bench("deserialize_legacy_navmap", lambda: Roaring64NavigableMap.deserialize_legacy(legacy))
+    bench("deserialize_art", lambda: Roaring64Bitmap.deserialize(art_bytes))
+    return out
