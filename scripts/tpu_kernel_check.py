@@ -22,6 +22,37 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 RECORDS = []
+JSON_OUT = None  # set by main(); each completed family flushes the artifact
+
+
+def _flush_json(partial: bool) -> None:
+    """Write the artifact after every family: a timeout or tunnel death
+    mid-suite must not erase the families that DID run (the JSON is the
+    committed hardware evidence, so partial > nothing)."""
+    if not JSON_OUT:
+        return
+    import jax
+
+    result = {
+        "generated_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "backend": jax.default_backend(),
+        "devices": [str(d) for d in jax.devices()],
+        "jax_version": jax.__version__,
+        "partial": partial,
+        "ok": all(r["ok"] for r in RECORDS) and bool(RECORDS),
+        "families": RECORDS,
+    }
+    try:
+        from roaringbitmap_tpu.ops import pallas_kernels as pk
+    except ImportError:
+        pass
+    else:
+        result["dispatch_counts"] = {f"{k[0]}/{k[1]}": v for k, v in pk.DISPATCH_COUNTS.items()}
+    os.makedirs(os.path.dirname(JSON_OUT) or ".", exist_ok=True)
+    tmp = JSON_OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f, indent=1)
+    os.replace(tmp, JSON_OUT)
 
 
 def family(name):
@@ -42,6 +73,10 @@ def family(name):
                     "traceback": traceback.format_exc()[-1500:],
                 }
             RECORDS.append(rec)
+            try:
+                _flush_json(partial=True)
+            except Exception as e:  # flush must never kill the suite it protects
+                print(f"partial flush failed: {e!r}", flush=True)
             print(f"{name}: {'OK' if rec['ok'] else 'FAIL ' + rec.get('error', '')}", flush=True)
             return rec
 
@@ -54,6 +89,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--json", help="write machine-readable results to this path")
     args = ap.parse_args()
+    global JSON_OUT
+    JSON_OUT = args.json
 
     import jax
     import jax.numpy as jnp
@@ -247,22 +284,12 @@ def main():
     ):
         run()
 
-    result = {
-        "generated_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-        "backend": backend,
-        "devices": devices,
-        "jax_version": jax.__version__,
-        "ok": all(r["ok"] for r in RECORDS),
-        "families": RECORDS,
-        "dispatch_counts": {f"{k[0]}/{k[1]}": v for k, v in pk.DISPATCH_COUNTS.items()},
-    }
-    print("all families ok:" if result["ok"] else "FAILURES:", result["ok"], flush=True)
+    ok = all(r["ok"] for r in RECORDS)
+    print("all families ok:" if ok else "FAILURES:", ok, flush=True)
     if args.json:
-        os.makedirs(os.path.dirname(args.json) or ".", exist_ok=True)
-        with open(args.json, "w") as f:
-            json.dump(result, f, indent=1)
+        _flush_json(partial=False)
         print("wrote", args.json, flush=True)
-    sys.exit(0 if result["ok"] else 1)
+    sys.exit(0 if ok else 1)
 
 
 if __name__ == "__main__":
