@@ -745,7 +745,10 @@ def _probed_call(kind: str, fn, args, op: str, key_extra: Tuple = ()):
 # Set both the policy and WIDE_CONFIG per the sweep digest, as with
 # GROUPED_PREFER_XLA / GROUPED_PALLAS_CONFIG.
 WIDE_DISPATCH = "pallas"
-WIDE_CONFIG: Dict = {}
+# Crowned by the on-chip sweep of 2026-07-31 (chip_artifacts/20260731T010236Z/
+# sweep_digest.json): pallas row_tile=256 w_tile=512 at 59.9 GB/s vs XLA 56.6
+# and two-stage 49.0 at [16384, 2048].
+WIDE_CONFIG: Dict = {"row_tile": 256, "w_tile": 512}
 
 _WIDE_CONFIG_KEYS = {
     "pallas": {"row_tile", "w_tile", "fold", "dimsem"},

@@ -73,7 +73,10 @@ def digest(sweep: dict) -> dict:
             f"{candidates[winner]} GB/s (candidates: {candidates}"
             + (f"; others within 2% of xla treated as parity" if winner == "xla" and len(candidates) > 1 else "")
             + f") — set WIDE_DISPATCH={winner!r}"
-            + (f" with WIDE_CONFIG per {cfg}" if cfg else "")
+            # always state the full WIDE_CONFIG: the dispatcher validates its
+            # keys against the active policy, so stale tiling keys from a
+            # previous winner would raise (e.g. pallas keys under 'xla')
+            + f" and WIDE_CONFIG={cfg if cfg else {}}"
         )
     flagship = next(
         (r for r in rows if r["kind"] == "grouped" and r["shape"] == [66, 1450, 2048]),
