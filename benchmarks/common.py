@@ -130,6 +130,23 @@ def steady_state_grouped(words3, op: str = "or", k: int = 64, reps: int = 3):
     return steady_state_reduce(words3, with_seed, k=k, reps=reps)
 
 
+def steady_state_bucketed(bucket_arrs, op: str = "or", k: int = 64, reps: int = 3):
+    """Steady-state seconds per aggregation over a ragged-batched working
+    set (store.padded_buckets_device): all buckets reduced per iteration
+    inside the one scanned jit, seed-mixed like the single-block path."""
+    from roaringbitmap_tpu.ops import device as dev
+
+    def with_seed(ws, seed):
+        import jax.numpy as jnp
+
+        cards = [dev.grouped_reduce_with_cardinality(w3 ^ seed, op=op)[1] for w3 in ws]
+        all_cards = jnp.concatenate(cards)
+        # same (reduced, cards) contract; the scan body only consumes cards
+        return None, all_cards
+
+    return steady_state_reduce(tuple(bucket_arrs), with_seed, k=k, reps=reps)
+
+
 _corpus_cache: Dict[str, List[np.ndarray]] = {}
 
 

@@ -141,6 +141,35 @@ class PackedGroups:
                 TRANSFER_BYTES["padded_groups"] += host.nbytes
         return cache[key]
 
+    def padded_buckets_device(self, fill: int, n_buckets: int = 3):
+        """Ragged-batched padding: groups partitioned by row count into
+        ``n_buckets`` contiguous-count buckets (optimal DP split), each
+        padded to its own bucket-local M — cutting the dead HBM traffic a
+        single [G, max(M), W] block pays on skewed group distributions
+        (census1881 flagship: 75.3% -> 92.4% occupancy at 3 buckets).
+
+        Returns a list of ``(orig_group_idx int64[g_b], jnp [g_b, m_b, W])``
+        pairs, cached per (fill, n_buckets)."""
+        cache = getattr(self, "_bucket_cache", None)
+        if cache is None:
+            cache = {}
+            object.__setattr__(self, "_bucket_cache", cache)
+        key = (int(fill), int(n_buckets))
+        if key not in cache:
+            counts = np.diff(self.group_offsets)
+            out = []
+            for idx in bucket_plan(counts, n_buckets):
+                g_b, m_b = len(idx), int(counts[idx].max())
+                block = np.full((g_b, m_b, dev.DEVICE_WORDS), fill, dtype=np.uint32)
+                for slot, gi in enumerate(idx):
+                    s, e = self.group_offsets[gi], self.group_offsets[gi + 1]
+                    block[slot, : e - s] = self.words[s:e]
+                arr = jnp.asarray(block)
+                TRANSFER_BYTES["padded_buckets"] += block.nbytes
+                out.append((idx, arr))
+            cache[key] = out
+        return cache[key]
+
 
 def group_by_key(
     bitmaps: Sequence[RoaringBitmap], keys_filter: Optional[set] = None
@@ -179,6 +208,44 @@ def pack_groups(groups: Dict[int, List[Container]]) -> PackedGroups:
     offsets = np.concatenate(([0], np.cumsum(counts)))
     rows = [c for k in group_keys for c in groups[int(k)]]
     return PackedGroups(pack_rows_host(rows), group_keys, offsets)
+
+
+def bucket_plan(counts: np.ndarray, n_buckets: int) -> List[np.ndarray]:
+    """Partition group indices into ≤ ``n_buckets`` buckets minimizing total
+    padded rows Σ g_b·max(M_b).
+
+    Sorted by descending count, the optimal bucketing is a contiguous
+    partition of the sorted order (any bucket's cost is len·its largest
+    member, so swapping non-contiguous members never helps), found by an
+    O(G²·K) DP — G is the number of 2^16-key groups (≤ 66 on the flagship
+    set), so this is microseconds. Degenerate cases (G ≤ n_buckets, or a
+    flat distribution) fall out naturally as fewer/equal buckets."""
+    if n_buckets < 1:
+        raise ValueError(f"n_buckets must be >= 1, got {n_buckets}")
+    g = len(counts)
+    if g == 0:
+        return []
+    order = np.argsort(-counts, kind="stable")
+    srt = counts[order]
+    k_max = min(int(n_buckets), g)
+    INF = float("inf")
+    # dp[i][k] = min padded rows covering sorted groups i.. with k buckets
+    dp = np.full((g + 1, k_max + 1), INF)
+    dp[g, :] = 0.0
+    choice = np.zeros((g, k_max + 1), dtype=np.int64)
+    for i in range(g - 1, -1, -1):
+        for k in range(1, k_max + 1):
+            spans = np.arange(i + 1, g + 1)
+            costs = (spans - i) * srt[i] + dp[spans, k - 1]
+            j = int(np.argmin(costs))
+            dp[i, k] = costs[j]
+            choice[i, k] = spans[j]
+    cuts, i, k = [], 0, k_max
+    while i < g:
+        j = int(choice[i, k])
+        cuts.append(order[i:j])
+        i, k = j, k - 1
+    return cuts
 
 
 def pad_groups_dense(
@@ -242,6 +309,48 @@ def prepare_reduce(packed: PackedGroups, op: str = "or"):
 
     LAYOUT_COUNTS["segmented-scan"] += 1
     return run, "segmented-scan"
+
+
+def prepare_reduce_bucketed(packed: PackedGroups, op: str = "or", n_buckets: int = 3):
+    """Ragged-batched variant of prepare_reduce: one grouped reduce per
+    count bucket (all inside one jit), results scattered back to ascending
+    key order. Same (run, layout) contract; layout = "bucketed"."""
+    import jax
+
+    buckets = packed.padded_buckets_device(dev._INIT[op], n_buckets)
+    if not buckets:  # empty working set: same contract as reduce_packed
+
+        def run_empty():
+            return (
+                jnp.empty((0, dev.DEVICE_WORDS), dtype=jnp.uint32),
+                jnp.empty((0,), dtype=jnp.int32),
+            )
+
+        LAYOUT_COUNTS["bucketed"] += 1
+        return run_empty, "bucketed"
+    order = np.concatenate([idx for idx, _ in buckets])
+    inv = jnp.asarray(np.argsort(order))
+
+    # the per-bucket engine is the stock XLA grouped reduce directly: the
+    # probing dispatcher (best_grouped_reduce) runs Python-side try-compiles
+    # and cannot sit under this outer jit — and XLA is the measured flagship
+    # winner anyway (BENCH_NOTES flagship post-mortem)
+    @jax.jit
+    def reduce_all(arrs):
+        reds, cards = [], []
+        for a in arrs:
+            r, c = dev.grouped_reduce_with_cardinality(a, op=op)
+            reds.append(r)
+            cards.append(c)
+        return jnp.concatenate(reds, axis=0)[inv], jnp.concatenate(cards)[inv]
+
+    arrs = tuple(a for _, a in buckets)
+
+    def run():
+        return reduce_all(arrs)
+
+    LAYOUT_COUNTS["bucketed"] += 1
+    return run, "bucketed"
 
 
 def reduce_packed(packed: PackedGroups, op: str = "or"):

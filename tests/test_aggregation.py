@@ -93,3 +93,45 @@ def test_device_path_with_many_containers(random_bitmap_factory):
     want = naive(bms, "or")
     assert FastAggregation.or_(*bms, mode="device") == want
     assert FastAggregation.or_(*bms, mode="cpu") == want
+
+
+def test_bucket_plan_properties():
+    """bucket_plan must cover every group exactly once and never cost more
+    padded rows than the single-block layout."""
+    from roaringbitmap_tpu.parallel import store
+
+    rng = np.random.default_rng(9)
+    for counts in (
+        np.array([1450, 1200, 700, 650, 300, 10, 5]),
+        rng.integers(1, 2000, size=66),
+        np.array([7]),
+        np.array([5, 5, 5, 5]),
+        np.array([], dtype=np.int64),
+    ):
+        for k in (1, 2, 3, 5):
+            plan = store.bucket_plan(counts, k)
+            seen = np.concatenate(plan) if plan else np.empty(0, np.int64)
+            assert sorted(seen.tolist()) == list(range(len(counts)))
+            cost = sum(len(idx) * counts[idx].max() for idx in plan)
+            single = len(counts) * counts.max() if len(counts) else 0
+            assert cost <= single
+            assert len(plan) <= max(1, min(k, len(counts)))
+
+
+def test_bucketed_reduce_matches_flat(random_bitmap_factory):
+    """prepare_reduce_bucketed must agree with reduce_packed on a skewed
+    working set, for every op and bucket count."""
+    from roaringbitmap_tpu.parallel import store
+
+    bms = [random_bitmap_factory()[0] for _ in range(24)]
+    bms.append(RoaringBitmap([(1 << 30) + 3]))  # lone far key -> skew
+    groups = store.group_by_key(bms)
+    packed = store.pack_groups(groups)
+    for op in ("or", "and", "xor"):
+        want_words, want_cards = store.reduce_packed(packed, op=op)
+        for k in (1, 3, 6):
+            run, layout = store.prepare_reduce_bucketed(packed, op=op, n_buckets=k)
+            assert layout == "bucketed"
+            got_words, got_cards = (np.asarray(x) for x in run())
+            assert np.array_equal(got_words, want_words), (op, k)
+            assert np.array_equal(got_cards, want_cards), (op, k)
