@@ -26,6 +26,7 @@ import numpy as np
 N_BITMAPS = 10_000
 REPS_CPU = 3
 REPS_TPU = 20
+N_BUCKETS = 3  # ragged-batch bucket count; shared by the correctness and timing paths
 
 # --smoke (the scripts/ci.sh gate): same end-to-end path — build, pack,
 # device reduce, unpack, CPU-vs-device equality assert — at 1/10 the
@@ -149,11 +150,13 @@ def main():
         # ragged-batched layout (store.prepare_reduce_bucketed): same
         # aggregation with the padding waste cut by count-bucketing — the
         # headline takes whichever layout measures faster, both recorded
-        run_b, _ = store.prepare_reduce_bucketed(packed, op="or", n_buckets=3)
+        run_b, _ = store.prepare_reduce_bucketed(packed, op="or", n_buckets=N_BUCKETS)
         red_b, cards_b = (np.asarray(x) for x in run_b())
         bucket_result = store.unpack_to_bitmap(packed.group_keys, red_b, cards_b)
         assert bucket_result == cpu_result, "bucketed result mismatch"
-        buckets = packed.padded_buckets_device(0, 3)
+        # same fill + bucket count as the correctness path above, so the
+        # timing below measures exactly the verified (cached) device layout
+        buckets = packed.padded_buckets_device(dev._INIT["or"], N_BUCKETS)
         bucket_rows = sum(int(a.shape[0] * a.shape[1]) for _, a in buckets)
         bucket_s, total_b = steady_state_bucketed(
             [a for _, a in buckets], op="or", k=k_reps

@@ -744,11 +744,14 @@ def _probed_call(kind: str, fn, args, op: str, key_extra: Tuple = ()):
 #   "xla"       — the stock one-shot XLA reduce.
 # Set both the policy and WIDE_CONFIG per the sweep digest, as with
 # GROUPED_PREFER_XLA / GROUPED_PALLAS_CONFIG.
-WIDE_DISPATCH = "pallas"
-# Crowned by the on-chip sweep of 2026-07-31 (chip_artifacts/20260731T010236Z/
-# sweep_digest.json): pallas row_tile=256 w_tile=512 at 59.9 GB/s vs XLA 56.6
-# and two-stage 49.0 at [16384, 2048].
-WIDE_CONFIG: Dict = {"row_tile": 256, "w_tile": 512}
+# The 2026-07-31 sweep briefly crowned pallas rt256/w512 (59.9 vs 56.6 GB/s
+# at [16384, 2048]), but the same-window scaling probe
+# (chip_artifacts/20260731T013545Z/wide_scaling_probe.json) showed that
+# 128 MiB shape is fixed-cost-bound (every engine lands at 28-59 GB/s) while
+# at real sizes XLA wins decisively: 228 vs 109 GB/s at 512 MiB, 318 vs 186
+# at 1 GiB. Policy rides on the at-scale numbers.
+WIDE_DISPATCH = "xla"
+WIDE_CONFIG: Dict = {}
 
 _WIDE_CONFIG_KEYS = {
     "pallas": {"row_tile", "w_tile", "fold", "dimsem"},

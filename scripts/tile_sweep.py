@@ -84,37 +84,59 @@ def main():
     rng = np.random.default_rng(0)
 
     # ---- wide: [N, 2048] ----
-    n = 16_384
-    host = rng.integers(0, 1 << 32, size=(n, 2048), dtype=np.uint64).astype(np.uint32)
-    arr = jnp.asarray(host)
-    _fetch(arr.sum())  # flush the transfer before timing anything
-    nbytes = arr.size * 4
-    shape = (n, 2048)
-    print(f"\nwide [N={n}, 2048] ({nbytes/2**20:.0f} MiB)", flush=True)
-    _run("wide", shape, "xla", {}, lambda w, s: dev.wide_reduce_with_cardinality(w ^ s, op="or"), arr, nbytes)
-    for g in (32, 128, 512):
-        _run(
-            "wide", shape, f"xla 2stage g={g}", {"stage_groups": g},
-            lambda w, s, g=g: dev.wide_reduce_two_stage(w ^ s, op="or", stage_groups=g),
-            arr, nbytes,
-        )
-    wide_cfgs = [
-        {"row_tile": 128},
-        {"row_tile": 256},
-        {"row_tile": 512},
-        {"row_tile": 256, "fold": "linear"},
-        {"row_tile": 256, "w_tile": 512},
-        {"row_tile": 256, "w_tile": 512, "fold": "linear"},
-        {"row_tile": 512, "w_tile": 1024, "dimsem": True},
-        {"row_tile": 256, "w_tile": 512, "fold": "linear", "dimsem": True},
-    ]
-    for kw in wide_cfgs:
-        label = "pallas " + " ".join(f"{k_}={v}" for k_, v in kw.items())
-        _run(
-            "wide", shape, label, kw,
-            lambda w, s, kw=kw: pk.wide_reduce_cardinality_pallas(w, op="or", seed=s, **kw),
-            arr, nbytes,
-        )
+    # two sizes: the historical 128 MiB shape (comparable to r3) and a
+    # 512 MiB shape, because the 2026-07-31 scaling probe
+    # (chip_artifacts/20260731T013545Z/wide_scaling_probe.json) showed the
+    # 128 MiB rate is dominated by fixed per-iteration cost (28-59 GB/s
+    # regardless of engine) while at >= 512 MiB the engines separate
+    # (xla 228-318 vs pallas 109-186 GB/s). The digest crowns the LARGEST
+    # wide shape, so the dispatch verdict now rides on the scale-relevant one.
+    for n, wide_cfgs in (
+        (
+            16_384,
+            [
+                {"row_tile": 128},
+                {"row_tile": 256},
+                {"row_tile": 512},
+                {"row_tile": 256, "fold": "linear"},
+                {"row_tile": 256, "w_tile": 512},
+                {"row_tile": 256, "w_tile": 512, "fold": "linear"},
+                {"row_tile": 512, "w_tile": 1024, "dimsem": True},
+                {"row_tile": 256, "w_tile": 512, "fold": "linear", "dimsem": True},
+            ],
+        ),
+        (
+            65_536,
+            [
+                {"row_tile": 256},
+                {"row_tile": 512},
+                {"row_tile": 256, "w_tile": 512},
+                {"row_tile": 512, "w_tile": 1024, "dimsem": True},
+            ],
+        ),
+    ):
+        host = rng.integers(0, 1 << 32, size=(n, 2048), dtype=np.uint64).astype(np.uint32)
+        arr = jnp.asarray(host)
+        _fetch(arr.sum())  # flush the transfer before timing anything
+        nbytes = arr.size * 4
+        shape = (n, 2048)
+        k = 16 if n > 30_000 else K  # bound the 512 MiB shape's wall clock
+        print(f"\nwide [N={n}, 2048] ({nbytes/2**20:.0f} MiB) K={k}", flush=True)
+        _run("wide", shape, "xla", {}, lambda w, s: dev.wide_reduce_with_cardinality(w ^ s, op="or"), arr, nbytes, k=k)
+        for g in (32, 128, 512):
+            _run(
+                "wide", shape, f"xla 2stage g={g}", {"stage_groups": g},
+                lambda w, s, g=g: dev.wide_reduce_two_stage(w ^ s, op="or", stage_groups=g),
+                arr, nbytes, k=k,
+            )
+        for kw in wide_cfgs:
+            label = "pallas " + " ".join(f"{k_}={v}" for k_, v in kw.items())
+            _run(
+                "wide", shape, label, kw,
+                lambda w, s, kw=kw: pk.wide_reduce_cardinality_pallas(w, op="or", seed=s, **kw),
+                arr, nbytes, k=k,
+            )
+        del arr, host
 
     # ---- grouped: [G, M, 2048] ----
     # census-like, skewed-wide, and (unless skipped) the flagship bench shape
