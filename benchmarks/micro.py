@@ -92,6 +92,31 @@ def run(reps: int = 10, datasets=None, **_) -> List[Result]:
     bench("nextValue_x1000", lambda: [mixed.next_value(v + 1) for v in hits])
     bench("nextAbsentValue_x1000", lambda: [mixed.next_absent_value(v) for v in hits])
 
+    # value mapping (map/MapBenchmark.java: apply int->int to every member
+    # into a fresh bitmap; the reference walks forEach + add). The
+    # vectorized twin is the TPU-idiomatic path: to_array -> numpy -> bulk
+    # constructor.
+    def map_foreach():
+        out_bm = RoaringBitmap()
+        mixed.for_each(lambda x: out_bm.add((x * 3) % 77_333_333))
+        return out_bm
+
+    def map_vectorized():
+        return RoaringBitmap((mixed.to_array().astype(np.uint64) * 3) % 77_333_333)
+
+    assert map_foreach() == map_vectorized()
+    # forEach pays ~700 ms of per-value adds; cap its reps so the suite's
+    # wall clock stays bounded (min-of timing needs few reps to converge)
+    out.append(
+        Result(
+            "mapValues_forEach",
+            "synthetic",
+            common.min_of(max(1, reps // 5), map_foreach),
+            "ns/op",
+        )
+    )
+    bench("mapValues_vectorized", map_vectorized)
+
     # combined cardinalities (inclusion-exclusion over one and_cardinality
     # walk, like the reference) vs materialize-then-count baselines
     # (combinedcardinality/CombinedCardinalityBenchmark)
