@@ -129,14 +129,22 @@ def verify_buffer_invariance(
 
 def random_working_set(rng, layout: str) -> List[RoaringBitmap]:
     """Working set whose key distribution forces a specific device layout
-    by construction (store.prepare_reduce: padded when G*M <= max(2N, 1024),
-    else segmented-scan). The round-2 fuzzers never produced skewed group
-    shapes, so the associative-scan path went unfuzzed (VERDICT r2 #6).
+    by construction against store.prepare_reduce's cost model (round 4):
+    single dense block when its occupancy >= 0.9; count-bucketed ragged
+    batching when 3-bucket padding stays <= 1.5x live rows; else the
+    segmented scan. The round-2 fuzzers never produced skewed group shapes,
+    so the scan path went unfuzzed (VERDICT r2 #6); round 4 adds the
+    bucketed middle regime.
 
-    ``layout='padded'``: every bitmap covers the same few keys, so groups
-    are perfectly balanced (G*M == N <= max). ``layout='segmented-scan'``:
-    one hot key shared by many bitmaps plus many singleton keys, so dense
-    padding would waste G*M >> max(2N, 1024) cells."""
+    ``layout='padded'``: every bitmap covers the same few keys — groups
+    perfectly balanced, occupancy 1.0. ``layout='bucketed'``: one hot key
+    shared by many bitmaps plus many singleton keys — one block would pad
+    every singleton group to the hot count (rejected), but 3 buckets pad to
+    ~100%. ``layout='segmented-scan'``: a 7-level geometric count pyramid
+    (2^j-sized groups, equal mass per level) — every contiguous 3-bucket
+    split of a geometric spectrum pays >= 1.86x the live rows (any bucket
+    spanning s levels costs ~(2^s - 1)/s per live row), defeating the
+    bucket rescue."""
     if layout == "padded":
         keys = np.sort(rng.choice(32, size=int(rng.integers(1, 4)), replace=False))
         out = []
@@ -146,7 +154,7 @@ def random_working_set(rng, layout: str) -> List[RoaringBitmap]:
             ]
             out.append(RoaringBitmap(np.concatenate(parts).astype(np.uint32)))
         return out
-    if layout == "segmented-scan":
+    if layout == "bucketed":
         hot = int(rng.integers(0, 8))
         n_hot = int(rng.integers(33, 48))
         n_single = int(rng.integers(64, 90))
@@ -160,6 +168,21 @@ def random_working_set(rng, layout: str) -> List[RoaringBitmap]:
                 RoaringBitmap((_sparse_region(rng) + (key << 16)).astype(np.uint32))
             )
         return out
+    if layout == "segmented-scan":
+        levels = 7
+        # group sizes 2^j, 2^(levels-1-j) groups per level; columnar build:
+        # bitmap b holds every group whose count exceeds b
+        group_counts: List[int] = []
+        for j in range(levels):
+            group_counts += [2 ** j] * (2 ** (levels - 1 - j))
+        n_bitmaps = max(group_counts)
+        parts: List[List[np.ndarray]] = [[] for _ in range(n_bitmaps)]
+        for key, count in enumerate(group_counts):
+            for b in range(count):
+                parts[b].append(_sparse_region(rng) + (key << 16))
+        return [
+            RoaringBitmap(np.concatenate(p).astype(np.uint32)) for p in parts
+        ]
     raise ValueError(f"unknown layout {layout}")
 
 
@@ -169,11 +192,11 @@ def verify_layout_invariance(
     iterations: Optional[int] = None,
     seed: Optional[int] = None,
 ) -> None:
-    """Device-layout fuzzing: for both the padded and segmented-scan layouts
-    (forced by construction, asserted against prepare_reduce's actual
-    choice), the device reduction must agree with every CPU engine
-    (naive / horizontal / priorityqueue for OR; the reference's
-    cross-engine oracle, Fuzzer.java + jmh smoke tests)."""
+    """Device-layout fuzzing: for all three layouts — padded, bucketed,
+    segmented-scan — (forced by construction, asserted against
+    prepare_reduce's actual choice), the device reduction must agree with
+    every CPU engine (naive / horizontal / priorityqueue for OR; the
+    reference's cross-engine oracle, Fuzzer.java + jmh smoke tests)."""
     from .parallel import aggregation, store
 
     if op not in ("or", "xor"):
@@ -185,7 +208,7 @@ def verify_layout_invariance(
         raise ValueError("layout fuzzing supports decomposable ops: 'or', 'xor'")
     rng = np.random.default_rng(seed)
     for i in range(iterations or default_iterations()):
-        layout = "padded" if i % 2 == 0 else "segmented-scan"
+        layout = ("padded", "bucketed", "segmented-scan")[i % 3]
         bms = random_working_set(rng, layout)
         packed = store.pack_groups(store.group_by_key(bms))
         run, chosen = store.prepare_reduce(packed, op=op)

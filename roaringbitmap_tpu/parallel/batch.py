@@ -96,7 +96,12 @@ def prepare_batched_cardinality(
 ):
     """Marshal once, query repeatedly: returns a closure computing
     ``[|many[i] OP one|]`` from the resident device tensors (the
-    steady-state retrieval loop; mirror of store.prepare_reduce)."""
+    steady-state retrieval loop; mirror of store.prepare_reduce).
+
+    The closure exposes its resident tensors and jitted step as
+    ``run.device_tensors == (batch, filt)`` and ``run.step`` so callers
+    timing steady-state loops (benchmarks/filtered_ann.py) can reuse the
+    one marshalled copy instead of re-packing."""
     filt, batch, _ = _pack_one_vs_many(one, many)
     step = _step(op, cards_only=True)
 
@@ -104,6 +109,8 @@ def prepare_batched_cardinality(
         row_cards = np.asarray(step(batch, filt)).astype(np.int64)
         return row_cards.sum(axis=1)
 
+    run.device_tensors = (batch, filt)
+    run.step = step
     return run
 
 
@@ -313,6 +320,44 @@ def _inclusion_exclusion(op: str, inter: np.ndarray, lefts, rights) -> np.ndarra
         return lc[:, None] - inter
     rc = np.array([b.get_cardinality() for b in rights], dtype=np.int64)
     return lc[:, None] + rc[None, :] - (2 if op == "xor" else 1) * inter
+
+
+def prepare_pairwise_mxu(
+    lefts: Sequence[RoaringBitmap], rights: Sequence[RoaringBitmap]
+):
+    """Marshal once for repeated MXU overlap-matrix dispatches: returns a
+    closure computing the [n, m] intersection-cardinality matrix from
+    resident device tensors, exposing ``run.device_tensors == (lw, rw)``
+    and ``run.step`` (the jitted bit-matmul) for steady-state timing.
+    Exactness bound as pairwise_and_cardinality(impl='mxu')."""
+    import jax.numpy as jnp
+
+    keys = sorted(
+        {k for c in lefts for k in c.high_low_container.keys}
+        & {k for c in rights for k in c.high_low_container.keys}
+    )
+    if not keys:
+        n, m = len(lefts), len(rights)
+
+        def run_empty() -> np.ndarray:
+            return np.zeros((n, m), dtype=np.int64)
+
+        run_empty.device_tensors = None
+        run_empty.step = None
+        return run_empty
+    if not all(b.get_cardinality() < (1 << 31) for b in (*lefts, *rights)):
+        raise ValueError("MXU path needs every cardinality < 2^31")
+    kidx = {k: i for i, k in enumerate(keys)}
+    lw = jnp.asarray(_pack_sets(lefts, keys, kidx))
+    rw = jnp.asarray(_pack_sets(rights, keys, kidx))
+    step = _pairwise_mxu_step()
+
+    def run() -> np.ndarray:
+        return np.asarray(step(lw, rw)).astype(np.int64)
+
+    run.device_tensors = (lw, rw)
+    run.step = step
+    return run
 
 
 def pairwise_jaccard(

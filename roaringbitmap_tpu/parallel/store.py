@@ -25,8 +25,13 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 import jax.numpy as jnp
 
-# layout observability: ("padded"|"segmented-scan") -> count (insights.dispatch_counters)
+# layout observability: ("padded"|"bucketed"|"segmented-scan") -> count
+# (insights.dispatch_counters)
 LAYOUT_COUNTS: Counter = Counter()
+# default ragged-batch bucket count for the prepare_reduce cost model;
+# bench.py reuses it so reported occupancy always describes the production
+# bucketing
+DEFAULT_BUCKETS = 3
 # host->device transfer accounting in bytes (insights.dispatch_counters)
 TRANSFER_BYTES: Counter = Counter()
 
@@ -275,24 +280,43 @@ def prepare_reduce(packed: PackedGroups, op: str = "or"):
     """Build the device reduction closure for a packed group set.
 
     Returns ``(run, layout)`` where ``run()`` -> (reduced [G, 2048] device
-    array, cards [G] device array) and ``layout`` is ``"padded"`` or
-    ``"segmented-scan"``. The choice: dense padded [G, M, 2048] + identity
-    padding when padding waste is bounded, else a flagged associative scan
-    (the reference's answer to skew is splitting slices across the fork-join
-    pool, ParallelAggregation.java:222-228). bench.py times exactly this
-    closure, so the benchmark and production always run the same path.
+    array, cards [G] device array) and ``layout`` is ``"padded"``,
+    ``"bucketed"``, or ``"segmented-scan"``. Cost-model-driven choice on
+    host-side row counts (measured on chip, BENCH_NOTES "Ragged batching"):
+
+    * single dense block when its occupancy is already >= 0.9 — one
+      dispatch, no scatter-back;
+    * count-bucketed ragged batching when bucketing keeps total padded
+      rows <= 1.5x the live rows — this also rescues most distributions
+      the single-block guard rejects (e.g. one giant group + many tiny
+      ones buckets to ~100% occupancy);
+    * else the segmented scan (the truly irregular tail). The reference's
+      answer to skew is splitting slices across the fork-join pool
+      (ParallelAggregation.java:222-228). bench.py times exactly these
+      closures, so the benchmark and production always run the same path.
     """
     n = packed.n_rows
-    dev_arr = packed.padded_device(dev._INIT[op])
-    if dev_arr is not None:
+    counts = np.diff(packed.group_offsets)
+    g = packed.n_groups
+    single_rows = int(g * counts.max()) if g else 0
+    # empty sets keep the (trivial) single-block path
+    if not g or not n or single_rows <= n / 0.9:
+        dev_arr = packed.padded_device(dev._INIT[op])
+        if dev_arr is not None:
 
-        def run():
-            from ..ops import pallas_kernels as pk
+            def run():
+                from ..ops import pallas_kernels as pk
 
-            return pk.best_grouped_reduce(dev_arr, op=op)
+                return pk.best_grouped_reduce(dev_arr, op=op)
 
-        LAYOUT_COUNTS["padded"] += 1
-        return run, "padded"
+            LAYOUT_COUNTS["padded"] += 1
+            return run, "padded"
+    if g and n:
+        bucket_rows = sum(
+            len(idx) * int(counts[idx].max()) for idx in bucket_plan(counts, DEFAULT_BUCKETS)
+        )
+        if bucket_rows <= 1.5 * n:
+            return prepare_reduce_bucketed(packed, op=op, n_buckets=DEFAULT_BUCKETS)
 
     seg_start = np.zeros(n, dtype=bool)
     seg_start[packed.group_offsets[:-1]] = True

@@ -135,3 +135,35 @@ def test_bucketed_reduce_matches_flat(random_bitmap_factory):
             got_words, got_cards = (np.asarray(x) for x in run())
             assert np.array_equal(got_words, want_words), (op, k)
             assert np.array_equal(got_cards, want_cards), (op, k)
+
+
+def test_prepare_reduce_layout_policy(random_bitmap_factory):
+    """The cost-model choice: near-full single block -> padded; skewed but
+    bucketable -> bucketed (rescued from the segmented fallback); results
+    identical either way."""
+    from roaringbitmap_tpu.parallel import store
+
+    # uniform groups: occupancy 1.0 -> single padded block
+    uniform = [RoaringBitmap([k << 16 for k in range(8)]) for _ in range(10)]
+    packed_u = store.pack_groups(store.group_by_key(uniform))
+    _, layout_u = store.prepare_reduce(packed_u)
+    assert layout_u == "padded"
+
+    # one giant group + many singletons: single-block occupancy ~tiny
+    # (pad_groups_dense returns None), but bucketing pads to ~100%
+    skew = [RoaringBitmap(np.arange(2000, dtype=np.uint32))] * 40
+    skew += [RoaringBitmap([(k + 2) << 16]) for k in range(30)]
+    packed_s = store.pack_groups(store.group_by_key(skew))
+    run_s, layout_s = store.prepare_reduce(packed_s)
+    assert layout_s == "bucketed"
+    # host oracle (not reduce_packed, which now routes through the same
+    # dispatcher): per-group numpy fold over the packed rows
+    offs = packed_s.group_offsets
+    want_words = np.stack(
+        [np.bitwise_or.reduce(packed_s.words[offs[i] : offs[i + 1]], axis=0)
+         for i in range(packed_s.n_groups)]
+    )
+    got_words, got_cards = (np.asarray(x) for x in run_s())
+    assert np.array_equal(got_words, want_words)
+    want_cards = [int(np.unpackbits(w.view(np.uint8)).sum()) for w in want_words]
+    assert got_cards.tolist() == want_cards
