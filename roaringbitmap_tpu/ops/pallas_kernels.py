@@ -573,21 +573,44 @@ def best_segmented_reduce(words, seg_start, op: str = "or"):
 # core — the memory-bound north-star compare approaches the S*K*8KB
 # streaming lower bound.
 
-ONEIL_K_TILE = 8  # key-chunks per grid step
+# O'Neil walk tiling, crowned on chip 2026-07-31 (chip_artifacts/
+# 20260731T023500Z/oneil_tiling_probe.json): at the 100M-row [32,1526,2048]
+# shape the old (k_tile=8, whole word axis) default measured 64.9 GB/s
+# while (16, 512) reached 113.6 — more, smaller grid cells pipeline the
+# sequential 32-slice walk far better; the w-split is legal because the
+# recurrence is elementwise over (K, w).
+ONEIL_K_TILE = 16  # key-chunks per grid step
+ONEIL_W_TILE = 512  # word-axis split (0 = whole axis); must divide w, %128
 
 
-def oneil_plan(s: int, k: int, w: int, k_tile: int = ONEIL_K_TILE):
-    """Block layout for the [S, K, w] O'Neil walk; K padded to k_tile."""
+def oneil_plan(s: int, k: int, w: int, k_tile: int = ONEIL_K_TILE, w_tile: int = -1):
+    """Block layout for the [S, K, w] O'Neil walk; K padded to k_tile.
+
+    ``w_tile`` splits the word axis into an extra grid dimension: the
+    recurrence is elementwise over (K, w), so (k_tile, w_tile) cells are
+    independent — more grid steps with smaller double-buffered blocks, the
+    same axis the wide/grouped kernels call w_tile. Must divide w and
+    satisfy the %128 lane rule. ``-1`` (the default — single source of
+    truth for kernel, tests, and sweep) resolves to the crowned
+    ONEIL_W_TILE when it divides w, else the whole axis; ``0`` forces the
+    whole axis."""
+    if w_tile < 0:
+        w_tile = ONEIL_W_TILE if (ONEIL_W_TILE and w % ONEIL_W_TILE == 0) else 0
+    if w_tile:
+        if w % w_tile or w_tile % 128:
+            raise ValueError(f"w_tile {w_tile} must divide {w} and be a multiple of 128")
+    else:
+        w_tile = w
     k_pad = k + (-k) % k_tile
     return {
         "pad_chunks": k_pad - k,
-        "grid": (k_pad // k_tile,),
+        "grid": (k_pad // k_tile, w // w_tile),
         "slices_array": (s, k_pad, w),
-        "slices_block": (s, k_tile, w),
-        "slices_index": lambda i: (0, i, 0),
+        "slices_block": (s, k_tile, w_tile),
+        "slices_index": lambda i, j: (0, i, j),
         "kw_array": (k_pad, w),
-        "kw_block": (k_tile, w),
-        "kw_index": lambda i: (i, 0),
+        "kw_block": (k_tile, w_tile),
+        "kw_index": lambda i, j: (i, j),
     }
 
 
@@ -636,7 +659,7 @@ def _make_oneil_kernel(s_count: int, op_name: str, dual: bool):
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("op", "interpret", "k_tile"))
+@functools.partial(jax.jit, static_argnames=("op", "interpret", "k_tile", "w_tile"))
 def oneil_compare_pallas(
     slices_w,
     bits_rev,
@@ -645,14 +668,16 @@ def oneil_compare_pallas(
     op: str = "GE",
     interpret: bool = False,
     k_tile: int = ONEIL_K_TILE,
+    w_tile: int = -1,
     seed=None,
 ):
     """Fused O'Neil compare: ([S, K, 2048], bits, [K, 2048], [K, 2048]) ->
     ([K, 2048] result, [K] cards). ``bits_rev`` is bool [S] (or [2, S] for
-    op="RANGE", lo-walk first), matching models/bsi.o_neil_math."""
+    op="RANGE", lo-walk first), matching models/bsi.o_neil_math.
+    ``w_tile=-1`` takes the crowned ONEIL_W_TILE when it divides w."""
     s, k, w = slices_w.shape
     dual = op == "RANGE"
-    plan = oneil_plan(s, k, w, k_tile)
+    plan = oneil_plan(s, k, w, k_tile, w_tile)
     if plan["pad_chunks"]:
         pad = plan["pad_chunks"]
         slices_w = jnp.pad(slices_w, ((0, 0), (0, pad), (0, 0)))

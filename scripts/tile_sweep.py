@@ -195,6 +195,43 @@ def main():
                 arr3, nbytes, k=k,
             )
 
+    # ---- O'Neil walk: [S, K, 2048] (the BSI engine's kernel) ----
+    # the 100M-row shape scaled to bound the sweep's wall clock; crowned
+    # (16, 512) on 2026-07-31 (oneil_tiling_probe.json) — re-crown each window
+    from roaringbitmap_tpu.models.bsi import o_neil_math
+
+    s_cnt, k_chunks = 32, 512  # 134 MB
+    slices = rng.integers(0, 1 << 32, size=(s_cnt, k_chunks, 2048), dtype=np.uint64).astype(np.uint32)
+    ebm = np.bitwise_or.reduce(slices, axis=0)
+    bits = np.array([(0xA5A5A5A5 >> i) & 1 for i in range(s_cnt - 1, -1, -1)], dtype=bool)
+    sl, bv, eb = jnp.asarray(slices), jnp.asarray(bits), jnp.asarray(ebm)
+    _fetch(sl.sum())
+    nbytes = sl.size * 4
+    shape = (s_cnt, k_chunks, 2048)
+    print(f"\noneil [S={s_cnt}, K={k_chunks}, 2048] ({nbytes/2**20:.0f} MiB) K={K}", flush=True)
+    _run(
+        "oneil", shape, "xla", {},
+        lambda w, s: o_neil_math(w, bv, eb ^ s, eb, "GE"), sl, nbytes,
+    )
+    for kt, wt in ((8, 0), (16, 512), (8, 1024), (64, 512)):
+        label = f"pallas k_tile={kt} w_tile={wt}"
+        block = 2 * 4 * s_cnt * kt * (wt or 2048)  # double-buffered slices block
+        if block > VMEM_BUDGET:
+            RECORDS.append(
+                {"kind": "oneil", "shape": list(shape), "config": label,
+                 "params": {"k_tile": kt, "w_tile": wt}, "skipped": "VMEM"}
+            )
+            print(f"  {label:<34} skipped (VMEM)", flush=True)
+            continue
+        _run(
+            "oneil", shape, label, {"k_tile": kt, "w_tile": wt},
+            lambda w, s, kt=kt, wt=wt: pk.oneil_compare_pallas(
+                w, bv, eb, eb, op="GE", k_tile=kt, w_tile=wt, seed=s
+            ),
+            sl, nbytes,
+        )
+    del sl, slices
+
     result = {
         "generated_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "backend": backend,

@@ -425,12 +425,15 @@ def test_oneil_pallas_interpret_matches_scan():
 def test_oneil_plan_blocks_legal(s, k):
     from roaringbitmap_tpu.ops import pallas_kernels as pk
 
+    # default plan == what the kernel dispatch runs (w_tile=-1 resolution
+    # lives in oneil_plan itself, so this covers the shipped layout)
     plan = pk.oneil_plan(s, k, 2048)
     assert pk.mosaic_block_ok(plan["slices_block"], plan["slices_array"])
     assert pk.mosaic_block_ok(plan["kw_block"], plan["kw_array"])
     # VMEM: double-buffered slices block + 3 kw blocks + state must fit
-    in_bytes = 4 * s * pk.ONEIL_K_TILE * 2048
-    assert 2 * in_bytes + 6 * 4 * pk.ONEIL_K_TILE * 2048 <= 12 * 2**20
+    _, kt, w_eff = plan["slices_block"]
+    in_bytes = 4 * s * kt * w_eff
+    assert 2 * in_bytes + 6 * 4 * kt * w_eff <= 12 * 2**20
 
 
 @pytest.mark.parametrize("op,npop", [("or", np.bitwise_or), ("and", np.bitwise_and), ("xor", np.bitwise_xor)])
