@@ -26,6 +26,7 @@ from . import common  # noqa: F401
 
 SUITES = [
     "realdata",
+    "realdata_ops",
     "ops",
     "iteration",
     "serialization",

@@ -1,0 +1,128 @@
+"""Realdata in-place / derived-op suites — twin of the jmh realdata
+families not covered by ``benchmarks/realdata.py`` (wide aggregations) or
+``benchmarks/ops.py`` (pairwise and/or/xor/andNot):
+
+* ``pairwiseIOr``      — RealDataBenchmarkIOr.java:17-23 (clone head, ior-fold
+  the rest, final cardinality)
+* ``flipLargeRange``   — RealDataBenchmarkInot.java:16-22 (flip [30000, 20M)
+  on every bitmap, sum cardinalities)
+* ``pairwiseOrNot``    — RealDataBenchmarkOrNot.java:19-27 (static orNot of
+  successive pairs bounded by last())
+* ``cardinality``      — RealDataBenchmarkCardinality.java:17-24
+* ``forEach``          — RealDataBenchmarkForEach.java:18-24 (consumer sums
+  every value)
+* ``mappedWideOr``     — needwork/SlowMappedORaggregate1.java:32-35 (wide OR
+  with every operand a zero-copy mapped ImmutableRoaringBitmap)
+* ``limitIncludingAndNot`` — SelectTopValuesBenchmark.java:32-36 (peel the
+  top-N off a bitmap via limit + andNot)
+
+Each timed closure ends in a value derived from the result (cardinality
+sums), mirroring the jmh Blackhole discipline so work cannot be elided.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from roaringbitmap_tpu import RoaringBitmap
+from roaringbitmap_tpu.models.buffer import BufferFastAggregation, MutableRoaringBitmap
+from roaringbitmap_tpu.models.immutable import ImmutableRoaringBitmap
+from roaringbitmap_tpu.parallel.aggregation import FastAggregation
+
+from . import common
+from .common import Result
+
+
+def _suite(dataset: str, reps: int) -> List[Result]:
+    bms = common.corpus_bitmaps(dataset, limit=200)
+    out = []
+
+    def bench(name, fn, per=1, extra=None):
+        ns = common.min_of(reps, fn)
+        out.append(Result(name, dataset, ns / max(1, per), "ns/op", extra or {}))
+
+    def pairwise_ior():
+        acc = bms[0].clone()
+        for b in bms[1:]:
+            acc.ior(b)
+        return acc.get_cardinality()
+
+    bench("pairwiseIOr", pairwise_ior, extra={"n_bitmaps": len(bms)})
+
+    def flip_large_range():
+        total = 0
+        for b in bms:
+            total += RoaringBitmap.flip(b, 30_000, 20_000_000).get_cardinality()
+        return total
+
+    bench("flipLargeRange", flip_large_range, per=len(bms))
+
+    def pairwise_or_not():
+        total = 0
+        for k in range(len(bms) - 1):
+            total += RoaringBitmap.or_not(
+                bms[k], bms[k + 1], int(bms[k].last()) + 1
+            ).get_cardinality()
+        return total
+
+    bench("pairwiseOrNot", pairwise_or_not, per=max(1, len(bms) - 1))
+
+    bench(
+        "cardinality",
+        lambda: sum(b.get_cardinality() for b in bms),
+        per=len(bms),
+    )
+
+    def for_each():
+        total = 0
+        for b in bms:
+            box = [0]
+
+            def add(v, box=box):
+                box[0] += v
+
+            b.for_each(add)
+            total += box[0]
+        return total
+
+    total_vals = sum(b.get_cardinality() for b in bms)
+    ns = common.min_of(max(1, reps // 2), for_each)
+    out.append(Result("forEach", dataset, ns / max(1, total_vals), "ns/value"))
+
+    # wide OR where every operand is a zero-copy mapped immutable bitmap —
+    # the "slow mapped OR aggregate" the reference keeps as a known-hard case
+    mapped = [ImmutableRoaringBitmap(b.serialize()) for b in bms]
+    heap_card = FastAggregation.or_(*bms, mode="cpu").get_cardinality()
+    mapped_card = BufferFastAggregation.or_(*mapped, mode="cpu").get_cardinality()
+    assert mapped_card == heap_card, (mapped_card, heap_card)
+    bench(
+        "mappedWideOr",
+        lambda: BufferFastAggregation.or_(*mapped, mode="cpu").get_cardinality(),
+        extra={"n_bitmaps": len(mapped)},
+    )
+    return out
+
+
+def _select_top_values(reps: int) -> List[Result]:
+    # SelectTopValuesBenchmark's synthetic state: values i*100, peel top n
+    base = MutableRoaringBitmap.bitmap_of(*range(0, 1_000_000, 100))
+    n = 1000
+
+    def limit_including_andnot():
+        bm = base.clone()
+        turnoff = bm.limit(n)
+        bm.iandnot(turnoff)
+        return bm.get_cardinality()
+
+    expect = base.get_cardinality() - n
+    assert limit_including_andnot() == expect
+    ns = common.min_of(reps, limit_including_andnot)
+    return [Result("limitIncludingAndNot", "synthetic", ns, "ns/op", {"n": n})]
+
+
+def run(reps: int = 5, datasets=None, **_) -> List[Result]:
+    results = []
+    for ds in datasets or common.DEFAULT_DATASETS:
+        results.extend(_suite(ds, reps))
+    results.extend(_select_top_values(reps))
+    return results

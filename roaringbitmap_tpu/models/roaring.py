@@ -285,8 +285,15 @@ class RoaringBitmap:
     # pairwise algebra (static, like the reference)
     # ------------------------------------------------------------------
     @staticmethod
-    def and_(x1: "RoaringBitmap", x2: "RoaringBitmap") -> "RoaringBitmap":
-        """RoaringBitmap.and (RoaringBitmap.java:377): intersect keys, drop empties."""
+    def and_(x1: "RoaringBitmap", x2: "RoaringBitmap", *more: "RoaringBitmap") -> "RoaringBitmap":
+        """RoaringBitmap.and (RoaringBitmap.java:377): intersect keys, drop empties.
+
+        With more than two operands this delegates to FastAggregation like the
+        reference's ``and(Iterator)`` facade overload (:831-844)."""
+        if more:
+            from ..parallel.aggregation import FastAggregation
+
+            return FastAggregation.and_(x1, x2, *more)
         out = RoaringBitmap()
         a, b = x1.high_low_container, x2.high_low_container
         ia = ib = 0
@@ -305,12 +312,23 @@ class RoaringBitmap:
         return out
 
     @staticmethod
-    def or_(x1: "RoaringBitmap", x2: "RoaringBitmap") -> "RoaringBitmap":
-        """RoaringBitmap.or (RoaringBitmap.java:860): two-pointer key merge."""
+    def or_(x1: "RoaringBitmap", x2: "RoaringBitmap", *more: "RoaringBitmap") -> "RoaringBitmap":
+        """RoaringBitmap.or (RoaringBitmap.java:860): two-pointer key merge.
+
+        With more than two operands this delegates to FastAggregation like the
+        reference's ``or(RoaringBitmap...)`` facade overload (:831-844)."""
+        if more:
+            from ..parallel.aggregation import FastAggregation
+
+            return FastAggregation.or_(x1, x2, *more)
         return RoaringBitmap._merge_op(x1, x2, "or")
 
     @staticmethod
-    def xor(x1: "RoaringBitmap", x2: "RoaringBitmap") -> "RoaringBitmap":
+    def xor(x1: "RoaringBitmap", x2: "RoaringBitmap", *more: "RoaringBitmap") -> "RoaringBitmap":
+        if more:
+            from ..parallel.aggregation import FastAggregation
+
+            return FastAggregation.xor(x1, x2, *more)
         return RoaringBitmap._merge_op(x1, x2, "xor")
 
     @staticmethod
@@ -343,6 +361,45 @@ class RoaringBitmap:
             out.high_low_container.append(b.keys[ib], b.containers[ib].clone())
             ib += 1
         return out
+
+    @staticmethod
+    def _restrict(bm: "RoaringBitmap", start: int, end: int) -> "RoaringBitmap":
+        """Values of ``bm`` in ``[start, end)`` (selectRangeWithoutCopy,
+        RoaringBitmap.java:3135): interior containers are shared-cloned,
+        only the two boundary chunks are masked."""
+        out = RoaringBitmap()
+        if start >= end:
+            return out
+        hlc = bm.high_low_container
+        first_key, last_key = start >> 16, (end - 1) >> 16
+        i = hlc.advance_until(first_key, -1)
+        while i < hlc.size and hlc.keys[i] <= last_key:
+            k = hlc.keys[i]
+            c = hlc.containers[i]
+            lo = start - (k << 16) if k == first_key else 0
+            hi = end - (k << 16) if k == last_key else 1 << 16
+            if lo > 0 or hi < (1 << 16):
+                c = c.and_(container_range_of_ones(lo, hi))
+            # interior containers are shared, not cloned: the result is only
+            # ever fed to non-mutating static algebra
+            if c.cardinality:
+                out.high_low_container.append(k, c)
+            i += 1
+        return out
+
+    @staticmethod
+    def andnot_range(
+        x1: "RoaringBitmap", x2: "RoaringBitmap", range_start: int, range_end: int
+    ) -> "RoaringBitmap":
+        """Ranged difference: (x1 \\ x2) restricted to [range_start, range_end)
+        (RoaringBitmap.andNot(x1, x2, rangeStart, rangeEnd),
+        RoaringBitmap.java:1396-1402 — both operands are restricted to the
+        range before the subtraction, so values of x1 outside it are dropped)."""
+        range_start, range_end = _check_range(range_start, range_end)
+        return RoaringBitmap.andnot(
+            RoaringBitmap._restrict(x1, range_start, range_end),
+            RoaringBitmap._restrict(x2, range_start, range_end),
+        )
 
     @staticmethod
     def andnot(x1: "RoaringBitmap", x2: "RoaringBitmap") -> "RoaringBitmap":

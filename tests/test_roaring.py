@@ -232,3 +232,33 @@ def test_constructor_accepts_any_iterable():
     assert set(RoaringBitmap({1, 2, 3}).to_array().tolist()) == {1, 2, 3}
     assert set(RoaringBitmap(v for v in [5, 6]).to_array().tolist()) == {5, 6}
     assert RoaringBitmap(iter([])).is_empty()
+
+
+def test_andnot_range_matches_set_oracle(random_bitmap_factory):
+    """Ranged difference (RoaringBitmap.andNot(x1, x2, start, end),
+    RoaringBitmap.java:1396-1402): both operands restricted to the range."""
+    a, va = random_bitmap_factory()
+    b, vb = random_bitmap_factory()
+    sa, sb = set(map(int, va)), set(map(int, vb))
+    lo = int(np.min(va)) + 1000
+    hi = max(int(np.max(va)) // 2 + (1 << 17), lo)
+    got = RoaringBitmap.andnot_range(a, b, lo, hi)
+    want = {v for v in sa - sb if lo <= v < hi}
+    assert set(map(int, got.to_array())) == want
+    # range boundaries inside one container, empty range, full range
+    assert RoaringBitmap.andnot_range(a, b, 5, 5).is_empty()
+    full = RoaringBitmap.andnot_range(a, b, 0, 1 << 32)
+    assert full == RoaringBitmap.andnot(a, b)
+
+
+def test_varargs_facade_delegates_to_aggregation(random_bitmap_factory):
+    """or/and/xor facade overloads over >2 operands delegate to
+    FastAggregation like RoaringBitmap.java:831-844."""
+    bms = [random_bitmap_factory()[0] for _ in range(4)]
+    sets = [set(map(int, bm.to_array())) for bm in bms]
+    assert set(map(int, RoaringBitmap.or_(*bms).to_array())) == set.union(*sets)
+    assert set(map(int, RoaringBitmap.and_(*bms).to_array())) == set.intersection(*sets)
+    want_xor = set()
+    for s in sets:
+        want_xor ^= s
+    assert set(map(int, RoaringBitmap.xor(*bms).to_array())) == want_xor
