@@ -58,6 +58,42 @@ def run(reps: int = 5, **_) -> List[Result]:
     bench("compareEQ", lambda: bsi.compare(Operation.EQ, med, 0, None))
     bench("sum", lambda: bsi.sum(found))
     bench("topK", lambda: bsi.top_k(found, 100))
+
+    # batched multi-predicate counts: Q thresholds per dispatch vs a loop
+    # of single-predicate counts (the vmapped walk amortizes the HBM pass)
+    q_vals = np.quantile(vals, np.linspace(0.05, 0.95, 64)).astype(np.int64)
+    for mode in ("cpu", "device"):
+        many = common.min_of(
+            reps,
+            lambda m=mode: bsi.compare_cardinality_many(
+                Operation.GE, q_vals, found_set=found, mode=m
+            ),
+        )
+        out.append(
+            Result(
+                f"compareCardinalityMany64_{mode}",
+                "synthetic-1M",
+                many / q_vals.size,
+                "ns/query",
+                {"rows": N_ROWS, "batch": int(q_vals.size)},
+            )
+        )
+    loop = common.min_of(
+        max(1, reps // 2),
+        lambda: [
+            bsi.compare_cardinality(Operation.GE, int(v), 0, found, mode="device")
+            for v in q_vals
+        ],
+    )
+    out.append(
+        Result(
+            "compareCardinalityLoop64_device",
+            "synthetic-1M",
+            loop / q_vals.size,
+            "ns/query",
+            {"rows": N_ROWS, "batch": int(q_vals.size)},
+        )
+    )
     return out
 
 

@@ -292,14 +292,58 @@ class Roaring64BitmapSliceIndex:
             )
             total = int(np.asarray(cards).astype(np.int64).sum())
             if operation == Operation.NEQ and found_set is not None:
-                kset = set(keys)  # outside-ebm chunks qualify wholesale
-                total += sum(
-                    c.cardinality for k, c in found_set._kv() if k not in kset
-                )
+                total += self._neq_outside_ebm(found_set, keys)
             return total
         return self.compare(
             operation, start_or_value, end, found_set, mode="cpu"
         ).get_cardinality()
+
+    def compare_cardinality_many(
+        self,
+        operation: Operation,
+        values,
+        ends=None,
+        found_set: Optional[Roaring64Bitmap] = None,
+        mode: Optional[str] = None,
+    ) -> np.ndarray:
+        """Batched count-only compare over [Q] 64-bit thresholds in one
+        device dispatch (the 32-bit compare_cardinality_many twin: the
+        vmapped O'Neil walk shares one HBM pass over the [S, K, 2048]
+        high-48-chunk pack across all Q predicates)."""
+        from .bsi import _counts_many
+
+        return _counts_many(
+            self,
+            operation,
+            values,
+            ends,
+            found_set,
+            mode,
+            batched_ok=self._use_device(mode),
+            pack_fixed=lambda: self._pack_with_fixed(found_set),
+            neq_remainder=lambda keys: self._neq_outside_ebm(found_set, keys),
+        )
+
+    def _pack_with_fixed(self, found_set: Optional[Roaring64Bitmap]):
+        """(keys, ebm_w, slices_w, fixed_w) over high-48 chunk keys — shared
+        pack+found-set marshal (32-bit twin: bsi._pack_with_fixed)."""
+        import jax.numpy as jnp
+
+        keys, ebm_w, slices_w = self._pack_dense64()
+        if found_set is None:
+            fixed_w = ebm_w
+        else:
+            fixed_w = jnp.asarray(
+                self._found_words(keys, (len(keys), ebm_w.shape[1]), found_set)
+            )
+        return keys, ebm_w, slices_w, fixed_w
+
+    @staticmethod
+    def _neq_outside_ebm(found_set: Roaring64Bitmap, keys) -> int:
+        """Clone-free count of found-set columns in chunks outside the
+        packed ebm keys (NEQ qualifies them wholesale)."""
+        kset = set(keys)
+        return sum(c.cardinality for k, c in found_set._kv() if k not in kset)
 
     def _use_device(self, mode: Optional[str]) -> bool:
         mode = mode or config.mode
@@ -375,7 +419,7 @@ class Roaring64BitmapSliceIndex:
 
         from ..ops import pallas_kernels as pk
 
-        keys, ebm_w, slices_w = self._pack_dense64()
+        keys, ebm_w, slices_w, fixed_w = self._pack_with_fixed(found_set)
         S = self.bit_count()
         bits_vec = np.array(
             [(predicate >> i) & 1 for i in range(S - 1, -1, -1)], dtype=bool
@@ -385,12 +429,6 @@ class Roaring64BitmapSliceIndex:
                 [(end >> i) & 1 for i in range(S - 1, -1, -1)], dtype=bool
             )
             bits_vec = np.stack([bits_vec, bits_hi])
-        if found_set is None:
-            fixed_w = ebm_w
-        else:
-            fixed_w = jnp.asarray(
-                self._found_words(keys, (len(keys), ebm_w.shape[1]), found_set)
-            )
         out, cards = pk.best_oneil_compare(
             slices_w, jnp.asarray(bits_vec), ebm_w, fixed_w, op.value
         )

@@ -248,6 +248,20 @@ class ImmutableBitSliceIndex(_RangeQueryAPI):
     def compare(self, operation, start_or_value, end=0, found_set=None, mode=None):
         return self._base.compare(operation, start_or_value, end, found_set, mode)
 
+    def compare_cardinality(
+        self, operation, start_or_value, end=0, found_set=None, mode=None
+    ):
+        return self._base.compare_cardinality(
+            operation, start_or_value, end, found_set, mode
+        )
+
+    def compare_cardinality_many(
+        self, operation, values, ends=None, found_set=None, mode=None
+    ):
+        return self._base.compare_cardinality_many(
+            operation, values, ends, found_set, mode
+        )
+
     def sum(self, found_set=None):
         return self._base.sum(found_set)
 

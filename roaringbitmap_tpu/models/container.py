@@ -582,11 +582,14 @@ class RunContainer(Container):
     RunContainer.java:78)."""
 
     TYPE = RUN_TYPE
-    __slots__ = ("starts", "lengths")
+    __slots__ = ("starts", "lengths", "_card")
 
     def __init__(self, starts=None, lengths=None):
         self.starts = _as_u16(starts if starts is not None else [])
         self.lengths = _as_u16(lengths if lengths is not None else [])
+        # run payloads are copy-on-write (every mutating op returns a new
+        # container), so cardinality is computed at most once
+        self._card = -1
 
     @staticmethod
     def from_values(values: np.ndarray) -> "RunContainer":
@@ -599,7 +602,9 @@ class RunContainer(Container):
 
     @property
     def cardinality(self) -> int:
-        return int(self.lengths.astype(np.int64).sum()) + int(self.starts.size)
+        if self._card < 0:
+            self._card = int(self.lengths.astype(np.int64).sum()) + int(self.starts.size)
+        return self._card
 
     def to_array(self) -> np.ndarray:
         return bits.values_from_runs(self.starts, self.lengths)
@@ -777,6 +782,8 @@ def container_range_of_ones(start: int, end: int) -> Container:
     n = end - start
     if n <= 2:
         return ArrayContainer(np.arange(start, end, dtype=np.uint16))
-    return RunContainer(
+    c = RunContainer(
         np.array([start], dtype=np.uint16), np.array([n - 1], dtype=np.uint16)
     )
+    c._card = n
+    return c

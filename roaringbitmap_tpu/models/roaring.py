@@ -227,7 +227,7 @@ class RoaringBitmap:
                 # add and flip are identical on an absent container
                 c = container_range_of_ones(lo, hi)
                 if c.cardinality:
-                    hlc.insert_new_key_value_at(-hlc.get_index(hb) - 1, hb, c)
+                    hlc.insert_new_key_value_at(-i - 1, hb, c)
 
     def contains_many(self, values) -> np.ndarray:
         """Vectorized membership: bool array aligned with ``values`` (the
@@ -332,7 +332,13 @@ class RoaringBitmap:
         return RoaringBitmap._merge_op(x1, x2, "xor")
 
     @staticmethod
-    def _merge_op(x1, x2, op: str) -> "RoaringBitmap":
+    def _merge_op(x1, x2, op: str, reuse_left: bool = False) -> "RoaringBitmap":
+        """Two-pointer key merge. ``reuse_left`` transfers x1's pass-through
+        containers without cloning — the in-place ops use it the way the
+        reference's member or/xor mutate ``this`` but never alias ``x2``
+        (RoaringBitmap.java member or :926; matched-key results are always
+        fresh objects from the container op, so only pass-through clones
+        are at stake)."""
         out = RoaringBitmap()
         a, b = x1.high_low_container, x2.high_low_container
         ia = ib = 0
@@ -349,13 +355,15 @@ class RoaringBitmap:
                 ia += 1
                 ib += 1
             elif ka < kb:
-                out.high_low_container.append(ka, a.containers[ia].clone())
+                c = a.containers[ia] if reuse_left else a.containers[ia].clone()
+                out.high_low_container.append(ka, c)
                 ia += 1
             else:
                 out.high_low_container.append(kb, b.containers[ib].clone())
                 ib += 1
         while ia < a.size:
-            out.high_low_container.append(a.keys[ia], a.containers[ia].clone())
+            c = a.containers[ia] if reuse_left else a.containers[ia].clone()
+            out.high_low_container.append(a.keys[ia], c)
             ia += 1
         while ib < b.size:
             out.high_low_container.append(b.keys[ib], b.containers[ib].clone())
@@ -518,7 +526,9 @@ class RoaringBitmap:
 
     # in-place variants + operators
     def ior(self, other: "RoaringBitmap") -> "RoaringBitmap":
-        self.high_low_container = RoaringBitmap.or_(self, other).high_low_container
+        self.high_low_container = RoaringBitmap._merge_op(
+            self, other, "or", reuse_left=True
+        ).high_low_container
         return self
 
     def iand(self, other: "RoaringBitmap") -> "RoaringBitmap":
@@ -526,7 +536,9 @@ class RoaringBitmap:
         return self
 
     def ixor(self, other: "RoaringBitmap") -> "RoaringBitmap":
-        self.high_low_container = RoaringBitmap.xor(self, other).high_low_container
+        self.high_low_container = RoaringBitmap._merge_op(
+            self, other, "xor", reuse_left=True
+        ).high_low_container
         return self
 
     def iandnot(self, other: "RoaringBitmap") -> "RoaringBitmap":

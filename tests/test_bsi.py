@@ -255,3 +255,72 @@ def test_get_values_beyond_int63():
     assert exists.tolist() == [True, True, False]
     assert list(values) == [1 << 63, (1 << 64) + 5, 0]
     assert b.get_value(1) == (1 << 63, True)
+
+
+def test_compare_cardinality_many_matches_single():
+    """Batched multi-predicate counts == per-predicate compare_cardinality
+    across ops, modes, found sets, and short-circuit mixes (the vmapped
+    device walk answers all Q predicates in one dispatch)."""
+    rng = np.random.default_rng(31)
+    bsi = RoaringBitmapSliceIndex()
+    cols = np.sort(rng.choice(400_000, size=30_000, replace=False))
+    vals = rng.integers(0, 1 << 20, size=30_000)
+    bsi.set_values((cols, vals))
+    found = RoaringBitmap(
+        rng.choice(800_000, size=25_000, replace=False).astype(np.uint32)
+    )
+    # thresholds spanning in-range, below-min and above-max (short-circuits)
+    qs = np.array(
+        [int(np.median(vals)), 0, (1 << 22), int(vals[5]), 1 + int(vals.max())],
+        dtype=np.int64,
+    )
+    for op in (Operation.GE, Operation.LT, Operation.EQ, Operation.NEQ):
+        for fs in (None, found):
+            want = np.array(
+                [bsi.compare_cardinality(op, int(v), 0, fs, mode="cpu") for v in qs],
+                dtype=np.int64,
+            )
+            for mode in ("cpu", "device"):
+                got = bsi.compare_cardinality_many(op, qs, found_set=fs, mode=mode)
+                assert np.array_equal(got, want), (op, mode, fs is not None)
+    # RANGE with per-query ends (incl. an oversized end that must clamp)
+    ends = qs + np.array([1000, 50, 1 << 40, 0, 10], dtype=np.int64)
+    for fs in (None, found):
+        want = np.array(
+            [
+                bsi.compare_cardinality(Operation.RANGE, int(a), int(b), fs, mode="cpu")
+                for a, b in zip(qs, ends)
+            ],
+            dtype=np.int64,
+        )
+        for mode in ("cpu", "device"):
+            got = bsi.compare_cardinality_many(
+                Operation.RANGE, qs, ends=ends, found_set=fs, mode=mode
+            )
+            assert np.array_equal(got, want), ("RANGE", mode, fs is not None)
+    # empty batch, misaligned ends
+    assert bsi.compare_cardinality_many(Operation.GE, []).size == 0
+    with pytest.raises(ValueError):
+        bsi.compare_cardinality_many(Operation.RANGE, qs)
+    with pytest.raises(ValueError):
+        bsi.compare_cardinality_many(Operation.RANGE, qs, ends=ends[:2])
+
+
+def test_compare_cardinality_many_beyond_int63():
+    """Thresholds at/above 2^63 must not wrap through an int64 cast
+    (code-review r4): the batched path must match the single-predicate
+    engine on an index holding huge values."""
+    bsi = RoaringBitmapSliceIndex()
+    bsi.set_value(1, 7)
+    bsi.set_value(2, 1 << 63)
+    bsi.set_value(3, (1 << 64) + 5)
+    qs = np.array([1 << 63], dtype=np.uint64)
+    want = bsi.compare_cardinality(Operation.GE, 1 << 63)
+    assert want == 2
+    got = bsi.compare_cardinality_many(Operation.GE, qs)
+    assert got.tolist() == [2]
+    got = bsi.compare_cardinality_many(Operation.GE, [(1 << 64) + 5])
+    assert got.tolist() == [1]
+    # RANGE ends beyond the bit depth clamp instead of wrapping
+    got = bsi.compare_cardinality_many(Operation.RANGE, [0], ends=[(1 << 64) + 100])
+    assert got.tolist() == [3]

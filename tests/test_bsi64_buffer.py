@@ -400,3 +400,62 @@ def test_bsi64_get_values_bulk():
     big.set_value(5, (1 << 63) + 7)
     v, e = big.get_values([5, 6])
     assert list(v) == [(1 << 63) + 7, 0] and e.tolist() == [True, False]
+
+
+def test_bsi64_compare_cardinality_many():
+    """Batched 64-bit counts == per-predicate counts (both modes), incl.
+    short-circuit thresholds, a found set with outside-ebm chunks (NEQ
+    remainder), and per-query RANGE ends."""
+    r = np.random.default_rng(57)
+    b = Roaring64BitmapSliceIndex()
+    cols = r.choice(1 << 40, size=8_000, replace=False).astype(np.int64)
+    vals = r.integers(0, 1 << 28, size=8_000).astype(np.int64)
+    b.set_values(list(zip(cols.tolist(), vals.tolist())))
+    found = Roaring64Bitmap.bitmap_of(
+        *cols[: 2000].tolist(), *(int(c) + (1 << 50) for c in cols[:50])
+    )
+    qs = np.array(
+        [int(np.median(vals)), 0, 1 << 30, int(vals[3])], dtype=np.int64
+    )
+    for op in (Operation.GE, Operation.NEQ, Operation.LT):
+        for fs in (None, found):
+            want = np.array(
+                [b.compare_cardinality(op, int(v), 0, fs, mode="cpu") for v in qs],
+                dtype=np.int64,
+            )
+            for mode in ("cpu", "device"):
+                got = b.compare_cardinality_many(op, qs, found_set=fs, mode=mode)
+                assert np.array_equal(got, want), (op, mode, fs is not None)
+    ends = qs + 999
+    want = np.array(
+        [
+            b.compare_cardinality(Operation.RANGE, int(a), int(e), None, mode="cpu")
+            for a, e in zip(qs, ends)
+        ],
+        dtype=np.int64,
+    )
+    for mode in ("cpu", "device"):
+        got = b.compare_cardinality_many(Operation.RANGE, qs, ends=ends, mode=mode)
+        assert np.array_equal(got, want), mode
+
+
+def test_buffer_bsi_compare_cardinality_delegation():
+    """The Immutable twin answers the count-only family (incl. the batched
+    form) over lazily mapped buffers, equal to the heap twin."""
+    from roaringbitmap_tpu.models.bsi import RoaringBitmapSliceIndex
+
+    r = np.random.default_rng(71)
+    heap = RoaringBitmapSliceIndex()
+    cols = np.sort(r.choice(200_000, size=6_000, replace=False)).astype(np.uint32)
+    vals = r.integers(0, 1 << 16, size=6_000).astype(np.int64)
+    heap.set_values((cols, vals))
+    imm = ImmutableBitSliceIndex(heap.serialize())
+    med = int(np.median(vals))
+    qs = np.array([med, med // 2, 0, 1 << 20], dtype=np.int64)
+    assert imm.compare_cardinality(Operation.GE, med) == heap.compare_cardinality(
+        Operation.GE, med
+    )
+    assert np.array_equal(
+        imm.compare_cardinality_many(Operation.GE, qs),
+        heap.compare_cardinality_many(Operation.GE, qs),
+    )
