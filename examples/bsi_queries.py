@@ -55,6 +55,14 @@ def main():
     assert (values[exists] == scores[probe[exists]]).all()
     print(f"bulk-read {probe.size} columns, {int(exists.sum())} present")
 
+    # batched predicates: a whole array of thresholds in ONE device
+    # dispatch (per-tenant cutoffs, histogram buckets, percentile scans —
+    # all Q walks share a single HBM pass over the packed slice tensor)
+    cutoffs = np.quantile(scores, [0.5, 0.9, 0.99]).astype(np.int64)
+    counts = index.compare_cardinality_many(Operation.GE, cutoffs, found_set=cohort)
+    for c, k in zip(cutoffs, counts):
+        print(f"cohort rows with score >= {int(c)}: {int(k)}")
+
 
 if __name__ == "__main__":
     main()

@@ -678,6 +678,56 @@ class RangeBitmap:
     def between_cardinality(self, lo: int, hi: int, context=None) -> int:
         return self._compare_cardinality(Operation.RANGE, lo, hi, context)
 
+    # Batched cardinality family: a whole [Q] array of thresholds answered
+    # in ONE device dispatch on the context-free path (the BSI's vmapped
+    # O'Neil walk shares a single HBM pass over the packed slice tensor —
+    # no reference equivalent; RangeBitmap.java answers one query per call)
+    def _compare_cardinality_many(self, op, values, ends=None, context=None):
+        vals = [int(v) for v in np.asarray(values, dtype=object).ravel()]
+        if any(v < 0 for v in vals):
+            raise ValueError("RangeBitmap values are unsigned")
+        if op is Operation.RANGE:
+            # same contract as the context-free engine (bsi._counts_many)
+            if ends is None:
+                raise ValueError("RANGE requires ends")
+            end_list = [int(e) for e in np.asarray(ends, dtype=object).ravel()]
+            if len(end_list) != len(vals):
+                raise ValueError("ends must align with values")
+        else:
+            end_list = [0] * len(vals)
+        if context is not None:
+            return np.array(
+                [
+                    self._chunk_walk(op, v, e, context).get_cardinality()
+                    for v, e in zip(vals, end_list)
+                ],
+                dtype=np.int64,
+            )
+        return self._bsi_index().compare_cardinality_many(
+            op, vals, end_list if op is Operation.RANGE else None
+        )
+
+    def lt_cardinality_many(self, values, context=None):
+        return self._compare_cardinality_many(Operation.LT, values, None, context)
+
+    def lte_cardinality_many(self, values, context=None):
+        return self._compare_cardinality_many(Operation.LE, values, None, context)
+
+    def gt_cardinality_many(self, values, context=None):
+        return self._compare_cardinality_many(Operation.GT, values, None, context)
+
+    def gte_cardinality_many(self, values, context=None):
+        return self._compare_cardinality_many(Operation.GE, values, None, context)
+
+    def eq_cardinality_many(self, values, context=None):
+        return self._compare_cardinality_many(Operation.EQ, values, None, context)
+
+    def neq_cardinality_many(self, values, context=None):
+        return self._compare_cardinality_many(Operation.NEQ, values, None, context)
+
+    def between_cardinality_many(self, los, his, context=None):
+        return self._compare_cardinality_many(Operation.RANGE, los, his, context)
+
     # ------------------------------------------------------------------
     @property
     def row_count(self) -> int:

@@ -209,6 +209,41 @@ def main():
             assert np.array_equal(got_cards, np.asarray(want_cards)), f"oneil {op} cards"
         return {"compile_s_per_op": times, "shape": [s, k, 2048]}
 
+    @family("oneil_batched")
+    def check_oneil_batched():
+        # the vmapped multi-predicate walk (bsi._o_neil_counts_batched) on
+        # real hardware: [Q] thresholds in one dispatch vs the CPU engine
+        from roaringbitmap_tpu.models.bsi import (
+            Operation,
+            RoaringBitmapSliceIndex,
+        )
+
+        cols = np.sort(rng.choice(4_000_000, size=300_000, replace=False)).astype(
+            np.uint32
+        )
+        vals = rng.integers(0, 1 << 24, size=cols.size)
+        bsi = RoaringBitmapSliceIndex()
+        bsi.set_values((cols, vals))
+        qs = np.quantile(vals, np.linspace(0.05, 0.95, 8)).astype(np.int64)
+        times = {}
+        for op in (Operation.GE, Operation.NEQ):
+            t0 = time.time()
+            got = bsi.compare_cardinality_many(op, qs, mode="device")
+            times[op.value] = round(time.time() - t0, 1)
+            want = [
+                bsi.compare_cardinality(op, int(v), 0, None, mode="cpu") for v in qs
+            ]
+            assert got.tolist() == want, f"batched {op} mismatch"
+        got = bsi.compare_cardinality_many(
+            Operation.RANGE, qs, ends=qs + 100_000, mode="device"
+        )
+        want = [
+            bsi.compare_cardinality(Operation.RANGE, int(v), int(v) + 100_000, None, "cpu")
+            for v in qs
+        ]
+        assert got.tolist() == want, "batched RANGE mismatch"
+        return {"rows": int(cols.size), "batch": int(qs.size), "seconds_per_op": times}
+
     @family("segmented_pallas")
     def check_segmented():
         n = 5_000

@@ -554,3 +554,68 @@ class TestJavaFormatAdversarial:
                 m.lte_cardinality(63)
             except InvalidRoaringFormat:
                 pass
+
+
+def test_cardinality_many_matches_single():
+    """Batched threshold counts == per-threshold *_cardinality on every
+    query family, incl. context-masked (chunk-walk loop) and a mapped
+    index (zero-copy slices feeding the same batched engine)."""
+    import numpy as np
+
+    from roaringbitmap_tpu import RangeBitmap, RoaringBitmap
+
+    rng = np.random.default_rng(11)
+    vals = rng.integers(0, 1 << 20, size=150_000)
+    ap = RangeBitmap.appender(int(vals.max()))
+    for v in vals.tolist():
+        ap.add(v)
+    rb = ap.build()
+    qs = np.quantile(vals, [0.1, 0.5, 0.9]).astype(np.int64).tolist() + [0, 1 << 30]
+    ctx = RoaringBitmap(
+        rng.choice(vals.size, size=vals.size // 10, replace=False).astype(np.uint32)
+    )
+    for many, single in (
+        (rb.lt_cardinality_many, rb.lt_cardinality),
+        (rb.lte_cardinality_many, rb.lte_cardinality),
+        (rb.gt_cardinality_many, rb.gt_cardinality),
+        (rb.gte_cardinality_many, rb.gte_cardinality),
+        (rb.eq_cardinality_many, rb.eq_cardinality),
+        (rb.neq_cardinality_many, rb.neq_cardinality),
+    ):
+        for context in (None, ctx):
+            got = many(qs, context=context)
+            want = [single(int(v), context=context) for v in qs]
+            assert got.tolist() == want, (single.__name__, context is not None)
+    los = qs
+    his = [q + 5000 for q in qs]
+    assert rb.between_cardinality_many(los, his).tolist() == [
+        rb.between_cardinality(a, b) for a, b in zip(los, his)
+    ]
+    # mapped index answers the same batch
+    mapped = RangeBitmap.map(rb.serialize())
+    assert np.array_equal(mapped.gte_cardinality_many(qs), rb.gte_cardinality_many(qs))
+    # unsigned validation
+    import pytest
+
+    with pytest.raises(ValueError):
+        rb.lt_cardinality_many([-1])
+
+
+def test_cardinality_many_range_validation_with_context():
+    """Context path enforces the same RANGE ends contract as the
+    context-free engine (code-review r4: zip() was silently truncating)."""
+    import numpy as np
+    import pytest
+
+    from roaringbitmap_tpu import RangeBitmap, RoaringBitmap
+
+    ap = RangeBitmap.appender(1000)
+    for v in range(100):
+        ap.add(v * 7 % 1000)
+    rb = ap.build()
+    ctx = RoaringBitmap(np.arange(50, dtype=np.uint32))
+    for context in (None, ctx):
+        with pytest.raises(ValueError):
+            rb.between_cardinality_many([1, 2, 3], None, context=context)
+        with pytest.raises(ValueError):
+            rb.between_cardinality_many([1, 2, 3], [5], context=context)
