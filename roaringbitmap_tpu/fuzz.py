@@ -460,7 +460,69 @@ def run_campaign(iterations: Optional[int] = None, verbose: bool = True) -> dict
         ),
         actual=max(1, n // 8),
     )
+    # round-4 second-session surfaces: the batched multi-predicate counts
+    # and the ranged andNot facade overload
+    _run(
+        "batched-counts-agree",
+        lambda: verify_invariance(
+            "batched-counts-agree",
+            _batched_counts_pred,
+            arity=1, iterations=max(1, n // 8), seed=48,
+        ),
+        actual=max(1, n // 8),
+    )
+    _run(
+        "ranged-andnot-agrees",
+        lambda: verify_invariance(
+            "ranged-andnot-agrees",
+            _ranged_andnot_pred,
+            arity=2, iterations=max(1, n // 8), seed=49,
+        ),
+        actual=max(1, n // 8),
+    )
     return results
+
+
+def _batched_counts_pred(a) -> bool:
+    """compare_cardinality_many must agree with the single-predicate engine
+    on a BSI derived from the fuzz bitmap, across ops, modes, and a RANGE
+    batch with per-query ends."""
+    from .models.bsi import Operation, RoaringBitmapSliceIndex
+
+    cols = a.to_array()
+    if cols.size == 0:
+        return True
+    vals = (cols.astype(np.int64) * 2654435761) % (1 << 22)
+    b = RoaringBitmapSliceIndex()
+    b.set_values((cols, vals))
+    qs = [int(vals[0]), int(vals.min()), int(vals.max()) + 7, 0]
+    for op in (Operation.GE, Operation.LT, Operation.NEQ):
+        want = [b.compare_cardinality(op, q, 0, None, mode="cpu") for q in qs]
+        for mode in ("cpu", "device"):
+            if b.compare_cardinality_many(op, qs, mode=mode).tolist() != want:
+                return False
+    ends = [q + 1000 for q in qs]
+    want = [
+        b.compare_cardinality(Operation.RANGE, q, e, None, mode="cpu")
+        for q, e in zip(qs, ends)
+    ]
+    return (
+        b.compare_cardinality_many(Operation.RANGE, qs, ends=ends, mode="device").tolist()
+        == want
+    )
+
+
+def _ranged_andnot_pred(a, b) -> bool:
+    """andnot_range == (a \\ b) masked to the range, built through an
+    independent construction (bitmap_of_range AND)."""
+    from .models.roaring import RoaringBitmap as RB
+
+    last = a.last() if not a.is_empty() else 1000
+    lo, hi = last // 3, max(last // 3 + 1, (2 * last) // 3)
+    got = RB.andnot_range(a, b, lo, hi)
+    mask = RB.bitmap_of_range(lo, hi)
+    want = RB.and_(RB.andnot(a, b), mask)
+    return got == want
 
 
 def _select_range_pred(a) -> bool:

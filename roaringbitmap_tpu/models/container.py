@@ -425,8 +425,7 @@ class BitmapContainer(Container):
     def or_(self, other: Container) -> Container:
         if isinstance(other, ArrayContainer):
             words = self.words.copy()
-            v = other.content.astype(np.uint32)
-            np.bitwise_or.at(words, v >> 6, np.uint64(1) << (v & np.uint32(63)).astype(np.uint64))
+            bits.or_values_into_words(words, other.content)
             return BitmapContainer(words)
         return self._binary(other, np.bitwise_or)
 

@@ -149,10 +149,7 @@ class BitmapWriter:
         keys = (v >> 16).astype(np.int64)
         lows = (v & 0xFFFF).astype(np.uint16)
         if self._current_key is not None and np.all(keys == self._current_key):
-            vv = lows.astype(np.uint32)
-            np.bitwise_or.at(
-                self._words, vv >> 6, np.uint64(1) << (vv & np.uint32(63)).astype(np.uint64)
-            )
+            bits.or_values_into_words(self._words, lows)
             self._words_dirty = True
             return
         for key in np.unique(keys):

@@ -460,6 +460,26 @@ def words_from_values(values: np.ndarray, n_words: int = 1024) -> np.ndarray:
     return words
 
 
+def or_values_into_words(words: np.ndarray, values: np.ndarray) -> np.ndarray:
+    """OR values into the caller's accumulator — rb_words_from_values ORs
+    into its output buffer, so the same C loop serves both entry points.
+    Always the ctypes path (the ext module has no or-into variant)."""
+    v = _c16(values)
+    # tier parity: the numpy fallback raises on a short or read-only
+    # accumulator; the C loop would corrupt the heap instead
+    if words.size < 1024:
+        raise IndexError(f"accumulator has {words.size} words, need 1024")
+    if not words.flags.writeable:
+        raise ValueError("accumulator is read-only")
+    if words.dtype != np.uint64 or not words.flags.c_contiguous:
+        w = np.ascontiguousarray(words, dtype=np.uint64)
+        lib().rb_words_from_values(v, v.size, w)
+        words[:] = w
+        return words
+    lib().rb_words_from_values(v, v.size, words)
+    return words
+
+
 def values_from_words(words: np.ndarray) -> np.ndarray:
     w = np.ascontiguousarray(words, dtype=np.uint64)
     out = np.empty(w.size * 64, dtype=np.uint16)

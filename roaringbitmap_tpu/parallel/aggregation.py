@@ -86,10 +86,7 @@ def _ior_container_into(acc: np.ndarray, c: Container) -> None:
     if isinstance(c, BitmapContainer):
         acc |= c.words
     elif isinstance(c, ArrayContainer):
-        v = c.content.astype(np.uint32)
-        np.bitwise_or.at(
-            acc, v >> 6, np.uint64(1) << (v & np.uint32(63)).astype(np.uint64)
-        )
+        bits.or_values_into_words(acc, c.content)
     else:
         for s, l in zip(c.starts.tolist(), c.lengths.tolist()):
             bits.set_bitmap_range(acc, s, s + l + 1)

@@ -67,9 +67,15 @@ def new_words() -> np.ndarray:
 
 def words_from_values(values: np.ndarray) -> np.ndarray:
     """Build 1024-word bitset from sorted-or-not uint16 values."""
-    words = new_words()
+    return or_values_into_words(new_words(), values)
+
+
+def or_values_into_words(words: np.ndarray, values: np.ndarray) -> np.ndarray:
+    """OR uint16 values into an EXISTING word accumulator in place (the
+    lazy-OR fold's array-container scatter; the native tier rides
+    rb_words_from_values, which ORs into the caller's buffer)."""
     v = np.asarray(values, dtype=np.uint32)
-    np.bitwise_or.at(words, v >> 6, _U64_ONE << np.uint64(0) << (v & np.uint32(63)).astype(np.uint64))
+    np.bitwise_or.at(words, v >> 6, _U64_ONE << (v & np.uint32(63)).astype(np.uint64))
     return words
 
 
@@ -312,6 +318,7 @@ _DISPATCHED = (
     "cardinality_of_words",
     "values_from_words",
     "words_from_values",
+    "or_values_into_words",
     "num_runs_in_words",
     "select_in_words",
     "cardinality_in_range",

@@ -100,3 +100,20 @@ def test_high_low_bits():
     assert bits.highbits(0x12345678) == 0x1234
     assert bits.lowbits(0x12345678) == 0x5678
     assert bits.combine(0x1234, 0x5678) == 0x12345678
+
+
+def test_or_values_into_words_accumulates():
+    """or_values_into_words ORs into the existing accumulator (the fold's
+    array-container scatter) — differential vs the allocate-then-or path,
+    exercised on whatever native tier is live."""
+    rng = np.random.default_rng(17)
+    acc = rng.integers(0, 1 << 64, 1024, dtype=np.uint64)
+    vals = rng.integers(0, 1 << 16, 5000).astype(np.uint16)
+    want = acc | bits.words_from_values(vals)
+    got = acc.copy()
+    ret = bits.or_values_into_words(got, vals)
+    assert ret is got and np.array_equal(got, want)
+    # empty scatter is a no-op
+    before = got.copy()
+    bits.or_values_into_words(got, np.empty(0, dtype=np.uint16))
+    assert np.array_equal(got, before)
