@@ -122,7 +122,10 @@ class RoaringBitmap:
             return
         if v.min() < 0 or v.max() >= _MAX32:
             raise ValueError("values outside unsigned 32-bit range")
-        v = np.unique(v.astype(np.uint32))
+        u = v.astype(np.uint32)
+        # strictly-increasing input (the common bulk shape: BSI slice masks,
+        # pre-sorted ingest) skips the unique's O(n log n) sort
+        v = u if bits.is_strictly_increasing(u) else np.unique(u)
         keys = (v >> 16).astype(np.int64)
         lows = (v & 0xFFFF).astype(np.uint16)
         boundaries = np.nonzero(np.diff(keys))[0] + 1

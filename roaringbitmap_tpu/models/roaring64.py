@@ -36,6 +36,7 @@ import numpy as np
 
 from .roaring import RoaringBitmap
 from ..serialization import InvalidRoaringFormat
+from ..utils import bits
 
 _MAX64 = 1 << 64
 _MAX32 = 1 << 32
@@ -73,7 +74,12 @@ def group_by_high(values, shift: int):
         values = np.fromiter(iter(values), dtype=np.uint64)
     if np.issubdtype(values.dtype, np.signedinteger) and values.size and values.min() < 0:
         raise ValueError("values outside unsigned 64-bit range")
-    v = np.sort(np.asarray(values).astype(np.uint64).ravel())
+    v = np.asarray(values).astype(np.uint64).ravel()
+    # pre-sorted bulk input (BSI slice masks, sorted ingest) skips the sort
+    # and the per-bucket uniques
+    presorted = bits.is_strictly_increasing(v)
+    if not presorted:
+        v = np.sort(v)
     if v.size == 0:
         return
     mask = np.uint64((1 << shift) - 1)
@@ -83,7 +89,8 @@ def group_by_high(values, shift: int):
     starts = np.concatenate(([0], boundaries))
     ends = np.concatenate((boundaries, [v.size]))
     for s, e in zip(starts.tolist(), ends.tolist()):
-        yield int(highs[s]), np.unique(lows[s:e])
+        chunk = lows[s:e]
+        yield int(highs[s]), (chunk if presorted else np.unique(chunk))
 
 
 def bucketed_membership(values, shift: int, probe) -> np.ndarray:

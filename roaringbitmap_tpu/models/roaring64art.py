@@ -171,7 +171,21 @@ class Roaring64Bitmap:
             )
 
     def add_many(self, values: Iterable[int]) -> None:
-        for high, lows in group_by_high(values, 16):
+        groups = group_by_high(values, 16)
+        if self._art.is_empty():
+            # bottom-up bulk trie build: group_by_high yields ascending
+            # highs (it sorts), exactly bulk_load's contract — no per-key
+            # root-to-leaf descent (Art.bulk_load)
+            self._ord = None
+            self._art.bulk_load(
+                (
+                    high.to_bytes(6, "big"),
+                    self._containers.add(container_from_values(lows.astype(np.uint16))),
+                )
+                for high, lows in groups
+            )
+            return
+        for high, lows in groups:
             key = high.to_bytes(6, "big")
             chunk = container_from_values(lows.astype(np.uint16))
             existing = self._get(key)

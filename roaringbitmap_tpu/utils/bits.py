@@ -65,6 +65,14 @@ def new_words() -> np.ndarray:
     return np.zeros(WORDS_PER_CONTAINER, dtype=np.uint64)
 
 
+def is_strictly_increasing(a: np.ndarray) -> bool:
+    """True when ``a`` is sorted AND duplicate-free — the bulk-ingest fast
+    paths' contract. The strictness is load-bearing: a non-strict (>=)
+    check would let duplicates skip the unique pass and corrupt
+    containers."""
+    return a.size <= 1 or bool(np.all(a[1:] > a[:-1]))
+
+
 def words_from_values(values: np.ndarray) -> np.ndarray:
     """Build 1024-word bitset from sorted-or-not uint16 values."""
     return or_values_into_words(new_words(), values)

@@ -215,3 +215,54 @@ class TestNavigableMapModes:
         back = Roaring64NavigableMap.deserialize_legacy(legacy)
         assert back.signed_longs
         assert np.array_equal(back.to_array(), bm.to_array())
+
+
+def test_bulk_load_equivalent_to_incremental():
+    """Art.bulk_load (one bottom-up pass over sorted distinct keys) must
+    produce byte-identical traversal order, size, and adaptive-width
+    histogram to per-key insert — and refuse a non-empty trie."""
+    import numpy as np
+    import pytest
+
+    from roaringbitmap_tpu.models.art import Art
+
+    rng = np.random.default_rng(77)
+    keys = sorted({rng.integers(0, 1 << 48).item().to_bytes(6, "big") for _ in range(4000)})
+    bulk, incr = Art(), Art()
+    bulk.bulk_load([(k, i) for i, k in enumerate(keys)])
+    for i, k in enumerate(keys):
+        incr.insert(k, i)
+    assert len(bulk) == len(incr) == len(keys)
+    assert list(bulk.items()) == list(incr.items())
+    assert list(bulk.items_reverse()) == list(incr.items_reverse())
+    assert bulk.node_width_histogram() == incr.node_width_histogram()
+    mid = keys[len(keys) // 2]
+    assert list(bulk.items_from(mid)) == list(incr.items_from(mid))
+    assert list(bulk.items_to(mid)) == list(incr.items_to(mid))
+    assert bulk.find(mid) == incr.find(mid)
+    with pytest.raises(ValueError):
+        bulk.bulk_load([(keys[0], 0)])
+    empty = Art()
+    empty.bulk_load([])
+    assert empty.is_empty()
+
+
+def test_roaring64art_bulk_ingest_matches_chunked():
+    """Roaring64Bitmap.add_many's empty-trie bulk path == the incremental
+    (non-empty trie) path over the same values, incl. mutation after."""
+    import numpy as np
+
+    from roaringbitmap_tpu import Roaring64Bitmap
+
+    rng = np.random.default_rng(78)
+    vals = np.unique(rng.choice(1 << 44, 60_000, replace=True).astype(np.uint64))
+    a = Roaring64Bitmap()
+    a.add_many(vals)
+    b = Roaring64Bitmap()
+    for chunk in np.array_split(vals, 5):
+        b.add_many(chunk)
+    assert np.array_equal(a.to_array(), vals)
+    assert a == b
+    a.add(123456789)
+    a.remove(int(vals[7]))
+    assert a.contains(123456789) and not a.contains(int(vals[7]))
