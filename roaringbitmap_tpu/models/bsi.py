@@ -971,6 +971,9 @@ def _o_neil_counts_batched(slices_w, bits_mat, ebm_w, fixed_w, op_name: str):
 
         fn = jax.jit(jax.vmap(one, in_axes=(None, 0, None, None)))
         _o_neil_many_jits[op_name] = fn
+    from ..ops.pallas_kernels import DISPATCH_COUNTS
+
+    DISPATCH_COUNTS[("oneil_batched", "xla_vmap")] += 1
     return fn(slices_w, bits_mat, ebm_w, fixed_w)
 
 
