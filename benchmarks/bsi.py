@@ -155,6 +155,40 @@ def run_northstar(n_rows: int = 100_000_000, reps: int = 3) -> List[Result]:
             results_by_mode[("cpu", qname)] == results_by_mode[("device", qname)]
         ), f"cpu/device mismatch on {qname}"
 
+    # batched multi-predicate counts on the resident pack: 64 thresholds in
+    # ONE dispatch vs a 64-dispatch loop — through the axon tunnel each
+    # dispatch pays the ~145 ms RPC floor, so this is where the batching
+    # shows up end-to-end (ns/query, device engine only; the CPU loop at
+    # this scale would add minutes for no information)
+    q_vals = np.quantile(vals, np.linspace(0.05, 0.95, 64)).astype(np.int64)
+    t_many = None
+    for _ in range(reps):
+        t0 = time.time()
+        many_counts = bsi.compare_cardinality_many(Operation.GE, q_vals, mode="device")
+        dt = time.time() - t0
+        t_many = dt if t_many is None else min(t_many, dt)
+    # warm the single-query count path so its cold JIT compile is not
+    # charged to the timed loop (the batched side above already got its
+    # compile absorbed by best-of-reps)
+    bsi.compare_cardinality(Operation.GE, int(q_vals[0]), 0, None, "device")
+    t0 = time.time()
+    loop_counts = np.array(
+        [bsi.compare_cardinality(Operation.GE, int(v), 0, None, "device") for v in q_vals],
+        dtype=np.int64,
+    )
+    t_loop = time.time() - t0
+    assert np.array_equal(many_counts, loop_counts), "batched != looped counts"
+    for name, t in (("batchedGE64_oneDispatch", t_many), ("batchedGE64_loop", t_loop)):
+        out.append(
+            Result(
+                f"northstar_{name}_device",
+                f"synthetic-{n_rows//1_000_000}M",
+                t / q_vals.size * 1e9,
+                "ns/query",
+                {**extra_base, "batch": int(q_vals.size)},
+            )
+        )
+
     out.extend(
         _northstar_steady_state(
             bsi, med, n_rows, extra_base, results_by_mode[("cpu", "GE_med")]

@@ -413,8 +413,14 @@ class RoaringBitmap:
         )
 
     @staticmethod
-    def andnot(x1: "RoaringBitmap", x2: "RoaringBitmap") -> "RoaringBitmap":
-        """RoaringBitmap.andNot (RoaringBitmap.java:444)."""
+    def andnot(
+        x1: "RoaringBitmap", x2: "RoaringBitmap", *, _reuse_left: bool = False
+    ) -> "RoaringBitmap":
+        """RoaringBitmap.andNot (RoaringBitmap.java:444). ``_reuse_left``
+        transfers x1's pass-through containers unclone'd — ONLY for the
+        in-place iandnot, which discards x1's old index; the static path
+        must keep cloning because andnot_range feeds it _restrict views
+        that share containers with live bitmaps."""
         out = RoaringBitmap()
         a, b = x1.high_low_container, x2.high_low_container
         ia = ib = 0
@@ -427,7 +433,8 @@ class RoaringBitmap:
                 if c.cardinality:
                     out.high_low_container.append(ka, c)
             else:
-                out.high_low_container.append(ka, a.containers[ia].clone())
+                c = a.containers[ia] if _reuse_left else a.containers[ia].clone()
+                out.high_low_container.append(ka, c)
             ia += 1
         return out
 
@@ -545,7 +552,9 @@ class RoaringBitmap:
         return self
 
     def iandnot(self, other: "RoaringBitmap") -> "RoaringBitmap":
-        self.high_low_container = RoaringBitmap.andnot(self, other).high_low_container
+        self.high_low_container = RoaringBitmap.andnot(
+            self, other, _reuse_left=True
+        ).high_low_container
         return self
 
     __or__ = lambda self, o: RoaringBitmap.or_(self, o)
