@@ -25,6 +25,7 @@ runOptimized, ebm, int32 sliceCount, slices — little-endian.
 from __future__ import annotations
 
 import enum
+import functools
 import struct
 from typing import Iterable, List, Optional, Tuple
 
@@ -583,6 +584,9 @@ class RoaringBitmapSliceIndex:
         walks ride a single pass over the resident pack (a multi-tenant /
         per-query-threshold filter answers its whole batch at once)."""
 
+        counts_fn = None
+        if config.mesh is not None:
+            counts_fn = functools.partial(_mesh_batched_counts, config.mesh)
         return _counts_many(
             self,
             operation,
@@ -590,10 +594,10 @@ class RoaringBitmapSliceIndex:
             ends,
             found_set,
             mode,
-            # the mesh path has no batched twin yet
-            batched_ok=self._use_device(mode) and config.mesh is None,
+            batched_ok=self._use_device(mode),
             pack_fixed=lambda: self._pack_with_fixed(found_set),
             neq_remainder=lambda keys: self._neq_outside_ebm(found_set, keys),
+            counts_fn=counts_fn,
         )
 
     def _pack_with_fixed(self, found_set: Optional[RoaringBitmap]):
@@ -977,6 +981,21 @@ def _o_neil_counts_batched(slices_w, bits_mat, ebm_w, fixed_w, op_name: str):
     return fn(slices_w, bits_mat, ebm_w, fixed_w)
 
 
+def _mesh_batched_counts(mesh, slices_w, bits, ebm_w, fixed_w, op_name):
+    """Mesh twin of _o_neil_counts_batched, shared by both BSI designs:
+    pad the chunk axis up to the containers-axis size with empty chunks
+    (zero ebm/fixed words contribute nothing for every op incl. NEQ), run
+    the sharded vmapped walk, drop the padding columns."""
+    from ..ops.pallas_kernels import DISPATCH_COUNTS
+    from ..parallel import sharding
+
+    DISPATCH_COUNTS[("oneil_batched", "mesh")] += 1
+    k_orig = ebm_w.shape[0]
+    s3, e2, f2 = _pad_chunk_axis(mesh, slices_w, ebm_w, fixed_w)
+    cards = sharding.distributed_bsi_counts_many(mesh, op_name)(s3, bits, e2, f2)
+    return cards[:, :k_orig]
+
+
 def _counts_many(
     owner,
     operation,
@@ -988,6 +1007,7 @@ def _counts_many(
     batched_ok: bool,
     pack_fixed,
     neq_remainder,
+    counts_fn=None,
 ) -> np.ndarray:
     """Shared engine behind compare_cardinality_many on both BSI designs
     (32-bit and the 64-bit high-48-chunk twin): per-predicate min/max
@@ -1056,10 +1076,9 @@ def _counts_many(
         )
     else:
         bits = np.array([bits_of(vals[qi]) for qi in pend], dtype=bool)
+    run = counts_fn or _o_neil_counts_batched
     cards = np.asarray(
-        _o_neil_counts_batched(
-            slices_w, jnp.asarray(bits), ebm_w, fixed_w, operation.value
-        )
+        run(slices_w, jnp.asarray(bits), ebm_w, fixed_w, operation.value)
     )
     totals = cards.astype(np.int64).sum(axis=1)
     if operation == Operation.NEQ and found_set is not None:

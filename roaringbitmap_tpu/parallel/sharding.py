@@ -167,6 +167,43 @@ def distributed_bsi_sum(mesh: Mesh):
     return jax.jit(mapped)
 
 
+@functools.lru_cache(maxsize=8)
+def distributed_bsi_counts_many(mesh: Mesh, op_name: str):
+    """Sharded batched multi-predicate counts (the mesh twin of
+    models/bsi._o_neil_counts_batched): Q query walks vmapped over the
+    predicate axis, all sharing the sharded [S, K, 2048] pack — per-query
+    the same zero-traffic slice scan as distributed_bsi_compare, with one
+    words-axis psum of the [Q, K] per-chunk counts at the end.
+
+    Returns a jitted ``(slices_w [S,K,W], bits_mat [Q,S] (or [Q,2,S] for
+    RANGE), ebm_w [K,W], fixed_w [K,W]) -> counts [Q,K]``.
+    """
+    from ..models.bsi import o_neil_math
+
+    def one(slices_w, bits, ebm_w, fixed_w):
+        _, cards = o_neil_math(slices_w, bits, ebm_w, fixed_w, op_name)
+        return cards
+
+    def step(slices_w, bits_mat, ebm_w, fixed_w):
+        cards = jax.vmap(one, in_axes=(None, 0, None, None))(
+            slices_w, bits_mat, ebm_w, fixed_w
+        )
+        return lax.psum(cards, "words")
+
+    mapped = shard_map(
+        step,
+        mesh,
+        in_specs=(
+            P(None, "containers", "words"),
+            P(),
+            P("containers", "words"),
+            P("containers", "words"),
+        ),
+        out_specs=P(None, "containers"),
+    )
+    return jax.jit(mapped)
+
+
 def collective_details(hlo_text: str) -> list:
     """Collective instructions in optimized HLO text: one record per
     instruction (start/done pairs counted once) with its replica groups —

@@ -119,6 +119,13 @@ def main():
         slices, fixed,
         expect={"all-reduce": 1},
     )
+    bits_q = jnp.asarray(np.stack([np.ones(s, dtype=bool)] * 5))
+    record(
+        "bsi_counts_many_GE",
+        sharding.distributed_bsi_counts_many(mesh, "GE"),
+        slices, bits_q, ebm, fixed,
+        expect={"all-reduce": 1},
+    )
 
     ok = all(f.get("ok", True) for f in families.values())
     report = {

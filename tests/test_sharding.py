@@ -194,3 +194,67 @@ def test_wide_or_collective_layout():
     counts = sharding.collective_summary(sharding.distributed_wide_or_cardinality(mesh), rows)
     assert counts.get("all-gather") == 1 and counts.get("all-reduce") == 1, counts
     assert "all-to-all" not in counts and "collective-permute" not in counts
+
+
+def test_batched_counts_through_mesh():
+    """compare_cardinality_many rides the sharded vmapped walk when a mesh
+    is configured, equal to the CPU per-predicate engine (incl. RANGE with
+    per-query ends and NEQ's outside-ebm remainder)."""
+    from roaringbitmap_tpu import RoaringBitmap, insights
+    from roaringbitmap_tpu.models.bsi import Operation, RoaringBitmapSliceIndex
+    from roaringbitmap_tpu.models.bsi import config as bsi_config
+    from roaringbitmap_tpu.parallel import sharding
+
+    rng = np.random.default_rng(83)
+    cols = np.sort(rng.choice(600_000, size=40_000, replace=False)).astype(np.uint32)
+    vals = rng.integers(0, 1 << 20, size=cols.size)
+    bsi = RoaringBitmapSliceIndex()
+    bsi.set_values((cols, vals))
+    found = RoaringBitmap(
+        rng.choice(900_000, size=30_000, replace=False).astype(np.uint32)
+    )
+    qs = np.quantile(vals, [0.2, 0.5, 0.8]).astype(np.int64)
+    want_ge = [bsi.compare_cardinality(Operation.GE, int(v), 0, found, "cpu") for v in qs]
+    want_neq = [bsi.compare_cardinality(Operation.NEQ, int(v), 0, found, "cpu") for v in qs]
+    ends = qs + 5000
+    want_rng = [
+        bsi.compare_cardinality(Operation.RANGE, int(a), int(b), None, "cpu")
+        for a, b in zip(qs, ends)
+    ]
+    insights.reset_dispatch_counters()
+    bsi_config.mesh = sharding.make_mesh(8, words_axis=2)
+    try:
+        got_ge = bsi.compare_cardinality_many(Operation.GE, qs, found_set=found, mode="device")
+        got_neq = bsi.compare_cardinality_many(Operation.NEQ, qs, found_set=found, mode="device")
+        got_rng = bsi.compare_cardinality_many(Operation.RANGE, qs, ends=ends, mode="device")
+    finally:
+        bsi_config.mesh = None
+    assert got_ge.tolist() == want_ge
+    assert got_neq.tolist() == want_neq
+    assert got_rng.tolist() == want_rng
+    assert insights.dispatch_counters()["kernel"].get("oneil_batched/mesh") == 3
+
+
+def test_batched_counts_64_through_mesh():
+    """The 64-bit twin shares the mesh batched walk (same [S, K, 2048]
+    physical pack over high-48 chunk keys)."""
+    from roaringbitmap_tpu import Roaring64BitmapSliceIndex, insights
+    from roaringbitmap_tpu.models.bsi import Operation
+    from roaringbitmap_tpu.models.bsi import config as bsi_config
+    from roaringbitmap_tpu.parallel import sharding
+
+    rng = np.random.default_rng(91)
+    b = Roaring64BitmapSliceIndex()
+    cols = rng.choice(1 << 40, size=6_000, replace=False).astype(np.int64)
+    vals = rng.integers(0, 1 << 24, size=cols.size).astype(np.int64)
+    b.set_values(list(zip(cols.tolist(), vals.tolist())))
+    qs = np.quantile(vals, [0.25, 0.75]).astype(np.int64)
+    want = [b.compare_cardinality(Operation.GE, int(v), 0, None, "cpu") for v in qs]
+    insights.reset_dispatch_counters()
+    bsi_config.mesh = sharding.make_mesh(8, words_axis=2)
+    try:
+        got = b.compare_cardinality_many(Operation.GE, qs, mode="device")
+    finally:
+        bsi_config.mesh = None
+    assert got.tolist() == want
+    assert insights.dispatch_counters()["kernel"].get("oneil_batched/mesh") == 1
