@@ -26,4 +26,5 @@ EXAMPLES = [
     "serialize_to_string",
     "very_large_bitmap",
     "device_aggregation",
+    "multi_chip",
 ]

@@ -1,0 +1,73 @@
+"""Multi-chip scaling: wide aggregations and BSI range queries sharded
+over a ``jax.sharding.Mesh`` (parallel/sharding.py — the distributed
+story SURVEY.md §5 maps from the reference's single-JVM fork-join).
+
+Setting ``config.mesh`` on the aggregation / BSI config routes every
+device dispatch through ``shard_map`` over a 2D (containers, words)
+mesh: container chunks split across chips, the word axis across the
+second mesh axis, with XLA placing the collectives (one containers-axis
+all-gather + words-axis all-reduce per reduce; the compiled placement is
+recorded in MULTICHIP_HLO_r04.json). On a single chip the mesh
+degenerates gracefully; under the test harness this runs on 8 virtual
+CPU devices.
+"""
+
+import jax
+import numpy as np
+
+from roaringbitmap_tpu import (
+    FastAggregation,
+    Operation,
+    RoaringBitmap,
+    RoaringBitmapSliceIndex,
+    insights,
+)
+from roaringbitmap_tpu.models.bsi import config as bsi_config
+from roaringbitmap_tpu.parallel import sharding
+from roaringbitmap_tpu.parallel.aggregation import config as agg_config
+
+N_BITMAPS = 64
+
+
+def main():
+    n_dev = len(jax.devices())
+    mesh = sharding.make_mesh(n_dev, words_axis=2)
+    print(f"mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))} over {n_dev} device(s)")
+
+    rng = np.random.default_rng(42)
+    bms = [
+        RoaringBitmap(np.unique(rng.integers(0, 1 << 20, 4000)).astype(np.uint32))
+        for _ in range(N_BITMAPS)
+    ]
+    want = FastAggregation.naive_or(*bms)
+
+    insights.reset_dispatch_counters()
+    agg_config.mesh = bsi_config.mesh = mesh
+    try:
+        # wide OR: containers sharded across chips, OR-combine over ICI
+        union = FastAggregation.or_(*bms, mode="device")
+        assert union == want
+        # count-only twin fetches just the popcounts (no result words)
+        n_union = FastAggregation.or_cardinality(*bms, mode="device")
+        assert n_union == want.get_cardinality()
+        print(f"wide OR over the mesh: {n_union} distinct values")
+
+        # BSI: a whole batch of thresholds in ONE sharded dispatch — all
+        # Q O'Neil walks share the sharded [S, K, 2048] pack
+        cols = np.arange(200_000, dtype=np.uint32)
+        vals = (cols.astype(np.int64) * 48271) % (1 << 20)
+        index = RoaringBitmapSliceIndex()
+        index.set_values((cols, vals))
+        cutoffs = np.quantile(vals, [0.5, 0.9, 0.99]).astype(np.int64)
+        counts = index.compare_cardinality_many(Operation.GE, cutoffs, mode="device")
+        assert counts.tolist() == [int((vals >= c).sum()) for c in cutoffs]
+        for c, k in zip(cutoffs, counts):
+            print(f"rows with value >= {int(c)}: {int(k)}")
+    finally:
+        agg_config.mesh = bsi_config.mesh = None
+
+    print("mesh dispatches:", insights.dispatch_counters()["kernel"])
+
+
+if __name__ == "__main__":
+    main()

@@ -584,9 +584,6 @@ class RoaringBitmapSliceIndex:
         walks ride a single pass over the resident pack (a multi-tenant /
         per-query-threshold filter answers its whole batch at once)."""
 
-        counts_fn = None
-        if config.mesh is not None:
-            counts_fn = functools.partial(_mesh_batched_counts, config.mesh)
         return _counts_many(
             self,
             operation,
@@ -597,7 +594,7 @@ class RoaringBitmapSliceIndex:
             batched_ok=self._use_device(mode),
             pack_fixed=lambda: self._pack_with_fixed(found_set),
             neq_remainder=lambda keys: self._neq_outside_ebm(found_set, keys),
-            counts_fn=counts_fn,
+            mesh=config.mesh,
         )
 
     def _pack_with_fixed(self, found_set: Optional[RoaringBitmap]):
@@ -1007,7 +1004,7 @@ def _counts_many(
     batched_ok: bool,
     pack_fixed,
     neq_remainder,
-    counts_fn=None,
+    mesh=None,
 ) -> np.ndarray:
     """Shared engine behind compare_cardinality_many on both BSI designs
     (32-bit and the 64-bit high-48-chunk twin): per-predicate min/max
@@ -1076,7 +1073,11 @@ def _counts_many(
         )
     else:
         bits = np.array([bits_of(vals[qi]) for qi in pend], dtype=bool)
-    run = counts_fn or _o_neil_counts_batched
+    run = (
+        functools.partial(_mesh_batched_counts, mesh)
+        if mesh is not None
+        else _o_neil_counts_batched
+    )
     cards = np.asarray(
         run(slices_w, jnp.asarray(bits), ebm_w, fixed_w, operation.value)
     )

@@ -34,6 +34,11 @@ class config:
 
     mode: str = "auto"  # 'auto' | 'cpu' | 'device'
     min_device_cells = 4096  # slices x key-chunks below which CPU wins
+    # jax.sharding.Mesh: when set, BATCHED compare_cardinality_many
+    # dispatches run sharded over the (containers, words) mesh — the same
+    # physical [S, K, 2048] pack as the 32-bit twin, so they share the
+    # mesh kernel; single-predicate 64-bit dispatches stay unsharded
+    mesh = None
 
 
 class Roaring64BitmapSliceIndex:
@@ -310,16 +315,8 @@ class Roaring64BitmapSliceIndex:
         device dispatch (the 32-bit compare_cardinality_many twin: the
         vmapped O'Neil walk shares one HBM pass over the [S, K, 2048]
         high-48-chunk pack across all Q predicates)."""
-        import functools
+        from .bsi import _counts_many
 
-        from .bsi import _counts_many, _mesh_batched_counts
-        from .bsi import config as bsi_config
-
-        counts_fn = None
-        if bsi_config.mesh is not None:
-            # the [S, K, 2048] pack is the same physical tensor either
-            # width, so the 64-bit batched walk shares the 32-bit mesh twin
-            counts_fn = functools.partial(_mesh_batched_counts, bsi_config.mesh)
         return _counts_many(
             self,
             operation,
@@ -330,7 +327,7 @@ class Roaring64BitmapSliceIndex:
             batched_ok=self._use_device(mode),
             pack_fixed=lambda: self._pack_with_fixed(found_set),
             neq_remainder=lambda keys: self._neq_outside_ebm(found_set, keys),
-            counts_fn=counts_fn,
+            mesh=config.mesh,
         )
 
     def _pack_with_fixed(self, found_set: Optional[Roaring64Bitmap]):

@@ -240,7 +240,7 @@ def test_batched_counts_64_through_mesh():
     physical pack over high-48 chunk keys)."""
     from roaringbitmap_tpu import Roaring64BitmapSliceIndex, insights
     from roaringbitmap_tpu.models.bsi import Operation
-    from roaringbitmap_tpu.models.bsi import config as bsi_config
+    from roaringbitmap_tpu.models.bsi64 import config as bsi64_config
     from roaringbitmap_tpu.parallel import sharding
 
     rng = np.random.default_rng(91)
@@ -251,10 +251,10 @@ def test_batched_counts_64_through_mesh():
     qs = np.quantile(vals, [0.25, 0.75]).astype(np.int64)
     want = [b.compare_cardinality(Operation.GE, int(v), 0, None, "cpu") for v in qs]
     insights.reset_dispatch_counters()
-    bsi_config.mesh = sharding.make_mesh(8, words_axis=2)
+    bsi64_config.mesh = sharding.make_mesh(8, words_axis=2)
     try:
         got = b.compare_cardinality_many(Operation.GE, qs, mode="device")
     finally:
-        bsi_config.mesh = None
+        bsi64_config.mesh = None
     assert got.tolist() == want
     assert insights.dispatch_counters()["kernel"].get("oneil_batched/mesh") == 1
