@@ -30,6 +30,15 @@ N_BITMAPS = 64
 
 
 def main():
+    import bench
+
+    # a registered-but-unreachable TPU plugin would block jax.devices()
+    # forever; probe in a subprocess and pin CPU on failure, like
+    # device_aggregation (run_all's try/except cannot catch a hang)
+    if not bench._probe_backend(timeout_s=60):
+        print("(TPU backend unreachable; running the same path on CPU)")
+        jax.config.update("jax_platforms", "cpu")
+
     n_dev = len(jax.devices())
     mesh = sharding.make_mesh(n_dev, words_axis=2)
     print(f"mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))} over {n_dev} device(s)")

@@ -256,18 +256,24 @@ class Roaring64Bitmap:
     # Roaring64Bitmap.java pairwise container ops)
     # ------------------------------------------------------------------
     def _merge_walk(self, other: "Roaring64Bitmap", op: str) -> "Roaring64Bitmap":
+        # two-pointer key merge emits strictly-ascending keys into a fresh
+        # index, so the result trie is bulk-built bottom-up (Art.bulk_load)
+        # instead of paying two root-to-leaf descents per key via _put
         out = Roaring64Bitmap()
+        store = out._containers
+        pairs: list = []
+        emit = pairs.append
         it_a, it_b = self._kv(), other._kv()
         a = next(it_a, None)
         b = next(it_b, None)
         while a is not None or b is not None:
             if b is None or (a is not None and a[0] < b[0]):
                 if op in ("or", "xor", "andnot"):
-                    out._put(a[0], a[1].clone())
+                    emit((a[0], store.add(a[1].clone())))
                 a = next(it_a, None)
             elif a is None or b[0] < a[0]:
                 if op in ("or", "xor"):
-                    out._put(b[0], b[1].clone())
+                    emit((b[0], store.add(b[1].clone())))
                 b = next(it_b, None)
             else:
                 if op == "or":
@@ -279,9 +285,10 @@ class Roaring64Bitmap:
                 else:
                     c = a[1].andnot(b[1])
                 if c.cardinality:
-                    out._put(a[0], c)
+                    emit((a[0], store.add(c)))
                 a = next(it_a, None)
                 b = next(it_b, None)
+        out._art.bulk_load(pairs)
         return out
 
     @staticmethod
