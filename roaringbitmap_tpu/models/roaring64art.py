@@ -444,9 +444,10 @@ class Roaring64Bitmap:
         return changed
 
     def clone(self) -> "Roaring64Bitmap":
+        # _kv() walks the trie in ascending key order -> bulk-build the copy
         out = Roaring64Bitmap()
-        for k, c in self._kv():
-            out._put(k, c.clone())
+        store = out._containers
+        out._art.bulk_load([(k, store.add(c.clone())) for k, c in self._kv()])
         return out
 
     def to_array(self) -> np.ndarray:
@@ -657,6 +658,27 @@ class Roaring64Bitmap:
             k = ((high32 << 16) | int(arr.keys[i])).to_bytes(6, "big")
             self._put(k, arr.containers[i])
 
+    def _adopt_buckets(self, buckets) -> None:
+        """Adopt decoded (high32, 32-bit bitmap) buckets in ascending key
+        order. On an empty trie — every deserializer's case — the chunk
+        keys arrive strictly ascending (bucket keys validated ascending,
+        in-bucket keys sorted), so the whole trie is bulk-built bottom-up
+        (Art.bulk_load) instead of one descent per chunk."""
+        if not self._art.is_empty():
+            for high32, bm in buckets:
+                self._absorb_spec_bucket(high32, bm)
+            return
+        self._ord = None
+        store = self._containers
+        pairs = []
+        for high32, bm in buckets:
+            arr = bm.high_low_container
+            base = high32 << 16
+            for i in range(arr.size):
+                k = (base | int(arr.keys[i])).to_bytes(6, "big")
+                pairs.append((k, store.add(arr.containers[i])))
+        self._art.bulk_load(pairs)
+
     @staticmethod
     def read_from(buf) -> Tuple["Roaring64Bitmap", int]:
         """Parse one portable-spec 64-bit bitmap from the head of `buf`,
@@ -677,6 +699,7 @@ class Roaring64Bitmap:
         pos = 8
         out = Roaring64Bitmap()
         prev_key = -1
+        buckets = []
         for _ in range(count):
             if pos + 4 > len(buf):
                 raise InvalidRoaringFormat("truncated bucket key")
@@ -687,7 +710,8 @@ class Roaring64Bitmap:
             prev_key = high32
             bm = RoaringBitmap()
             pos += read_into(bm, buf[pos:])
-            out._absorb_spec_bucket(high32, bm)
+            buckets.append((high32, bm))
+        out._adopt_buckets(buckets)
         return out, pos
 
     @staticmethod
@@ -715,12 +739,14 @@ class Roaring64Bitmap:
             raise InvalidRoaringFormat(f"implausible bucket count {count}")
         out = Roaring64Bitmap()
         prev_key = -1
+        buckets = []
         for _ in range(count):
             (high32,) = struct.unpack("<I", read_exact(fileobj, 4))
             if high32 <= prev_key:
                 raise InvalidRoaringFormat("bucket keys not strictly increasing")
             prev_key = high32
-            out._absorb_spec_bucket(high32, RoaringBitmap.deserialize_from(fileobj))
+            buckets.append((high32, RoaringBitmap.deserialize_from(fileobj)))
+        out._adopt_buckets(buckets)
         return out
 
     # ------------------------------------------------------------------
