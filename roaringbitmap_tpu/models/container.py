@@ -170,6 +170,12 @@ class Container:
         """Number of values <= x (Container.rank, Container.java:849)."""
         raise NotImplementedError
 
+    def rank_many(self, lows: np.ndarray) -> np.ndarray:
+        """Vectorized rank over a uint16 probe array (no reference
+        equivalent — Container.java only has the scalar :849); concrete
+        types override with one numpy pass per batch."""
+        return np.array([self.rank(int(x)) for x in lows], dtype=np.int64)
+
     def select(self, j: int) -> int:
         """j-th smallest value, 0-based (Container.select, Container.java:891)."""
         raise NotImplementedError
@@ -347,6 +353,9 @@ class ArrayContainer(Container):
         # values <= x == first index with content[i] >= x+1
         return bits.lower_bound(self.content, int(x) + 1) if x < 0xFFFF else self.content.size
 
+    def rank_many(self, lows: np.ndarray) -> np.ndarray:
+        return np.searchsorted(self.content, lows, side="right").astype(np.int64)
+
     def select(self, j: int) -> int:
         return int(self.content[j])
 
@@ -448,6 +457,18 @@ class BitmapContainer(Container):
 
     def rank(self, x: int) -> int:
         return bits.cardinality_in_range(self.words, 0, x + 1)
+
+    def rank_many(self, lows: np.ndarray) -> np.ndarray:
+        # exclusive per-word popcount prefix + masked popcount of the
+        # probe's own word, all vectorized
+        pc = bits.popcount64(self.words).astype(np.int64)
+        cum = np.concatenate(([0], np.cumsum(pc)[:-1]))
+        lows = np.asarray(lows, dtype=np.uint32)
+        wi = (lows >> 6).astype(np.int64)
+        b = (lows & 63).astype(np.uint64)
+        masks = np.uint64(0xFFFFFFFFFFFFFFFF) >> (np.uint64(63) - b)
+        partial = bits.popcount64(self.words[wi] & masks).astype(np.int64)
+        return cum[wi] + partial
 
     def select(self, j: int) -> int:
         return bits.select_in_words(self.words, j)
@@ -708,6 +729,18 @@ class RunContainer(Container):
         full = s <= x
         contrib = np.where(full, np.minimum(e, x) - s + 1, 0)
         return int(contrib.sum())
+
+    def rank_many(self, lows: np.ndarray) -> np.ndarray:
+        s = self.starts.astype(np.int64)
+        lens = self.lengths.astype(np.int64) + 1
+        cum = np.concatenate(([0], np.cumsum(lens)))  # exclusive prefix
+        lows = np.asarray(lows, dtype=np.int64)
+        i = np.searchsorted(s, lows, side="right") - 1  # last run with start <= x
+        safe = np.maximum(i, 0)
+        # full runs before run i, plus the in-run contribution clipped to
+        # its length (0 when the probe precedes every run)
+        inside = np.where(i >= 0, np.clip(lows - s[safe] + 1, 0, lens[safe]), 0)
+        return np.where(i >= 0, cum[safe], 0) + inside
 
     def select(self, j: int) -> int:
         lens = self.lengths.astype(np.int64) + 1

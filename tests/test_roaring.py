@@ -262,3 +262,22 @@ def test_varargs_facade_delegates_to_aggregation(random_bitmap_factory):
     for s in sets:
         want_xor ^= s
     assert set(map(int, RoaringBitmap.xor(*bms).to_array())) == want_xor
+
+
+def test_rank_many_matches_scalar(random_bitmap_factory):
+    """Vectorized bulk rank == scalar rank_long across container shapes,
+    absent chunks, boundaries, and the empty bitmap."""
+    bm, vals = random_bitmap_factory()
+    rng = np.random.default_rng(7)
+    qs = np.concatenate(
+        [
+            rng.integers(0, 1 << 23, 600).astype(np.uint32),
+            np.unique(vals)[:50],
+            np.array([0, (1 << 32) - 1], dtype=np.uint32),
+        ]
+    )
+    assert bm.rank_many(qs).tolist() == [bm.rank_long(int(q)) for q in qs]
+    assert RoaringBitmap().rank_many(qs).tolist() == [0] * qs.size
+    assert bm.rank_many([]).size == 0
+    with pytest.raises(ValueError):
+        bm.rank_many([-1])
