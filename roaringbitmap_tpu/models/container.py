@@ -176,6 +176,11 @@ class Container:
         types override with one numpy pass per batch."""
         return np.array([self.rank(int(x)) for x in lows], dtype=np.int64)
 
+    def select_many(self, js: np.ndarray) -> np.ndarray:
+        """Vectorized select over in-container 0-based ranks (the bulk twin
+        of :891); concrete types override with one numpy pass."""
+        return np.array([self.select(int(j)) for j in js], dtype=np.uint16)
+
     def select(self, j: int) -> int:
         """j-th smallest value, 0-based (Container.select, Container.java:891)."""
         raise NotImplementedError
@@ -359,6 +364,9 @@ class ArrayContainer(Container):
     def select(self, j: int) -> int:
         return int(self.content[j])
 
+    def select_many(self, js: np.ndarray) -> np.ndarray:
+        return self.content[np.asarray(js, dtype=np.int64)]
+
     def next_value(self, from_value: int) -> int:
         i = bits.lower_bound(self.content, from_value)
         return int(self.content[i]) if i < self.content.size else -1
@@ -472,6 +480,10 @@ class BitmapContainer(Container):
 
     def select(self, j: int) -> int:
         return bits.select_in_words(self.words, j)
+
+    def select_many(self, js: np.ndarray) -> np.ndarray:
+        # one vectorized unpack of the whole word form answers any batch
+        return self.to_array()[np.asarray(js, dtype=np.int64)]
 
     def next_value(self, from_value: int) -> int:
         w = from_value >> 6
@@ -741,6 +753,13 @@ class RunContainer(Container):
         # its length (0 when the probe precedes every run)
         inside = np.where(i >= 0, np.clip(lows - s[safe] + 1, 0, lens[safe]), 0)
         return np.where(i >= 0, cum[safe], 0) + inside
+
+    def select_many(self, js: np.ndarray) -> np.ndarray:
+        lens = self.lengths.astype(np.int64) + 1
+        cum = np.concatenate(([0], np.cumsum(lens)))  # exclusive prefix
+        js = np.asarray(js, dtype=np.int64)
+        i = np.searchsorted(cum, js, side="right") - 1  # run holding rank j
+        return (self.starts.astype(np.int64)[i] + (js - cum[i])).astype(np.uint16)
 
     def select(self, j: int) -> int:
         lens = self.lengths.astype(np.int64) + 1

@@ -60,10 +60,6 @@ class FastRankRoaringBitmap(RoaringBitmap):
         self._invalidate()
         return super().iandnot(o)
 
-    def _cumulative_cards(self) -> np.ndarray:
-        # rank_many's prefix hook rides the invalidation-tracked cache
-        return self._cum_cards()
-
     def _cum_cards(self) -> np.ndarray:
         if self._dirty or self._cum is None:
             cards = np.array(

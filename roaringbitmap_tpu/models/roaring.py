@@ -34,6 +34,19 @@ from .roaring_array import RoaringArray
 _MAX32 = 1 << 32
 
 
+def _group_positions(vals: np.ndarray):
+    """Yield (value, positions) for each distinct entry of ``vals`` (one
+    stable argsort) — the grouping idiom shared by the bulk-probe paths
+    (contains_many / rank_many / select_many)."""
+    order = np.argsort(vals, kind="stable")
+    sv = vals[order]
+    bounds = np.nonzero(np.diff(sv))[0] + 1
+    starts = np.concatenate(([0], bounds))
+    ends = np.concatenate((bounds, [sv.size]))
+    for s, e in zip(starts.tolist(), ends.tolist()):
+        yield int(sv[s]), order[s:e]
+
+
 def _check_value(x: int) -> int:
     x = int(x)
     if not 0 <= x < _MAX32:
@@ -241,17 +254,11 @@ class RoaringBitmap:
         if v.size == 0:
             return out
         keys = (v >> 16).astype(np.int64)
-        order = np.argsort(keys, kind="stable")
-        sorted_keys = keys[order]
-        boundaries = np.nonzero(np.diff(sorted_keys))[0] + 1
-        starts = np.concatenate(([0], boundaries))
-        ends = np.concatenate((boundaries, [v.size]))
         hlc = self.high_low_container
-        for s, e in zip(starts.tolist(), ends.tolist()):
-            c = hlc.get_container(int(sorted_keys[s]))
+        for key, idx in _group_positions(keys):
+            c = hlc.get_container(key)
             if c is None:
                 continue
-            idx = order[s:e]
             out[idx] = c.contains_many((v[idx] & 0xFFFF).astype(np.uint16))
         return out
 
@@ -271,7 +278,7 @@ class RoaringBitmap:
         if hlc.size == 0:
             return out
         keys_arr = np.asarray(hlc.keys, dtype=np.int64)
-        prefix = np.concatenate(([0], self._cumulative_cards()))  # exclusive
+        prefix = np.concatenate(([0], self._cum_cards()))  # exclusive
         hbs = v >> 16
         # containers strictly before the probe's chunk contribute wholesale
         idx = np.searchsorted(keys_arr, hbs, side="left")
@@ -279,27 +286,44 @@ class RoaringBitmap:
         # probes whose chunk exists add the in-container rank, grouped per key
         hit = (idx < keys_arr.size) & (keys_arr[np.minimum(idx, keys_arr.size - 1)] == hbs)
         if hit.any():
-            order = np.argsort(hbs[hit], kind="stable")
-            hit_pos = np.flatnonzero(hit)[order]
-            sorted_hbs = hbs[hit_pos]
-            bounds = np.nonzero(np.diff(sorted_hbs))[0] + 1
-            starts = np.concatenate(([0], bounds))
-            ends = np.concatenate((bounds, [sorted_hbs.size]))
-            for s, e in zip(starts.tolist(), ends.tolist()):
-                pos = hit_pos[s:e]
+            hit_all = np.flatnonzero(hit)
+            for _, rel in _group_positions(hbs[hit_all]):
+                pos = hit_all[rel]
                 c = hlc.containers[int(idx[pos[0]])]
                 out[pos] += c.rank_many((v[pos] & 0xFFFF).astype(np.uint16))
         return out
 
-    def _cumulative_cards(self) -> np.ndarray:
+    def _cum_cards(self) -> np.ndarray:
         """Inclusive per-container cardinality cumsum — FastRank overrides
-        with its invalidation-tracked cache (fastrank._cum_cards)."""
+        with its invalidation-tracked cache."""
         return np.cumsum(
             np.array(
                 [c.cardinality for c in self.high_low_container.containers],
                 dtype=np.int64,
             )
         )
+
+    def select_many(self, ranks) -> np.ndarray:
+        """Vectorized select: uint32 array of the rank-th smallest values,
+        aligned with ``ranks`` (bulk twin of select; a retrieval stack's
+        "docIDs at ranks [r0..rk]" pagination ask). Raises IndexError when
+        any rank is out of range, like the scalar."""
+        js = np.asarray(ranks, dtype=np.int64).ravel()
+        out = np.zeros(js.size, dtype=np.uint32)
+        if js.size == 0:
+            return out
+        cum = self._cum_cards()  # inclusive
+        total = int(cum[-1]) if cum.size else 0
+        if js.min() < 0 or js.max() >= total:
+            raise IndexError("select out of range")
+        hlc = self.high_low_container
+        keys_arr = np.asarray(hlc.keys, dtype=np.int64)
+        ci = np.searchsorted(cum, js, side="right")  # container holding rank
+        base = np.concatenate(([0], cum))[ci]
+        for c_idx, pos in _group_positions(ci):
+            lows = hlc.containers[c_idx].select_many(js[pos] - base[pos])
+            out[pos] = (keys_arr[c_idx] << 16) | lows.astype(np.uint32)
+        return out
 
     def contains_range(self, start: int, end: int) -> bool:
         """RoaringBitmap.contains(long,long)."""

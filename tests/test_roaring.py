@@ -281,3 +281,22 @@ def test_rank_many_matches_scalar(random_bitmap_factory):
     assert bm.rank_many([]).size == 0
     with pytest.raises(ValueError):
         bm.rank_many([-1])
+
+
+def test_select_many_matches_scalar(random_bitmap_factory):
+    """Vectorized bulk select == scalar select; select_many/rank_many are
+    inverse on present values; out-of-range raises like the scalar."""
+    bm, vals = random_bitmap_factory()
+    u = np.unique(vals)
+    rng = np.random.default_rng(13)
+    ranks = np.concatenate(
+        [rng.integers(0, u.size, 200), np.array([0, u.size - 1])]
+    )
+    got = bm.select_many(ranks)
+    assert np.array_equal(got, u[ranks])
+    assert np.array_equal(bm.rank_many(got), ranks + 1)
+    with pytest.raises(IndexError):
+        bm.select_many([u.size])
+    with pytest.raises(IndexError):
+        bm.select_many([-1])
+    assert bm.select_many([]).size == 0
