@@ -178,7 +178,8 @@ class Container:
 
     def select_many(self, js: np.ndarray) -> np.ndarray:
         """Vectorized select over in-container 0-based ranks (the bulk twin
-        of :891); concrete types override with one numpy pass."""
+        of Container.select, Container.java:891); concrete types override
+        with one numpy pass."""
         return np.array([self.select(int(j)) for j in js], dtype=np.uint16)
 
     def select(self, j: int) -> int:

@@ -113,7 +113,7 @@ class ImmutableRoaringBitmap:
     zero-copy numpy views into the source buffer.
     """
 
-    __slots__ = ("_buf", "_keys", "_cards", "_types", "_offsets", "_size", "_hlc", "_ro")
+    __slots__ = ("_buf", "_keys", "_cards", "_types", "_offsets", "_size", "_hlc", "_ro", "_cum")
 
     ARRAY, BITMAP, RUN = 0, 1, 2
 
@@ -216,6 +216,7 @@ class ImmutableRoaringBitmap:
             self._offsets = offsets
         self._size = size
         self._hlc = None
+        self._cum = None
         # validate payload extents
         for i in range(size):
             end = self._offsets[i] + self._payload_len(i, int(self._offsets[i]))
@@ -357,7 +358,7 @@ class ImmutableRoaringBitmap:
         hb, lb = x >> 16, x & 0xFFFF
         return bucketed_rank(
             self._keys.tolist(),
-            np.cumsum(self._cards),
+            self._cum_cards(),
             hb,
             lambda i: self._container(i).rank(lb),
         )
@@ -367,10 +368,24 @@ class ImmutableRoaringBitmap:
 
         return bucketed_select(
             self._keys.tolist(),
-            np.cumsum(self._cards),
+            self._cum_cards(),
             j,
             lambda i, lj: (int(self._keys[i]) << 16) | self._container(i).select(lj),
         )
+
+    # bulk probes shared with the heap facade: ImmutableRoaringArray
+    # exposes the same keys/containers surface, so the vectorized
+    # implementations run unchanged over the lazily mapped views
+    contains_many = RoaringBitmap.contains_many
+    rank_many = RoaringBitmap.rank_many
+    select_many = RoaringBitmap.select_many
+
+    def _cum_cards(self) -> np.ndarray:
+        # header cardinalities, computed once — an immutable bitmap's
+        # prefix never changes and costs no payload decode
+        if self._cum is None:
+            self._cum = np.cumsum(np.asarray(self._cards, dtype=np.int64))
+        return self._cum
 
     def first(self) -> int:
         if self.is_empty():

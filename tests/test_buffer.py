@@ -280,3 +280,19 @@ def test_mapped_run_views_zero_copy(tmp_path):
     assert inter_card == want.get_cardinality() and inter == want
     assert hits == len(probe)
     assert ranks == [rb.rank(p) for p in probe]
+
+
+def test_mapped_bulk_probes_match_heap():
+    """contains_many/rank_many/select_many run over the lazily mapped
+    views, equal to the heap facade (and the rank prefix reads only the
+    header cardinalities, no payload decode)."""
+    rng = np.random.default_rng(41)
+    vals = np.unique(rng.choice(1 << 22, 50_000, replace=False)).astype(np.uint32)
+    heap = RoaringBitmap(vals)
+    heap.run_optimize()
+    imm = ImmutableRoaringBitmap(heap.serialize())
+    probes = rng.choice(1 << 23, 2000).astype(np.uint32)
+    assert np.array_equal(imm.contains_many(probes), heap.contains_many(probes))
+    assert np.array_equal(imm.rank_many(probes), heap.rank_many(probes))
+    ranks = rng.integers(0, vals.size, 2000)
+    assert np.array_equal(imm.select_many(ranks), heap.select_many(ranks))
