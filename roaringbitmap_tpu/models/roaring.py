@@ -34,17 +34,9 @@ from .roaring_array import RoaringArray
 _MAX32 = 1 << 32
 
 
-def _group_positions(vals: np.ndarray):
-    """Yield (value, positions) for each distinct entry of ``vals`` (one
-    stable argsort) — the grouping idiom shared by the bulk-probe paths
-    (contains_many / rank_many / select_many)."""
-    order = np.argsort(vals, kind="stable")
-    sv = vals[order]
-    bounds = np.nonzero(np.diff(sv))[0] + 1
-    starts = np.concatenate(([0], bounds))
-    ends = np.concatenate((bounds, [sv.size]))
-    for s, e in zip(starts.tolist(), ends.tolist()):
-        yield int(sv[s]), order[s:e]
+# the grouping idiom shared by the bulk-probe paths (contains_many /
+# rank_many / select_many) — one home in utils.order_stats
+from ..utils.order_stats import group_positions as _group_positions
 
 
 def _check_value(x: int) -> int:
@@ -277,21 +269,17 @@ class RoaringBitmap:
             raise ValueError("values outside unsigned 32-bit range")
         if hlc.size == 0:
             return out
+        from ..utils.order_stats import bucketed_rank_many
+
         keys_arr = np.asarray(hlc.keys, dtype=np.int64)
-        prefix = np.concatenate(([0], self._cum_cards()))  # exclusive
-        hbs = v >> 16
-        # containers strictly before the probe's chunk contribute wholesale
-        idx = np.searchsorted(keys_arr, hbs, side="left")
-        out = prefix[idx].copy()
-        # probes whose chunk exists add the in-container rank, grouped per key
-        hit = (idx < keys_arr.size) & (keys_arr[np.minimum(idx, keys_arr.size - 1)] == hbs)
-        if hit.any():
-            hit_all = np.flatnonzero(hit)
-            for _, rel in _group_positions(hbs[hit_all]):
-                pos = hit_all[rel]
-                c = hlc.containers[int(idx[pos[0]])]
-                out[pos] += c.rank_many((v[pos] & 0xFFFF).astype(np.uint16))
-        return out
+        return bucketed_rank_many(
+            keys_arr,
+            self._cum_cards(),
+            v >> 16,
+            lambda i, pos: hlc.containers[i].rank_many(
+                (v[pos] & 0xFFFF).astype(np.uint16)
+            ),
+        )
 
     def _cum_cards(self) -> np.ndarray:
         """Inclusive per-container cardinality cumsum — FastRank overrides

@@ -418,6 +418,34 @@ class Roaring64NavigableMap:
             lambda i: self._buckets[keys[i]].rank(low),
         )
 
+    def rank_many(self, values) -> np.ndarray:
+        """Bulk rankLong: int64 counts aligned with ``values`` — one
+        vectorized bucket resolution in comparator order plus one 32-bit
+        ``rank_many`` per touched bucket (the bulk twin of rank; the
+        reference answers order statistics one rankLong at a time,
+        Roaring64NavigableMap.java:351). Negative ints are taken as their
+        two's-complement bit patterns, like contains_many."""
+        from ..utils.order_stats import bucketed_rank_many
+
+        vals = np.asarray(values).astype(np.uint64, copy=False).ravel()
+        if vals.size == 0 or not self._buckets:
+            return np.zeros(vals.size, dtype=np.int64)
+        keys = self._sorted_keys()
+        kt = np.array(self._comparator_keys(), dtype=np.int64)
+        highs = (vals >> np.uint64(32)).astype(np.int64)
+        ch = (
+            np.where(highs >= (1 << 31), highs - _MAX32, highs)
+            if self.signed_longs
+            else highs
+        )
+        lows = (vals & np.uint64(0xFFFFFFFF)).astype(np.int64)
+        return bucketed_rank_many(
+            kt,
+            self._cum(),
+            ch,
+            lambda i, pos: self._buckets[keys[i]].rank_many(lows[pos]),
+        )
+
     def select(self, j: int) -> int:
         """selectLong (Roaring64NavigableMap.java:473)."""
         from ..utils.order_stats import bucketed_select

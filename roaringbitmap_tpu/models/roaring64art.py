@@ -153,7 +153,15 @@ class Roaring64Bitmap:
                 keys.append(k)
                 conts.append(c)
             cum = np.cumsum([c.cardinality for c in conts], dtype=np.int64)
-            self._ord = (keys, conts, cum)
+            # int64 view of the byte keys, cached for the vectorized
+            # bulk-rank searchsorted (big-endian 6-byte keys sort
+            # identically to their ints)
+            key_ints = np.array([key_to_int(k) for k in keys], dtype=np.int64)
+            self._ord = (keys, conts, cum, key_ints)
+        return self._ord[:3]
+
+    def _ordered4(self):
+        self._ordered()
         return self._ord
 
     def add(self, x: int) -> None:
@@ -384,6 +392,25 @@ class Roaring64Bitmap:
         if i < len(keys) and keys[i] == key:
             total += conts[i].rank(low)
         return total
+
+    def rank_many(self, values) -> np.ndarray:
+        """Bulk rank: int64 counts aligned with ``values`` — one vectorized
+        high-48 chunk resolution plus one container ``rank_many`` per
+        touched chunk (bulk twin of rank; negative ints as their
+        two's-complement bit patterns, like contains_many)."""
+        from ..utils.order_stats import bucketed_rank_many
+
+        vals = np.asarray(values).astype(np.uint64, copy=False).ravel()
+        keys, conts, cum, key_ints = self._ordered4()
+        if vals.size == 0 or not keys:
+            return np.zeros(vals.size, dtype=np.int64)
+        lows = (vals & np.uint64(0xFFFF)).astype(np.uint16)
+        return bucketed_rank_many(
+            key_ints,
+            cum,
+            (vals >> np.uint64(16)).astype(np.int64),
+            lambda i, pos: conts[i].rank_many(lows[pos]),
+        )
 
     def select(self, j: int) -> int:
         if j < 0:

@@ -47,3 +47,39 @@ def bucketed_select(
         raise IndexError("select out of range")
     prior = int(cum[i - 1]) if i else 0
     return bucket_select(i, j - prior)
+
+
+def group_positions(vals: np.ndarray):
+    """Yield (value, positions) for each distinct entry of ``vals`` (one
+    stable argsort) — the grouping idiom shared by the bulk-probe paths."""
+    order = np.argsort(vals, kind="stable")
+    sv = vals[order]
+    bounds = np.nonzero(np.diff(sv))[0] + 1
+    starts = np.concatenate(([0], bounds))
+    ends = np.concatenate((bounds, [sv.size]))
+    for s, e in zip(starts.tolist(), ends.tolist()):
+        yield int(sv[s]), order[s:e]
+
+
+def bucketed_rank_many(
+    sorted_keys: np.ndarray,
+    cum: np.ndarray,
+    probe_keys: np.ndarray,
+    in_bucket: Callable[[int, np.ndarray], np.ndarray],
+) -> np.ndarray:
+    """Vectorized bucketed rank, shared by every bulk rank_many: buckets
+    strictly before each probe's key contribute wholesale via the exclusive
+    prefix of ``cum`` (inclusive cumsum), and probes whose bucket exists add
+    ``in_bucket(bucket_index, positions)`` — called once per touched
+    bucket."""
+    prefix = np.concatenate(([0], cum))
+    idx = np.searchsorted(sorted_keys, probe_keys, side="left")
+    out = prefix[idx].copy()
+    n = sorted_keys.size
+    hit = (idx < n) & (sorted_keys[np.minimum(idx, n - 1)] == probe_keys)
+    if hit.any():
+        hit_all = np.flatnonzero(hit)
+        for _, rel in group_positions(idx[hit_all]):
+            pos = hit_all[rel]
+            out[pos] += in_bucket(int(idx[pos[0]]), pos)
+    return out

@@ -326,3 +326,40 @@ def test_stream_deserialize_survives_short_reads():
     stream = Dribble(buf.getvalue())
     assert Roaring64Bitmap.deserialize_from(stream) == art
     assert Roaring64BitmapSliceIndex.deserialize_from(stream) == bsi
+
+
+def test_rank_many_64_matches_scalar():
+    """Bulk rank on both 64-bit designs == scalar rank, across unsigned
+    AND signed comparator order, probes in/out of buckets, and the
+    above-2^63 band."""
+    import numpy as np
+
+    from roaringbitmap_tpu import Roaring64Bitmap, Roaring64NavigableMap
+
+    rng = np.random.default_rng(61)
+    vals = np.unique(
+        np.concatenate(
+            [
+                rng.integers(0, 1 << 20, 8_000, dtype=np.uint64),
+                rng.integers(0, 1 << 42, 5_000, dtype=np.uint64),
+                np.uint64(1 << 63) + rng.integers(0, 1 << 16, 1_500, dtype=np.uint64),
+            ]
+        )
+    )
+    probes = np.concatenate(
+        [
+            vals[::7][:300],
+            rng.integers(0, 1 << 43, 400, dtype=np.uint64),
+            np.array([0, (1 << 64) - 1], dtype=np.uint64),
+        ]
+    )
+    art = Roaring64Bitmap()
+    art.add_many(vals)
+    assert art.rank_many(probes).tolist() == [art.rank(int(p)) for p in probes]
+    assert art.rank_many([]).size == 0
+    for signed in (False, True):
+        nav = Roaring64NavigableMap(signed_longs=signed)
+        nav.add_many(vals)
+        want = [nav.rank(int(p)) for p in probes]
+        assert nav.rank_many(probes).tolist() == want, signed
+    assert Roaring64NavigableMap().rank_many(probes).tolist() == [0] * probes.size
