@@ -85,6 +85,11 @@ def run(reps: int = 10, datasets=None, **_) -> List[Result]:
     assert [art_a.rank(v) for v in rank_pts] == want_ranks
     bench("rank_x200_navmap", lambda: [nav_a.rank(v) for v in rank_pts])
     bench("rank_x200_art", lambda: [art_a.rank(v) for v in rank_pts])
+    rank_arr = np.array(rank_pts, dtype=np.uint64)
+    assert nav_a.rank_many(rank_arr).tolist() == want_ranks
+    assert art_a.rank_many(rank_arr).tolist() == want_ranks
+    bench("rankMany_x200_navmap", lambda: nav_a.rank_many(rank_arr))
+    bench("rankMany_x200_art", lambda: art_a.rank_many(rank_arr))
     sel_pts = list(range(0, card, max(1, card // 200)))[:200]
     assert [nav_a.select(j) for j in sel_pts] == [art_a.select(j) for j in sel_pts]
     bench("select_x200_navmap", lambda: [nav_a.select(j) for j in sel_pts])

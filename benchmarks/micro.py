@@ -86,6 +86,12 @@ def run(reps: int = 10, datasets=None, **_) -> List[Result]:
     # select/top values (SelectTopValuesBenchmark)
     card = mixed.get_cardinality()
     bench("select_spread_x100", lambda: [mixed.select(j) for j in range(0, card, max(1, card // 100))])
+    # bulk order-statistic twins: whole probe arrays in one vectorized pass
+    rank_probes = np.asarray(hits, dtype=np.uint32)
+    sel_ranks = np.arange(0, card, max(1, card // 1000), dtype=np.int64)
+    assert mixed.rank_many(rank_probes).tolist() == [mixed.rank_long(int(v)) for v in hits]
+    bench("rankMany_x1000", lambda: mixed.rank_many(rank_probes))
+    bench("selectMany_x1000", lambda: mixed.select_many(sel_ranks))
     bench("limit_1000", lambda: mixed.limit(1000))
 
     # first/last/next (BitmapNextBenchmark)

@@ -134,6 +134,7 @@ class Roaring64NavigableMap:
         "_buckets",
         "_keys",
         "_ckeys",
+        "_ckeys_arr",
         "_keys_dirty",
         "_cum_cards",
         "_cum_dirty",
@@ -150,6 +151,7 @@ class Roaring64NavigableMap:
         self._buckets: dict = {}  # high32 -> RoaringBitmap
         self._keys: List[int] = []
         self._ckeys: Optional[List[int]] = None
+        self._ckeys_arr: Optional[np.ndarray] = None
         self._keys_dirty = False
         self._cum_cards: Optional[np.ndarray] = None
         self._cum_dirty = True
@@ -172,6 +174,7 @@ class Roaring64NavigableMap:
         if self._keys_dirty:
             self._keys = sorted(self._buckets, key=self._key_order)
             self._ckeys = None
+            self._ckeys_arr = None
             self._keys_dirty = False
         return self._keys
 
@@ -431,7 +434,9 @@ class Roaring64NavigableMap:
         if vals.size == 0 or not self._buckets:
             return np.zeros(vals.size, dtype=np.int64)
         keys = self._sorted_keys()
-        kt = np.array(self._comparator_keys(), dtype=np.int64)
+        if self._ckeys_arr is None:  # cached int64 comparator keys
+            self._ckeys_arr = np.array(self._comparator_keys(), dtype=np.int64)
+        kt = self._ckeys_arr
         highs = (vals >> np.uint64(32)).astype(np.int64)
         ch = (
             np.where(highs >= (1 << 31), highs - _MAX32, highs)
@@ -439,12 +444,18 @@ class Roaring64NavigableMap:
             else highs
         )
         lows = (vals & np.uint64(0xFFFFFFFF)).astype(np.int64)
-        return bucketed_rank_many(
-            kt,
-            self._cum(),
-            ch,
-            lambda i, pos: self._buckets[keys[i]].rank_many(lows[pos]),
-        )
+
+        def in_bucket(i, pos):
+            bucket = self._buckets[keys[i]]
+            if pos.size < 8:
+                # scattered probes (one or two per bucket): the scalar walk
+                # beats the vectorized path's per-call numpy setup
+                return np.array(
+                    [bucket.rank_long(int(v)) for v in lows[pos]], dtype=np.int64
+                )
+            return bucket.rank_many(lows[pos])
+
+        return bucketed_rank_many(kt, self._cum(), ch, in_bucket)
 
     def select(self, j: int) -> int:
         """selectLong (Roaring64NavigableMap.java:473)."""

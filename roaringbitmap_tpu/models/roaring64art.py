@@ -158,10 +158,6 @@ class Roaring64Bitmap:
             # identically to their ints)
             key_ints = np.array([key_to_int(k) for k in keys], dtype=np.int64)
             self._ord = (keys, conts, cum, key_ints)
-        return self._ord[:3]
-
-    def _ordered4(self):
-        self._ordered()
         return self._ord
 
     def add(self, x: int) -> None:
@@ -377,7 +373,7 @@ class Roaring64Bitmap:
     # cardinality / order statistics
     # ------------------------------------------------------------------
     def get_cardinality(self) -> int:
-        _, _, cum = self._ordered()
+        _, _, cum, _ = self._ordered()
         return int(cum[-1]) if cum.size else 0
 
     def is_empty(self) -> bool:
@@ -386,7 +382,7 @@ class Roaring64Bitmap:
     def rank(self, x: int) -> int:
         x = _check64(x)
         key, low = high48_key(x), x & 0xFFFF
-        keys, conts, cum = self._ordered()
+        keys, conts, cum, _ = self._ordered()
         i = bisect.bisect_left(keys, key)
         total = int(cum[i - 1]) if i else 0
         if i < len(keys) and keys[i] == key:
@@ -401,21 +397,25 @@ class Roaring64Bitmap:
         from ..utils.order_stats import bucketed_rank_many
 
         vals = np.asarray(values).astype(np.uint64, copy=False).ravel()
-        keys, conts, cum, key_ints = self._ordered4()
+        keys, conts, cum, key_ints = self._ordered()
         if vals.size == 0 or not keys:
             return np.zeros(vals.size, dtype=np.int64)
         lows = (vals & np.uint64(0xFFFF)).astype(np.uint16)
+
+        def in_chunk(i, pos):
+            c = conts[i]
+            if pos.size < 4:  # scattered probes: scalar beats numpy setup
+                return np.array([c.rank(int(v)) for v in lows[pos]], dtype=np.int64)
+            return c.rank_many(lows[pos])
+
         return bucketed_rank_many(
-            key_ints,
-            cum,
-            (vals >> np.uint64(16)).astype(np.int64),
-            lambda i, pos: conts[i].rank_many(lows[pos]),
+            key_ints, cum, (vals >> np.uint64(16)).astype(np.int64), in_chunk
         )
 
     def select(self, j: int) -> int:
         if j < 0:
             raise IndexError(f"select({j})")
-        keys, conts, cum = self._ordered()
+        keys, conts, cum, _ = self._ordered()
         if not keys or j >= int(cum[-1]):
             raise IndexError(f"select({j}) out of range")
         i = int(np.searchsorted(cum, j, side="right"))
