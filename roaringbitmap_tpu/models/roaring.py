@@ -296,22 +296,20 @@ class RoaringBitmap:
         aligned with ``ranks`` (bulk twin of select; a retrieval stack's
         "docIDs at ranks [r0..rk]" pagination ask). Raises IndexError when
         any rank is out of range, like the scalar."""
+        from ..utils.order_stats import bucketed_select_many
+
         js = np.asarray(ranks, dtype=np.int64).ravel()
-        out = np.zeros(js.size, dtype=np.uint32)
-        if js.size == 0:
-            return out
-        cum = self._cum_cards()  # inclusive
-        total = int(cum[-1]) if cum.size else 0
-        if js.min() < 0 or js.max() >= total:
-            raise IndexError("select out of range")
+        if js.size == 0:  # skip the uncached cumsum for an empty page
+            return np.zeros(0, dtype=np.uint32)
         hlc = self.high_low_container
         keys_arr = np.asarray(hlc.keys, dtype=np.int64)
-        ci = np.searchsorted(cum, js, side="right")  # container holding rank
-        base = np.concatenate(([0], cum))[ci]
-        for c_idx, pos in _group_positions(ci):
-            lows = hlc.containers[c_idx].select_many(js[pos] - base[pos])
-            out[pos] = (keys_arr[c_idx] << 16) | lows.astype(np.uint32)
-        return out
+        return bucketed_select_many(
+            self._cum_cards(),
+            js,
+            lambda i, j: np.uint32(keys_arr[i] << 16)
+            | hlc.containers[i].select_many(j).astype(np.uint32),
+            dtype=np.uint32,
+        )
 
     def contains_range(self, start: int, end: int) -> bool:
         """RoaringBitmap.contains(long,long)."""

@@ -457,6 +457,20 @@ class Roaring64NavigableMap:
 
         return bucketed_rank_many(kt, self._cum(), ch, in_bucket)
 
+    def select_many(self, ranks) -> np.ndarray:
+        """Bulk selectLong: uint64 values at the given comparator-order
+        ranks, one vectorized bucket resolution plus one 32-bit
+        ``select_many`` per touched bucket (bulk twin of select)."""
+        from ..utils.order_stats import bucketed_select_many
+
+        keys = self._sorted_keys()
+        return bucketed_select_many(
+            self._cum(),
+            ranks,
+            lambda i, js: (np.uint64(keys[i]) << np.uint64(32))
+            | self._buckets[keys[i]].select_many(js).astype(np.uint64),
+        )
+
     def select(self, j: int) -> int:
         """selectLong (Roaring64NavigableMap.java:473)."""
         from ..utils.order_stats import bucketed_select

@@ -412,6 +412,20 @@ class Roaring64Bitmap:
             key_ints, cum, (vals >> np.uint64(16)).astype(np.int64), in_chunk
         )
 
+    def select_many(self, ranks) -> np.ndarray:
+        """Bulk select: uint64 values at the given ranks, one vectorized
+        chunk resolution plus one container ``select_many`` per touched
+        chunk (bulk twin of select)."""
+        from ..utils.order_stats import bucketed_select_many
+
+        _, conts, cum, key_ints = self._ordered()
+        return bucketed_select_many(
+            cum,
+            ranks,
+            lambda i, js: (np.uint64(key_ints[i]) << np.uint64(16))
+            | conts[i].select_many(js).astype(np.uint64),
+        )
+
     def select(self, j: int) -> int:
         if j < 0:
             raise IndexError(f"select({j})")

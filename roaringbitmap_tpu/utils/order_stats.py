@@ -83,3 +83,28 @@ def bucketed_rank_many(
             pos = hit_all[rel]
             out[pos] += in_bucket(int(idx[pos[0]]), pos)
     return out
+
+
+def bucketed_select_many(
+    cum: np.ndarray,
+    ranks: np.ndarray,
+    in_bucket: Callable[[int, np.ndarray], np.ndarray],
+    dtype=np.uint64,
+) -> np.ndarray:
+    """Vectorized bucketed select, shared by every bulk select_many: each
+    rank resolves to its bucket through the inclusive cumsum, and
+    ``in_bucket(bucket_index, local_ranks)`` returns the finished values
+    (high bits merged) — called once per touched bucket. Raises IndexError
+    on any out-of-range rank, like the scalar selects."""
+    js = np.asarray(ranks, dtype=np.int64).ravel()
+    out = np.zeros(js.size, dtype=dtype)
+    if js.size == 0:
+        return out
+    total = int(cum[-1]) if cum.size else 0
+    if js.min() < 0 or js.max() >= total:
+        raise IndexError("select out of range")
+    ci = np.searchsorted(cum, js, side="right")
+    base = np.concatenate(([0], cum))[ci]
+    for c_idx, pos in group_positions(ci):
+        out[pos] = in_bucket(c_idx, js[pos] - base[pos])
+    return out

@@ -363,3 +363,34 @@ def test_rank_many_64_matches_scalar():
         want = [nav.rank(int(p)) for p in probes]
         assert nav.rank_many(probes).tolist() == want, signed
     assert Roaring64NavigableMap().rank_many(probes).tolist() == [0] * probes.size
+
+
+def test_select_many_64_matches_scalar():
+    """Bulk select on both 64-bit designs == scalar select, comparator
+    orders included, and inverse with rank_many."""
+    import numpy as np
+    import pytest
+
+    from roaringbitmap_tpu import Roaring64Bitmap, Roaring64NavigableMap
+
+    rng = np.random.default_rng(67)
+    vals = np.unique(
+        np.concatenate(
+            [
+                rng.integers(0, 1 << 42, 10_000, dtype=np.uint64),
+                np.uint64(1 << 63) + rng.integers(0, 1 << 16, 1_000, dtype=np.uint64),
+            ]
+        )
+    )
+    ranks = np.concatenate([rng.integers(0, vals.size, 500), [0, vals.size - 1]])
+    art = Roaring64Bitmap()
+    art.add_many(vals)
+    assert art.select_many(ranks).tolist() == [art.select(int(j)) for j in ranks]
+    assert np.array_equal(art.rank_many(art.select_many(ranks)), ranks + 1)
+    for signed in (False, True):
+        nav = Roaring64NavigableMap(signed_longs=signed)
+        nav.add_many(vals)
+        assert nav.select_many(ranks).tolist() == [nav.select(int(j)) for j in ranks]
+    with pytest.raises(IndexError):
+        art.select_many([vals.size])
+    assert art.select_many([]).size == 0
