@@ -480,6 +480,15 @@ def run_campaign(iterations: Optional[int] = None, verbose: bool = True) -> dict
         ),
         actual=max(1, n // 8),
     )
+    _run(
+        "bulk-order-stats-agree",
+        lambda: verify_invariance(
+            "bulk-order-stats-agree",
+            _bulk_order_stats_pred,
+            arity=1, iterations=max(1, n // 8), seed=50,
+        ),
+        actual=max(1, n // 8),
+    )
     return results
 
 
@@ -510,6 +519,50 @@ def _batched_counts_pred(a) -> bool:
         b.compare_cardinality_many(Operation.RANGE, qs, ends=ends, mode="device").tolist()
         == want
     )
+
+
+def _bulk_order_stats_pred(a) -> bool:
+    """rank_many/select_many/contains_many must agree with a sorted-array
+    numpy oracle on the heap bitmap, the mapped immutable view, and (via
+    a 64-bit lift) both 64-bit designs. Probes mix in-domain misses with
+    exact members so the <= boundary is pinned on every surface."""
+    from .models.immutable import ImmutableRoaringBitmap
+    from .models.roaring64 import Roaring64NavigableMap
+    from .models.roaring64art import Roaring64Bitmap
+
+    arr = a.to_array()
+    if arr.size == 0:
+        return True
+    u = np.sort(arr)
+    rng = np.random.default_rng(int(u[0]) + u.size)
+    ranks = rng.integers(0, u.size, 64)
+    # in-domain misses + exact members (the <= boundary case)
+    probes = np.concatenate(
+        [rng.integers(0, int(u[-1]) + 2, 48).astype(np.uint32), u[ranks[:16]]]
+    )
+    want_rank = np.searchsorted(u, probes, side="right")
+    want_in = np.isin(probes, u)
+    for bm in (a, ImmutableRoaringBitmap(a.serialize())):
+        if not np.array_equal(bm.rank_many(probes), want_rank):
+            return False
+        if not np.array_equal(bm.select_many(ranks), u[ranks]):
+            return False
+        if not np.array_equal(bm.contains_many(probes), want_in):
+            return False
+    lifted = (u.astype(np.uint64) << np.uint64(20)) | np.uint64(5)
+    p64 = np.concatenate(
+        [probes.astype(np.uint64) << np.uint64(20), lifted[ranks[:16]]]
+    )
+    want64 = np.searchsorted(lifted, p64, side="right")
+    for bm64 in (Roaring64NavigableMap(), Roaring64Bitmap()):
+        bm64.add_many(lifted)
+        if not np.array_equal(bm64.rank_many(p64), want64):
+            return False
+        if not np.array_equal(bm64.select_many(ranks), lifted[ranks]):
+            return False
+        if not np.array_equal(bm64.contains_many(p64), np.isin(p64, lifted)):
+            return False
+    return True
 
 
 def _ranged_andnot_pred(a, b) -> bool:
