@@ -42,4 +42,5 @@ SUITES = [
     "formats",
     "bithacking",
     "longlong",
+    "pairwise_cases",
 ]

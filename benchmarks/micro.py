@@ -77,6 +77,32 @@ def run(reps: int = 10, datasets=None, **_) -> List[Result]:
     # rangeCardinality + range ops (RangeOperationBenchmark, TestRangeCardinality)
     bench("rangeCardinality", lambda: mixed.range_cardinality(lo, hi))
 
+    # containsRange vs the rank-pair route (range/ContainsRange.java:
+    # contains() vs containsViaRank())
+    r_lo, r_hi = int(arr[100]), int(arr[100]) + 1000
+    assert mixed.contains_range(r_lo, r_hi) == (
+        mixed.rank_long(r_hi - 1) - mixed.rank_long(r_lo - 1) == r_hi - r_lo
+    )
+    bench("containsRange_viaRank", lambda: mixed.rank_long(r_hi - 1) - mixed.rank_long(r_lo - 1) == r_hi - r_lo)
+
+    # bitmap concatenation (iteration/Concatenation.java: shift-and-or via
+    # addOffset vs rebuilding from values)
+    piece = RoaringBitmap(np.arange(0, 50_000, 3, dtype=np.uint32))
+
+    def concat_offset():
+        out_bm = mixed.clone()
+        out_bm.ior(RoaringBitmap.add_offset(piece, 1 << 23))
+        return out_bm
+
+    def concat_naive():
+        return RoaringBitmap(
+            np.concatenate([mixed.to_array(), piece.to_array().astype(np.int64) + (1 << 23)]).astype(np.uint32)
+        )
+
+    assert concat_offset() == concat_naive()
+    bench("concatenate_viaOffset", concat_offset)
+    bench("concatenate_naive", concat_naive)
+
     def flip_range():
         bm = mixed.clone()
         bm.flip_range(lo, hi)

@@ -1,0 +1,141 @@
+"""Synthetic pairwise-op grids — the aggregation/{and,andnot}/{bestcase,
+identical,worstcase} jmh twins (jmh/src/jmh/java/org/roaringbitmap/
+aggregation/and/bestcase/RoaringBitmapBenchmark.java:21-37 and siblings,
+both widths), plus the N-way ior fold of aggregation/or/
+RoaringBitmapBenchmark.java:20-41.
+
+Case shapes (k = 2^16, exactly the reference setups):
+
+* ``bestcase``  — operands own almost entirely disjoint key ranges with a
+                  50-key overlap band (the key-skip fast path dominates)
+* ``identical`` — the same 10k single-value containers on both sides
+* ``worstcase`` — interleaved adjacent values in shared containers
+
+Per (case, op, width): the static op, the in-place op on a clone, and
+``justclone`` (the jmh baseline row that prices the clone out of the
+in-place number). Static and in-place results are asserted equal before
+timing. or/xor grids are recorded too (the reference only ships and/
+andnot grids; same shapes, marked beyond=true).
+
+Run:  python -m benchmarks.run pairwise_cases --reps 5
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from roaringbitmap_tpu import Roaring64Bitmap, RoaringBitmap
+
+from . import common
+from .common import Result
+
+K = 1 << 16
+
+
+def _cases_values():
+    """(case, values1, values2) triples shared by both widths."""
+    i = np.arange(10_000, dtype=np.uint64)
+    j = np.arange(10_000, 10_050, dtype=np.uint64)
+    tail = np.arange(10_050, 20_000, dtype=np.uint64)
+    best1 = np.concatenate([i * K, j * K + 13, [np.uint64(20_000 * K)]])
+    best2 = np.concatenate([j * K, tail * K])
+    ident = i * K
+    worst1 = 2 * i * K
+    worst2 = 2 * i * K + 1
+    return [
+        ("bestcase", best1, best2),
+        ("identical", ident, ident.copy()),
+        ("worstcase", worst1, worst2),
+    ]
+
+
+_OPS32 = {
+    "and": (RoaringBitmap.and_, "iand"),
+    "or": (RoaringBitmap.or_, "ior"),
+    "xor": (RoaringBitmap.xor, "ixor"),
+    "andnot": (RoaringBitmap.andnot, "iandnot"),
+}
+_OPS64 = {
+    "and": (Roaring64Bitmap.and_, "iand"),
+    "or": (Roaring64Bitmap.or_, "ior"),
+    "xor": (Roaring64Bitmap.xor, "ixor"),
+    "andnot": (Roaring64Bitmap.andnot, "iandnot"),
+}
+# the reference grid only ships and/andnot; or/xor rows are extra coverage
+_REFERENCE_OPS = {"and", "andnot"}
+
+
+def run(reps: int = 5, datasets=None, **_) -> List[Result]:
+    out: List[Result] = []
+
+    def rec(name, dataset, value, **extra):
+        out.append(Result(name, dataset, value, "ns/op", {"suite": "pairwise_cases", **extra}))
+
+    for case, v1, v2 in _cases_values():
+        for width, ctor, ops in (
+            (32, lambda v: RoaringBitmap(v.astype(np.uint32)), _OPS32),
+            (64, Roaring64Bitmap, _OPS64),
+        ):
+            ds = f"synthetic-{width}"
+            b1, b2 = ctor(v1), ctor(v2)
+            rec(f"{case}:justclone", ds, common.min_of(reps, b1.clone))
+            for opname, (static_op, inplace_name) in ops.items():
+                inplace = getattr(type(b1), inplace_name)
+                want = static_op(b1, b2)
+                got = inplace(b1.clone(), b2)
+                assert got == want, (case, width, opname)
+                extra = {} if opname in _REFERENCE_OPS else {"beyond": True}
+                rec(f"{case}:{opname}", ds, common.min_of(reps, lambda: static_op(b1, b2)), **extra)
+                rec(
+                    f"{case}:inplace_{opname}",
+                    ds,
+                    common.min_of(reps, lambda: inplace(b1.clone(), b2)),
+                    **extra,
+                )
+
+    # buffer twins of the and/andnot grids (buffer/aggregation/{and,andnot}/
+    # {bestcase,identical,worstcase}/MutableRoaringBitmapBenchmark.java):
+    # static ops on the buffer facade, one operand an immutable mapped view
+    # (the mixed-input case the buffer layer exists for)
+    from roaringbitmap_tpu.models.buffer import MutableRoaringBitmap
+    from roaringbitmap_tpu.models.immutable import ImmutableRoaringBitmap
+
+    for case, v1, v2 in _cases_values():
+        b1 = MutableRoaringBitmap(v1.astype(np.uint32))
+        b2 = ImmutableRoaringBitmap(
+            RoaringBitmap(v2.astype(np.uint32)).serialize()
+        )
+        for opname in ("and", "andnot"):
+            static_op = getattr(MutableRoaringBitmap, opname + ("_" if opname == "and" else ""))
+            oracle = getattr(RoaringBitmap, opname + ("_" if opname == "and" else ""))(
+                RoaringBitmap(v1.astype(np.uint32)), RoaringBitmap(v2.astype(np.uint32))
+            )
+            assert static_op(b1, b2) == oracle, (case, "buffer", opname)
+            rec(
+                f"{case}:buffer_{opname}",
+                "synthetic-buffer",
+                common.min_of(reps, lambda: static_op(b1, b2)),
+            )
+
+    # N-way in-place OR fold (aggregation/or/RoaringBitmapBenchmark.java:
+    # @Param {10, 50, 100} random bitmaps, b1.or(each) into an accumulator)
+    rng = np.random.default_rng(0xFEEF1F0)
+    pool = [
+        RoaringBitmap(np.unique(rng.integers(0, 1 << 24, 1 << 12)).astype(np.uint32))
+        for _ in range(100)
+    ]
+    for n in (10, 50, 100):
+
+        def fold(n=n):
+            acc = RoaringBitmap()
+            for bm in pool[:n]:
+                acc.ior(bm)
+            return acc
+
+        from roaringbitmap_tpu.parallel.aggregation import FastAggregation
+
+        assert fold() == FastAggregation.or_(*pool[:n], mode="cpu")
+        rec("orFold:ior", "synthetic-32", common.min_of(reps, fold), n_bitmaps=n)
+    return out

@@ -35,7 +35,7 @@ def main():
     # a registered-but-unreachable TPU plugin would block jax.devices()
     # forever; probe in a subprocess and pin CPU on failure, like
     # device_aggregation (run_all's try/except cannot catch a hang)
-    if not bench._probe_backend(timeout_s=60):
+    if not bench._probe_backend_once(timeout_s=60):
         print("(TPU backend unreachable; running the same path on CPU)")
         jax.config.update("jax_platforms", "cpu")
 

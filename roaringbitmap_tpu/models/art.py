@@ -318,17 +318,30 @@ class Art:
         yield from self._walk(self._root, reverse=False)
 
     def items_reverse(self) -> Iterator[Tuple[bytes, Any]]:
+        """Streaming descending traversal in O(depth) memory — the
+        BackwardShuttle (art/BackwardShuttle.java:1); callers must NOT need
+        the trie materialized (it exists precisely to hold huge key sets)."""
         yield from self._walk(self._root, reverse=True)
 
     def _walk(self, node, reverse: bool):
+        """Explicit-stack shuttle (art/AbstractShuttle.java:1): one child
+        iterator per trie level, so traversal holds O(depth) frames — never
+        the O(n) node list — in either direction."""
         if node is None:
             return
-        if isinstance(node, _Leaf):
-            yield node.key, node.value
-            return
-        it = node.items_reverse() if reverse else node.items()
-        for _, child in it:
-            yield from self._walk(child, reverse)
+        stack = [iter(((0, node),))]
+        while stack:
+            nxt = next(stack[-1], None)
+            if nxt is None:
+                stack.pop()
+                continue
+            child = nxt[1]
+            if isinstance(child, _Leaf):
+                yield child.key, child.value
+            else:
+                stack.append(
+                    child.items_reverse() if reverse else child.items()
+                )
 
     def node_width_histogram(self) -> dict:
         """Count of inner nodes per reference node class (4/16/48/256) —

@@ -559,7 +559,12 @@ class Roaring64Bitmap:
                     yield val
 
     def _kv_reversed(self):
-        return reversed(list(self._kv()))
+        """Streaming (key, container) descending — rides the trie's
+        explicit-stack BackwardShuttle (art/BackwardShuttle.java:1) in
+        O(depth) memory; reverse iteration over a huge key set must not
+        materialize the trie it exists to index."""
+        for key, idx in self._art.items_reverse():
+            yield key, self._containers.get(idx)
 
     def for_each(self, consumer) -> None:
         for v in self:

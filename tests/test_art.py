@@ -266,3 +266,67 @@ def test_roaring64art_bulk_ingest_matches_chunked():
     a.add(123456789)
     a.remove(int(vals[7]))
     assert a.contains(123456789) and not a.contains(int(vals[7]))
+
+
+def test_backward_shuttle_streams_in_odepth_memory():
+    """Reverse traversal is the explicit-stack BackwardShuttle
+    (art/BackwardShuttle.java:1 / AbstractShuttle.java:1): O(depth) live
+    frames, never a materialized node list — pinned by a tracemalloc bound
+    far below what reversed(list(items())) would allocate, plus exact
+    equality with the reversed forward order."""
+    import tracemalloc
+
+    from roaringbitmap_tpu.models.art import Art
+
+    rng = np.random.default_rng(99)
+    keys = np.unique(rng.integers(0, 1 << 48, 200_000).astype(np.uint64))
+    art = Art()
+    art.bulk_load([(int(k).to_bytes(6, "big"), i) for i, k in enumerate(keys)])
+
+    # equality with reversed(forward) on the full set
+    fwd = list(art.items())
+    assert len(fwd) == len(keys)
+    it = art.items_reverse()
+    # prime the generator so setup allocations (first frame) are excluded
+    first = next(it)
+    assert first == fwd[-1]
+    expect = reversed(fwd[:-1])  # the oracle's slice stays outside the bound
+    tracemalloc.start()
+    rest = 0
+    for (k, v), (fk, fv) in zip(it, expect):
+        assert k == fk and v == fv
+        rest += 1
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert rest == len(fwd) - 1
+    # materializing ~200k (bytes, int) pairs costs megabytes; the shuttle's
+    # live state is a handful of iterator frames
+    assert peak < 256 * 1024, f"reverse walk allocated {peak} bytes"
+
+
+def test_roaring64art_reverse_iterator_streams():
+    """get_reverse_long_iterator rides the streaming shuttle: first values
+    arrive without touching the rest of a large trie, and the full order
+    equals reversed(forward)."""
+    import itertools
+    import tracemalloc
+
+    from roaringbitmap_tpu import Roaring64Bitmap
+
+    rng = np.random.default_rng(7)
+    vals = np.unique(rng.integers(0, 1 << 40, 50_000).astype(np.uint64))
+    bm = Roaring64Bitmap(vals)
+    assert list(bm.get_reverse_long_iterator()) == vals[::-1].tolist()
+    # previous_value seeks through the same backward walk
+    probe = int(vals[len(vals) // 2])
+    assert bm.previous_value(probe) == probe
+    assert bm.previous_value(probe - 1) == int(vals[len(vals) // 2 - 1])
+    # streaming: taking the top 10 values must not materialize the trie
+    it = bm.get_reverse_long_iterator()
+    next(it)
+    tracemalloc.start()
+    top = list(itertools.islice(it, 10))
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert top == vals[-11:-1][::-1].tolist()
+    assert peak < 256 * 1024, f"top-10 reverse peel allocated {peak} bytes"
