@@ -29,6 +29,33 @@ import numpy as np
 
 from ..utils import bits
 
+# raw C point-probe kernels, bound once: scalar contains is the per-call
+# latency floor (simplebenchmark contains row; Util.java:697's
+# unsignedBinarySearch role), and every avoided Python frame or numpy
+# scalar-index on that path is ~70-150 ns
+_EXT_CONTAINS = None  # contains_u16(sorted_content, x) -> bool
+_EXT_WORDBIT = None  # word_bit(words_u64, x) -> bool
+_EXT_RUNCONTAINS = None  # run_contains(starts, lengths, x) -> bool
+_EXT_ADVANCE = None  # advance_until(sorted, pos, min) -> first idx with a[i] >= min
+_EXT_PROBES_TRIED = False
+
+
+def _bind_scalar_probes():
+    global _EXT_CONTAINS, _EXT_WORDBIT, _EXT_RUNCONTAINS, _EXT_ADVANCE
+    global _EXT_PROBES_TRIED
+    if not _EXT_PROBES_TRIED:
+        _EXT_PROBES_TRIED = True
+        from .. import native
+
+        if native.available():
+            e = native._load_ext()
+            if e is not None:
+                _EXT_CONTAINS = getattr(e, "contains_u16", None)
+                _EXT_WORDBIT = getattr(e, "word_bit", None)
+                _EXT_RUNCONTAINS = getattr(e, "run_contains", None)
+                _EXT_ADVANCE = getattr(e, "advance_until", None)
+    return _EXT_CONTAINS
+
 ARRAY_MAX_SIZE = 4096  # ArrayContainer.java:27 DEFAULT_MAX_SIZE
 MAX_CAPACITY = 1 << 16  # BitmapContainer.java:25
 
@@ -286,8 +313,13 @@ class ArrayContainer(Container):
 
     def contains(self, x: int) -> bool:
         c = self.content
-        i = bits.lower_bound(c, x)
-        return i < c.size and c[i] == x
+        e = _EXT_CONTAINS
+        if e is None:
+            if not _EXT_PROBES_TRIED and (e := _bind_scalar_probes()) is not None:
+                return e(c, x)
+            i = bits.lower_bound(c, x)
+            return bool(i < c.size and c[i] == x)
+        return e(c, x)
 
     def contains_many(self, values: np.ndarray) -> np.ndarray:
         if self.content.size == 0:
@@ -298,18 +330,37 @@ class ArrayContainer(Container):
         return (idx < self.content.size) & (self.content[idx_c] == v)
 
     def add(self, x: int) -> Container:
-        i = bits.lower_bound(self.content, x)
-        if i < self.content.size and self.content[i] == x:
+        c = self.content
+        e = _EXT_ADVANCE
+        if e is None and not _EXT_PROBES_TRIED:
+            _bind_scalar_probes()
+            e = _EXT_ADVANCE
+        i = e(c, -1, x) if e is not None else bits.lower_bound(c, x)
+        if i < c.size and c[i] == x:
             return self
-        if self.content.size >= ARRAY_MAX_SIZE:
+        if c.size >= ARRAY_MAX_SIZE:
             return self._promote().add(x)  # ArrayContainer.java:158 promotion
-        self.content = np.insert(self.content, i, np.uint16(x))
+        # manual two-slice insert: np.insert pays ~5 us of generic shape
+        # machinery per call on this point-mutation hot path
+        out = np.empty(c.size + 1, dtype=np.uint16)
+        out[:i] = c[:i]
+        out[i] = x
+        out[i + 1 :] = c[i:]
+        self.content = out
         return self
 
     def remove(self, x: int) -> Container:
-        i = bits.lower_bound(self.content, x)
-        if i < self.content.size and self.content[i] == x:
-            self.content = np.delete(self.content, i)
+        c = self.content
+        e = _EXT_ADVANCE
+        if e is None and not _EXT_PROBES_TRIED:
+            _bind_scalar_probes()
+            e = _EXT_ADVANCE
+        i = e(c, -1, x) if e is not None else bits.lower_bound(c, x)
+        if i < c.size and c[i] == x:
+            out = np.empty(c.size - 1, dtype=np.uint16)
+            out[:i] = c[:i]
+            out[i:] = c[i + 1 :]
+            self.content = out
         return self
 
     def _promote(self) -> "BitmapContainer":
@@ -357,7 +408,15 @@ class ArrayContainer(Container):
 
     def rank(self, x: int) -> int:
         # values <= x == first index with content[i] >= x+1
-        return bits.lower_bound(self.content, int(x) + 1) if x < 0xFFFF else self.content.size
+        if x >= 0xFFFF:
+            return self.content.size
+        e = _EXT_ADVANCE
+        if e is None and not _EXT_PROBES_TRIED:
+            _bind_scalar_probes()
+            e = _EXT_ADVANCE
+        if e is not None:
+            return e(self.content, -1, int(x) + 1)
+        return bits.lower_bound(self.content, int(x) + 1)
 
     def rank_many(self, lows: np.ndarray) -> np.ndarray:
         return np.searchsorted(self.content, lows, side="right").astype(np.int64)
@@ -412,7 +471,14 @@ class BitmapContainer(Container):
         return 8192
 
     def contains(self, x: int) -> bool:
-        return bits.get_bit(self.words, x)
+        e = _EXT_WORDBIT
+        if e is None:
+            if not _EXT_PROBES_TRIED:
+                _bind_scalar_probes()
+                e = _EXT_WORDBIT
+            if e is None:
+                return bits.get_bit(self.words, x)
+        return e(self.words, x)
 
     def contains_many(self, values: np.ndarray) -> np.ndarray:
         """Vectorized membership mask for uint16 values."""
@@ -664,13 +730,22 @@ class RunContainer(Container):
         return self.serialized_size_for(self.num_runs())
 
     def contains(self, x: int) -> bool:
-        # scalar fast path: one searchsorted over the run starts instead of
-        # the vectorized _run_contains_many machinery (~8x less overhead on
-        # the point-probe path)
-        i = int(np.searchsorted(self.starts, x, side="right")) - 1
+        # scalar fast path: one C probe over (starts, lengths) — or one
+        # searchsorted when no ext — instead of the vectorized
+        # _run_contains_many machinery (~8x less overhead per point probe)
+        e = _EXT_RUNCONTAINS
+        if e is None and not _EXT_PROBES_TRIED:
+            _bind_scalar_probes()
+            e = _EXT_RUNCONTAINS
+        starts = self.starts
+        # mapped twins hold strided zero-copy views the ext rejects; a
+        # flags check is ~100 ns vs a raised-and-caught TypeError per probe
+        if e is not None and starts.flags.c_contiguous:
+            return e(starts, self.lengths, x)
+        i = int(np.searchsorted(starts, x, side="right")) - 1
         if i < 0:
             return False
-        return x - int(self.starts[i]) <= int(self.lengths[i])
+        return x - int(starts[i]) <= int(self.lengths[i])
 
     def contains_many(self, values: np.ndarray) -> np.ndarray:
         return _run_contains_many(self, values)

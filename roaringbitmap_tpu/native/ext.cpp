@@ -116,6 +116,58 @@ static PyObject* contains_many(PyObject*, PyObject* args) {
   return out;
 }
 
+// Scalar point-probe fast paths --------------------------------------------
+// One C call does the whole membership test (search + compare + boolean),
+// so the Python side pays a single frame instead of search-then-numpy-index.
+// These exist purely for per-call latency (simplebenchmark contains row;
+// Util.java:697 unsignedBinarySearch serves this role in the JVM).
+
+static PyObject* contains_u16(PyObject*, PyObject* args) {
+  PyObject* ao;
+  int x;
+  if (!PyArg_ParseTuple(args, "Oi", &ao, &x)) return nullptr;
+  const uint16_t* a;
+  int32_t na;
+  if (!as_u16(ao, &a, &na)) return nullptr;
+  int32_t i = rb_advance_until(a, na, -1, (uint16_t)x);
+  if (i < na && a[i] == (uint16_t)x) Py_RETURN_TRUE;
+  Py_RETURN_FALSE;
+}
+
+static PyObject* word_bit(PyObject*, PyObject* args) {
+  PyObject* wo;
+  int x;
+  if (!PyArg_ParseTuple(args, "Oi", &wo, &x)) return nullptr;
+  const uint64_t* w;
+  int64_t nw;
+  if (!as_u64(wo, &w, &nw)) return nullptr;
+  int64_t idx = (int64_t)((uint32_t)x >> 6);
+  if (idx >= nw) {
+    PyErr_SetString(PyExc_IndexError, "bit index beyond word array");
+    return nullptr;
+  }
+  if ((w[idx] >> (x & 63)) & 1) Py_RETURN_TRUE;
+  Py_RETURN_FALSE;
+}
+
+static PyObject* run_contains(PyObject*, PyObject* args) {
+  PyObject *so, *lo;
+  int x;
+  if (!PyArg_ParseTuple(args, "OOi", &so, &lo, &x)) return nullptr;
+  const uint16_t *s, *l;
+  int32_t ns, nl;
+  if (!as_u16(so, &s, &ns) || !as_u16(lo, &l, &nl)) return nullptr;
+  if (ns != nl) {
+    PyErr_SetString(PyExc_ValueError, "starts/lengths size mismatch");
+    return nullptr;
+  }
+  int32_t i = rb_advance_until(s, ns, -1, (uint16_t)x);  // first start >= x
+  if (i < ns && s[i] == (uint16_t)x) Py_RETURN_TRUE;
+  if (i == 0) Py_RETURN_FALSE;
+  if ((uint16_t)x - s[i - 1] <= l[i - 1]) Py_RETURN_TRUE;
+  Py_RETURN_FALSE;
+}
+
 // Word-level kernels -------------------------------------------------------
 
 static PyObject* cardinality_of_words(PyObject*, PyObject* args) {
@@ -233,6 +285,9 @@ static PyMethodDef Methods[] = {
     {"intersect_cardinality", intersect_cardinality, METH_VARARGS, nullptr},
     {"advance_until", advance_until, METH_VARARGS, nullptr},
     {"contains_many", contains_many, METH_VARARGS, nullptr},
+    {"contains_u16", contains_u16, METH_VARARGS, nullptr},
+    {"word_bit", word_bit, METH_VARARGS, nullptr},
+    {"run_contains", run_contains, METH_VARARGS, nullptr},
     {"cardinality_of_words", cardinality_of_words, METH_VARARGS, nullptr},
     {"words_from_values", words_from_values, METH_VARARGS, nullptr},
     {"values_from_words", values_from_words, METH_VARARGS, nullptr},

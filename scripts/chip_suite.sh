@@ -78,6 +78,12 @@ trap commit_artifacts EXIT
   commit_artifacts
   echo "--- BSI north star on chip (10M rows to bound build time)"
   timeout 1800 python -u -m benchmarks.bsi 10000000 2>&1 | grep -v WARNING | tee "$ART/bsi_northstar.jsonl"
+  commit_artifacts
+  echo "--- filtered-ANN (BASELINE config 5: 1M docs, incl. steady-state block)"
+  # tee the per-measurement stdout lines: --json only flushes at the END of
+  # the whole suite, so a timeout kill would leave no artifact at all
+  # (code-review r5)
+  timeout 900 python -u -m benchmarks.run filtered_ann --reps 3 2>&1 | grep -v WARNING | tee "$ART/filtered_ann.jsonl"
   echo "=== chip suite done: $(date -u +%FT%TZ)"
 } >> "$LOG" 2>&1
 cp -f "$LOG" CHIP_SUITE.log 2>/dev/null || true
