@@ -41,6 +41,13 @@ _EXT_PROBES_TRIED = False
 
 
 def _bind_scalar_probes():
+    # callers share one stanza shape (keep it when adding probes):
+    #   e = _EXT_X
+    #   if e is None and not _EXT_PROBES_TRIED:
+    #       _bind_scalar_probes()
+    #       e = _EXT_X
+    # — a helper function here would cost the hot path the very frame the
+    # probes exist to avoid
     global _EXT_CONTAINS, _EXT_WORDBIT, _EXT_RUNCONTAINS, _EXT_ADVANCE
     global _EXT_PROBES_TRIED
     if not _EXT_PROBES_TRIED:
@@ -314,12 +321,13 @@ class ArrayContainer(Container):
     def contains(self, x: int) -> bool:
         c = self.content
         e = _EXT_CONTAINS
-        if e is None:
-            if not _EXT_PROBES_TRIED and (e := _bind_scalar_probes()) is not None:
-                return e(c, x)
-            i = bits.lower_bound(c, x)
-            return bool(i < c.size and c[i] == x)
-        return e(c, x)
+        if e is None and not _EXT_PROBES_TRIED:
+            _bind_scalar_probes()
+            e = _EXT_CONTAINS
+        if e is not None:
+            return e(c, x)
+        i = bits.lower_bound(c, x)
+        return bool(i < c.size and c[i] == x)
 
     def contains_many(self, values: np.ndarray) -> np.ndarray:
         if self.content.size == 0:
@@ -472,13 +480,12 @@ class BitmapContainer(Container):
 
     def contains(self, x: int) -> bool:
         e = _EXT_WORDBIT
-        if e is None:
-            if not _EXT_PROBES_TRIED:
-                _bind_scalar_probes()
-                e = _EXT_WORDBIT
-            if e is None:
-                return bits.get_bit(self.words, x)
-        return e(self.words, x)
+        if e is None and not _EXT_PROBES_TRIED:
+            _bind_scalar_probes()
+            e = _EXT_WORDBIT
+        if e is not None:
+            return e(self.words, x)
+        return bits.get_bit(self.words, x)
 
     def contains_many(self, values: np.ndarray) -> np.ndarray:
         """Vectorized membership mask for uint16 values."""

@@ -15,12 +15,17 @@ for i in $(seq 1 220); do
     HEAD=$(git rev-parse HEAD)
     if [ "$HEAD" != "$LAST_RUN_HEAD" ]; then
       echo "$(date -u +%FT%TZ) tunnel UP (probe $i) — running chip suite at $HEAD" >> /tmp/tunnel_watch.log
-      bash scripts/chip_suite.sh
-      # chip_suite.sh commits its own artifacts, advancing HEAD; record the
-      # post-run HEAD or every probe would see "new" commits and re-run the
-      # multi-hour suite forever (code-review r5)
-      LAST_RUN_HEAD=$(git rev-parse HEAD)
-      echo "$(date -u +%FT%TZ) chip suite finished" >> /tmp/tunnel_watch.log
+      if bash scripts/chip_suite.sh; then
+        # chip_suite.sh commits its own artifacts, advancing HEAD; record
+        # the post-run HEAD or every probe would see "new" commits and
+        # re-run the multi-hour suite forever. Only on success — a
+        # mid-suite death must leave this HEAD eligible for a retry
+        # (code-review r5)
+        LAST_RUN_HEAD=$(git rev-parse HEAD)
+        echo "$(date -u +%FT%TZ) chip suite finished" >> /tmp/tunnel_watch.log
+      else
+        echo "$(date -u +%FT%TZ) chip suite FAILED (will retry this HEAD)" >> /tmp/tunnel_watch.log
+      fi
     else
       echo "$(date -u +%FT%TZ) tunnel up, suite already ran at $HEAD (probe $i)" >> /tmp/tunnel_watch.log
     fi

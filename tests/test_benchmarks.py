@@ -105,3 +105,43 @@ def test_bitset_matrix_retriever():
         back = words_of_bitmap(bm)
         assert np.array_equal(back, r[: back.size])
         assert not np.any(r[back.size :])
+
+
+def test_wah_ewah_codecs_roundtrip():
+    """The formats suite's WAH/EWAH codecs against a dense oracle across
+    density regimes (the wrapper-format implementations must be right
+    before their comparison rows mean anything)."""
+    import numpy as np
+
+    from benchmarks import formats as F
+
+    rng = np.random.default_rng(1)
+    universe = 200_000
+    for density in (0.0, 0.001, 0.3, 0.95):
+        n = int(universe * density)
+        vals = (
+            np.unique(rng.integers(0, universe, n)).astype(np.uint32)
+            if n
+            else np.empty(0, np.uint32)
+        )
+        n_groups = (universe + 30) // 31
+        n_words = (universe + 63) >> 6
+        s = F.wah_encode(vals, n_groups)
+        acc = np.zeros(n_groups, dtype=np.uint32)
+        F.wah_decode_into(s, acc, np.bitwise_or)
+        assert np.array_equal(acc, F._dense_groups(vals, n_groups, 31, np.uint32))
+        e = F.ewah_encode(vals, n_words)
+        acc64 = np.zeros(n_words, dtype=np.uint64)
+        F.ewah_decode_into(e, acc64, np.bitwise_or)
+        assert np.array_equal(acc64, F._dense_groups(vals, n_words, 64, np.uint64))
+        probes = np.sort(rng.integers(0, universe, 500).astype(np.uint32))
+        want = np.isin(probes, vals)
+        assert np.array_equal(F.wah_contains_many(s, probes), want)
+        assert np.array_equal(F.ewah_contains_many(e, probes), want)
+        # AND-fold identity: x AND full-universe == x
+        full = np.arange(universe, dtype=np.uint32)
+        sf = F.wah_encode(full, n_groups)
+        acc = np.full(n_groups, F._WAH_FULL, dtype=np.uint32)
+        F.wah_decode_into(s, acc, np.bitwise_and)
+        F.wah_decode_into(sf, acc, np.bitwise_and)
+        assert np.array_equal(acc, F._dense_groups(vals, n_groups, 31, np.uint32))
