@@ -83,6 +83,20 @@ def run(reps: int = 1, n_keys: int = N_KEYS, **_) -> List[Result]:
         walk_ns = (time.perf_counter_ns() - t0) / max(1, n_walked)
         assert n_walked == len(art)
 
+        # backward shuttle at scale (art/BackwardShuttle.java:1): timing
+        # untraced (tracemalloc hooks every yielded tuple and would inflate
+        # the ns/key 1.3-2x vs the untraced forward number), then a second
+        # traced pass for the O(depth) live-memory bound
+        t0 = time.perf_counter_ns()
+        n_rev = sum(1 for _ in art.items_reverse())
+        rev_ns = (time.perf_counter_ns() - t0) / max(1, n_rev)
+        assert n_rev == n_walked
+        tracemalloc.start()
+        for _ in art.items_reverse():
+            pass
+        rev_peak = tracemalloc.get_traced_memory()[1]
+        tracemalloc.stop()
+
         hist = art.node_width_histogram()
         extra = {
             "n_keys": n_eff,
@@ -90,6 +104,8 @@ def run(reps: int = 1, n_keys: int = N_KEYS, **_) -> List[Result]:
             "hit_ns": round(hit_ns, 1),
             "miss_ns": round(miss_ns, 1),
             "walk_ns_per_key": round(walk_ns, 1),
+            "reverse_walk_ns_per_key": round(rev_ns, 1),
+            "reverse_walk_peak_bytes": int(rev_peak),
             "node_width_histogram": {str(k): v for k, v in hist.items()},
         }
         out.append(Result("artScale_bytesPerKey", f"dist-{dist}", mem / n_eff, "bytes/key", extra))

@@ -260,18 +260,41 @@ class RoaringBitmap:
     def contains_many(self, values) -> np.ndarray:
         """Vectorized membership: bool array aligned with ``values`` (the
         batch analogue of contains; what a retrieval stack calls to filter
-        an ANN candidate list)."""
+        an ANN candidate list).
+
+        One searchsorted against the bitmap's own key array classifies
+        every probe, then each LIVE container answers its probes in one
+        call — iterating the bitmap's (few) keys, not the probes' (many)
+        key groups, so probes landing in absent chunks cost nothing (the
+        workShyAnd pre-filter idea applied to point probes)."""
         v = np.asarray(values, dtype=np.int64).ravel()
         out = np.zeros(v.size, dtype=bool)
         if v.size == 0:
             return out
-        keys = (v >> 16).astype(np.int64)
+        keys = v >> 16
         hlc = self.high_low_container
-        for key, idx in _group_positions(keys):
-            c = hlc.get_container(key)
-            if c is None:
-                continue
-            out[idx] = c.contains_many((v[idx] & 0xFFFF).astype(np.uint16))
+        if len(hlc.keys) > v.size:
+            # many-key bitmap, few probes: classifying probes against the
+            # whole key array would cost more than per-group bisects
+            for key, idx in _group_positions(keys):
+                c = hlc.get_container(int(key))
+                if c is not None:
+                    out[idx] = c.contains_many((v[idx] & 0xFFFF).astype(np.uint16))
+            return out
+        hkeys = np.asarray(hlc.keys, dtype=np.int64)
+        if hkeys.size == 0:
+            return out
+        pos = np.searchsorted(hkeys, keys)
+        pos_c = np.minimum(pos, hkeys.size - 1)
+        hit = hkeys[pos_c] == keys
+        if not hit.any():
+            return out
+        containers = hlc.containers
+        lows = (v & 0xFFFF).astype(np.uint16)
+        hid = np.flatnonzero(hit)
+        for ci, seg in _group_positions(pos_c[hid]):
+            s = hid[seg]
+            out[s] = containers[int(ci)].contains_many(lows[s])
         return out
 
     def rank_many(self, values) -> np.ndarray:
@@ -375,13 +398,17 @@ class RoaringBitmap:
             return FastAggregation.and_(x1, x2, *more)
         out = RoaringBitmap()
         a, b = x1.high_low_container, x2.high_low_container
+        akeys, acont, na = a.keys, a.containers, len(a.keys)
+        bkeys, bcont, nb = b.keys, b.containers, len(b.keys)
+        okeys, ocont = out.high_low_container.keys, out.high_low_container.containers
         ia = ib = 0
-        while ia < a.size and ib < b.size:
-            ka, kb = a.keys[ia], b.keys[ib]
+        while ia < na and ib < nb:
+            ka, kb = akeys[ia], bkeys[ib]
             if ka == kb:
-                c = a.containers[ia].and_(b.containers[ib])
+                c = acont[ia].and_(bcont[ib])
                 if c.cardinality:
-                    out.high_low_container.append(ka, c)
+                    okeys.append(ka)
+                    ocont.append(c)
                 ia += 1
                 ib += 1
             elif ka < kb:
@@ -420,32 +447,40 @@ class RoaringBitmap:
         are at stake)."""
         out = RoaringBitmap()
         a, b = x1.high_low_container, x2.high_low_container
+        # loop-local bindings: the merge touches size/keys/containers every
+        # iteration, and property + attribute hops were a third of or2by2
+        akeys, acont, na = a.keys, a.containers, len(a.keys)
+        bkeys, bcont, nb = b.keys, b.containers, len(b.keys)
+        okeys, ocont = out.high_low_container.keys, out.high_low_container.containers
         ia = ib = 0
-        while ia < a.size and ib < b.size:
-            ka, kb = a.keys[ia], b.keys[ib]
+        while ia < na and ib < nb:
+            ka, kb = akeys[ia], bkeys[ib]
             if ka == kb:
                 c = (
-                    a.containers[ia].or_(b.containers[ib])
+                    acont[ia].or_(bcont[ib])
                     if op == "or"
-                    else a.containers[ia].xor_(b.containers[ib])
+                    else acont[ia].xor_(bcont[ib])
                 )
                 if c.cardinality:
-                    out.high_low_container.append(ka, c)
+                    okeys.append(ka)
+                    ocont.append(c)
                 ia += 1
                 ib += 1
             elif ka < kb:
-                c = a.containers[ia] if reuse_left else a.containers[ia].clone()
-                out.high_low_container.append(ka, c)
+                okeys.append(ka)
+                ocont.append(acont[ia] if reuse_left else acont[ia].clone())
                 ia += 1
             else:
-                out.high_low_container.append(kb, b.containers[ib].clone())
+                okeys.append(kb)
+                ocont.append(bcont[ib].clone())
                 ib += 1
-        while ia < a.size:
-            c = a.containers[ia] if reuse_left else a.containers[ia].clone()
-            out.high_low_container.append(a.keys[ia], c)
+        while ia < na:
+            okeys.append(akeys[ia])
+            ocont.append(acont[ia] if reuse_left else acont[ia].clone())
             ia += 1
-        while ib < b.size:
-            out.high_low_container.append(b.keys[ib], b.containers[ib].clone())
+        while ib < nb:
+            okeys.append(bkeys[ib])
+            ocont.append(bcont[ib].clone())
             ib += 1
         return out
 
@@ -499,18 +534,22 @@ class RoaringBitmap:
         that share containers with live bitmaps."""
         out = RoaringBitmap()
         a, b = x1.high_low_container, x2.high_low_container
+        akeys, acont, na = a.keys, a.containers, len(a.keys)
+        bkeys, bcont, nb = b.keys, b.containers, len(b.keys)
+        okeys, ocont = out.high_low_container.keys, out.high_low_container.containers
         ia = ib = 0
-        while ia < a.size:
-            ka = a.keys[ia]
-            while ib < b.size and b.keys[ib] < ka:
+        while ia < na:
+            ka = akeys[ia]
+            while ib < nb and bkeys[ib] < ka:
                 ib += 1
-            if ib < b.size and b.keys[ib] == ka:
-                c = a.containers[ia].andnot(b.containers[ib])
+            if ib < nb and bkeys[ib] == ka:
+                c = acont[ia].andnot(bcont[ib])
                 if c.cardinality:
-                    out.high_low_container.append(ka, c)
+                    okeys.append(ka)
+                    ocont.append(c)
             else:
-                c = a.containers[ia] if _reuse_left else a.containers[ia].clone()
-                out.high_low_container.append(ka, c)
+                okeys.append(ka)
+                ocont.append(acont[ia] if _reuse_left else acont[ia].clone())
             ia += 1
         return out
 
