@@ -75,6 +75,16 @@ def _as_u16(values) -> np.ndarray:
     return np.asarray(values, dtype=np.uint16)
 
 
+def _wrap_u16(content: np.ndarray) -> "ArrayContainer":
+    """ArrayContainer around an ALREADY-uint16 sorted array (kernel output
+    or a mask/fancy index of existing content) — bypasses __init__'s
+    dtype conversion, which is pure overhead on the pairwise-algebra hot
+    path (~10k container ops per merge on adversarial key sets)."""
+    out = ArrayContainer.__new__(ArrayContainer)
+    out.content = content
+    return out
+
+
 class Container:
     """Abstract chunk over a 16-bit sub-universe (Container.java:19)."""
 
@@ -309,11 +319,7 @@ class ArrayContainer(Container):
         return int((np.diff(self.content.astype(np.int32)) != 1).sum()) + 1
 
     def clone(self) -> "ArrayContainer":
-        # bypass __init__'s dtype validation: content is already uint16
-        # (clone sits on the pairwise-algebra pass-through hot path)
-        out = ArrayContainer.__new__(ArrayContainer)
-        out.content = self.content.copy()
-        return out
+        return _wrap_u16(self.content.copy())
 
     def serialized_size(self) -> int:
         return 2 * self.cardinality  # payload: cardinality uint16s
@@ -377,10 +383,10 @@ class ArrayContainer(Container):
     # pairwise
     def and_(self, other: Container) -> Container:
         if isinstance(other, ArrayContainer):
-            return ArrayContainer(bits.intersect_sorted(self.content, other.content))
+            return _wrap_u16(bits.intersect_sorted(self.content, other.content))
         if isinstance(other, BitmapContainer):
             mask = other.contains_many(self.content)
-            return ArrayContainer(self.content[mask])
+            return _wrap_u16(self.content[mask])
         return other.and_(self)  # run
 
     def or_(self, other: Container) -> Container:
@@ -388,7 +394,7 @@ class ArrayContainer(Container):
             merged = bits.merge_sorted_unique(self.content, other.content)
             if merged.size > ARRAY_MAX_SIZE:
                 return BitmapContainer(bits.words_from_values(merged), int(merged.size))
-            return ArrayContainer(merged)
+            return _wrap_u16(merged)
         return other.or_(self)
 
     def xor_(self, other: Container) -> Container:
@@ -396,16 +402,16 @@ class ArrayContainer(Container):
             out = bits.xor_sorted(self.content, other.content)
             if out.size > ARRAY_MAX_SIZE:
                 return BitmapContainer(bits.words_from_values(out), int(out.size))
-            return ArrayContainer(out)
+            return _wrap_u16(out)
         return other.xor_(self)
 
     def andnot(self, other: Container) -> Container:
         if isinstance(other, ArrayContainer):
-            return ArrayContainer(bits.difference_sorted(self.content, other.content))
+            return _wrap_u16(bits.difference_sorted(self.content, other.content))
         if isinstance(other, BitmapContainer):
             mask = other.contains_many(self.content)
-            return ArrayContainer(self.content[~mask])
-        return ArrayContainer(
+            return _wrap_u16(self.content[~mask])
+        return _wrap_u16(
             self.content[~_run_contains_many(other, self.content)]
         )
 
