@@ -791,7 +791,7 @@ class RunContainer(Container):
 
     def and_(self, other: Container) -> Container:
         if isinstance(other, ArrayContainer):
-            return ArrayContainer(other.content[_run_contains_many(self, other.content)])
+            return _wrap_u16(other.content[_run_contains_many(self, other.content)])
         if isinstance(other, RunContainer):
             return self._interval_binary(other, np.logical_and)
         # run x bitmap: words are the natural shape for the dense side

@@ -50,7 +50,7 @@ class ImmutableRoaringArray:
 
     def __init__(self, bm: "ImmutableRoaringBitmap"):
         self._bm = bm
-        self.keys = bm._keys.tolist()
+        self.keys = bm._keys_list
         self._cache: dict = {}
         self.containers = _LazyContainers(self)
 
@@ -113,7 +113,7 @@ class ImmutableRoaringBitmap:
     zero-copy numpy views into the source buffer.
     """
 
-    __slots__ = ("_buf", "_keys", "_cards", "_types", "_offsets", "_size", "_hlc", "_ro", "_cum")
+    __slots__ = ("_buf", "_keys", "_keys_list", "_cards", "_types", "_offsets", "_size", "_hlc", "_ro", "_cum")
 
     ARRAY, BITMAP, RUN = 0, 1, 2
 
@@ -185,6 +185,10 @@ class ImmutableRoaringBitmap:
         desc = np.frombuffer(buf, dtype="<u2", count=2 * size, offset=pos)
         pos += 4 * size
         self._keys = desc[0::2].astype(np.int64)
+        # Python-list twin for scalar probes: bisect on a list is ~7x
+        # cheaper than a scalar np.searchsorted through the ufunc wrappers,
+        # and the metadata-only memory cost is the mapped design's budget
+        self._keys_list = self._keys.tolist()
         self._cards = desc[1::2].astype(np.int64) + 1
         if size and np.any(np.diff(self._keys) <= 0):
             raise InvalidRoaringFormat("container keys not strictly increasing")
@@ -276,8 +280,9 @@ class ImmutableRoaringBitmap:
         return RunContainer(starts, lengths)
 
     def _key_index(self, key: int) -> int:
-        i = int(np.searchsorted(self._keys, key))
-        return i if i < self._size and self._keys[i] == key else -1
+        keys = self._keys_list
+        i = bisect_left(keys, key)
+        return i if i < self._size and keys[i] == key else -1
 
     # ------------------------------------------------------------------
     # read API (ImmutableBitmapDataProvider surface)
@@ -348,8 +353,14 @@ class ImmutableRoaringBitmap:
         x = int(x)
         if not 0 <= x < 1 << 32:
             return False
-        i = self._key_index(x >> 16)
-        return i >= 0 and self._container(i).contains(x & 0xFFFF)
+        # frame-flat like the heap facade: scalar probes are the mapped
+        # simplebenchmark contains row
+        keys = self._keys_list
+        key = x >> 16
+        i = bisect_left(keys, key)
+        if i == self._size or keys[i] != key:
+            return False
+        return self._container(i).contains(x & 0xFFFF)
 
     def rank(self, x: int) -> int:
         from ..utils.order_stats import bucketed_rank
@@ -357,7 +368,7 @@ class ImmutableRoaringBitmap:
         x = int(x)
         hb, lb = x >> 16, x & 0xFFFF
         return bucketed_rank(
-            self._keys.tolist(),
+            self._keys_list,
             self._cum_cards(),
             hb,
             lambda i: self._container(i).rank(lb),
@@ -367,7 +378,7 @@ class ImmutableRoaringBitmap:
         from ..utils.order_stats import bucketed_select
 
         return bucketed_select(
-            self._keys.tolist(),
+            self._keys_list,
             self._cum_cards(),
             j,
             lambda i, lj: (int(self._keys[i]) << 16) | self._container(i).select(lj),
