@@ -26,7 +26,17 @@ python -m roaringbitmap_tpu.fuzz 500 > /tmp/ci_fuzz.log 2>&1 \
 tail -1 /tmp/ci_fuzz.log
 
 step "bench.py --smoke (end-to-end north-star path, CPU)"
-JAX_PLATFORMS=cpu python bench.py --smoke
+# validate the driver contract, not just the exit code: exactly the keys
+# BENCH_r*.json records, with a sane positive speedup
+JAX_PLATFORMS=cpu python bench.py --smoke | python -c '
+import json, sys
+line = sys.stdin.readlines()[-1]
+r = json.loads(line)
+if set(r) != {"metric", "value", "unit", "vs_baseline"}:
+    raise SystemExit("bench contract: wrong keys %s" % sorted(r))
+if not (r["value"] > 0 and r["vs_baseline"] > 0):
+    raise SystemExit("bench contract: non-positive %s" % r)
+print("bench contract ok (vs_baseline %s)" % r["vs_baseline"])'
 
 step "graft entry + 8-device virtual-mesh dryrun"
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
