@@ -14,6 +14,13 @@ Prints ONE JSON line:
 where value is TPU aggregations/sec over the 10k-bitmap working set and
 vs_baseline is the speedup over the CPU fold (target >= 10x,
 BASELINE.json).
+
+Every run (full and --smoke) also drops a metrics sidecar next to the
+result: BENCH_METRICS.json (override with BENCH_METRICS_OUT; defaults to
+the BENCH_JSON_OUT directory when that is set), the observe/ registry
+snapshot — kernel dispatch counts, layout choices, transfer bytes, span
+histograms — written atomically even when the run dies mid-way.
+scripts/ci.sh fails if the smoke sidecar is missing or schema-invalid.
 """
 
 import json
@@ -104,7 +111,26 @@ def _probe_backend() -> bool:
         time.sleep(min(15.0, max(0.0, remaining)))
 
 
+def _sidecar_path() -> str:
+    """BENCH_METRICS.json next to the run's artifacts: BENCH_METRICS_OUT
+    wins, else the BENCH_JSON_OUT directory, else the working directory."""
+    explicit = os.environ.get("BENCH_METRICS_OUT")
+    if explicit:
+        return explicit
+    json_out = os.environ.get("BENCH_JSON_OUT")
+    if json_out:
+        return os.path.join(os.path.dirname(json_out) or ".", "BENCH_METRICS.json")
+    return "BENCH_METRICS.json"
+
+
 def main():
+    from roaringbitmap_tpu.observe import export as obs_export
+
+    with obs_export.metrics_sidecar(_sidecar_path()):
+        _run()
+
+
+def _run():
     import jax
 
     if not _probe_backend():

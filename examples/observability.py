@@ -1,13 +1,18 @@
 """Execution observability: which engine, layout, and backend served each
 aggregation, how many bytes moved host->device, and where host time went
 (insights.dispatch_counters + tracing; the reference's introspection-only
-story extended to the device runtime)."""
+story extended to the device runtime).
+
+Since ISSUE 1 everything records into the unified ``observe`` registry —
+the legacy facades below still work unchanged, and the same numbers export
+as Prometheus text, JSONL, or an atomic JSON sidecar for scrapers and CI.
+"""
 
 import json
 
 import numpy as np
 
-from roaringbitmap_tpu import FastAggregation, RoaringBitmap, insights, tracing
+from roaringbitmap_tpu import FastAggregation, RoaringBitmap, insights, observe, tracing
 
 
 def main():
@@ -19,14 +24,26 @@ def main():
         RoaringBitmap(rng.choice(1 << 21, size=20_000, replace=False).astype(np.uint32))
         for _ in range(64)
     ]
-    union = FastAggregation.or_(*bms, mode="device")
+    with observe.span("examples.observability"):  # nested under this span
+        union = FastAggregation.or_(*bms, mode="device")
     print("union cardinality:", union.get_cardinality())
 
+    # the legacy facades: unchanged shapes, now registry-backed
     counters = insights.dispatch_counters()
     print("kernel dispatch:", counters["kernel"])  # pallas vs xla per shape class
     print("layout chosen:", counters["layout"])  # padded vs segmented-scan
     print("bytes shipped:", counters["transfer_bytes"])
     print("host phases:", json.dumps(tracing.timings(), indent=2))
+
+    # the registry itself: nested span paths and machine-readable exports
+    print("span paths:", sorted(observe.span_timings()))
+    prom = observe.prometheus_text()
+    print("prometheus exposition:", len(prom.splitlines()), "lines, e.g.")
+    print("\n".join(l for l in prom.splitlines() if l.startswith("rb_tpu_store_layout")))
+    observe.write_jsonl("/tmp/rb_tpu_metrics.jsonl")
+    with observe.metrics_sidecar("/tmp/rb_tpu_metrics_sidecar.json"):
+        pass  # snapshot written atomically on exit — bench.py wraps its whole run
+    print("wrote /tmp/rb_tpu_metrics.jsonl and /tmp/rb_tpu_metrics_sidecar.json")
 
 
 if __name__ == "__main__":

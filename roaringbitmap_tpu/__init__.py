@@ -59,6 +59,8 @@ from .parallel.batch import (
 )
 from . import insights
 from . import fuzz
+from . import observe
+from . import tracing
 
 __version__ = "0.1.0"
 
@@ -103,4 +105,6 @@ __all__ = [
     "pairwise_jaccard",
     "insights",
     "fuzz",
+    "observe",
+    "tracing",
 ]

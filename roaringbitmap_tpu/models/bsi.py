@@ -972,9 +972,9 @@ def _o_neil_counts_batched(slices_w, bits_mat, ebm_w, fixed_w, op_name: str):
 
         fn = jax.jit(jax.vmap(one, in_axes=(None, 0, None, None)))
         _o_neil_many_jits[op_name] = fn
-    from ..ops.pallas_kernels import DISPATCH_COUNTS
+    from ..ops.pallas_kernels import _DISPATCH_TOTAL
 
-    DISPATCH_COUNTS[("oneil_batched", "xla_vmap")] += 1
+    _DISPATCH_TOTAL.inc(1, ("oneil_batched", "xla_vmap"))
     return fn(slices_w, bits_mat, ebm_w, fixed_w)
 
 
@@ -983,10 +983,10 @@ def _mesh_batched_counts(mesh, slices_w, bits, ebm_w, fixed_w, op_name):
     pad the chunk axis up to the containers-axis size with empty chunks
     (zero ebm/fixed words contribute nothing for every op incl. NEQ), run
     the sharded vmapped walk, drop the padding columns."""
-    from ..ops.pallas_kernels import DISPATCH_COUNTS
+    from ..ops.pallas_kernels import _DISPATCH_TOTAL
     from ..parallel import sharding
 
-    DISPATCH_COUNTS[("oneil_batched", "mesh")] += 1
+    _DISPATCH_TOTAL.inc(1, ("oneil_batched", "mesh"))
     k_orig = ebm_w.shape[0]
     s3, e2, f2 = _pad_chunk_axis(mesh, slices_w, ebm_w, fixed_w)
     cards = sharding.distributed_bsi_counts_many(mesh, op_name)(s3, bits, e2, f2)

@@ -1,0 +1,92 @@
+"""Unified metrics & span subsystem (ISSUE 1).
+
+One registry, three metric kinds, two exporters, one sidecar::
+
+    instrumented layers                         observe/
+    ────────────────────────────────            ─────────────────────────────
+    kernel dispatch + probes  (ops/pallas_kernels) ─┐
+    layout, transfers, cache  (parallel/store)      ├─► registry.REGISTRY ─► export:
+    pairwise engines          (parallel/batch)      │   (Counter/Gauge/       Prometheus text,
+    wire-format bytes         (serialization)       │    Histogram,           JSONL,
+    host phases + spans       (tracing + spans)    ─┘    snapshot/reset)      metrics_sidecar(path)
+                                                             │
+                               legacy facades (shapes unchanged):
+                               insights.dispatch_counters(), tracing.timings()
+
+Metric naming convention: ``rb_tpu_<layer>_<name>`` (canonical names in
+``registry.py``). Pure stdlib — importable before (and without) jax.
+"""
+
+from .registry import (
+    BATCH_PAIRWISE_TOTAL,
+    DEFAULT_TIME_BUCKETS,
+    HOST_OP_SECONDS,
+    KERNEL_DISPATCH_TOTAL,
+    KERNEL_PROBE_TOTAL,
+    REGISTRY,
+    SERIAL_BYTES_TOTAL,
+    SPAN_SECONDS,
+    STORE_LAYOUT_TOTAL,
+    STORE_RESIDENT_BYTES,
+    STORE_TRANSFER_BYTES_TOTAL,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricError,
+    Registry,
+    counter,
+    gauge,
+    histogram,
+    reset,
+    snapshot,
+)
+from .compat import CounterMap
+from .spans import current_path, depth, reset_spans, span, span_timings
+from .export import (
+    SIDECAR_SCHEMA,
+    jsonl_lines,
+    metrics_sidecar,
+    prometheus_text,
+    sidecar_snapshot,
+    to_jsonl,
+    write_jsonl,
+    write_prometheus,
+)
+
+__all__ = [
+    "REGISTRY",
+    "Registry",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricError",
+    "CounterMap",
+    "counter",
+    "gauge",
+    "histogram",
+    "snapshot",
+    "reset",
+    "span",
+    "span_timings",
+    "current_path",
+    "depth",
+    "reset_spans",
+    "metrics_sidecar",
+    "sidecar_snapshot",
+    "prometheus_text",
+    "to_jsonl",
+    "jsonl_lines",
+    "write_jsonl",
+    "write_prometheus",
+    "SIDECAR_SCHEMA",
+    "DEFAULT_TIME_BUCKETS",
+    "KERNEL_DISPATCH_TOTAL",
+    "KERNEL_PROBE_TOTAL",
+    "STORE_LAYOUT_TOTAL",
+    "STORE_TRANSFER_BYTES_TOTAL",
+    "STORE_RESIDENT_BYTES",
+    "BATCH_PAIRWISE_TOTAL",
+    "SERIAL_BYTES_TOTAL",
+    "HOST_OP_SECONDS",
+    "SPAN_SECONDS",
+]

@@ -1,0 +1,81 @@
+"""Legacy-facade adapters: the pre-registry module globals
+(``pallas_kernels.DISPATCH_COUNTS``, ``store.LAYOUT_COUNTS``,
+``store.TRANSFER_BYTES``, ``batch.PAIRWISE_COUNTS``) were
+``collections.Counter`` objects that tests and tooling read directly.
+``CounterMap`` keeps that mapping interface while storing every value in a
+labeled registry ``Counter`` — so ``insights.dispatch_counters()`` and
+direct readers see exactly the pre-migration shapes, and the registry
+exporters see the same numbers under their canonical metric names.
+
+Writers inside this package go through ``Counter.inc`` (atomic under the
+registry lock); ``CounterMap.__setitem__`` exists only so external code
+that still does ``COUNTS[key] += 1`` keeps working (that read-modify-write
+is exactly as racy as the ``collections.Counter`` it replaces — no worse,
+and migrating to ``inc`` fixes it)."""
+
+from __future__ import annotations
+
+from collections.abc import MutableMapping
+from typing import Iterator, Tuple, Union
+
+from .registry import Counter
+
+Key = Union[str, Tuple[str, ...]]
+
+
+class CounterMap(MutableMapping):
+    """``collections.Counter``-compatible view over one labeled registry
+    Counter. ``scalar=True`` maps bare-string keys onto a single-label
+    metric; otherwise keys are tuples aligned with the metric's
+    labelnames."""
+
+    def __init__(self, metric: Counter, scalar: bool = False):
+        if scalar and len(metric.labelnames) != 1:
+            raise ValueError(
+                f"scalar CounterMap needs a 1-label metric, "
+                f"{metric.name} has {metric.labelnames}"
+            )
+        self._metric = metric
+        self._scalar = scalar
+
+    @property
+    def metric(self) -> Counter:
+        return self._metric
+
+    def _lv(self, key: Key) -> Tuple[str, ...]:
+        return (str(key),) if self._scalar else tuple(str(k) for k in key)
+
+    def _key(self, lv: Tuple[str, ...]) -> Key:
+        return lv[0] if self._scalar else lv
+
+    def __getitem__(self, key: Key):
+        # Counter semantics: a missing key reads as 0 and is not created
+        return self._metric.get(self._lv(key))
+
+    def __setitem__(self, key: Key, value) -> None:
+        self._metric.set(value, self._lv(key))
+
+    def __delitem__(self, key: Key) -> None:
+        self._metric.remove(self._lv(key))
+
+    def __contains__(self, key) -> bool:
+        try:
+            lv = self._lv(key)
+        except TypeError:
+            return False
+        return lv in self._metric.series()
+
+    def __iter__(self) -> Iterator[Key]:
+        return iter([self._key(lv) for lv in self._metric.series()])
+
+    def __len__(self) -> int:
+        return len(self._metric.series())
+
+    def items(self):
+        return [(self._key(lv), v) for lv, v in self._metric.series().items()]
+
+    def clear(self) -> None:
+        self._metric.clear()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging nicety
+        return f"CounterMap({self._metric.name}, {dict(self.items())!r})"

@@ -1,0 +1,200 @@
+"""One-call exporters for the metrics registry: JSONL, Prometheus text
+exposition, and the bench/CI metrics sidecar.
+
+* ``jsonl_lines()`` / ``write_jsonl(path)`` — one JSON object per line per
+  series (counters/gauges carry ``value``; histograms carry ``count``,
+  ``sum``, and cumulative ``buckets``). The shape log shippers ingest
+  without a schema.
+* ``prometheus_text()`` / ``write_prometheus(path)`` — the Prometheus
+  text exposition format (``# HELP``/``# TYPE`` + samples; histograms as
+  ``_bucket{le=...}``/``_sum``/``_count``), scrapeable by a node exporter
+  textfile collector or pushgateway.
+* ``sidecar_snapshot()`` / ``metrics_sidecar(path)`` — the structured
+  summary bench.py drops next to its result line (BENCH_METRICS.json):
+  top-level ``kernel``/``layout``/``transfer_bytes``/``spans`` keys (the
+  contract scripts/ci.sh validates) plus the full registry snapshot. The
+  context manager writes atomically (tmp file + os.replace) on exit, even
+  when the enclosed block raises — a crashed bench still leaves its
+  telemetry behind.
+
+Everything is a pure function of a ``Registry`` (default: the process
+registry), so golden-format tests run against a private registry.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import tempfile
+import time
+from typing import Iterator, List, Optional
+
+from . import registry as _registry
+from .registry import Histogram, Registry, format_le
+
+SIDECAR_SCHEMA = "rb_tpu_metrics/1"
+
+
+def _reg(registry: Optional[Registry]) -> Registry:
+    return _registry.REGISTRY if registry is None else registry
+
+
+# ---------------------------------------------------------------------------
+# JSONL
+# ---------------------------------------------------------------------------
+
+
+def jsonl_lines(registry: Optional[Registry] = None) -> List[str]:
+    """One compact JSON object per metric series, in sorted name order."""
+    lines = []
+    for name, m in sorted(_reg(registry).snapshot().items()):
+        for s in m["samples"]:
+            rec = {"name": name, "type": m["type"], "labels": s["labels"]}
+            if m["type"] == "histogram":
+                rec.update(count=s["count"], sum=s["sum"], buckets=s["buckets"])
+            else:
+                rec["value"] = s["value"]
+            lines.append(json.dumps(rec, sort_keys=True))
+    return lines
+
+
+def to_jsonl(registry: Optional[Registry] = None) -> str:
+    lines = jsonl_lines(registry)
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def write_jsonl(path: str, registry: Optional[Registry] = None) -> None:
+    _atomic_write(path, to_jsonl(registry))
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+
+def _escape_help(s: str) -> str:
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label(s: str) -> str:
+    return s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _label_str(labels: dict, extra: Optional[str] = None) -> str:
+    parts = [f'{k}="{_escape_label(v)}"' for k, v in labels.items()]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def prometheus_text(registry: Optional[Registry] = None) -> str:
+    """The text exposition format, empty-series metrics included (HELP/TYPE
+    only) so a scrape always shows what *could* be reported."""
+    out: List[str] = []
+    for m in _reg(registry).metrics():
+        out.append(f"# HELP {m.name} {_escape_help(m.help)}")
+        out.append(f"# TYPE {m.name} {m.kind}")
+        if isinstance(m, Histogram):
+            for lv, st in sorted(m.series().items()):
+                labels = dict(zip(m.labelnames, lv))
+                cum = 0
+                for le, n in zip(m.buckets, st["slots"]):
+                    cum += n
+                    le_attr = 'le="%s"' % format_le(le)
+                    out.append(f"{m.name}_bucket{_label_str(labels, le_attr)} {cum}")
+                inf_attr = 'le="+Inf"'
+                out.append(
+                    f"{m.name}_bucket{_label_str(labels, inf_attr)} {st['count']}"
+                )
+                out.append(f"{m.name}_sum{_label_str(labels)} {st['sum']}")
+                out.append(f"{m.name}_count{_label_str(labels)} {st['count']}")
+        else:
+            for lv, v in sorted(m.series().items()):
+                labels = dict(zip(m.labelnames, lv))
+                out.append(f"{m.name}{_label_str(labels)} {v}")
+    return "\n".join(out) + ("\n" if out else "")
+
+
+def write_prometheus(path: str, registry: Optional[Registry] = None) -> None:
+    _atomic_write(path, prometheus_text(registry))
+
+
+# ---------------------------------------------------------------------------
+# bench/CI sidecar
+# ---------------------------------------------------------------------------
+
+
+def _counter_map(snap: dict, name: str, joined: bool = False) -> dict:
+    """Flatten one counter's samples to {key: value}; multi-label keys are
+    /-joined (the legacy ``insights.dispatch_counters()`` rendering)."""
+    m = snap.get(name)
+    if m is None:
+        return {}
+    out = {}
+    for s in m["samples"]:
+        vals = [s["labels"][n] for n in m["labelnames"]]
+        key = "/".join(vals) if (joined or len(vals) != 1) else vals[0]
+        out[key] = s["value"]
+    return out
+
+
+def _histogram_timings(snap: dict, name: str) -> dict:
+    m = snap.get(name)
+    if m is None:
+        return {}
+    out = {}
+    for s in m["samples"]:
+        c, total = s["count"], s["sum"]
+        key = "/".join(s["labels"][n] for n in m["labelnames"])
+        out[key] = {
+            "count": c,
+            "total_s": round(total, 6),
+            "mean_ms": round(total / c * 1e3, 3) if c else 0.0,
+        }
+    return out
+
+
+def sidecar_snapshot(registry: Optional[Registry] = None) -> dict:
+    """The structured summary the bench sidecar persists. Top-level keys
+    ``kernel``/``layout``/``transfer_bytes``/``spans`` are the contract
+    scripts/ci.sh enforces; the full registry snapshot rides along under
+    ``registry`` for anything the summary flattens away."""
+    snap = _reg(registry).snapshot()
+    return {
+        "schema": SIDECAR_SCHEMA,
+        "generated_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "kernel": _counter_map(snap, _registry.KERNEL_DISPATCH_TOTAL, joined=True),
+        "layout": _counter_map(snap, _registry.STORE_LAYOUT_TOTAL),
+        "transfer_bytes": _counter_map(snap, _registry.STORE_TRANSFER_BYTES_TOTAL),
+        "pairwise": _counter_map(snap, _registry.BATCH_PAIRWISE_TOTAL),
+        "serial_bytes": _counter_map(snap, _registry.SERIAL_BYTES_TOTAL),
+        "probes": _counter_map(snap, _registry.KERNEL_PROBE_TOTAL, joined=True),
+        "timings": _histogram_timings(snap, _registry.HOST_OP_SECONDS),
+        "spans": _histogram_timings(snap, _registry.SPAN_SECONDS),
+        "registry": snap,
+    }
+
+
+@contextlib.contextmanager
+def metrics_sidecar(path: str, registry: Optional[Registry] = None) -> Iterator[str]:
+    """Atomically write ``sidecar_snapshot()`` to ``path`` when the block
+    exits — success OR failure, so crashed runs keep their telemetry."""
+    try:
+        yield path
+    finally:
+        _atomic_write(path, json.dumps(sidecar_snapshot(registry), indent=1) + "\n")
+
+
+def _atomic_write(path: str, content: str) -> None:
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".metrics.", suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(content)
+        os.replace(tmp, path)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise

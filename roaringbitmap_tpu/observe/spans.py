@@ -1,0 +1,100 @@
+"""Nested span tracer: wall-time histograms per span *path*.
+
+A span is a named region of host execution. Spans nest: each thread keeps
+a stack, and a span's histogram label is its ``/``-joined path from the
+stack root ("store.reduce.padded/kernel.probe.grouped"), so after a run
+the registry answers not only "how long did packing take" but "packing
+under which caller". Recording happens in the registry histogram
+``rb_tpu_span_seconds`` (observe/registry.py) — ``snapshot()``, the JSONL
+and Prometheus exporters, and the bench sidecar all see spans with no
+extra wiring.
+
+``span(name, trace=True)`` additionally opens a
+``jax.profiler.TraceAnnotation`` so the same region shows up as a named
+span in XProf/TensorBoard device traces — the composition point with the
+pre-existing ``tracing.annotate`` path (which now routes through here).
+Only ``ImportError``/``AttributeError`` (jax missing or stripped) disable
+the annotation; a real TraceAnnotation failure propagates.
+
+Thread-local stacks mean concurrent spans never corrupt each other's
+paths; the histogram itself is locked by the registry.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Iterator, List
+
+from . import registry as _registry
+
+SPAN_SECONDS = _registry.histogram(
+    _registry.SPAN_SECONDS,
+    "Wall time of nested host spans, labeled by /-joined span path",
+    ("name",),
+)
+
+_local = threading.local()
+
+
+def _stack() -> List[str]:
+    try:
+        return _local.stack
+    except AttributeError:
+        _local.stack = []
+        return _local.stack
+
+
+def current_path() -> str:
+    """The /-joined path of the innermost active span ("" outside any)."""
+    return "/".join(_stack())
+
+
+def depth() -> int:
+    """How many spans are open on this thread."""
+    return len(_stack())
+
+
+@contextlib.contextmanager
+def span(name: str, trace: bool = False) -> Iterator[str]:
+    """Time the enclosed block under ``name`` nested below the active span.
+
+    Yields the full span path. ``trace=True`` also opens a
+    ``jax.profiler.TraceAnnotation(name)`` when jax is importable."""
+    ctx = contextlib.nullcontext()
+    if trace:
+        try:
+            import jax
+
+            ctx = jax.profiler.TraceAnnotation(name)
+        except (ImportError, AttributeError):  # jax missing or stripped build
+            pass
+    stack = _stack()
+    stack.append(name)
+    path = "/".join(stack)
+    t0 = time.perf_counter()
+    try:
+        with ctx:
+            yield path
+    finally:
+        stack.pop()
+        SPAN_SECONDS.observe(time.perf_counter() - t0, (path,))
+
+
+def span_timings() -> dict:
+    """{path: {count, total_s, mean_ms}} over all recorded spans — the
+    shape ``tracing.timings()`` uses, keyed by nested path."""
+    out = {}
+    for (path,), st in sorted(SPAN_SECONDS.series().items()):
+        c, total = st["count"], st["sum"]
+        out[path] = {
+            "count": c,
+            "total_s": round(total, 6),
+            "mean_ms": round(total / c * 1e3, 3) if c else 0.0,
+        }
+    return out
+
+
+def reset_spans() -> None:
+    SPAN_SECONDS.clear()

@@ -26,7 +26,6 @@ call (insights.dispatch_counters).
 from __future__ import annotations
 
 import functools
-from collections import Counter
 from typing import Dict, Tuple
 
 import jax
@@ -34,6 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from . import device as dev
+from .. import observe as _observe
 
 try:  # pallas is optional at import time (e.g. stripped CPU envs)
     from jax.experimental import pallas as pl
@@ -49,8 +49,22 @@ ROW_TILE = 256
 G_TILE = 8  # groups per grid step; Mosaic needs the second-minor block dim % 8 == 0
 G_ROW_TILE = 64
 
-# dispatch observability: ("wide"|"grouped", "pallas"|"xla") -> count
-DISPATCH_COUNTS: Counter = Counter()
+# dispatch observability: ("wide"|"grouped"|..., "pallas"|"xla"|...) -> count.
+# Registry-backed (rb_tpu_kernel_dispatch_total) since ISSUE 1; this module
+# increments the metric directly, the CounterMap keeps the legacy mapping
+# interface for insights.dispatch_counters() and external readers.
+_DISPATCH_TOTAL = _observe.counter(
+    _observe.KERNEL_DISPATCH_TOTAL,
+    "Device aggregation dispatches by (kind, engine)",
+    ("kind", "engine"),
+)
+DISPATCH_COUNTS = _observe.CounterMap(_DISPATCH_TOTAL)
+# per-(kind, op, backend) probe conclusions; shape detail stays in _PROBED
+_PROBE_TOTAL = _observe.counter(
+    _observe.KERNEL_PROBE_TOTAL,
+    "Pallas lowering-probe conclusions by (kind, op, backend, outcome)",
+    ("kind", "op", "backend", "outcome"),
+)
 # lowering probe results: (kind, op, shape, backend) -> bool
 _PROBED: Dict[Tuple, bool] = {}
 
@@ -555,9 +569,9 @@ def best_segmented_reduce(words, seg_start, op: str = "or"):
     if HAS_PALLAS and on_tpu():
         out = _probed_call("segmented", segmented_reduce_pallas, (words, seg_start), op)
         if out is not None:
-            DISPATCH_COUNTS[("segmented", "pallas")] += 1
+            _DISPATCH_TOTAL.inc(1, ("segmented", "pallas"))
             return out
-    DISPATCH_COUNTS[("segmented", "xla")] += 1
+    _DISPATCH_TOTAL.inc(1, ("segmented", "xla"))
     return dev.segmented_reduce(words, seg_start, op=op)
 
 
@@ -716,9 +730,9 @@ def best_oneil_compare(slices_w, bits_rev, ebm_w, fixed_w, op_name: str):
             "oneil", oneil_compare_pallas, (slices_w, bits_rev, ebm_w, fixed_w), op_name
         )
         if out is not None:
-            DISPATCH_COUNTS[("oneil", "pallas")] += 1
+            _DISPATCH_TOTAL.inc(1, ("oneil", "pallas"))
             return out
-    DISPATCH_COUNTS[("oneil", "xla")] += 1
+    _DISPATCH_TOTAL.inc(1, ("oneil", "xla"))
     from ..models.bsi import _o_neil_compare_fused
 
     return _o_neil_compare_fused(slices_w, bits_rev, ebm_w, fixed_w, op_name)
@@ -745,18 +759,24 @@ def _probed_call(kind: str, fn, args, op: str, key_extra: Tuple = ()):
     key_extra]) key bad so subsequent calls go straight to XLA —
     ``key_extra`` carries the dispatcher's tiling config so changing it
     re-probes instead of reusing a stale verdict."""
-    key = (kind, op, tuple(args[0].shape), jax.default_backend(), *key_extra)
+    backend = jax.default_backend()
+    key = (kind, op, tuple(args[0].shape), backend, *key_extra)
     ok = _PROBED.get(key)
     if ok is False:
         return None
     try:
         out = fn(*args, op=op)
         if ok is None:
-            jax.block_until_ready(out)
+            from .. import tracing
+
+            with tracing.op_timer(f"kernel.probe.{kind}"):
+                jax.block_until_ready(out)
             _PROBED[key] = True
+            _PROBE_TOTAL.inc(1, (kind, str(op), backend, "ok"))
         return out
     except Exception:
         _PROBED[key] = False
+        _PROBE_TOTAL.inc(1, (kind, str(op), backend, "failed"))
         return None
 
 
@@ -831,12 +851,12 @@ def best_wide_reduce(words, op: str = "or"):
                 key_extra=key_extra,
             )
             if out is not None:
-                DISPATCH_COUNTS[("wide", "pallas")] += 1
+                _DISPATCH_TOTAL.inc(1, ("wide", "pallas"))
                 return out
         elif policy == "two_stage":
-            DISPATCH_COUNTS[("wide", "two_stage")] += 1
+            _DISPATCH_TOTAL.inc(1, ("wide", "two_stage"))
             return dev.wide_reduce_two_stage(words, op=op, **WIDE_CONFIG)
-    DISPATCH_COUNTS[("wide", "xla")] += 1
+    _DISPATCH_TOTAL.inc(1, ("wide", "xla"))
     return dev.wide_reduce_with_cardinality(words, op=op)
 
 
@@ -873,7 +893,7 @@ def best_grouped_reduce(words3, op: str = "or"):
             key_extra=key_extra,
         )
         if out is not None:
-            DISPATCH_COUNTS[("grouped", "pallas")] += 1
+            _DISPATCH_TOTAL.inc(1, ("grouped", "pallas"))
             return out
-    DISPATCH_COUNTS[("grouped", "xla")] += 1
+    _DISPATCH_TOTAL.inc(1, ("grouped", "xla"))
     return dev.grouped_reduce_with_cardinality(words3, op=op)

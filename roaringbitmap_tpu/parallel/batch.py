@@ -12,18 +12,24 @@ Host marshal is O(total values); results come back either as counts
 
 from __future__ import annotations
 
-from collections import Counter
 from typing import List, Sequence
 
 import numpy as np
 
+from .. import observe as _observe
 from ..models.roaring import RoaringBitmap
 from ..ops import device as dev
 from . import store
 
 # observability: which engine served each pairwise-matrix dispatch
-# ("mxu" | "vpu"), surfaced via insights.dispatch_counters()["pairwise"]
-PAIRWISE_COUNTS: Counter = Counter()
+# ("mxu" | "vpu"), surfaced via insights.dispatch_counters()["pairwise"].
+# Registry-backed since ISSUE 1 (rb_tpu_batch_pairwise_total).
+_PAIRWISE_TOTAL = _observe.counter(
+    _observe.BATCH_PAIRWISE_TOTAL,
+    "Pairwise-matrix dispatches by engine (mxu | vpu)",
+    ("impl",),
+)
+PAIRWISE_COUNTS = _observe.CounterMap(_PAIRWISE_TOTAL, scalar=True)
 
 
 def _pack_one_vs_many(one: RoaringBitmap, many: Sequence[RoaringBitmap]):
@@ -106,8 +112,11 @@ def prepare_batched_cardinality(
     step = _step(op, cards_only=True)
 
     def run() -> np.ndarray:
-        row_cards = np.asarray(step(batch, filt)).astype(np.int64)
-        return row_cards.sum(axis=1)
+        from .. import tracing
+
+        with tracing.op_timer(f"batch.one_vs_many.{op}"):
+            row_cards = np.asarray(step(batch, filt)).astype(np.int64)
+            return row_cards.sum(axis=1)
 
     run.device_tensors = (batch, filt)
     run.step = step
@@ -290,24 +299,27 @@ def pairwise_and_cardinality(
             "impl='mxu' needs every cardinality < 2^31 (int32 accumulation "
             "exactness); use impl='vpu' or 'auto' for larger sets"
         )
+    from .. import tracing
+
     kidx = {k: i for i, k in enumerate(keys)}
     lw = _pack_sets(lefts, keys, kidx)
     rw_host = _pack_sets(rights, keys, kidx)
-    PAIRWISE_COUNTS[impl] += 1
-    if impl == "mxu":
-        return (
-            np.asarray(_pairwise_mxu_step()(jnp.asarray(lw), jnp.asarray(rw_host)))
-            .astype(np.int64)
-        )
-    rw = jnp.asarray(rw_host)
-    step = _pairwise_step()
-    per_row = 4 * m * len(keys) * dev.DEVICE_WORDS
-    nb = max(1, min(n, tile_bytes // max(1, per_row)))
-    out = np.empty((n, m), dtype=np.int64)
-    for s in range(0, n, nb):
-        per_key = np.asarray(step(jnp.asarray(lw[s : s + nb]), rw))
-        out[s : s + nb] = per_key.astype(np.int64).sum(axis=2)
-    return out
+    _PAIRWISE_TOTAL.inc(1, (impl,))
+    with tracing.op_timer(f"batch.pairwise.{impl}"):
+        if impl == "mxu":
+            return (
+                np.asarray(_pairwise_mxu_step()(jnp.asarray(lw), jnp.asarray(rw_host)))
+                .astype(np.int64)
+            )
+        rw = jnp.asarray(rw_host)
+        step = _pairwise_step()
+        per_row = 4 * m * len(keys) * dev.DEVICE_WORDS
+        nb = max(1, min(n, tile_bytes // max(1, per_row)))
+        out = np.empty((n, m), dtype=np.int64)
+        for s in range(0, n, nb):
+            per_key = np.asarray(step(jnp.asarray(lw[s : s + nb]), rw))
+            out[s : s + nb] = per_key.astype(np.int64).sum(axis=2)
+        return out
 
 
 def _inclusion_exclusion(op: str, inter: np.ndarray, lefts, rights) -> np.ndarray:

@@ -18,22 +18,41 @@ the best container form when streamed back (best_container_of_words, the
 
 from __future__ import annotations
 
-from collections import Counter
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 import jax.numpy as jnp
 
-# layout observability: ("padded"|"bucketed"|"segmented-scan") -> count
-# (insights.dispatch_counters)
-LAYOUT_COUNTS: Counter = Counter()
+from .. import observe as _observe
+
+# layout observability: ("padded"|"bucketed"|"segmented-scan") -> count.
+# Registry-backed since ISSUE 1 (rb_tpu_store_layout_total); the CounterMap
+# keeps the legacy mapping shape for insights.dispatch_counters().
+_LAYOUT_TOTAL = _observe.counter(
+    _observe.STORE_LAYOUT_TOTAL,
+    "prepare_reduce layout choices (padded | bucketed | segmented-scan)",
+    ("layout",),
+)
+LAYOUT_COUNTS = _observe.CounterMap(_LAYOUT_TOTAL, scalar=True)
 # default ragged-batch bucket count for the prepare_reduce cost model;
 # bench.py reuses it so reported occupancy always describes the production
 # bucketing
 DEFAULT_BUCKETS = 3
 # host->device transfer accounting in bytes (insights.dispatch_counters)
-TRANSFER_BYTES: Counter = Counter()
+_TRANSFER_TOTAL = _observe.counter(
+    _observe.STORE_TRANSFER_BYTES_TOTAL,
+    "Host->device transfer bytes by route (device-built blocks tracked "
+    "under their own route so the ledger stays truthful)",
+    ("route",),
+)
+TRANSFER_BYTES = _observe.CounterMap(_TRANSFER_TOTAL, scalar=True)
+# bytes of device-resident working-set tensors cached by PackedGroups
+_RESIDENT_BYTES = _observe.gauge(
+    _observe.STORE_RESIDENT_BYTES,
+    "Device-resident cached working-set bytes by layout kind",
+    ("kind",),
+)
 
 from ..models.container import ArrayContainer, BitmapContainer, Container
 from ..models.roaring import RoaringBitmap
@@ -117,13 +136,35 @@ class PackedGroups:
     def n_groups(self) -> int:
         return len(self.group_keys)
 
+    def _account_resident(self, kind: str, nbytes: int) -> None:
+        """Track this working set's cached device bytes so the resident
+        gauge goes back DOWN when the PackedGroups (and with it the cached
+        arrays) is freed — a rise-only gauge would report cumulative bytes
+        ever cached, not what is resident now."""
+        held = getattr(self, "_resident_held", None)
+        if held is None:
+            held = {}
+            object.__setattr__(self, "_resident_held", held)
+        held[kind] = held.get(kind, 0) + int(nbytes)
+        _RESIDENT_BYTES.inc(int(nbytes), (kind,))
+
+    def __del__(self):
+        held = getattr(self, "_resident_held", None)
+        if held:
+            try:
+                for kind, nbytes in held.items():
+                    _RESIDENT_BYTES.dec(nbytes, (kind,))
+            except Exception:  # pragma: no cover - interpreter teardown
+                pass
+
     @property
     def device_words(self) -> jnp.ndarray:
         """The flat rows on device (transferred once, then cached)."""
         d = getattr(self, "_device_words", None)
         if d is None:
             d = jnp.asarray(self.words)
-            TRANSFER_BYTES["flat_rows"] += self.words.nbytes
+            _TRANSFER_TOTAL.inc(self.words.nbytes, ("flat_rows",))
+            self._account_resident("flat_rows", self.words.nbytes)
             object.__setattr__(self, "_device_words", d)
         return d
 
@@ -143,7 +184,8 @@ class PackedGroups:
                 cache[key] = None
             else:
                 cache[key] = jnp.asarray(host)
-                TRANSFER_BYTES["padded_groups"] += host.nbytes
+                _TRANSFER_TOTAL.inc(host.nbytes, ("padded_groups",))
+                self._account_resident("padded_groups", host.nbytes)
         return cache[key]
 
     def plan_buckets(self, n_buckets: int = 3) -> List[np.ndarray]:
@@ -220,7 +262,8 @@ class PackedGroups:
                     ).reshape(g_b, m_b, dev.DEVICE_WORDS)
                     # no host->device transfer happened here; tracked under
                     # its own key so the transfer ledger stays truthful
-                    TRANSFER_BYTES["padded_buckets_built_on_device"] += int(arr.nbytes)
+                    _TRANSFER_TOTAL.inc(int(arr.nbytes), ("padded_buckets_built_on_device",))
+                    self._account_resident("padded_buckets", int(arr.nbytes))
                 else:
                     # CPU backend: a host fill + alias is faster than an
                     # eager gather (an OR fill allocates its zero pages
@@ -235,7 +278,8 @@ class PackedGroups:
                             self.words[src]
                         )
                     arr = jnp.asarray(block)
-                    TRANSFER_BYTES["padded_buckets"] += int(block.nbytes)
+                    _TRANSFER_TOTAL.inc(int(block.nbytes), ("padded_buckets",))
+                    self._account_resident("padded_buckets", int(block.nbytes))
                 out.append((idx, arr))
             cache[key] = out
         return cache[key]
@@ -370,11 +414,13 @@ def prepare_reduce(packed: PackedGroups, op: str = "or"):
         if dev_arr is not None:
 
             def run():
+                from .. import tracing
                 from ..ops import pallas_kernels as pk
 
-                return pk.best_grouped_reduce(dev_arr, op=op)
+                with tracing.op_timer("store.reduce.padded"):
+                    return pk.best_grouped_reduce(dev_arr, op=op)
 
-            LAYOUT_COUNTS["padded"] += 1
+            _LAYOUT_TOTAL.inc(1, ("padded",))
             return run, "padded"
     if g and n:
         bucket_rows = sum(
@@ -391,13 +437,15 @@ def prepare_reduce(packed: PackedGroups, op: str = "or"):
     words = packed.device_words
 
     def run():
+        from .. import tracing
         from ..ops import pallas_kernels as pk
 
-        vals = pk.best_segmented_reduce(words, seg, op=op)
-        red = vals[end_rows]
-        return red, dev.popcount_rows(red)
+        with tracing.op_timer("store.reduce.segmented-scan"):
+            vals = pk.best_segmented_reduce(words, seg, op=op)
+            red = vals[end_rows]
+            return red, dev.popcount_rows(red)
 
-    LAYOUT_COUNTS["segmented-scan"] += 1
+    _LAYOUT_TOTAL.inc(1, ("segmented-scan",))
     return run, "segmented-scan"
 
 
@@ -416,7 +464,7 @@ def prepare_reduce_bucketed(packed: PackedGroups, op: str = "or", n_buckets: int
                 jnp.empty((0,), dtype=jnp.int32),
             )
 
-        LAYOUT_COUNTS["bucketed"] += 1
+        _LAYOUT_TOTAL.inc(1, ("bucketed",))
         return run_empty, "bucketed"
     order = np.concatenate([idx for idx, _ in buckets])
     inv = jnp.asarray(np.argsort(order))
@@ -437,9 +485,12 @@ def prepare_reduce_bucketed(packed: PackedGroups, op: str = "or", n_buckets: int
     arrs = tuple(a for _, a in buckets)
 
     def run():
-        return reduce_all(arrs)
+        from .. import tracing
 
-    LAYOUT_COUNTS["bucketed"] += 1
+        with tracing.op_timer("store.reduce.bucketed"):
+            return reduce_all(arrs)
+
+    _LAYOUT_TOTAL.inc(1, ("bucketed",))
     return run, "bucketed"
 
 

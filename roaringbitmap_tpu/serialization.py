@@ -33,6 +33,7 @@ from typing import Union
 
 import numpy as np
 
+from . import observe as _observe
 from .utils import bits as _bits
 from .models.container import (
     ARRAY_MAX_SIZE,
@@ -47,6 +48,15 @@ SERIAL_COOKIE = 12347  # RoaringArray.java:23
 SERIAL_COOKIE_NO_RUNCONTAINER = 12346  # RoaringArray.java:24
 NO_OFFSET_THRESHOLD = 4  # RoaringArray.java:25
 _MAX_CONTAINERS = 1 << 16
+
+# wire-format byte accounting (ISSUE 1): bytes produced by serialize and
+# consumed by the parsers, by direction — the checkpoint/interop traffic
+# ledger next to store's host->device one
+_SERIAL_BYTES = _observe.counter(
+    _observe.SERIAL_BYTES_TOTAL,
+    "RoaringFormatSpec bytes by direction (serialize | deserialize)",
+    ("direction",),
+)
 
 
 class InvalidRoaringFormat(ValueError):
@@ -137,7 +147,9 @@ def serialize(bm: RoaringBitmap) -> bytes:
 
     for c in containers:
         parts.append(_container_payload(c))
-    return b"".join(parts)
+    out = b"".join(parts)
+    _SERIAL_BYTES.inc(len(out), ("serialize",))
+    return out
 
 
 def _need(buf: memoryview, pos: int, n: int) -> None:
@@ -308,6 +320,7 @@ def read_into(bm: RoaringBitmap, data) -> int:
             c = ArrayContainer(values)
         hlc.keys.append(key)
         hlc.containers.append(c)
+    _SERIAL_BYTES.inc(pos, ("deserialize",))
     return pos
 
 

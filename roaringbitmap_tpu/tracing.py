@@ -11,21 +11,44 @@ Three layers:
 * ``annotate(name)`` — ``jax.profiler.TraceAnnotation`` wrapper so host-side
   phases (packing, unpack/stream-back) show up as named spans between the
   device ops. No-ops gracefully when jax is unavailable.
-* ``op_timer(name)`` / ``timings()`` — lightweight wall-clock accounting of
-  host-visible phases, queryable without a profile dump. Combined with
+* ``op_timer(name)`` / ``timings()`` — wall-clock accounting of host-visible
+  phases, queryable without a profile dump. Combined with
   ``insights.dispatch_counters()`` (engine/layout/backend choices +
   host->device transfer bytes) this answers "where did the time go, which
   path served it, how many bytes moved" — the observability the reference
   exposes via its introspection API (RoaringBitmap.getSizeInBytes etc.).
+
+Since ISSUE 1 the recording substrate is ``observe/``: every ``op_timer``
+block lands in the locked registry histogram ``rb_tpu_host_op_seconds``
+(flat name) and, via ``observe.spans``, in ``rb_tpu_span_seconds`` (nested
+``/``-joined path), so the JSONL/Prometheus exporters and the bench
+sidecar see host phases with no extra wiring. ``timings()`` is a thin
+facade over the registry with the pre-migration shape. The old module
+global ``_TIMINGS`` is kept for back-compat readers and is now
+lock-protected — the bare ``defaultdict`` mutation could lose increments
+under concurrent timers.
 """
 
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from collections import defaultdict
 from typing import Dict, Iterator
 
+from . import observe as _observe
+from .observe import spans as _spans
+
+_OP_SECONDS = _observe.histogram(
+    _observe.HOST_OP_SECONDS,
+    "Wall time of named host-side phases (op_timer)",
+    ("name",),
+)
+
+# legacy accounting, kept so pre-registry readers of _TIMINGS stay correct;
+# all mutation goes through _TIMINGS_LOCK (the ISSUE 1 thread-safety fix)
+_TIMINGS_LOCK = threading.Lock()
 _TIMINGS: Dict[str, list] = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
 
 
@@ -40,12 +63,16 @@ def trace(logdir: str = "/tmp/rb_tpu_trace") -> Iterator[None]:
 
 @contextlib.contextmanager
 def annotate(name: str) -> Iterator[None]:
-    """Named span in the device trace (falls back to a plain timer)."""
+    """Named span in the device trace (falls back to a plain timer).
+
+    Only jax being missing or stripped (ImportError/AttributeError)
+    downgrades to the plain timer — a real failure inside
+    ``TraceAnnotation`` propagates instead of being silently swallowed."""
     try:
         import jax
 
         ctx = jax.profiler.TraceAnnotation(name)
-    except Exception:  # jax missing or stripped build
+    except (ImportError, AttributeError):  # jax missing or stripped build
         ctx = contextlib.nullcontext()
     with ctx, op_timer(name):
         yield
@@ -53,27 +80,39 @@ def annotate(name: str) -> Iterator[None]:
 
 @contextlib.contextmanager
 def op_timer(name: str) -> Iterator[None]:
-    """Accumulate wall time for a named host-side phase."""
+    """Accumulate wall time for a named host-side phase.
+
+    Records into the registry (flat ``rb_tpu_host_op_seconds`` histogram +
+    nested ``rb_tpu_span_seconds`` via the span stack) and the
+    lock-protected legacy ``_TIMINGS`` dict."""
     t0 = time.perf_counter()
     try:
-        yield
+        with _spans.span(name):
+            yield
     finally:
-        rec = _TIMINGS[name]
-        rec[0] += 1
-        rec[1] += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        _OP_SECONDS.observe(dt, (name,))
+        with _TIMINGS_LOCK:
+            rec = _TIMINGS[name]
+            rec[0] += 1
+            rec[1] += dt
 
 
 def timings() -> Dict[str, Dict[str, float]]:
-    """{name: {count, total_s, mean_ms}} for all recorded phases."""
+    """{name: {count, total_s, mean_ms}} for all recorded phases (facade
+    over the ``rb_tpu_host_op_seconds`` registry histogram)."""
     return {
         name: {
-            "count": c,
-            "total_s": round(total, 6),
-            "mean_ms": round(total / c * 1e3, 3) if c else 0.0,
+            "count": st["count"],
+            "total_s": round(st["sum"], 6),
+            "mean_ms": round(st["sum"] / st["count"] * 1e3, 3) if st["count"] else 0.0,
         }
-        for name, (c, total) in _TIMINGS.items()
+        for (name,), st in _OP_SECONDS.series().items()
     }
 
 
 def reset_timings() -> None:
-    _TIMINGS.clear()
+    _OP_SECONDS.clear()
+    _spans.reset_spans()
+    with _TIMINGS_LOCK:
+        _TIMINGS.clear()

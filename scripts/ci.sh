@@ -28,7 +28,9 @@ tail -1 /tmp/ci_fuzz.log
 step "bench.py --smoke (end-to-end north-star path, CPU)"
 # validate the driver contract, not just the exit code: exactly the keys
 # BENCH_r*.json records, with a sane positive speedup
-JAX_PLATFORMS=cpu python bench.py --smoke | python -c '
+rm -f /tmp/ci_bench_metrics.json
+JAX_PLATFORMS=cpu BENCH_METRICS_OUT=/tmp/ci_bench_metrics.json \
+  python bench.py --smoke | python -c '
 import json, sys
 line = sys.stdin.readlines()[-1]
 r = json.loads(line)
@@ -37,6 +39,30 @@ if set(r) != {"metric", "value", "unit", "vs_baseline"}:
 if not (r["value"] > 0 and r["vs_baseline"] > 0):
     raise SystemExit("bench contract: non-positive %s" % r)
 print("bench contract ok (vs_baseline %s)" % r["vs_baseline"])'
+
+step "bench metrics sidecar (observe/ registry snapshot contract)"
+# same SystemExit discipline as the driver-contract check above: the smoke
+# run must leave a schema-valid registry snapshot behind
+python -c '
+import json, os, sys
+path = "/tmp/ci_bench_metrics.json"
+if not os.path.isfile(path):
+    raise SystemExit("metrics sidecar missing: %s" % path)
+try:
+    with open(path) as f:
+        m = json.load(f)
+except ValueError as e:
+    raise SystemExit("metrics sidecar is not valid JSON: %s" % e)
+required = {"kernel", "layout", "transfer_bytes", "spans"}
+missing = required - set(m)
+if missing:
+    raise SystemExit("metrics sidecar lacks keys %s (has %s)" % (sorted(missing), sorted(m)))
+for key in ("kernel", "layout", "transfer_bytes"):
+    if not (isinstance(m[key], dict) and all(isinstance(v, int) for v in m[key].values())):
+        raise SystemExit("metrics sidecar %s must map str->int: %r" % (key, m[key]))
+if not (m["layout"] and m["spans"]):
+    raise SystemExit("metrics sidecar recorded no layouts/spans: %r" % sorted(m))
+print("metrics sidecar ok (layouts %s, %d span paths)" % (m["layout"], len(m["spans"])))'
 
 step "graft entry + 8-device virtual-mesh dryrun"
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

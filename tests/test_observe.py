@@ -1,0 +1,417 @@
+"""The unified metrics & span subsystem (ISSUE 1): registry semantics
+(incl. under a thread hammer), span nesting, exporter golden formats, the
+bench sidecar, and facade parity — ``insights.dispatch_counters()`` /
+``tracing.timings()`` must keep their pre-migration shapes."""
+
+import json
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import RoaringBitmap, insights, observe, tracing
+from roaringbitmap_tpu.observe import Registry, MetricError
+from roaringbitmap_tpu.parallel import store
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+
+def test_counter_gauge_basics():
+    reg = Registry()
+    c = reg.counter("rb_tpu_test_total", "help text", ("kind",))
+    c.inc(labels=("a",))
+    c.inc(2, ("a",))
+    c.inc(labels={"kind": "b"})
+    assert c.get(("a",)) == 3 and c.get(("b",)) == 1
+    assert c.get(("missing",)) == 0  # read-only: no series created
+    assert set(c.series()) == {("a",), ("b",)}
+    with pytest.raises(MetricError):
+        c.inc(-1, ("a",))  # counters only go up
+    g = reg.gauge("rb_tpu_test_gauge", "", ("kind",))
+    g.set(10, ("x",))
+    g.dec(4, ("x",))
+    assert g.get(("x",)) == 6
+
+
+def test_registration_idempotent_and_conflicts_loud():
+    reg = Registry()
+    c1 = reg.counter("rb_tpu_dup_total", "h", ("a",))
+    assert reg.counter("rb_tpu_dup_total", "h", ("a",)) is c1
+    with pytest.raises(MetricError):
+        reg.gauge("rb_tpu_dup_total", "h", ("a",))  # kind conflict
+    with pytest.raises(MetricError):
+        reg.counter("rb_tpu_dup_total", "h", ("a", "b"))  # label conflict
+    with pytest.raises(MetricError):
+        reg.counter("0bad name")
+
+
+def test_label_arity_checked():
+    reg = Registry()
+    c = reg.counter("rb_tpu_arity_total", "", ("a", "b"))
+    with pytest.raises(MetricError):
+        c.inc(1, ("only-one",))
+    with pytest.raises(MetricError):
+        c.inc(1, {"a": "x", "wrong": "y"})
+
+
+def test_histogram_buckets_and_snapshot():
+    reg = Registry()
+    h = reg.histogram("rb_tpu_test_seconds", "", ("name",), buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.1, 0.5, 3.0, 99.0):
+        h.observe(v, ("x",))
+    st = h.get(("x",))
+    assert st["count"] == 5 and st["sum"] == pytest.approx(102.65)
+    # per-slot: <=0.1 gets 0.05 and the exactly-equal 0.1; 0.5 -> <=1;
+    # 3.0 -> <=10; 99.0 -> +Inf overflow
+    assert st["slots"] == [2, 1, 1, 1]
+    snap = reg.snapshot()
+    sample = snap["rb_tpu_test_seconds"]["samples"][0]
+    assert sample["labels"] == {"name": "x"}
+    assert sample["buckets"] == {"0.1": 2, "1": 3, "10": 4, "+Inf": 5}
+    json.dumps(snap)  # plain dicts only
+
+
+def test_reset_keeps_definitions():
+    reg = Registry()
+    c = reg.counter("rb_tpu_reset_total", "", ("k",))
+    c.inc(5, ("a",))
+    reg.reset()
+    assert c.get(("a",)) == 0
+    assert reg.get("rb_tpu_reset_total") is c
+
+
+def test_counter_hammer_threadsafe():
+    """8 writers x 2000 atomic incs across 4 label series lose nothing."""
+    reg = Registry()
+    c = reg.counter("rb_tpu_hammer_total", "", ("k",))
+    h = reg.histogram("rb_tpu_hammer_seconds", "", ("k",), buckets=(1.0,))
+
+    def work(i):
+        for j in range(2000):
+            c.inc(1, (str(j % 4),))
+            h.observe(0.5, ("h",))
+
+    with ThreadPoolExecutor(max_workers=8) as ex:
+        list(ex.map(work, range(8)))
+    assert sum(c.get((str(k),)) for k in range(4)) == 16000
+    assert h.get(("h",))["count"] == 16000
+
+
+def test_op_timer_hammer_threadsafe():
+    """The ISSUE 1 satellite: concurrent op_timer must not lose increments
+    (the old bare defaultdict mutation could)."""
+    tracing.reset_timings()
+
+    def work(i):
+        for _ in range(500):
+            with tracing.op_timer("hammer-phase"):
+                pass
+
+    with ThreadPoolExecutor(max_workers=8) as ex:
+        list(ex.map(work, range(8)))
+    t = tracing.timings()["hammer-phase"]
+    assert t["count"] == 4000
+    assert tracing._TIMINGS["hammer-phase"][0] == 4000  # legacy path agrees
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_paths():
+    observe.reset_spans()
+    with observe.span("outer"):
+        assert observe.current_path() == "outer" and observe.depth() == 1
+        with observe.span("inner") as path:
+            assert path == "outer/inner"
+            assert observe.depth() == 2
+    assert observe.depth() == 0
+    t = observe.span_timings()
+    assert set(t) == {"outer", "outer/inner"}
+    assert t["outer/inner"]["count"] == 1
+
+
+def test_span_stack_unwinds_on_exception():
+    observe.reset_spans()
+    with pytest.raises(RuntimeError):
+        with observe.span("boom"):
+            raise RuntimeError("x")
+    assert observe.depth() == 0
+    assert observe.span_timings()["boom"]["count"] == 1  # still recorded
+
+
+def test_span_stacks_are_thread_local():
+    observe.reset_spans()
+    seen = {}
+    barrier = threading.Barrier(2)
+
+    def work(name):
+        with observe.span(name):
+            barrier.wait(timeout=10)
+            seen[name] = observe.current_path()
+
+    threads = [threading.Thread(target=work, args=(n,)) for n in ("t1", "t2")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert seen == {"t1": "t1", "t2": "t2"}  # no cross-thread nesting
+
+
+def test_op_timer_records_span_nesting():
+    tracing.reset_timings()
+    with tracing.op_timer("a"):
+        with tracing.op_timer("b"):
+            pass
+    assert set(observe.span_timings()) == {"a", "a/b"}
+    # flat facade unaffected by nesting
+    assert set(tracing.timings()) == {"a", "b"}
+
+
+def test_annotate_only_swallows_missing_jax(monkeypatch):
+    """The over-broad `except Exception` fix: a real TraceAnnotation
+    failure propagates; only ImportError/AttributeError degrade."""
+    import jax
+
+    class Boom:
+        def __init__(self, name):
+            raise RuntimeError("real profiler bug")
+
+    monkeypatch.setattr(jax.profiler, "TraceAnnotation", Boom)
+    with pytest.raises(RuntimeError, match="real profiler bug"):
+        with tracing.annotate("x"):
+            pass
+    monkeypatch.delattr(jax.profiler, "TraceAnnotation")
+    tracing.reset_timings()
+    with tracing.annotate("degraded"):  # AttributeError -> plain timer
+        pass
+    assert tracing.timings()["degraded"]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# exporters: golden formats
+# ---------------------------------------------------------------------------
+
+
+def _golden_registry():
+    reg = Registry()
+    c = reg.counter("rb_tpu_g_total", "dispatches", ("kind", "engine"))
+    c.inc(3, ("wide", "xla"))
+    g = reg.gauge("rb_tpu_g_bytes", "resident", ("kind",))
+    g.set(512, ("flat",))
+    h = reg.histogram("rb_tpu_g_seconds", "spans", ("name",), buckets=(0.5, 2.0))
+    h.observe(0.25, ("pack",))
+    h.observe(1.0, ("pack",))
+    h.observe(9.0, ("pack",))
+    return reg
+
+
+def test_prometheus_golden_format():
+    text = observe.prometheus_text(_golden_registry())
+    assert text.splitlines() == [
+        "# HELP rb_tpu_g_bytes resident",
+        "# TYPE rb_tpu_g_bytes gauge",
+        'rb_tpu_g_bytes{kind="flat"} 512',
+        "# HELP rb_tpu_g_seconds spans",
+        "# TYPE rb_tpu_g_seconds histogram",
+        'rb_tpu_g_seconds_bucket{name="pack",le="0.5"} 1',
+        'rb_tpu_g_seconds_bucket{name="pack",le="2"} 2',
+        'rb_tpu_g_seconds_bucket{name="pack",le="+Inf"} 3',
+        'rb_tpu_g_seconds_sum{name="pack"} 10.25',
+        'rb_tpu_g_seconds_count{name="pack"} 3',
+        "# HELP rb_tpu_g_total dispatches",
+        "# TYPE rb_tpu_g_total counter",
+        'rb_tpu_g_total{kind="wide",engine="xla"} 3',
+    ]
+
+
+def test_prometheus_label_escaping():
+    reg = Registry()
+    reg.counter("rb_tpu_esc_total", "", ("p",)).inc(1, ('we"ird\\pa\nth',))
+    line = observe.prometheus_text(reg).splitlines()[-1]
+    assert line == 'rb_tpu_esc_total{p="we\\"ird\\\\pa\\nth"} 1'
+
+
+def test_jsonl_golden_format():
+    lines = observe.jsonl_lines(_golden_registry())
+    recs = [json.loads(l) for l in lines]
+    assert [r["name"] for r in recs] == [
+        "rb_tpu_g_bytes",
+        "rb_tpu_g_seconds",
+        "rb_tpu_g_total",
+    ]
+    assert recs[0] == {
+        "labels": {"kind": "flat"},
+        "name": "rb_tpu_g_bytes",
+        "type": "gauge",
+        "value": 512,
+    }
+    assert recs[1]["count"] == 3 and recs[1]["buckets"] == {
+        "0.5": 1,
+        "2": 2,
+        "+Inf": 3,
+    }
+    assert recs[2]["value"] == 3 and recs[2]["labels"] == {
+        "kind": "wide",
+        "engine": "xla",
+    }
+
+
+def test_write_exports_atomic(tmp_path):
+    reg = _golden_registry()
+    prom = tmp_path / "metrics.prom"
+    jl = tmp_path / "metrics.jsonl"
+    observe.write_prometheus(str(prom), reg)
+    observe.write_jsonl(str(jl), reg)
+    assert prom.read_text() == observe.prometheus_text(reg)
+    for line in jl.read_text().splitlines():
+        json.loads(line)
+    assert not [p for p in os.listdir(tmp_path) if p.endswith(".tmp")]
+
+
+def test_metrics_sidecar_written_even_on_failure(tmp_path):
+    path = tmp_path / "side" / "BENCH_METRICS.json"
+    with pytest.raises(RuntimeError):
+        with observe.metrics_sidecar(str(path)):
+            raise RuntimeError("bench died")
+    m = json.loads(path.read_text())
+    assert m["schema"] == observe.SIDECAR_SCHEMA
+    assert {"kernel", "layout", "transfer_bytes", "spans", "registry"} <= set(m)
+
+
+# ---------------------------------------------------------------------------
+# facade parity + migration wiring
+# ---------------------------------------------------------------------------
+
+
+def _workload():
+    bms = [RoaringBitmap(np.arange(i, 70000 + i, dtype=np.uint32)) for i in range(3)]
+    packed = store.pack_groups(store.group_by_key(bms))
+    words, cards = store.reduce_packed(packed, op="or")
+    store.unpack_to_bitmap(packed.group_keys, words, cards)
+    return insights.dispatch_counters(), tracing.timings()
+
+
+def test_facade_parity_shapes_and_determinism():
+    """dispatch_counters()/timings() keep their pre-registry shapes, and an
+    identical workload after reset reproduces identical counters — the
+    'before vs after migration' equivalence, observable from either side."""
+    insights.reset_dispatch_counters()
+    tracing.reset_timings()
+    first_counters, first_timings = _workload()
+    # legacy shape: exactly these top-level keys, str keys, int values
+    assert set(first_counters) == {
+        "kernel", "layout", "transfer_bytes", "pairwise", "probes", "native",
+    }
+    for section in ("kernel", "layout", "transfer_bytes", "pairwise"):
+        assert all(
+            isinstance(k, str) and isinstance(v, int)
+            for k, v in first_counters[section].items()
+        )
+    assert first_counters["kernel"] == {"grouped/xla": 1}
+    assert sum(first_counters["layout"].values()) == 1
+    for entry in first_timings.values():
+        assert set(entry) == {"count", "total_s", "mean_ms"}
+    assert first_timings["store.pack_rows_host"]["count"] == 1
+
+    insights.reset_dispatch_counters()
+    tracing.reset_timings()
+    second_counters, second_timings = _workload()
+    assert second_counters == first_counters
+    assert set(second_timings) == set(first_timings)
+
+
+def test_facades_are_registry_views():
+    """The legacy module globals and the registry are the same numbers."""
+    from roaringbitmap_tpu.ops import pallas_kernels as pk
+
+    insights.reset_dispatch_counters()
+    _workload()
+    reg_counter = observe.REGISTRY.get(observe.KERNEL_DISPATCH_TOTAL)
+    assert reg_counter.get(("grouped", "xla")) == pk.DISPATCH_COUNTS[("grouped", "xla")] == 1
+    layout = observe.REGISTRY.get(observe.STORE_LAYOUT_TOTAL)
+    assert {lv[0]: v for lv, v in layout.series().items()} == dict(store.LAYOUT_COUNTS)
+    xfer = observe.REGISTRY.get(observe.STORE_TRANSFER_BYTES_TOTAL)
+    assert {lv[0]: v for lv, v in xfer.series().items()} == dict(store.TRANSFER_BYTES)
+
+
+def test_countermap_legacy_mutation_roundtrip():
+    """External `COUNTS[key] += 1` writers keep working on the facades."""
+    from roaringbitmap_tpu.ops import pallas_kernels as pk
+
+    pk.DISPATCH_COUNTS.clear()
+    pk.DISPATCH_COUNTS[("custom", "engine")] += 1
+    pk.DISPATCH_COUNTS[("custom", "engine")] += 2
+    assert pk.DISPATCH_COUNTS[("custom", "engine")] == 3
+    assert ("custom", "engine") in pk.DISPATCH_COUNTS
+    assert ("absent", "engine") not in pk.DISPATCH_COUNTS
+    assert pk.DISPATCH_COUNTS[("absent", "engine")] == 0
+    assert insights.dispatch_counters()["kernel"] == {"custom/engine": 3}
+    del pk.DISPATCH_COUNTS[("custom", "engine")]
+    assert len(pk.DISPATCH_COUNTS) == 0
+
+
+def test_resident_gauge_rises_and_falls_with_working_set():
+    """rb_tpu_store_resident_bytes tracks what is resident NOW: freeing a
+    PackedGroups (and its cached device arrays) decrements the gauge."""
+    gauge = observe.REGISTRY.get(observe.STORE_RESIDENT_BYTES)
+    gauge.clear()
+    bms = [RoaringBitmap(np.arange(i, 70000 + i, dtype=np.uint32)) for i in range(3)]
+    packed = store.pack_groups(store.group_by_key(bms))
+    packed.device_words
+    packed.padded_device(0)
+    flat = gauge.get(("flat_rows",))
+    padded = gauge.get(("padded_groups",))
+    assert flat == packed.words.nbytes and padded > 0
+    del packed
+    assert gauge.get(("flat_rows",)) == 0
+    assert gauge.get(("padded_groups",)) == 0
+
+
+def test_probe_ledgers_survive_reset_consistently():
+    """reset_dispatch_counters leaves BOTH probe views (the _PROBED cache
+    and the registry probe counter) alone — clearing only one would make
+    dispatch_counters()['probes'] and BENCH_METRICS.json disagree."""
+    from roaringbitmap_tpu.ops import pallas_kernels as pk
+
+    probe = observe.REGISTRY.get(observe.KERNEL_PROBE_TOTAL)
+    probe.inc(1, ("testkind", "or", "cpu", "ok"))
+    pk._PROBED[("testkind", "or", (1, 2048), "cpu")] = True
+    try:
+        insights.reset_dispatch_counters()
+        assert probe.get(("testkind", "or", "cpu", "ok")) == 1
+        assert ("testkind", "or", (1, 2048), "cpu") in pk._PROBED
+    finally:
+        probe.remove(("testkind", "or", "cpu", "ok"))
+        pk._PROBED.pop(("testkind", "or", (1, 2048), "cpu"), None)
+
+
+def test_serialization_byte_accounting():
+    observe.REGISTRY.get(observe.SERIAL_BYTES_TOTAL).clear()
+    bm = RoaringBitmap(np.arange(0, 100000, 3, dtype=np.uint32))
+    data = bm.serialize()
+    from roaringbitmap_tpu import serialization
+
+    assert serialization.deserialize(data) == bm
+    ser = observe.REGISTRY.get(observe.SERIAL_BYTES_TOTAL)
+    assert ser.get(("serialize",)) == len(data)
+    assert ser.get(("deserialize",)) == len(data)
+
+
+def test_sidecar_snapshot_reflects_workload():
+    insights.reset_dispatch_counters()
+    tracing.reset_timings()
+    _workload()
+    side = observe.sidecar_snapshot()
+    assert side["kernel"] == {"grouped/xla": 1}
+    assert sum(side["layout"].values()) == 1
+    assert side["transfer_bytes"]  # the working set shipped at least once
+    assert "store.pack_rows_host" in side["spans"]
+    # reduce span nests the probe/dispatch work under the layout it chose
+    assert any(p.startswith("store.reduce.") for p in side["spans"])
