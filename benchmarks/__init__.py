@@ -39,6 +39,7 @@ SUITES = [
     "bsi",
     "bitsetutil",
     "filtered_ann",
+    "query",
     "formats",
     "bithacking",
     "longlong",

@@ -1,0 +1,71 @@
+"""Query-engine suite: planned vs naive evaluation on realdata-style
+workloads, cache warm vs cold (ISSUE 2 satellite).
+
+The workload is the serving-system shape the tentpole exists for — a
+nested boolean expression over many corpus bitmaps,
+``(or(A) & or(B)) \\ or(C) | threshold_2(head)`` — evaluated four ways:
+
+* ``queryNaive`` — recursive pairwise set algebra (query.evaluate_naive),
+  the reference baseline a caller without a planner pays;
+* ``queryPlanned`` — planner + executor, memoization disabled: what the
+  rewrites + operand ordering + engine choice buy on their own;
+* ``queryPlannedColdCache`` — a fresh result cache every repetition
+  (planning + execution + store costs, no reuse);
+* ``queryPlannedWarmCache`` — a shared cache warmed before timing: the
+  steady-state repeated-query hot path (dict probes + one root clone).
+
+Correctness of the planned result against the naive fold is asserted
+before any timing is trusted (the test_benchmarks discipline).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from roaringbitmap_tpu.query import Q, ResultCache, evaluate_naive, execute, plan
+
+from . import common
+from .common import Result
+
+
+def _expression(bms):
+    third = max(1, len(bms) // 3)
+    a = Q.or_(*[Q.leaf(b) for b in bms[:third]])
+    b = Q.or_(*[Q.leaf(b) for b in bms[third : 2 * third]])
+    c = Q.or_(*[Q.leaf(b) for b in bms[2 * third :]])
+    head = [Q.leaf(x) for x in bms[: min(8, len(bms))]]
+    return (a & b) - c | Q.threshold(2, *head)
+
+
+def _suite(dataset: str, reps: int, limit: int) -> List[Result]:
+    bms = common.corpus_bitmaps(dataset, limit=limit)
+    q = _expression(bms)
+    want = evaluate_naive(q)
+    got = execute(q, cache=None)
+    assert got == want, "planned evaluation diverged from naive algebra"
+    out = []
+    extra = {"n_bitmaps": len(bms), "steps": len(plan(q).steps)}
+
+    def bench(name, fn):
+        ns = common.min_of(reps, fn)
+        out.append(Result(name, dataset, ns, "ns/op", dict(extra)))
+
+    bench("queryNaive", lambda: evaluate_naive(q))
+    bench("queryPlanned", lambda: execute(q, cache=None))
+
+    def cold():
+        execute(q, cache=ResultCache(max_entries=64))
+
+    bench("queryPlannedColdCache", cold)
+
+    warm_cache = ResultCache(max_entries=64)
+    execute(q, cache=warm_cache)  # warm outside the timed region
+    bench("queryPlannedWarmCache", lambda: execute(q, cache=warm_cache))
+    return out
+
+
+def run(reps: int = 5, datasets=None, limit: int = 48, **_) -> List[Result]:
+    results = []
+    for ds in datasets or common.DEFAULT_DATASETS:
+        results.extend(_suite(ds, reps, limit))
+    return results

@@ -19,6 +19,7 @@ EXAMPLES = [
     "bsi_queries",
     "similarity_matrix",
     "observability",
+    "query_engine",
     "memory_mapping",
     "paged_iterator",
     "serialize_to_bytes",

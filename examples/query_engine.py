@@ -1,0 +1,64 @@
+"""The lazy query expression engine (ISSUE 2): build a boolean query as a
+DAG, inspect the plan the cost-based planner chose (rewrites, operand
+ordering, engine per node), execute it through the memoizing result cache,
+and watch repeated queries short-circuit — the serving-system hot path
+``(users_in_A & users_in_B) - opted_out | ...`` as a first-class object.
+"""
+
+import numpy as np
+
+from roaringbitmap_tpu import Q, RoaringBitmap, insights
+from roaringbitmap_tpu.query import ResultCache, evaluate_naive, execute, plan
+
+
+def main():
+    rng = np.random.default_rng(7)
+
+    def segment(n):
+        return RoaringBitmap(
+            rng.choice(1 << 20, size=n, replace=False).astype(np.uint32)
+        )
+
+    users_in_a = segment(50_000)
+    users_in_b = segment(60_000)
+    users_in_c = segment(40_000)
+    premium = segment(30_000)
+    trial = segment(30_000)
+    opted_out = segment(20_000)
+    everyone = evaluate_naive(
+        Q.or_(*[Q.leaf(b) for b in (users_in_a, users_in_b, users_in_c, premium, trial)])
+    )
+
+    # build lazily: operators on Q.leaf(...) nodes allocate DAG nodes only
+    q = (
+        (Q.leaf(users_in_a) & Q.leaf(users_in_b) | Q.leaf(users_in_c))
+        - Q.leaf(opted_out)
+        # "in at least 2 of these 3 programs" — the bit-sliced threshold
+        | Q.threshold(2, Q.leaf(premium), Q.leaf(trial), Q.leaf(users_in_a))
+        # complement against an explicit universe, De-Morgan'd by the planner
+        & Q.not_(Q.leaf(opted_out), Q.leaf(everyone))
+    )
+
+    p = plan(q)
+    print(p.explain())
+
+    cache = ResultCache(max_entries=64)
+    cold = execute(p, cache=cache)
+    print("result cardinality:", cold.get_cardinality())
+    assert cold == evaluate_naive(q), "planned execution must match naive algebra"
+
+    warm = execute(q, cache=cache)  # same DAG, unchanged leaves: all hits
+    assert warm == cold
+    stats = cache.stats()
+    print(f"cache after repeat: {stats['hits']} hits, {stats['misses']} misses")
+    assert stats["hits"] > 0
+
+    opted_out.add_many(np.arange(0, 2048, dtype=np.uint32))  # fingerprint bump
+    fresh = execute(q, cache=cache)
+    assert fresh == evaluate_naive(q), "mutated leaf must invalidate by key"
+    print("after opt-out mutation:", fresh.get_cardinality())
+    print("registry counters:", insights.query_counters()["cache"])
+
+
+if __name__ == "__main__":
+    main()

@@ -61,6 +61,8 @@ from . import insights
 from . import fuzz
 from . import observe
 from . import tracing
+from . import query
+from .query import Q
 
 __version__ = "0.1.0"
 
@@ -107,4 +109,6 @@ __all__ = [
     "fuzz",
     "observe",
     "tracing",
+    "query",
+    "Q",
 ]

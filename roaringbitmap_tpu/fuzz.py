@@ -233,6 +233,66 @@ def verify_layout_invariance(
                 )
 
 
+def random_expression(rng, leaves: List[RoaringBitmap], max_depth: int = 4):
+    """Random query DAG over the given leaf bitmaps: every node kind
+    (and/or/xor/n-ary andnot/not-over-explicit-universe/threshold), biased
+    toward reusing leaves so hash-consing and CSE paths are exercised. The
+    universe for ``not`` is the union of all leaves (a realistic "all
+    users" set)."""
+    from .query import Q
+
+    universe = Q.or_(*[Q.leaf(b) for b in leaves]) if len(leaves) > 1 else Q.leaf(leaves[0])
+
+    def build(depth: int):
+        if depth >= max_depth or rng.random() < 0.3:
+            return Q.leaf(leaves[int(rng.integers(0, len(leaves)))])
+        kind = int(rng.integers(0, 6))
+        n = int(rng.integers(2, 5))
+        subs = [build(depth + 1) for _ in range(n)]
+        if kind == 0:
+            return Q.and_(*subs)
+        if kind == 1:
+            return Q.or_(*subs)
+        if kind == 2:
+            return Q.xor(*subs)
+        if kind == 3:
+            return Q.andnot(subs[0], *subs[1:])
+        if kind == 4:
+            return Q.not_(subs[0], universe)
+        # k spans the interesting range including k == n and k > n
+        return Q.threshold(int(rng.integers(1, n + 2)), *subs)
+
+    return build(0)
+
+
+def verify_query_invariance(
+    name: str,
+    iterations: Optional[int] = None,
+    seed: Optional[int] = None,
+    mode: Optional[str] = None,
+) -> None:
+    """The query-engine differential invariant: for every sampled DAG,
+    planner + executor output must equal naive recursive set-algebra
+    evaluation (query.evaluate_naive). Runs with a small shared result
+    cache so memoization and eviction are under test too — an execution
+    served from a stale cache entry fails exactly like a wrong engine."""
+    from .query import ResultCache, evaluate_naive, execute
+
+    rng = np.random.default_rng(seed)
+    cache = ResultCache(max_entries=32)
+    for _ in range(iterations or default_iterations()):
+        leaves = [random_bitmap(rng) for _ in range(int(rng.integers(2, 5)))]
+        expr = random_expression(rng, leaves)
+        try:
+            got = execute(expr, cache=cache, mode=mode)
+            want = evaluate_naive(expr)
+            ok = got == want
+        except Exception as e:
+            raise InvarianceFailure(name, leaves, detail=f"{expr!r}: {e!r}") from e
+        if not ok:
+            raise InvarianceFailure(name, leaves, detail=repr(expr))
+
+
 def random_bitmap64(rng, max_buckets: int = 3):
     """Shape-diverse 64-bit bitmap spanning several high-32 buckets."""
     from .models.roaring64 import Roaring64NavigableMap
@@ -486,6 +546,23 @@ def run_campaign(iterations: Optional[int] = None, verbose: bool = True) -> dict
             "bulk-order-stats-agree",
             _bulk_order_stats_pred,
             arity=1, iterations=max(1, n // 8), seed=50,
+        ),
+        actual=max(1, n // 8),
+    )
+    # ISSUE 2: query engine (planner + executor + cache) vs naive algebra,
+    # on both forced regimes (device engines run on the CPU backend too)
+    _run(
+        "query-planner-vs-naive",
+        lambda: verify_query_invariance(
+            "query-planner-vs-naive", iterations=max(1, n // 4), seed=51
+        ),
+        actual=max(1, n // 4),
+    )
+    _run(
+        "query-planner-vs-naive(device)",
+        lambda: verify_query_invariance(
+            "query-planner-vs-naive(device)",
+            iterations=max(1, n // 8), seed=52, mode="device",
         ),
         actual=max(1, n // 8),
     )

@@ -103,6 +103,24 @@ def native_backend() -> str:
     return native.backend_tier()
 
 
+def query_counters() -> dict:
+    """Query-engine execution observability (ISSUE 2): planned steps by
+    chosen engine and result-cache events, as plain str->int dicts (the
+    dispatch_counters() shape convention — kept additive, not merged into
+    that facade, whose key set is a frozen legacy contract).
+
+    Returns ``{"plan": {engine: steps}, "cache": {event: count}}``; events
+    are hit/miss/store/evict (query/cache.py)."""
+    from . import observe
+
+    plan = observe.REGISTRY.get(observe.QUERY_PLAN_TOTAL)
+    cache = observe.REGISTRY.get(observe.QUERY_CACHE_TOTAL)
+    return {
+        "plan": {lv[0]: v for lv, v in plan.series().items()} if plan else {},
+        "cache": {lv[0]: v for lv, v in cache.series().items()} if cache else {},
+    }
+
+
 def metrics_snapshot() -> dict:
     """The full labeled registry snapshot (every rb_tpu_* metric incl.
     histograms) — the machine-readable superset of dispatch_counters();

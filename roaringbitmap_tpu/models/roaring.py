@@ -112,6 +112,7 @@ class RoaringBitmap:
         if i < len(keys) and keys[i] == hb:
             containers = hlc.containers
             containers[i] = containers[i].add(lb)
+            hlc._version += 1  # frame-flat path bypasses set_container_at_index
         else:
             hlc.insert_new_key_value_at(
                 i, hb, ArrayContainer(np.array([lb], dtype=np.uint16))
@@ -1086,6 +1087,20 @@ class RoaringBitmap:
     # ------------------------------------------------------------------
     # introspection (SURVEY §5 observability)
     # ------------------------------------------------------------------
+    def fingerprint(self) -> tuple:
+        """Cheap mutation-tracking token: ``(array generation, mutation
+        version)``. Every mutator bumps the version (or, for the in-place
+        algebra that swaps in a fresh ``RoaringArray``, changes the
+        generation), so two equal fingerprints of the same bitmap object
+        guarantee unchanged contents — the invalidation key of the query
+        result cache (query/cache.py). O(1); NOT a content hash: two equal
+        bitmaps have different fingerprints."""
+        hlc = self.high_low_container
+        gen = getattr(hlc, "_gen", None)
+        if gen is None:  # mapped/immutable container arrays never mutate
+            return ("static", id(hlc))
+        return (gen, hlc._version)
+
     def get_container_count(self) -> int:
         return self.high_low_container.size
 

@@ -7,18 +7,27 @@ entries) and never on the device hot path.
 
 from __future__ import annotations
 
+import itertools
 from bisect import bisect_left
 from typing import List, Tuple
 
 from .container import Container
 
+# process-unique generation ids: a (gen, version) pair is a stable identity
+# token for "this container array at this mutation count" that can never be
+# confused with a different array reusing the same memory address — the
+# substrate of RoaringBitmap.fingerprint() (query/cache.py invalidation)
+_GEN = itertools.count(1)
+
 
 class RoaringArray:
-    __slots__ = ("keys", "containers")
+    __slots__ = ("keys", "containers", "_gen", "_version")
 
     def __init__(self):
         self.keys: List[int] = []
         self.containers: List[Container] = []
+        self._gen = next(_GEN)
+        self._version = 0
 
     @property
     def size(self) -> int:
@@ -43,18 +52,22 @@ class RoaringArray:
 
     def set_container_at_index(self, i: int, c: Container) -> None:
         self.containers[i] = c
+        self._version += 1
 
     def insert_new_key_value_at(self, i: int, key: int, c: Container) -> None:
         self.keys.insert(i, key)
         self.containers.insert(i, c)
+        self._version += 1
 
     def remove_at_index(self, i: int) -> None:
         del self.keys[i]
         del self.containers[i]
+        self._version += 1
 
     def remove_index_range(self, begin: int, end: int) -> None:
         del self.keys[begin:end]
         del self.containers[begin:end]
+        self._version += 1
 
     def append(self, key: int, c: Container) -> None:
         """Append-only builder path (RoaringArray.java:111); key must exceed all
@@ -63,6 +76,7 @@ class RoaringArray:
             raise ValueError(f"append key {key} <= last key {self.keys[-1]}")
         self.keys.append(key)
         self.containers.append(c)
+        self._version += 1
 
     def advance_until(self, key: int, pos: int) -> int:
         """First index > pos with keys[index] >= key (RoaringArray.java:64)."""

@@ -23,6 +23,8 @@ from .registry import (
     HOST_OP_SECONDS,
     KERNEL_DISPATCH_TOTAL,
     KERNEL_PROBE_TOTAL,
+    QUERY_CACHE_TOTAL,
+    QUERY_PLAN_TOTAL,
     REGISTRY,
     SERIAL_BYTES_TOTAL,
     SPAN_SECONDS,
@@ -89,4 +91,6 @@ __all__ = [
     "SERIAL_BYTES_TOTAL",
     "HOST_OP_SECONDS",
     "SPAN_SECONDS",
+    "QUERY_CACHE_TOTAL",
+    "QUERY_PLAN_TOTAL",
 ]

@@ -45,6 +45,8 @@ BATCH_PAIRWISE_TOTAL = "rb_tpu_batch_pairwise_total"
 SERIAL_BYTES_TOTAL = "rb_tpu_serial_bytes_total"
 HOST_OP_SECONDS = "rb_tpu_host_op_seconds"
 SPAN_SECONDS = "rb_tpu_span_seconds"
+QUERY_CACHE_TOTAL = "rb_tpu_query_cache_total"
+QUERY_PLAN_TOTAL = "rb_tpu_query_plan_total"
 
 # upper bucket bounds (seconds) for wall-time histograms: host phases span
 # ~100 µs packing steps to multi-second CPU folds; +Inf is implicit

@@ -393,6 +393,35 @@ class FastAggregation:
         return _aggregate(_flatten(bitmaps), "and", mode)
 
     @staticmethod
+    def andnot(
+        first: RoaringBitmap, *rest: RoaringBitmap, mode: Optional[str] = None
+    ) -> RoaringBitmap:
+        """N-way difference ``first \\ (rest_1 | rest_2 | ...)`` — API
+        parity with the reference's ``andNot`` surface extended the way
+        ``or``/``and`` already are. Delegates to the query engine's n-way
+        kernel (query/kernels.py): one word fold per surviving key on CPU,
+        a fused grouped-OR + mask dispatch on device."""
+        from ..query import kernels
+
+        bms = _flatten((first,) + rest)
+        if not bms:
+            return RoaringBitmap()
+        return kernels.andnot_nway(bms[0], *bms[1:], mode=mode)
+
+    @staticmethod
+    def andnot_cardinality(
+        first: RoaringBitmap, *rest: RoaringBitmap, mode: Optional[str] = None
+    ) -> int:
+        """``|first \\ (rest_1 | ...)|`` — the device path fetches only
+        per-group popcounts (FastAggregation.andNotCardinality analogue)."""
+        from ..query import kernels
+
+        bms = _flatten((first,) + rest)
+        if not bms:
+            return 0
+        return kernels.andnot_nway_cardinality(bms[0], *bms[1:], mode=mode)
+
+    @staticmethod
     def and_cardinality(*bitmaps: RoaringBitmap, mode: Optional[str] = None) -> int:
         """FastAggregation.andCardinality (FastAggregation.java:71). On the
         device path only the per-group popcounts come back to host — no

@@ -148,14 +148,34 @@ class PackedGroups:
         held[kind] = held.get(kind, 0) + int(nbytes)
         _RESIDENT_BYTES.inc(int(nbytes), (kind,))
 
-    def __del__(self):
+    def close(self) -> None:
+        """Release the cached device arrays and settle the resident-bytes
+        gauge NOW, instead of whenever GC runs ``__del__`` — a long-lived
+        process that drops working sets without closing them misreports
+        residency for as long as collection is delayed. Idempotent (safe
+        alongside ``__del__``), and a closed working set stays usable: the
+        caches rebuild, re-ship, and re-account on next touch."""
         held = getattr(self, "_resident_held", None)
         if held:
-            try:
-                for kind, nbytes in held.items():
-                    _RESIDENT_BYTES.dec(nbytes, (kind,))
-            except Exception:  # pragma: no cover - interpreter teardown
-                pass
+            for kind, nbytes in held.items():
+                _RESIDENT_BYTES.dec(nbytes, (kind,))
+            held.clear()
+        # drop the cached device arrays so HBM actually frees with the gauge
+        for attr in ("_device_words", "_padded_cache", "_bucket_cache"):
+            if getattr(self, attr, None) is not None:
+                object.__setattr__(self, attr, None)
+
+    def __enter__(self) -> "PackedGroups":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # pragma: no cover - interpreter teardown
+            pass
 
     @property
     def device_words(self) -> jnp.ndarray:

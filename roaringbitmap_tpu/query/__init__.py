@@ -1,0 +1,57 @@
+"""Lazy query expression engine (ISSUE 2 tentpole).
+
+A serving-system hot path evaluates whole boolean expressions —
+``(users_in_A & users_in_B) - opted_out | Q.threshold(2, x, y, z)`` — over
+many bitmaps. The reference library's ``FastAggregation`` chooses an
+algorithm per *call* and leaves operand ordering to the caller; this layer
+plans over the whole expression instead ("beyond unions and intersections",
+PAPERS.md):
+
+* ``expr.py`` — lazy, hash-consed DAG nodes (And/Or/Xor/AndNot/Not over an
+  explicit universe/Threshold(k)) built via operator overloading or the
+  :class:`Q` API; repeated subtrees share one node.
+* ``plan.py`` — exact algebraic rewrites (flattening, De Morgan push-down,
+  difference pull-up, constant folding), a cardinality-driven cost model,
+  and per-node engine selection over the full FastAggregation/device/batch
+  menu; emits an inspectable :class:`Plan` with ``explain()``.
+* ``exec.py`` — bottom-up execution with interior-result memoization in a
+  bounded LRU cache (``cache.py``) keyed by (node, leaf fingerprints), so
+  repeated queries over unchanged bitmaps short-circuit and leaf mutation
+  invalidates by key miss.
+* ``kernels.py`` — the aggregation-gap fillers: n-way ANDNOT and the
+  bit-sliced-adder Threshold(k), each with CPU and packed-device paths.
+
+Quick start::
+
+    from roaringbitmap_tpu.query import Q, execute, plan
+
+    q = (Q.leaf(a) & Q.leaf(b) | Q.leaf(c)) - Q.leaf(opted_out)
+    print(plan(q).explain())           # rewrites + engines + estimates
+    result = execute(q)                # planned, memoized
+    result = execute(q)                # cache hit (bitmaps unchanged)
+"""
+
+from .cache import DEFAULT_CACHE, ResultCache, cache_key
+from .exec import execute
+from .expr import Expr, Leaf, Q, as_expr, evaluate_naive
+from .kernels import andnot_nway, andnot_nway_cardinality, threshold
+from .plan import Plan, PlanStep, plan, rewrite
+
+__all__ = [
+    "Q",
+    "Expr",
+    "Leaf",
+    "as_expr",
+    "evaluate_naive",
+    "plan",
+    "rewrite",
+    "Plan",
+    "PlanStep",
+    "execute",
+    "ResultCache",
+    "DEFAULT_CACHE",
+    "cache_key",
+    "andnot_nway",
+    "andnot_nway_cardinality",
+    "threshold",
+]

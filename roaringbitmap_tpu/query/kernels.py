@@ -1,0 +1,301 @@
+"""N-way ANDNOT and Threshold(k) kernels — the aggregation gap fillers.
+
+The aggregation layer (parallel/aggregation.py) covers n-ary AND/OR/XOR;
+difference exists only pairwise and "element in >= k of N" not at all.
+Both kernels here follow the house two-regime design:
+
+* **andnot_nway(first, \\*rest)** — ``first \\ (rest_1 | ... | rest_n)``.
+  Only ``first``'s keys can survive (the workShyAnd observation applied to
+  subtraction), so the subtrahends transpose into key groups *restricted to
+  first's keys*, the union reduces per group (CPU word fold, or the packed
+  device reduction via ``store.prepare_reduce``), and the subtraction is a
+  single fused ``first & ~union`` mask + popcount — on device this is
+  exactly the ``parallel.batch`` pairwise-mask shape, run once per working
+  set instead of once per operand.
+
+* **threshold(k, bitmaps)** — the bit-sliced adder trick from "beyond
+  unions and intersections": per key group, fold each container's words
+  into a binary counter held as L = ceil(log2(count+1)) bit-slices (XOR =
+  sum bit, AND = carry), then compare the per-bit counters against the
+  constant k with a bitwise >= circuit (one pass MSB->LSB maintaining
+  eq/gt masks). O(N·log N) word-ops instead of materializing per-element
+  counts. The device path runs the same adder as a ``lax.scan`` over the
+  row axis of the dense-padded ``[G, M, W]`` group block (zero fill rows
+  add nothing), with the compare + popcount fused into the same dispatch;
+  distributions too skewed to pad fall back to the CPU fold.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..models.container import BitmapContainer, best_container_of_words
+from ..models.roaring import RoaringBitmap
+from ..utils import bits
+
+
+def _container_words(c) -> np.ndarray:
+    return c.words if isinstance(c, BitmapContainer) else c.to_words()
+
+
+def _rest_groups(first: RoaringBitmap, rest: Sequence[RoaringBitmap]):
+    """Subtrahend containers keyed by first's keys only (other keys cannot
+    affect the difference)."""
+    first_keys = set(first.high_low_container.keys)
+    groups: dict = {}
+    for bm in rest:
+        hlc = bm.high_low_container
+        for k, c in zip(hlc.keys, hlc.containers):
+            if k in first_keys:
+                groups.setdefault(k, []).append(c)
+    return groups
+
+
+def _cpu_folds(first: RoaringBitmap, groups: dict):
+    """The shared CPU core: per key of ``first`` yield ``(key, container,
+    folded_words)`` — folded_words is None for pass-through keys with no
+    subtrahend containers. One fold body serves both the materializing and
+    the count-only entry points so they cannot desynchronize."""
+    hlc = first.high_low_container
+    for k, c in zip(hlc.keys, hlc.containers):
+        cs = groups.get(k)
+        if not cs:
+            yield k, c, None
+            continue
+        acc = c.to_words()
+        for rc in cs:
+            acc &= ~_container_words(rc)
+        yield k, c, acc
+
+
+def andnot_nway(
+    first: RoaringBitmap, *rest: RoaringBitmap, mode: Optional[str] = None
+) -> RoaringBitmap:
+    """``first \\ (rest_1 | rest_2 | ...)`` without materializing the union
+    as a bitmap (single word fold per surviving key)."""
+    from ..parallel.aggregation import _use_device
+
+    if not rest:
+        return first.clone()
+    groups = _rest_groups(first, rest)
+    n_rows = first.high_low_container.size + sum(len(v) for v in groups.values())
+    if groups and _use_device(n_rows, mode):
+        return _device_andnot(first, groups)
+    out = RoaringBitmap()
+    for k, c, acc in _cpu_folds(first, groups):
+        if acc is None:
+            out.high_low_container.append(k, c.clone())
+            continue
+        res = best_container_of_words(acc)
+        if res.cardinality:
+            out.high_low_container.append(k, res)
+    return out
+
+
+def andnot_nway_cardinality(
+    first: RoaringBitmap, *rest: RoaringBitmap, mode: Optional[str] = None
+) -> int:
+    """``|first \\ (rest_1 | ...)|``; the device path fetches only the
+    per-group popcounts (the count-only asymmetry, ARCHITECTURE.md)."""
+    from ..parallel.aggregation import _use_device
+
+    if not rest:
+        return first.get_cardinality()
+    groups = _rest_groups(first, rest)
+    n_rows = first.high_low_container.size + sum(len(v) for v in groups.values())
+    if groups and _use_device(n_rows, mode):
+        _, cards, passthrough = _device_andnot_parts(first, groups)
+        return int(np.asarray(cards).astype(np.int64).sum()) + sum(
+            c.cardinality for _, c in passthrough
+        )
+    return sum(
+        c.cardinality if acc is None else bits.cardinality_of_words(acc)
+        for _k, c, acc in _cpu_folds(first, groups)
+    )
+
+
+def _device_andnot_parts(first: RoaringBitmap, groups: dict):
+    """Shared device core: reduce the subtrahend union per covered key and
+    fuse the ``first & ~union`` mask + popcount into one dispatch. Returns
+    (masked device words [G, 2048], cards [G], passthrough key/container
+    pairs for first's uncovered keys)."""
+    import jax.numpy as jnp
+
+    from ..ops import device as dev
+    from ..parallel import store
+    from .. import tracing
+
+    hlc = first.high_low_container
+    covered = [(k, c) for k, c in zip(hlc.keys, hlc.containers) if k in groups]
+    passthrough = [(k, c) for k, c in zip(hlc.keys, hlc.containers) if k not in groups]
+    with tracing.op_timer("query.andnot.device"):
+        packed = store.pack_groups(groups)
+        run, _layout = store.prepare_reduce(packed, op="or")
+        union, _ = run()
+        first_rows = jnp.asarray(store.pack_rows_host([c for _, c in covered]))
+        masked = first_rows & ~jnp.asarray(union)
+        cards = dev.popcount_rows(masked)
+    return masked, cards, passthrough
+
+
+def _device_andnot(first: RoaringBitmap, groups: dict) -> RoaringBitmap:
+    from ..parallel import store
+
+    masked, cards, passthrough = _device_andnot_parts(first, groups)
+    keys = np.asarray(sorted(groups), dtype=np.int64)
+    computed = dict(
+        store.iter_group_containers(
+            keys, np.asarray(masked), np.asarray(cards).astype(np.int64)
+        )
+    )
+    out = RoaringBitmap()
+    merged = {k: c.clone() for k, c in passthrough}
+    merged.update(computed)
+    for k in sorted(merged):
+        out.high_low_container.append(k, merged[k])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Threshold(k): bit-sliced adder
+# ---------------------------------------------------------------------------
+
+
+def _add_word_slices(slices: List[np.ndarray], carry: np.ndarray) -> None:
+    """Binary counter increment: add the 0/1 word ``carry`` into the LSB of
+    the bit-sliced counter (XOR = sum, AND = carry ripple)."""
+    i = 0
+    while i < len(slices) and carry.any():
+        s = slices[i]
+        slices[i] = s ^ carry
+        carry = s & carry
+        i += 1
+    if carry.any():
+        slices.append(carry)
+
+
+def _ge_const_words(slices: List[np.ndarray], k: int) -> Optional[np.ndarray]:
+    """Bitwise compare of the per-position counters against the constant k:
+    one MSB->LSB pass maintaining equal-so-far / greater masks. None when
+    the counter width cannot reach k."""
+    L = len(slices)
+    if (k >> L) != 0:
+        return None
+    eq = np.full_like(slices[0], ~np.uint64(0))
+    gt = np.zeros_like(slices[0])
+    for b in range(L - 1, -1, -1):
+        s = slices[b]
+        if (k >> b) & 1:
+            eq = eq & s
+        else:
+            gt = gt | (eq & s)
+            eq = eq & ~s
+    return gt | eq
+
+
+def threshold(
+    k: int, bitmaps: Sequence[RoaringBitmap], mode: Optional[str] = None
+) -> RoaringBitmap:
+    """Values present in at least ``k`` of ``bitmaps`` (multiset: a bitmap
+    passed twice counts twice). k=1 is OR, k=N is AND, k>N is empty."""
+    from ..parallel import aggregation, store
+
+    k = int(k)
+    if k < 1:
+        raise ValueError(f"threshold k must be >= 1, got {k}")
+    bms = list(bitmaps)
+    if k > len(bms):
+        return RoaringBitmap()
+    if k == 1:
+        return aggregation.FastAggregation.or_(*bms, mode=mode)
+    if k == len(bms):
+        return aggregation.FastAggregation.and_(*bms, mode=mode)
+    groups = store.group_by_key(bms)
+    # a key present in fewer than k containers can never reach the threshold
+    groups = {key: cs for key, cs in groups.items() if len(cs) >= k}
+    out = RoaringBitmap()
+    if not groups:
+        return out
+    n_rows = sum(len(v) for v in groups.values())
+    if aggregation._use_device(n_rows, mode):
+        dev_out = _device_threshold(groups, k)
+        if dev_out is not None:
+            return dev_out
+    for key in sorted(groups):
+        slices: List[np.ndarray] = []
+        for c in groups[key]:
+            _add_word_slices(slices, c.to_words())
+        words = _ge_const_words(slices, k)
+        if words is None:
+            continue
+        res = best_container_of_words(words)
+        if res.cardinality:
+            out.high_low_container.append(key, res)
+    return out
+
+
+_threshold_steps: dict = {}
+
+
+def _threshold_kernel(k: int, n_slices: int):
+    """Jitted [G, M, W] bit-sliced adder + >=k compare + popcount, one
+    dispatch; cached per (k, slice count) like the batch steps."""
+    fn = _threshold_steps.get((k, n_slices))
+    if fn is None:
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ..ops import device as dev
+
+        def run(words3):
+            g, _m, w = words3.shape
+
+            def body(slices, row):  # slices [L, G, W] uint32, row [G, W]
+                carry = row
+                outs = []
+                for i in range(n_slices):
+                    s = slices[i]
+                    outs.append(s ^ carry)
+                    carry = s & carry
+                return jnp.stack(outs), None
+
+            init = jnp.zeros((n_slices, g, w), dtype=jnp.uint32)
+            slices, _ = lax.scan(body, init, jnp.swapaxes(words3, 0, 1))
+            eq = jnp.full((g, w), jnp.uint32(0xFFFFFFFF))
+            gt = jnp.zeros((g, w), jnp.uint32)
+            for b in range(n_slices - 1, -1, -1):
+                s = slices[b]
+                if (k >> b) & 1:
+                    eq = eq & s
+                else:
+                    gt = gt | (eq & s)
+                    eq = eq & ~s
+            res = gt | eq
+            return res, dev.popcount_rows(res)
+
+        fn = _threshold_steps[(k, n_slices)] = jax.jit(run)
+    return fn
+
+
+def _device_threshold(groups: dict, k: int) -> Optional[RoaringBitmap]:
+    """Dense-padded device path; None when the group distribution is too
+    skewed to pad (caller falls back to the CPU fold)."""
+    from ..parallel import store
+    from .. import tracing
+
+    packed = store.pack_groups(groups)
+    words3 = packed.padded_device(0)  # zero fill rows add nothing to counts
+    if words3 is None:
+        return None
+    m = int(words3.shape[1])
+    n_slices = max(1, m.bit_length())  # counters reach at most m < 2^L
+    if (k >> n_slices) != 0:
+        return RoaringBitmap()
+    with tracing.op_timer("query.threshold.device"):
+        red, cards = _threshold_kernel(k, n_slices)(words3)
+        return store.unpack_to_bitmap(
+            packed.group_keys, np.asarray(red), np.asarray(cards).astype(np.int64)
+        )

@@ -279,6 +279,10 @@ def read_into(bm: RoaringBitmap, data) -> int:
     hlc = bm.high_low_container
     hlc.keys = []
     hlc.containers = []
+    # this refill path rebinds the lists directly (bypassing the mutator
+    # methods), so bump the mutation version by hand — a stale fingerprint
+    # here would let the query result cache serve pre-deserialize results
+    hlc._version += 1
     for i in range(size):
         key = int(keys[i])
         card = int(cards[i])

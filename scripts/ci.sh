@@ -20,10 +20,32 @@ if [[ "${1:-}" != "--fast" ]]; then
   python -m pytest tests/ -q
 fi
 
-step "fuzz smoke (500 iterations x 15 invariant families)"
+step "fuzz smoke (500 iterations x 23 invariant families)"
 python -m roaringbitmap_tpu.fuzz 500 > /tmp/ci_fuzz.log 2>&1 \
   || { tail -20 /tmp/ci_fuzz.log; exit 1; }
 tail -1 /tmp/ci_fuzz.log
+
+step "query engine (differential fuzz + benchmark contract)"
+# planner+executor vs naive set algebra on sampled DAGs (both regimes),
+# then the query benchmark's four-way contract with sane positive timings
+JAX_PLATFORMS=cpu python - <<'EOF'
+from roaringbitmap_tpu import fuzz
+fuzz.verify_query_invariance("ci-query-differential", iterations=40, seed=51)
+fuzz.verify_query_invariance(
+    "ci-query-differential(device)", iterations=15, seed=52, mode="device")
+print("query differential ok (55 DAGs, cpu + forced-device engines)")
+from benchmarks import query
+rs = {r.benchmark: r.value for r in query.run(reps=1, datasets=["census1881"], limit=32)}
+need = {"queryNaive", "queryPlanned", "queryPlannedColdCache", "queryPlannedWarmCache"}
+missing = need - set(rs)
+if missing:
+    raise SystemExit("query bench contract: missing %s" % sorted(missing))
+if not all(v > 0 for v in rs.values()):
+    raise SystemExit("query bench contract: non-positive timing %r" % rs)
+print("query bench ok (planned %.1fx vs naive, warm cache %.1fx)"
+      % (rs["queryNaive"] / rs["queryPlanned"],
+         rs["queryNaive"] / rs["queryPlannedWarmCache"]))
+EOF
 
 step "bench.py --smoke (end-to-end north-star path, CPU)"
 # validate the driver contract, not just the exit code: exactly the keys

@@ -184,6 +184,48 @@ def test_campaign_runner_smoke():
     assert all(1 <= v <= 8 for v in res.values())
 
 
+def test_query_differential_invariant():
+    """ISSUE 2: planner + executor output equals naive recursive set
+    algebra on every sampled DAG (and/or/xor/n-ary andnot/not over an
+    explicit universe/threshold), through a small shared result cache so
+    memoization is part of the property."""
+    from roaringbitmap_tpu.fuzz import verify_query_invariance
+
+    verify_query_invariance(
+        "query-planner-vs-naive", iterations=max(4, ITER // 2), seed=51
+    )
+
+
+def test_query_differential_device_mode():
+    """Same property with every engine forced onto the device regime
+    (runs on the CPU backend like the other mode='device' invariants)."""
+    from roaringbitmap_tpu.fuzz import verify_query_invariance
+
+    verify_query_invariance(
+        "query-planner-vs-naive(device)",
+        iterations=max(2, ITER // 4), seed=52, mode="device",
+    )
+
+
+def test_random_expression_covers_node_kinds():
+    """The generator must produce every node kind across a sample — a
+    degenerate generator would silently gut the differential."""
+    import numpy as np
+
+    from roaringbitmap_tpu.fuzz import random_bitmap, random_expression
+
+    rng = np.random.default_rng(99)
+    seen = set()
+    for _ in range(40):
+        leaves = [random_bitmap(rng) for _ in range(3)]
+        stack = [random_expression(rng, leaves)]
+        while stack:
+            n = stack.pop()
+            seen.add(n.op)
+            stack.extend(n.children)
+    assert {"leaf", "and", "or", "xor", "andnot", "not", "threshold"} <= seen
+
+
 def test_layout_fuzz_rejects_and():
     """Per-key grouped AND has no multi-bitmap oracle; the harness must say
     so instead of reporting spurious failures (code-review regression)."""

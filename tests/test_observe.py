@@ -374,6 +374,31 @@ def test_resident_gauge_rises_and_falls_with_working_set():
     assert gauge.get(("padded_groups",)) == 0
 
 
+def test_packed_groups_close_is_explicit_and_idempotent():
+    """The ISSUE 2 satellite: long-lived processes must not depend on GC
+    timing for truthful residency — close() settles the gauge NOW, the
+    context manager drives it, __del__ after close() is a no-op, and a
+    closed working set re-accounts if touched again."""
+    gauge = observe.REGISTRY.get(observe.STORE_RESIDENT_BYTES)
+    gauge.clear()
+    bms = [RoaringBitmap(np.arange(i, 70000 + i, dtype=np.uint32)) for i in range(3)]
+    with store.pack_groups(store.group_by_key(bms)) as packed:
+        packed.device_words
+        packed.padded_device(0)
+        assert gauge.get(("flat_rows",)) == packed.words.nbytes
+        assert gauge.get(("padded_groups",)) > 0
+    # context exit closed it: gauge settled with the object still alive
+    assert gauge.get(("flat_rows",)) == 0
+    assert gauge.get(("padded_groups",)) == 0
+    packed.close()  # idempotent: no double-decrement below zero
+    assert gauge.get(("flat_rows",)) == 0
+    # a closed set stays usable and re-accounts on next touch
+    packed.device_words
+    assert gauge.get(("flat_rows",)) == packed.words.nbytes
+    del packed  # __del__ closes the re-opened state exactly once
+    assert gauge.get(("flat_rows",)) == 0
+
+
 def test_probe_ledgers_survive_reset_consistently():
     """reset_dispatch_counters leaves BOTH probe views (the _PROBED cache
     and the registry probe counter) alone — clearing only one would make

@@ -1,0 +1,291 @@
+"""Query expression engine (ISSUE 2): DAG construction + hash-consing,
+planner rewrites (exactness asserted structurally), golden explain() string,
+executor-vs-naive differentials (incl. Not over an explicit universe and
+Threshold edge cases), engine parity across forced cpu/device regimes, and
+the observe-registry cache counters."""
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import FastAggregation, Q, RoaringBitmap, observe
+from roaringbitmap_tpu.query import (
+    ResultCache,
+    evaluate_naive,
+    execute,
+    kernels,
+    plan,
+    rewrite,
+)
+
+
+def _bm(*ranges):
+    out = RoaringBitmap()
+    for start, end, step in ranges:
+        out.add_many(np.arange(start, end, step, dtype=np.uint32))
+    return out
+
+
+@pytest.fixture
+def abcd():
+    a = _bm((0, 1000, 2))
+    b = _bm((0, 1000, 3))
+    c = _bm((500, 1500, 1))
+    d = _bm((0, 100, 1))
+    return a, b, c, d
+
+
+# ---------------------------------------------------------------------------
+# DAG construction + hash-consing
+# ---------------------------------------------------------------------------
+
+
+def test_hash_consing_shares_nodes(abcd):
+    a, b, c, _ = abcd
+    assert Q.leaf(a) is Q.leaf(a)
+    assert (Q.leaf(a) & Q.leaf(b)) is (Q.leaf(a) & Q.leaf(b))
+    assert (Q.leaf(a) & Q.leaf(b)) is not (Q.leaf(b) & Q.leaf(a))
+    assert Q.threshold(2, Q.leaf(a), Q.leaf(b)) is Q.threshold(2, Q.leaf(a), Q.leaf(b))
+    assert Q.threshold(2, Q.leaf(a), Q.leaf(b)) is not Q.threshold(
+        3, Q.leaf(a), Q.leaf(b)
+    )
+    # operator overloading coerces raw bitmaps to (the same) leaves
+    assert (Q.leaf(a) & b) is (Q.leaf(a) & Q.leaf(b))
+
+
+def test_shared_subtree_planned_once(abcd):
+    a, b, c, _ = abcd
+    shared = Q.leaf(a) & Q.leaf(b)
+    q = shared | (shared ^ Q.leaf(c))
+    p = plan(q)
+    assert len(p.steps) == 3  # and, xor, or — the AND is CSE'd, not planned twice
+
+
+# ---------------------------------------------------------------------------
+# planner rewrites (structural, on the folded DAG)
+# ---------------------------------------------------------------------------
+
+
+def test_flatten_and_dedup(abcd):
+    a, b, c, _ = abcd
+    r = rewrite(Q.and_(Q.and_(Q.leaf(a), Q.leaf(b)), Q.leaf(c), Q.leaf(a)))
+    assert r.op == "and" and len(r.children) == 3
+    assert rewrite(Q.and_(Q.leaf(a), Q.leaf(a))) is Q.leaf(a)
+
+
+def test_de_morgan_pushdown_fuses_to_nary_andnot(abcd):
+    a, b, c, _ = abcd
+    u = Q.leaf(c)
+    r = rewrite(Q.not_(Q.or_(Q.leaf(a), Q.leaf(b)), u))
+    # U \ (a|b) = (U\a) & (U\b) -> one n-ary difference andnot(U, a, b)
+    assert r.op == "andnot"
+    assert r.children[0] is u
+    assert set(x.uid for x in r.children[1:]) == {Q.leaf(a).uid, Q.leaf(b).uid}
+
+
+def test_double_not_same_universe(abcd):
+    a, _, c, _ = abcd
+    u = Q.leaf(c)
+    r = rewrite(Q.not_(Q.not_(Q.leaf(a), u), u))
+    assert r.op == "and"  # U \ (U \ a) = U & a
+
+
+def test_difference_pull_up_and_chain_flatten(abcd):
+    a, b, c, d = abcd
+    r = rewrite(Q.and_(Q.leaf(a), Q.andnot(Q.leaf(b), Q.leaf(c))))
+    assert r.op == "andnot" and r.children[0].op == "and"
+    r2 = rewrite(Q.andnot(Q.andnot(Q.leaf(a), Q.leaf(b)), Q.leaf(c), Q.leaf(d)))
+    assert r2.op == "andnot" and len(r2.children) == 4  # a \ (b|c|d)
+
+
+def test_constant_folding(abcd):
+    a, b, _, _ = abcd
+    empty = Q.leaf(RoaringBitmap())
+    assert rewrite(Q.and_(Q.leaf(a), empty)).op == "leaf"
+    assert rewrite(Q.and_(Q.leaf(a), empty)).bitmap.is_empty()
+    assert rewrite(Q.or_(Q.leaf(a), empty)) is Q.leaf(a)
+    assert rewrite(Q.xor(Q.leaf(a), Q.leaf(a))).bitmap.is_empty()
+    assert rewrite(Q.andnot(Q.leaf(a), Q.leaf(a))).bitmap.is_empty()
+    assert rewrite(Q.andnot(Q.leaf(a), empty)) is Q.leaf(a)
+    assert rewrite(Q.threshold(3, Q.leaf(a), Q.leaf(b))).bitmap.is_empty()
+    assert rewrite(Q.threshold(1, Q.leaf(a), Q.leaf(b))).op == "or"
+    assert rewrite(Q.threshold(2, Q.leaf(a), Q.leaf(b))).op == "and"
+
+
+def test_threshold_k_validation(abcd):
+    a, _, _, _ = abcd
+    with pytest.raises(ValueError, match="k must be >= 1"):
+        Q.threshold(0, Q.leaf(a))
+
+
+# ---------------------------------------------------------------------------
+# golden explain()
+# ---------------------------------------------------------------------------
+
+
+def test_explain_golden(abcd):
+    a, b, c, d = abcd
+    q = (Q.leaf(a) & Q.leaf(b) | Q.leaf(c)) - Q.leaf(d)
+    assert plan(q).explain() == "\n".join(
+        [
+            "plan: 3 steps over 4 leaves",
+            "  L0 leaf card=500",
+            "  L1 leaf card=334",
+            "  L2 leaf card=1000",
+            "  L3 leaf card=100",
+            "  s0 and(L1, L0) engine=pairwise est_card=334 est_rows=2",
+            "  s1 or(s0, L2) engine=pairwise est_card=1334 est_rows=3",
+            "  s2 andnot(s1, L3) engine=pairwise est_card=1334 est_rows=4",
+            "  root: s2",
+        ]
+    )
+    # stable across replans
+    assert plan(q).explain() == plan(q).explain()
+
+
+def test_explain_shows_device_engines_and_threshold(abcd):
+    a, b, c, d = abcd
+    p = plan(Q.or_(Q.leaf(a), Q.leaf(b), Q.leaf(c)), mode="device")
+    assert "engine=device-or" in p.explain()
+    p2 = plan(Q.threshold(2, Q.leaf(a), Q.leaf(b), Q.leaf(c), Q.leaf(d)))
+    assert "threshold[k=2](L0, L1, L2, L3) engine=threshold-bitsliced[cpu]" in p2.explain()
+
+
+def test_and_operands_ordered_ascending(abcd):
+    a, b, c, _ = abcd  # cards: a=500, b=334, c=1000
+    p = plan(Q.and_(Q.leaf(a), Q.leaf(b), Q.leaf(c)))
+    (step,) = p.steps
+    cards = [o.bitmap.get_cardinality() for o in step.operands]
+    assert cards == sorted(cards) == [334, 500, 1000]
+
+
+# ---------------------------------------------------------------------------
+# executor vs naive (the acceptance differential)
+# ---------------------------------------------------------------------------
+
+
+def _random_leaves(rng, n):
+    from roaringbitmap_tpu.fuzz import random_bitmap
+
+    return [random_bitmap(rng) for _ in range(n)]
+
+
+@pytest.mark.parametrize("mode", [None, "cpu", "device"])
+def test_randomized_dags_match_naive(mode):
+    from roaringbitmap_tpu.fuzz import random_expression
+
+    rng = np.random.default_rng(77)
+    cache = ResultCache(max_entries=16)
+    for _ in range(12):
+        leaves = _random_leaves(rng, int(rng.integers(2, 5)))
+        expr = random_expression(rng, leaves)
+        assert execute(expr, cache=cache, mode=mode) == evaluate_naive(expr)
+
+
+def test_not_over_explicit_universe(abcd):
+    a, b, _, _ = abcd
+    u = Q.leaf(_bm((0, 600, 1)))  # universe smaller than the operands
+    q = Q.not_(Q.leaf(a) ^ Q.leaf(b), u)
+    got = execute(q)
+    want = evaluate_naive(q)
+    assert got == want
+    # spot-check semantics: U \ (a ^ b), values outside U never appear
+    assert got.contains_bitmap(RoaringBitmap()) and (got.is_empty() or got.last() < 600)
+
+
+def test_threshold_edge_cases(abcd):
+    a, b, c, _ = abcd
+    leaves = [Q.leaf(a), Q.leaf(b), Q.leaf(c)]
+    n = len(leaves)
+    union = evaluate_naive(Q.or_(*leaves))
+    inter = evaluate_naive(Q.and_(*leaves))
+    assert execute(Q.threshold(1, *leaves)) == union  # k=1 == OR
+    assert execute(Q.threshold(n, *leaves)) == inter  # k=N == AND
+    assert execute(Q.threshold(n + 1, *leaves)).is_empty()  # k>N
+    for k in range(1, n + 2):
+        t = Q.threshold(k, *leaves)
+        assert execute(t) == evaluate_naive(t), k
+        assert execute(t, mode="device") == evaluate_naive(t), k
+    # multiset: a repeated child counts with multiplicity
+    t2 = Q.threshold(2, Q.leaf(a), Q.leaf(a))
+    assert execute(t2) == a
+
+
+def test_threshold_kernel_direct_general_k(abcd):
+    a, b, c, d = abcd
+    bms = [a, b, c, d]
+    for k in (2, 3):
+        want = evaluate_naive(Q.threshold(k, *[Q.leaf(x) for x in bms]))
+        assert kernels.threshold(k, bms, mode="cpu") == want
+        assert kernels.threshold(k, bms, mode="device") == want
+
+
+def test_andnot_nway_kernel_and_wrappers(abcd):
+    a, b, c, d = abcd
+    want = evaluate_naive(Q.andnot(Q.leaf(c), Q.leaf(a), Q.leaf(b), Q.leaf(d)))
+    assert kernels.andnot_nway(c, a, b, d, mode="cpu") == want
+    assert kernels.andnot_nway(c, a, b, d, mode="device") == want
+    assert FastAggregation.andnot(c, a, b, d) == want
+    for mode in ("cpu", "device"):
+        assert (
+            FastAggregation.andnot_cardinality(c, a, b, d, mode=mode)
+            == want.get_cardinality()
+        )
+    # degenerate arities
+    assert FastAggregation.andnot(c) == c
+    assert kernels.andnot_nway(RoaringBitmap(), a).is_empty()
+
+
+# ---------------------------------------------------------------------------
+# cache counters in the observe registry (acceptance)
+# ---------------------------------------------------------------------------
+
+
+def test_cache_hit_counter_and_mutation_reset(abcd):
+    a, b, c, d = abcd
+    counter = observe.REGISTRY.get(observe.QUERY_CACHE_TOTAL)
+    q = (Q.leaf(a) & Q.leaf(b) | Q.leaf(c)) - Q.leaf(d)
+    cache = ResultCache(max_entries=64)
+
+    execute(q, cache=cache)  # cold: all misses
+    assert cache.stats()["hits"] == 0 and cache.stats()["misses"] > 0
+    base_hits = counter.get(("hit",))
+    first = execute(q, cache=cache)  # warm: every step short-circuits
+    assert counter.get(("hit",)) > base_hits  # registry hit counter rose
+    assert cache.stats()["hits"] == len(plan(q).steps)
+
+    # leaf mutation bumps the fingerprint: the warm keys miss, the query
+    # recomputes against the new contents, and the hit-rate resets
+    # (105 is an odd multiple of 3 outside c's and d's ranges, so a&b —
+    # and with it the query result — gains it)
+    a.add(105)
+    hits_before = cache.stats()["hits"]
+    got = execute(q, cache=cache)
+    assert cache.stats()["hits"] == hits_before  # zero hits on this run
+    assert got == evaluate_naive(q) and got != first
+    # and warms back up
+    execute(q, cache=cache)
+    assert cache.stats()["hits"] == hits_before + len(plan(q).steps)
+
+
+def test_returned_bitmap_is_private(abcd):
+    a, b, _, _ = abcd
+    cache = ResultCache()
+    q = Q.leaf(a) & Q.leaf(b)
+    r1 = execute(q, cache=cache)
+    r1.add_range(0, 1 << 20)  # caller mutation must not corrupt the cache
+    assert execute(q, cache=cache) == evaluate_naive(q)
+
+
+def test_execute_without_cache(abcd):
+    a, b, _, _ = abcd
+    q = Q.leaf(a) ^ Q.leaf(b)
+    assert execute(q, cache=None) == evaluate_naive(q)
+
+
+def test_leaf_root_and_prebuilt_plan(abcd):
+    a, _, _, _ = abcd
+    assert execute(Q.leaf(a)) == a
+    q = Q.leaf(a) | Q.leaf(a)  # folds to the leaf
+    p = plan(q)
+    assert not p.steps
+    assert execute(p) == a

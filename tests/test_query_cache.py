@@ -1,0 +1,243 @@
+"""Query result cache (query/cache.py): LRU hit/miss/eviction semantics,
+the byte budget, fingerprint-keyed invalidation on leaf mutation across
+every mutator family, and a thread-safety hammer mirroring
+tests/test_observe.py style."""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import Q, RoaringBitmap
+from roaringbitmap_tpu.query import ResultCache, cache_key, evaluate_naive, execute
+
+
+def _bm(start, end, step=1):
+    return RoaringBitmap(np.arange(start, end, step, dtype=np.uint32))
+
+
+# ---------------------------------------------------------------------------
+# LRU semantics
+# ---------------------------------------------------------------------------
+
+
+def test_hit_miss_and_lru_eviction():
+    c = ResultCache(max_entries=2)
+    r1, r2, r3 = _bm(0, 10), _bm(10, 20), _bm(20, 30)
+    assert c.get(("k1",)) is None  # miss
+    c.put(("k1",), r1)
+    c.put(("k2",), r2)
+    assert c.get(("k1",)) is r1  # hit refreshes recency
+    c.put(("k3",), r3)  # evicts k2 (LRU), not the just-touched k1
+    assert c.get(("k2",)) is None
+    assert c.get(("k1",)) is r1 and c.get(("k3",)) is r3
+    s = c.stats()
+    assert s["hits"] == 3 and s["misses"] == 2 and s["evictions"] == 1
+    assert s["entries"] == len(c) == 2
+
+
+def test_put_same_key_replaces_without_eviction():
+    c = ResultCache(max_entries=2)
+    c.put(("k",), _bm(0, 10))
+    c.put(("k",), _bm(0, 20))
+    assert len(c) == 1 and c.stats()["evictions"] == 0
+    assert c.get(("k",)).get_cardinality() == 20
+
+
+def test_byte_budget_eviction():
+    big = _bm(0, 200_000)
+    small = _bm(0, 64)
+    c = ResultCache(max_entries=64, max_bytes=big.get_size_in_bytes() + 1)
+    c.put(("big",), big)
+    c.put(("small",), small)  # pushes bytes over budget -> big evicted first
+    assert ("big",) not in c and ("small",) in c
+    assert c.stats()["bytes"] == small.get_size_in_bytes()
+
+
+def test_clear_and_validation():
+    c = ResultCache(max_entries=4)
+    c.put(("k",), _bm(0, 4))
+    c.clear()
+    assert len(c) == 0 and c.stats()["bytes"] == 0
+    with pytest.raises(ValueError):
+        ResultCache(max_entries=0)
+
+
+# ---------------------------------------------------------------------------
+# fingerprint-keyed invalidation
+# ---------------------------------------------------------------------------
+
+
+def test_fingerprint_bumps_on_every_mutator_family():
+    bm = _bm(0, 1000, 3)
+    seen = {bm.fingerprint()}
+
+    def mutated():
+        fp = bm.fingerprint()
+        fresh = fp not in seen
+        seen.add(fp)
+        return fresh
+
+    bm.add(7)
+    assert mutated()
+    bm.remove(7)
+    assert mutated()
+    bm.add_many(np.arange(5000, 5100, dtype=np.uint32))
+    assert mutated()
+    bm.add_range(1 << 20, (1 << 20) + 50)
+    assert mutated()
+    bm.remove_range(1 << 20, (1 << 20) + 10)
+    assert mutated()
+    bm.flip_range(0, 100)
+    assert mutated()
+    bm.ior(_bm(9000, 9100))
+    assert mutated()
+    bm.iand(_bm(0, 1 << 21))
+    assert mutated()
+    bm.ixor(_bm(40, 60))
+    assert mutated()
+    bm.iandnot(_bm(40, 50))
+    assert mutated()
+    bm.clear()
+    assert mutated()
+
+
+def test_fingerprint_stable_across_reads():
+    bm = _bm(0, 100_000, 7)
+    fp = bm.fingerprint()
+    bm.contains(49)
+    bm.get_cardinality()
+    bm.rank(1000)
+    bm.to_array()
+    bm.serialize()
+    assert bm.fingerprint() == fp
+    assert bm.clone().fingerprint() != fp  # a clone is a distinct identity
+
+
+def test_cache_key_tracks_leaf_mutation():
+    a, b = _bm(0, 100), _bm(50, 150)
+    q = Q.leaf(a) & Q.leaf(b)
+    fps = {l.uid: l.fingerprint() for l in q.leaves}
+    k1 = cache_key(q, fps)
+    a.add(1234)
+    fps2 = {l.uid: l.fingerprint() for l in q.leaves}
+    assert cache_key(q, fps2) != k1
+
+
+def test_stale_entries_age_out_after_mutation():
+    """Mutating a leaf in a loop must not grow the cache unboundedly: old
+    fingerprints' entries fall off the LRU tail."""
+    a, b = _bm(0, 1000, 2), _bm(0, 1000, 5)
+    q = Q.leaf(a) & Q.leaf(b)
+    cache = ResultCache(max_entries=4)
+    for i in range(20):
+        a.add(100_000 + i)
+        assert execute(q, cache=cache) == evaluate_naive(q)
+    assert len(cache) <= 4
+
+
+def test_fingerprint_bumps_on_deserialize_into():
+    """read_into refills the container array by rebinding its lists, which
+    bypasses the versioned mutators — it must bump the version itself or
+    the result cache serves pre-deserialize results (code-review fix)."""
+    from roaringbitmap_tpu import serialization
+
+    a = _bm(0, 100)
+    b = _bm(0, 1000)
+    q = Q.leaf(a) & Q.leaf(b)
+    cache = ResultCache()
+    assert execute(q, cache=cache).get_cardinality() == 100
+    fp = a.fingerprint()
+    serialization.read_into(a, _bm(5000, 5600).serialize())
+    assert a.fingerprint() != fp
+    got = execute(q, cache=cache)
+    assert got == evaluate_naive(q) and got.is_empty()
+
+
+def test_plan_memoized_on_warm_path():
+    """Repeated execute() over unchanged leaves must not replan (planning
+    reads every leaf; the warm path should be cache probes only), and a
+    leaf mutation must re-plan by fingerprint-key miss."""
+    from roaringbitmap_tpu import tracing
+
+    a, b, c = _bm(0, 1000, 2), _bm(0, 1000, 3), _bm(200, 800)
+    q = (Q.leaf(a) & Q.leaf(b)) | Q.leaf(c)
+    cache = ResultCache()
+
+    def plan_count():
+        return tracing.timings().get("query.plan", {}).get("count", 0)
+
+    execute(q, cache=cache)
+    warm = plan_count()
+    for _ in range(3):
+        execute(q, cache=cache)
+    assert plan_count() == warm  # served from the plan memo
+    a.add(7)
+    execute(q, cache=cache)
+    assert plan_count() == warm + 1  # mutation re-planned once
+
+
+# ---------------------------------------------------------------------------
+# thread safety (test_observe.py hammer style)
+# ---------------------------------------------------------------------------
+
+
+def test_cache_hammer_threadsafe():
+    """8 writers x 500 get/put rounds over 16 shared keys: counters add up
+    exactly (hits + misses == gets) and nothing is lost or corrupted."""
+    c = ResultCache(max_entries=8)
+    payloads = {k: _bm(k * 10, k * 10 + 10) for k in range(16)}
+
+    def work(i):
+        for j in range(500):
+            k = ((i + j) % 16,)
+            got = c.get(k)
+            if got is None:
+                c.put(k, payloads[k[0]])
+            else:
+                assert got.get_cardinality() == 10
+
+    with ThreadPoolExecutor(max_workers=8) as ex:
+        list(ex.map(work, range(8)))
+    s = c.stats()
+    assert s["hits"] + s["misses"] == 8 * 500
+    assert len(c) <= 8
+
+
+def test_execute_hammer_shared_cache():
+    """Concurrent executions of overlapping queries through one shared
+    cache all return correct results."""
+    rng = np.random.default_rng(5)
+    leaves = [
+        RoaringBitmap(rng.choice(1 << 16, size=500, replace=False).astype(np.uint32))
+        for _ in range(4)
+    ]
+    qs = [
+        Q.leaf(leaves[0]) & Q.leaf(leaves[1]),
+        (Q.leaf(leaves[0]) & Q.leaf(leaves[1])) | Q.leaf(leaves[2]),
+        Q.andnot(Q.leaf(leaves[2]), Q.leaf(leaves[3])),
+        Q.threshold(2, *[Q.leaf(l) for l in leaves]),
+    ]
+    wants = [evaluate_naive(q) for q in qs]
+    cache = ResultCache(max_entries=32)
+    errors = []
+    barrier = threading.Barrier(8)
+
+    def work(i):
+        try:
+            barrier.wait(timeout=10)
+            for j in range(50):
+                qi = (i + j) % len(qs)
+                if execute(qs[qi], cache=cache) != wants[qi]:
+                    errors.append((i, j, qi))
+        except Exception as e:  # pragma: no cover - surfaced via assert
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert cache.stats()["hits"] > 0
