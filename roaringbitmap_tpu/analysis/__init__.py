@@ -1,0 +1,56 @@
+"""Project-native static analysis (ISSUE 3): machine-checked invariants
+next to the test matrix, the analogue of the reference's per-push analysis
+workflow (.github/workflows/java-all-versions.yml).
+
+Five rules (analysis/rules/):
+
+* ``dtype-discipline``  — container payloads stay uint16/uint64; signed
+  sub-64-bit intermediates on payload paths need a justifying pragma.
+* ``trace-safety``      — no Python control flow or host syncs on traced
+  values inside jax.jit / Pallas entry points.
+* ``lock-discipline``   — state annotated ``# guarded-by: <lock>`` is
+  written only inside ``with <lock>:``.
+* ``exception-hygiene`` — broad excepts re-raise or carry a pragma.
+* ``metric-naming``     — observe/ registrations use ``rb_tpu_`` names
+  with declared label sets.
+
+CLI: ``python scripts/analyze.py [--check] [--json]``; baseline in
+ANALYSIS_BASELINE.json keeps pre-existing findings from blocking while new
+ones fail CI (see baseline.py). ``lockwitness`` is the dynamic complement:
+a lock-acquisition-order recorder the thread-hammer tests assert on.
+
+The analysis modules themselves are pure stdlib (ast/tokenize/hashlib);
+scripts/analyze.py additionally reports per-rule finding counts into the
+observe registry (``rb_tpu_analysis_findings_total``) when run in-process.
+"""
+
+from .core import (
+    CHECKERS,
+    Checker,
+    FileContext,
+    Finding,
+    RunResult,
+    all_rule_ids,
+    fingerprints,
+    iter_python_files,
+    register,
+    run_checks,
+)
+from . import baseline
+from .lockwitness import LockOrderError, LockWitness
+
+__all__ = [
+    "CHECKERS",
+    "Checker",
+    "FileContext",
+    "Finding",
+    "RunResult",
+    "all_rule_ids",
+    "baseline",
+    "fingerprints",
+    "iter_python_files",
+    "register",
+    "run_checks",
+    "LockOrderError",
+    "LockWitness",
+]

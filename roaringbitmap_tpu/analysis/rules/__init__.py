@@ -1,0 +1,9 @@
+"""The five project-native rules. Importing this package registers every
+checker in ``core.CHECKERS``; add a module here (with ``@register``) to
+grow the rule set."""
+
+from . import dtype  # noqa: F401
+from . import exceptions  # noqa: F401
+from . import locks  # noqa: F401
+from . import metrics  # noqa: F401
+from . import trace_safety  # noqa: F401

@@ -1,0 +1,72 @@
+"""exception-hygiene: broad catches are justified or they are bugs.
+
+A bare ``except:`` or ``except Exception`` that swallows is how kernel
+dispatch bugs hide — the Pallas probe path *deliberately* catches
+everything (a Mosaic lowering error must degrade to XLA, not crash the
+aggregation), but that judgement belongs in the source, not a reviewer's
+memory. The rule:
+
+* ``except:`` / ``except Exception`` / ``except BaseException`` (alone or
+  in a tuple) requires ``# rb-ok: exception-hygiene <why>`` on the line
+  (or the comment line above);
+* a handler with a top-level ``raise`` is exempt — re-wrapping into a
+  domain error (fuzz.InvarianceFailure) or cleanup-then-reraise
+  (observe/export._atomic_write) is not a swallow;
+* narrow catches (``except (ImportError, RuntimeError)``) never need a
+  pragma — prefer narrowing where the error taxonomy is stable.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..core import Checker, FileContext, Finding, dotted_name, register
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(type_node) -> bool:
+    if type_node is None:
+        return True  # bare except
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(el) for el in type_node.elts)
+    name = dotted_name(type_node)
+    return name is not None and name.rsplit(".", 1)[-1] in _BROAD
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    # a top-level raise anywhere in the handler: both immediate re-wraps
+    # (`raise Domain(...) from e`) and cleanup-then-`raise` are not swallows
+    return any(isinstance(stmt, ast.Raise) for stmt in handler.body)
+
+
+@register
+class ExceptionHygiene(Checker):
+    rule_id = "exception-hygiene"
+    description = (
+        "bare/broad `except Exception` must re-raise or carry a "
+        "justifying `# rb-ok: exception-hygiene` pragma"
+    )
+    severity = "error"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node.type):
+                continue
+            if _reraises(node):
+                continue
+            what = (
+                "bare except"
+                if node.type is None
+                else f"except {ast.unparse(node.type)}"
+            )
+            yield self.finding(
+                ctx,
+                node,
+                f"{what} swallows unexpected failures: narrow the type, "
+                f"re-raise, or justify with "
+                f"`# rb-ok: {self.rule_id} <why degrading is safe>`",
+            )

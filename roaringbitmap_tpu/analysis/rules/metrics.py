@@ -1,0 +1,205 @@
+"""metric-naming: every metric registered through observe/ uses the
+``rb_tpu_`` prefix with a declared (literal) label set.
+
+The registry's convention (observe/registry.py) is ``rb_tpu_<layer>_<name>``
+so a Prometheus scrape of a fleet is groupable by layer; a stray prefix or
+a computed label tuple silently forks the namespace. Checked per
+registration call (``observe.counter(...)`` / ``_observe.gauge(...)`` /
+``_registry.histogram(...)`` / ``REGISTRY.counter(...)``):
+
+* a literal name must start with ``rb_tpu_``;
+* an ALL_CAPS constant reference is accepted when it is either defined in
+  another scanned module (the canonical-name block in registry.py, which
+  this rule validates directly via the constant check below) or resolves
+  in-file to a compliant literal;
+* a computed name (f-string, concatenation, lowercase variable) is flagged
+  — metric names are declared, not built;
+* ``labelnames`` (3rd positional or keyword) must be a literal tuple/list
+  of string literals (or absent);
+* any module-level ``ALL_CAPS = "rb..."`` string constant must start with
+  ``rb_tpu_`` (this is what validates registry.py's canonical names).
+
+Forwarding wrappers (a call whose name argument is the enclosing
+function's own ``name`` parameter, e.g. the module-level ``counter()``
+helpers in registry.py) are exempt — the real declaration is at their
+call sites.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, Optional, Set
+
+from ..core import Checker, FileContext, Finding, dotted_name, register
+
+PREFIX = "rb_tpu_"
+_REG_METHODS = {"counter", "gauge", "histogram"}
+_ALL_CAPS = re.compile(r"^[A-Z][A-Z0-9_]*$")
+# constant names that read as canonical metric names (unit-suffixed)
+_SHAPED_CONST = re.compile(r"^[A-Z][A-Z0-9_]*_(TOTAL|SECONDS|BYTES|COUNT)$")
+
+
+def _literal_label_tuple(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(
+            isinstance(el, ast.Constant) and isinstance(el.value, str)
+            for el in node.elts
+        )
+    # () default shows up as an empty tuple; a lone string is a caller bug
+    # the registry itself rejects, not a naming issue
+    return False
+
+
+def _function_spans(tree: ast.AST):
+    """[(lineno, end_lineno, param-name set)] for every def, computed once
+    per file (the per-call lookup below is then a linear scan of defs, not
+    a full-tree walk)."""
+    spans = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            a = node.args
+            names = {x.arg for x in a.posonlyargs + a.args + a.kwonlyargs}
+            for star in (a.vararg, a.kwarg):
+                if star is not None:
+                    names.add(star.arg)
+            spans.append((node.lineno, node.end_lineno or node.lineno, names))
+    return spans
+
+
+def _enclosing_function_params(spans, call: ast.Call) -> Set[str]:
+    best = None
+    for lineno, end, names in spans:
+        if lineno <= call.lineno <= end and (best is None or lineno >= best[0]):
+            best = (lineno, names)
+    return best[1] if best else set()
+
+
+@register
+class MetricNaming(Checker):
+    rule_id = "metric-naming"
+    description = (
+        "metrics registered via observe/ use the rb_tpu_ prefix with "
+        "declared literal label sets"
+    )
+    severity = "error"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        # module-level ALL_CAPS string constants (the canonical-name block)
+        constants: Dict[str, str] = {}
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if (
+                    isinstance(t, ast.Name)
+                    and _ALL_CAPS.match(t.id)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)
+                ):
+                    constants[t.id] = node.value.value
+                    v = node.value.value
+                    # a constant is metric-name-shaped when its VALUE
+                    # carries the rb prefix / a Prometheus unit suffix, or
+                    # its NAME does (SPAN_SECONDS etc.) — the name-shape
+                    # half pairs with the use-site rule below: cross-module
+                    # references are only accepted for shaped names, and
+                    # shaped names are validated here where they're defined
+                    looks_like_metric = (
+                        v.startswith("rb")
+                        or re.search(r"_(total|seconds|bytes|count)$", v)
+                        or _SHAPED_CONST.match(t.id)
+                    )
+                    if looks_like_metric and not v.startswith(PREFIX):
+                        yield self.finding(
+                            ctx,
+                            node,
+                            f"metric-name constant {t.id} = {v!r} does not "
+                            f"use the {PREFIX!r} prefix",
+                        )
+
+        spans = _function_spans(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted_name(node.func)
+            if fname is None:
+                continue
+            tail = fname.rsplit(".", 1)[-1]
+            if tail not in _REG_METHODS:
+                continue
+            # registration needs at least the name argument
+            if not node.args and not any(k.arg == "name" for k in node.keywords):
+                continue
+            name_arg = node.args[0] if node.args else next(
+                k.value for k in node.keywords if k.arg == "name"
+            )
+            # forwarding wrapper: counter(name, ...) inside def counter(name,
+            # ...) — including the star form, counter(*args, **kw)
+            fwd = name_arg.value if isinstance(name_arg, ast.Starred) else name_arg
+            if (
+                isinstance(fwd, ast.Name)
+                and fwd.id in _enclosing_function_params(spans, node)
+            ):
+                continue
+            yield from self._check_name(ctx, node, name_arg, constants)
+            yield from self._check_labels(ctx, node)
+
+    def _check_name(self, ctx, call, name_arg, constants) -> Iterable[Finding]:
+        if isinstance(name_arg, ast.Constant) and isinstance(name_arg.value, str):
+            if not name_arg.value.startswith(PREFIX):
+                yield self.finding(
+                    ctx,
+                    call,
+                    f"metric name {name_arg.value!r} must start with "
+                    f"{PREFIX!r} (rb_tpu_<layer>_<name> convention)",
+                )
+            return
+        term = dotted_name(name_arg)
+        term = term.rsplit(".", 1)[-1] if term else None
+        if term is not None and _ALL_CAPS.match(term):
+            val = constants.get(term)
+            if val is not None:
+                # in-file constant: resolve and validate the value here
+                if not val.startswith(PREFIX):
+                    yield self.finding(
+                        ctx,
+                        call,
+                        f"metric registered under constant {term} = {val!r} "
+                        f"which lacks the {PREFIX!r} prefix",
+                    )
+            elif not _SHAPED_CONST.match(term):
+                # cross-module constants are accepted only when the NAME is
+                # metric-shaped — that shape is exactly what the
+                # definition-site check validates in the defining module, so
+                # an unshaped name here would escape both checks
+                yield self.finding(
+                    ctx,
+                    call,
+                    f"metric name constant {term} is neither defined in this "
+                    f"module nor unit-suffixed (_TOTAL/_SECONDS/_BYTES/"
+                    f"_COUNT): the prefix cannot be verified",
+                )
+            return
+        yield self.finding(
+            ctx,
+            call,
+            "metric name must be a string literal or ALL_CAPS constant "
+            "(computed names fork the metric namespace)",
+        )
+
+    def _check_labels(self, ctx, call) -> Iterable[Finding]:
+        label_arg = None
+        if len(call.args) >= 3:
+            label_arg = call.args[2]
+        for kw in call.keywords:
+            if kw.arg == "labelnames":
+                label_arg = kw.value
+        if label_arg is None:
+            return
+        if not _literal_label_tuple(label_arg):
+            yield self.finding(
+                ctx,
+                call,
+                "labelnames must be a literal tuple of string literals "
+                "(declared label sets, not computed)",
+            )

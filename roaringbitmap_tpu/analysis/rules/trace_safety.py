@@ -1,0 +1,269 @@
+"""trace-safety: no host sync or Python control flow on traced values
+inside jit/Pallas entry points.
+
+Inside a function staged by ``jax.jit`` (or handed to ``pl.pallas_call``),
+Python ``if``/``while``/``int()``/``float()``/``bool()`` on a traced value
+raises a ConcretizationTypeError at best and silently forces a device→host
+sync at worst; ``.item()``/``.tolist()``/``np.asarray``/``jax.device_get``
+are unconditional syncs. The reference library has no analogue (the JVM has
+no tracing); for this port the invariant is load-bearing — every hot
+aggregation routes through jitted entry points in ops/ and parallel/.
+
+Detection, per module:
+
+* traced entry points: ``def`` decorated with ``jax.jit`` / ``jit`` /
+  ``[functools.]partial(jax.jit, ...)``, functions wrapped as
+  ``jax.jit(f)``, and kernels passed to ``[pl.]pallas_call(f, ...)``.
+* static arguments (``static_argnames=`` / ``static_argnums=`` literals)
+  are exempt — Python control flow on them is resolved at trace time.
+* one-level closure: module-local functions *called from* a traced body
+  are checked for the unconditional syncs only (``.item``/``.tolist``/
+  ``jax.device_get``/``block_until_ready``) — their parameters' tracedness
+  is unknown, so value-flow checks stay at the entry point.
+* ``np.array``/``np.asarray`` are flagged only when fed a traced value —
+  building a trace-time constant table inside a jitted function is fine.
+
+Shape access is static under trace: expressions reaching a traced name
+only through ``.shape``/``.ndim``/``.size``/``.dtype``/``len()`` are fine.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core import Checker, FileContext, Finding, dotted_name, register
+
+_SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+# unconditional host syncs: flagged wherever they appear in traced code
+# (dotted or bare from-import spelling — the names are distinctive)
+_SYNC_CALLS = {
+    "jax.device_get",
+    "jax.block_until_ready",
+    "device_get",
+    "block_until_ready",
+}
+# host materializers: legitimate on trace-time constants (np.array lookup
+# tables), a sync only when fed a traced value — gated on taint
+_MATERIALIZERS = {
+    "np.asarray",
+    "np.array",
+    "np.ascontiguousarray",
+    "numpy.asarray",
+    "numpy.array",
+    "numpy.ascontiguousarray",
+}
+_CONCRETIZERS = {"int", "float", "bool"}
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype"}
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """True for ``jax.jit`` / ``jit`` references."""
+    name = dotted_name(node)
+    return name in ("jax.jit", "jit")
+
+
+def _static_names_from_call(call: ast.Call, params: List[str]) -> Set[str]:
+    """Literal static_argnames/static_argnums from a jit(...) call."""
+    statics: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                statics.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for el in v.elts:
+                    if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                        statics.add(el.value)
+        elif kw.arg == "static_argnums":
+            nums: List[int] = []
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                nums = [v.value]
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                nums = [
+                    el.value
+                    for el in v.elts
+                    if isinstance(el, ast.Constant) and isinstance(el.value, int)
+                ]
+            for n in nums:
+                if 0 <= n < len(params):
+                    statics.add(params[n])
+    return statics
+
+
+def _jit_decoration(fn: ast.FunctionDef, params: List[str]) -> Optional[Set[str]]:
+    """Static-param set if ``fn`` is jit-decorated, else None."""
+    for dec in fn.decorator_list:
+        if _is_jit_expr(dec):
+            return set()
+        if isinstance(dec, ast.Call):
+            dn = dotted_name(dec.func)
+            if dn in ("functools.partial", "partial") and dec.args:
+                if _is_jit_expr(dec.args[0]):
+                    return _static_names_from_call(dec, params)
+            elif _is_jit_expr(dec.func):
+                return _static_names_from_call(dec, params)
+    return None
+
+
+def _param_names(fn: ast.FunctionDef) -> List[str]:
+    """Positional params in order, then keyword-only (jit traces kwonly
+    arguments too; only the positional prefix matters for static_argnums)."""
+    a = fn.args
+    return [x.arg for x in a.posonlyargs + a.args + a.kwonlyargs]
+
+
+def _taints(node: ast.AST, traced: Set[str]) -> bool:
+    """True when the expression can reach a traced name as a *value* —
+    access through .shape/.ndim/.size/.dtype or len() is static."""
+    if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+        return False
+    if isinstance(node, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+    ):
+        # `x is None` is a pytree-structure check, resolved at trace time
+        return False
+    if isinstance(node, ast.Call):
+        fname = dotted_name(node.func)
+        if fname == "len":
+            return False
+        if isinstance(node.func, ast.Attribute):
+            # x.get_cardinality() etc: recurse into the receiver + args
+            return any(_taints(c, traced) for c in [node.func.value, *node.args])
+    if isinstance(node, ast.Name):
+        return node.id in traced
+    return any(_taints(c, traced) for c in ast.iter_child_nodes(node))
+
+
+@register
+class TraceSafety(Checker):
+    rule_id = "trace-safety"
+    description = (
+        "no Python control flow / host syncs on traced values inside "
+        "jax.jit or Pallas entry points"
+    )
+    severity = "error"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        # --- collect traced functions -----------------------------------
+        # name -> (FunctionDef, static param names); funcs wrapped by call
+        # sites (jax.jit(f), pallas_call(f)) have no static info -> set()
+        defs: Dict[int, Tuple[ast.FunctionDef, Set[str]]] = {}
+        by_name: Dict[str, ast.FunctionDef] = {}
+        wrapped: Dict[str, ast.Call] = {}  # fn name -> wrapping jit/pallas call
+        factories: Set[str] = set()  # kernel factories / transformed fns
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.FunctionDef):
+                by_name.setdefault(node.name, node)
+                statics = _jit_decoration(node, _param_names(node))
+                if statics is not None:
+                    defs[id(node)] = (node, statics)
+            elif isinstance(node, ast.Call):
+                fname = dotted_name(node.func)
+                is_wrapper = fname in ("jax.jit", "jit") or (
+                    fname is not None
+                    and fname.rsplit(".", 1)[-1] == "pallas_call"
+                )
+                if is_wrapper and node.args:
+                    tgt = node.args[0]
+                    if isinstance(tgt, ast.Name):
+                        wrapped.setdefault(tgt.id, node)
+                    else:
+                        # pallas_call(_make_kernel(fn, ...)) / jit(vmap(f)):
+                        # the staged callable comes out of a factory or
+                        # transform — every module-local name reachable in
+                        # that expression (the factory, whose body holds the
+                        # kernel closure, and any function arguments) gets
+                        # the definite-sync closure checks
+                        for sub in ast.walk(tgt):
+                            if isinstance(sub, ast.Name):
+                                factories.add(sub.id)
+        for name, call in wrapped.items():
+            fn = by_name.get(name)
+            if fn is not None and id(fn) not in defs:
+                # jax.jit(f, static_argnames=...) carries statics at the
+                # call site, same as the decorator form
+                defs[id(fn)] = (fn, _static_names_from_call(call, _param_names(fn)))
+
+        # --- check each traced body -------------------------------------
+        called: Set[str] = set()
+        for fn, statics in defs.values():
+            params = [p for p in _param_names(fn) if p not in ("self", "cls")]
+            traced = {p for p in params if p not in statics}
+            yield from self._check_body(ctx, fn, traced, entry=True)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                    called.add(node.func.id)
+
+        # --- one-level closure: definite syncs only ---------------------
+        for name in called | factories:
+            fn = by_name.get(name)
+            if fn is not None and id(fn) not in defs:
+                yield from self._check_body(ctx, fn, set(), entry=False)
+
+    def _check_body(
+        self, ctx: FileContext, fn: ast.FunctionDef, traced: Set[str], entry: bool
+    ) -> Iterable[Finding]:
+        where = "jit/Pallas entry point" if entry else "function called from a traced body"
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                fname = dotted_name(node.func)
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _SYNC_ATTRS
+                ):
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f".{node.func.attr}() inside a {where}: "
+                        f"device→host sync under trace",
+                    )
+                elif fname in _SYNC_CALLS:
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"{fname}(...) inside a {where}: device→host sync",
+                    )
+                elif (
+                    entry
+                    and fname in _MATERIALIZERS
+                    and any(_taints(a, traced) for a in node.args)
+                ):
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"{fname}(...) on a traced value inside a jit entry "
+                        f"point: materializes the tracer on host",
+                    )
+                elif (
+                    entry
+                    and fname in _CONCRETIZERS
+                    and node.args
+                    and _taints(node.args[0], traced)
+                ):
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"{fname}() on traced value inside a jit entry point: "
+                        f"concretizes the tracer (host sync / trace error)",
+                    )
+            elif entry and isinstance(node, (ast.If, ast.While)):
+                if _taints(node.test, traced):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"Python `{kind}` on traced value inside a jit entry "
+                        f"point: use lax.cond/select or mark the argument "
+                        f"static",
+                    )
+            elif entry and isinstance(node, ast.For):
+                if _taints(node.iter, traced):
+                    yield self.finding(
+                        ctx,
+                        node,
+                        "Python `for` over a traced value inside a jit entry "
+                        "point: use lax.fori_loop/scan",
+                    )

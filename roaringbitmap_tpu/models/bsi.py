@@ -427,7 +427,7 @@ class RoaringBitmapSliceIndex:
             import jax
 
             backend = jax.default_backend()
-        except Exception:
+        except (ImportError, RuntimeError):  # no jax / no usable backend
             return False
         cells = self.bit_count() * self.ebm.get_container_count()
         return backend != "cpu" and cells >= config.min_device_cells

@@ -363,7 +363,7 @@ class Roaring64BitmapSliceIndex:
             import jax
 
             backend = jax.default_backend()
-        except Exception:
+        except (ImportError, RuntimeError):  # no jax / no usable backend
             return False
         cells = self.bit_count() * self._key_count()
         return backend != "cpu" and cells >= config.min_device_cells

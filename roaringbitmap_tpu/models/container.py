@@ -316,6 +316,9 @@ class ArrayContainer(Container):
     def num_runs(self) -> int:
         if self.content.size == 0:
             return 0
+        # rb-ok: dtype-discipline -- uint16 payload (<= 0xFFFF) is exact in
+        # int32; signed diff is the point (uint16 wraparound would lose the
+        # negative gaps this counts)
         return int((np.diff(self.content.astype(np.int32)) != 1).sum()) + 1
 
     def clone(self) -> "ArrayContainer":
@@ -653,6 +656,7 @@ def _interval_op(as_, ae, bs, be, op):
     in_a = np.searchsorted(as_, seg, side="right") > np.searchsorted(ae, seg, side="right")
     in_b = np.searchsorted(bs, seg, side="right") > np.searchsorted(be, seg, side="right")
     keep = op(in_a, in_b)
+    # rb-ok: dtype-discipline -- diffs of a boolean mask are in {-1, 0, 1}
     change = np.diff(keep.astype(np.int8), prepend=np.int8(0), append=np.int8(0))
     return pts[change == 1], pts[np.nonzero(change == -1)[0]]
 

@@ -33,8 +33,8 @@ _SRC = os.path.join(_DIR, "kernels.cpp")
 _LIB_NAME = "_rb_kernels.so"
 
 _lock = threading.Lock()
-_lib = None
-_tried = False
+_lib = None  # guarded-by: _lock
+_tried = False  # guarded-by: _lock
 
 
 def _build(out_path: str) -> bool:
@@ -223,9 +223,9 @@ def _ext_name() -> str:
     return "_rb_ext" + (sysconfig.get_config_var("EXT_SUFFIX") or ".so")
 
 
-_ext = None
-_ext_tried = False
-_ext_bound = False
+_ext = None  # guarded-by: _lock
+_ext_tried = False  # guarded-by: _lock
+_ext_bound = False  # guarded-by: _lock
 
 
 def _build_ext(out_path: str) -> bool:
@@ -270,7 +270,7 @@ def _load_ext():
                     if not _build_ext(path):
                         return None
             _ext = _import_ext(path)
-        except Exception:
+        except Exception:  # rb-ok: exception-hygiene -- degrade-not-crash contract: any load/ABI failure of the cached .so falls through to the rebuild ladder below
             # a cached build that fails to load gets a rebuild IN PLACE
             # first (self-healing the package-dir cache so later processes
             # don't re-pay this), then one private-dir attempt (read-only
@@ -284,7 +284,7 @@ def _load_ext():
                     if _build_ext(retry):
                         _ext = _import_ext(retry)
                         break
-                except Exception:
+                except Exception:  # rb-ok: exception-hygiene -- each rung of the rebuild ladder may fail for its own reason (read-only dir, bad toolchain); the ctypes tier is the documented landing
                     continue
     return _ext
 
@@ -305,20 +305,22 @@ def _import_ext(path: str):
 
 
 def _bind_ext_once() -> None:
-    global _ext_bound
+    global _ext_bound, _ext
     if _ext_bound:
         return
     e = _load_ext()
     if e is None:
         return
+    # _load_ext has released _lock here; take it again for the publication
+    # writes (the lock-discipline pass caught the original unlocked writes)
     try:
         _bind_ext(e)
+    except Exception:  # rb-ok: exception-hygiene -- a partial module must degrade to the ctypes path, never raise out of available() (degrade-not-crash contract)
+        with _lock:
+            _ext = None
+        return
+    with _lock:
         _ext_bound = True
-    except Exception:
-        # a partial module must degrade to the ctypes path, never raise
-        # out of available() (the module's degrade-not-crash contract)
-        global _ext
-        _ext = None
 
 
 def _bind_ext(e) -> None:

@@ -18,6 +18,7 @@ Metric naming convention: ``rb_tpu_<layer>_<name>`` (canonical names in
 """
 
 from .registry import (
+    ANALYSIS_FINDINGS_TOTAL,
     BATCH_PAIRWISE_TOTAL,
     DEFAULT_TIME_BUCKETS,
     HOST_OP_SECONDS,
@@ -93,4 +94,5 @@ __all__ = [
     "SPAN_SECONDS",
     "QUERY_CACHE_TOTAL",
     "QUERY_PLAN_TOTAL",
+    "ANALYSIS_FINDINGS_TOTAL",
 ]

@@ -47,6 +47,7 @@ HOST_OP_SECONDS = "rb_tpu_host_op_seconds"
 SPAN_SECONDS = "rb_tpu_span_seconds"
 QUERY_CACHE_TOTAL = "rb_tpu_query_cache_total"
 QUERY_PLAN_TOTAL = "rb_tpu_query_plan_total"
+ANALYSIS_FINDINGS_TOTAL = "rb_tpu_analysis_findings_total"
 
 # upper bucket bounds (seconds) for wall-time histograms: host phases span
 # ~100 µs packing steps to multi-second CPU folds; +Inf is implicit
@@ -70,7 +71,7 @@ class _Metric:
         self.name = name
         self.help = help
         self.labelnames: Tuple[str, ...] = tuple(labelnames)
-        self._series: Dict[Tuple[str, ...], object] = {}
+        self._series: Dict[Tuple[str, ...], object] = {}  # guarded-by: self._lock
 
     def _labels_tuple(self, labels: LabelsArg) -> Tuple[str, ...]:
         if isinstance(labels, Mapping):
@@ -216,7 +217,7 @@ class Registry:
 
     def __init__(self) -> None:
         self._lock = threading.RLock()
-        self._metrics: Dict[str, _Metric] = {}
+        self._metrics: Dict[str, _Metric] = {}  # guarded-by: self._lock
 
     def _register(self, cls, name: str, help: str, labelnames, **kw) -> _Metric:
         if not name.replace("_", "").replace(":", "").isalnum() or name[0].isdigit():

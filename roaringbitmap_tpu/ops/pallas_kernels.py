@@ -40,7 +40,7 @@ try:  # pallas is optional at import time (e.g. stripped CPU envs)
     from jax.experimental.pallas import tpu as pltpu
 
     HAS_PALLAS = True
-except Exception:  # pragma: no cover
+except Exception:  # pragma: no cover  # rb-ok: exception-hygiene -- optional-dep probe: any import-time failure mode (stripped build, ABI skew) must mean "no pallas", never a crash
     HAS_PALLAS = False
 
 # VMEM is ~16 MiB/core on v5e. Wide blocks: ROW_TILE*2048*4 = 2 MiB.
@@ -746,7 +746,7 @@ def best_oneil_compare(slices_w, bits_rev, ebm_w, fixed_w, op_name: str):
 def on_tpu() -> bool:
     try:
         return jax.default_backend() not in ("cpu",)
-    except Exception:  # backend init failure (e.g. stale axon env) -> no TPU
+    except RuntimeError:  # backend init failure (e.g. stale axon env) -> no TPU
         return False
 
 
@@ -774,7 +774,7 @@ def _probed_call(kind: str, fn, args, op: str, key_extra: Tuple = ()):
             _PROBED[key] = True
             _PROBE_TOTAL.inc(1, (kind, str(op), backend, "ok"))
         return out
-    except Exception:
+    except Exception:  # rb-ok: exception-hygiene -- the probe's whole job: a Mosaic lowering/compile failure of ANY type marks the shape bad and degrades to XLA; outcome is counted in rb_tpu_kernel_probe_total
         _PROBED[key] = False
         _PROBE_TOTAL.inc(1, (kind, str(op), backend, "failed"))
         return None

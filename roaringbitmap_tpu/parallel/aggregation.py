@@ -68,9 +68,10 @@ def _use_device(n_containers: int, mode: Optional[str]) -> bool:
         import jax
 
         backend = jax.default_backend()
-    except Exception:
-        # jax present but no usable backend (e.g. stale JAX_PLATFORMS in the
-        # environment) — the CPU word-fold path needs no jax at all.
+    except (ImportError, RuntimeError):
+        # jax missing, or present but no usable backend (RuntimeError from
+        # backend init, e.g. stale JAX_PLATFORMS) — the CPU word-fold path
+        # needs no jax at all.
         return False
     return backend != "cpu" and n_containers >= config.min_device_containers
 
@@ -479,7 +480,7 @@ class ParallelAggregation:
     delegates to FastAggregation."""
 
     _POOL_SIZE = 8
-    _POOL: Optional[ThreadPoolExecutor] = None
+    _POOL: Optional[ThreadPoolExecutor] = None  # guarded-by: _POOL_LOCK
     _POOL_LOCK = threading.Lock()
 
     @classmethod

@@ -291,7 +291,7 @@ def pairwise_and_cardinality(
     if impl == "auto":
         try:
             on_acc = jax.default_backend() != "cpu"
-        except Exception:
+        except RuntimeError:  # backend init failure -> VPU path (CPU-safe)
             on_acc = False
         impl = "mxu" if (on_acc and _exact()) else "vpu"
     elif impl == "mxu" and not _exact():

@@ -174,7 +174,7 @@ class PackedGroups:
     def __del__(self):
         try:
             self.close()
-        except Exception:  # pragma: no cover - interpreter teardown
+        except Exception:  # pragma: no cover - interpreter teardown  # rb-ok: exception-hygiene -- __del__ during teardown: modules may already be torn down; raising here aborts GC
             pass
 
     @property
@@ -496,6 +496,8 @@ def prepare_reduce_bucketed(packed: PackedGroups, op: str = "or", n_buckets: int
     @jax.jit
     def reduce_all(arrs):
         reds, cards = [], []
+        # rb-ok: trace-safety -- arrs is a tuple-of-arrays pytree: the loop
+        # unrolls over static structure at trace time, not traced values
         for a in arrs:
             r, c = dev.grouped_reduce_with_cardinality(a, op=op)
             reds.append(r)

@@ -43,11 +43,11 @@ class ResultCache:
         self.max_entries = int(max_entries)
         self.max_bytes = None if max_bytes is None else int(max_bytes)
         self._lock = threading.Lock()
-        self._entries: "OrderedDict[tuple, Tuple[RoaringBitmap, int]]" = OrderedDict()
-        self._bytes = 0
-        self.hits = 0
-        self.misses = 0
-        self.evictions = 0
+        self._entries: "OrderedDict[tuple, Tuple[RoaringBitmap, int]]" = OrderedDict()  # guarded-by: self._lock
+        self._bytes = 0  # guarded-by: self._lock
+        self.hits = 0  # guarded-by: self._lock
+        self.misses = 0  # guarded-by: self._lock
+        self.evictions = 0  # guarded-by: self._lock
 
     def get(self, key: tuple) -> Optional[RoaringBitmap]:
         with self._lock:

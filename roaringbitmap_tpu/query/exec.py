@@ -36,9 +36,9 @@ from .expr import Expr
 from .plan import Plan, PlanStep
 from .plan import plan as build_plan
 
-_PLAN_MEMO: "OrderedDict[tuple, Plan]" = OrderedDict()
 _PLAN_MEMO_MAX = 128
 _PLAN_MEMO_LOCK = threading.Lock()
+_PLAN_MEMO: "OrderedDict[tuple, Plan]" = OrderedDict()  # guarded-by: _PLAN_MEMO_LOCK
 
 
 def _memo_plan(expr: Expr, mode: Optional[str]) -> Plan:

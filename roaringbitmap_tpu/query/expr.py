@@ -36,8 +36,8 @@ from ..models.roaring import RoaringBitmap
 _UID = itertools.count(1)
 # op, k, child uids (+ bitmap id for leaves) -> node; weak values so dropping
 # every external reference to an expression frees its whole subtree
-_INTERN: "weakref.WeakValueDictionary[tuple, Expr]" = weakref.WeakValueDictionary()
 _INTERN_LOCK = threading.Lock()
+_INTERN: "weakref.WeakValueDictionary[tuple, Expr]" = weakref.WeakValueDictionary()  # guarded-by: _INTERN_LOCK
 
 ExprLike = Union["Expr", RoaringBitmap]
 

@@ -49,7 +49,8 @@ _OP_SECONDS = _observe.histogram(
 # legacy accounting, kept so pre-registry readers of _TIMINGS stay correct;
 # all mutation goes through _TIMINGS_LOCK (the ISSUE 1 thread-safety fix)
 _TIMINGS_LOCK = threading.Lock()
-_TIMINGS: Dict[str, list] = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
+# name -> [count, total_s]
+_TIMINGS: Dict[str, list] = defaultdict(lambda: [0, 0.0])  # guarded-by: _TIMINGS_LOCK
 
 
 @contextlib.contextmanager

@@ -222,9 +222,13 @@ def values_from_runs(starts: np.ndarray, lengths: np.ndarray) -> np.ndarray:
 def words_from_intervals(starts: np.ndarray, ends: np.ndarray) -> np.ndarray:
     """1024-word uint64 bitset from disjoint half-open [start, end) intervals,
     via a boundary-delta cumsum (vectorized; no per-run loop)."""
+    # rb-ok: dtype-discipline -- boundary deltas are ±1 per disjoint
+    # interval (|delta| <= 2 where a start meets an end), far inside int8
     delta = np.zeros((1 << 16) + 1, dtype=np.int8)
     np.add.at(delta, np.asarray(starts, dtype=np.int64), 1)
     np.subtract.at(delta, np.asarray(ends, dtype=np.int64), 1)
+    # rb-ok: dtype-discipline -- running sum of the deltas is bounded by
+    # the interval count (<= 2^16), exact in int32; result is only a mask
     mask = np.cumsum(delta[:-1], dtype=np.int32) > 0
     return np.packbits(mask, bitorder="little").view(np.uint64)
 
@@ -298,6 +302,8 @@ def validate_runs_u16(pairs: np.ndarray) -> bool:
     """True iff interleaved (start, length) runs are sorted, disjoint,
     non-touching, and end inside the 2^16 universe."""
     starts, lengths = pairs[0::2], pairs[1::2]
+    # rb-ok: dtype-discipline -- uint16 start+length <= 2*0xFFFF, exact in
+    # int32; signed width is what makes the `> 0xFFFF` overflow check work
     s32 = starts.astype(np.int32)
     ends = s32 + lengths  # int32: no uint16 overflow
     return not (
@@ -348,7 +354,7 @@ def _resolve_native() -> None:
         from .. import native as _native
 
         use = _native.available()
-    except Exception:  # toolchain missing, sandboxed, etc.
+    except Exception:  # rb-ok: exception-hygiene -- native-tier probe: toolchain missing, sandboxed, ABI skew — every failure mode must degrade to the numpy tier
         use = False
     for name in _DISPATCHED:
         g[name] = getattr(_native, name) if use else g[name + "_numpy"]

@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Project-native static analysis CLI (ISSUE 3) — the analysis half of the
+reference's per-push gate (.github/workflows/java-all-versions.yml runs
+checkstyle-style analysis beside the JDK test matrix; scripts/ci.sh runs
+this beside pytest).
+
+Usage::
+
+    python scripts/analyze.py                  # report findings, exit 0
+    python scripts/analyze.py --check          # exit 1 on non-baselined findings
+    python scripts/analyze.py --json           # machine-readable output
+    python scripts/analyze.py --update-baseline
+    python scripts/analyze.py --rules lock-discipline,metric-naming pkg/dir
+
+Default scan root is the ``roaringbitmap_tpu`` package. The baseline
+(ANALYSIS_BASELINE.json) holds fingerprints of accepted findings so
+pre-existing debt never blocks while anything new fails CI. Per-rule
+finding counts are reported into the observe registry
+(``rb_tpu_analysis_findings_total{rule}``) for the metrics sidecar.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from roaringbitmap_tpu import observe
+from roaringbitmap_tpu.analysis import all_rule_ids, baseline, fingerprints, run_checks
+from roaringbitmap_tpu.analysis.core import CHECKERS
+
+DEFAULT_PATHS = [os.path.join(REPO_ROOT, "roaringbitmap_tpu")]
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, baseline.DEFAULT_BASELINE_NAME)
+
+_FINDINGS_TOTAL = observe.counter(
+    observe.ANALYSIS_FINDINGS_TOTAL,
+    "Static-analysis findings by rule (includes baselined)",
+    ("rule",),
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", help="files/dirs (default: the package)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 if any non-baselined finding exists")
+    ap.add_argument("--json", action="store_true", help="JSON output")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids (default: all)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: %(default)s)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="accept every current finding into the baseline")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid in all_rule_ids():
+            print(f"{rid}: {CHECKERS[rid].description}")
+        return 0
+
+    rules = [r.strip() for r in args.rules.split(",")] if args.rules else None
+    paths = args.paths or DEFAULT_PATHS
+    try:
+        result = run_checks(paths, rules=rules, root=REPO_ROOT)
+    except ValueError as e:  # unknown rule id
+        print(f"analyze: {e}", file=sys.stderr)
+        return 2
+
+    for rid in rules or all_rule_ids():
+        # inc(0) still materializes the series, so the sidecar shows a
+        # clean rule as an explicit zero rather than an absence
+        _FINDINGS_TOTAL.inc(
+            sum(1 for f in result.findings if f.rule == rid), (rid,)
+        )
+
+    if args.update_baseline:
+        if args.paths or args.rules:
+            # a scoped run sees only a subset of findings; dumping it would
+            # silently drop accepted fingerprints outside the scope and
+            # break the next full --check
+            print("analyze: --update-baseline requires a full default run "
+                  "(no path or --rules arguments)", file=sys.stderr)
+            return 2
+        if result.parse_errors:
+            # an unparsed file was never scanned: its findings are unknown,
+            # so "accept everything current" would be a lie
+            for e in result.parse_errors:
+                print(f"parse error: {e}", file=sys.stderr)
+            print("analyze: refusing to update baseline with unscanned files",
+                  file=sys.stderr)
+            return 2
+        doc = baseline.dump(args.baseline, result.findings)
+        print(f"baseline updated: {len(doc['findings'])} finding(s) "
+              f"accepted into {os.path.relpath(args.baseline, REPO_ROOT)}")
+        return 0
+
+    try:
+        known = baseline.load(args.baseline)
+    except (ValueError, json.JSONDecodeError) as e:
+        print(f"analyze: bad baseline: {e}", file=sys.stderr)
+        return 2
+    new, old = baseline.partition(result.findings, known)
+
+    if args.json:
+        fps = fingerprints(result.findings)
+        old_ids = {id(f) for f in old}
+        out = {
+            "files": result.files,
+            "rules": rules or all_rule_ids(),
+            "suppressed": result.suppressed,
+            "parse_errors": result.parse_errors,
+            "findings": [
+                {**f.to_dict(), "fingerprint": fp, "baselined": id(f) in old_ids}
+                for f, fp in zip(result.findings, fps)
+            ],
+            "new": len(new),
+            "baselined": len(old),
+        }
+        json.dump(out, sys.stdout, indent=2)
+        print()
+    else:
+        for f in new:
+            print(f.render())
+        for f in old:
+            print(f"{f.render()}  [baselined]")
+        for e in result.parse_errors:
+            print(f"parse error: {e}", file=sys.stderr)
+        print(
+            f"analyze: {len(result.findings)} finding(s) "
+            f"({len(new)} new, {len(old)} baselined, "
+            f"{result.suppressed} pragma-suppressed) across "
+            f"{result.files} files"
+        )
+
+    if result.parse_errors:
+        return 2
+    if args.check and new:
+        if not args.json:
+            print("analyze: FAIL — new findings above are not in the baseline "
+                  f"({os.path.relpath(args.baseline, REPO_ROOT)}); fix them or "
+                  "run --update-baseline with justification", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,12 @@ export PYTHONPATH=$PWD:${PYTHONPATH:-}
 t0=$SECONDS
 step() { echo; echo "=== ci: $1 (t+$((SECONDS - t0))s)"; }
 
+step "static analysis (dtype/trace-safety/lock-discipline/exception-hygiene/metric-naming)"
+# the analysis half of the reference's per-push gate: zero non-baselined
+# findings or the push fails (runs in --fast mode too — it's seconds).
+# scripts/analyze.py also reports rb_tpu_analysis_findings_total in-process.
+JAX_PLATFORMS=cpu python scripts/analyze.py --check
+
 if [[ "${1:-}" != "--fast" ]]; then
   step "pytest (full suite incl. Mosaic block-rule checks)"
   python -m pytest tests/ -q

@@ -1,0 +1,799 @@
+"""Static-analysis framework tests (ISSUE 3): per-rule fixture snippets
+(positive + negative + pragma-suppressed), baseline round-trip, the
+lock-order witness, CLI exit codes, and the live-tree smoke gate (zero
+non-baselined findings across all five rules)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from roaringbitmap_tpu.analysis import (
+    LockOrderError,
+    LockWitness,
+    all_rule_ids,
+    baseline,
+    fingerprints,
+    run_checks,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "roaringbitmap_tpu")
+
+
+def _run_snippet(tmp_path, source, rules=None, name="snippet.py"):
+    p = tmp_path / name
+    p.write_text(source)
+    return run_checks([str(p)], rules=rules, root=str(tmp_path))
+
+
+def _rules_of(result):
+    return sorted({f.rule for f in result.findings})
+
+
+# ---------------------------------------------------------------------------
+# rule registry / framework basics
+# ---------------------------------------------------------------------------
+
+
+def test_all_five_rules_registered():
+    assert all_rule_ids() == [
+        "dtype-discipline",
+        "exception-hygiene",
+        "lock-discipline",
+        "metric-naming",
+        "trace-safety",
+    ]
+
+
+def test_unknown_rule_rejected(tmp_path):
+    with pytest.raises(ValueError, match="unknown rule"):
+        _run_snippet(tmp_path, "x = 1\n", rules=["no-such-rule"])
+
+
+def test_findings_carry_location_and_snippet(tmp_path):
+    res = _run_snippet(
+        tmp_path,
+        "try:\n    pass\nexcept Exception:\n    pass\n",
+        rules=["exception-hygiene"],
+    )
+    (f,) = res.findings
+    assert (f.line, f.rule, f.severity) == (3, "exception-hygiene", "error")
+    assert f.snippet == "except Exception:"
+    assert f.path.endswith("snippet.py")
+
+
+# ---------------------------------------------------------------------------
+# dtype-discipline
+# ---------------------------------------------------------------------------
+
+DTYPE_POS = """# rb-payload-path
+import numpy as np
+def f(a):
+    return a.astype(np.int32)
+def g(n):
+    return np.zeros(n, dtype=np.int16)
+def h(x):
+    return np.int32(x)
+"""
+
+
+def test_dtype_positive(tmp_path):
+    res = _run_snippet(tmp_path, DTYPE_POS, rules=["dtype-discipline"])
+    assert len(res.findings) == 3
+    assert {f.line for f in res.findings} == {4, 6, 8}
+
+
+def test_dtype_negative_int64_and_unsigned_ok(tmp_path):
+    src = """# rb-payload-path
+import numpy as np
+def f(a):
+    return a.astype(np.int64) + np.cumsum(a, dtype=np.uint64)
+"""
+    res = _run_snippet(tmp_path, src, rules=["dtype-discipline"])
+    assert res.findings == []
+
+
+def test_dtype_scoped_to_payload_paths(tmp_path):
+    # same code without the directive / payload filename: out of scope
+    res = _run_snippet(
+        tmp_path,
+        "import numpy as np\ndef f(a):\n    return a.astype(np.int32)\n",
+        rules=["dtype-discipline"],
+    )
+    assert res.findings == []
+
+
+def test_dtype_pragma_suppressed(tmp_path):
+    src = """# rb-payload-path
+import numpy as np
+def f(a):
+    return a.astype(np.int32)  # rb-ok: dtype-discipline -- bounded by 2^16
+"""
+    res = _run_snippet(tmp_path, src, rules=["dtype-discipline"])
+    assert res.findings == [] and res.suppressed == 1
+
+
+def test_dtype_multiline_comment_pragma_covers_next_code_line(tmp_path):
+    src = """# rb-payload-path
+import numpy as np
+def f(a):
+    # rb-ok: dtype-discipline -- the justification is long and
+    # continues on a second comment line before the code
+    return a.astype(np.int32)
+"""
+    res = _run_snippet(tmp_path, src, rules=["dtype-discipline"])
+    assert res.findings == [] and res.suppressed == 1
+
+
+# ---------------------------------------------------------------------------
+# trace-safety
+# ---------------------------------------------------------------------------
+
+TRACE_POS = """import functools
+import jax
+@jax.jit
+def f(x):
+    if x > 0:
+        return int(x)
+    return x.item()
+"""
+
+
+def test_trace_safety_positive(tmp_path):
+    res = _run_snippet(tmp_path, TRACE_POS, rules=["trace-safety"])
+    msgs = " ".join(f.message for f in res.findings)
+    assert len(res.findings) == 3
+    assert "`if`" in msgs and "int()" in msgs and ".item()" in msgs
+
+
+def test_trace_safety_static_args_exempt(tmp_path):
+    src = """import functools
+import jax
+@functools.partial(jax.jit, static_argnames=("op",))
+def f(x, op):
+    if op == "or":
+        return x
+    while op != "or":
+        break
+    return x
+"""
+    res = _run_snippet(tmp_path, src, rules=["trace-safety"])
+    assert res.findings == []
+
+
+def test_trace_safety_shape_and_none_checks_exempt(tmp_path):
+    src = """import jax
+import jax.numpy as jnp
+@jax.jit
+def f(x, seed=None):
+    n = x.shape[0]
+    if n > 2:
+        return x
+    if seed is None:
+        seed = jnp.uint32(0)
+    return x
+"""
+    res = _run_snippet(tmp_path, src, rules=["trace-safety"])
+    assert res.findings == []
+
+
+def test_trace_safety_untraced_function_clean(tmp_path):
+    src = "def f(x):\n    return x.item() if x > 0 else int(x)\n"
+    res = _run_snippet(tmp_path, src, rules=["trace-safety"])
+    assert res.findings == []
+
+
+def test_trace_safety_pallas_kernel_and_wrapped(tmp_path):
+    src = """import jax
+from jax.experimental import pallas as pl
+def kernel(ref, out):
+    out[...] = ref[...].tolist()
+def run(x):
+    return pl.pallas_call(kernel)(x)
+def wrapped(x):
+    return x.item()
+g = jax.jit(wrapped)
+"""
+    res = _run_snippet(tmp_path, src, rules=["trace-safety"])
+    assert {f.line for f in res.findings} == {4, 8}
+
+
+def test_trace_safety_one_level_closure_syncs_only(tmp_path):
+    src = """import jax
+def helper(x):
+    if x:  # tracedness unknown at this level: not flagged
+        return x.item()  # definite sync: flagged
+    return x
+@jax.jit
+def f(x):
+    return helper(x)
+"""
+    res = _run_snippet(tmp_path, src, rules=["trace-safety"])
+    assert [f.line for f in res.findings] == [4]
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+LOCK_SRC = """import threading
+_L = threading.Lock()
+_STATE = {}  # guarded-by: _L
+
+def bad(k, v):
+    _STATE[k] = v
+
+def bad_mutator(k):
+    _STATE.pop(k)
+
+def good(k, v):
+    with _L:
+        _STATE[k] = v
+        _STATE.update({k: v})
+
+class C:
+    POOL = None  # guarded-by: _POOL_LOCK
+    _POOL_LOCK = threading.Lock()
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}  # guarded-by: self._lock
+        self.count = 0  # init writes exempt
+
+    def bad(self, k):
+        self._entries[k] = 1
+        C.POOL = object()
+
+    def good(self, k):
+        with self._lock:
+            self._entries[k] = 1
+        with C._POOL_LOCK:
+            C.POOL = object()
+"""
+
+
+def test_lock_discipline(tmp_path):
+    res = _run_snippet(tmp_path, LOCK_SRC, rules=["lock-discipline"])
+    assert {f.line for f in res.findings} == {6, 9, 26, 27}
+    assert all("guarded-by" in f.message for f in res.findings)
+
+
+def test_lock_discipline_unannotated_state_ignored(tmp_path):
+    src = "_S = {}\ndef f():\n    _S['x'] = 1\n"
+    res = _run_snippet(tmp_path, src, rules=["lock-discipline"])
+    assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# exception-hygiene
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "handler,n",
+    [
+        ("except Exception:\n    pass", 1),
+        ("except:\n    pass", 1),
+        ("except (ValueError, Exception):\n    pass", 1),
+        ("except BaseException:\n    pass", 1),
+        ("except ValueError:\n    pass", 0),  # narrow: fine
+        ("except Exception as e:\n    raise RuntimeError() from e", 0),  # re-wrap
+        ("except BaseException:\n    x = 1\n    raise", 0),  # cleanup-then-reraise
+        ("except Exception:  # rb-ok: exception-hygiene -- probe\n    pass", 0),
+    ],
+)
+def test_exception_hygiene(tmp_path, handler, n):
+    src = "def f():\n    try:\n        pass\n" + "\n".join(
+        "    " + l for l in handler.splitlines()
+    ) + "\n"
+    res = _run_snippet(tmp_path, src, rules=["exception-hygiene"])
+    assert len(res.findings) == n, src
+
+
+# ---------------------------------------------------------------------------
+# metric-naming
+# ---------------------------------------------------------------------------
+
+METRIC_SRC = """from roaringbitmap_tpu import observe
+GOOD_TOTAL = "rb_tpu_good_total"
+BAD_TOTAL = "rb_other_total"
+A = observe.counter("rb_tpu_a_total", "ok", ("k",))
+B = observe.counter("oops_total", "bad prefix")
+C = observe.counter(GOOD_TOTAL, "ok")
+D = observe.counter(BAD_TOTAL, "bad constant")
+E = observe.gauge("rb_tpu_" + "computed", "computed name")
+F = observe.histogram("rb_tpu_h_seconds", "labels not literal", labelnames=tuple(["a"]))
+"""
+
+
+def test_metric_naming(tmp_path):
+    res = _run_snippet(tmp_path, METRIC_SRC, rules=["metric-naming"])
+    by_line = {f.line for f in res.findings}
+    # line 3: non-compliant ALL_CAPS constant; 5: bad literal; 7: bad
+    # constant use; 8: computed name; 9: computed labelnames
+    assert by_line == {3, 5, 7, 8, 9}
+
+
+def test_metric_naming_forwarding_wrapper_exempt(tmp_path):
+    src = """from roaringbitmap_tpu.observe import registry
+def counter(name, help=""):
+    return registry.REGISTRY.counter(name, help)
+"""
+    res = _run_snippet(tmp_path, src, rules=["metric-naming"])
+    assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_round_trip(tmp_path):
+    res = _run_snippet(
+        tmp_path,
+        "try:\n    pass\nexcept Exception:\n    pass\n",
+        rules=["exception-hygiene"],
+    )
+    assert len(res.findings) == 1
+    bl = tmp_path / "baseline.json"
+    doc = baseline.dump(str(bl), res.findings)
+    assert len(doc["findings"]) == 1
+    fps = baseline.load(str(bl))
+    new, old = baseline.partition(res.findings, fps)
+    assert new == [] and len(old) == 1
+    # a different violation is NOT covered by the baseline
+    res2 = _run_snippet(
+        tmp_path,
+        "try:\n    x = 1\nexcept BaseException:\n    pass\n",
+        rules=["exception-hygiene"],
+        name="other.py",
+    )
+    new2, old2 = baseline.partition(res2.findings, fps)
+    assert len(new2) == 1 and old2 == []
+
+
+def test_baseline_fingerprints_survive_line_shifts(tmp_path):
+    src = "try:\n    pass\nexcept Exception:\n    pass\n"
+    res = _run_snippet(tmp_path, src, rules=["exception-hygiene"])
+    shifted = "import os\n\n" + src  # same finding, two lines lower
+    res2 = _run_snippet(tmp_path, shifted, rules=["exception-hygiene"], name="snippet.py")
+    assert fingerprints(res.findings) == fingerprints(res2.findings)
+
+
+def test_baseline_missing_file_is_empty():
+    assert baseline.load("/nonexistent/baseline.json") == set()
+
+
+def test_baseline_rejects_foreign_json(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text('{"something": "else"}')
+    with pytest.raises(ValueError, match="not a v1 analysis baseline"):
+        baseline.load(str(p))
+
+
+# ---------------------------------------------------------------------------
+# lock-order witness (dynamic complement)
+# ---------------------------------------------------------------------------
+
+
+def test_lock_witness_consistent_order_passes():
+    w = LockWitness()
+    a = w.wrap("A", threading.Lock())
+    b = w.wrap("B", threading.Lock())
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert ("A", "B") in w.edges
+    w.assert_consistent()
+
+
+def test_lock_witness_detects_inversion():
+    w = LockWitness()
+    a = w.wrap("A", threading.Lock())
+    b = w.wrap("B", threading.Lock())
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    with pytest.raises(LockOrderError, match="cycle"):
+        w.assert_consistent()
+
+
+def test_lock_witness_reentrant_rlock_no_self_edge():
+    w = LockWitness()
+    r = w.wrap("R", threading.RLock())
+    with r:
+        with r:
+            pass
+    assert ("R", "R") not in w.edges
+    w.assert_consistent()
+
+
+def test_lock_witness_threaded_stacks_are_isolated():
+    w = LockWitness()
+    a = w.wrap("A", threading.Lock())
+    b = w.wrap("B", threading.Lock())
+    barrier = threading.Barrier(2)
+
+    def t1():
+        barrier.wait()
+        for _ in range(50):
+            with a:
+                with b:
+                    pass
+
+    def t2():
+        barrier.wait()
+        for _ in range(50):
+            with b:
+                pass  # holds only B: no (B, A) edge may appear
+            with a:
+                pass
+
+    ts = [threading.Thread(target=t1), threading.Thread(target=t2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert ("B", "A") not in w.edges
+    w.assert_consistent()
+
+
+# ---------------------------------------------------------------------------
+# live tree + CLI gate
+# ---------------------------------------------------------------------------
+
+
+def test_live_tree_zero_non_baselined_findings():
+    """The acceptance gate, in-process: the shipped tree is clean across
+    all five rules modulo the checked-in baseline."""
+    res = run_checks([PKG], root=REPO)
+    known = baseline.load(os.path.join(REPO, "ANALYSIS_BASELINE.json"))
+    new, _old = baseline.partition(res.findings, known)
+    assert new == [], "\n".join(f.render() for f in new)
+    assert res.parse_errors == []
+    assert res.files > 50  # the walk actually covered the package
+
+
+def test_cli_check_clean_tree_exits_zero():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "analyze.py"), "--check"],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 new" in proc.stdout
+
+
+def test_cli_check_injected_violation_exits_nonzero(tmp_path):
+    (tmp_path / "bad.py").write_text(
+        "try:\n    pass\nexcept Exception:\n    pass\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "analyze.py"),
+         "--check", str(tmp_path)],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "exception-hygiene" in proc.stdout
+
+
+def test_cli_json_output(tmp_path):
+    (tmp_path / "bad.py").write_text(
+        "try:\n    pass\nexcept Exception:\n    pass\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "analyze.py"),
+         "--json", str(tmp_path)],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["new"] == 1 and doc["baselined"] == 0
+    (f,) = doc["findings"]
+    assert f["rule"] == "exception-hygiene" and f["fingerprint"]
+    assert sorted(doc["rules"]) == all_rule_ids()
+
+
+def test_cli_emits_analysis_metric():
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; sys.argv=['analyze.py']; "
+         "import importlib.util, os; "
+         "spec=importlib.util.spec_from_file_location('azcli', "
+         f"os.path.join({REPO!r}, 'scripts', 'analyze.py')); "
+         "m=importlib.util.module_from_spec(spec); spec.loader.exec_module(m); "
+         "rc=m.main([]); "
+         "from roaringbitmap_tpu import observe; "
+         "snap=observe.snapshot()['rb_tpu_analysis_findings_total']; "
+         "assert len(snap['samples']) == 5, snap; "
+         "assert snap['labelnames'] == ['rule'], snap; "
+         "sys.exit(rc)"],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# review regressions: sync-method form, astype(dtype=...), CLI path typos,
+# damaged baseline entries
+# ---------------------------------------------------------------------------
+
+
+def test_trace_safety_block_until_ready_method_form(tmp_path):
+    src = """import jax
+@jax.jit
+def f(x):
+    return x.block_until_ready()
+"""
+    res = _run_snippet(tmp_path, src, rules=["trace-safety"])
+    assert len(res.findings) == 1 and "block_until_ready" in res.findings[0].message
+
+
+def test_dtype_astype_keyword_form(tmp_path):
+    src = """# rb-payload-path
+import numpy as np
+def f(a):
+    return a.astype(dtype=np.int32)
+"""
+    res = _run_snippet(tmp_path, src, rules=["dtype-discipline"])
+    assert len(res.findings) == 1
+
+
+def test_nonexistent_path_is_an_error(tmp_path):
+    with pytest.raises(ValueError, match="not a directory or .py file"):
+        run_checks([str(tmp_path / "no_such_dir")], root=str(tmp_path))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "analyze.py"),
+         "--check", "no_such_dir_typo"],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+
+
+def test_baseline_entry_without_fingerprint_rejected(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text('{"version": 1, "findings": [{"rule": "x"}]}')
+    with pytest.raises(ValueError, match="without fingerprint"):
+        baseline.load(str(p))
+
+
+def test_update_baseline_refuses_scoped_runs(tmp_path):
+    for extra in (["--rules", "metric-naming"], [str(tmp_path)]):
+        (tmp_path / "ok.py").write_text("x = 1\n")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "analyze.py"),
+             "--update-baseline", "--baseline", str(tmp_path / "b.json"), *extra],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 2, (extra, proc.stdout, proc.stderr)
+        assert "full default run" in proc.stderr
+        assert not (tmp_path / "b.json").exists()
+
+
+def test_metric_naming_flags_metric_shaped_constants_without_rb(tmp_path):
+    src = 'LEGACY_TOTAL = "legacy_findings_total"\nPLAIN = "not a metric"\n'
+    res = _run_snippet(tmp_path, src, rules=["metric-naming"])
+    assert [f.line for f in res.findings] == [1]
+
+
+def test_lock_discipline_local_shadow_is_not_a_write(tmp_path):
+    src = """import threading
+_L = threading.Lock()
+_POOL = None  # guarded-by: _L
+
+def local_shadow():
+    _POOL = object()  # creates a local: no shared-state write
+    return _POOL
+
+def real_write():
+    global _POOL
+    _POOL = object()
+
+def locked_write():
+    global _POOL
+    with _L:
+        _POOL = object()
+"""
+    res = _run_snippet(tmp_path, src, rules=["lock-discipline"])
+    assert [f.line for f in res.findings] == [11]
+
+
+def test_trace_safety_np_array_constant_table_ok(tmp_path):
+    src = """import jax
+import numpy as np
+@jax.jit
+def f(x):
+    table = np.array([0, 1, 2], np.uint8)  # trace-time constant: fine
+    return x + int(table[0])
+@jax.jit
+def g(x):
+    return np.asarray(x)  # traced value: materializes
+"""
+    res = _run_snippet(tmp_path, src, rules=["trace-safety"])
+    assert [f.line for f in res.findings] == [9]
+
+
+def test_trace_safety_kernel_factory_closure_checked(tmp_path):
+    src = """import jax
+from jax.experimental import pallas as pl
+def _make_kernel(fn):
+    def kernel(ref, out):
+        out[...] = ref[...].item()  # sync inside the factory's closure
+    return kernel
+def run(x, fn):
+    return pl.pallas_call(_make_kernel(fn))(x)
+def one(x):
+    return x.tolist()
+g = jax.jit(jax.vmap(one))
+"""
+    res = _run_snippet(tmp_path, src, rules=["trace-safety"])
+    assert {f.line for f in res.findings} == {5, 10}
+
+
+def test_metric_naming_cross_module_constant_needs_shaped_name(tmp_path):
+    src = """from roaringbitmap_tpu import observe
+from somewhere import QUERY_DEPTH, OTHER_TOTAL
+A = observe.histogram(QUERY_DEPTH, "unshaped name: unverifiable")
+B = observe.counter(OTHER_TOTAL, "shaped name: validated at definition")
+"""
+    res = _run_snippet(tmp_path, src, rules=["metric-naming"])
+    assert [f.line for f in res.findings] == [3]
+
+
+def test_metric_naming_shaped_constant_definition_validated(tmp_path):
+    src = 'SPAN_SECONDS = "span_seconds"\n'  # shaped NAME, bad value
+    res = _run_snippet(tmp_path, src, rules=["metric-naming"])
+    assert len(res.findings) == 1
+
+
+def test_dtype_bare_from_import_cast_flagged(tmp_path):
+    src = """# rb-payload-path
+from numpy import int32
+def f(x):
+    return int32(x)
+"""
+    res = _run_snippet(tmp_path, src, rules=["dtype-discipline"])
+    assert len(res.findings) == 1
+
+
+def test_trace_safety_callsite_static_argnames_respected(tmp_path):
+    src = """import jax
+def f(x, op):
+    if op == "or":
+        return x
+    return x
+g = jax.jit(f, static_argnames=("op",))
+"""
+    res = _run_snippet(tmp_path, src, rules=["trace-safety"])
+    assert res.findings == []
+
+
+def test_trace_safety_kwonly_params_are_traced(tmp_path):
+    src = """import jax
+@jax.jit
+def f(x, *, y):
+    if y > 0:
+        return int(y)
+    return x
+"""
+    res = _run_snippet(tmp_path, src, rules=["trace-safety"])
+    assert len(res.findings) == 2
+
+
+def test_pragma_on_continuation_line_of_wrapped_call(tmp_path):
+    src = """# rb-payload-path
+import numpy as np
+def f(a):
+    return np.cumsum(
+        a, dtype=np.int32)  # rb-ok: dtype-discipline -- bounded by 2^16
+"""
+    res = _run_snippet(tmp_path, src, rules=["dtype-discipline"])
+    assert res.findings == [] and res.suppressed == 1
+
+
+def test_pragma_inside_if_body_does_not_suppress_the_if(tmp_path):
+    src = """import jax
+@jax.jit
+def f(x):
+    if x > 0:
+        return x  # rb-ok: trace-safety -- pragma on body line is not the `if`
+    return x
+"""
+    res = _run_snippet(tmp_path, src, rules=["trace-safety"])
+    assert len(res.findings) == 1
+
+
+def test_metric_naming_star_forwarding_wrapper_exempt(tmp_path):
+    src = """from roaringbitmap_tpu import observe
+def counter(*args, **kw):
+    return observe.counter(*args, **kw)
+"""
+    res = _run_snippet(tmp_path, src, rules=["metric-naming"])
+    assert res.findings == []
+
+
+def test_lock_discipline_nested_global_not_attributed_to_outer(tmp_path):
+    src = """import threading
+_L = threading.Lock()
+_G = {}  # guarded-by: _L
+
+def outer():
+    _G = {}  # local shadow: exempt, despite inner's global decl
+    def inner():
+        global _G
+        with _L:
+            _G = {}
+    return _G
+"""
+    res = _run_snippet(tmp_path, src, rules=["lock-discipline"])
+    assert res.findings == []
+
+
+def test_update_baseline_refuses_unparseable_files(tmp_path, monkeypatch):
+    # a default-path run can't be forced to hit a syntax error without
+    # touching the package, so exercise the refusal through run_checks +
+    # the CLI's parse-error contract on a scoped scan instead
+    (tmp_path / "broken.py").write_text("def f(:\n")
+    res = run_checks([str(tmp_path)], root=str(tmp_path))
+    assert len(res.parse_errors) == 1
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "analyze.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 2 and "parse error" in proc.stderr
+
+
+def test_lock_discipline_shadowed_local_mutations_exempt(tmp_path):
+    src = """import threading
+_L = threading.Lock()
+_POOL = []  # guarded-by: _L
+
+def local_only():
+    _POOL = []
+    _POOL.append(1)
+    _POOL[0] = 2
+    return _POOL
+
+def real_mutation():
+    _POOL.append(1)  # no local rebind: this is the module global
+"""
+    res = _run_snippet(tmp_path, src, rules=["lock-discipline"])
+    assert [f.line for f in res.findings] == [12]
+
+
+def test_exception_pragma_on_wrapped_clause_continuation(tmp_path):
+    src = """def f():
+    try:
+        pass
+    except (ValueError,
+            Exception):  # rb-ok: exception-hygiene -- probe must degrade
+        pass
+"""
+    res = _run_snippet(tmp_path, src, rules=["exception-hygiene"])
+    assert res.findings == [] and res.suppressed == 1
+
+
+def test_trace_safety_bare_from_import_sync_flagged(tmp_path):
+    src = """import jax
+from jax import device_get
+@jax.jit
+def f(x):
+    return device_get(x)
+"""
+    res = _run_snippet(tmp_path, src, rules=["trace-safety"])
+    assert len(res.findings) == 1 and "device_get" in res.findings[0].message

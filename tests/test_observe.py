@@ -440,3 +440,45 @@ def test_sidecar_snapshot_reflects_workload():
     assert "store.pack_rows_host" in side["spans"]
     # reduce span nests the probe/dispatch work under the layout it chose
     assert any(p.startswith("store.reduce.") for p in side["spans"])
+
+
+# ---------------------------------------------------------------------------
+# lock-order witness (ISSUE 3: dynamic complement of the static
+# lock-discipline rule) — the op_timer hammer re-run with the tracing-side
+# locks instrumented: registry RLock + legacy _TIMINGS lock must never
+# nest inconsistently (a cycle is a potential deadlock).
+# ---------------------------------------------------------------------------
+
+
+def test_op_timer_hammer_lock_order_witness(monkeypatch):
+    from roaringbitmap_tpu.analysis import LockWitness
+    from roaringbitmap_tpu.observe import spans
+
+    tracing.reset_timings()
+    w = LockWitness()
+    reg_lock = observe.REGISTRY._lock  # one RLock shared by every metric
+    monkeypatch.setattr(
+        tracing._OP_SECONDS, "_lock", w.wrap("observe.registry", reg_lock)
+    )
+    monkeypatch.setattr(
+        spans.SPAN_SECONDS, "_lock", w.wrap("observe.registry", reg_lock)
+    )
+    monkeypatch.setattr(
+        tracing, "_TIMINGS_LOCK", w.wrap("tracing._TIMINGS", tracing._TIMINGS_LOCK)
+    )
+
+    def work(i):
+        for _ in range(300):
+            with tracing.op_timer("witness-phase"):
+                pass
+
+    with ThreadPoolExecutor(max_workers=8) as ex:
+        list(ex.map(work, range(8)))
+    assert tracing.timings()["witness-phase"]["count"] == 2400
+    # both instrumented locks were actually exercised...
+    assert w.acquisitions["observe.registry"] >= 2400
+    assert w.acquisitions["tracing._TIMINGS"] >= 2400
+    # ...and no inconsistent ordering (cycle) was observed: op_timer takes
+    # the registry lock and the legacy lock sequentially, never nested both
+    # ways
+    w.assert_consistent()
