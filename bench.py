@@ -162,11 +162,12 @@ def _run():
     cpu_s = min(cpu_times) if cpu_times else cpu_first_s
     cpu_card = cpu_result.get_cardinality()
 
-    # ---- TPU path: pack once, reduce on device ----
-    groups = store.group_by_key(bitmaps)
+    # ---- TPU path: pack once via the resident pack cache (ISSUE 4), ----
+    # ---- reduce on device                                           ----
+    store.PACK_CACHE.close()  # cold start: pack_s is the uncached marshal
     t0 = time.time()
-    packed = store.pack_groups(groups)
-    pack_s = time.time() - t0
+    packed = store.packed_for(bitmaps)
+    pack_s = time.time() - t0  # transpose + pack: the cold cost a first call pays
 
     # cold-path accounting (VERDICT r4 weak #2): the bucketed layout's
     # one-time build cost, measured explicitly so every artifact carries the
@@ -296,6 +297,34 @@ def _run():
         hbm["xla_dispatch_s"] = round(t_xla, 6)
         hbm["dispatch"] = insights.dispatch_counters()["kernel"]
 
+    # ---- resident pack cache: warm hit + incremental delta repack ----
+    # (ISSUE 4 acceptance: a repeated aggregation over unchanged bitmaps
+    # performs zero host packs; mutating k of N containers ships O(k) rows)
+    from roaringbitmap_tpu import insights
+
+    t0 = time.time()
+    warm = store.packed_for(bitmaps)
+    warm_pack_s = time.time() - t0
+    assert warm is packed, "warm lookup must return the resident pack"
+
+    k_mut = 5
+    pc_before = insights.pack_cache_counters()
+    for bm in bitmaps[:k_mut]:
+        hb = int(bm.high_low_container.keys[0])
+        bm.add((hb << 16) | 911)
+    t0 = time.time()
+    delta_packed = store.packed_for(bitmaps)
+    delta_packed.device_words.block_until_ready()
+    delta_repack_s = time.time() - t0
+    pc = insights.pack_cache_counters()
+    delta_rows = pc["delta_rows"].get("agg", 0) - pc_before["delta_rows"].get("agg", 0)
+    assert delta_packed is packed, "delta must refresh the resident pack in place"
+    # differential: the O(k)-row delta repack equals a from-scratch pack
+    fresh = store.pack_groups(store.group_by_key(bitmaps))
+    assert np.array_equal(delta_packed.words, fresh.words), "delta != full repack"
+    hits = sum(pc["hits"].values())
+    misses = sum(pc["misses"].values())
+
     meta = {
         "dataset": "census1881" if real else "synthetic-census-like",
         "n_bitmaps": N_BITMAPS,
@@ -312,6 +341,15 @@ def _run():
         "tpu_dispatch_s": round(dispatch_s, 6),
         "pack_s": round(pack_s, 4),
         "bucket_build_s": round(bucket_build_s, 4),
+        # resident pack cache (ISSUE 4): warm lookups are dict probes, a
+        # k-container mutation re-ships k rows (pack_delta_rows is read
+        # from rb_tpu_pack_cache_delta_rows_total and must equal
+        # pack_mutated_containers — the O(k) claim as a checked number)
+        "pack_warm_s": round(warm_pack_s, 6),
+        "delta_repack_s": round(delta_repack_s, 6),
+        "pack_mutated_containers": k_mut,
+        "pack_delta_rows": int(delta_rows),
+        "pack_cache_hit_ratio": round(hits / max(1, hits + misses), 3),
         # cold-path break-even vs the CPU fold: pack + bucket build + K
         # device reductions against K CPU folds (the amortization story as
         # numbers, not prose)

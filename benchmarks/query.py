@@ -13,6 +13,10 @@ nested boolean expression over many corpus bitmaps,
   (planning + execution + store costs, no reuse);
 * ``queryPlannedWarmCache`` — a shared cache warmed before timing: the
   steady-state repeated-query hot path (dict probes + one root clone).
+* ``queryPlannedColdPack`` / ``queryPlannedWarmPack`` — device engines with
+  the result cache OFF, against the resident pack cache (ISSUE 4) cleared
+  every rep vs warm: what pack residency alone buys a repeated query that
+  cannot reuse results (e.g. a mutating leaf elsewhere evicted them).
 
 Correctness of the planned result against the naive fold is asserted
 before any timing is trusted (the test_benchmarks discipline).
@@ -22,6 +26,7 @@ from __future__ import annotations
 
 from typing import List
 
+from roaringbitmap_tpu.parallel import store
 from roaringbitmap_tpu.query import Q, ResultCache, evaluate_naive, execute, plan
 
 from . import common
@@ -61,6 +66,19 @@ def _suite(dataset: str, reps: int, limit: int) -> List[Result]:
     warm_cache = ResultCache(max_entries=64)
     execute(q, cache=warm_cache)  # warm outside the timed region
     bench("queryPlannedWarmCache", lambda: execute(q, cache=warm_cache))
+
+    # resident pack cache (ISSUE 4): device engines, result cache OFF —
+    # cold pays the host transpose+pack every rep, warm rides HBM
+    got_dev = execute(q, cache=None, mode="device")
+    assert got_dev == want, "device-engine evaluation diverged from naive algebra"
+
+    def cold_pack():
+        store.PACK_CACHE.close()
+        execute(q, cache=None, mode="device")
+
+    bench("queryPlannedColdPack", cold_pack)
+    execute(q, cache=None, mode="device")  # warm the pack cache
+    bench("queryPlannedWarmPack", lambda: execute(q, cache=None, mode="device"))
     return out
 
 

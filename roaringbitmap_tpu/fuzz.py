@@ -233,6 +233,73 @@ def verify_layout_invariance(
                 )
 
 
+def _random_mutation(rng, bm: RoaringBitmap) -> None:
+    """One random mutation drawn from every family the delta validator must
+    classify: in-place container edits (delta rows), key insertions and
+    removals (structural -> full repack), and container-form rewrites."""
+    kind = int(rng.integers(0, 5))
+    hlc = bm.high_low_container
+    if kind == 0 and hlc.size:  # point add within an existing chunk
+        hb = hlc.keys[int(rng.integers(0, hlc.size))]
+        bm.add((int(hb) << 16) | int(rng.integers(0, 1 << 16)))
+    elif kind == 1 and not bm.is_empty():  # point remove (may drop the key)
+        arr = bm.to_array()
+        bm.remove(int(arr[int(rng.integers(0, arr.size))]))
+    elif kind == 2:  # brand-new chunk key: structural
+        bm.add(int(rng.integers(100, 200)) << 16 | int(rng.integers(0, 1 << 16)))
+    elif kind == 3:  # container-form rewrite (set_container_at_index dirty)
+        bm.run_optimize()
+    else:  # bulk add spanning existing + possibly new chunks
+        vals = rng.integers(0, 80 << 16, size=int(rng.integers(1, 64)))
+        bm.add_many(vals.astype(np.uint32))
+
+
+def verify_pack_cache_invariance(
+    name: str,
+    iterations: Optional[int] = None,
+    seed: Optional[int] = None,
+) -> None:
+    """The resident pack cache differential (ISSUE 4): across randomized
+    mutation sequences, the cache-returned pack — whether exact hit,
+    incremental delta repack, or full rebuild — must be byte-identical to
+    a from-scratch ``pack_groups(group_by_key(...))`` of the current
+    bitmaps, on both the unfiltered (OR/XOR) and the AND key-intersection
+    layouts. A wrong delta classification fails exactly like a wrong
+    kernel."""
+    from .parallel import store
+
+    rng = np.random.default_rng(seed)
+    for _ in range(iterations or default_iterations()):
+        bms = [random_bitmap(rng, max_keys=4) for _ in range(int(rng.integers(2, 6)))]
+        cache = store.PackCache(max_bytes=1 << 30)
+        for _step in range(int(rng.integers(1, 5))):
+            for bi in rng.choice(len(bms), size=int(rng.integers(1, 3)), replace=False):
+                _random_mutation(rng, bms[int(bi)])
+            keys_filter = None
+            if rng.random() < 0.4:
+                keys_filter = store.intersect_keys(bms)
+                if not keys_filter:
+                    continue
+            try:
+                got = cache.get_packed(bms, keys_filter)
+                want = store.pack_groups(
+                    store.group_by_key(bms, keys_filter=keys_filter)
+                )
+                ok = (
+                    np.array_equal(got.words, want.words)
+                    and np.array_equal(got.group_keys, want.group_keys)
+                    and np.array_equal(got.group_offsets, want.group_offsets)
+                )
+            except Exception as e:  # predicate crash is also a failure
+                raise InvarianceFailure(name, bms, detail=repr(e)) from e
+            if not ok:
+                raise InvarianceFailure(
+                    name, bms,
+                    detail=f"cached pack != fresh pack (filter={keys_filter is not None})",
+                )
+        cache.close()
+
+
 def random_expression(rng, leaves: List[RoaringBitmap], max_depth: int = 4):
     """Random query DAG over the given leaf bitmaps: every node kind
     (and/or/xor/n-ary andnot/not-over-explicit-universe/threshold), biased
@@ -563,6 +630,15 @@ def run_campaign(iterations: Optional[int] = None, verbose: bool = True) -> dict
         lambda: verify_query_invariance(
             "query-planner-vs-naive(device)",
             iterations=max(1, n // 8), seed=52, mode="device",
+        ),
+        actual=max(1, n // 8),
+    )
+    # ISSUE 4: resident pack cache — delta repack vs from-scratch pack on
+    # randomized mutation sequences (both unfiltered and AND-filtered)
+    _run(
+        "pack-cache-delta-vs-full-repack",
+        lambda: verify_pack_cache_invariance(
+            "pack-cache-delta-vs-full-repack", iterations=max(1, n // 8), seed=53
         ),
         actual=max(1, n // 8),
     )

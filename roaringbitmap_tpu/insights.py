@@ -121,6 +121,26 @@ def query_counters() -> dict:
     }
 
 
+def pack_cache_counters() -> dict:
+    """Resident pack cache observability (ISSUE 4): per-kind hit/miss/
+    delta-row/evicted-byte counters plus the resident-bytes gauge, as plain
+    str->int dicts (the query_counters() shape convention). Kinds are the
+    routed consumers: agg | bsi | andnot | threshold."""
+    from . import observe
+
+    def _series(name):
+        m = observe.REGISTRY.get(name)
+        return {lv[0]: v for lv, v in m.series().items()} if m else {}
+
+    return {
+        "hits": _series(observe.PACK_CACHE_HITS_TOTAL),
+        "misses": _series(observe.PACK_CACHE_MISSES_TOTAL),
+        "delta_rows": _series(observe.PACK_CACHE_DELTA_ROWS_TOTAL),
+        "evicted_bytes": _series(observe.PACK_CACHE_EVICTED_BYTES_TOTAL),
+        "resident_bytes": _series(observe.PACK_CACHE_RESIDENT_BYTES),
+    }
+
+
 def metrics_snapshot() -> dict:
     """The full labeled registry snapshot (every rb_tpu_* metric incl.
     histograms) — the machine-readable superset of dispatch_counters();

@@ -159,8 +159,10 @@ class RoaringBitmapSliceIndex:
             RoaringBitmap() for _ in range(max(0, int(max_value)).bit_length())
         ]
         self.run_optimized = False
-        self._version = 0  # bumped on mutation; keys the device pack cache
-        self._pack_cache = None
+        # mutation counter kept for subclasses/diagnostics; the device pack
+        # is keyed by member-bitmap fingerprints in the shared PACK_CACHE
+        # (parallel/store.py) since ISSUE 4, not by this counter
+        self._version = 0
 
     # ------------------------------------------------------------------
     # construction
@@ -469,32 +471,47 @@ class RoaringBitmapSliceIndex:
 
     # ---- device path --------------------------------------------------
     def _pack_dense(self):
-        """[S, K, 2048] slice tensor + [K, 2048] ebm over the ebm's keys.
-        Cached until the next mutation — repeat queries skip the host-side
-        marshal entirely (the device arrays stay resident in HBM)."""
-        if self._pack_cache is not None and self._pack_cache[0] == self._version:
-            return self._pack_cache[1:]
-        import jax.numpy as jnp
+        """[S, K, 2048] slice tensor + [K, 2048] ebm over the ebm's keys,
+        resident in the process-wide pack cache (parallel/store.PACK_CACHE,
+        ISSUE 4) under the member bitmaps' fingerprints — repeat queries
+        skip the host-side marshal entirely, BSI tensors share ONE byte
+        budget and LRU with the aggregation/query packs, and any mutation
+        (including one that bypasses this object and touches a slice bitmap
+        directly) re-keys the entry so the stale pack ages out."""
+        from ..parallel import store
 
-        from ..ops import device as dev
-        from ..parallel.store import container_words_u32
+        key = (
+            "bsi",
+            self.ebm.fingerprint(),
+            tuple(s.fingerprint() for s in self.slices),
+        )
 
-        keys = list(self.ebm.high_low_container.keys)
-        kidx = {k: i for i, k in enumerate(keys)}
-        K = len(keys)
-        S = self.bit_count()
-        ebm_w = np.zeros((K, dev.DEVICE_WORDS), dtype=np.uint32)
-        for k, c in zip(keys, self.ebm.high_low_container.containers):
-            ebm_w[kidx[k]] = container_words_u32(c)
-        slices_w = np.zeros((S, K, dev.DEVICE_WORDS), dtype=np.uint32)
-        for i, s in enumerate(self.slices):
-            hlc = s.high_low_container
-            for k, c in zip(hlc.keys, hlc.containers):
-                j = kidx.get(k)
-                if j is not None:
-                    slices_w[i, j] = container_words_u32(c)
-        self._pack_cache = (self._version, keys, jnp.asarray(ebm_w), jnp.asarray(slices_w))
-        return self._pack_cache[1:]
+        def build():
+            import jax.numpy as jnp
+
+            from ..ops import device as dev
+            from ..parallel.store import container_words_u32
+
+            keys = list(self.ebm.high_low_container.keys)
+            kidx = {k: i for i, k in enumerate(keys)}
+            K = len(keys)
+            S = self.bit_count()
+            ebm_w = np.zeros((K, dev.DEVICE_WORDS), dtype=np.uint32)
+            for k, c in zip(keys, self.ebm.high_low_container.containers):
+                ebm_w[kidx[k]] = container_words_u32(c)
+            slices_w = np.zeros((S, K, dev.DEVICE_WORDS), dtype=np.uint32)
+            for i, s in enumerate(self.slices):
+                hlc = s.high_low_container
+                for k, c in zip(hlc.keys, hlc.containers):
+                    j = kidx.get(k)
+                    if j is not None:
+                        slices_w[i, j] = container_words_u32(c)
+            value = (keys, jnp.asarray(ebm_w), jnp.asarray(slices_w))
+            return value, int(ebm_w.nbytes) + int(slices_w.nbytes)
+
+        return store.PACK_CACHE.get_or_build(
+            key, build, refs=store.static_fp_refs([self.ebm] + list(self.slices))
+        )
 
     @staticmethod
     def _found_words(keys, shape, found_set: RoaringBitmap):

@@ -54,8 +54,11 @@ class Roaring64BitmapSliceIndex:
             Roaring64Bitmap() for _ in range(max(0, int(max_value)).bit_length())
         ]
         self.run_optimized = False
+        # mutation counter: keys this index's resident pack in the shared
+        # PACK_CACHE (the 64-bit designs have no per-array fingerprint, so
+        # the entry key is (id(self), _version) with self held as a ref —
+        # see _pack_dense64)
         self._version = 0
-        self._pack_cache = None
 
     # ------------------------------------------------------------------
     # construction
@@ -376,34 +379,40 @@ class Roaring64BitmapSliceIndex:
         """[S, K, 2048] slice tensor + [K, 2048] ebm over the ebm's high-48
         chunk keys — the 64-bit twin of bsi._pack_dense; the K axis IS the
         long-context scaling axis (SURVEY §5: 64-bit universes shard along
-        the key axis). Cached until the next mutation."""
-        if self._pack_cache is not None and self._pack_cache[0] == self._version:
-            return self._pack_cache[1:]
-        import jax.numpy as jnp
+        the key axis). Resident in the shared PACK_CACHE (ISSUE 4) so
+        64-bit BSI tensors share the same byte budget, LRU, and close()
+        as everything else. The 64-bit container stores have no
+        per-array fingerprint, so the key is ``(id(self), _version)``
+        with ``self`` held as an entry ref — the id cannot be recycled
+        by a different index while the entry is resident, and every
+        mutation re-keys it."""
+        from ..parallel import store
 
-        from ..ops import device as dev
-        from ..parallel.store import container_words_u32
+        key = ("bsi64", id(self), self._version)
 
-        kv = list(self.ebm._kv())
-        keys = [k for k, _ in kv]
-        kidx = {k: i for i, k in enumerate(keys)}
-        K, S = len(keys), self.bit_count()
-        ebm_w = np.zeros((K, dev.DEVICE_WORDS), dtype=np.uint32)
-        for k, c in kv:
-            ebm_w[kidx[k]] = container_words_u32(c)
-        slices_w = np.zeros((S, K, dev.DEVICE_WORDS), dtype=np.uint32)
-        for i, sl in enumerate(self.slices):
-            for k, c in sl._kv():
-                ki = kidx.get(k)
-                if ki is not None:  # slice columns are always ebm columns
-                    slices_w[i, ki] = container_words_u32(c)
-        self._pack_cache = (
-            self._version,
-            keys,
-            jnp.asarray(ebm_w),
-            jnp.asarray(slices_w),
-        )
-        return self._pack_cache[1:]
+        def build():
+            import jax.numpy as jnp
+
+            from ..ops import device as dev
+            from ..parallel.store import container_words_u32
+
+            kv = list(self.ebm._kv())
+            keys = [k for k, _ in kv]
+            kidx = {k: i for i, k in enumerate(keys)}
+            K, S = len(keys), self.bit_count()
+            ebm_w = np.zeros((K, dev.DEVICE_WORDS), dtype=np.uint32)
+            for k, c in kv:
+                ebm_w[kidx[k]] = container_words_u32(c)
+            slices_w = np.zeros((S, K, dev.DEVICE_WORDS), dtype=np.uint32)
+            for i, sl in enumerate(self.slices):
+                for k, c in sl._kv():
+                    ki = kidx.get(k)
+                    if ki is not None:  # slice columns are always ebm columns
+                        slices_w[i, ki] = container_words_u32(c)
+            value = (keys, jnp.asarray(ebm_w), jnp.asarray(slices_w))
+            return value, int(ebm_w.nbytes) + int(slices_w.nbytes)
+
+        return store.PACK_CACHE.get_or_build(key, build, refs=(self,))
 
     def _found_words(self, keys, shape, found_set) -> np.ndarray:
         from ..parallel.store import container_words_u32

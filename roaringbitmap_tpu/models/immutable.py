@@ -123,6 +123,10 @@ class ImmutableRoaringBitmap:
     # surface without a second 2k-line twin class.
     _DELEGATED_READS = frozenset(
         {
+            # identity token for the result/pack caches: the mapped array
+            # never mutates, so the ("static", id) form is stable for the
+            # life of this object (the facade shares one high_low_container)
+            "fingerprint",
             "rank_long",
             "next_value",
             "previous_value",

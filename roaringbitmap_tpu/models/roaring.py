@@ -112,7 +112,7 @@ class RoaringBitmap:
         if i < len(keys) and keys[i] == hb:
             containers = hlc.containers
             containers[i] = containers[i].add(lb)
-            hlc._version += 1  # frame-flat path bypasses set_container_at_index
+            hlc.touch_key(hb)  # frame-flat path bypasses set_container_at_index
         else:
             hlc.insert_new_key_value_at(
                 i, hb, ArrayContainer(np.array([lb], dtype=np.uint16))

@@ -24,6 +24,11 @@ from .registry import (
     HOST_OP_SECONDS,
     KERNEL_DISPATCH_TOTAL,
     KERNEL_PROBE_TOTAL,
+    PACK_CACHE_DELTA_ROWS_TOTAL,
+    PACK_CACHE_EVICTED_BYTES_TOTAL,
+    PACK_CACHE_HITS_TOTAL,
+    PACK_CACHE_MISSES_TOTAL,
+    PACK_CACHE_RESIDENT_BYTES,
     QUERY_CACHE_TOTAL,
     QUERY_PLAN_TOTAL,
     REGISTRY,
@@ -88,6 +93,11 @@ __all__ = [
     "STORE_LAYOUT_TOTAL",
     "STORE_TRANSFER_BYTES_TOTAL",
     "STORE_RESIDENT_BYTES",
+    "PACK_CACHE_HITS_TOTAL",
+    "PACK_CACHE_MISSES_TOTAL",
+    "PACK_CACHE_DELTA_ROWS_TOTAL",
+    "PACK_CACHE_EVICTED_BYTES_TOTAL",
+    "PACK_CACHE_RESIDENT_BYTES",
     "BATCH_PAIRWISE_TOTAL",
     "SERIAL_BYTES_TOTAL",
     "HOST_OP_SECONDS",

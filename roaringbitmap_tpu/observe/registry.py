@@ -41,6 +41,11 @@ KERNEL_PROBE_TOTAL = "rb_tpu_kernel_probe_total"
 STORE_LAYOUT_TOTAL = "rb_tpu_store_layout_total"
 STORE_TRANSFER_BYTES_TOTAL = "rb_tpu_store_transfer_bytes_total"
 STORE_RESIDENT_BYTES = "rb_tpu_store_resident_bytes"
+PACK_CACHE_HITS_TOTAL = "rb_tpu_pack_cache_hits_total"
+PACK_CACHE_MISSES_TOTAL = "rb_tpu_pack_cache_misses_total"
+PACK_CACHE_DELTA_ROWS_TOTAL = "rb_tpu_pack_cache_delta_rows_total"
+PACK_CACHE_EVICTED_BYTES_TOTAL = "rb_tpu_pack_cache_evicted_bytes_total"
+PACK_CACHE_RESIDENT_BYTES = "rb_tpu_pack_cache_resident_bytes"
 BATCH_PAIRWISE_TOTAL = "rb_tpu_batch_pairwise_total"
 SERIAL_BYTES_TOTAL = "rb_tpu_serial_bytes_total"
 HOST_OP_SECONDS = "rb_tpu_host_op_seconds"

@@ -131,8 +131,15 @@ def _cpu_aggregate(
     return out
 
 
-def _device_aggregate(groups: Dict[int, List[Container]], op: str) -> RoaringBitmap:
-    packed = store.pack_groups(groups)
+def _device_aggregate(
+    bitmaps: Sequence[RoaringBitmap], keys_filter, op: str
+) -> RoaringBitmap:
+    """Device reduce via the resident pack cache (ISSUE 4): a warm working
+    set skips the host transpose + pack entirely; a mutated one re-ships
+    only its dirty rows. The pack is op-independent (fill values live in
+    the per-layout caches), so OR/XOR/AND-cardinality over the same
+    bitmaps share one resident entry."""
+    packed = store.packed_for(bitmaps, keys_filter)
     if config.mesh is not None:
         words, cards = _sharded_reduce(packed, op)
     else:
@@ -177,19 +184,23 @@ def _sharded_reduce(packed: "store.PackedGroups", op: str, cards_only: bool = Fa
     return np.asarray(red), np.asarray(cards).astype(np.int64)
 
 
-def _prepare_groups(bitmaps: Sequence[RoaringBitmap], op: str):
+def _dispatch_prelude(bitmaps: Sequence[RoaringBitmap], op: str):
     """Shared dispatch prelude for the materializing and cardinality-only
-    engines: key-major transpose (AND pre-filtered through the key
-    intersection, FastAggregation.workShyAnd). Returns (groups, n_rows), or
-    None when the AND key intersection is empty (trivially empty result)."""
+    engines: the AND key intersection (FastAggregation.workShyAnd) and the
+    working-set row count — WITHOUT building the key-major transpose, so a
+    warm device path (resident pack-cache hit) never pays the group walk.
+    Returns ``(keys_filter, n_rows)``; keys_filter is None for or/xor and
+    an empty set when the AND intersection is empty (trivial result)."""
     if op == "and":
         keys = store.intersect_keys(bitmaps)
         if not keys:
-            return None
-        groups = store.group_by_key(bitmaps, keys_filter=keys)
-    else:
-        groups = store.group_by_key(bitmaps)
-    return groups, sum(len(v) for v in groups.values())
+            return set(), 0
+        n = sum(
+            sum(1 for k in bm.high_low_container.keys if k in keys)
+            for bm in bitmaps
+        )
+        return keys, n
+    return None, sum(bm.high_low_container.size for bm in bitmaps)
 
 
 def _aggregate(
@@ -203,12 +214,12 @@ def _aggregate(
         return RoaringBitmap()
     if len(bitmaps) == 1:
         return bitmaps[0].clone()
-    prepared = _prepare_groups(bitmaps, op)
-    if prepared is None:
+    keys, n = _dispatch_prelude(bitmaps, op)
+    if keys is not None and not keys:
         return RoaringBitmap()
-    groups, n = prepared
     if _use_device(n, mode):
-        return _device_aggregate(groups, op)
+        return _device_aggregate(bitmaps, keys, op)
+    groups = store.group_by_key(bitmaps, keys_filter=keys)
     return _cpu_aggregate(groups, op, pool=pool)
 
 
@@ -456,18 +467,17 @@ def _aggregate_cardinality(bitmaps: List[RoaringBitmap], op: str, mode) -> int:
         return 0
     if len(bitmaps) == 1:
         return bitmaps[0].get_cardinality()
-    prepared = _prepare_groups(bitmaps, op)
-    if prepared is None:
+    keys, n = _dispatch_prelude(bitmaps, op)
+    if keys is not None and not keys:
         return 0
-    groups, n = prepared
     if _use_device(n, mode):
-        packed = store.pack_groups(groups)
+        packed = store.packed_for(bitmaps, keys)  # resident-cache routed
         if config.mesh is not None:  # same ICI-sharded reduce as _device_aggregate
             _none, cards = _sharded_reduce(packed, op, cards_only=True)
         else:
             cards = store.reduce_packed_cardinality(packed, op=op)
         return int(cards.sum())
-    return _cpu_aggregate(groups, op).get_cardinality()
+    return _cpu_aggregate(store.group_by_key(bitmaps, keys_filter=keys), op).get_cardinality()
 
 
 class ParallelAggregation:
@@ -522,8 +532,8 @@ class ParallelAggregation:
             return RoaringBitmap()
         if len(bitmaps) == 1:
             return bitmaps[0].clone()
-        groups = store.group_by_key(bitmaps)
-        n = sum(len(v) for v in groups.values())
+        n = sum(bm.high_low_container.size for bm in bitmaps)
         if _use_device(n, mode):
-            return _device_aggregate(groups, op)
+            return _device_aggregate(bitmaps, None, op)
+        groups = store.group_by_key(bitmaps)
         return _cpu_aggregate(groups, op, pool=ParallelAggregation._shared_pool())

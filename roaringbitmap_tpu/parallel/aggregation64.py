@@ -172,7 +172,7 @@ def _workshy_keys(bms) -> set:
 
 
 def _prepare_groups64(bms, op: str):
-    """Shared grouping prelude (the 32-bit _prepare_groups twin): AND goes
+    """Shared grouping prelude (the 32-bit _dispatch_prelude analogue, pre-pack-cache shape): AND goes
     through the key intersection; returns (groups, n_rows) or None when the
     result is trivially empty."""
     if op == "and":

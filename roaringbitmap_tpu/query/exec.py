@@ -18,9 +18,20 @@ A bounded plan memo keyed that way keeps the warm repeated-query path free
 of rewrite/estimate work (code-review: planning must not dominate the
 cache-hit steady state); a leaf mutation re-plans by key miss.
 
+Below the result cache sits the resident pack cache (ISSUE 4,
+parallel/store.PACK_CACHE): every device engine a step dispatches to —
+FastAggregation and/or/xor, the n-way andnot batch, the bit-sliced
+threshold — keys its packed working set by the SAME leaf fingerprints
+this executor snapshots for result keys. A repeated query whose result
+cache was disabled (or evicted) therefore still performs zero host packs:
+the leaf packs come back resident, shared across the query's own nodes
+and across queries over the same leaves. A leaf mutation delta-repacks
+O(changed containers) rows instead of rebuilding the working set.
+
 Instrumentation: ``rb_tpu_host_op_seconds{name="query.execute"}`` (and the
 matching span) around the run, ``rb_tpu_query_cache_total{event}`` from the
-cache, ``rb_tpu_query_plan_total{engine}`` from the planner.
+cache, ``rb_tpu_query_plan_total{engine}`` from the planner, and
+``rb_tpu_pack_cache_*`` from the pack cache underneath.
 """
 
 from __future__ import annotations

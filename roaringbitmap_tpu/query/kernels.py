@@ -53,6 +53,23 @@ def _rest_groups(first: RoaringBitmap, rest: Sequence[RoaringBitmap]):
     return groups
 
 
+def _covered(first: RoaringBitmap, rest):
+    """``(covered_keys, covered_rows)`` — the keys of ``first`` that any
+    subtrahend shares, and the count of subtrahend containers on them —
+    from the key lists alone, so the warm device path (resident pack-cache
+    hit) never pays the container transpose. The single source of the
+    key-partition rule for both andnot entry points and the device core."""
+    fk = set(first.high_low_container.keys)
+    keys: set = set()
+    rows = 0
+    for bm in rest:
+        for k in bm.high_low_container.keys:
+            if k in fk:
+                keys.add(k)
+                rows += 1
+    return keys, rows
+
+
 def _cpu_folds(first: RoaringBitmap, groups: dict):
     """The shared CPU core: per key of ``first`` yield ``(key, container,
     folded_words)`` — folded_words is None for pass-through keys with no
@@ -79,10 +96,10 @@ def andnot_nway(
 
     if not rest:
         return first.clone()
+    ckeys, crows = _covered(first, rest)
+    if crows and _use_device(first.high_low_container.size + crows, mode):
+        return _device_andnot(first, rest, ckeys)
     groups = _rest_groups(first, rest)
-    n_rows = first.high_low_container.size + sum(len(v) for v in groups.values())
-    if groups and _use_device(n_rows, mode):
-        return _device_andnot(first, groups)
     out = RoaringBitmap()
     for k, c, acc in _cpu_folds(first, groups):
         if acc is None:
@@ -103,24 +120,30 @@ def andnot_nway_cardinality(
 
     if not rest:
         return first.get_cardinality()
-    groups = _rest_groups(first, rest)
-    n_rows = first.high_low_container.size + sum(len(v) for v in groups.values())
-    if groups and _use_device(n_rows, mode):
-        _, cards, passthrough = _device_andnot_parts(first, groups)
+    ckeys, crows = _covered(first, rest)
+    if crows and _use_device(first.high_low_container.size + crows, mode):
+        _, cards, passthrough, _keys = _device_andnot_parts(first, rest, ckeys)
         return int(np.asarray(cards).astype(np.int64).sum()) + sum(
             c.cardinality for _, c in passthrough
         )
+    groups = _rest_groups(first, rest)
     return sum(
         c.cardinality if acc is None else bits.cardinality_of_words(acc)
         for _k, c, acc in _cpu_folds(first, groups)
     )
 
 
-def _device_andnot_parts(first: RoaringBitmap, groups: dict):
+def _device_andnot_parts(first: RoaringBitmap, rest, covered_keys: set):
     """Shared device core: reduce the subtrahend union per covered key and
     fuse the ``first & ~union`` mask + popcount into one dispatch. Returns
     (masked device words [G, 2048], cards [G], passthrough key/container
-    pairs for first's uncovered keys)."""
+    pairs for first's uncovered keys, sorted covered keys int64[G]).
+
+    Both packs — the subtrahend groups AND first's covered rows — live in
+    the resident pack cache (store.PACK_CACHE, ISSUE 4) under the operand
+    fingerprints; the group transpose itself happens only inside the miss
+    build, so a repeated andnot over unchanged bitmaps performs zero host
+    packs AND no per-container walk (only the key partition of first)."""
     import jax.numpy as jnp
 
     from ..ops import device as dev
@@ -128,23 +151,37 @@ def _device_andnot_parts(first: RoaringBitmap, groups: dict):
     from .. import tracing
 
     hlc = first.high_low_container
-    covered = [(k, c) for k, c in zip(hlc.keys, hlc.containers) if k in groups]
-    passthrough = [(k, c) for k, c in zip(hlc.keys, hlc.containers) if k not in groups]
+    covered = [(k, c) for k, c in zip(hlc.keys, hlc.containers) if k in covered_keys]
+    passthrough = [
+        (k, c) for k, c in zip(hlc.keys, hlc.containers) if k not in covered_keys
+    ]
+    operands = (first,) + tuple(rest)
+    key = (
+        "andnot",
+        first.fingerprint(),
+        tuple(bm.fingerprint() for bm in rest),
+    )
+
+    def build():
+        packed = store.pack_groups(_rest_groups(first, rest))
+        first_rows = jnp.asarray(store.pack_rows_host([c for _, c in covered]))
+        return (packed, first_rows), int(packed.words.nbytes) + int(first_rows.nbytes)
+
     with tracing.op_timer("query.andnot.device"):
-        packed = store.pack_groups(groups)
+        packed, first_rows = store.PACK_CACHE.get_or_build(
+            key, build, refs=store.static_fp_refs(operands)
+        )
         run, _layout = store.prepare_reduce(packed, op="or")
         union, _ = run()
-        first_rows = jnp.asarray(store.pack_rows_host([c for _, c in covered]))
         masked = first_rows & ~jnp.asarray(union)
         cards = dev.popcount_rows(masked)
-    return masked, cards, passthrough
+    return masked, cards, passthrough, np.asarray(sorted(covered_keys), dtype=np.int64)
 
 
-def _device_andnot(first: RoaringBitmap, groups: dict) -> RoaringBitmap:
+def _device_andnot(first: RoaringBitmap, rest, covered_keys: set) -> RoaringBitmap:
     from ..parallel import store
 
-    masked, cards, passthrough = _device_andnot_parts(first, groups)
-    keys = np.asarray(sorted(groups), dtype=np.int64)
+    masked, cards, passthrough, keys = _device_andnot_parts(first, rest, covered_keys)
     computed = dict(
         store.iter_group_containers(
             keys, np.asarray(masked), np.asarray(cards).astype(np.int64)
@@ -212,17 +249,24 @@ def threshold(
         return aggregation.FastAggregation.or_(*bms, mode=mode)
     if k == len(bms):
         return aggregation.FastAggregation.and_(*bms, mode=mode)
-    groups = store.group_by_key(bms)
-    # a key present in fewer than k containers can never reach the threshold
-    groups = {key: cs for key, cs in groups.items() if len(cs) >= k}
+    # a key present in fewer than k containers can never reach the
+    # threshold — decided from the key lists alone so the warm device path
+    # (resident pack-cache hit) skips the container transpose entirely
+    from collections import Counter
+
+    key_counts = Counter()
+    for bm in bms:
+        key_counts.update(bm.high_low_container.keys)
+    keys_ok = {key for key, c in key_counts.items() if c >= k}
     out = RoaringBitmap()
-    if not groups:
+    if not keys_ok:
         return out
-    n_rows = sum(len(v) for v in groups.values())
+    n_rows = sum(c for key, c in key_counts.items() if key in keys_ok)
     if aggregation._use_device(n_rows, mode):
-        dev_out = _device_threshold(groups, k)
+        dev_out = _device_threshold(bms, k, keys_ok)
         if dev_out is not None:
             return dev_out
+    groups = store.group_by_key(bms, keys_filter=keys_ok)
     for key in sorted(groups):
         slices: List[np.ndarray] = []
         for c in groups[key]:
@@ -280,15 +324,28 @@ def _threshold_kernel(k: int, n_slices: int):
     return fn
 
 
-def _device_threshold(groups: dict, k: int) -> Optional[RoaringBitmap]:
+def _device_threshold(bms, k: int, keys_ok: set) -> Optional[RoaringBitmap]:
     """Dense-padded device path; None when the group distribution is too
-    skewed to pad (caller falls back to the CPU fold)."""
+    skewed to pad (caller falls back to the CPU fold). The pack is resident
+    in the shared cache (k participates in the key: it decides which key
+    groups survive the >= k pre-filter, hence the pack contents); the
+    group transpose runs only inside the miss build."""
     from ..parallel import store
     from .. import tracing
 
-    packed = store.pack_groups(groups)
+    def _build():
+        p = store.pack_groups(store.group_by_key(bms, keys_filter=keys_ok))
+        return p, int(p.words.nbytes)
+
+    key = ("threshold", k, tuple(bm.fingerprint() for bm in bms))
+    packed = store.PACK_CACHE.get_or_build(
+        key, _build, refs=store.static_fp_refs(bms)
+    )
     words3 = packed.padded_device(0)  # zero fill rows add nothing to counts
     if words3 is None:
+        # too skewed to pad: the CPU fold serves this working set, so a
+        # resident pack would only squat on the shared budget — drop it
+        store.PACK_CACHE.discard(key)
         return None
     m = int(words3.shape[1])
     n_slices = max(1, m.bit_length())  # counters reach at most m < 2^L

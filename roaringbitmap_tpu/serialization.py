@@ -280,9 +280,11 @@ def read_into(bm: RoaringBitmap, data) -> int:
     hlc.keys = []
     hlc.containers = []
     # this refill path rebinds the lists directly (bypassing the mutator
-    # methods), so bump the mutation version by hand — a stale fingerprint
-    # here would let the query result cache serve pre-deserialize results
-    hlc._version += 1
+    # methods), so record a wholesale mutation — a stale fingerprint here
+    # would let the query result cache serve pre-deserialize results, and a
+    # key-attributed bump would let the pack cache delta-repack rows that
+    # were in fact replaced wholesale (mark_all_dirty forces a full repack)
+    hlc.mark_all_dirty()
     for i in range(size):
         key = int(keys[i])
         card = int(cards[i])

@@ -26,7 +26,7 @@ if [[ "${1:-}" != "--fast" ]]; then
   python -m pytest tests/ -q
 fi
 
-step "fuzz smoke (500 iterations x 23 invariant families)"
+step "fuzz smoke (500 iterations x 24 invariant families)"
 python -m roaringbitmap_tpu.fuzz 500 > /tmp/ci_fuzz.log 2>&1 \
   || { tail -20 /tmp/ci_fuzz.log; exit 1; }
 tail -1 /tmp/ci_fuzz.log
@@ -42,22 +42,25 @@ fuzz.verify_query_invariance(
 print("query differential ok (55 DAGs, cpu + forced-device engines)")
 from benchmarks import query
 rs = {r.benchmark: r.value for r in query.run(reps=1, datasets=["census1881"], limit=32)}
-need = {"queryNaive", "queryPlanned", "queryPlannedColdCache", "queryPlannedWarmCache"}
+need = {"queryNaive", "queryPlanned", "queryPlannedColdCache", "queryPlannedWarmCache",
+        "queryPlannedColdPack", "queryPlannedWarmPack"}
 missing = need - set(rs)
 if missing:
     raise SystemExit("query bench contract: missing %s" % sorted(missing))
 if not all(v > 0 for v in rs.values()):
     raise SystemExit("query bench contract: non-positive timing %r" % rs)
-print("query bench ok (planned %.1fx vs naive, warm cache %.1fx)"
+print("query bench ok (planned %.1fx vs naive, warm cache %.1fx, warm pack %.1fx vs cold)"
       % (rs["queryNaive"] / rs["queryPlanned"],
-         rs["queryNaive"] / rs["queryPlannedWarmCache"]))
+         rs["queryNaive"] / rs["queryPlannedWarmCache"],
+         rs["queryPlannedColdPack"] / rs["queryPlannedWarmPack"]))
 EOF
 
 step "bench.py --smoke (end-to-end north-star path, CPU)"
 # validate the driver contract, not just the exit code: exactly the keys
 # BENCH_r*.json records, with a sane positive speedup
-rm -f /tmp/ci_bench_metrics.json
+rm -f /tmp/ci_bench_metrics.json /tmp/ci_bench.json
 JAX_PLATFORMS=cpu BENCH_METRICS_OUT=/tmp/ci_bench_metrics.json \
+  BENCH_JSON_OUT=/tmp/ci_bench.json \
   python bench.py --smoke | python -c '
 import json, sys
 line = sys.stdin.readlines()[-1]
@@ -67,6 +70,30 @@ if set(r) != {"metric", "value", "unit", "vs_baseline"}:
 if not (r["value"] > 0 and r["vs_baseline"] > 0):
     raise SystemExit("bench contract: non-positive %s" % r)
 print("bench contract ok (vs_baseline %s)" % r["vs_baseline"])'
+
+step "pack-cache rows in the bench artifact (ISSUE 4 contract)"
+# cold/warm/delta schema: the warm lookup must be cheaper than the cold
+# pack, and the delta repack must ship exactly the mutated containers
+# (O(k) rows, not O(N)) — asserted on the committed-artifact meta block
+python -c '
+import json
+m = json.load(open("/tmp/ci_bench.json"))["meta"]
+need = {"pack_cache_hit_ratio", "delta_repack_s", "pack_warm_s",
+        "pack_delta_rows", "pack_mutated_containers"}
+missing = need - set(m)
+if missing:
+    raise SystemExit("bench pack-cache contract: missing %s" % sorted(missing))
+if not (0.0 <= m["pack_cache_hit_ratio"] <= 1.0):
+    raise SystemExit("bench pack-cache contract: bad hit ratio %r" % m)
+if not (0 < m["pack_warm_s"] < m["pack_s"]):
+    raise SystemExit("bench pack-cache contract: warm lookup not cheaper than cold pack %r" % m)
+if m["pack_delta_rows"] != m["pack_mutated_containers"]:
+    raise SystemExit("bench pack-cache contract: delta shipped %s rows for %s mutations"
+                     % (m["pack_delta_rows"], m["pack_mutated_containers"]))
+if not m["delta_repack_s"] > 0:
+    raise SystemExit("bench pack-cache contract: non-positive delta_repack_s %r" % m)
+print("pack-cache rows ok (hit ratio %s, delta %s rows in %ss)"
+      % (m["pack_cache_hit_ratio"], m["pack_delta_rows"], m["delta_repack_s"]))'
 
 step "bench metrics sidecar (observe/ registry snapshot contract)"
 # same SystemExit discipline as the driver-contract check above: the smoke
@@ -90,7 +117,11 @@ for key in ("kernel", "layout", "transfer_bytes"):
         raise SystemExit("metrics sidecar %s must map str->int: %r" % (key, m[key]))
 if not (m["layout"] and m["spans"]):
     raise SystemExit("metrics sidecar recorded no layouts/spans: %r" % sorted(m))
-print("metrics sidecar ok (layouts %s, %d span paths)" % (m["layout"], len(m["spans"])))'
+pack = m.get("registry", {}).get("rb_tpu_pack_cache_hits_total", {}).get("samples", [])
+if not pack:
+    raise SystemExit("metrics sidecar recorded no pack-cache hits (ISSUE 4)")
+print("metrics sidecar ok (layouts %s, %d span paths, pack-cache hits %s)"
+      % (m["layout"], len(m["spans"]), sum(s["value"] for s in pack)))'
 
 step "graft entry + 8-device virtual-mesh dryrun"
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

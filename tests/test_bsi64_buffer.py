@@ -267,11 +267,15 @@ def test_bsi64_device_path_matches_cpu():
     cpu = bsi.compare(Operation.NEQ, med, 0, fs2, mode="cpu")
     dev = bsi.compare(Operation.NEQ, med, 0, fs2, mode="device")
     assert dev.serialize() == cpu.serialize()
-    # the pack is cached until mutation
-    assert bsi._pack_cache is not None
-    v = bsi._pack_cache[0]
+    # the pack is resident in the shared cache until mutation (ISSUE 4)
+    from roaringbitmap_tpu.parallel import store
+
+    key = ("bsi64", id(bsi), bsi._version)
+    assert key in store.PACK_CACHE
+    v = bsi._version
     bsi.set_value(int(cols[0]), 7)
     assert bsi._version != v
+    assert ("bsi64", id(bsi), bsi._version) != key  # mutation re-keys
 
 
 def test_bsi64_compare_cardinality():

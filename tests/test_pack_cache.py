@@ -1,0 +1,663 @@
+"""Resident pack cache (ISSUE 4): warm hits, incremental delta repack,
+byte-budget LRU eviction, pinning, cache-aware close, clone identity,
+BSI/query unification, and the lock-order hammer.
+
+The acceptance claims are asserted the way production observes them — via
+the ``rb_tpu_pack_cache_*`` registry counters and the
+``store.pack_rows_host`` op-timer count (a "host pack" is exactly one
+observation of that timer).
+"""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import observe
+from roaringbitmap_tpu.models.roaring import RoaringBitmap
+from roaringbitmap_tpu.parallel import store
+from roaringbitmap_tpu.parallel.aggregation import FastAggregation as FA
+
+
+def _bm(rng, n=2000, spread=1 << 18):
+    return RoaringBitmap(
+        np.sort(rng.choice(spread, size=n, replace=False)).astype(np.uint32)
+    )
+
+
+def _working_set(seed=7, k=5):
+    rng = np.random.default_rng(seed)
+    return [_bm(rng) for _ in range(k)]
+
+
+def _host_pack_count() -> int:
+    """Observations of the store.pack_rows_host op timer — one per host
+    pack, the quantity the warm path must hold at zero."""
+    h = observe.REGISTRY.get(observe.HOST_OP_SECONDS)
+    if h is None:
+        return 0
+    st = h.get(("store.pack_rows_host",))
+    return 0 if st is None else st["count"]
+
+
+def _agg_counts():
+    hits = observe.REGISTRY.get(observe.PACK_CACHE_HITS_TOTAL)
+    misses = observe.REGISTRY.get(observe.PACK_CACHE_MISSES_TOTAL)
+    delta = observe.REGISTRY.get(observe.PACK_CACHE_DELTA_ROWS_TOTAL)
+    return (
+        hits.get(("agg",)) if hits else 0,
+        misses.get(("agg",)) if misses else 0,
+        delta.get(("agg",)) if delta else 0,
+    )
+
+
+# ---------------------------------------------------------------------------
+# warm hits: zero host packs after the first call
+# ---------------------------------------------------------------------------
+
+
+def test_repeated_wide_or_zero_host_packs():
+    bms = _working_set(seed=1)
+    want = FA.naive_or(*bms)
+    assert FA.or_(*bms, mode="device") == want
+    h0, m0, _ = _agg_counts()
+    packs0 = _host_pack_count()
+    for _ in range(3):
+        assert FA.or_(*bms, mode="device") == want
+    h1, m1, _ = _agg_counts()
+    assert h1 == h0 + 3, "every repeat must be served resident"
+    assert m1 == m0, "no repeat may pay a full pack"
+    assert _host_pack_count() == packs0, "zero host packs on the warm path"
+
+
+def test_or_xor_and_cardinality_share_one_entry():
+    """The pack is op-independent: OR, XOR, and the cardinality-only
+    engines over the same bitmaps ride one resident entry."""
+    bms = _working_set(seed=2)
+    FA.or_(*bms, mode="device")  # populate
+    h0, m0, _ = _agg_counts()
+    FA.xor(*bms, mode="device")
+    FA.or_cardinality(*bms, mode="device")
+    FA.xor_cardinality(*bms, mode="device")
+    h1, m1, _ = _agg_counts()
+    assert h1 == h0 + 3 and m1 == m0
+
+
+def test_and_uses_separate_filtered_entry():
+    bms = _working_set(seed=3)
+    FA.or_(*bms, mode="device")
+    _, m0, _ = _agg_counts()
+    want = FA.naive_and(*bms)
+    assert FA.and_(*bms, mode="device") == want
+    _, m1, _ = _agg_counts()
+    assert m1 == m0 + 1, "AND packs the key-intersection layout (own entry)"
+    h0, _, _ = _agg_counts()
+    assert FA.and_(*bms, mode="device") == want
+    h1, _, _ = _agg_counts()
+    assert h1 == h0 + 1
+
+
+# ---------------------------------------------------------------------------
+# incremental delta repack
+# ---------------------------------------------------------------------------
+
+
+def test_delta_repack_ships_o_k_rows():
+    bms = _working_set(seed=4, k=8)
+    want = FA.naive_or(*bms)
+    assert FA.or_(*bms, mode="device") == want
+    # make the flat rows device-resident so the delta has something to
+    # patch (the padded layout alone never ships them on this backend)
+    _ = store.packed_for(bms).device_words
+    n_rows = sum(bm.high_low_container.size for bm in bms)
+    k = 3
+    for bm in bms[:k]:  # one container each, existing chunk keys
+        hb = int(bm.high_low_container.keys[0])
+        bm.add((hb << 16) | 54321)
+    h0, m0, d0 = _agg_counts()
+    xfer0 = observe.REGISTRY.get(observe.STORE_TRANSFER_BYTES_TOTAL).get(("pack_delta",))
+    got = FA.or_(*bms, mode="device")
+    assert got == FA.naive_or(*bms)
+    h1, m1, d1 = _agg_counts()
+    assert (h1, m1) == (h0 + 1, m0), "delta refresh counts as a hit"
+    assert d1 - d0 == k, f"must re-pack exactly {k} of {n_rows} rows"
+    xfer1 = observe.REGISTRY.get(observe.STORE_TRANSFER_BYTES_TOTAL).get(("pack_delta",))
+    assert xfer1 - xfer0 == k * 2048 * 4, "delta ships k rows of words, not O(N)"
+
+
+def test_delta_equals_full_repack_differential():
+    """The fuzz-family predicate at unit scale: a mutation sequence mixing
+    in-place edits with structural changes always yields a pack identical
+    to a from-scratch rebuild."""
+    from roaringbitmap_tpu import fuzz
+
+    fuzz.verify_pack_cache_invariance("unit-pack-cache", iterations=25, seed=99)
+
+
+def test_structural_mutation_forces_full_repack():
+    bms = _working_set(seed=5)
+    FA.or_(*bms, mode="device")
+    bms[0].add((300 << 16) | 1)  # brand-new chunk key
+    h0, m0, _ = _agg_counts()
+    assert FA.or_(*bms, mode="device") == FA.naive_or(*bms)
+    h1, m1, _ = _agg_counts()
+    assert m1 == m0 + 1 and h1 == h0
+
+
+def test_wholesale_deserialize_forces_full_repack():
+    """read_into rebinds the container lists without key attribution —
+    mark_all_dirty must veto the delta path."""
+    from roaringbitmap_tpu.serialization import read_into
+
+    bms = _working_set(seed=6)
+    FA.or_(*bms, mode="device")
+    read_into(bms[0], bms[1].serialize())
+    h0, m0, _ = _agg_counts()
+    assert FA.or_(*bms, mode="device") == FA.naive_or(*bms)
+    h1, m1, _ = _agg_counts()
+    assert m1 == m0 + 1 and h1 == h0
+
+
+def test_and_intersection_change_forces_full_repack():
+    rng = np.random.default_rng(11)
+    # two bitmaps sharing keys 0..3; bm0 additionally holds key 9
+    a = RoaringBitmap((np.arange(4000) + (0 << 16)).astype(np.uint32))
+    for key in (1, 2, 3, 9):
+        a.add_many(((np.arange(50) * 7) + (key << 16)).astype(np.uint32))
+    b = RoaringBitmap(np.concatenate(
+        [(rng.choice(1 << 16, 200, replace=False) + (k << 16)) for k in range(4)]
+    ).astype(np.uint32))
+    cache = store.PackCache(max_bytes=1 << 30)
+    keys = store.intersect_keys([a, b])
+    p1 = cache.get_packed([a, b], keys)
+    # grow the intersection: b gains key 9 (already in a)
+    b.add((9 << 16) | 5)
+    keys2 = store.intersect_keys([a, b])
+    assert keys2 != keys
+    p2 = cache.get_packed([a, b], keys2)
+    want = store.pack_groups(store.group_by_key([a, b], keys_filter=keys2))
+    assert np.array_equal(p2.group_keys, want.group_keys)
+    assert np.array_equal(p2.words, want.words)
+    assert cache.stats()["misses"] == 2, "intersection change cannot delta"
+    assert p1 is not p2
+    cache.close()
+
+
+def test_dirty_tracking_unit():
+    from roaringbitmap_tpu.models.roaring_array import RoaringArray
+    from roaringbitmap_tpu.models.container import ArrayContainer
+
+    ra = RoaringArray()
+    c = ArrayContainer(np.array([1, 2], dtype=np.uint16))
+    ra.append(3, c)
+    v0 = ra._version
+    assert ra.dirty_keys_since(v0) == set()
+    ra.append(7, c.clone())
+    ra.set_container_at_index(0, c.clone())
+    assert ra.dirty_keys_since(v0) == {3, 7}
+    ra.remove_at_index(1)  # removal of key 7 is attributed too
+    assert 7 in ra.dirty_keys_since(v0)
+    ra.mark_all_dirty()
+    assert ra.dirty_keys_since(v0) is None, "wholesale mutation -> unknowable"
+    assert ra.dirty_keys_since(ra._version) == set()
+
+
+# ---------------------------------------------------------------------------
+# clone identity (satellite: RoaringArray.clone fingerprint semantics)
+# ---------------------------------------------------------------------------
+
+
+def test_clone_mutations_never_touch_parent_cache():
+    bms = _working_set(seed=8)
+    want = FA.naive_or(*bms)
+    assert FA.or_(*bms, mode="device") == want
+    clones = [bm.clone() for bm in bms]
+    for cl in clones:  # hammer the clones
+        cl.add(12345)
+        cl.remove(int(cl.to_array()[0]))
+    h0, m0, d0 = _agg_counts()
+    # parent working set is untouched: exact resident hit, no delta rows
+    assert FA.or_(*bms, mode="device") == want
+    h1, m1, d1 = _agg_counts()
+    assert (h1, m1, d1) == (h0 + 1, m0, d0)
+    # and the clones never alias the parent's entry: fresh gen -> full pack
+    got = FA.or_(*clones, mode="device")
+    assert got == FA.naive_or(*clones)
+    _, m2, _ = _agg_counts()
+    assert m2 == m1 + 1
+
+
+def test_clone_fingerprint_identity():
+    bm = _working_set(seed=9, k=1)[0]
+    cl = bm.clone()
+    assert bm.fingerprint() != cl.fingerprint(), "process-unique generations"
+    fp = bm.fingerprint()
+    cl.add(1)
+    cl.remove(int(cl.to_array()[-1]))
+    assert bm.fingerprint() == fp, "clone mutations must not move the parent"
+
+
+# ---------------------------------------------------------------------------
+# byte-budget LRU eviction + pinning
+# ---------------------------------------------------------------------------
+
+
+def test_byte_budget_evicts_in_lru_order():
+    sets = [_working_set(seed=20 + i, k=2) for i in range(3)]
+    cache = store.PackCache(max_bytes=1 << 60)
+    packs = [cache.get_packed(s) for s in sets]
+    per_entry = packs[0].words.nbytes
+    cache.get_packed(sets[0])  # touch set 0: set 1 becomes LRU
+    cache.configure(max_bytes=int(per_entry * 2.5))  # room for two entries
+    st = cache.stats()
+    assert st["entries"] == 2 and st["evictions"] == 1
+    keys = [("agg", "all", tuple(b.fingerprint() for b in s)) for s in sets]
+    assert keys[0] in cache and keys[2] in cache and keys[1] not in cache
+    evicted = observe.REGISTRY.get(observe.PACK_CACHE_EVICTED_BYTES_TOTAL)
+    assert evicted.get(("agg",)) > 0
+    cache.close()
+    assert len(cache) == 0
+
+
+def test_pinned_entries_survive_eviction():
+    sets = [_working_set(seed=30 + i, k=2) for i in range(2)]
+    cache = store.PackCache(max_bytes=1 << 60)
+    pinned = cache.pin_packed(sets[0])
+    cache.get_packed(sets[1])
+    cache.configure(max_bytes=pinned.words.nbytes + 1)  # room for one
+    st = cache.stats()
+    assert st["pinned"] == 1
+    key0 = ("agg", "all", tuple(b.fingerprint() for b in sets[0]))
+    assert key0 in cache, "pinned LRU entry must be skipped by the evictor"
+    cache.unpin_packed(sets[0])
+    assert cache.stats()["pinned"] == 0
+    cache.close()
+
+
+def test_budget_counts_lazily_built_device_layouts():
+    """Derived layouts (flat ship, padded blocks) are built AFTER the
+    entry is stored; their bytes must flow into the cache's budget — a
+    words-only weight would let real HBM run multiples past max_bytes."""
+    bms = _working_set(seed=36, k=3)
+    cache = store.PackCache(max_bytes=1 << 60)
+    packed = cache.get_packed(bms)
+    base = cache.stats()["bytes"]
+    assert base == packed.words.nbytes
+    _ = packed.device_words
+    after_flat = cache.stats()["bytes"]
+    assert after_flat == base + packed.words.nbytes
+    _ = packed.padded_device(0)
+    after_padded = cache.stats()["bytes"]
+    assert after_padded > after_flat
+    # growth past the budget triggers eviction of colder entries
+    other = cache.get_packed(_working_set(seed=37, k=2))
+    cache.configure(max_bytes=after_padded + other.words.nbytes - 1)
+    assert cache.stats()["entries"] == 1, "layout growth must count"
+    cache.close()
+    assert cache.stats()["bytes"] == 0
+
+
+def test_pin_is_a_refcount():
+    bms = _working_set(seed=38, k=2)
+    cache = store.PackCache(max_bytes=1 << 60)
+    cache.pin_packed(bms)
+    cache.pin_packed(bms)  # second consumer pins the same working set
+    cache.unpin_packed(bms)  # first consumer releases
+    cache.configure(max_bytes=1)
+    assert cache.stats()["entries"] == 1, "still pinned by the second consumer"
+    cache.unpin_packed(bms)
+    cache.get_packed(_working_set(seed=39, k=2))  # pressure: now evictable
+    key = ("agg", "all", tuple(b.fingerprint() for b in bms))
+    assert key not in cache
+    cache.close()
+
+
+def test_unpin_survives_mutation_between_pin_and_unpin():
+    """unpin must resolve the entry by identity (generations): the entry
+    rekeys on every delta, so an exact-fingerprint lookup after a mutation
+    would silently leak the pin forever."""
+    bms = _working_set(seed=43, k=2)
+    cache = store.PackCache(max_bytes=1 << 60)
+    cache.pin_packed(bms)
+    hb = int(bms[0].high_low_container.keys[0])
+    bms[0].add((hb << 16) | 77)  # mutate between pin and unpin
+    cache.unpin_packed(bms)
+    assert cache.stats()["pinned"] == 0, "pin leaked across the mutation"
+    cache.close()
+
+
+def test_pinned_budget_does_not_thrash_new_entries():
+    """When pinned bytes alone exceed the budget, a freshly stored
+    unpinned entry must still survive as the anti-thrash survivor — not
+    be evicted inside its own store call."""
+    pinned_set = _working_set(seed=44, k=2)
+    cache = store.PackCache(max_bytes=1 << 60)
+    cache.pin_packed(pinned_set)
+    cache.configure(max_bytes=1)  # pinned entry alone blows the budget
+    bms = _working_set(seed=45, k=2)
+    p1 = cache.get_packed(bms)
+    p2 = cache.get_packed(bms)
+    assert p1 is p2, "new unpinned entry must not be store->evict thrashed"
+    assert cache.stats()["entries"] == 2
+    cache.close()
+
+
+def test_configure_zero_releases_everything():
+    bms = _working_set(seed=46, k=2)
+    cache = store.PackCache(max_bytes=1 << 60)
+    packed = cache.get_packed(bms)
+    _ = packed.device_words
+    cache.configure(0)
+    st = cache.stats()
+    assert st["entries"] == 0 and st["bytes"] == 0, "disable must free HBM"
+    assert getattr(packed, "_device_words", None) is None
+    # and the disabled path stays functional (fresh packs)
+    assert np.array_equal(cache.get_packed(bms).words, packed.words)
+    cache.close()
+
+
+def test_threshold_skew_fallback_leaves_no_resident_entry():
+    """A too-skewed-to-pad threshold working set falls back to the CPU
+    fold; its pack must not squat on the shared budget."""
+    from roaringbitmap_tpu.query import kernels
+
+    rng = np.random.default_rng(47)
+    # one giant key group + a long geometric tail defeats dense padding
+    bms = []
+    for i in range(24):
+        parts = [rng.choice(1 << 16, 300, replace=False).astype(np.uint32)]
+        if i < 2:
+            for key in range(1, 40):
+                parts.append(
+                    (rng.choice(1 << 16, 300, replace=False) + (key << 16)).astype(np.uint32)
+                )
+        bms.append(RoaringBitmap(np.concatenate(parts)))
+    want = kernels.threshold(3, bms, mode="cpu")
+    before = len(store.PACK_CACHE)
+    got = kernels.threshold(3, bms, mode="device")
+    assert got == want
+    keys = [k for k in list(store.PACK_CACHE._entries) if k[0] == "threshold"]
+    for k in keys:
+        packed = store.PACK_CACHE._entries[k].value
+        assert packed.padded_device(0) is not None, (
+            "skew-fallback threshold pack must be discarded, not resident"
+        )
+    assert len(store.PACK_CACHE) <= before + 1
+
+
+def test_static_fingerprint_ids_are_pinned_while_resident():
+    """("static", id) keys must keep the mapped container array alive —
+    a recycled id on a different immutable bitmap would be a stale hit."""
+    import gc
+
+    from roaringbitmap_tpu.models.immutable import ImmutableRoaringBitmap
+
+    bms = _working_set(seed=42, k=2)
+    imm = ImmutableRoaringBitmap(bms[0].serialize())
+    operands = [imm, bms[1]]
+    cache = store.PackCache(max_bytes=1 << 60)
+    cache.get_packed(operands)
+    hlc_id = id(imm.high_low_container)
+    key = ("agg", "all", tuple(b.fingerprint() for b in operands))
+    e = cache._entries[key]
+    assert any(id(r) == hlc_id for r in e.refs)
+    del imm, operands
+    gc.collect()
+    assert any(id(r) == hlc_id for r in e.refs), "entry keeps the id live"
+    cache.close()
+
+
+def test_single_oversized_entry_is_kept_not_thrashed():
+    """A working set larger than the whole budget must stay resident (the
+    north-star pack alone can exceed any fixed budget) — store->evict
+    thrash would turn every call into a cold pack."""
+    bms = _working_set(seed=34, k=2)
+    cache = store.PackCache(max_bytes=1)  # smaller than any real entry
+    p1 = cache.get_packed(bms)
+    p2 = cache.get_packed(bms)
+    assert p1 is p2, "the only entry survives the byte budget"
+    st = cache.stats()
+    assert st["entries"] == 1 and st["hits"] == 1
+    # a second working set still displaces it (LRU under pressure)
+    other = _working_set(seed=35, k=2)
+    cache.get_packed(other)
+    assert cache.stats()["entries"] == 1
+    cache.close()
+
+
+def test_disabled_cache_always_packs_fresh():
+    cache = store.PackCache(max_bytes=0)
+    bms = _working_set(seed=33, k=2)
+    p1 = cache.get_packed(bms)
+    p2 = cache.get_packed(bms)
+    assert p1 is not p2 and len(cache) == 0
+    assert np.array_equal(p1.words, p2.words)
+    # uncached packs are consumer-owned: close really frees
+    p1.close()
+    assert getattr(p1, "_device_words", None) is None
+
+
+# ---------------------------------------------------------------------------
+# lifetime: cache-aware close (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_close_while_cached_is_noop_and_eviction_really_closes():
+    cache = store.PackCache(max_bytes=1 << 60)
+    bms = _working_set(seed=40, k=2)
+    packed = cache.get_packed(bms)
+    _ = packed.device_words  # make device state resident
+    packed.close()  # consumer close: the cache owns lifetime -> no-op
+    assert getattr(packed, "_device_words", None) is not None
+    packed.close()  # double close: still a no-op, still safe
+    assert cache.get_packed(bms) is packed
+    cache.close()  # the OWNER close frees for real
+    assert getattr(packed, "_device_words", None) is None
+    packed.close()  # double close after the real one: idempotent
+    # a closed-but-alive working set stays usable (rebuilds on touch)
+    assert packed.device_words is not None
+
+
+def test_uncached_close_still_idempotent():
+    bms = _working_set(seed=41, k=2)
+    packed = store.pack_groups(store.group_by_key(bms))
+    _ = packed.device_words
+    packed.close()
+    assert getattr(packed, "_device_words", None) is None
+    packed.close()
+
+
+# ---------------------------------------------------------------------------
+# unified consumers: BSI + planned queries
+# ---------------------------------------------------------------------------
+
+
+def test_bsi_pack_rides_shared_cache():
+    from roaringbitmap_tpu.models.bsi import Operation, RoaringBitmapSliceIndex
+
+    rng = np.random.default_rng(50)
+    cols = np.sort(rng.choice(1 << 17, size=3000, replace=False)).astype(np.uint32)
+    vals = (cols.astype(np.int64) * 31) % 1000
+    b = RoaringBitmapSliceIndex()
+    b.set_values((cols, vals))
+    want = b.compare(Operation.GE, 500, 0, None, mode="cpu")
+    assert b.compare(Operation.GE, 500, 0, None, mode="device") == want
+    hits = observe.REGISTRY.get(observe.PACK_CACHE_HITS_TOTAL)
+    resident = observe.REGISTRY.get(observe.PACK_CACHE_RESIDENT_BYTES)
+    assert resident.get(("bsi",)) > 0, "BSI tensors live in the shared budget"
+    h0 = hits.get(("bsi",))
+    packs0 = _host_pack_count()
+    assert b.compare(Operation.LT, 200, 0, None, mode="device") == b.compare(
+        Operation.LT, 200, 0, None, mode="cpu"
+    )
+    assert hits.get(("bsi",)) == h0 + 1, "second compare reuses the resident pack"
+    assert _host_pack_count() == packs0
+    # mutation re-keys: the next compare pays a miss, never a stale hit
+    b.set_value(int(cols[0]), 999)
+    m0 = observe.REGISTRY.get(observe.PACK_CACHE_MISSES_TOTAL).get(("bsi",))
+    assert b.compare(Operation.GE, 500, 0, None, mode="device") == b.compare(
+        Operation.GE, 500, 0, None, mode="cpu"
+    )
+    assert observe.REGISTRY.get(observe.PACK_CACHE_MISSES_TOTAL).get(("bsi",)) == m0 + 1
+
+
+def test_planned_query_reuses_packs_without_result_cache():
+    """ISSUE 4 acceptance for query/exec.py: repeated planned queries with
+    the RESULT cache disabled still perform zero host packs on their
+    leaf-level steps — the leaf fingerprints key the same resident packs
+    across executions AND across structurally different queries sharing a
+    subexpression."""
+    from roaringbitmap_tpu.query import Q, evaluate_naive, execute
+
+    rng = np.random.default_rng(60)
+    leaves = [_bm(rng, n=3000) for _ in range(6)]
+    q = Q.or_(*[Q.leaf(b) for b in leaves])
+    want = evaluate_naive(q)
+    assert execute(q, cache=None, mode="device") == want  # cold: pack builds
+    packs0 = _host_pack_count()
+    for _ in range(2):
+        assert execute(q, cache=None, mode="device") == want
+    assert _host_pack_count() == packs0, "warm planned query must not host-pack"
+    # across queries: a different expression embedding the same wide-OR
+    # reuses its aggregation pack (the top andnot step works on a fresh
+    # intermediate, so only non-agg kinds may pack)
+    h0, m0, _ = _agg_counts()
+    q2 = Q.andnot(Q.or_(*[Q.leaf(b) for b in leaves]), Q.leaf(leaves[0]))
+    assert execute(q2, cache=None, mode="device") == evaluate_naive(q2)
+    h1, m1, _ = _agg_counts()
+    assert m1 == m0, "shared wide-OR subexpression must not re-pack"
+    assert h1 == h0 + 1
+
+
+def test_planned_query_result_cache_plus_delta_repack():
+    """The serving steady state: result cache ON, a leaf mutates — the
+    re-execution stays correct and the leaf-level working set refreshes by
+    delta repack (O(changed containers) rows), not a full rebuild."""
+    from roaringbitmap_tpu.query import Q, ResultCache, evaluate_naive, execute
+
+    rng = np.random.default_rng(62)
+    # well-separated cardinalities: a one-value mutation must not reorder
+    # the planner's cost-sorted operands (which would re-key the pack)
+    leaves = [_bm(rng, n=1500 + 500 * i) for i in range(5)]
+    q = Q.or_(*[Q.leaf(b) for b in leaves])
+    cache = ResultCache(max_entries=32)
+    assert execute(q, cache=cache, mode="device") == evaluate_naive(q)
+    _ = store.packed_for(leaves).device_words  # flat rows resident
+    packs0 = _host_pack_count()
+    assert execute(q, cache=cache, mode="device") == evaluate_naive(q)
+    assert _host_pack_count() == packs0, "result-cache hit: zero packs"
+    hb = int(leaves[0].high_low_container.keys[0])
+    leaves[0].add((hb << 16) | 4321)
+    _, _, d0 = _agg_counts()
+    assert execute(q, cache=cache, mode="device") == evaluate_naive(q)
+    _, _, d1 = _agg_counts()
+    assert d1 - d0 == 1, "one mutated container -> one delta row"
+
+
+def test_andnot_kernel_pack_reuse():
+    from roaringbitmap_tpu.query import kernels
+
+    rng = np.random.default_rng(61)
+    first, r1, r2 = _bm(rng), _bm(rng), _bm(rng)
+    want = kernels.andnot_nway(first, r1, r2, mode="cpu")
+    assert kernels.andnot_nway(first, r1, r2, mode="device") == want
+    packs0 = _host_pack_count()
+    assert kernels.andnot_nway(first, r1, r2, mode="device") == want
+    assert kernels.andnot_nway_cardinality(
+        first, r1, r2, mode="device"
+    ) == want.get_cardinality()
+    assert _host_pack_count() == packs0
+
+
+# ---------------------------------------------------------------------------
+# concurrency: hammer + lock-order witness
+# ---------------------------------------------------------------------------
+
+
+def test_pack_cache_hammer_threadsafe():
+    """8 threads x shared working sets through one cache: every result is
+    correct and the per-instance counters add up exactly."""
+    sets = [_working_set(seed=70 + i, k=3) for i in range(4)]
+    wants = [
+        store.pack_groups(store.group_by_key(s)).words.copy() for s in sets
+    ]
+    cache = store.PackCache(max_bytes=1 << 60)
+    errors = []
+    barrier = threading.Barrier(8)
+
+    def work(i):
+        try:
+            barrier.wait(timeout=10)
+            for j in range(40):
+                si = (i + j) % len(sets)
+                got = cache.get_packed(sets[si])
+                if not np.array_equal(got.words, wants[si]):
+                    errors.append((i, j, si))
+        except Exception as e:  # pragma: no cover - surfaced via assert
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    st = cache.stats()
+    assert st["hits"] + st["misses"] == 8 * 40
+    assert st["entries"] == len(sets)
+    cache.close()
+
+
+def test_pack_cache_lock_joins_order_graph_cycle_free(monkeypatch):
+    """The ISSUE 4 lockwitness hammer: the new pack-cache lock instrumented
+    alongside the registry lock (its only nesting partner) plus the query
+    caches it composes with in a serving process — concurrent aggregations,
+    BSI compares, and delta repacks must witness the pack.cache ->
+    observe.registry edge and keep the global acquisition graph acyclic."""
+    from roaringbitmap_tpu.analysis import LockWitness
+    from roaringbitmap_tpu.query import ResultCache, Q, execute
+
+    w = LockWitness()
+    reg_lock = observe.REGISTRY._lock
+    for metric in (store._PACK_HITS, store._PACK_MISSES, store._PACK_DELTA_ROWS,
+                   store._PACK_EVICTED_BYTES, store._PACK_RESIDENT,
+                   store._TRANSFER_TOTAL, store._LAYOUT_TOTAL):
+        monkeypatch.setattr(metric, "_lock", w.wrap("observe.registry", reg_lock))
+    cache = store.PackCache(max_bytes=1 << 60)
+    cache._lock = w.wrap("pack.cache", cache._lock)
+    monkeypatch.setattr(store, "PACK_CACHE", cache)
+    rcache = ResultCache(max_entries=16)
+    rcache._lock = w.wrap("query.cache", rcache._lock)
+
+    sets = [_working_set(seed=80 + i, k=3) for i in range(3)]
+    wants = [FA.naive_or(*s) for s in sets]
+    errors = []
+    barrier = threading.Barrier(6)
+
+    def work(i):
+        try:
+            barrier.wait(timeout=10)
+            for j in range(12):
+                si = (i + j) % len(sets)
+                if FA.or_(*sets[si], mode="device") != wants[si]:
+                    errors.append((i, j, si))
+                if j % 4 == 0:
+                    q = Q.leaf(sets[si][0]) & Q.leaf(sets[si][1])
+                    execute(q, cache=rcache)
+        except Exception as e:  # pragma: no cover - surfaced via assert
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # exercise the delta path under instrumentation too
+    hb = int(sets[0][0].high_low_container.keys[0])
+    sets[0][0].add((hb << 16) | 4242)
+    assert FA.or_(*sets[0], mode="device") == FA.naive_or(*sets[0])
+    assert not errors
+    assert w.acquisitions.get("pack.cache", 0) > 0
+    assert ("pack.cache", "observe.registry") in w.edges
+    w.assert_consistent()
