@@ -17,6 +17,14 @@ in-place number). Static and in-place results are asserted equal before
 timing. or/xor grids are recorded too (the reference only ships and/
 andnot grids; same shapes, marked beyond=true).
 
+Engine twins (ISSUE 5): the unsuffixed 32-bit rows pin the PER-CONTAINER
+engine (``columnar.disabled()``), keeping their historical meaning across
+BENCH_CPU_SWEEP rounds; each gains a ``columnar:`` twin calling the
+batched engine DIRECTLY on the same inputs, asserted value-equal first.
+(These grids are 10k single-value containers — the shape the router's
+``max_containers`` cap deliberately keeps on the per-container walk; the
+twin rows are the measured justification.)
+
 Run:  python -m benchmarks.run pairwise_cases --reps 5
 """
 
@@ -26,7 +34,7 @@ from typing import List
 
 import numpy as np
 
-from roaringbitmap_tpu import Roaring64Bitmap, RoaringBitmap
+from roaringbitmap_tpu import Roaring64Bitmap, RoaringBitmap, columnar
 
 from . import common
 from .common import Result
@@ -87,13 +95,34 @@ def run(reps: int = 5, datasets=None, **_) -> List[Result]:
                 got = inplace(b1.clone(), b2)
                 assert got == want, (case, width, opname)
                 extra = {} if opname in _REFERENCE_OPS else {"beyond": True}
-                rec(f"{case}:{opname}", ds, common.min_of(reps, lambda: static_op(b1, b2)), **extra)
+
+                def percontainer(fn=static_op):
+                    with columnar.disabled():
+                        return fn(b1, b2)
+
+                def percontainer_inplace(fn=inplace):
+                    with columnar.disabled():
+                        return fn(b1.clone(), b2)
+
+                rec(f"{case}:{opname}", ds, common.min_of(reps, percontainer), **extra)
                 rec(
                     f"{case}:inplace_{opname}",
                     ds,
-                    common.min_of(reps, lambda: inplace(b1.clone(), b2)),
+                    common.min_of(reps, percontainer_inplace),
                     **extra,
                 )
+                if width == 32:  # columnar engine twin (direct engine call)
+                    assert columnar.pairwise(opname, b1, b2) == want, (
+                        case, opname, "columnar",
+                    )
+                    rec(
+                        f"columnar:{case}:{opname}",
+                        ds,
+                        common.min_of(
+                            reps, lambda: columnar.pairwise(opname, b1, b2)
+                        ),
+                        **extra,
+                    )
 
     # buffer twins of the and/andnot grids (buffer/aggregation/{and,andnot}/
     # {bestcase,identical,worstcase}/MutableRoaringBitmapBenchmark.java):

@@ -7,12 +7,20 @@ measured as ns per wide aggregation; the device engines additionally report
 aggregate throughput.  Correctness of every engine against the naive fold is
 asserted by tests/test_benchmarks.py before numbers are trusted, mirroring
 jmh/src/test/.../RealDataBenchmarkOrTest.
+
+Engine twins (ISSUE 5): ``wideOr``/``wideXor``/``parallelOr`` pin the
+pre-columnar pooled word fold (``columnar.disabled()``), keeping their
+historical meaning; the ``columnar:`` twins measure the routed batched
+fold on the same corpus, asserted equal first. AND has no twin — its
+fold deliberately stays on the lazy per-group path (aggregation.py), so
+there is no second engine to measure.
 """
 
 from __future__ import annotations
 
 from typing import List
 
+from roaringbitmap_tpu import columnar
 from roaringbitmap_tpu.parallel.aggregation import FastAggregation, ParallelAggregation
 
 from . import common
@@ -27,16 +35,26 @@ def _suite(dataset: str, reps: int) -> List[Result]:
         ns = common.min_of(reps, fn)
         out.append(Result(name, dataset, ns, "ns/op", {"n_bitmaps": len(bms)}))
 
+    def percontainer(fn):
+        def run():
+            with columnar.disabled():
+                return fn()
+
+        return run
+
     bench("wideOrNaive", lambda: FastAggregation.naive_or(*bms))
-    bench("wideOr", lambda: FastAggregation.or_(*bms, mode="cpu"))
+    bench("wideOr", percontainer(lambda: FastAggregation.or_(*bms, mode="cpu")))
+    bench("columnar:wideOr", lambda: FastAggregation.or_(*bms, mode="cpu"))
     bench("wideOrDevice", lambda: FastAggregation.or_(*bms, mode="device"))
     bench("wideAndNaive", lambda: FastAggregation.naive_and(*bms))
     bench("wideAnd", lambda: FastAggregation.workshy_and(*bms, mode="cpu"))
     bench("wideAndDevice", lambda: FastAggregation.workshy_and(*bms, mode="device"))
-    bench("wideXor", lambda: FastAggregation.xor(*bms, mode="cpu"))
+    bench("wideXor", percontainer(lambda: FastAggregation.xor(*bms, mode="cpu")))
+    bench("columnar:wideXor", lambda: FastAggregation.xor(*bms, mode="cpu"))
     bench("horizontalOr", lambda: FastAggregation.horizontal_or(*bms))
     bench("priorityQueueOr", lambda: FastAggregation.priorityqueue_or(*bms))
-    bench("parallelOr", lambda: ParallelAggregation.or_(*bms, mode="cpu"))
+    bench("parallelOr", percontainer(lambda: ParallelAggregation.or_(*bms, mode="cpu")))
+    bench("columnar:parallelOr", lambda: ParallelAggregation.or_(*bms, mode="cpu"))
     bench("parallelOrDevice", lambda: ParallelAggregation.or_(*bms, mode="device"))
     bench("parallelXor", lambda: ParallelAggregation.xor(*bms, mode="cpu"))
     # cardinality-only N-way (device path fetches only per-group popcounts)

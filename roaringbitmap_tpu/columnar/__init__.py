@@ -1,0 +1,54 @@
+"""Columnar pairwise engine: type-partitioned batched container algebra
+(ISSUE 5).
+
+Executes whole bitmap-pair ops and N-way CPU fold steps WITHOUT
+per-container Python dispatch, in three vectorized stages:
+
+1. **key plan** (keyplan.py) — one searchsorted over the two key arrays
+   splits matched pairs from pass-throughs;
+2. **type partition** (partition.py) — matched pairs classify into the 9
+   ``(array|bitmap|run)²`` classes; array payloads gather into CSR
+   ``(values, offsets)`` buffers, dense payloads stack into ``[n, 1024]``
+   word matrices (runs through the batched interval fill);
+3. **per-class batch kernels** (kernels.py / native ``rb_batch_*``) — one
+   call per occupied class, then batched result-format selection.
+
+The facade (models/roaring.py), the CPU folds (parallel/aggregation.py)
+and the query kernels' CPU fallbacks route here above
+``config.min_containers`` / ``config.min_fold_rows``; the per-container
+walk stays below the cutoff and as the differential reference (fuzz
+family ``columnar-vs-percontainer``). Observability:
+``rb_tpu_columnar_batch_total{op,class}`` via
+``insights.columnar_counters()``.
+"""
+
+from .engine import (
+    and_cardinality_pair,
+    config,
+    disabled,
+    enabled_for,
+    enabled_for_fold,
+    fold,
+    intersects_pair,
+    or_fold_words,
+    pairwise,
+)
+from .keyplan import KeyPlan, key_plan
+from .partition import CLASS_NAMES, class_histogram, classify
+
+__all__ = [
+    "config",
+    "disabled",
+    "enabled_for",
+    "enabled_for_fold",
+    "pairwise",
+    "and_cardinality_pair",
+    "intersects_pair",
+    "fold",
+    "or_fold_words",
+    "key_plan",
+    "KeyPlan",
+    "classify",
+    "class_histogram",
+    "CLASS_NAMES",
+]

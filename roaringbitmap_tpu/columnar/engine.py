@@ -1,0 +1,648 @@
+"""Stage 3 of the columnar pairwise engine: per-class batch execution.
+
+``pairwise`` executes a whole bitmap-pair op — key plan, 9-class type
+partition, one batch kernel per occupied class, batched result-format
+selection — with NO per-container Python dispatch on the matched path
+(the ~1-2 µs/container interpreter floor BENCH_NOTES round-5 pins as "the
+region the reference's JIT'd per-key loops win by construction").
+``fold``/``or_fold_words`` apply the same machinery to the N-way CPU folds
+(the >=10x target's own denominator).
+
+Result formats select in batch: the run-unified and/andnot path applies
+the reference's full size rule (run iff 2+4·nruns smallest, so run-shaped
+results stay compressed); the word-matrix classes normalize to
+array<=4096<bitmap like ``best_container_of_words``. Either way results
+are value-identical to the per-container engine (``==`` compares values,
+not forms; ``run_optimize`` re-establishes RLE where a word-path result
+left it). Pass-through containers keep their form: transferred unclone'd
+under member-op semantics (``reuse_left``, the round-4 ior elision
+extended here to xor/andnot), cloned validation-free otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import observe as _observe
+from ..models.container import (
+    ARRAY_MAX_SIZE,
+    ArrayContainer,
+    BitmapContainer,
+    Container,
+    RunContainer,
+    _container_of_intervals,
+    _wrap_u16,
+)
+from ..models.roaring import RoaringBitmap
+from ..utils import bits
+from . import kernels
+from .keyplan import key_plan
+from .partition import (
+    ARRAY,
+    BITMAP,
+    CLASS_NAMES,
+    class_histogram,
+    classify,
+    expand_rows,
+    gather_intervals,
+    gather_runs,
+    gather_values,
+    scatter_containers,
+    stack_words,
+)
+
+
+class config:
+    """Columnar dispatch knobs.
+
+    ``min_containers`` — the small-operand cutoff: a pair routes columnar
+    only when BOTH operands hold at least this many containers (below it
+    the per-container walk's constant factor wins; the plan/partition
+    overhead is ~10 µs). ``max_containers`` — the large-count cap: at
+    many thousands of (necessarily tiny) containers the CSR gather's
+    per-piece concatenation overtakes the already-sub-2µs per-container
+    ops (the jmh identical/worstcase grids: 10k single-value containers,
+    measured 0.3-0.9x), so the per-container walk keeps those too.
+    ``min_fold_rows`` — row cutoff for the N-way CPU folds.
+    ``ROARINGBITMAP_TPU_NO_COLUMNAR=1`` disables routing entirely (the
+    per-container engine remains the differential reference)."""
+
+    enabled: bool = not os.environ.get("ROARINGBITMAP_TPU_NO_COLUMNAR")
+    min_containers: int = 16
+    max_containers: int = 4096
+    min_fold_rows: int = 64
+    # row budget for the chunked dense-class batches: bounds peak matrix
+    # memory at ~3 * 8 KiB * chunk_rows while keeping full vectorization
+    chunk_rows: int = 4096
+
+
+_COLUMNAR_TOTAL = _observe.counter(
+    _observe.COLUMNAR_BATCH_TOTAL,
+    "Columnar batched container-pairs by op and (array|bitmap|run)^2 class",
+    ("op", "class"),
+)
+
+
+# per-thread disable depth: disabled() must not flip process-global state
+# (two overlapping threads would strand routing off — the framework's
+# shared-mutable-state discipline), so the router consults a thread-local
+# counter; re-entrant by construction
+_TLS = threading.local()
+
+
+@contextmanager
+def disabled():
+    """Temporarily force the per-container engines ON THIS THREAD
+    (benchmark twins and differential tests); re-entrant."""
+    _TLS.depth = getattr(_TLS, "depth", 0) + 1
+    try:
+        yield
+    finally:
+        _TLS.depth -= 1
+
+
+def _routing_on() -> bool:
+    return config.enabled and not getattr(_TLS, "depth", 0)
+
+
+def _dense_hint(hlc) -> bool:
+    """Sampled type probe (<= 8 containers): does this operand hold run or
+    bitmap containers? Array-only pairs stay per-container — their scalar
+    ops already sit at the C-kernel floor (~2 µs), and no gather can beat
+    a floor it must first pay to assemble. Runs/bitmaps are where the
+    per-container engine spends 5-50 µs each, i.e. where batching pays."""
+    conts = hlc.containers
+    n = len(conts)
+    step = max(1, n // 8)
+    for i in range(0, n, step):
+        if type(conts[i]) is not ArrayContainer:
+            return True
+    return False
+
+
+def enabled_for(a_hlc, b_hlc) -> bool:
+    """Route this pair columnar? Cheap pre-plan gate: container counts in
+    [min_containers, max_containers] on BOTH sides plus a sampled
+    dense-shape hint on either side."""
+    if not _routing_on():
+        return False
+    na, nb = a_hlc.size, b_hlc.size
+    return (
+        na >= config.min_containers
+        and nb >= config.min_containers
+        and na <= config.max_containers
+        and nb <= config.max_containers
+        and (_dense_hint(a_hlc) or _dense_hint(b_hlc))
+    )
+
+
+def enabled_for_fold(n_rows: int) -> bool:
+    return _routing_on() and n_rows >= config.min_fold_rows
+
+
+def _record(op: str, codes_a: np.ndarray, codes_b: np.ndarray) -> None:
+    hist = class_histogram(codes_a, codes_b)
+    for ci in np.flatnonzero(hist).tolist():
+        _COLUMNAR_TOTAL.inc(int(hist[ci]), labels=(op, CLASS_NAMES[ci]))
+
+
+# ---------------------------------------------------------------------------
+# matched-pair class execution
+# ---------------------------------------------------------------------------
+
+
+def _fill_aa(
+    op: str, acs, bcs, idx: np.ndarray, results: List[Optional[Container]]
+) -> None:
+    """array x array: the CSR batch kernel, then batched format selection
+    (or/xor unions can overflow 4096 into bitmap)."""
+    if idx.size == 0:
+        return
+    avals, aoffs = gather_values(acs, idx)
+    bvals, boffs = gather_values(bcs, idx)
+    vals, starts, counts = kernels.batch_pairwise(avals, aoffs, bvals, boffs, op)
+    starts_l, counts_l = starts.tolist(), counts.tolist()
+    for j, i in enumerate(idx.tolist()):
+        n = counts_l[j]
+        if n == 0:
+            continue
+        s = starts_l[j]
+        chunk = vals[s : s + n]
+        if n <= ARRAY_MAX_SIZE:
+            # copy: the batch buffer is shared scratch; a view would pin it
+            results[i] = _wrap_u16(chunk.copy())
+        else:
+            results[i] = BitmapContainer(bits.words_from_values(chunk), n)
+
+
+def _gather_mask(probe_cs, dense_cs, idx: np.ndarray, dense_is_run: bool):
+    """Shared probe machinery of the array x dense classes: one batched
+    membership pass answers every probe value of every pair — a word-test
+    gather against stacked bitmap rows, or the banded searchsorted against
+    run payloads (NO word expansion either way)."""
+    vals, offs = gather_values(probe_cs, idx)
+    if dense_is_run:
+        starts, lengths, roffs = gather_runs(dense_cs, idx)
+        return vals, offs, kernels.run_member_mask(vals, offs, starts, lengths, roffs)
+    rows_mat = stack_words(dense_cs, idx)
+    row_ids = np.repeat(np.arange(idx.size, dtype=np.int64), np.diff(offs))
+    return vals, offs, kernels.member_mask(rows_mat, row_ids, vals)
+
+
+def _fill_gather(
+    op: str, probe_cs, dense_cs, idx: np.ndarray, results, dense_is_run: bool
+) -> None:
+    """array x dense (and/andnot): membership gather; results stay
+    arrays by construction."""
+    if idx.size == 0:
+        return
+    vals, offs, mask = _gather_mask(probe_cs, dense_cs, idx, dense_is_run)
+    if op == "andnot":
+        mask = ~mask
+    row_ids = np.repeat(np.arange(idx.size, dtype=np.int64), np.diff(offs))
+    kept = vals[mask]
+    counts = np.bincount(row_ids[mask], minlength=idx.size)
+    starts = np.concatenate(([0], np.cumsum(counts)))
+    starts_l, counts_l = starts.tolist(), counts.tolist()
+    for j, i in enumerate(idx.tolist()):
+        n = counts_l[j]
+        if n:
+            s = starts_l[j]
+            results[i] = _wrap_u16(kept[s : s + n].copy())
+
+
+def _fill_runs_native(op: str, acs, bcs, idx: np.ndarray, results) -> None:
+    """All bitmap-free classes (aa/ar/ra/rr) of and/andnot through ONE
+    native call: payloads unify as CSR run lists (arrays are length-0
+    runs), ``rb_batch_run_pairwise`` two-pointer-merges every pair in C
+    emitting result intervals, and the whole batch's container formats
+    are selected by the reference's size rule (run iff 2+4·nruns smallest
+    — run-shaped results stay compressed; small ones expand to arrays in
+    one vectorized pass)."""
+    if idx.size == 0:
+        return
+    as_, al, acnt = gather_intervals(acs, idx)
+    bs_, bl, bcnt = gather_intervals(bcs, idx)
+    out_s, out_l, starts, counts, cards = kernels.batch_run_pairwise(
+        as_, al, acnt, bs_, bl, bcnt, op
+    )
+    starts_l, counts_l, cards_l = starts.tolist(), counts.tolist(), cards.tolist()
+    arr_js: List[int] = []  # pairs whose result becomes an array container
+    for j, i in enumerate(idx.tolist()):
+        card = cards_l[j]
+        if card == 0:
+            continue
+        n = counts_l[j]
+        run_size = 2 + 4 * n
+        other = 8192 if card > ARRAY_MAX_SIZE else 2 + 2 * card
+        if run_size <= other:
+            s = starts_l[j]
+            rc = RunContainer(out_s[s : s + n].copy(), out_l[s : s + n].copy())
+            rc._card = card
+            results[i] = rc
+        elif card <= ARRAY_MAX_SIZE:
+            arr_js.append(j)
+        else:
+            s = starts_l[j]
+            s64 = out_s[s : s + n].astype(np.int64)
+            e64 = s64 + out_l[s : s + n].astype(np.int64) + 1
+            results[i] = BitmapContainer(bits.words_from_intervals(s64, e64), card)
+    if arr_js:
+        # one vectorized interval -> value expansion for every array result
+        seg_s = np.concatenate(
+            [out_s[starts_l[j] : starts_l[j] + counts_l[j]] for j in arr_js]
+        ).astype(np.int64)
+        seg_l = np.concatenate(
+            [out_l[starts_l[j] : starts_l[j] + counts_l[j]] for j in arr_js]
+        ).astype(np.int64)
+        lens = seg_l + 1
+        total = int(lens.sum())
+        prefix = np.concatenate(([0], np.cumsum(lens)[:-1]))
+        vals = (
+            np.repeat(seg_s - prefix, lens) + np.arange(total, dtype=np.int64)
+        ).astype(np.uint16)
+        pos = 0
+        idx_l = idx.tolist()
+        for j in arr_js:
+            card = cards_l[j]
+            results[idx_l[j]] = _wrap_u16(vals[pos : pos + card].copy())
+            pos += card
+
+
+def _fill_interval(op: str, acs, bcs, idx: np.ndarray, results) -> None:
+    """run x run (plus andnot's run-minus-array), numpy tier: the banded
+    interval-algebra batch — no word expansion, one global sort for the
+    whole bucket; each pair's result intervals pick their container by the
+    reference's size rule (``_container_of_intervals``), so run-shaped
+    results stay runs."""
+    if idx.size == 0:
+        return
+    as_, al, acnt = gather_intervals(acs, idx)
+    bs_, bl, bcnt = gather_intervals(bcs, idx)
+    out_s, out_e, starts, counts = kernels.interval_batch(
+        as_, al, acnt, bs_, bl, bcnt, op
+    )
+    starts_l, counts_l = starts.tolist(), counts.tolist()
+    for j, i in enumerate(idx.tolist()):
+        n = counts_l[j]
+        if n == 0:
+            continue
+        s = starts_l[j]
+        results[i] = _container_of_intervals(out_s[s : s + n], out_e[s : s + n])
+
+
+def _build_words_results(
+    mat: np.ndarray, idx_chunk: List[int], results
+) -> None:
+    """Batched format selection over a result word matrix: one popcount
+    pass decides array-vs-bitmap for the whole chunk."""
+    cards = kernels.popcount_rows(mat).tolist()
+    for j, i in enumerate(idx_chunk):
+        card = cards[j]
+        if card == 0:
+            continue
+        if card <= ARRAY_MAX_SIZE:
+            results[i] = _wrap_u16(bits.values_from_words(mat[j]))
+        else:
+            results[i] = BitmapContainer(mat[j].copy(), card)
+
+
+def _fill_dense(
+    op: str, acs, bcs, idx: np.ndarray, results
+) -> None:
+    """Word-matrix classes, chunked to bound peak memory:
+
+    * and / andnot — both sides dense (runs expanded through the batched
+      interval fill): expand, one ``&`` / ``& ~``, batched popcount+select.
+    * and/andnot with an array RIGHT operand never lands here (gather /
+      scatter-clear paths); or/xor land here for every non-aa pair — the
+      left side expands, the right side combines via the same batched
+      scatter/fill/reduceat machinery.
+    """
+    if idx.size == 0:
+        return
+    step = max(1, config.chunk_rows)
+    for lo in range(0, idx.size, step):
+        chunk = idx[lo : lo + step]
+        chunk_l = chunk.tolist()
+        if op in ("or", "xor"):
+            mat = expand_rows(acs, chunk)
+            rows = np.arange(chunk.size, dtype=np.int64)
+            scatter_containers(mat, rows, [bcs[i] for i in chunk_l], op=op)
+        else:
+            mat = expand_rows(acs, chunk)
+            right = expand_rows(bcs, chunk)
+            if op == "and":
+                mat &= right
+            else:  # andnot
+                mat &= ~right
+        _build_words_results(mat, chunk_l, results)
+
+
+def _fill_clear(acs, bcs, idx: np.ndarray, results) -> None:
+    """andnot with a dense left and array right: expand the left, scatter-
+    CLEAR the right's values out of it in one batched pass."""
+    if idx.size == 0:
+        return
+    step = max(1, config.chunk_rows)
+    for lo in range(0, idx.size, step):
+        chunk = idx[lo : lo + step]
+        mat = expand_rows(acs, chunk)
+        bvals, boffs = gather_values(bcs, chunk)
+        kernels.scatter_values_rows(
+            np.arange(chunk.size, dtype=np.int64), boffs, bvals, mat, op="clear"
+        )
+        _build_words_results(mat, chunk.tolist(), results)
+
+
+def _matched_results(
+    op: str, acs: Sequence[Container], bcs: Sequence[Container]
+) -> List[Optional[Container]]:
+    n = len(acs)
+    results: List[Optional[Container]] = [None] * n
+    if n == 0:
+        return results
+    codes_a = classify(acs)
+    codes_b = classify(bcs)
+    _record(op, codes_a, codes_b)
+    a_arr = codes_a == ARRAY
+    b_arr = codes_b == ARRAY
+    if op in ("and", "andnot"):
+        a_bm = codes_a == BITMAP
+        b_bm = codes_b == BITMAP
+        if kernels.has_native():
+            # one run-unified native call serves every bitmap-free class
+            _fill_runs_native(
+                op, acs, bcs, np.flatnonzero(~a_bm & ~b_bm), results
+            )
+        else:
+            a_run = ~a_arr & ~a_bm
+            b_run = ~b_arr & ~b_bm
+            _fill_aa(op, acs, bcs, np.flatnonzero(a_arr & b_arr), results)
+            # banded run probes for the array x run directions
+            _fill_gather(op, acs, bcs, np.flatnonzero(a_arr & b_run), results, True)
+            if op == "and":
+                _fill_gather(op, bcs, acs, np.flatnonzero(b_arr & a_run), results, True)
+                iv = np.flatnonzero(a_run & b_run)  # rr
+            else:
+                iv = np.flatnonzero(a_run & ~b_bm)  # rr + ra
+            _fill_interval(op, acs, bcs, iv, results)
+        # ab: array probe vs stacked bitmap words
+        _fill_gather(op, acs, bcs, np.flatnonzero(a_arr & b_bm), results, False)
+        if op == "and":
+            _fill_gather(op, bcs, acs, np.flatnonzero(b_arr & a_bm), results, False)
+        else:
+            # ba under andnot: expand a, scatter-CLEAR b's values
+            _fill_clear(acs, bcs, np.flatnonzero(a_bm & b_arr), results)
+        # bb / br / rb: at least one bitmap, no array side -> word matrices
+        _fill_dense(
+            op, acs, bcs,
+            np.flatnonzero((a_bm & ~b_arr) | (~a_arr & b_bm)), results,
+        )
+    else:  # or / xor
+        _fill_aa(op, acs, bcs, np.flatnonzero(a_arr & b_arr), results)
+        _fill_dense(op, acs, bcs, np.flatnonzero(~(a_arr & b_arr)), results)
+    return results
+
+
+# ---------------------------------------------------------------------------
+# public pairwise entry points
+# ---------------------------------------------------------------------------
+
+
+def pairwise(
+    op: str, x1: RoaringBitmap, x2: RoaringBitmap, reuse_left: bool = False
+) -> RoaringBitmap:
+    """Whole-pair ``x1 OP x2`` through the batched engine. ``reuse_left``
+    transfers x1's pass-through containers unclone'd — ONLY for the
+    in-place facades (ior/ixor/iandnot), which discard x1's old index:
+    the member-op semantics win, now uniform across all four ops."""
+    a, b = x1.high_low_container, x2.high_low_container
+    plan = key_plan(a.keys, b.keys, op)
+    acont, bcont = a.containers, b.containers
+    acs = [acont[i] for i in plan.ia.tolist()]
+    bcs = [bcont[i] for i in plan.ib.tolist()]
+    results = _matched_results(op, acs, bcs)
+    out = RoaringBitmap()
+    okeys, ocont = out.high_low_container.keys, out.high_low_container.containers
+    if op == "and":
+        for k, c in zip(plan.matched_keys.tolist(), results):
+            if c is not None:
+                okeys.append(k)
+                ocont.append(c)
+        return out
+    a_only_l = plan.a_only.tolist()
+    b_only_l = plan.b_only.tolist()
+    keys_all = np.concatenate(
+        [plan.matched_keys, plan.akeys[plan.a_only], plan.bkeys[plan.b_only]]
+    )
+    keys_l = keys_all.tolist()
+    n_m = len(results)
+    n_a = len(a_only_l)
+    for idx in np.argsort(keys_all, kind="stable").tolist():
+        if idx < n_m:
+            c = results[idx]
+            if c is None:
+                continue
+        elif idx < n_m + n_a:
+            ca = acont[a_only_l[idx - n_m]]
+            c = ca if reuse_left else ca.clone()
+        else:
+            c = bcont[b_only_l[idx - n_m - n_a]].clone()
+        okeys.append(keys_l[idx])
+        ocont.append(c)
+    return out
+
+
+def and_cardinality_pair(x1: RoaringBitmap, x2: RoaringBitmap) -> int:
+    """``|x1 & x2|`` with NO materialization anywhere: the aa class runs
+    the count-only batch kernel, gathers count their masks, dense pairs
+    stop at the batched popcount."""
+    a, b = x1.high_low_container, x2.high_low_container
+    plan = key_plan(a.keys, b.keys, "and")
+    acont, bcont = a.containers, b.containers
+    acs = [acont[i] for i in plan.ia.tolist()]
+    bcs = [bcont[i] for i in plan.ib.tolist()]
+    total = 0
+    for count in _cardinality_batches(acs, bcs):
+        total += count
+    return total
+
+
+def intersects_pair(x1: RoaringBitmap, x2: RoaringBitmap) -> bool:
+    """Batched intersects: same buckets as and-cardinality, short-circuits
+    between class batches."""
+    a, b = x1.high_low_container, x2.high_low_container
+    plan = key_plan(a.keys, b.keys, "and")
+    acont, bcont = a.containers, b.containers
+    acs = [acont[i] for i in plan.ia.tolist()]
+    bcs = [bcont[i] for i in plan.ib.tolist()]
+    for count in _cardinality_batches(acs, bcs):
+        if count:
+            return True
+    return False
+
+
+def _cardinality_batches(acs, bcs):
+    """Yield per-class-bucket AND cardinalities (sum = and_cardinality)."""
+    if not acs:
+        return
+    codes_a = classify(acs)
+    codes_b = classify(bcs)
+    _record("and_card", codes_a, codes_b)
+    a_arr = codes_a == ARRAY
+    b_arr = codes_b == ARRAY
+    a_bm = codes_a == BITMAP
+    b_bm = codes_b == BITMAP
+    nonbm = np.flatnonzero(~a_bm & ~b_bm)
+    if nonbm.size and kernels.has_native():
+        as_, al, acnt = gather_intervals(acs, nonbm)
+        bs_, bl, bcnt = gather_intervals(bcs, nonbm)
+        yield int(
+            kernels.batch_run_pairwise(
+                as_, al, acnt, bs_, bl, bcnt, "and", cards_only=True
+            ).sum()
+        )
+    elif nonbm.size:
+        a_run = ~a_arr & ~a_bm
+        b_run = ~b_arr & ~b_bm
+        aa = np.flatnonzero(a_arr & b_arr)
+        if aa.size:
+            avals, aoffs = gather_values(acs, aa)
+            bvals, boffs = gather_values(bcs, aa)
+            yield int(
+                kernels.batch_and_cardinality(avals, aoffs, bvals, boffs).sum()
+            )
+        iv = np.flatnonzero(a_run & b_run)  # rr
+        if iv.size:
+            as_, al, acnt = gather_intervals(acs, iv)
+            bs_, bl, bcnt = gather_intervals(bcs, iv)
+            yield int(
+                kernels.interval_batch(
+                    as_, al, acnt, bs_, bl, bcnt, "and", cards_only=True
+                ).sum()
+            )
+        for idx, probe_cs, dense_cs, dense_is_run in (
+            (np.flatnonzero(a_arr & b_run), acs, bcs, True),
+            (np.flatnonzero(b_arr & a_run), bcs, acs, True),
+        ):
+            if idx.size:
+                _v, _o, mask = _gather_mask(probe_cs, dense_cs, idx, dense_is_run)
+                yield int(mask.sum())
+    for idx, probe_cs, dense_cs in (
+        (np.flatnonzero(a_arr & b_bm), acs, bcs),
+        (np.flatnonzero(b_arr & a_bm), bcs, acs),
+    ):
+        if idx.size:
+            _v, _o, mask = _gather_mask(probe_cs, dense_cs, idx, False)
+            yield int(mask.sum())
+    ww = np.flatnonzero((a_bm & ~b_arr) | (~a_arr & b_bm))
+    if ww.size:
+        step = max(1, config.chunk_rows)
+        total = 0
+        for lo in range(0, ww.size, step):
+            chunk = ww[lo : lo + step]
+            mat = expand_rows(acs, chunk)
+            mat &= expand_rows(bcs, chunk)
+            total += int(kernels.popcount_rows(mat).sum())
+        yield total
+
+
+# ---------------------------------------------------------------------------
+# N-way CPU folds
+# ---------------------------------------------------------------------------
+
+
+def fold(groups: Dict[int, List[Container]], op: str) -> RoaringBitmap:
+    """Key-grouped N-way fold without per-container dispatch: all array
+    payloads scatter in one batched call, all runs expand through one
+    batched interval fill, bitmap rows reduce with one ``reduceat`` — then
+    one batched popcount selects every result format. Single-container
+    groups pass through as type-preserving clones (exactly the
+    per-container engine's behavior)."""
+    keys = sorted(groups)
+    singles: Dict[int, Container] = {}
+    multi_keys: List[int] = []
+    multi_cs: List[List[Container]] = []
+    n_rows = 0
+    for k in keys:
+        cs = groups[k]
+        if len(cs) == 1:
+            singles[k] = cs[0]
+        else:
+            multi_keys.append(k)
+            multi_cs.append(cs)
+            n_rows += len(cs)
+    if n_rows:
+        _COLUMNAR_TOTAL.inc(n_rows, labels=(f"fold_{op}", "rows"))
+    out = RoaringBitmap()
+    hlc = out.high_low_container
+    results: Dict[int, Optional[Container]] = {}
+    if multi_keys:
+        if op in ("or", "xor"):
+            mat = np.zeros(
+                (len(multi_keys), bits.WORDS_PER_CONTAINER), dtype=np.uint64
+            )
+            row_ids = np.repeat(
+                np.arange(len(multi_keys), dtype=np.int64),
+                np.fromiter((len(cs) for cs in multi_cs), np.int64, len(multi_cs)),
+            )
+            flat = [c for cs in multi_cs for c in cs]
+            scatter_containers(mat, row_ids, flat, op=op)
+        else:  # and: expand + reduceat, chunked by row budget
+            mats: List[np.ndarray] = []
+            step = max(1, config.chunk_rows)
+            gi = 0
+            while gi < len(multi_keys):
+                ge, rows = gi, 0
+                while ge < len(multi_keys) and (
+                    rows == 0 or rows + len(multi_cs[ge]) <= step
+                ):
+                    rows += len(multi_cs[ge])
+                    ge += 1
+                chunk_cs = [c for cs in multi_cs[gi:ge] for c in cs]
+                rows_mat = expand_rows(
+                    chunk_cs, np.arange(len(chunk_cs), dtype=np.int64)
+                )
+                starts = np.concatenate(
+                    ([0], np.cumsum([len(cs) for cs in multi_cs[gi:ge]]))
+                )[:-1]
+                mats.append(np.bitwise_and.reduceat(rows_mat, starts, axis=0))
+                gi = ge
+            mat = np.concatenate(mats, axis=0)
+        cards = kernels.popcount_rows(mat).tolist()
+        for j, k in enumerate(multi_keys):
+            card = cards[j]
+            if card == 0:
+                results[k] = None
+            elif card <= ARRAY_MAX_SIZE:
+                results[k] = _wrap_u16(bits.values_from_words(mat[j]))
+            else:
+                results[k] = BitmapContainer(mat[j].copy(), card)
+    for k in keys:
+        c = singles[k].clone() if k in singles else results[k]
+        if c is not None and c.cardinality:
+            hlc.append(k, c)
+    return out
+
+
+def or_fold_words(groups: Dict[int, List[Container]]) -> Dict[int, np.ndarray]:
+    """Per-key OR of each group's containers as word rows — the batched
+    core the query kernels' CPU fallbacks (n-way ANDNOT's subtrahend
+    union) share with ``fold``. Returned rows are views into one matrix;
+    callers consume them immediately."""
+    keys = sorted(groups)
+    if not keys:
+        return {}
+    counts = np.fromiter((len(groups[k]) for k in keys), np.int64, len(keys))
+    _COLUMNAR_TOTAL.inc(int(counts.sum()), labels=("fold_or", "rows"))
+    mat = np.zeros((len(keys), bits.WORDS_PER_CONTAINER), dtype=np.uint64)
+    row_ids = np.repeat(np.arange(len(keys), dtype=np.int64), counts)
+    flat = [c for k in keys for c in groups[k]]
+    scatter_containers(mat, row_ids, flat, op="or")
+    return {k: mat[g] for g, k in enumerate(keys)}

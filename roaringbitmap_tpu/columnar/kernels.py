@@ -1,0 +1,315 @@
+"""Batched per-class kernels for the columnar pairwise engine (ISSUE 5).
+
+Every primitive here executes a WHOLE batch of container payloads in one
+call — the native tier loops compiled two-pointer merges over CSR offset
+arrays (native/kernels.cpp ``rb_batch_*``), and the numpy tier reaches the
+same results fully vectorized via the *banding* trick: pair ``j``'s uint16
+payload is lifted into its own disjoint int64 band ``j * 2^16 + value``,
+after which ONE global sort / searchsorted over the concatenation performs
+every pair's merge at once (bands never interleave, so a global sort IS a
+per-pair merge). Semantics of the two tiers are identical; the numpy tier
+is the differential oracle and the no-toolchain fallback
+(``ROARINGBITMAP_TPU_NO_NATIVE=1``).
+
+Ops are the four pairwise set operations on sorted unique uint16 arrays;
+word-matrix primitives (scatter / interval fill / per-row popcount) serve
+the dense classes and the N-way folds.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from ..utils import bits
+
+_WORDS = bits.WORDS_PER_CONTAINER  # 1024
+
+
+def _native():
+    """The native module when a compiled tier is live, else None."""
+    from .. import native
+
+    return native if native.available() else None
+
+
+# ---------------------------------------------------------------------------
+# sorted-u16 CSR batch algebra
+# ---------------------------------------------------------------------------
+
+
+def _banded(vals: np.ndarray, offs: np.ndarray) -> np.ndarray:
+    """Lift pair j's values into band j: int64 ``(j << 16) | value``."""
+    lens = np.diff(offs)
+    band = np.repeat(np.arange(lens.size, dtype=np.int64), lens) << 16
+    return vals.astype(np.int64) + band
+
+
+def _batch_pairwise_numpy(
+    avals: np.ndarray, aoffs: np.ndarray, bvals: np.ndarray, boffs: np.ndarray, op: str
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    n = aoffs.size - 1
+    ag = _banded(avals, aoffs)
+    bg = _banded(bvals, boffs)
+    if op in ("and", "andnot"):
+        if bg.size:
+            pos = np.searchsorted(bg, ag)
+            posc = np.minimum(pos, bg.size - 1)
+            member = (pos < bg.size) & (bg[posc] == ag)
+        else:
+            member = np.zeros(ag.size, dtype=bool)
+        kept = ag[member if op == "and" else ~member]
+    elif op == "or":
+        m = np.sort(np.concatenate([ag, bg]))
+        keep = np.ones(m.size, dtype=bool)
+        keep[1:] = m[1:] != m[:-1]
+        kept = m[keep]
+    else:  # xor: each side is unique, so a value appears at most twice
+        m = np.sort(np.concatenate([ag, bg]))
+        solo = np.ones(m.size, dtype=bool)
+        solo[1:] &= m[1:] != m[:-1]
+        solo[:-1] &= m[:-1] != m[1:]
+        kept = m[solo]
+    counts = np.bincount(kept >> 16, minlength=n)[:n]
+    offs = np.concatenate(([0], np.cumsum(counts)))
+    return (kept & 0xFFFF).astype(np.uint16), offs[:-1], counts
+
+
+def batch_pairwise(
+    avals: np.ndarray, aoffs: np.ndarray, bvals: np.ndarray, boffs: np.ndarray, op: str
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """All pairs' ``a[j] OP b[j]`` in one call.
+
+    Returns ``(values, starts, counts)``: pair j's result values are
+    ``values[starts[j] : starts[j] + counts[j]]`` (the values buffer may be
+    an oversized scratch on the native tier — callers copy their slice out
+    before holding it)."""
+    n = aoffs.size - 1
+    if n == 0:
+        z = np.empty(0, dtype=np.int64)
+        return np.empty(0, dtype=np.uint16), z, z
+    nat = _native()
+    if nat is None:
+        return _batch_pairwise_numpy(avals, aoffs, bvals, boffs, op)
+    alens = np.diff(aoffs)
+    blens = np.diff(boffs)
+    if op == "and":
+        bounds = np.minimum(alens, blens)
+    elif op == "andnot":
+        bounds = alens
+    else:
+        bounds = alens + blens
+    starts = np.concatenate(([0], np.cumsum(bounds)))
+    out, counts = nat.batch_pairwise_u16(
+        avals, aoffs, bvals, boffs, op, starts[:-1], int(starts[-1])
+    )
+    return out, starts[:-1], counts
+
+
+def has_native() -> bool:
+    return _native() is not None
+
+
+def batch_run_pairwise(
+    as_: np.ndarray, al: np.ndarray, acnt: np.ndarray,
+    bs_: np.ndarray, bl: np.ndarray, bcnt: np.ndarray,
+    op: str, cards_only: bool = False,
+):
+    """Run-unified batch AND/ANDNOT over CSR run payloads (native tier
+    only — callers fall back to the per-class numpy buckets otherwise).
+    Returns ``(out_starts, out_lengths, starts, interval_counts, cards)``
+    — result INTERVALS, pair j's at ``starts[j] : starts[j] +
+    interval_counts[j]`` — or just per-pair cardinalities when
+    ``cards_only``."""
+    nat = _native()
+    aoffs = np.concatenate(([0], np.cumsum(acnt)))
+    boffs = np.concatenate(([0], np.cumsum(bcnt)))
+    if cards_only:
+        _s, _l, _counts, cards = nat.batch_run_pairwise(
+            as_, al, aoffs, bs_, bl, boffs, op, None, 0
+        )
+        return cards
+    bounds = acnt + bcnt  # an output interval ends at an input endpoint
+    starts = np.concatenate(([0], np.cumsum(bounds)))
+    out_s, out_l, counts, cards = nat.batch_run_pairwise(
+        as_, al, aoffs, bs_, bl, boffs, op, starts[:-1], int(starts[-1])
+    )
+    return out_s, out_l, starts[:-1], counts, cards
+
+
+def batch_and_cardinality(
+    avals: np.ndarray, aoffs: np.ndarray, bvals: np.ndarray, boffs: np.ndarray
+) -> np.ndarray:
+    """Per-pair ``|a[j] & b[j]|`` without materialization."""
+    n = aoffs.size - 1
+    if n == 0:
+        return np.empty(0, dtype=np.int64)
+    nat = _native()
+    if nat is not None:
+        return nat.batch_intersect_card_u16(avals, aoffs, bvals, boffs)
+    ag = _banded(avals, aoffs)
+    bg = _banded(bvals, boffs)
+    if not bg.size:
+        return np.zeros(n, dtype=np.int64)
+    pos = np.searchsorted(bg, ag)
+    posc = np.minimum(pos, bg.size - 1)
+    member = (pos < bg.size) & (bg[posc] == ag)
+    return np.bincount((ag >> 16)[member], minlength=n)[:n].astype(np.int64)
+
+
+# ---------------------------------------------------------------------------
+# word-matrix primitives
+# ---------------------------------------------------------------------------
+
+
+def popcount_rows(mat: np.ndarray) -> np.ndarray:
+    """Per-row popcount of an [n, 1024] uint64 matrix (batched result
+    cardinalities — ONE call for the whole batch's format selection)."""
+    if mat.shape[0] == 0:
+        return np.empty(0, dtype=np.int64)
+    nat = _native()
+    if nat is not None and mat.flags.c_contiguous:
+        return nat.popcount_rows(mat)
+    return bits.popcount64(mat).sum(axis=1).astype(np.int64)
+
+
+def scatter_values_rows(
+    row_ids: np.ndarray, offsets: np.ndarray, vals: np.ndarray,
+    out64: np.ndarray, op: str = "or",
+) -> None:
+    """Scatter concatenated array-container values into word rows with
+    or/xor/clear combine; ``row_ids`` may repeat (fold accumulators)."""
+    if row_ids.size == 0:
+        return
+    nat = _native()
+    if nat is not None:
+        nat.scatter_values_rows(row_ids, offsets, vals, out64, op)
+        return
+    lens = np.diff(offsets)
+    rows = np.repeat(np.asarray(row_ids, dtype=np.int64), lens)
+    v = vals.astype(np.int64)
+    flat = rows * _WORDS + (v >> 6)
+    bit = np.uint64(1) << (v & 63).astype(np.uint64)
+    flat_out = out64.reshape(-1)
+    if op == "or":
+        np.bitwise_or.at(flat_out, flat, bit)
+    elif op == "xor":
+        np.bitwise_xor.at(flat_out, flat, bit)
+    else:  # clear (andnot)
+        np.bitwise_and.at(flat_out, flat, ~bit)
+
+
+def fill_intervals_rows(
+    row_ids: np.ndarray, run_offs: np.ndarray, starts: np.ndarray,
+    ends: np.ndarray, out64: np.ndarray, op: str = "or",
+) -> None:
+    """Expand many run containers' half-open [start, end) intervals into
+    word rows in one call (``rb_fill_intervals_rows``); numpy tier loops
+    per run with the shared range fills (correctness fallback)."""
+    if row_ids.size == 0:
+        return
+    nat = _native()
+    if nat is not None:
+        nat.fill_intervals_rows(row_ids, run_offs, starts, ends, out64, op)
+        return
+    fill = bits.set_bitmap_range if op == "or" else bits.flip_bitmap_range
+    for j in range(row_ids.size):
+        row = out64[int(row_ids[j])]
+        for i in range(int(run_offs[j]), int(run_offs[j + 1])):
+            fill(row, int(starts[i]), min(int(ends[i]), 1 << 16))
+
+
+def run_member_mask(
+    vals: np.ndarray,
+    val_offs: np.ndarray,
+    starts: np.ndarray,
+    lengths: np.ndarray,
+    run_offs: np.ndarray,
+) -> np.ndarray:
+    """Batched run membership WITHOUT word expansion: one banded
+    right-searchsorted answers every probe of every array x run pair —
+    the whole-batch form of ``_run_contains_many``.
+
+    Probes and runs lift into band ``j << 17``; the gap above 2^16 makes
+    any cross-band distance exceed the maximum run length, so a probe can
+    never false-positive against the previous pair's last run."""
+    n = val_offs.size - 1
+    if vals.size == 0:
+        return np.zeros(0, dtype=bool)
+    band_v = np.repeat(np.arange(n, dtype=np.int64) << 17, np.diff(val_offs))
+    vg = vals.astype(np.int64) + band_v
+    band_r = np.repeat(np.arange(n, dtype=np.int64) << 17, np.diff(run_offs))
+    sg = starts.astype(np.int64) + band_r
+    if sg.size == 0:
+        return np.zeros(vals.size, dtype=bool)
+    idx = np.searchsorted(sg, vg, side="right") - 1
+    idxc = np.maximum(idx, 0)
+    return (idx >= 0) & (vg - sg[idxc] <= lengths.astype(np.int64)[idxc])
+
+
+def member_mask(
+    words_rows: np.ndarray, row_ids: np.ndarray, vals: np.ndarray
+) -> np.ndarray:
+    """Vectorized word-test gather: is ``vals[i]`` set in row
+    ``row_ids[i]`` of the stacked word matrix? (the array x bitmap class's
+    whole-batch membership probe)."""
+    v = vals.astype(np.int64)
+    return (
+        (words_rows[row_ids, v >> 6] >> (v & 63).astype(np.uint64)) & np.uint64(1)
+    ).astype(bool)
+
+
+def interval_batch(
+    as_: np.ndarray, al: np.ndarray, acnt: np.ndarray,
+    bs_: np.ndarray, bl: np.ndarray, bcnt: np.ndarray,
+    op: str, cards_only: bool = False,
+):
+    """Banded interval algebra for a whole bucket of (array|run) x
+    (array|run) pairs: ONE global sort + four searchsorteds evaluate every
+    pair's boolean combination (container.py ``_interval_op`` lifted to a
+    batch — the telescoping trick: for a segment in band j, every earlier
+    band's starts and ends both precede it, so the global
+    #starts>#ends test sees only band j's open intervals).
+
+    Inputs are CSR run payloads (arrays enter as length-0 runs). Returns
+    the result INTERVALS — ``(out_starts, out_ends, starts, counts)`` with
+    band-local half-open bounds, pair j's intervals at
+    ``starts[j] : starts[j] + counts[j]`` — so run-shaped results never
+    expand to values; or just per-pair cardinalities when ``cards_only``."""
+    n = acnt.size
+    band_a = np.repeat(np.arange(n, dtype=np.int64) << 17, acnt)
+    band_b = np.repeat(np.arange(n, dtype=np.int64) << 17, bcnt)
+    ga_s = as_.astype(np.int64) + band_a
+    ga_e = ga_s + al.astype(np.int64) + 1
+    gb_s = bs_.astype(np.int64) + band_b
+    gb_e = gb_s + bl.astype(np.int64) + 1
+    pts = np.unique(np.concatenate([ga_s, ga_e, gb_s, gb_e]))
+    seg = pts[:-1]
+    in_a = np.searchsorted(ga_s, seg, side="right") > np.searchsorted(
+        ga_e, seg, side="right"
+    )
+    in_b = np.searchsorted(gb_s, seg, side="right") > np.searchsorted(
+        gb_e, seg, side="right"
+    )
+    if op == "and":
+        keep = in_a & in_b
+    elif op == "andnot":
+        keep = in_a & ~in_b
+    elif op == "or":
+        keep = in_a | in_b
+    else:  # xor
+        keep = in_a ^ in_b
+    change = np.diff(keep.astype(np.int8), prepend=np.int8(0), append=np.int8(0))
+    out_s = pts[change == 1]
+    out_e = pts[np.nonzero(change == -1)[0]]
+    if cards_only:
+        return np.bincount(
+            out_s >> 17, weights=out_e - out_s, minlength=n
+        )[:n].astype(np.int64)
+    counts = np.bincount(out_s >> 17, minlength=n)[:n]
+    starts = np.concatenate(([0], np.cumsum(counts)))[:-1]
+    # strip the band: local values fit 17 bits (ends may be exactly 2^16)
+    return out_s & 0x1FFFF, out_e & 0x1FFFF, starts, counts
+
+

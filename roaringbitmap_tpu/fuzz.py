@@ -300,6 +300,78 @@ def verify_pack_cache_invariance(
         cache.close()
 
 
+def verify_columnar_invariance(
+    name: str,
+    iterations: Optional[int] = None,
+    seed: Optional[int] = None,
+) -> None:
+    """The columnar pairwise engine differential (ISSUE 5): under
+    randomized op sequences — static and member-semantics (reuse_left)
+    pairwise ops, cardinality-only probes, and N-way CPU folds, over
+    shape-diverse operands including run-optimized and mapped (buffer)
+    ones — the batched engine's result must be value-identical to the
+    per-container engine's at every step. Both accumulators then advance
+    with their own engine's output, so a divergence compounds and cannot
+    cancel out."""
+    from . import columnar
+    from .models.immutable import ImmutableRoaringBitmap
+    from .models.roaring import RoaringBitmap as RB
+    from .parallel import store
+    from .parallel.aggregation import FastAggregation as FA
+
+    rng = np.random.default_rng(seed)
+    ops = ("and", "or", "xor", "andnot")
+    for _ in range(iterations or default_iterations()):
+        seed_bm = random_bitmap(rng)
+        acc_col, acc_ref = seed_bm.clone(), seed_bm.clone()
+        repro = [seed_bm]
+        try:
+            for _step in range(int(rng.integers(2, 6))):
+                b = random_bitmap(rng)
+                repro.append(b)
+                operand = (
+                    ImmutableRoaringBitmap(b.serialize())
+                    if rng.random() < 0.3
+                    else b
+                )
+                kind = int(rng.integers(0, 4))
+                if kind == 3:  # cardinality-only + intersects probes
+                    got_c = columnar.and_cardinality_pair(acc_col, operand)
+                    got_i = columnar.intersects_pair(acc_col, operand)
+                    with columnar.disabled():
+                        want_c = RB.and_cardinality(acc_ref, operand)
+                        want_i = RB.intersects(acc_ref, operand)
+                    if got_c != want_c or got_i != want_i:
+                        raise InvarianceFailure(
+                            name, repro, detail=f"card {got_c}!={want_c}"
+                        )
+                    continue
+                op = ops[int(rng.integers(0, 4))]
+                # kind 1 = member-op semantics: acc's pass-throughs transfer
+                got = columnar.pairwise(op, acc_col, operand, reuse_left=kind == 1)
+                with columnar.disabled():
+                    want = {
+                        "and": RB.and_, "or": RB.or_,
+                        "xor": RB.xor, "andnot": RB.andnot,
+                    }[op](acc_ref, operand)
+                if got != want:
+                    raise InvarianceFailure(name, repro, detail=f"op {op}")
+                acc_col, acc_ref = got, want
+                if rng.random() < 0.3:
+                    acc_col.run_optimize()
+                    acc_ref.run_optimize()
+            # N-way fold step: batched fold vs the naive oracle
+            if rng.random() < 0.5:
+                bms = [acc_ref] + [random_bitmap(rng) for _ in range(2)]
+                groups = store.group_by_key(bms)
+                if columnar.fold(groups, "or") != FA.naive_or(*bms):
+                    raise InvarianceFailure(name, repro, detail="fold or")
+        except InvarianceFailure:
+            raise
+        except Exception as e:  # engine crash is also a failure
+            raise InvarianceFailure(name, repro, detail=repr(e)) from e
+
+
 def random_expression(rng, leaves: List[RoaringBitmap], max_depth: int = 4):
     """Random query DAG over the given leaf bitmaps: every node kind
     (and/or/xor/n-ary andnot/not-over-explicit-universe/threshold), biased
@@ -641,6 +713,15 @@ def run_campaign(iterations: Optional[int] = None, verbose: bool = True) -> dict
             "pack-cache-delta-vs-full-repack", iterations=max(1, n // 8), seed=53
         ),
         actual=max(1, n // 8),
+    )
+    # ISSUE 5: columnar batched pairwise engine vs the per-container
+    # engine under randomized op sequences (incl. mapped + run operands)
+    _run(
+        "columnar-vs-percontainer",
+        lambda: verify_columnar_invariance(
+            "columnar-vs-percontainer", iterations=max(1, n // 4), seed=54
+        ),
+        actual=max(1, n // 4),
     )
     return results
 

@@ -121,6 +121,22 @@ def query_counters() -> dict:
     }
 
 
+def columnar_counters() -> dict:
+    """Columnar pairwise-engine observability (ISSUE 5): batched
+    container-pairs by ``op/class`` — the 9 ``(array|bitmap|run)²``
+    classes for pairwise ops plus ``fold_<op>/rows`` for the N-way CPU
+    folds — as a plain str->int dict (the query_counters() shape
+    convention). Backed by ``rb_tpu_columnar_batch_total``."""
+    from . import observe
+
+    m = observe.REGISTRY.get(observe.COLUMNAR_BATCH_TOTAL)
+    return {
+        "batch": {f"{lv[0]}/{lv[1]}": v for lv, v in m.series().items()}
+        if m
+        else {}
+    }
+
+
 def pack_cache_counters() -> dict:
     """Resident pack cache observability (ISSUE 4): per-kind hit/miss/
     delta-row/evicted-byte counters plus the resident-bytes gauge, as plain

@@ -39,6 +39,19 @@ _MAX32 = 1 << 32
 # rank_many / select_many) — one home in utils.order_stats
 from ..utils.order_stats import group_positions as _group_positions
 
+# columnar pairwise engine (ISSUE 5): bound lazily because the package
+# imports this module; one global probe per process, ~no per-call cost
+_COLUMNAR = None
+
+
+def _columnar():
+    global _COLUMNAR
+    if _COLUMNAR is None:
+        from .. import columnar
+
+        _COLUMNAR = columnar
+    return _COLUMNAR
+
 
 def _check_value(x: int) -> int:
     x = int(x)
@@ -391,12 +404,22 @@ class RoaringBitmap:
     def and_(x1: "RoaringBitmap", x2: "RoaringBitmap", *more: "RoaringBitmap") -> "RoaringBitmap":
         """RoaringBitmap.and (RoaringBitmap.java:377): intersect keys, drop empties.
 
-        With more than two operands this delegates to FastAggregation like the
-        reference's ``and(Iterator)`` facade overload (:831-844)."""
+        With more than two operands this delegates to FastAggregation like
+        the reference's ``and(Iterator)`` facade overload (:831-844). Above
+        the columnar cutoff the whole pair executes as one batched op
+        (columnar/, ISSUE 5); the per-container walk below stays the
+        small-operand fast path and the differential reference."""
         if more:
             from ..parallel.aggregation import FastAggregation
 
             return FastAggregation.and_(x1, x2, *more)
+        col = _columnar()
+        if col.enabled_for(x1.high_low_container, x2.high_low_container):
+            return col.pairwise("and", x1, x2)
+        return RoaringBitmap._and_percontainer(x1, x2)
+
+    @staticmethod
+    def _and_percontainer(x1: "RoaringBitmap", x2: "RoaringBitmap") -> "RoaringBitmap":
         out = RoaringBitmap()
         a, b = x1.high_low_container, x2.high_low_container
         akeys, acont, na = a.keys, a.containers, len(a.keys)
@@ -428,6 +451,9 @@ class RoaringBitmap:
             from ..parallel.aggregation import FastAggregation
 
             return FastAggregation.or_(x1, x2, *more)
+        col = _columnar()
+        if col.enabled_for(x1.high_low_container, x2.high_low_container):
+            return col.pairwise("or", x1, x2)
         return RoaringBitmap._merge_op(x1, x2, "or")
 
     @staticmethod
@@ -436,6 +462,9 @@ class RoaringBitmap:
             from ..parallel.aggregation import FastAggregation
 
             return FastAggregation.xor(x1, x2, *more)
+        col = _columnar()
+        if col.enabled_for(x1.high_low_container, x2.high_low_container):
+            return col.pairwise("xor", x1, x2)
         return RoaringBitmap._merge_op(x1, x2, "xor")
 
     @staticmethod
@@ -533,6 +562,9 @@ class RoaringBitmap:
         in-place iandnot, which discards x1's old index; the static path
         must keep cloning because andnot_range feeds it _restrict views
         that share containers with live bitmaps."""
+        col = _columnar()
+        if col.enabled_for(x1.high_low_container, x2.high_low_container):
+            return col.pairwise("andnot", x1, x2, reuse_left=_reuse_left)
         out = RoaringBitmap()
         a, b = x1.high_low_container, x2.high_low_container
         akeys, acont, na = a.keys, a.containers, len(a.keys)
@@ -556,19 +588,26 @@ class RoaringBitmap:
 
     def ior_not(self, other: "RoaringBitmap", range_end: int) -> "RoaringBitmap":
         """In-place orNot (the reference's member orNot(x2, rangeEnd)):
-        this |= (~other restricted to [0, range_end))."""
+        this |= (~other restricted to [0, range_end)). Member-op
+        semantics: self's old index is discarded, so its beyond-range
+        pass-through chunks transfer unclone'd (the same reuse_left
+        elision ior/ixor/iandnot already have)."""
         self.high_low_container = RoaringBitmap.or_not(
-            self, other, range_end
+            self, other, range_end, _reuse_left=True
         ).high_low_container
         return self
 
     @staticmethod
-    def or_not(x1: "RoaringBitmap", x2: "RoaringBitmap", range_end: int) -> "RoaringBitmap":
+    def or_not(
+        x1: "RoaringBitmap", x2: "RoaringBitmap", range_end: int,
+        *, _reuse_left: bool = False,
+    ) -> "RoaringBitmap":
         """x1 | (~x2 ∩ [0, range_end)) (RoaringBitmap.orNot, RoaringBitmap.java:1521).
 
         Container walk: every key chunk of [0, range_end) gets the in-chunk
         complement of x2's container (full-range when absent) OR'd with x1's —
-        no whole-universe bitmap is ever materialized."""
+        no whole-universe bitmap is ever materialized. ``_reuse_left`` (the
+        ior_not path only) transfers x1's beyond-range chunks unclone'd."""
         _, range_end = _check_range(0, range_end)
         out = RoaringBitmap()
         if range_end == 0:
@@ -589,13 +628,25 @@ class RoaringBitmap:
         # x1's chunks beyond the range pass through untouched
         ia = a.advance_until(last_key + 1, -1)
         while ia < a.size:
-            out.high_low_container.append(a.keys[ia], a.containers[ia].clone())
+            out.high_low_container.append(
+                a.keys[ia],
+                a.containers[ia] if _reuse_left else a.containers[ia].clone(),
+            )
             ia += 1
         return out
 
     @staticmethod
     def and_cardinality(x1: "RoaringBitmap", x2: "RoaringBitmap") -> int:
-        """RoaringBitmap.andCardinality (RoaringBitmap.java:413)."""
+        """RoaringBitmap.andCardinality (RoaringBitmap.java:413). Above
+        the columnar cutoff the count comes from the batched
+        cardinality-only kernels — nothing materializes."""
+        col = _columnar()
+        if col.enabled_for(x1.high_low_container, x2.high_low_container):
+            return col.and_cardinality_pair(x1, x2)
+        return RoaringBitmap._and_cardinality_percontainer(x1, x2)
+
+    @staticmethod
+    def _and_cardinality_percontainer(x1: "RoaringBitmap", x2: "RoaringBitmap") -> int:
         total = 0
         a, b = x1.high_low_container, x2.high_low_container
         ia = ib = 0
@@ -634,7 +685,16 @@ class RoaringBitmap:
 
     @staticmethod
     def intersects(x1: "RoaringBitmap", x2: "RoaringBitmap") -> bool:
-        """RoaringBitmap.intersects (RoaringBitmap.java:698)."""
+        """RoaringBitmap.intersects (RoaringBitmap.java:698). The columnar
+        path short-circuits between class batches instead of between
+        containers."""
+        col = _columnar()
+        if col.enabled_for(x1.high_low_container, x2.high_low_container):
+            return col.intersects_pair(x1, x2)
+        return RoaringBitmap._intersects_percontainer(x1, x2)
+
+    @staticmethod
+    def _intersects_percontainer(x1: "RoaringBitmap", x2: "RoaringBitmap") -> bool:
         a, b = x1.high_low_container, x2.high_low_container
         ia = ib = 0
         while ia < a.size and ib < b.size:
@@ -650,11 +710,12 @@ class RoaringBitmap:
                 ib = b.advance_until(ka, ib)
         return False
 
-    # in-place variants + operators
+    # in-place variants + operators. The member-op pass-through transfer
+    # (reuse_left — round 4's ior win, extended to ixor/iandnot and now
+    # uniform on the columnar engine too) is safe exactly because these
+    # discard self's old index.
     def ior(self, other: "RoaringBitmap") -> "RoaringBitmap":
-        self.high_low_container = RoaringBitmap._merge_op(
-            self, other, "or", reuse_left=True
-        ).high_low_container
+        self.high_low_container = self._inplace_merge(other, "or")
         return self
 
     def iand(self, other: "RoaringBitmap") -> "RoaringBitmap":
@@ -662,10 +723,16 @@ class RoaringBitmap:
         return self
 
     def ixor(self, other: "RoaringBitmap") -> "RoaringBitmap":
-        self.high_low_container = RoaringBitmap._merge_op(
-            self, other, "xor", reuse_left=True
-        ).high_low_container
+        self.high_low_container = self._inplace_merge(other, "xor")
         return self
+
+    def _inplace_merge(self, other: "RoaringBitmap", op: str):
+        col = _columnar()
+        if col.enabled_for(self.high_low_container, other.high_low_container):
+            return col.pairwise(op, self, other, reuse_left=True).high_low_container
+        return RoaringBitmap._merge_op(
+            self, other, op, reuse_left=True
+        ).high_low_container
 
     def iandnot(self, other: "RoaringBitmap") -> "RoaringBitmap":
         self.high_low_container = RoaringBitmap.andnot(

@@ -97,6 +97,27 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.rb_pack_array_rows.argtypes = [i64p, i64p, i64, u16p, u64p]
     lib.rb_words_from_intervals.restype = None
     lib.rb_words_from_intervals.argtypes = [i64p, i64p, ctypes.c_int32, u64p]
+    # columnar batched pairwise (ISSUE 5): declared with raw pointers, not
+    # ndpointer — these are called several times per *pairwise op* (not per
+    # working set), and ndpointer's from_param validation costs ~10 µs per
+    # array argument, which at 5-9 arguments would hand back most of the
+    # dispatch win the batch kernels exist to create. The wrappers below
+    # own the dtype/contiguity guarantees instead.
+    vp = ctypes.c_void_p
+    lib.rb_batch_pairwise_u16.restype = None
+    lib.rb_batch_pairwise_u16.argtypes = [vp, vp, vp, vp, i64, i32, vp, vp, vp]
+    lib.rb_batch_intersect_card_u16.restype = None
+    lib.rb_batch_intersect_card_u16.argtypes = [vp, vp, vp, vp, i64, vp]
+    lib.rb_batch_run_pairwise.restype = None
+    lib.rb_batch_run_pairwise.argtypes = [
+        vp, vp, vp, vp, vp, vp, i64, i32, vp, vp, vp, vp, vp,
+    ]
+    lib.rb_popcount_rows.restype = None
+    lib.rb_popcount_rows.argtypes = [vp, i64, i64, vp]
+    lib.rb_scatter_values_rows.restype = None
+    lib.rb_scatter_values_rows.argtypes = [vp, vp, i64, vp, vp, i32]
+    lib.rb_fill_intervals_rows.restype = None
+    lib.rb_fill_intervals_rows.argtypes = [vp, vp, i64, vp, vp, vp, i32]
 
 
 def _load():
@@ -541,6 +562,134 @@ def words_from_intervals(starts: np.ndarray, ends: np.ndarray) -> np.ndarray:
     words = np.zeros(1024, dtype=np.uint64)
     lib().rb_words_from_intervals(s, e, np.int32(s.size), words)
     return words
+
+
+_BATCH_OPS = {"and": 0, "or": 1, "xor": 2, "andnot": 3}
+_SCATTER_OPS = {"or": 0, "xor": 1, "clear": 2}
+
+
+def _c64i(a: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(a, dtype=np.int64)
+
+
+def _p(a: np.ndarray) -> int:
+    # raw data pointer for the void_p-declared batch entry points; every
+    # caller below has already forced dtype + C-contiguity, and the array
+    # stays referenced by the calling frame for the duration of the call
+    return a.ctypes.data
+
+
+def batch_pairwise_u16(
+    avals: np.ndarray,
+    aoffs: np.ndarray,
+    bvals: np.ndarray,
+    boffs: np.ndarray,
+    op: str,
+    out_offs: np.ndarray,
+    out_size: int,
+):
+    """One call = one whole batch of sorted-u16 container ops (columnar
+    engine, ISSUE 5). Pair j reads avals[aoffs[j]:aoffs[j+1]] x
+    bvals[boffs[j]:boffs[j+1]] and writes at out[out_offs[j]:]; returns
+    ``(out_scratch, counts)`` — caller slices out_scratch per pair."""
+    a, b = _c16(avals), _c16(bvals)
+    ao, bo, oo = _c64i(aoffs), _c64i(boffs), _c64i(out_offs)
+    n = ao.size - 1
+    out = np.empty(max(1, int(out_size)), dtype=np.uint16)
+    counts = np.empty(max(1, n), dtype=np.int64)
+    lib().rb_batch_pairwise_u16(
+        _p(a), _p(ao), _p(b), _p(bo), n, _BATCH_OPS[op], _p(oo), _p(out), _p(counts)
+    )
+    return out, counts[:n]
+
+
+def batch_run_pairwise(
+    astarts: np.ndarray,
+    alens: np.ndarray,
+    aoffs: np.ndarray,
+    bstarts: np.ndarray,
+    blens: np.ndarray,
+    boffs: np.ndarray,
+    op: str,
+    out_offs,
+    out_size: int,
+):
+    """Run-unified batch AND/ANDNOT (arrays as length-0 runs): one call
+    executes every (array|run) x (array|run) pair of a bucket, emitting
+    result INTERVALS (payload-sized buffers, never cardinality-sized).
+    ``out_offs=None`` cards only; returns ``(out_starts_or_None,
+    out_lengths_or_None, interval_counts, cards)``."""
+    a_s, a_l = _c16(astarts), _c16(alens)
+    b_s, b_l = _c16(bstarts), _c16(blens)
+    ao, bo = _c64i(aoffs), _c64i(boffs)
+    n = ao.size - 1
+    counts = np.empty(max(1, n), dtype=np.int64)
+    cards = np.empty(max(1, n), dtype=np.int64)
+    if out_offs is None:
+        lib().rb_batch_run_pairwise(
+            _p(a_s), _p(a_l), _p(ao), _p(b_s), _p(b_l), _p(bo),
+            n, _BATCH_OPS[op], None, None, None, _p(counts), _p(cards),
+        )
+        return None, None, counts[:n], cards[:n]
+    oo = _c64i(out_offs)
+    out_s = np.empty(max(1, int(out_size)), dtype=np.uint16)
+    out_l = np.empty(max(1, int(out_size)), dtype=np.uint16)
+    lib().rb_batch_run_pairwise(
+        _p(a_s), _p(a_l), _p(ao), _p(b_s), _p(b_l), _p(bo),
+        n, _BATCH_OPS[op], _p(oo), _p(out_s), _p(out_l), _p(counts), _p(cards),
+    )
+    return out_s, out_l, counts[:n], cards[:n]
+
+
+def batch_intersect_card_u16(
+    avals: np.ndarray, aoffs: np.ndarray, bvals: np.ndarray, boffs: np.ndarray
+) -> np.ndarray:
+    """Per-pair AND cardinalities, no materialization."""
+    a, b = _c16(avals), _c16(bvals)
+    ao, bo = _c64i(aoffs), _c64i(boffs)
+    n = ao.size - 1
+    counts = np.empty(max(1, n), dtype=np.int64)
+    lib().rb_batch_intersect_card_u16(_p(a), _p(ao), _p(b), _p(bo), n, _p(counts))
+    return counts[:n]
+
+
+def popcount_rows(mat: np.ndarray) -> np.ndarray:
+    """Per-row popcount of an [n_rows, n_words] uint64 matrix."""
+    m = np.ascontiguousarray(mat, dtype=np.uint64)
+    n_rows, n_words = m.shape
+    out = np.empty(max(1, n_rows), dtype=np.int64)
+    lib().rb_popcount_rows(_p(m), n_rows, n_words, _p(out))
+    return out[:n_rows]
+
+
+def scatter_values_rows(
+    row_ids: np.ndarray, offsets: np.ndarray, vals: np.ndarray,
+    out64: np.ndarray, op: str = "or",
+) -> None:
+    """Scatter concatenated u16 container values into [*, 1024]-word rows
+    with or/xor/clear combine; row_ids may repeat (fold accumulators)."""
+    rows, offs = _c64i(row_ids), _c64i(offsets)
+    v = _c16(vals)
+    if out64.dtype != np.uint64 or not out64.flags.c_contiguous:
+        raise ValueError("scatter_values_rows needs a C-contiguous uint64 target")
+    lib().rb_scatter_values_rows(
+        _p(rows), _p(offs), rows.size, _p(v), _p(out64), _SCATTER_OPS[op]
+    )
+
+
+def fill_intervals_rows(
+    row_ids: np.ndarray, run_offs: np.ndarray, starts: np.ndarray,
+    ends: np.ndarray, out64: np.ndarray, op: str = "or",
+) -> None:
+    """Expand many run containers' [start, end) intervals into word rows in
+    one call — the batched twin of words_from_intervals."""
+    rows, offs = _c64i(row_ids), _c64i(run_offs)
+    s, e = _c64i(starts), _c64i(ends)
+    if out64.dtype != np.uint64 or not out64.flags.c_contiguous:
+        raise ValueError("fill_intervals_rows needs a C-contiguous uint64 target")
+    lib().rb_fill_intervals_rows(
+        _p(rows), _p(offs), rows.size, _p(s), _p(e), _p(out64), _SCATTER_OPS[op]
+    )
 
 
 def pack_array_rows(

@@ -280,6 +280,218 @@ void rb_words_from_intervals(const int64_t* starts, const int64_t* ends,
 }
 
 // ---------------------------------------------------------------------------
+// columnar batched pairwise algebra (ISSUE 5)
+//
+// One call executes a whole batch of sorted-u16 container ops: pair j reads
+// avals[aoffs[j]:aoffs[j+1]] x bvals[boffs[j]:boffs[j+1]] and writes its
+// result at out + out_offs[j] (caller-computed worst-case bounds, so pairs
+// are independent and the loop parallelizes). This is the per-type-pair
+// kernel loop of the reference (Util.java unsigned*2by2 driven by
+// RoaringBitmap's key merge) with the Python dispatch hoisted out of the
+// per-container path entirely.
+// ---------------------------------------------------------------------------
+
+// op codes shared with columnar/kernels.py: 0=and 1=or 2=xor 3=andnot
+void rb_batch_pairwise_u16(const uint16_t* avals, const int64_t* aoffs,
+                           const uint16_t* bvals, const int64_t* boffs,
+                           int64_t n_pairs, int32_t op,
+                           const int64_t* out_offs, uint16_t* out,
+                           int64_t* counts) {
+#pragma omp parallel for schedule(dynamic, 64)
+  for (int64_t j = 0; j < n_pairs; ++j) {
+    const uint16_t* a = avals + aoffs[j];
+    const uint16_t* b = bvals + boffs[j];
+    int32_t na = (int32_t)(aoffs[j + 1] - aoffs[j]);
+    int32_t nb = (int32_t)(boffs[j + 1] - boffs[j]);
+    uint16_t* o = out + out_offs[j];
+    switch (op) {
+      case 0: counts[j] = rb_intersect_u16(a, na, b, nb, o); break;
+      case 1: counts[j] = rb_union_u16(a, na, b, nb, o); break;
+      case 2: counts[j] = rb_xor_u16(a, na, b, nb, o); break;
+      default: counts[j] = rb_difference_u16(a, na, b, nb, o); break;
+    }
+  }
+}
+
+// ---- run-unified batch (arrays enter as length-0 runs) --------------------
+//
+// A container side is a sorted disjoint run list (start, length), run =
+// [start, start+length]; an array container is its values with length 0.
+// This single representation lets ONE kernel serve the aa/ar/ra/rr classes
+// of AND/ANDNOT — the 4 of the reference's 9 type-pair kernels that matter
+// for intersection-shaped ops — emitting result VALUES (intersections are
+// small by construction; the or/xor classes go through the word path).
+
+// intervals of (A AND B) as (start, length) pairs; returns interval count,
+// accumulates result cardinality into *card. os==nullptr: card only.
+static int64_t run_and_intervals(const uint16_t* as, const uint16_t* al,
+                                 int32_t na, const uint16_t* bs,
+                                 const uint16_t* bl, int32_t nb, uint16_t* os,
+                                 uint16_t* ol, int64_t* card) {
+  int32_t i = 0, j = 0;
+  int64_t k = 0, c = 0;
+  while (i < na && j < nb) {
+    int32_t a0 = as[i], a1 = a0 + al[i];
+    int32_t b0 = bs[j], b1 = b0 + bl[j];
+    int32_t lo = a0 > b0 ? a0 : b0;
+    int32_t hi = a1 < b1 ? a1 : b1;
+    if (hi >= lo) {
+      c += hi - lo + 1;
+      if (os) {
+        os[k] = (uint16_t)lo;
+        ol[k] = (uint16_t)(hi - lo);
+      }
+      ++k;
+    }
+    if (a1 < b1) ++i; else ++j;
+  }
+  *card = c;
+  return k;
+}
+
+// intervals of (A ANDNOT B)
+static int64_t run_andnot_intervals(const uint16_t* as, const uint16_t* al,
+                                    int32_t na, const uint16_t* bs,
+                                    const uint16_t* bl, int32_t nb,
+                                    uint16_t* os, uint16_t* ol, int64_t* card) {
+  int32_t j = 0;
+  int64_t k = 0, c = 0;
+  for (int32_t i = 0; i < na; ++i) {
+    int32_t a0 = as[i], a1 = a0 + al[i];
+    while (j < nb && (int32_t)(bs[j] + bl[j]) < a0) ++j;
+    int32_t jj = j, cur = a0;
+    while (cur <= a1) {
+      if (jj < nb && (int32_t)bs[jj] <= cur) {
+        int32_t be = bs[jj] + bl[jj];
+        ++jj;
+        if (be >= cur) cur = be + 1;
+        continue;
+      }
+      int32_t stop = a1;
+      if (jj < nb && (int32_t)bs[jj] <= a1) stop = bs[jj] - 1;
+      c += stop - cur + 1;
+      if (os) {
+        os[k] = (uint16_t)cur;
+        ol[k] = (uint16_t)(stop - cur);
+      }
+      ++k;
+      cur = stop + 1;
+    }
+  }
+  *card = c;
+  return k;
+}
+
+// Whole-batch run-unified pairwise: pair j reads run lists
+// (as, al)[aoffs[j]:aoffs[j+1]] x (bs, bl)[boffs[j]:boffs[j+1]] and writes
+// result INTERVALS at out_s/out_l + out_offs[j] (bounds: na+nb intervals —
+// payload-sized, never cardinality-sized, so run-shaped results stay
+// compressed end to end). op: 0=and 3=andnot. out_s==nullptr -> cards only.
+void rb_batch_run_pairwise(const uint16_t* as, const uint16_t* al,
+                           const int64_t* aoffs, const uint16_t* bs,
+                           const uint16_t* bl, const int64_t* boffs,
+                           int64_t n_pairs, int32_t op, const int64_t* out_offs,
+                           uint16_t* out_s, uint16_t* out_l, int64_t* counts,
+                           int64_t* cards) {
+#pragma omp parallel for schedule(dynamic, 64)
+  for (int64_t j = 0; j < n_pairs; ++j) {
+    const uint16_t* a_s = as + aoffs[j];
+    const uint16_t* a_l = al + aoffs[j];
+    int32_t na = (int32_t)(aoffs[j + 1] - aoffs[j]);
+    const uint16_t* b_s = bs + boffs[j];
+    const uint16_t* b_l = bl + boffs[j];
+    int32_t nb = (int32_t)(boffs[j + 1] - boffs[j]);
+    uint16_t* os = out_s ? out_s + out_offs[j] : nullptr;
+    uint16_t* ol = out_l ? out_l + out_offs[j] : nullptr;
+    counts[j] = (op == 0)
+                    ? run_and_intervals(a_s, a_l, na, b_s, b_l, nb, os, ol,
+                                        cards + j)
+                    : run_andnot_intervals(a_s, a_l, na, b_s, b_l, nb, os, ol,
+                                           cards + j);
+  }
+}
+
+// cardinality-only AND batch: no output buffer, no materialization
+void rb_batch_intersect_card_u16(const uint16_t* avals, const int64_t* aoffs,
+                                 const uint16_t* bvals, const int64_t* boffs,
+                                 int64_t n_pairs, int64_t* counts) {
+#pragma omp parallel for schedule(dynamic, 64)
+  for (int64_t j = 0; j < n_pairs; ++j) {
+    counts[j] = rb_intersect_u16(
+        avals + aoffs[j], (int32_t)(aoffs[j + 1] - aoffs[j]),
+        bvals + boffs[j], (int32_t)(boffs[j + 1] - boffs[j]), nullptr);
+  }
+}
+
+// per-row popcount of an [n_rows, n_words] matrix (batched result
+// cardinalities; rows are independent)
+void rb_popcount_rows(const uint64_t* words, int64_t n_rows, int64_t n_words,
+                      int64_t* out) {
+#pragma omp parallel for schedule(static)
+  for (int64_t r = 0; r < n_rows; ++r)
+    out[r] = rb_popcount_words(words + r * n_words, n_words);
+}
+
+// Scatter sorted-u16 container values into [*, 1024]-word rows, container j
+// targeting row row_ids[j] with combine op 0=or 1=xor 2=clear (andnot).
+// SERIAL over containers: unlike rb_pack_array_rows, row_ids may repeat
+// (fold accumulators), so the parallel-for would race.
+void rb_scatter_values_rows(const int64_t* row_ids, const int64_t* offsets,
+                            int64_t n_containers, const uint16_t* vals,
+                            uint64_t* out, int32_t op) {
+  for (int64_t j = 0; j < n_containers; ++j) {
+    uint64_t* row = out + row_ids[j] * 1024;
+    for (int64_t i = offsets[j]; i < offsets[j + 1]; ++i) {
+      uint16_t v = vals[i];
+      uint64_t bit = 1ULL << (v & 63);
+      switch (op) {
+        case 0: row[v >> 6] |= bit; break;
+        case 1: row[v >> 6] ^= bit; break;
+        default: row[v >> 6] &= ~bit; break;
+      }
+    }
+  }
+}
+
+// Fill disjoint half-open [start, end) intervals into word rows: container
+// j's runs (starts/ends[run_offs[j]:run_offs[j+1]]) land in row row_ids[j]
+// with op 0=or 1=xor. The batched twin of rb_words_from_intervals — one
+// call expands every run container of a working set. Serial: rows repeat.
+void rb_fill_intervals_rows(const int64_t* row_ids, const int64_t* run_offs,
+                            int64_t n_containers, const int64_t* starts,
+                            const int64_t* ends, uint64_t* out, int32_t op) {
+  for (int64_t j = 0; j < n_containers; ++j) {
+    uint64_t* words = out + row_ids[j] * 1024;
+    for (int64_t i = run_offs[j]; i < run_offs[j + 1]; ++i) {
+      int64_t s = starts[i], e = ends[i];
+      if (s < 0) s = 0;
+      if (e > 65536) e = 65536;
+      if (e <= s) continue;
+      int64_t sw = s >> 6, ew = (e - 1) >> 6;
+      uint64_t first = ~0ULL << (s & 63);
+      uint64_t last = ~0ULL >> (63 - ((e - 1) & 63));
+      if (op == 0) {
+        if (sw == ew) {
+          words[sw] |= first & last;
+        } else {
+          words[sw] |= first;
+          for (int64_t w = sw + 1; w < ew; ++w) words[w] = ~0ULL;
+          words[ew] |= last;
+        }
+      } else {  // xor: runs within a container are disjoint, so ^= is exact
+        if (sw == ew) {
+          words[sw] ^= first & last;
+        } else {
+          words[sw] ^= first;
+          for (int64_t w = sw + 1; w < ew; ++w) words[w] ^= ~0ULL;
+          words[ew] ^= last;
+        }
+      }
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
 // batch packing (device-store marshal)
 // ---------------------------------------------------------------------------
 

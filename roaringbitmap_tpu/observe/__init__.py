@@ -20,6 +20,7 @@ Metric naming convention: ``rb_tpu_<layer>_<name>`` (canonical names in
 from .registry import (
     ANALYSIS_FINDINGS_TOTAL,
     BATCH_PAIRWISE_TOTAL,
+    COLUMNAR_BATCH_TOTAL,
     DEFAULT_TIME_BUCKETS,
     HOST_OP_SECONDS,
     KERNEL_DISPATCH_TOTAL,
@@ -99,6 +100,7 @@ __all__ = [
     "PACK_CACHE_EVICTED_BYTES_TOTAL",
     "PACK_CACHE_RESIDENT_BYTES",
     "BATCH_PAIRWISE_TOTAL",
+    "COLUMNAR_BATCH_TOTAL",
     "SERIAL_BYTES_TOTAL",
     "HOST_OP_SECONDS",
     "SPAN_SECONDS",

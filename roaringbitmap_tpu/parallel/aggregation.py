@@ -112,6 +112,19 @@ def _fold_group_words(cs: List[Container], op: str) -> np.ndarray:
 def _cpu_aggregate(
     groups: Dict[int, List[Container]], op: str, pool: Optional[ThreadPoolExecutor] = None
 ) -> RoaringBitmap:
+    """CPU fold dispatcher: large OR/XOR working sets take the columnar
+    batched fold (one scatter/fill/reduceat pass over every container,
+    ISSUE 5 — single-threaded vectorized, so it also replaces the thread
+    pool); small ones keep the per-key word-fold walk below. AND stays on
+    the lazy per-group fold: its columnar variant must expand every
+    operand to words up front, measured ~2x slower than folding one
+    container at a time."""
+    from .. import columnar
+
+    if op != "and" and columnar.enabled_for_fold(
+        sum(len(cs) for cs in groups.values())
+    ):
+        return columnar.fold(groups, op)
     out = RoaringBitmap()
     keys = sorted(groups)
 

@@ -74,16 +74,29 @@ def _cpu_folds(first: RoaringBitmap, groups: dict):
     """The shared CPU core: per key of ``first`` yield ``(key, container,
     folded_words)`` — folded_words is None for pass-through keys with no
     subtrahend containers. One fold body serves both the materializing and
-    the count-only entry points so they cannot desynchronize."""
+    the count-only entry points so they cannot desynchronize.
+
+    Large subtrahend sets route the per-key union through the columnar
+    batched OR fold (one scatter/fill/reduceat pass over every subtrahend
+    container, ISSUE 5) instead of the per-container ``acc &= ~words``
+    walk."""
+    from .. import columnar
+
     hlc = first.high_low_container
+    union_words = None
+    if columnar.enabled_for_fold(sum(len(cs) for cs in groups.values())):
+        union_words = columnar.or_fold_words(groups)
     for k, c in zip(hlc.keys, hlc.containers):
         cs = groups.get(k)
         if not cs:
             yield k, c, None
             continue
         acc = c.to_words()
-        for rc in cs:
-            acc &= ~_container_words(rc)
+        if union_words is not None:
+            acc &= ~union_words[k]
+        else:
+            for rc in cs:
+                acc &= ~_container_words(rc)
         yield k, c, acc
 
 

@@ -26,7 +26,7 @@ if [[ "${1:-}" != "--fast" ]]; then
   python -m pytest tests/ -q
 fi
 
-step "fuzz smoke (500 iterations x 24 invariant families)"
+step "fuzz smoke (500 iterations x 25 invariant families)"
 python -m roaringbitmap_tpu.fuzz 500 > /tmp/ci_fuzz.log 2>&1 \
   || { tail -20 /tmp/ci_fuzz.log; exit 1; }
 tail -1 /tmp/ci_fuzz.log
@@ -53,6 +53,38 @@ print("query bench ok (planned %.1fx vs naive, warm cache %.1fx, warm pack %.1fx
       % (rs["queryNaive"] / rs["queryPlanned"],
          rs["queryNaive"] / rs["queryPlannedWarmCache"],
          rs["queryPlannedColdPack"] / rs["queryPlannedWarmPack"]))
+EOF
+
+step "columnar engine parity (census1881 sample vs per-container, ISSUE 5)"
+# the batched pairwise engine must agree with the per-container engine on
+# every op over a real-corpus sample, and must actually have engaged (the
+# counter proves the router didn't silently fall back)
+JAX_PLATFORMS=cpu python - <<'EOF'
+from benchmarks import common
+from roaringbitmap_tpu import columnar, insights
+from roaringbitmap_tpu.models.roaring import RoaringBitmap as RB
+
+bms = common.corpus_bitmaps("census1881", limit=64)
+pairs = list(zip(bms[:-1], bms[1:]))
+ops = {"and": RB.and_, "or": RB.or_, "xor": RB.xor, "andnot": RB.andnot}
+checked = 0
+for a, b in pairs:
+    for name, op in ops.items():
+        got = op(a, b)
+        with columnar.disabled():
+            want = op(a, b)
+        if got != want:
+            raise SystemExit("columnar parity broke: %s" % name)
+        checked += 1
+    with columnar.disabled():
+        wc, wi = RB.and_cardinality(a, b), RB.intersects(a, b)
+    if RB.and_cardinality(a, b) != wc or RB.intersects(a, b) != wi:
+        raise SystemExit("columnar cardinality/intersects parity broke")
+counts = insights.columnar_counters()["batch"]
+if not sum(counts.values()):
+    raise SystemExit("columnar engine never engaged on the census sample")
+print("columnar parity ok (%d op pairs; %d batched container-pairs)"
+      % (checked, sum(counts.values())))
 EOF
 
 step "bench.py --smoke (end-to-end north-star path, CPU)"
@@ -95,6 +127,29 @@ if not m["delta_repack_s"] > 0:
 print("pack-cache rows ok (hit ratio %s, delta %s rows in %ss)"
       % (m["pack_cache_hit_ratio"], m["pack_delta_rows"], m["delta_repack_s"]))'
 
+step "columnar dispatch floor in the bench artifact (ISSUE 5 contract)"
+# the bench must have run its in-bench parity gate and recorded the
+# per-container dispatch floor before/after (the smoke numbers gate
+# presence and sanity; the >=2x claim lives in the full-run BENCH_r*.json)
+python -c '
+import json
+m = json.load(open("/tmp/ci_bench.json"))["meta"]
+col = m.get("columnar")
+if not isinstance(col, dict):
+    raise SystemExit("bench columnar contract: missing meta.columnar block")
+need = {"parity_ok", "n_pairs", "and2by2_percontainer_ns", "and2by2_columnar_ns",
+        "and2by2_speedup", "andcard_percontainer_ns", "andcard_columnar_ns",
+        "andcard_speedup", "cpu_fold_percontainer_s", "fold_speedup"}
+missing = need - set(col)
+if missing:
+    raise SystemExit("bench columnar contract: missing %s" % sorted(missing))
+if col["parity_ok"] is not True:
+    raise SystemExit("bench columnar contract: parity gate did not pass")
+if not all(col[k] > 0 for k in need - {"parity_ok"}):
+    raise SystemExit("bench columnar contract: non-positive floor %r" % col)
+print("columnar floor ok (and2by2 %.2fx, andCardinality %.2fx, cpu fold %.2fx)"
+      % (col["and2by2_speedup"], col["andcard_speedup"], col["fold_speedup"]))'
+
 step "bench metrics sidecar (observe/ registry snapshot contract)"
 # same SystemExit discipline as the driver-contract check above: the smoke
 # run must leave a schema-valid registry snapshot behind
@@ -120,8 +175,12 @@ if not (m["layout"] and m["spans"]):
 pack = m.get("registry", {}).get("rb_tpu_pack_cache_hits_total", {}).get("samples", [])
 if not pack:
     raise SystemExit("metrics sidecar recorded no pack-cache hits (ISSUE 4)")
-print("metrics sidecar ok (layouts %s, %d span paths, pack-cache hits %s)"
-      % (m["layout"], len(m["spans"]), sum(s["value"] for s in pack)))'
+col = m.get("registry", {}).get("rb_tpu_columnar_batch_total", {}).get("samples", [])
+if not col:
+    raise SystemExit("metrics sidecar recorded no columnar batches (ISSUE 5)")
+print("metrics sidecar ok (layouts %s, %d span paths, pack-cache hits %s, columnar pairs %s)"
+      % (m["layout"], len(m["spans"]), sum(s["value"] for s in pack),
+         sum(s["value"] for s in col)))'
 
 step "graft entry + 8-device virtual-mesh dryrun"
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -1,0 +1,374 @@
+"""Columnar pairwise engine (ISSUE 5): differential coverage vs the
+per-container engine across all 9 type-pair classes, both kernel tiers
+(native batch / numpy fallback), the routing cutoff, key-plan edge cases,
+member-op reuse semantics, the N-way folds, and the metrics surface."""
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import columnar, insights
+from roaringbitmap_tpu.columnar import engine as col_engine
+from roaringbitmap_tpu.columnar import kernels as col_kernels
+from roaringbitmap_tpu.models.container import (
+    ArrayContainer,
+    BitmapContainer,
+    RunContainer,
+)
+from roaringbitmap_tpu.models.immutable import ImmutableRoaringBitmap
+from roaringbitmap_tpu.models.roaring import RoaringBitmap
+from roaringbitmap_tpu.parallel import store
+from roaringbitmap_tpu.parallel.aggregation import FastAggregation
+
+OPS = {
+    "and": RoaringBitmap.and_,
+    "or": RoaringBitmap.or_,
+    "xor": RoaringBitmap.xor,
+    "andnot": RoaringBitmap.andnot,
+}
+
+
+def _chunk_values(kind: str, key: int, rng) -> np.ndarray:
+    """Values for one 2^16 chunk shaped to settle into the given container
+    type after construction (+ run_optimize for 'run')."""
+    base = key << 16
+    if kind == "array":
+        vals = np.sort(rng.choice(1 << 16, 500, replace=False))
+    elif kind == "bitmap":
+        vals = np.sort(rng.choice(1 << 16, 9000, replace=False))
+    else:  # run
+        starts = np.arange(0, 1 << 16, 1 << 11)[:20]
+        vals = np.unique(
+            np.concatenate([np.arange(s, s + 900) for s in starts])
+        )
+    return (vals + base).astype(np.uint32)
+
+
+def _typed_bitmap(kinds, rng) -> RoaringBitmap:
+    bm = RoaringBitmap(
+        np.concatenate([_chunk_values(k, i, rng) for i, k in enumerate(kinds)])
+    )
+    bm.run_optimize()
+    return bm
+
+
+@pytest.mark.parametrize("op", list(OPS))
+def test_all_nine_classes_parity(op):
+    """Every (array|bitmap|run)^2 matched class, both operand orders, vs
+    the per-container engine."""
+    rng = np.random.default_rng(5)
+    kinds = ["array", "bitmap", "run"]
+    a = _typed_bitmap([k for k in kinds for _ in kinds], rng)  # a,a,a,b,b,b,r,r,r
+    b = _typed_bitmap([k for _ in kinds for k in kinds], rng)  # a,b,r,a,b,r,...
+    got = columnar.pairwise(op, a, b)
+    with columnar.disabled():
+        want = OPS[op](a, b)
+    assert got == want
+    assert got.get_cardinality() == want.get_cardinality()
+    # container *types* on the two sides really were the 9-class grid
+    ca = columnar.classify(a.high_low_container.containers)
+    cb = columnar.classify(b.high_low_container.containers)
+    assert columnar.class_histogram(ca, cb).tolist() == [1] * 9
+
+
+@pytest.mark.parametrize("force_numpy", [False, True])
+def test_random_parity_both_tiers(monkeypatch, force_numpy):
+    """Randomized differential on the native AND the numpy fallback tier:
+    identical results with the C extension unavailable."""
+    if force_numpy:
+        monkeypatch.setattr(col_kernels, "_native", lambda: None)
+    from roaringbitmap_tpu import fuzz
+
+    rng = np.random.default_rng(17)
+    for _ in range(40):
+        a = fuzz.random_bitmap(rng)
+        b = fuzz.random_bitmap(rng)
+        for op, ref in OPS.items():
+            with columnar.disabled():
+                want = ref(a, b)
+            assert columnar.pairwise(op, a, b) == want, op
+        with columnar.disabled():
+            want_c = RoaringBitmap.and_cardinality(a, b)
+            want_i = RoaringBitmap.intersects(a, b)
+        assert columnar.and_cardinality_pair(a, b) == want_c
+        assert columnar.intersects_pair(a, b) == want_i
+
+
+def test_numpy_tier_fold_parity(monkeypatch):
+    monkeypatch.setattr(col_kernels, "_native", lambda: None)
+    from roaringbitmap_tpu import fuzz
+
+    rng = np.random.default_rng(23)
+    bms = [fuzz.random_bitmap(rng) for _ in range(5)]
+    groups = store.group_by_key(bms)
+    assert columnar.fold(groups, "or") == FastAggregation.naive_or(*bms)
+    assert columnar.fold(groups, "xor") == FastAggregation.naive_xor(*bms)
+    keys = store.intersect_keys(bms)
+    if keys:
+        g2 = store.group_by_key(bms, keys_filter=keys)
+        assert columnar.fold(g2, "and") == FastAggregation.naive_and(*bms)
+
+
+def test_empty_and_disjoint_key_plans():
+    empty = RoaringBitmap()
+    disj_a = RoaringBitmap((np.arange(100) + (1 << 16)).astype(np.uint32))
+    disj_b = RoaringBitmap((np.arange(100) + (9 << 16)).astype(np.uint32))
+    for op, ref in OPS.items():
+        for x1, x2 in [
+            (empty, disj_a),
+            (disj_a, empty),
+            (empty, empty.clone()),
+            (disj_a, disj_b),
+            (disj_b, disj_a),
+        ]:
+            with columnar.disabled():
+                want = ref(x1, x2)
+            assert columnar.pairwise(op, x1, x2) == want, op
+    assert columnar.and_cardinality_pair(disj_a, disj_b) == 0
+    assert not columnar.intersects_pair(disj_a, disj_b)
+    # key plan internals: disjoint -> no matched pairs, full pass-throughs
+    plan = columnar.key_plan(
+        disj_a.high_low_container.keys, disj_b.high_low_container.keys, "or"
+    )
+    assert plan.ia.size == 0 and plan.a_only.size == 1 and plan.b_only.size == 1
+
+
+def _runny(n_keys: int) -> RoaringBitmap:
+    """n_keys run containers (the shape the router's dense hint admits)."""
+    bm = RoaringBitmap(
+        np.concatenate(
+            [np.arange(k << 16, (k << 16) + 40) for k in range(n_keys)]
+        ).astype(np.uint32)
+    )
+    bm.run_optimize()
+    return bm
+
+
+def test_cutoff_boundary_routes():
+    """Below config.min_containers the facade keeps the per-container
+    walk; at the cutoff it switches to the columnar engine (visible in
+    rb_tpu_columnar_batch_total)."""
+    cut = columnar.config.min_containers
+
+    def counter_total():
+        return sum(insights.columnar_counters()["batch"].values())
+
+    small = _runny(cut - 1)
+    at_cut = _runny(cut)
+    before = counter_total()
+    RoaringBitmap.and_(small, small.clone())
+    assert counter_total() == before  # routed per-container
+    RoaringBitmap.and_(at_cut, at_cut.clone())
+    assert counter_total() > before  # routed columnar
+    # results agree on both sides of the boundary
+    for bm in (small, at_cut):
+        with columnar.disabled():
+            want = RoaringBitmap.and_(bm, bm.clone())
+        assert RoaringBitmap.and_(bm, bm.clone()) == want
+
+
+def test_array_only_operands_keep_percontainer_walk():
+    """The dense-shape hint: array-only pairs (whose scalar ops already
+    sit at the C floor) never route columnar, whatever their count."""
+    cut = columnar.config.min_containers
+
+    def counter_total():
+        return sum(insights.columnar_counters()["batch"].values())
+
+    arrays = RoaringBitmap((np.arange(cut * 2) << 16).astype(np.uint32))
+    before = counter_total()
+    RoaringBitmap.and_(arrays, arrays.clone())
+    RoaringBitmap.or_(arrays, arrays.clone())
+    assert counter_total() == before
+    # one run container on either side flips the hint
+    mixed = arrays.clone()
+    mixed.add_range(100 << 16, (100 << 16) + 50)
+    mixed.run_optimize()
+    RoaringBitmap.and_(arrays, mixed)
+    assert counter_total() > before
+
+
+def test_inplace_reuse_semantics():
+    """ior/ixor/iandnot above the cutoff: pass-through containers of self
+    TRANSFER (no clone), matched results are fresh, and the right operand
+    is never touched."""
+    rng = np.random.default_rng(3)
+    n = columnar.config.min_containers + 8
+    a = _typed_bitmap(["array", "run"] * (n // 2), rng)
+    # b shares only the last few keys, so a has pass-throughs
+    b_vals = np.concatenate(
+        [_chunk_values("run", k, rng) for k in range(n - 4, n + 4)]
+    )
+    b = RoaringBitmap(b_vals)
+    b.run_optimize()
+    b_before = b.clone()
+    passthrough = a.high_low_container.containers[0]
+    ref = RoaringBitmap.or_(a, b)
+    a.ior(b)
+    assert a == ref
+    assert a.high_low_container.containers[0] is passthrough  # transferred
+    assert b == b_before
+    # static path must NOT transfer: x1 stays usable
+    a2 = _typed_bitmap(["array", "run"] * (n // 2), rng)
+    keep = a2.high_low_container.containers[0]
+    out = RoaringBitmap.xor(a2, b)
+    assert out.high_low_container.containers[0] is not keep
+    a3 = a2.clone()
+    a3.ixor(b)
+    a4 = a2.clone()
+    a4.iandnot(b)
+    with columnar.disabled():
+        assert a3 == RoaringBitmap.xor(a2, b)
+        assert a4 == RoaringBitmap.andnot(a2, b)
+
+
+def test_ior_not_tail_passthrough_transfer():
+    """ior_not transfers self's beyond-range chunks unclone'd (member-op
+    semantics), value-equal to the static or_not."""
+    a = RoaringBitmap([1, 5, (40 << 16) | 3])
+    b = RoaringBitmap([5, 6])
+    tail = a.high_low_container.containers[-1]
+    want = RoaringBitmap.or_not(a.clone(), b, 1 << 10)
+    a.ior_not(b, 1 << 10)
+    assert a == want
+    assert a.high_low_container.containers[-1] is tail
+
+
+def test_mapped_operands_route_columnar():
+    rng = np.random.default_rng(11)
+    n = columnar.config.min_containers + 2
+    a = _typed_bitmap(["array", "run"] * n, rng)
+    b = _typed_bitmap(["run", "array"] * n, rng)
+    mapped = ImmutableRoaringBitmap(b.serialize())
+    for op, ref in OPS.items():
+        with columnar.disabled():
+            want = ref(a, b)
+        assert ref(a, mapped) == want, op
+    with columnar.disabled():
+        want_c = RoaringBitmap.and_cardinality(a, b)
+    assert RoaringBitmap.and_cardinality(a, mapped) == want_c
+
+
+def test_fold_parity_and_type_preserving_singles():
+    """Columnar fold == pooled word fold == naive engines; single-container
+    groups pass through as type-preserving clones (run stays run)."""
+    rng = np.random.default_rng(29)
+    bms = [_typed_bitmap(["run", "array", "bitmap"], rng) for _ in range(6)]
+    solo = RoaringBitmap(_chunk_values("run", 40, rng))
+    solo.run_optimize()
+    bms.append(solo)
+    groups = store.group_by_key(bms)
+    got = columnar.fold(groups, "or")
+    assert got == FastAggregation.naive_or(*bms)
+    assert got == FastAggregation.horizontal_or(*bms)
+    # key 40 exists only in solo -> its run container must stay a run
+    c = got.high_low_container.get_container(40)
+    assert isinstance(c, RunContainer)
+    assert columnar.fold(groups, "xor") == FastAggregation.naive_xor(*bms)
+
+
+def test_cpu_aggregation_routes_columnar():
+    """FastAggregation/ParallelAggregation CPU folds route through the
+    columnar fold above min_fold_rows and stay equal to the naive fold."""
+    from roaringbitmap_tpu.parallel.aggregation import ParallelAggregation
+
+    rng = np.random.default_rng(31)
+    bms = [
+        RoaringBitmap(
+            np.concatenate(
+                [_chunk_values("array", k, rng) for k in range(24)]
+            )
+        )
+        for _ in range(6)
+    ]  # 144 rows >= min_fold_rows
+    want = FastAggregation.naive_or(*bms)
+    before = insights.columnar_counters()["batch"].get("fold_or/rows", 0)
+    assert FastAggregation.or_(*bms, mode="cpu") == want
+    assert ParallelAggregation.or_(*bms, mode="cpu") == want
+    after = insights.columnar_counters()["batch"].get("fold_or/rows", 0)
+    assert after > before
+    assert FastAggregation.and_(*bms, mode="cpu") == FastAggregation.naive_and(*bms)
+    assert FastAggregation.xor(*bms, mode="cpu") == FastAggregation.naive_xor(*bms)
+
+
+def test_query_kernel_cpu_fallback_uses_columnar_union():
+    """andnot_nway's CPU path (subtrahend union) equals the composed
+    reference on working sets large enough to take or_fold_words."""
+    from roaringbitmap_tpu.query import kernels as qk
+
+    rng = np.random.default_rng(37)
+    first = _typed_bitmap(["array"] * 30, rng)
+    rest = [_typed_bitmap(["array", "run"] * 15, rng) for _ in range(4)]
+    got = qk.andnot_nway(first, *rest, mode="cpu")
+    want = RoaringBitmap.andnot(first, FastAggregation.or_(*rest, mode="cpu"))
+    assert got == want
+    assert qk.andnot_nway_cardinality(first, *rest, mode="cpu") == want.get_cardinality()
+
+
+def test_interval_batch_edges():
+    """Full-range runs, touching array-born singletons, and the cards-only
+    path of the banded interval kernel."""
+    full = RunContainer(np.array([0], np.uint16), np.array([0xFFFF], np.uint16))
+    arr = ArrayContainer(np.array([0, 1, 2, 65535], np.uint16))
+    a = RoaringBitmap()
+    b = RoaringBitmap()
+    for k in range(columnar.config.min_containers):
+        a.high_low_container.append(k, full.clone())
+        b.high_low_container.append(k, arr.clone())
+    for op, ref in OPS.items():
+        with columnar.disabled():
+            want = ref(a, b)
+        assert columnar.pairwise(op, a, b) == want, op
+        assert columnar.pairwise(op, b, a) == ref(b, a), op
+    assert (
+        columnar.and_cardinality_pair(a, b)
+        == columnar.config.min_containers * 4
+    )
+
+
+def test_columnar_counters_shape():
+    rng = np.random.default_rng(41)
+    a = _typed_bitmap(["array"] * 20, rng)
+    columnar.pairwise("and", a, a.clone())
+    snap = insights.columnar_counters()
+    assert set(snap) == {"batch"}
+    assert snap["batch"].get("and/aa", 0) >= 20
+    for key in snap["batch"]:
+        op, klass = key.split("/")
+        assert klass in columnar.CLASS_NAMES or klass == "rows"
+
+
+def test_dense_chunking():
+    """The word-matrix classes honor config.chunk_rows (bounded peak
+    memory) without changing results."""
+    rng = np.random.default_rng(43)
+    a = _typed_bitmap(["bitmap"] * 24, rng)
+    b = _typed_bitmap(["bitmap"] * 24, rng)
+    old = columnar.config.chunk_rows
+    columnar.config.chunk_rows = 5  # force many chunks
+    try:
+        for op, ref in OPS.items():
+            with columnar.disabled():
+                want = ref(a, b)
+            assert columnar.pairwise(op, a, b) == want, op
+    finally:
+        columnar.config.chunk_rows = old
+
+
+def test_pairwise_results_are_independent_buffers():
+    """Batched results must not alias the shared scratch: mutating one
+    result cannot corrupt a sibling."""
+    rng = np.random.default_rng(47)
+    a = _typed_bitmap(["array"] * 20, rng)
+    b = _typed_bitmap(["array"] * 20, rng)
+    out = columnar.pairwise("or", a, b)
+    c0 = out.high_low_container.containers[0]
+    before = out.high_low_container.containers[1].to_array().copy()
+    for v in range(200):
+        c0.add(v)
+    assert np.array_equal(out.high_low_container.containers[1].to_array(), before)
+
+
+def test_fuzz_family_smoke():
+    from roaringbitmap_tpu import fuzz
+
+    fuzz.verify_columnar_invariance("columnar-vs-percontainer", iterations=25, seed=54)
