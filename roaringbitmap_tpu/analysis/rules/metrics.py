@@ -5,7 +5,8 @@ The registry's convention (observe/registry.py) is ``rb_tpu_<layer>_<name>``
 so a Prometheus scrape of a fleet is groupable by layer; a stray prefix or
 a computed label tuple silently forks the namespace. Checked per
 registration call (``observe.counter(...)`` / ``_observe.gauge(...)`` /
-``_registry.histogram(...)`` / ``REGISTRY.counter(...)``):
+``_registry.histogram(...)`` / ``observe.latency_histogram(...)`` /
+``REGISTRY.counter(...)``):
 
 * a literal name must start with ``rb_tpu_``;
 * an ALL_CAPS constant reference is accepted when it is either defined in
@@ -17,7 +18,11 @@ registration call (``observe.counter(...)`` / ``_observe.gauge(...)`` /
 * ``labelnames`` (3rd positional or keyword) must be a literal tuple/list
   of string literals (or absent);
 * any module-level ``ALL_CAPS = "rb..."`` string constant must start with
-  ``rb_tpu_`` (this is what validates registry.py's canonical names).
+  ``rb_tpu_`` (this is what validates registry.py's canonical names);
+* **latency histograms** (``latency_histogram(...)``, ISSUE 6) measure
+  seconds and must carry the ``_seconds`` unit suffix — a literal or
+  in-file constant is validated directly, a cross-module constant must be
+  ``*_SECONDS``-shaped so the defining module's check covers it.
 
 Forwarding wrappers (a call whose name argument is the enclosing
 function's own ``name`` parameter, e.g. the module-level ``counter()``
@@ -34,7 +39,9 @@ from typing import Dict, Iterable, Optional, Set
 from ..core import Checker, FileContext, Finding, dotted_name, register
 
 PREFIX = "rb_tpu_"
-_REG_METHODS = {"counter", "gauge", "histogram"}
+_REG_METHODS = {"counter", "gauge", "histogram", "latency_histogram"}
+# registration methods whose metrics measure seconds (unit suffix required)
+_SECONDS_METHODS = {"latency_histogram"}
 _ALL_CAPS = re.compile(r"^[A-Z][A-Z0-9_]*$")
 # constant names that read as canonical metric names (unit-suffixed)
 _SHAPED_CONST = re.compile(r"^[A-Z][A-Z0-9_]*_(TOTAL|SECONDS|BYTES|COUNT)$")
@@ -141,10 +148,15 @@ class MetricNaming(Checker):
                 and fwd.id in _enclosing_function_params(spans, node)
             ):
                 continue
-            yield from self._check_name(ctx, node, name_arg, constants)
+            yield from self._check_name(
+                ctx, node, name_arg, constants,
+                needs_seconds=tail in _SECONDS_METHODS,
+            )
             yield from self._check_labels(ctx, node)
 
-    def _check_name(self, ctx, call, name_arg, constants) -> Iterable[Finding]:
+    def _check_name(
+        self, ctx, call, name_arg, constants, needs_seconds=False
+    ) -> Iterable[Finding]:
         if isinstance(name_arg, ast.Constant) and isinstance(name_arg.value, str):
             if not name_arg.value.startswith(PREFIX):
                 yield self.finding(
@@ -152,6 +164,13 @@ class MetricNaming(Checker):
                     call,
                     f"metric name {name_arg.value!r} must start with "
                     f"{PREFIX!r} (rb_tpu_<layer>_<name> convention)",
+                )
+            if needs_seconds and not name_arg.value.endswith("_seconds"):
+                yield self.finding(
+                    ctx,
+                    call,
+                    f"latency histogram {name_arg.value!r} must end in "
+                    "'_seconds' (latency histograms measure seconds)",
                 )
             return
         term = dotted_name(name_arg)
@@ -167,6 +186,23 @@ class MetricNaming(Checker):
                         f"metric registered under constant {term} = {val!r} "
                         f"which lacks the {PREFIX!r} prefix",
                     )
+                if needs_seconds and not val.endswith("_seconds"):
+                    yield self.finding(
+                        ctx,
+                        call,
+                        f"latency histogram registered under constant {term} "
+                        f"= {val!r} which lacks the '_seconds' unit suffix",
+                    )
+            elif needs_seconds and not term.endswith("_SECONDS"):
+                # cross-module latency constants must be _SECONDS-shaped so
+                # the defining module's value check enforces the suffix
+                yield self.finding(
+                    ctx,
+                    call,
+                    f"latency histogram name constant {term} is not "
+                    "_SECONDS-shaped: the '_seconds' suffix cannot be "
+                    "verified",
+                )
             elif not _SHAPED_CONST.match(term):
                 # cross-module constants are accepted only when the NAME is
                 # metric-shaped — that shape is exactly what the

@@ -21,6 +21,7 @@ extended here to xor/andnot), cloned validation-free otherwise.
 
 from __future__ import annotations
 
+import functools
 import os
 import threading
 from contextlib import contextmanager
@@ -29,6 +30,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from .. import observe as _observe
+from ..observe import timeline as _timeline
 from ..models.container import (
     ARRAY_MAX_SIZE,
     ArrayContainer,
@@ -86,6 +88,44 @@ _COLUMNAR_TOTAL = _observe.counter(
     "Columnar batched container-pairs by op and (array|bitmap|run)^2 class",
     ("op", "class"),
 )
+# per-class kernel latency (ISSUE 6): one series per (op, execution-class
+# bucket) — the flight recorder shows each bucket as a named span when
+# RB_TPU_TIMELINE is active
+_CLASS_SECONDS = _observe.latency_histogram(
+    _observe.COLUMNAR_CLASS_SECONDS,
+    "Wall time of columnar per-class batch kernels by op and execution "
+    "class (aa | runs | gather | interval | dense | clear | fold | "
+    "fold_words)",
+    ("op", "class"),
+)
+
+
+def _kernel_stage(op: str, klass: str, n_pairs: int) -> "_timeline.stage":
+    return _timeline.stage(
+        _CLASS_SECONDS, (op, klass), "columnar." + klass, cat="columnar",
+        op=op, pairs=n_pairs,
+    )
+
+
+def _timed_fill(klass: str, idx_pos: int, op_pos: Optional[int] = 0):
+    """Wrap a ``_fill_*`` class executor so each non-empty batch records a
+    per-class kernel span + latency sample. ``idx_pos``/``op_pos`` locate
+    the pair-index array and op name in the positional args (``op_pos``
+    None = the executor is andnot-only)."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args):
+            idx = args[idx_pos]
+            if idx.size == 0:
+                return
+            op = args[op_pos] if op_pos is not None else "andnot"
+            with _kernel_stage(op, klass, int(idx.size)):
+                return fn(*args)
+
+        return wrapper
+
+    return deco
 
 
 # per-thread disable depth: disabled() must not flip process-global state
@@ -156,6 +196,7 @@ def _record(op: str, codes_a: np.ndarray, codes_b: np.ndarray) -> None:
 # ---------------------------------------------------------------------------
 
 
+@_timed_fill("aa", 3)
 def _fill_aa(
     op: str, acs, bcs, idx: np.ndarray, results: List[Optional[Container]]
 ) -> None:
@@ -194,6 +235,7 @@ def _gather_mask(probe_cs, dense_cs, idx: np.ndarray, dense_is_run: bool):
     return vals, offs, kernels.member_mask(rows_mat, row_ids, vals)
 
 
+@_timed_fill("gather", 3)
 def _fill_gather(
     op: str, probe_cs, dense_cs, idx: np.ndarray, results, dense_is_run: bool
 ) -> None:
@@ -216,6 +258,7 @@ def _fill_gather(
             results[i] = _wrap_u16(kept[s : s + n].copy())
 
 
+@_timed_fill("runs", 3)
 def _fill_runs_native(op: str, acs, bcs, idx: np.ndarray, results) -> None:
     """All bitmap-free classes (aa/ar/ra/rr) of and/andnot through ONE
     native call: payloads unify as CSR run lists (arrays are length-0
@@ -274,6 +317,7 @@ def _fill_runs_native(op: str, acs, bcs, idx: np.ndarray, results) -> None:
             pos += card
 
 
+@_timed_fill("interval", 3)
 def _fill_interval(op: str, acs, bcs, idx: np.ndarray, results) -> None:
     """run x run (plus andnot's run-minus-array), numpy tier: the banded
     interval-algebra batch — no word expansion, one global sort for the
@@ -312,6 +356,7 @@ def _build_words_results(
             results[i] = BitmapContainer(mat[j].copy(), card)
 
 
+@_timed_fill("dense", 3)
 def _fill_dense(
     op: str, acs, bcs, idx: np.ndarray, results
 ) -> None:
@@ -344,6 +389,7 @@ def _fill_dense(
         _build_words_results(mat, chunk_l, results)
 
 
+@_timed_fill("clear", 2, op_pos=None)
 def _fill_clear(acs, bcs, idx: np.ndarray, results) -> None:
     """andnot with a dense left and array right: expand the left, scatter-
     CLEAR the right's values out of it in one batched pass."""
@@ -584,46 +630,47 @@ def fold(groups: Dict[int, List[Container]], op: str) -> RoaringBitmap:
     hlc = out.high_low_container
     results: Dict[int, Optional[Container]] = {}
     if multi_keys:
-        if op in ("or", "xor"):
-            mat = np.zeros(
-                (len(multi_keys), bits.WORDS_PER_CONTAINER), dtype=np.uint64
-            )
-            row_ids = np.repeat(
-                np.arange(len(multi_keys), dtype=np.int64),
-                np.fromiter((len(cs) for cs in multi_cs), np.int64, len(multi_cs)),
-            )
-            flat = [c for cs in multi_cs for c in cs]
-            scatter_containers(mat, row_ids, flat, op=op)
-        else:  # and: expand + reduceat, chunked by row budget
-            mats: List[np.ndarray] = []
-            step = max(1, config.chunk_rows)
-            gi = 0
-            while gi < len(multi_keys):
-                ge, rows = gi, 0
-                while ge < len(multi_keys) and (
-                    rows == 0 or rows + len(multi_cs[ge]) <= step
-                ):
-                    rows += len(multi_cs[ge])
-                    ge += 1
-                chunk_cs = [c for cs in multi_cs[gi:ge] for c in cs]
-                rows_mat = expand_rows(
-                    chunk_cs, np.arange(len(chunk_cs), dtype=np.int64)
+        with _kernel_stage(op, "fold", n_rows):
+            if op in ("or", "xor"):
+                mat = np.zeros(
+                    (len(multi_keys), bits.WORDS_PER_CONTAINER), dtype=np.uint64
                 )
-                starts = np.concatenate(
-                    ([0], np.cumsum([len(cs) for cs in multi_cs[gi:ge]]))
-                )[:-1]
-                mats.append(np.bitwise_and.reduceat(rows_mat, starts, axis=0))
-                gi = ge
-            mat = np.concatenate(mats, axis=0)
-        cards = kernels.popcount_rows(mat).tolist()
-        for j, k in enumerate(multi_keys):
-            card = cards[j]
-            if card == 0:
-                results[k] = None
-            elif card <= ARRAY_MAX_SIZE:
-                results[k] = _wrap_u16(bits.values_from_words(mat[j]))
-            else:
-                results[k] = BitmapContainer(mat[j].copy(), card)
+                row_ids = np.repeat(
+                    np.arange(len(multi_keys), dtype=np.int64),
+                    np.fromiter((len(cs) for cs in multi_cs), np.int64, len(multi_cs)),
+                )
+                flat = [c for cs in multi_cs for c in cs]
+                scatter_containers(mat, row_ids, flat, op=op)
+            else:  # and: expand + reduceat, chunked by row budget
+                mats: List[np.ndarray] = []
+                step = max(1, config.chunk_rows)
+                gi = 0
+                while gi < len(multi_keys):
+                    ge, rows = gi, 0
+                    while ge < len(multi_keys) and (
+                        rows == 0 or rows + len(multi_cs[ge]) <= step
+                    ):
+                        rows += len(multi_cs[ge])
+                        ge += 1
+                    chunk_cs = [c for cs in multi_cs[gi:ge] for c in cs]
+                    rows_mat = expand_rows(
+                        chunk_cs, np.arange(len(chunk_cs), dtype=np.int64)
+                    )
+                    starts = np.concatenate(
+                        ([0], np.cumsum([len(cs) for cs in multi_cs[gi:ge]]))
+                    )[:-1]
+                    mats.append(np.bitwise_and.reduceat(rows_mat, starts, axis=0))
+                    gi = ge
+                mat = np.concatenate(mats, axis=0)
+            cards = kernels.popcount_rows(mat).tolist()
+            for j, k in enumerate(multi_keys):
+                card = cards[j]
+                if card == 0:
+                    results[k] = None
+                elif card <= ARRAY_MAX_SIZE:
+                    results[k] = _wrap_u16(bits.values_from_words(mat[j]))
+                else:
+                    results[k] = BitmapContainer(mat[j].copy(), card)
     for k in keys:
         c = singles[k].clone() if k in singles else results[k]
         if c is not None and c.cardinality:
@@ -641,8 +688,9 @@ def or_fold_words(groups: Dict[int, List[Container]]) -> Dict[int, np.ndarray]:
         return {}
     counts = np.fromiter((len(groups[k]) for k in keys), np.int64, len(keys))
     _COLUMNAR_TOTAL.inc(int(counts.sum()), labels=("fold_or", "rows"))
-    mat = np.zeros((len(keys), bits.WORDS_PER_CONTAINER), dtype=np.uint64)
-    row_ids = np.repeat(np.arange(len(keys), dtype=np.int64), counts)
-    flat = [c for k in keys for c in groups[k]]
-    scatter_containers(mat, row_ids, flat, op="or")
-    return {k: mat[g] for g, k in enumerate(keys)}
+    with _kernel_stage("or", "fold_words", int(counts.sum())):
+        mat = np.zeros((len(keys), bits.WORDS_PER_CONTAINER), dtype=np.uint64)
+        row_ids = np.repeat(np.arange(len(keys), dtype=np.int64), counts)
+        flat = [c for k in keys for c in groups[k]]
+        scatter_containers(mat, row_ids, flat, op="or")
+        return {k: mat[g] for g, k in enumerate(keys)}

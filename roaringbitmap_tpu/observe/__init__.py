@@ -21,6 +21,7 @@ from .registry import (
     ANALYSIS_FINDINGS_TOTAL,
     BATCH_PAIRWISE_TOTAL,
     COLUMNAR_BATCH_TOTAL,
+    COLUMNAR_CLASS_SECONDS,
     DEFAULT_TIME_BUCKETS,
     HOST_OP_SECONDS,
     KERNEL_DISPATCH_TOTAL,
@@ -31,13 +32,18 @@ from .registry import (
     PACK_CACHE_MISSES_TOTAL,
     PACK_CACHE_RESIDENT_BYTES,
     QUERY_CACHE_TOTAL,
+    QUERY_LATENCY_SECONDS,
     QUERY_PLAN_TOTAL,
     REGISTRY,
     SERIAL_BYTES_TOTAL,
     SPAN_SECONDS,
+    STORE_DELTA_STAGE_SECONDS,
     STORE_LAYOUT_TOTAL,
+    STORE_PACK_STAGE_SECONDS,
     STORE_RESIDENT_BYTES,
     STORE_TRANSFER_BYTES_TOTAL,
+    TIMELINE_ANOMALY_TOTAL,
+    TIMELINE_SPAN_SECONDS,
     Counter,
     Gauge,
     Histogram,
@@ -50,7 +56,21 @@ from .registry import (
     snapshot,
 )
 from .compat import CounterMap
+from .histogram import (
+    DEFAULT_LATENCY_BUCKETS,
+    SNAPSHOT_QUANTILES,
+    LatencyHistogram,
+    latency_histogram,
+    log_time_buckets,
+)
+from . import timeline
+from .timeline import FlightRecorder, TimelineEvent
 from .spans import current_path, depth, reset_spans, span, span_timings
+
+# the .histogram submodule import above shadows the registration helper on
+# the package namespace; re-bind the helper (the submodule stays reachable
+# as roaringbitmap_tpu.observe.histogram via sys.modules)
+from .registry import histogram
 from .export import (
     SIDECAR_SCHEMA,
     jsonl_lines,
@@ -68,11 +88,17 @@ __all__ = [
     "Counter",
     "Gauge",
     "Histogram",
+    "LatencyHistogram",
+    "FlightRecorder",
+    "TimelineEvent",
     "MetricError",
     "CounterMap",
     "counter",
     "gauge",
     "histogram",
+    "latency_histogram",
+    "log_time_buckets",
+    "timeline",
     "snapshot",
     "reset",
     "span",
@@ -89,6 +115,8 @@ __all__ = [
     "write_prometheus",
     "SIDECAR_SCHEMA",
     "DEFAULT_TIME_BUCKETS",
+    "DEFAULT_LATENCY_BUCKETS",
+    "SNAPSHOT_QUANTILES",
     "KERNEL_DISPATCH_TOTAL",
     "KERNEL_PROBE_TOTAL",
     "STORE_LAYOUT_TOTAL",
@@ -107,4 +135,10 @@ __all__ = [
     "QUERY_CACHE_TOTAL",
     "QUERY_PLAN_TOTAL",
     "ANALYSIS_FINDINGS_TOTAL",
+    "TIMELINE_SPAN_SECONDS",
+    "TIMELINE_ANOMALY_TOTAL",
+    "STORE_PACK_STAGE_SECONDS",
+    "STORE_DELTA_STAGE_SECONDS",
+    "QUERY_LATENCY_SECONDS",
+    "COLUMNAR_CLASS_SECONDS",
 ]

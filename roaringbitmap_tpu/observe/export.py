@@ -31,6 +31,7 @@ import time
 from typing import Iterator, List, Optional
 
 from . import registry as _registry
+from .histogram import SNAPSHOT_QUANTILES, LatencyHistogram
 from .registry import Histogram, Registry, format_le
 
 SIDECAR_SCHEMA = "rb_tpu_metrics/1"
@@ -53,6 +54,8 @@ def jsonl_lines(registry: Optional[Registry] = None) -> List[str]:
             rec = {"name": name, "type": m["type"], "labels": s["labels"]}
             if m["type"] == "histogram":
                 rec.update(count=s["count"], sum=s["sum"], buckets=s["buckets"])
+                if "quantiles" in s:  # latency histograms publish p50/p90/p99
+                    rec["quantiles"] = s["quantiles"]
             else:
                 rec["value"] = s["value"]
             lines.append(json.dumps(rec, sort_keys=True))
@@ -109,6 +112,16 @@ def prometheus_text(registry: Optional[Registry] = None) -> str:
                 )
                 out.append(f"{m.name}_sum{_label_str(labels)} {st['sum']}")
                 out.append(f"{m.name}_count{_label_str(labels)} {st['count']}")
+                if isinstance(m, LatencyHistogram):
+                    # summary-style quantile convenience samples next to the
+                    # buckets (our own exporter's extension; scrapers that
+                    # only understand TYPE histogram ignore them)
+                    for q in SNAPSHOT_QUANTILES:
+                        q_attr = 'quantile="%g"' % q
+                        out.append(
+                            f"{m.name}{_label_str(labels, q_attr)} "
+                            f"{m._quantile_of_state(st, q)}"
+                        )
         else:
             for lv, v in sorted(m.series().items()):
                 labels = dict(zip(m.labelnames, lv))
@@ -155,6 +168,28 @@ def _histogram_timings(snap: dict, name: str) -> dict:
     return out
 
 
+def _latency_summaries(registry: Registry) -> dict:
+    """{metric: {label-values (/-joined): {count, sum, p50, p90, p99}}} for
+    every latency histogram — the sidecar's quantile table (the schema gate
+    in scripts/ci.sh checks the pack/delta stage rows here)."""
+    out: dict = {}
+    for m in registry.metrics():
+        if not isinstance(m, LatencyHistogram):
+            continue
+        series = {}
+        for lv, st in sorted(m.series().items()):
+            series["/".join(lv)] = {
+                "count": st["count"],
+                "sum": round(st["sum"], 6),
+                **{
+                    "p%g" % (q * 100): round(m._quantile_of_state(st, q), 6)
+                    for q in SNAPSHOT_QUANTILES
+                },
+            }
+        out[m.name] = series
+    return out
+
+
 def sidecar_snapshot(registry: Optional[Registry] = None) -> dict:
     """The structured summary the bench sidecar persists. Top-level keys
     ``kernel``/``layout``/``transfer_bytes``/``spans`` are the contract
@@ -172,6 +207,7 @@ def sidecar_snapshot(registry: Optional[Registry] = None) -> dict:
         "probes": _counter_map(snap, _registry.KERNEL_PROBE_TOTAL, joined=True),
         "timings": _histogram_timings(snap, _registry.HOST_OP_SECONDS),
         "spans": _histogram_timings(snap, _registry.SPAN_SECONDS),
+        "latency": _latency_summaries(_reg(registry)),
         "registry": snap,
     }
 

@@ -54,6 +54,14 @@ SPAN_SECONDS = "rb_tpu_span_seconds"
 QUERY_CACHE_TOTAL = "rb_tpu_query_cache_total"
 QUERY_PLAN_TOTAL = "rb_tpu_query_plan_total"
 ANALYSIS_FINDINGS_TOTAL = "rb_tpu_analysis_findings_total"
+# timeline / latency instrumentation (ISSUE 6): the flight recorder's span
+# feed plus the per-stage latency histograms over the marshal pipeline
+TIMELINE_SPAN_SECONDS = "rb_tpu_timeline_span_seconds"
+TIMELINE_ANOMALY_TOTAL = "rb_tpu_timeline_anomaly_total"
+STORE_PACK_STAGE_SECONDS = "rb_tpu_store_pack_stage_seconds"
+STORE_DELTA_STAGE_SECONDS = "rb_tpu_store_delta_stage_seconds"
+QUERY_LATENCY_SECONDS = "rb_tpu_query_latency_seconds"
+COLUMNAR_CLASS_SECONDS = "rb_tpu_columnar_class_seconds"
 
 # upper bucket bounds (seconds) for wall-time histograms: host phases span
 # ~100 µs packing steps to multi-second CPU folds; +Inf is implicit
@@ -212,6 +220,18 @@ class Histogram(_Metric):
                 for k, st in self._series.items()
             }
 
+    def _sample_dict(self, st: dict) -> dict:
+        """The snapshot sample for one series state: count/sum plus the
+        cumulative Prometheus ``le`` bucket map. Subclasses (the latency
+        histogram) extend this — snapshot() delegates here so every
+        exporter sees their extra keys with no exporter changes."""
+        cum, buckets = 0, {}
+        for le, n in zip(self.buckets, st["slots"]):
+            cum += n
+            buckets[format_le(le)] = cum
+        buckets["+Inf"] = st["count"]
+        return {"count": st["count"], "sum": st["sum"], "buckets": buckets}
+
     def _same_definition(self, other) -> bool:
         return super()._same_definition(other) and self.buckets == other.buckets
 
@@ -271,19 +291,7 @@ class Registry:
             for lv, st in sorted(m.series().items()):
                 labels = dict(zip(m.labelnames, lv))
                 if isinstance(m, Histogram):
-                    cum, buckets = 0, {}
-                    for le, n in zip(m.buckets, st["slots"]):
-                        cum += n
-                        buckets[format_le(le)] = cum
-                    buckets["+Inf"] = st["count"]
-                    samples.append(
-                        {
-                            "labels": labels,
-                            "count": st["count"],
-                            "sum": st["sum"],
-                            "buckets": buckets,
-                        }
-                    )
+                    samples.append({"labels": labels, **m._sample_dict(st)})
                 else:
                     samples.append({"labels": labels, "value": st})
             out[m.name] = {

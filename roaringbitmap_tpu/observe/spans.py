@@ -28,6 +28,7 @@ import time
 from typing import Iterator, List
 
 from . import registry as _registry
+from . import timeline as _timeline
 
 SPAN_SECONDS = _registry.histogram(
     _registry.SPAN_SECONDS,
@@ -73,13 +74,18 @@ def span(name: str, trace: bool = False) -> Iterator[str]:
     stack = _stack()
     stack.append(name)
     path = "/".join(stack)
-    t0 = time.perf_counter()
+    t0_ns = time.perf_counter_ns()
     try:
         with ctx:
             yield path
     finally:
         stack.pop()
-        SPAN_SECONDS.observe(time.perf_counter() - t0, (path,))
+        dur_ns = time.perf_counter_ns() - t0_ns
+        SPAN_SECONDS.observe(dur_ns / 1e9, (path,))
+        # mirror into the flight recorder (ISSUE 6) so every pre-existing
+        # op_timer/span block appears on the timeline with no new wiring
+        if _timeline.enabled():
+            _timeline._record_complete(name, "span", t0_ns, dur_ns, None)
 
 
 def span_timings() -> dict:

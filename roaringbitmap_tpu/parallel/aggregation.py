@@ -42,6 +42,7 @@ from ..models.container import (
     best_container_of_words,
 )
 from ..models.roaring import RoaringBitmap
+from ..observe import timeline as _timeline
 from ..utils import bits
 from . import store
 
@@ -152,12 +153,15 @@ def _device_aggregate(
     only its dirty rows. The pack is op-independent (fill values live in
     the per-layout caches), so OR/XOR/AND-cardinality over the same
     bitmaps share one resident entry."""
-    packed = store.packed_for(bitmaps, keys_filter)
-    if config.mesh is not None:
-        words, cards = _sharded_reduce(packed, op)
-    else:
-        words, cards = store.reduce_packed(packed, op=op)
-    return store.unpack_to_bitmap(packed.group_keys, words, cards)
+    with _timeline.tspan(
+        "agg.device", "agg", trace=True, op=op, n=len(bitmaps)
+    ):
+        packed = store.packed_for(bitmaps, keys_filter)
+        if config.mesh is not None:
+            words, cards = _sharded_reduce(packed, op)
+        else:
+            words, cards = store.reduce_packed(packed, op=op)
+        return store.unpack_to_bitmap(packed.group_keys, words, cards)
 
 
 def _sharded_reduce(packed: "store.PackedGroups", op: str, cards_only: bool = False):
@@ -233,7 +237,8 @@ def _aggregate(
     if _use_device(n, mode):
         return _device_aggregate(bitmaps, keys, op)
     groups = store.group_by_key(bitmaps, keys_filter=keys)
-    return _cpu_aggregate(groups, op, pool=pool)
+    with _timeline.tspan("agg.cpu", "agg", op=op, rows=n):
+        return _cpu_aggregate(groups, op, pool=pool)
 
 
 # ---------------------------------------------------------------------------
@@ -549,4 +554,7 @@ class ParallelAggregation:
         if _use_device(n, mode):
             return _device_aggregate(bitmaps, None, op)
         groups = store.group_by_key(bitmaps)
-        return _cpu_aggregate(groups, op, pool=ParallelAggregation._shared_pool())
+        with _timeline.tspan("agg.cpu", "agg", op=op, rows=n):
+            return _cpu_aggregate(
+                groups, op, pool=ParallelAggregation._shared_pool()
+            )

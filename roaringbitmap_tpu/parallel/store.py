@@ -28,6 +28,25 @@ import numpy as np
 import jax.numpy as jnp
 
 from .. import observe as _observe
+from ..observe import timeline as _timeline
+
+# marshal stage attribution (ISSUE 6): every pack / delta-repack stage
+# lands in a log-bucketed latency histogram (p50/p99 in every export) and,
+# when RB_TPU_TIMELINE is active, in the flight recorder — the named,
+# summable decomposition bench.py's BENCH_TIMELINE.json is built from
+_PACK_STAGE_SECONDS = _observe.latency_histogram(
+    _observe.STORE_PACK_STAGE_SECONDS,
+    "Wall time of marshal pack stages (key_plan | group_tables | "
+    "host_words | provenance | dense_pad_plan | ship | padded_build | "
+    "bucket_build)",
+    ("stage",),
+)
+_DELTA_STAGE_SECONDS = _observe.latency_histogram(
+    _observe.STORE_DELTA_STAGE_SECONDS,
+    "Wall time of incremental delta-repack stages (dirty_scan | "
+    "host_rows | scatter | republish)",
+    ("stage",),
+)
 
 # layout observability: ("padded"|"bucketed"|"segmented-scan") -> count.
 # Registry-backed since ISSUE 1 (rb_tpu_store_layout_total); the CounterMap
@@ -111,7 +130,9 @@ def pack_rows_host(containers: Sequence[Container]) -> np.ndarray:
     from .. import tracing
 
     n = len(containers)
-    with tracing.op_timer("store.pack_rows_host"):
+    with tracing.op_timer("store.pack_rows_host"), _timeline.stage(
+        _PACK_STAGE_SECONDS, "host_words", "pack.host_words", cat="pack", rows=n
+    ):
         return _pack_rows_host(containers, n)
 
 
@@ -249,15 +270,24 @@ class PackedGroups:
         unspecified transient results — that race exists at the bitmap
         level already.)"""
         object.__setattr__(self, "_layout_epoch", self._epoch() + 1)
-        self.words[rows] = new_words_u32
-        d = getattr(self, "_device_words", None)
-        if d is not None:
-            delta = jnp.asarray(new_words_u32)
-            object.__setattr__(
-                self, "_device_words", d.at[jnp.asarray(rows)].set(delta)
-            )
-            _TRANSFER_TOTAL.inc(int(new_words_u32.nbytes), ("pack_delta",))
-        self._drop_derived()
+        with _timeline.stage(
+            _DELTA_STAGE_SECONDS, "scatter", "delta.scatter", cat="delta",
+            rows=len(rows), bytes=int(new_words_u32.nbytes),
+        ):
+            self.words[rows] = new_words_u32
+            d = getattr(self, "_device_words", None)
+            if d is not None:
+                delta = jnp.asarray(new_words_u32)
+                object.__setattr__(
+                    self,
+                    "_device_words",
+                    _timeline.fence(d.at[jnp.asarray(rows)].set(delta)),
+                )
+                _TRANSFER_TOTAL.inc(int(new_words_u32.nbytes), ("pack_delta",))
+        with _timeline.stage(
+            _DELTA_STAGE_SECONDS, "republish", "delta.republish", cat="delta"
+        ):
+            self._drop_derived()
 
     def __enter__(self) -> "PackedGroups":
         return self
@@ -285,7 +315,11 @@ class PackedGroups:
         d = getattr(self, "_device_words", None)
         if d is None:
             epoch = self._epoch()
-            d = jnp.asarray(self.words)
+            with _timeline.stage(
+                _PACK_STAGE_SECONDS, "ship", "pack.ship", cat="pack",
+                bytes=int(self.words.nbytes),
+            ):
+                d = _timeline.fence(jnp.asarray(self.words))
             if self._epoch() != epoch:
                 return d  # raced a delta repack: do not publish
             _TRANSFER_TOTAL.inc(self.words.nbytes, ("flat_rows",))
@@ -315,22 +349,32 @@ class PackedGroups:
             if plan is None:  # the shared skew guard
                 cache[key] = None
             elif jax.default_backend() != "cpu":
-                m, slots = plan
-                flat = self.device_words  # one cached ship
-                src_map = np.full(g * m, n, dtype=np.int64)
-                src_map[slots] = np.arange(n)
-                arr = jnp.take(
-                    flat, jnp.asarray(src_map), axis=0, mode="fill",
-                    fill_value=np.uint32(fill),
-                ).reshape(g, m, dev.DEVICE_WORDS)
+                with _timeline.stage(
+                    _PACK_STAGE_SECONDS, "padded_build", "pack.padded_build",
+                    cat="pack", groups=g, on_device=1,
+                ):
+                    m, slots = plan
+                    flat = self.device_words  # one cached ship
+                    src_map = np.full(g * m, n, dtype=np.int64)
+                    src_map[slots] = np.arange(n)
+                    arr = _timeline.fence(
+                        jnp.take(
+                            flat, jnp.asarray(src_map), axis=0, mode="fill",
+                            fill_value=np.uint32(fill),
+                        ).reshape(g, m, dev.DEVICE_WORDS)
+                    )
                 if self._epoch() != epoch:
                     return arr  # raced a delta repack: do not publish
                 _TRANSFER_TOTAL.inc(int(arr.nbytes), ("padded_groups_built_on_device",))
                 self._account_resident("padded_groups", int(arr.nbytes))
                 cache[key] = arr
             else:
-                host = pad_groups_dense(self, fill, row_multiple)
-                arr = jnp.asarray(host)
+                with _timeline.stage(
+                    _PACK_STAGE_SECONDS, "padded_build", "pack.padded_build",
+                    cat="pack", groups=g, on_device=0,
+                ):
+                    host = pad_groups_dense(self, fill, row_multiple)
+                    arr = _timeline.fence(jnp.asarray(host))
                 if self._epoch() != epoch:
                     return arr  # raced a delta repack: do not publish
                 cache[key] = arr
@@ -375,62 +419,68 @@ class PackedGroups:
             import jax
 
             epoch = self._epoch()
-            counts = np.diff(self.group_offsets)
-            on_accel = jax.default_backend() != "cpu"
-            flat = self.device_words if on_accel else None  # one cached ship
-            out = []
-            pending_account = []  # (route, nbytes): published only if no delta raced
-            for idx in self.plan_buckets(n_buckets):
-                g_b, m_b = len(idx), int(counts[idx].max())
-                # all live rows of the bucket move in ONE vectorized step:
-                # group idx[slot]'s local row p lands at flat slot*m_b + p
-                b_counts = counts[idx]
-                n_b = int(b_counts.sum())
-                slot_rows = None
-                src = None
-                if n_b:
-                    src = np.concatenate(
-                        [
-                            np.arange(self.group_offsets[gi], self.group_offsets[gi + 1])
-                            for gi in idx
-                        ]
-                    )
-                    slot_of_row = np.repeat(np.arange(g_b), b_counts)
-                    local = np.arange(n_b) - np.repeat(
-                        np.cumsum(np.concatenate(([0], b_counts[:-1]))), b_counts
-                    )
-                    slot_rows = slot_of_row * m_b + local
-                if on_accel:
-                    # device gather-with-fill from the already-shipped flat
-                    # rows: pad cells point out of range so mode="fill"
-                    # writes the op identity — the host never materializes
-                    # (or ships) the padded copy, and the gather rides HBM
-                    src_map = np.full(g_b * m_b, self.n_rows, dtype=np.int64)
+            with _timeline.stage(
+                _PACK_STAGE_SECONDS, "bucket_build", "pack.bucket_build",
+                cat="pack", buckets=int(n_buckets), groups=self.n_groups,
+            ):
+                counts = np.diff(self.group_offsets)
+                on_accel = jax.default_backend() != "cpu"
+                flat = self.device_words if on_accel else None  # one cached ship
+                out = []
+                pending_account = []  # (route, nbytes): published only if no delta raced
+                for idx in self.plan_buckets(n_buckets):
+                    g_b, m_b = len(idx), int(counts[idx].max())
+                    # all live rows of the bucket move in ONE vectorized step:
+                    # group idx[slot]'s local row p lands at flat slot*m_b + p
+                    b_counts = counts[idx]
+                    n_b = int(b_counts.sum())
+                    slot_rows = None
+                    src = None
                     if n_b:
-                        src_map[slot_rows] = src
-                    arr = jnp.take(
-                        flat, jnp.asarray(src_map), axis=0, mode="fill",
-                        fill_value=np.uint32(fill),
-                    ).reshape(g_b, m_b, dev.DEVICE_WORDS)
-                    # no host->device transfer happened here; tracked under
-                    # its own key so the transfer ledger stays truthful
-                    pending_account.append(("padded_buckets_built_on_device", int(arr.nbytes)))
-                else:
-                    # CPU backend: a host fill + alias is faster than an
-                    # eager gather (an OR fill allocates its zero pages
-                    # lazily instead of writing the block twice)
-                    shape = (g_b, m_b, dev.DEVICE_WORDS)
-                    if fill == 0:
-                        block = np.zeros(shape, dtype=np.uint32)
-                    else:
-                        block = np.full(shape, fill, dtype=np.uint32)
-                    if n_b:
-                        block.reshape(g_b * m_b, dev.DEVICE_WORDS)[slot_rows] = (
-                            self.words[src]
+                        src = np.concatenate(
+                            [
+                                np.arange(self.group_offsets[gi], self.group_offsets[gi + 1])
+                                for gi in idx
+                            ]
                         )
-                    arr = jnp.asarray(block)
-                    pending_account.append(("padded_buckets", int(block.nbytes)))
-                out.append((idx, arr))
+                        slot_of_row = np.repeat(np.arange(g_b), b_counts)
+                        local = np.arange(n_b) - np.repeat(
+                            np.cumsum(np.concatenate(([0], b_counts[:-1]))), b_counts
+                        )
+                        slot_rows = slot_of_row * m_b + local
+                    if on_accel:
+                        # device gather-with-fill from the already-shipped flat
+                        # rows: pad cells point out of range so mode="fill"
+                        # writes the op identity — the host never materializes
+                        # (or ships) the padded copy, and the gather rides HBM
+                        src_map = np.full(g_b * m_b, self.n_rows, dtype=np.int64)
+                        if n_b:
+                            src_map[slot_rows] = src
+                        arr = _timeline.fence(
+                            jnp.take(
+                                flat, jnp.asarray(src_map), axis=0, mode="fill",
+                                fill_value=np.uint32(fill),
+                            ).reshape(g_b, m_b, dev.DEVICE_WORDS)
+                        )
+                        # no host->device transfer happened here; tracked under
+                        # its own key so the transfer ledger stays truthful
+                        pending_account.append(("padded_buckets_built_on_device", int(arr.nbytes)))
+                    else:
+                        # CPU backend: a host fill + alias is faster than an
+                        # eager gather (an OR fill allocates its zero pages
+                        # lazily instead of writing the block twice)
+                        shape = (g_b, m_b, dev.DEVICE_WORDS)
+                        if fill == 0:
+                            block = np.zeros(shape, dtype=np.uint32)
+                        else:
+                            block = np.full(shape, fill, dtype=np.uint32)
+                        if n_b:
+                            block.reshape(g_b * m_b, dev.DEVICE_WORDS)[slot_rows] = (
+                                self.words[src]
+                            )
+                        arr = _timeline.fence(jnp.asarray(block))
+                        pending_account.append(("padded_buckets", int(block.nbytes)))
+                    out.append((idx, arr))
             if self._epoch() != epoch:
                 return out  # raced a delta repack: do not publish
             for route, nbytes in pending_account:
@@ -445,14 +495,18 @@ def group_by_key(
 ) -> Dict[int, List[Container]]:
     """Transpose bitmaps into key-major groups
     (ParallelAggregation.groupByKey, ParallelAggregation.java:136-153)."""
-    groups: Dict[int, List[Container]] = {}
-    for bm in bitmaps:
-        hlc = bm.high_low_container
-        for k, c in zip(hlc.keys, hlc.containers):
-            if keys_filter is not None and k not in keys_filter:
-                continue
-            groups.setdefault(k, []).append(c)
-    return groups
+    with _timeline.stage(
+        _PACK_STAGE_SECONDS, "key_plan", "pack.key_plan", cat="pack",
+        bitmaps=len(bitmaps),
+    ):
+        groups: Dict[int, List[Container]] = {}
+        for bm in bitmaps:
+            hlc = bm.high_low_container
+            for k, c in zip(hlc.keys, hlc.containers):
+                if keys_filter is not None and k not in keys_filter:
+                    continue
+                groups.setdefault(k, []).append(c)
+        return groups
 
 
 def intersect_keys(bitmaps: Sequence[RoaringBitmap]) -> set:
@@ -472,10 +526,14 @@ def pack_groups(groups: Dict[int, List[Container]]) -> PackedGroups:
     """Pack key-major groups into one host SoA array; the device transfer
     happens once in prepare_reduce after the layout choice, so rows are
     shipped exactly once in whichever layout they'll be reduced in."""
-    group_keys = np.array(sorted(groups), dtype=np.int64)
-    counts = np.array([len(groups[int(k)]) for k in group_keys], dtype=np.int64)
-    offsets = np.concatenate(([0], np.cumsum(counts)))
-    rows = [c for k in group_keys for c in groups[int(k)]]
+    with _timeline.stage(
+        _PACK_STAGE_SECONDS, "group_tables", "pack.group_tables", cat="pack",
+        groups=len(groups),
+    ):
+        group_keys = np.array(sorted(groups), dtype=np.int64)
+        counts = np.array([len(groups[int(k)]) for k in group_keys], dtype=np.int64)
+        offsets = np.concatenate(([0], np.cumsum(counts)))
+        rows = [c for k in group_keys for c in groups[int(k)]]
     return PackedGroups(pack_rows_host(rows), group_keys, offsets)
 
 
@@ -527,20 +585,23 @@ def dense_pad_plan(
     max(2*rows, 1024)). Single source of truth for the host scatter
     (pad_groups_dense) and the device gather (PackedGroups.padded_device)
     so the two paths can never drift apart."""
-    counts = np.diff(group_offsets)
-    g = len(counts)
-    n = int(group_offsets[-1])
-    m = int(counts.max()) if g else 0
-    m += (-m) % row_multiple
-    if g * m > max(2 * n, 1024):
-        return None
-    if n:
-        group_of_row = np.repeat(np.arange(g), counts)
-        local = np.arange(n) - np.repeat(group_offsets[:-1], counts)
-        slots = group_of_row * m + local
-    else:
-        slots = np.empty(0, dtype=np.int64)
-    return m, slots
+    with _timeline.stage(
+        _PACK_STAGE_SECONDS, "dense_pad_plan", "pack.dense_pad_plan", cat="pack"
+    ):
+        counts = np.diff(group_offsets)
+        g = len(counts)
+        n = int(group_offsets[-1])
+        m = int(counts.max()) if g else 0
+        m += (-m) % row_multiple
+        if g * m > max(2 * n, 1024):
+            return None
+        if n:
+            group_of_row = np.repeat(np.arange(g), counts)
+            local = np.arange(n) - np.repeat(group_offsets[:-1], counts)
+            slots = group_of_row * m + local
+        else:
+            slots = np.empty(0, dtype=np.int64)
+        return m, slots
 
 
 def pad_groups_dense(
@@ -744,18 +805,22 @@ def pack_groups_with_provenance(
     group in bitmap order (the group_by_key append order)."""
     groups = group_by_key(bitmaps, keys_filter=keys_filter)
     packed = pack_groups(groups)
-    pos = {
-        int(k): int(off)
-        for k, off in zip(packed.group_keys, packed.group_offsets[:-1])
-    }
-    row_map: Dict[Tuple[int, int], int] = {}
-    for bi, bm in enumerate(bitmaps):
-        for k in bm.high_low_container.keys:
-            if keys_filter is not None and k not in keys_filter:
-                continue
-            row_map[(bi, k)] = pos[k]
-            pos[k] += 1
-    return packed, row_map
+    with _timeline.stage(
+        _PACK_STAGE_SECONDS, "provenance", "pack.provenance", cat="pack",
+        rows=packed.n_rows,
+    ):
+        pos = {
+            int(k): int(off)
+            for k, off in zip(packed.group_keys, packed.group_offsets[:-1])
+        }
+        row_map: Dict[Tuple[int, int], int] = {}
+        for bi, bm in enumerate(bitmaps):
+            for k in bm.high_low_container.keys:
+                if keys_filter is not None and k not in keys_filter:
+                    continue
+                row_map[(bi, k)] = pos[k]
+                pos[k] += 1
+        return packed, row_map
 
 
 class _PackEntry:
@@ -865,6 +930,9 @@ class PackCache:
                 self._entries.move_to_end(key)
                 self.hits += 1
                 _PACK_HITS.inc(1, ("agg",))
+                _timeline.instant(
+                    "pack_cache.hit", "cache", kind="agg", bytes=e.nbytes
+                )
                 return e.value
             old_key = self._ident.get(ident)
             if old_key is not None:
@@ -880,11 +948,16 @@ class PackCache:
                         self.hits += 1
                         self.delta_rows += len(rows)
                         _PACK_HITS.inc(1, ("agg",))
+                        _timeline.instant(
+                            "pack_cache.delta_hit", "cache", kind="agg",
+                            rows=len(rows),
+                        )
                         if rows:
                             _PACK_DELTA_ROWS.inc(len(rows), ("agg",))
                         return e.value
         # full repack outside the lock (packing dominates; a racing thread
         # packing the same key is benign — first store wins)
+        _timeline.instant("pack_cache.miss", "cache", kind="agg")
         packed, row_map = pack_groups_with_provenance(bitmaps, keys_filter)
         with self._lock:
             self.misses += 1
@@ -915,7 +988,11 @@ class PackCache:
                 self._entries.move_to_end(key)
                 self.hits += 1
                 _PACK_HITS.inc(1, (kind,))
+                _timeline.instant(
+                    "pack_cache.hit", "cache", kind=kind, bytes=e.nbytes
+                )
                 return e.value
+        _timeline.instant("pack_cache.miss", "cache", kind=kind)
         value, nbytes = build()
         with self._lock:
             self.misses += 1
@@ -936,6 +1013,9 @@ class PackCache:
             e = self._agg_entry(bitmaps, keys_filter)
             if e is not None:
                 e.pins += 1
+                _timeline.instant(
+                    "pack_cache.pin", "cache", kind="agg", pins=e.pins
+                )
         return packed
 
     def unpin_packed(
@@ -945,6 +1025,9 @@ class PackCache:
             e = self._agg_entry(bitmaps, keys_filter)
             if e is not None:
                 e.pins = max(0, e.pins - 1)
+                _timeline.instant(
+                    "pack_cache.unpin", "cache", kind="agg", pins=e.pins
+                )
                 if e.pins == 0:
                     self._evict_over_budget()
 
@@ -1116,6 +1199,9 @@ class PackCache:
             self._bytes -= e.nbytes  # rb-ok: lock-discipline -- caller holds self._lock
             self.evictions += 1  # rb-ok: lock-discipline -- caller holds self._lock
             _PACK_EVICTED_BYTES.inc(e.nbytes, (e.kind,))
+            _timeline.instant(
+                "pack_cache.evict", "cache", kind=e.kind, bytes=e.nbytes
+            )
             ident = ("agg", e.key[1], tuple(_fp_ident(fp) for fp in e.fps)) \
                 if e.kind == "agg" else None
             if ident is not None and self._ident.get(ident) == key:
@@ -1130,43 +1216,52 @@ class PackCache:
         if len(new_fps) != len(e.fps):
             return None
         packed: PackedGroups = e.value
-        packed_keys = {int(k) for k in packed.group_keys}
-        dirty_rows: Dict[int, Tuple[int, int]] = {}
-        for bi, (old_fp, new_fp) in enumerate(zip(e.fps, new_fps)):
-            if old_fp == new_fp:
-                continue
-            if old_fp[0] != new_fp[0]:  # generation changed (or static id)
-                return None
-            hlc = bitmaps[bi].high_low_container
-            dirty_of = getattr(hlc, "dirty_keys_since", None)
-            dirty = dirty_of(old_fp[1]) if dirty_of is not None else None
-            if dirty is None:  # wholesale / unattributed mutation
-                return None
-            for k in dirty:
-                present_now = hlc.get_index(k) >= 0
-                if keys_filter is not None:  # "and": filter = key intersection
-                    if k in packed_keys:
-                        if not present_now:
-                            return None  # intersection shrank
-                        dirty_rows[e.row_map[(bi, k)]] = (bi, k)
-                    elif present_now and all(
-                        b.high_low_container.get_index(k) >= 0 for b in bitmaps
-                    ):
-                        return None  # intersection grew
-                else:
-                    was_packed = (bi, k) in e.row_map
-                    if was_packed != present_now:
-                        return None  # container added or removed
-                    if present_now:
-                        dirty_rows[e.row_map[(bi, k)]] = (bi, k)
+        with _timeline.stage(
+            _DELTA_STAGE_SECONDS, "dirty_scan", "delta.dirty_scan",
+            cat="delta", operands=len(new_fps),
+        ):
+            packed_keys = {int(k) for k in packed.group_keys}
+            dirty_rows: Dict[int, Tuple[int, int]] = {}
+            for bi, (old_fp, new_fp) in enumerate(zip(e.fps, new_fps)):
+                if old_fp == new_fp:
+                    continue
+                if old_fp[0] != new_fp[0]:  # generation changed (or static id)
+                    return None
+                hlc = bitmaps[bi].high_low_container
+                dirty_of = getattr(hlc, "dirty_keys_since", None)
+                dirty = dirty_of(old_fp[1]) if dirty_of is not None else None
+                if dirty is None:  # wholesale / unattributed mutation
+                    return None
+                for k in dirty:
+                    present_now = hlc.get_index(k) >= 0
+                    if keys_filter is not None:  # "and": filter = key intersection
+                        if k in packed_keys:
+                            if not present_now:
+                                return None  # intersection shrank
+                            dirty_rows[e.row_map[(bi, k)]] = (bi, k)
+                        elif present_now and all(
+                            b.high_low_container.get_index(k) >= 0 for b in bitmaps
+                        ):
+                            return None  # intersection grew
+                    else:
+                        was_packed = (bi, k) in e.row_map
+                        if was_packed != present_now:
+                            return None  # container added or removed
+                        if present_now:
+                            dirty_rows[e.row_map[(bi, k)]] = (bi, k)
         if not dirty_rows:
             return ()
         rows = sorted(dirty_rows)
-        containers = [
-            bitmaps[bi].high_low_container.get_container(k)
-            for bi, k in (dirty_rows[r] for r in rows)
-        ]
-        packed.apply_delta(np.asarray(rows, dtype=np.int64), pack_rows_host(containers))
+        with _timeline.stage(
+            _DELTA_STAGE_SECONDS, "host_rows", "delta.host_rows",
+            cat="delta", rows=len(rows),
+        ):
+            containers = [
+                bitmaps[bi].high_low_container.get_container(k)
+                for bi, k in (dirty_rows[r] for r in rows)
+            ]
+            host_rows = pack_rows_host(containers)
+        packed.apply_delta(np.asarray(rows, dtype=np.int64), host_rows)
         return rows
 
 

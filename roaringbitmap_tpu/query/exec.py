@@ -40,12 +40,22 @@ import threading
 from collections import OrderedDict
 from typing import Dict, List, Optional, Union
 
+from .. import observe as _observe
+from ..observe import timeline as _timeline
 from ..models.roaring import RoaringBitmap
 from . import kernels
 from .cache import DEFAULT_CACHE, ResultCache, cache_key
 from .expr import Expr
 from .plan import Plan, PlanStep
 from .plan import plan as build_plan
+
+# end-to-end query latency quantiles (ISSUE 6): p50/p99 per phase in every
+# export — the serving-layer measurement ROADMAP item 3 builds on
+_QUERY_LATENCY = _observe.latency_histogram(
+    _observe.QUERY_LATENCY_SECONDS,
+    "End-to-end query latencies by phase (plan | execute)",
+    ("phase",),
+)
 
 _PLAN_MEMO_MAX = 128
 _PLAN_MEMO_LOCK = threading.Lock()
@@ -70,7 +80,8 @@ def _memo_plan(expr: Expr, mode: Optional[str]) -> Plan:
         if p is not None:
             _PLAN_MEMO.move_to_end(key)
             return p
-    p = build_plan(expr, mode=mode)
+    with _timeline.stage(_QUERY_LATENCY, "plan", "query.plan", cat="query"):
+        p = build_plan(expr, mode=mode)
     with _PLAN_MEMO_LOCK:
         _PLAN_MEMO[key] = p
         while len(_PLAN_MEMO) > _PLAN_MEMO_MAX:
@@ -89,7 +100,10 @@ def execute(
     from .. import tracing
 
     p = query if isinstance(query, Plan) else _memo_plan(query, mode)
-    with tracing.op_timer("query.execute"):
+    with tracing.op_timer("query.execute"), _timeline.stage(
+        _QUERY_LATENCY, "execute", "query.execute", cat="query",
+        steps=len(p.steps),
+    ):
         leaf_fps = {l.uid: l.fingerprint() for l in p.root.leaves}
         results: Dict[int, RoaringBitmap] = {
             l.uid: l.bitmap for l in p.root.leaves
@@ -100,9 +114,15 @@ def execute(
                 hit = cache.get(key)
                 if hit is not None:
                     results[step.node.uid] = hit
+                    _timeline.instant(
+                        "query.cache_hit", "query", op=step.node.op
+                    )
                     continue
             inputs = [results[o.uid] for o in step.operands]
-            val = _run_step(step, inputs)
+            with _timeline.tspan(
+                "query.step", "query", engine=step.engine, op=step.node.op
+            ):
+                val = _run_step(step, inputs)
             if cache is not None:
                 cache.put(key, val)
             results[step.node.uid] = val

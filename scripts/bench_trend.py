@@ -1,0 +1,276 @@
+#!/usr/bin/env python
+"""Bench trend regression detector (ISSUE 6 satellite).
+
+Reads the committed ``BENCH_r0*.json`` series and does two jobs:
+
+1. **Prints the headline trajectory with its provenance.** ``vs_baseline``
+   is a *ratio* whose denominator (``cpu_fold_s``) rides every CPU-side
+   win and every dataset change — the 12.96 → 8.02 → 3.59 slide across
+   r05→r07 is mostly the denominator improving (columnar fold) and the
+   corpus changing (real census1881 73k containers → synthetic 308k), not
+   the device path regressing. The report prints, per round, the ratio
+   NEXT TO its denominator, dataset, container count, and backend so the
+   number can never slide silently again (ROADMAP re-anchor note).
+
+2. **Gates the newest round.** Each gated row of the latest artifact is
+   compared against the best prior round measured on the same
+   ``(backend, dataset, n_bitmaps)`` triple — cross-machine/corpus
+   comparisons are meaningless, so rounds from other triples are ignored.
+   A lower-is-better row more than 15 % slower than the best prior (or
+   the throughput ``value`` more than 15 % below the best prior) is a
+   regression; ``--check`` exits 1 unless it is acknowledged in
+   ``TREND_BASELINE.json`` (the ANALYSIS_BASELINE discipline: a known
+   regression is recorded with a reason, not silenced). Regenerate the
+   baseline with ``--update-baseline`` after editing the reasons.
+
+Artifact shapes: rounds 1-5 are driver captures (``{tail, parsed}`` with
+the meta JSON embedded in the stderr tail); rounds 6+ are bench.py's own
+``{result, meta}`` files. Both normalize here.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE_PATH = os.path.join(REPO, "TREND_BASELINE.json")
+THRESHOLD = 0.15
+
+# lower-is-better wall-clock rows; gated when present in latest AND a
+# comparable prior round
+GATED_LOWER = (
+    "cpu_fold_s",
+    "pack_s",
+    "bucket_build_s",
+    "tpu_reduce_s",
+    "pack_warm_s",
+    "delta_repack_s",
+)
+# higher-is-better rows
+GATED_HIGHER = ("value",)
+
+
+def _round_of(path: str) -> Optional[int]:
+    m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def _meta_from_tail(tail: str) -> dict:
+    """Rounds 1-5: bench.py printed meta as a JSON line on stderr; the
+    driver capture interleaves it with warnings. Take the last line that
+    parses as an object carrying a 'dataset' key."""
+    meta = {}
+    for line in tail.splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and line.endswith("}")):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and "dataset" in obj:
+            meta = obj
+    return meta
+
+
+def load_round(path: str) -> Optional[dict]:
+    with open(path) as f:
+        data = json.load(f)
+    rnd = _round_of(path)
+    if "meta" in data and "result" in data:  # r06+ shape
+        meta, result = data["meta"], data["result"]
+    elif "parsed" in data:  # r01-r05 driver capture
+        # a failed capture (rc != 0) has parsed=None; keep whatever meta
+        # made it into the tail so the trajectory still shows the round
+        meta, result = _meta_from_tail(data.get("tail", "")), data["parsed"] or {}
+    else:
+        return None
+    rows: Dict[str, float] = {}
+    for k in GATED_LOWER:
+        v = meta.get(k)
+        if isinstance(v, (int, float)) and v > 0:
+            rows[k] = float(v)
+    v = result.get("value")
+    if isinstance(v, (int, float)) and v > 0:
+        rows["value"] = float(v)
+    return {
+        "round": rnd,
+        "path": os.path.basename(path),
+        "backend": meta.get("backend", "?"),
+        "dataset": meta.get("dataset", "?"),
+        "n_bitmaps": meta.get("n_bitmaps"),
+        "n_containers": meta.get("n_containers"),
+        "vs_baseline": result.get("vs_baseline"),
+        "denominator_s": meta.get("cpu_fold_s"),
+        "baseline_block": meta.get("baseline"),
+        "rows": rows,
+    }
+
+
+def load_series(root: str = REPO) -> List[dict]:
+    rounds = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        if _round_of(path) is None:
+            continue
+        r = load_round(path)
+        if r is not None:
+            rounds.append(r)
+    rounds.sort(key=lambda r: r["round"])
+    return rounds
+
+
+def _triple(r: dict):
+    return (r["backend"], r["dataset"], r["n_bitmaps"])
+
+
+def find_regressions(rounds: List[dict], threshold: float = THRESHOLD) -> List[dict]:
+    """Gate the newest round against the best comparable prior round."""
+    if len(rounds) < 2:
+        return []
+    latest = rounds[-1]
+    priors = [r for r in rounds[:-1] if _triple(r) == _triple(latest)]
+    if not priors:
+        return []
+    out = []
+    for row, cur in sorted(latest["rows"].items()):
+        vals = [r["rows"][row] for r in priors if row in r["rows"]]
+        if not vals:
+            continue
+        if row in GATED_HIGHER:
+            best = max(vals)
+            regressed = cur < best / (1 + threshold)
+            pct = (best / cur - 1) * 100
+        else:
+            best = min(vals)
+            regressed = cur > best * (1 + threshold)
+            pct = (cur / best - 1) * 100
+        if regressed:
+            out.append(
+                {
+                    "round": latest["round"],
+                    "row": row,
+                    "value": cur,
+                    "best_prior": best,
+                    "regression_pct": round(pct, 1),
+                }
+            )
+    return out
+
+
+def load_baseline(path: str = BASELINE_PATH) -> List[dict]:
+    if not os.path.isfile(path):
+        return []
+    with open(path) as f:
+        data = json.load(f)
+    return data.get("acknowledged", [])
+
+
+def _acknowledged(reg: dict, baseline: List[dict]) -> Optional[dict]:
+    for b in baseline:
+        if b.get("round") == reg["round"] and b.get("row") == reg["row"]:
+            return b
+    return None
+
+
+def print_trajectory(rounds: List[dict], out=sys.stdout) -> None:
+    print("vs_baseline trajectory (ratio next to its denominator):", file=out)
+    print(
+        f"  {'round':>5}  {'vs_base':>8}  {'cpu_fold_s':>10}  "
+        f"{'containers':>10}  {'backend':>7}  dataset",
+        file=out,
+    )
+    for r in rounds:
+        vb = r["vs_baseline"]
+        den = r["denominator_s"]
+        print(
+            f"  r{r['round']:02d}    {vb if vb is not None else '-':>8}  "
+            f"{den if den is not None else '-':>10}  "
+            f"{r['n_containers'] if r['n_containers'] else '-':>10}  "
+            f"{r['backend']:>7}  {r['dataset']}",
+            file=out,
+        )
+    print(
+        "  (vs_baseline = cpu_fold_s / tpu_reduce_s — the denominator rides\n"
+        "   every CPU win and every dataset change; compare rows only within\n"
+        "   one backend+dataset+size triple)",
+        file=out,
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 on unacknowledged >15%% regressions")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="record current regressions in TREND_BASELINE.json")
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    ap.add_argument("--root", default=REPO)
+    args = ap.parse_args(argv)
+
+    rounds = load_series(args.root)
+    if not rounds:
+        print("no BENCH_r*.json artifacts found", file=sys.stderr)
+        return 2
+    regressions = find_regressions(rounds)
+    baseline = load_baseline(os.path.join(args.root, "TREND_BASELINE.json"))
+
+    if args.update_baseline:
+        payload = {
+            "_comment": "Acknowledged bench regressions (scripts/bench_trend.py). "
+                        "Each entry needs a human reason; delete entries once fixed.",
+            "acknowledged": [
+                {**r, "reason": (_acknowledged(r, baseline) or {}).get(
+                    "reason", "TODO: explain this regression")}
+                for r in regressions
+            ],
+        }
+        path = os.path.join(args.root, "TREND_BASELINE.json")
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+        print(f"wrote {len(regressions)} acknowledged regression(s) to {path}")
+        return 0
+
+    fresh = [r for r in regressions if _acknowledged(r, baseline) is None]
+    if args.json:
+        print(json.dumps(
+            {"rounds": rounds, "regressions": regressions, "fresh": fresh},
+            indent=1,
+        ))
+    else:
+        print_trajectory(rounds)
+        latest = rounds[-1]
+        priors = [r for r in rounds[:-1] if _triple(r) == _triple(latest)]
+        names = (
+            ", ".join("r%02d" % r["round"] for r in priors)
+            if priors
+            else "no comparable prior round"
+        )
+        print("\ngate: r%02d vs best of %s" % (latest["round"], names))
+        for reg in regressions:
+            ack = _acknowledged(reg, baseline)
+            tag = f"acknowledged: {ack['reason']}" if ack else "NEW"
+            print(
+                f"  {reg['row']}: {reg['value']} vs best prior "
+                f"{reg['best_prior']} (+{reg['regression_pct']}%) [{tag}]"
+            )
+        if not regressions:
+            print("  no gated row regressed >15% vs the best comparable prior")
+    if args.check and fresh:
+        print(
+            f"\nFAIL: {len(fresh)} unacknowledged regression(s) >15% — fix, "
+            "or record a reason via --update-baseline + edit TREND_BASELINE.json",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

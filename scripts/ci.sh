@@ -90,9 +90,10 @@ EOF
 step "bench.py --smoke (end-to-end north-star path, CPU)"
 # validate the driver contract, not just the exit code: exactly the keys
 # BENCH_r*.json records, with a sane positive speedup
-rm -f /tmp/ci_bench_metrics.json /tmp/ci_bench.json
+rm -f /tmp/ci_bench_metrics.json /tmp/ci_bench.json /tmp/ci_bench_timeline.json
 JAX_PLATFORMS=cpu BENCH_METRICS_OUT=/tmp/ci_bench_metrics.json \
   BENCH_JSON_OUT=/tmp/ci_bench.json \
+  BENCH_TIMELINE_OUT=/tmp/ci_bench_timeline.json \
   python bench.py --smoke | python -c '
 import json, sys
 line = sys.stdin.readlines()[-1]
@@ -181,6 +182,79 @@ if not col:
 print("metrics sidecar ok (layouts %s, %d span paths, pack-cache hits %s, columnar pairs %s)"
       % (m["layout"], len(m["spans"]), sum(s["value"] for s in pack),
          sum(s["value"] for s in col)))'
+
+step "timeline artifact (BENCH_TIMELINE.json schema + stage attribution, ISSUE 6)"
+# the flight-recorder artifact must be Perfetto-loadable trace-event JSON
+# and its named stages must attribute >=90% of the traced pack and delta
+# walls — the decomposition ROADMAP item 1 consumes
+python -c '
+import json
+path = "/tmp/ci_bench_timeline.json"
+t = json.load(open(path))
+evs = t.get("traceEvents")
+if not (isinstance(evs, list) and evs):
+    raise SystemExit("timeline: traceEvents missing/empty")
+for e in evs:
+    need = {"name", "ph", "pid", "tid"}
+    if e.get("ph") == "X":
+        need = need | {"ts", "dur", "cat"}
+    elif e.get("ph") == "i":
+        need = need | {"ts"}
+    # ph "M" metadata (thread_name) legitimately has no timestamp
+    missing = need - set(e)
+    if missing:
+        raise SystemExit("timeline event lacks %s: %r" % (sorted(missing), e))
+od = t.get("otherData", {})
+if od.get("schema") != "rb_tpu_bench_timeline/1":
+    raise SystemExit("timeline: bad otherData.schema %r" % od.get("schema"))
+for part in ("pack", "delta"):
+    blk = od.get(part)
+    if not (isinstance(blk, dict) and blk.get("stage_s") and blk.get("wall_s", 0) > 0):
+        raise SystemExit("timeline: missing %s attribution block: %r" % (part, blk))
+    if blk["coverage"] < 0.9:
+        raise SystemExit("timeline: %s stages cover only %.1f%% of the wall"
+                         % (part, blk["coverage"] * 100))
+if not od["delta"].get("dominant_stage"):
+    raise SystemExit("timeline: delta block names no dominant stage")
+spans = sum(1 for e in evs if e.get("ph") == "X")
+print("timeline ok (%d events, %d spans; pack %.1f%%, delta %.1f%% attributed; delta dominated by %s)"
+      % (len(evs), spans, od["pack"]["coverage"] * 100,
+         od["delta"]["coverage"] * 100, od["delta"]["dominant_stage"]))'
+
+step "latency histogram rows in the metrics sidecar (p50/p99, ISSUE 6)"
+# the log-bucketed latency histograms must surface quantile snapshots in
+# the sidecar (and therefore the JSONL/Prometheus exports they mirror)
+python -c '
+import json
+m = json.load(open("/tmp/ci_bench_metrics.json"))
+lat = m.get("latency")
+if not isinstance(lat, dict):
+    raise SystemExit("metrics sidecar lacks the latency block")
+need = {"rb_tpu_store_pack_stage_seconds", "rb_tpu_store_delta_stage_seconds",
+        "rb_tpu_timeline_span_seconds"}
+missing = need - set(lat)
+if missing:
+    raise SystemExit("latency block lacks %s (has %s)" % (sorted(missing), sorted(lat)))
+for name in need:
+    series = lat[name]
+    if not series:
+        raise SystemExit("latency metric %s recorded no series" % name)
+    for key, st in series.items():
+        if not ({"count", "sum", "p50", "p90", "p99"} <= set(st)):
+            raise SystemExit("latency series %s{%s} lacks quantiles: %r" % (name, key, st))
+        if st["count"] <= 0 or st["p99"] < st["p50"]:
+            raise SystemExit("latency series %s{%s} is inconsistent: %r" % (name, key, st))
+reg = m.get("registry", {}).get("rb_tpu_store_pack_stage_seconds", {})
+if reg.get("type") != "histogram" or not reg.get("samples"):
+    raise SystemExit("registry snapshot lacks the pack-stage histogram")
+if "quantiles" not in reg["samples"][0]:
+    raise SystemExit("pack-stage histogram sample carries no quantiles")
+stages = sorted(lat["rb_tpu_store_pack_stage_seconds"])
+print("latency rows ok (%d pack stages %s; delta stages %s)"
+      % (len(stages), stages, sorted(lat["rb_tpu_store_delta_stage_seconds"])))'
+
+step "bench trend gate (>15% vs best comparable prior round)"
+python scripts/bench_trend.py --check
 
 step "graft entry + 8-device virtual-mesh dryrun"
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

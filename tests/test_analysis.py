@@ -327,6 +327,37 @@ def counter(name, help=""):
     assert res.findings == []
 
 
+LATENCY_SRC = """from roaringbitmap_tpu import observe
+GOOD_SECONDS = "rb_tpu_good_seconds"
+BAD_UNIT_TOTAL = "rb_tpu_oops_total"
+A = observe.latency_histogram("rb_tpu_a_seconds", "ok", ("stage",))
+B = observe.latency_histogram("rb_tpu_b_total", "bad unit suffix")
+C = observe.latency_histogram("oops_seconds", "bad prefix")
+D = observe.latency_histogram(GOOD_SECONDS, "ok via constant")
+E = observe.latency_histogram(BAD_UNIT_TOTAL, "bad constant value")
+F = observe.latency_histogram(observe.QUERY_LATENCY_SECONDS, "ok cross-module")
+G = observe.latency_histogram(observe.QUERY_CACHE_TOTAL, "bad cross-module shape")
+"""
+
+
+def test_metric_naming_latency_histograms_need_seconds_suffix(tmp_path):
+    res = _run_snippet(tmp_path, LATENCY_SRC, rules=["metric-naming"])
+    by_line = {f.line for f in res.findings}
+    # 5: literal lacking _seconds; 6: bad prefix; 8: constant value lacking
+    # _seconds; 10: cross-module constant not _SECONDS-shaped. Lines 4/7/9
+    # are compliant.
+    assert by_line == {5, 6, 8, 10}
+
+
+def test_metric_naming_plain_histogram_keeps_old_rules(tmp_path):
+    # the _seconds requirement is latency-histogram-only: a plain registry
+    # histogram under a _TOTAL-ish name stays legal (regression guard)
+    src = 'from roaringbitmap_tpu import observe\n' \
+          'H = observe.histogram("rb_tpu_plain_bytes", "not latency", ("k",))\n'
+    res = _run_snippet(tmp_path, src, rules=["metric-naming"])
+    assert res.findings == []
+
+
 # ---------------------------------------------------------------------------
 # baseline round-trip
 # ---------------------------------------------------------------------------
