@@ -256,6 +256,47 @@ def _run():
         "fold_speedup": round(cpu_fold_percontainer_s / cpu_s, 2),
     }
 
+    # ---- degraded tier (ISSUE 7): the fold with the device tier down ----
+    # degraded_fold_s is the STEADY-STATE outage number: injected dispatch
+    # faults trip the agg/device circuit breaker (three sacrificial
+    # small-set calls), after which degraded traffic rides the
+    # columnar-CPU tier without attempting the dead device tier at all —
+    # the ladder's whole point. The first-hit transient (failed device
+    # attempt incl. cold pack + bounded retries) is recorded separately as
+    # degraded_first_hit_s. Bits asserted identical; min-of-reps like cpu_s.
+    from roaringbitmap_tpu import robust
+    from roaringbitmap_tpu.robust import faults as rfaults
+    from roaringbitmap_tpu.robust import ladder as rladder
+
+    rladder.LADDER.reset()
+    # long cooldown: a half-open probe admitting a full-scale device
+    # attempt mid-measurement would pollute a rep
+    rladder.LADDER.configure(cooldown_s=600.0)
+    store.PACK_CACHE.close()
+    with rfaults.inject("ops.dispatch", robust.TransientDeviceError, every=1):
+        t0 = time.time()
+        first_hit = aggregation.FastAggregation.or_(*bitmaps[:64], mode="device")
+        degraded_first_hit_s = time.time() - t0
+        assert first_hit == aggregation.FastAggregation.naive_or(*bitmaps[:64])
+        for _ in range(2):  # two more failures trip the breaker (trip_after=3)
+            aggregation.FastAggregation.or_(*bitmaps[:64], mode="device")
+        assert rladder.LADDER.breaker_state("agg", "device") == "open", (
+            "breaker must be open before the steady-state degraded fold"
+        )
+        store.PACK_CACHE.close()  # the failed attempts' packs must not skew reps
+        degraded_times = []
+        for _ in range(REPS_CPU):
+            t0 = time.time()
+            degraded_result = aggregation.ParallelAggregation.or_(
+                *bitmaps, mode="device"
+            )
+            degraded_times.append(time.time() - t0)
+    degraded_fold_s = min(degraded_times)
+    assert degraded_result == cpu_result, "degraded tier result mismatch"
+    # tripped breakers / stretched cooldown must not leak into the TPU path
+    rladder.LADDER.reset()
+    rladder.LADDER.configure(cooldown_s=5.0)
+
     # ---- TPU path: pack once via the resident pack cache (ISSUE 4), ----
     # ---- reduce on device                                           ----
     store.PACK_CACHE.close()  # cold start: pack_s is the uncached marshal
@@ -495,6 +536,15 @@ def _run():
         "layout": layout,
         "cardinality": int(cpu_card),
         "cpu_fold_s": round(cpu_s, 4),
+        # degraded-tier rows (ISSUE 7): the same fold with the device tier
+        # killed by injected dispatch faults. degraded_fold_s = steady
+        # state under the tripped agg/device breaker (columnar-CPU tier
+        # absorbs the traffic, dead tier never attempted);
+        # degraded_first_hit_s = the transient cost of the FIRST failure
+        # (failed device attempt on a 64-bitmap set + degrade). Bits
+        # asserted identical to cpu_result above.
+        "degraded_fold_s": round(degraded_fold_s, 4),
+        "degraded_first_hit_s": round(degraded_first_hit_s, 4),
         # columnar pairwise engine (ISSUE 5): the host dispatch floor
         # before/after + the in-bench parity gate's verdict
         "columnar": columnar_meta,

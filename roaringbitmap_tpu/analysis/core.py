@@ -58,6 +58,10 @@ class Finding:
     message: str
     snippet: str = ""
     end_line: int = 0  # 0 -> same as line
+    # pragma-proof findings ignore `# rb-ok:` suppression: rules use this
+    # where a pragma would waive a contract the rule exists to enforce
+    # (exception-hygiene's fault-site strictness, ISSUE 7)
+    pragma_proof: bool = False
 
     def render(self) -> str:
         return (
@@ -172,7 +176,8 @@ class Checker:
     description: str = ""
     severity: str = "error"
 
-    def finding(self, ctx: FileContext, node_or_line, message: str, col: int = 0):
+    def finding(self, ctx: FileContext, node_or_line, message: str, col: int = 0,
+                suppress_pragma: bool = False):
         if isinstance(node_or_line, int):
             line = end = node_or_line
         else:
@@ -205,6 +210,7 @@ class Checker:
             message=message,
             snippet=ctx.line_text(line).strip(),
             end_line=end,
+            pragma_proof=suppress_pragma,
         )
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:
@@ -296,7 +302,7 @@ def run_checks(
         result.files += 1
         for checker in checkers:
             for f in checker.check(ctx):
-                if ctx.suppressed(f.rule, f.line, f.end_line):
+                if not f.pragma_proof and ctx.suppressed(f.rule, f.line, f.end_line):
                     result.suppressed += 1
                 else:
                     result.findings.append(f)

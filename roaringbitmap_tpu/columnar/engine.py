@@ -31,6 +31,8 @@ import numpy as np
 
 from .. import observe as _observe
 from ..observe import timeline as _timeline
+from ..robust import errors as _rerrors
+from ..robust import ladder as _ladder
 from ..models.container import (
     ARRAY_MAX_SIZE,
     ArrayContainer,
@@ -421,12 +423,22 @@ def _matched_results(
     if op in ("and", "andnot"):
         a_bm = codes_a == BITMAP
         b_bm = codes_b == BITMAP
+        nonbm = np.flatnonzero(~a_bm & ~b_bm)
+        native_done = False
         if kernels.has_native():
-            # one run-unified native call serves every bitmap-free class
-            _fill_runs_native(
-                op, acs, bcs, np.flatnonzero(~a_bm & ~b_bm), results
-            )
-        else:
+            # one run-unified native call serves every bitmap-free class;
+            # a non-fatal failure (injected or real) classifies and the
+            # whole bucket re-runs on the numpy tiers below (ISSUE 7)
+            try:
+                _fill_runs_native(op, acs, bcs, nonbm, results)
+                native_done = True
+            except Exception as e:
+                if _rerrors.classify(e) == _rerrors.FATAL:
+                    raise
+                _ladder.LADDER.note_degrade("columnar.kernel", "native", "numpy", e)
+                for i in nonbm.tolist():  # drop any partial native writes
+                    results[i] = None
+        if not native_done:
             a_run = ~a_arr & ~a_bm
             b_run = ~b_arr & ~b_bm
             _fill_aa(op, acs, bcs, np.flatnonzero(a_arr & b_arr), results)
@@ -546,14 +558,22 @@ def _cardinality_batches(acs, bcs):
     a_bm = codes_a == BITMAP
     b_bm = codes_b == BITMAP
     nonbm = np.flatnonzero(~a_bm & ~b_bm)
+    native_count = None
     if nonbm.size and kernels.has_native():
-        as_, al, acnt = gather_intervals(acs, nonbm)
-        bs_, bl, bcnt = gather_intervals(bcs, nonbm)
-        yield int(
-            kernels.batch_run_pairwise(
-                as_, al, acnt, bs_, bl, bcnt, "and", cards_only=True
-            ).sum()
-        )
+        try:
+            as_, al, acnt = gather_intervals(acs, nonbm)
+            bs_, bl, bcnt = gather_intervals(bcs, nonbm)
+            native_count = int(
+                kernels.batch_run_pairwise(
+                    as_, al, acnt, bs_, bl, bcnt, "and", cards_only=True
+                ).sum()
+            )
+        except Exception as e:
+            if _rerrors.classify(e) == _rerrors.FATAL:
+                raise
+            _ladder.LADDER.note_degrade("columnar.kernel", "native", "numpy", e)
+    if native_count is not None:
+        yield native_count
     elif nonbm.size:
         a_run = ~a_arr & ~a_bm
         b_run = ~b_arr & ~b_bm

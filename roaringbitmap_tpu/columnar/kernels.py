@@ -22,6 +22,9 @@ from typing import Tuple
 
 import numpy as np
 
+from ..robust import errors as _rerrors
+from ..robust import faults as _faults
+from ..robust import ladder as _ladder
 from ..utils import bits
 
 _WORDS = bits.WORDS_PER_CONTAINER  # 1024
@@ -32,6 +35,27 @@ def _native():
     from .. import native
 
     return native if native.available() else None
+
+
+def _native_guard():
+    """The native module for a batch kernel with an inline numpy fallback:
+    the ``columnar.kernel`` fault site fires here, and a non-fatal failure
+    classifies and routes to the numpy tier (returns None) instead of
+    raising — the native→banded-numpy chain as one declared degradation
+    (ISSUE 7). Kernels WITHOUT an inline fallback (batch_run_pairwise)
+    call ``fault_point`` directly and let the engine's class-bucket
+    fallback catch."""
+    nat = _native()
+    if nat is None:
+        return None
+    try:
+        _faults.fault_point("columnar.kernel")
+    except Exception as e:
+        if _rerrors.classify(e) == _rerrors.FATAL:
+            raise
+        _ladder.LADDER.note_degrade("columnar.kernel", "native", "numpy", e)
+        return None
+    return nat
 
 
 # ---------------------------------------------------------------------------
@@ -89,7 +113,7 @@ def batch_pairwise(
     if n == 0:
         z = np.empty(0, dtype=np.int64)
         return np.empty(0, dtype=np.uint16), z, z
-    nat = _native()
+    nat = _native_guard()
     if nat is None:
         return _batch_pairwise_numpy(avals, aoffs, bvals, boffs, op)
     alens = np.diff(aoffs)
@@ -123,6 +147,18 @@ def batch_run_pairwise(
     interval_counts[j]`` — or just per-pair cardinalities when
     ``cards_only``."""
     nat = _native()
+    if nat is None:
+        # the native tier vanished between the caller's has_native() check
+        # and this call (a native.entry fault, or a real load failure on
+        # another thread): raise the non-fatal taxonomy error so the
+        # engine's classify-then-route handler absorbs it — an
+        # AttributeError here would classify FATAL and escape the ladder
+        raise _rerrors.TierUnavailable(
+            "native batch tier unavailable for batch_run_pairwise"
+        )
+    # no inline fallback here: the fault raises through to the engine's
+    # class-bucket router, which re-runs the batch on the numpy tiers
+    _faults.fault_point("columnar.kernel")
     aoffs = np.concatenate(([0], np.cumsum(acnt)))
     boffs = np.concatenate(([0], np.cumsum(bcnt)))
     if cards_only:
@@ -145,7 +181,7 @@ def batch_and_cardinality(
     n = aoffs.size - 1
     if n == 0:
         return np.empty(0, dtype=np.int64)
-    nat = _native()
+    nat = _native_guard()
     if nat is not None:
         return nat.batch_intersect_card_u16(avals, aoffs, bvals, boffs)
     ag = _banded(avals, aoffs)
@@ -168,7 +204,7 @@ def popcount_rows(mat: np.ndarray) -> np.ndarray:
     cardinalities — ONE call for the whole batch's format selection)."""
     if mat.shape[0] == 0:
         return np.empty(0, dtype=np.int64)
-    nat = _native()
+    nat = _native_guard()
     if nat is not None and mat.flags.c_contiguous:
         return nat.popcount_rows(mat)
     return bits.popcount64(mat).sum(axis=1).astype(np.int64)
@@ -182,7 +218,7 @@ def scatter_values_rows(
     or/xor/clear combine; ``row_ids`` may repeat (fold accumulators)."""
     if row_ids.size == 0:
         return
-    nat = _native()
+    nat = _native_guard()
     if nat is not None:
         nat.scatter_values_rows(row_ids, offsets, vals, out64, op)
         return
@@ -209,7 +245,7 @@ def fill_intervals_rows(
     per run with the shared range fills (correctness fallback)."""
     if row_ids.size == 0:
         return
-    nat = _native()
+    nat = _native_guard()
     if nat is not None:
         nat.fill_intervals_rows(row_ids, run_offs, starts, ends, out64, op)
         return

@@ -372,6 +372,102 @@ def verify_columnar_invariance(
             raise InvarianceFailure(name, repro, detail=repr(e)) from e
 
 
+def random_fault_schedule(rng) -> list:
+    """1-3 random fault rules over random registered sites: error kind
+    drawn from the taxonomy (transient / resource / simulated XLA OOM),
+    trigger drawn from every=/after=/prob= (seeded)."""
+    from .robust import faults as rfaults
+    from .robust.errors import ResourceExhausted, TransientDeviceError, simulated_oom
+
+    rules = []
+    for _ in range(int(rng.integers(1, 4))):
+        site = rfaults.SITES[int(rng.integers(0, len(rfaults.SITES)))]
+        exc = (TransientDeviceError, ResourceExhausted, simulated_oom)[
+            int(rng.integers(0, 3))
+        ]
+        kind = int(rng.integers(0, 3))
+        kw: dict = {}
+        if kind == 0:
+            kw["every"] = int(rng.integers(1, 4))
+        elif kind == 1:
+            kw["after"] = int(rng.integers(0, 3))
+        else:
+            kw["prob"] = float(rng.uniform(0.1, 0.9))
+            kw["seed"] = int(rng.integers(0, 1 << 16))
+        rules.append((site, exc, kw))
+    return rules
+
+
+def verify_fault_schedule_invariance(
+    name: str,
+    iterations: Optional[int] = None,
+    seed: Optional[int] = None,
+) -> None:
+    """Fuzz family 26 (ISSUE 7): random op/query sequences under random
+    seeded fault schedules must be bit-exact with the no-fault oracle
+    (computed mid-schedule inside ``faults.suspended()``) and must never
+    raise past the degradation ladder. A fault that corrupts a result, a
+    tier that isn't bit-exact, or an exception that escapes a ladder all
+    fail identically."""
+    from contextlib import ExitStack
+
+    from .models.roaring import RoaringBitmap as RB
+    from .parallel import store
+    from .parallel.aggregation import FastAggregation as FA
+    from .query import evaluate_naive, execute
+    from .robust import faults as rfaults
+    from .robust import ladder as rladder
+
+    rng = np.random.default_rng(seed)
+    for _ in range(iterations or default_iterations()):
+        bms = [random_bitmap(rng) for _ in range(int(rng.integers(2, 5)))]
+        sched = random_fault_schedule(rng)
+        rfaults.clear()  # fresh per-site hit counters: schedules replay
+        rladder.LADDER.reset()
+        store.PACK_CACHE.close()
+        try:
+            with ExitStack() as stack:
+                for site, exc, kw in sched:
+                    stack.enter_context(rfaults.inject(site, exc, **kw))
+                for _step in range(int(rng.integers(1, 4))):
+                    kind = int(rng.integers(0, 4))
+                    if kind == 0:  # N-way aggregation, any dispatch mode
+                        mode = ("cpu", "device", None)[int(rng.integers(0, 3))]
+                        op = ("or_", "and_", "xor")[int(rng.integers(0, 3))]
+                        got = getattr(FA, op)(*bms, mode=mode)
+                        with rfaults.suspended():
+                            want = getattr(FA, op)(*bms, mode="cpu")
+                    elif kind == 1:  # pairwise facade (columnar router)
+                        got = RB.and_(bms[0], bms[1])
+                        with rfaults.suspended():
+                            want = RB.and_(bms[0], bms[1])
+                    elif kind == 2:  # n-way andnot kernel, device-routed
+                        got = FA.andnot(bms[0], *bms[1:], mode="device")
+                        with rfaults.suspended():
+                            want = FA.andnot(bms[0], *bms[1:], mode="cpu")
+                    else:  # full query DAG, sometimes deadline-cancelled
+                        expr = random_expression(rng, bms, max_depth=3)
+                        deadline = (None, 0.0)[int(rng.integers(0, 2))]
+                        got = execute(expr, cache=None, deadline_s=deadline)
+                        with rfaults.suspended():
+                            want = evaluate_naive(expr)
+                    if got != want:
+                        raise InvarianceFailure(
+                            name, bms,
+                            detail=f"fault-schedule result diverged "
+                            f"(step kind={kind}, schedule={sched})",
+                        )
+        except InvarianceFailure:
+            raise
+        except Exception as e:  # rb-ok: exception-hygiene -- the family's whole point: ANY escape past the ladder is a failure, re-wrapped with the repro schedule
+            raise InvarianceFailure(
+                name, bms,
+                detail=f"exception escaped the ladder: {e!r} (schedule={sched})",
+            ) from e
+        finally:
+            rfaults.clear()
+
+
 def random_expression(rng, leaves: List[RoaringBitmap], max_depth: int = 4):
     """Random query DAG over the given leaf bitmaps: every node kind
     (and/or/xor/n-ary andnot/not-over-explicit-universe/threshold), biased
@@ -722,6 +818,17 @@ def run_campaign(iterations: Optional[int] = None, verbose: bool = True) -> dict
             "columnar-vs-percontainer", iterations=max(1, n // 4), seed=54
         ),
         actual=max(1, n // 4),
+    )
+    # ISSUE 7: random op/query sequences under random seeded fault
+    # schedules vs the no-fault oracle — bit-exact, nothing escapes the
+    # degradation ladder (derated: each iteration is a multi-step sequence
+    # with per-step oracle recomputation)
+    _run(
+        "fault-schedule-vs-oracle",
+        lambda: verify_fault_schedule_invariance(
+            "fault-schedule-vs-oracle", iterations=max(1, n // 8), seed=55
+        ),
+        actual=max(1, n // 8),
     )
     return results
 

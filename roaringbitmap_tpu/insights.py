@@ -157,6 +157,27 @@ def pack_cache_counters() -> dict:
     }
 
 
+def robust_counters() -> dict:
+    """Fault model & degradation ladder observability (ISSUE 7):
+    degradation edges (``site->from->to``), breaker transitions
+    (``site/tier/state``), retry outcomes, deadline outcomes, and injected
+    faults by site, as plain str->int dicts (the query_counters() shape
+    convention)."""
+    from . import observe
+
+    def _joined(name):
+        m = observe.REGISTRY.get(name)
+        return {"/".join(lv): v for lv, v in m.series().items()} if m else {}
+
+    return {
+        "degrade": _joined(observe.DEGRADE_TOTAL),
+        "breaker": _joined(observe.BREAKER_TRANSITIONS_TOTAL),
+        "retry": _joined(observe.RETRY_TOTAL),
+        "deadline": _joined(observe.DEADLINE_TOTAL),
+        "faults": _joined(observe.FAULT_INJECTED_TOTAL),
+    }
+
+
 def metrics_snapshot() -> dict:
     """The full labeled registry snapshot (every rb_tpu_* metric incl.
     histograms) — the machine-readable superset of dispatch_counters();

@@ -150,6 +150,22 @@ def _load():
 
 
 def available() -> bool:
+    try:
+        # ISSUE 7 fault site: the C-tier entry probe. An injected (or
+        # classified-transient) failure here flips every caller onto the
+        # identical-semantics numpy fallbacks — the native→numpy chain
+        # exercised as a degradation, not a crash.
+        from ..robust import faults as _faults
+
+        _faults.fault_point("native.entry")
+    except Exception as e:
+        from ..robust import errors as _rerrors
+        from ..robust import ladder as _ladder
+
+        if _rerrors.classify(e) == _rerrors.FATAL:
+            raise
+        _ladder.LADDER.note_degrade("native.entry", "native", "numpy", e)
+        return False
     ok = _load() is not None
     if ok:
         _bind_ext_once()

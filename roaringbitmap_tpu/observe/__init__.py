@@ -20,9 +20,13 @@ Metric naming convention: ``rb_tpu_<layer>_<name>`` (canonical names in
 from .registry import (
     ANALYSIS_FINDINGS_TOTAL,
     BATCH_PAIRWISE_TOTAL,
+    BREAKER_TRANSITIONS_TOTAL,
     COLUMNAR_BATCH_TOTAL,
     COLUMNAR_CLASS_SECONDS,
+    DEADLINE_TOTAL,
     DEFAULT_TIME_BUCKETS,
+    DEGRADE_TOTAL,
+    FAULT_INJECTED_TOTAL,
     HOST_OP_SECONDS,
     KERNEL_DISPATCH_TOTAL,
     KERNEL_PROBE_TOTAL,
@@ -35,6 +39,7 @@ from .registry import (
     QUERY_LATENCY_SECONDS,
     QUERY_PLAN_TOTAL,
     REGISTRY,
+    RETRY_TOTAL,
     SERIAL_BYTES_TOTAL,
     SPAN_SECONDS,
     STORE_DELTA_STAGE_SECONDS,
@@ -141,4 +146,9 @@ __all__ = [
     "STORE_DELTA_STAGE_SECONDS",
     "QUERY_LATENCY_SECONDS",
     "COLUMNAR_CLASS_SECONDS",
+    "DEGRADE_TOTAL",
+    "BREAKER_TRANSITIONS_TOTAL",
+    "RETRY_TOTAL",
+    "FAULT_INJECTED_TOTAL",
+    "DEADLINE_TOTAL",
 ]

@@ -62,6 +62,13 @@ STORE_PACK_STAGE_SECONDS = "rb_tpu_store_pack_stage_seconds"
 STORE_DELTA_STAGE_SECONDS = "rb_tpu_store_delta_stage_seconds"
 QUERY_LATENCY_SECONDS = "rb_tpu_query_latency_seconds"
 COLUMNAR_CLASS_SECONDS = "rb_tpu_columnar_class_seconds"
+# fault model & degradation ladder (ISSUE 7): every degradation, breaker
+# transition, retry, injected fault, and deadline outcome is a counter
+DEGRADE_TOTAL = "rb_tpu_degrade_total"
+BREAKER_TRANSITIONS_TOTAL = "rb_tpu_breaker_transitions_total"
+RETRY_TOTAL = "rb_tpu_retry_total"
+FAULT_INJECTED_TOTAL = "rb_tpu_fault_injected_total"
+DEADLINE_TOTAL = "rb_tpu_deadline_total"
 
 # upper bucket bounds (seconds) for wall-time histograms: host phases span
 # ~100 µs packing steps to multi-second CPU folds; +Inf is implicit

@@ -43,6 +43,17 @@ try:  # pallas is optional at import time (e.g. stripped CPU envs)
 except Exception:  # pragma: no cover  # rb-ok: exception-hygiene -- optional-dep probe: any import-time failure mode (stripped build, ABI skew) must mean "no pallas", never a crash
     HAS_PALLAS = False
 
+
+def supports_dimension_semantics() -> bool:
+    """Capability probe: does this jaxlib's pallas expose the Mosaic
+    grid-dimension-semantics hint (``GridDimensionSemantics`` +
+    ``CompilerParams``)? The ``dimsem`` kernel variants require it; callers
+    (and the tier-1 variant tests) probe instead of crashing on older
+    toolchains."""
+    return HAS_PALLAS and hasattr(pltpu, "GridDimensionSemantics") and hasattr(
+        pltpu, "CompilerParams"
+    )
+
 # VMEM is ~16 MiB/core on v5e. Wide blocks: ROW_TILE*2048*4 = 2 MiB.
 # Grouped blocks: G_TILE*G_ROW_TILE*2048*4 = 4 MiB (double-buffered: 8 MiB).
 ROW_TILE = 256
@@ -566,6 +577,9 @@ def segmented_reduce_pallas(
 def best_segmented_reduce(words, seg_start, op: str = "or"):
     """Pallas one-pass segmented scan on TPU (probed, with fallback to the
     XLA associative scan)."""
+    from ..robust import faults as _faults
+
+    _faults.fault_point("ops.dispatch")
     if HAS_PALLAS and on_tpu():
         out = _probed_call("segmented", segmented_reduce_pallas, (words, seg_start), op)
         if out is not None:
@@ -881,6 +895,9 @@ def best_grouped_reduce(words3, op: str = "or"):
     """Measured-best grouped reduce: XLA by default (see GROUPED_PREFER_XLA),
     the Pallas kernel — at GROUPED_PALLAS_CONFIG's tiling — with lowering
     probe + automatic XLA fallback when preferred."""
+    from ..robust import faults as _faults
+
+    _faults.fault_point("ops.dispatch")
     if not GROUPED_PREFER_XLA and HAS_PALLAS and on_tpu():
         key_extra = _validated_key_extra(
             GROUPED_PALLAS_CONFIG, GROUPED_CONFIG_KEYS, "GROUPED_PALLAS_CONFIG"

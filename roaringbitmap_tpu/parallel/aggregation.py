@@ -43,6 +43,8 @@ from ..models.container import (
 )
 from ..models.roaring import RoaringBitmap
 from ..observe import timeline as _timeline
+from ..robust import errors as _rerrors
+from ..robust import ladder as _ladder
 from ..utils import bits
 from . import store
 
@@ -110,22 +112,11 @@ def _fold_group_words(cs: List[Container], op: str) -> np.ndarray:
     return acc
 
 
-def _cpu_aggregate(
+def _percontainer_aggregate(
     groups: Dict[int, List[Container]], op: str, pool: Optional[ThreadPoolExecutor] = None
 ) -> RoaringBitmap:
-    """CPU fold dispatcher: large OR/XOR working sets take the columnar
-    batched fold (one scatter/fill/reduceat pass over every container,
-    ISSUE 5 — single-threaded vectorized, so it also replaces the thread
-    pool); small ones keep the per-key word-fold walk below. AND stays on
-    the lazy per-group fold: its columnar variant must expand every
-    operand to words up front, measured ~2x slower than folding one
-    container at a time."""
-    from .. import columnar
-
-    if op != "and" and columnar.enabled_for_fold(
-        sum(len(cs) for cs in groups.values())
-    ):
-        return columnar.fold(groups, op)
+    """The per-container tier: per-key word-fold walk (optionally on the
+    shared pool) — no columnar batching, no device."""
     out = RoaringBitmap()
     keys = sorted(groups)
 
@@ -220,12 +211,75 @@ def _dispatch_prelude(bitmaps: Sequence[RoaringBitmap], op: str):
     return None, sum(bm.high_low_container.size for bm in bitmaps)
 
 
+def _pure_python_fold(bitmaps: Sequence[RoaringBitmap], op: str) -> RoaringBitmap:
+    """The bottom ladder rung: the reference's naive sequential folds with
+    every batching layer (columnar router included) pinned off — the
+    engine of last resort, kept deliberately free of the machinery whose
+    failure would land traffic here."""
+    from .. import columnar
+
+    with columnar.disabled():
+        if op == "or":
+            return FastAggregation.naive_or(*bitmaps)
+        if op == "xor":
+            return FastAggregation.naive_xor(*bitmaps)
+        return FastAggregation.naive_and(*bitmaps)
+
+
+def _cpu_tiers(
+    bitmaps: Sequence[RoaringBitmap],
+    keys: Optional[set],
+    n: int,
+    op: str,
+    pool: Optional[ThreadPoolExecutor] = None,
+):
+    """The CPU rungs of the aggregation ladder, cost-model-gated exactly
+    like the pre-ladder dispatch: the columnar batched fold for large
+    OR/XOR working sets (AND's columnar variant measured ~2x slower than
+    the lazy per-group fold, so AND starts per-container), the per-key
+    word-fold walk, and the pure-python naive fold as last resort. The
+    key-major transpose builds lazily ONCE and is shared by whichever
+    rung ends up running."""
+    from .. import columnar
+
+    box: Dict[str, Dict[int, List[Container]]] = {}
+
+    def _groups():
+        if "g" not in box:
+            box["g"] = store.group_by_key(bitmaps, keys_filter=keys)
+        return box["g"]
+
+    tiers = []
+    if op != "and" and columnar.enabled_for_fold(n):
+
+        def _columnar_tier():
+            with _timeline.tspan("agg.cpu", "agg", op=op, rows=n):
+                return columnar.fold(_groups(), op)
+
+        tiers.append(("columnar-cpu", _columnar_tier))
+
+    def _percontainer_tier():
+        with _timeline.tspan("agg.cpu", "agg", op=op, rows=n):
+            return _percontainer_aggregate(_groups(), op, pool=pool)
+
+    tiers.append(("per-container", _percontainer_tier))
+    tiers.append(("pure-python", lambda: _pure_python_fold(bitmaps, op)))
+    return tiers
+
+
 def _aggregate(
     bitmaps: Sequence[RoaringBitmap],
     op: str,
     mode: Optional[str] = None,
     pool: Optional[ThreadPoolExecutor] = None,
 ) -> RoaringBitmap:
+    """N-way aggregation through the degradation ladder (ISSUE 7): the
+    cost model still picks the STARTING tier (device vs columnar vs
+    per-container, exactly the pre-ladder dispatch); the ladder owns what
+    happens when a tier fails — classify, record tier health, ride the
+    next tier down, emit ``rb_tpu_degrade_total`` — one code path for
+    every degradation instead of per-site try/except scatter. Every tier
+    computes the same bits (the fuzz oracle family pins this)."""
     bitmaps = [b for b in bitmaps]
     if not bitmaps:
         return RoaringBitmap()
@@ -234,11 +288,11 @@ def _aggregate(
     keys, n = _dispatch_prelude(bitmaps, op)
     if keys is not None and not keys:
         return RoaringBitmap()
+    tiers = []
     if _use_device(n, mode):
-        return _device_aggregate(bitmaps, keys, op)
-    groups = store.group_by_key(bitmaps, keys_filter=keys)
-    with _timeline.tspan("agg.cpu", "agg", op=op, rows=n):
-        return _cpu_aggregate(groups, op, pool=pool)
+        tiers.append(("device", lambda: _device_aggregate(bitmaps, keys, op)))
+    tiers.extend(_cpu_tiers(bitmaps, keys, n, op, pool=pool))
+    return _ladder.LADDER.run("agg", tiers)
 
 
 # ---------------------------------------------------------------------------
@@ -488,14 +542,25 @@ def _aggregate_cardinality(bitmaps: List[RoaringBitmap], op: str, mode) -> int:
     keys, n = _dispatch_prelude(bitmaps, op)
     if keys is not None and not keys:
         return 0
+    tiers = []
     if _use_device(n, mode):
-        packed = store.packed_for(bitmaps, keys)  # resident-cache routed
-        if config.mesh is not None:  # same ICI-sharded reduce as _device_aggregate
-            _none, cards = _sharded_reduce(packed, op, cards_only=True)
-        else:
-            cards = store.reduce_packed_cardinality(packed, op=op)
-        return int(cards.sum())
-    return _cpu_aggregate(store.group_by_key(bitmaps, keys_filter=keys), op).get_cardinality()
+
+        def _device_tier() -> int:
+            packed = store.packed_for(bitmaps, keys)  # resident-cache routed
+            if config.mesh is not None:  # same ICI-sharded reduce as _device_aggregate
+                _none, cards = _sharded_reduce(packed, op, cards_only=True)
+            else:
+                cards = store.reduce_packed_cardinality(packed, op=op)
+            return int(cards.sum())
+
+        tiers.append(("device", _device_tier))
+    # the SAME cpu rungs as _aggregate (so degrade/breaker series name the
+    # tier that actually absorbs the traffic), counted instead of kept
+    tiers.extend(
+        (name, (lambda fn=fn: fn().get_cardinality()))
+        for name, fn in _cpu_tiers(bitmaps, keys, n, op)
+    )
+    return _ladder.LADDER.run("agg", tiers)
 
 
 class ParallelAggregation:
@@ -546,15 +611,9 @@ class ParallelAggregation:
 
     @staticmethod
     def _run(bitmaps, op, mode):
-        if not bitmaps:
-            return RoaringBitmap()
-        if len(bitmaps) == 1:
-            return bitmaps[0].clone()
-        n = sum(bm.high_low_container.size for bm in bitmaps)
-        if _use_device(n, mode):
-            return _device_aggregate(bitmaps, None, op)
-        groups = store.group_by_key(bitmaps)
-        with _timeline.tspan("agg.cpu", "agg", op=op, rows=n):
-            return _cpu_aggregate(
-                groups, op, pool=ParallelAggregation._shared_pool()
-            )
+        # same ladder-routed engine as FastAggregation (the "fork-join
+        # pool" distinction is the shared thread pool on the
+        # per-container tier) — one dispatch path, not two (ISSUE 7)
+        return _aggregate(
+            bitmaps, op, mode, pool=ParallelAggregation._shared_pool()
+        )

@@ -214,8 +214,12 @@ def collective_details(hlo_text: str) -> list:
 
     out = []
     for line in hlo_text.splitlines():
+        # match the instruction APPLICATION (opcode followed by its operand
+        # list) — newer jaxlib HLO text prints operand *references* like
+        # `all-gather.1` without a `%` sigil, so a bare name match counted
+        # every use of a collective's result as another collective
         m = re.search(
-            r"\b(all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute)(-start)?\b",
+            r"\b(all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute)(-start)?\(",
             line,
         )
         if not m or "-done" in line:

@@ -29,6 +29,9 @@ import jax.numpy as jnp
 
 from .. import observe as _observe
 from ..observe import timeline as _timeline
+from ..robust import errors as _rerrors
+from ..robust import faults as _faults
+from ..robust import ladder as _ladder
 
 # marshal stage attribution (ISSUE 6): every pack / delta-repack stage
 # lands in a log-bucketed latency histogram (p50/p99 in every export) and,
@@ -277,13 +280,24 @@ class PackedGroups:
             self.words[rows] = new_words_u32
             d = getattr(self, "_device_words", None)
             if d is not None:
-                delta = jnp.asarray(new_words_u32)
-                object.__setattr__(
-                    self,
-                    "_device_words",
-                    _timeline.fence(d.at[jnp.asarray(rows)].set(delta)),
-                )
-                _TRANSFER_TOTAL.inc(int(new_words_u32.nbytes), ("pack_delta",))
+
+                def _ship_delta():
+                    delta = jnp.asarray(new_words_u32)
+                    return d.at[jnp.asarray(rows)].set(delta)
+
+                try:
+                    shipped = self._guarded_ship(_ship_delta)
+                except Exception as e:
+                    if _rerrors.classify(e) == _rerrors.FATAL:
+                        raise
+                    # the host copy is already updated; dropping the device
+                    # rows degrades the next consumer to a re-ship instead
+                    # of serving a stale resident tensor
+                    _ladder.LADDER.note_degrade("store.ship", "device", "re-ship", e)
+                    object.__setattr__(self, "_device_words", None)
+                else:
+                    object.__setattr__(self, "_device_words", shipped)
+                    _TRANSFER_TOTAL.inc(int(new_words_u32.nbytes), ("pack_delta",))
         with _timeline.stage(
             _DELTA_STAGE_SECONDS, "republish", "delta.republish", cat="delta"
         ):
@@ -309,6 +323,22 @@ class PackedGroups:
         can never outlive the race as the entry's current layout."""
         return getattr(self, "_layout_epoch", 0)
 
+    @staticmethod
+    def _guarded_ship(build):
+        """Run one host->HBM ship/build through the fault model (ISSUE 7):
+        the ``store.ship`` (transient transfer) and ``store.hbm`` (OOM)
+        fault sites fire here, transients retry with jittered bounded
+        backoff, and anything that survives retry propagates to the tier
+        ladder above — which rides the aggregation down to a CPU tier
+        instead of failing the caller."""
+
+        def _attempt():
+            _faults.fault_point("store.ship")
+            _faults.fault_point("store.hbm")
+            return _timeline.fence(build())
+
+        return _ladder.retry("store.ship", _attempt)
+
     @property
     def device_words(self) -> jnp.ndarray:
         """The flat rows on device (transferred once, then cached)."""
@@ -319,7 +349,7 @@ class PackedGroups:
                 _PACK_STAGE_SECONDS, "ship", "pack.ship", cat="pack",
                 bytes=int(self.words.nbytes),
             ):
-                d = _timeline.fence(jnp.asarray(self.words))
+                d = self._guarded_ship(lambda: jnp.asarray(self.words))
             if self._epoch() != epoch:
                 return d  # raced a delta repack: do not publish
             _TRANSFER_TOTAL.inc(self.words.nbytes, ("flat_rows",))
@@ -357,8 +387,8 @@ class PackedGroups:
                     flat = self.device_words  # one cached ship
                     src_map = np.full(g * m, n, dtype=np.int64)
                     src_map[slots] = np.arange(n)
-                    arr = _timeline.fence(
-                        jnp.take(
+                    arr = self._guarded_ship(
+                        lambda: jnp.take(
                             flat, jnp.asarray(src_map), axis=0, mode="fill",
                             fill_value=np.uint32(fill),
                         ).reshape(g, m, dev.DEVICE_WORDS)
@@ -374,7 +404,7 @@ class PackedGroups:
                     cat="pack", groups=g, on_device=0,
                 ):
                     host = pad_groups_dense(self, fill, row_multiple)
-                    arr = _timeline.fence(jnp.asarray(host))
+                    arr = self._guarded_ship(lambda: jnp.asarray(host))
                 if self._epoch() != epoch:
                     return arr  # raced a delta repack: do not publish
                 cache[key] = arr
@@ -456,8 +486,8 @@ class PackedGroups:
                         src_map = np.full(g_b * m_b, self.n_rows, dtype=np.int64)
                         if n_b:
                             src_map[slot_rows] = src
-                        arr = _timeline.fence(
-                            jnp.take(
+                        arr = self._guarded_ship(
+                            lambda: jnp.take(
                                 flat, jnp.asarray(src_map), axis=0, mode="fill",
                                 fill_value=np.uint32(fill),
                             ).reshape(g_b, m_b, dev.DEVICE_WORDS)
@@ -478,7 +508,7 @@ class PackedGroups:
                             block.reshape(g_b * m_b, dev.DEVICE_WORDS)[slot_rows] = (
                                 self.words[src]
                             )
-                        arr = _timeline.fence(jnp.asarray(block))
+                        arr = self._guarded_ship(lambda: jnp.asarray(block))
                         pending_account.append(("padded_buckets", int(block.nbytes)))
                     out.append((idx, arr))
             if self._epoch() != epoch:
@@ -652,6 +682,7 @@ def prepare_reduce(packed: PackedGroups, op: str = "or"):
                 from .. import tracing
                 from ..ops import pallas_kernels as pk
 
+                # ops.dispatch fault site fires inside best_grouped_reduce
                 with tracing.op_timer("store.reduce.padded"):
                     return pk.best_grouped_reduce(dev_arr, op=op)
 
@@ -675,6 +706,7 @@ def prepare_reduce(packed: PackedGroups, op: str = "or"):
         from .. import tracing
         from ..ops import pallas_kernels as pk
 
+        # ops.dispatch fault site fires inside best_segmented_reduce
         with tracing.op_timer("store.reduce.segmented-scan"):
             vals = pk.best_segmented_reduce(words, seg, op=op)
             red = vals[end_rows]
@@ -724,6 +756,7 @@ def prepare_reduce_bucketed(packed: PackedGroups, op: str = "or", n_buckets: int
     def run():
         from .. import tracing
 
+        _faults.fault_point("ops.dispatch")
         with tracing.op_timer("store.reduce.bucketed"):
             return reduce_all(arrs)
 
@@ -1107,6 +1140,22 @@ class PackCache:
     # -- internals ---------------------------------------------------------
 
     def _store(self, entry: _PackEntry, ident: Optional[tuple] = None) -> _PackEntry:
+        try:
+            # budget-pressure fault site (ISSUE 7): real or injected HBM
+            # pressure at admission time must degrade — spill cold entries
+            # and serve this working set uncached — never fail the caller
+            _faults.fault_point("pack_cache.budget")
+        except Exception as e:
+            if _rerrors.classify(e) == _rerrors.FATAL:
+                raise
+            with self._lock:
+                self._evict_to(self.max_bytes // 2)
+            _ladder.LADDER.note_degrade("pack_cache.budget", "resident", "uncached", e)
+            _timeline.instant(
+                "pack_cache.pressure", "cache", kind=entry.kind,
+                bytes=entry.nbytes,
+            )
+            return entry  # consumer-owned: never marked cache-held
         with self._lock:
             existing = self._entries.get(entry.key)
             if existing is not None:
@@ -1177,6 +1226,9 @@ class PackCache:
             pg.close()
 
     def _evict_over_budget(self) -> None:
+        self._evict_to(self.max_bytes)
+
+    def _evict_to(self, target: int) -> None:
         # caller holds self._lock; LRU order, pinned entries skipped. At
         # least one UNPINNED entry always survives: a single working set
         # larger than the whole budget would otherwise thrash
@@ -1185,11 +1237,13 @@ class PackCache:
         # is ~2.4 GB. Counting pinned entries toward the survivor quota
         # would re-introduce exactly that thrash for every unpinned
         # working set once a standing pinned index fills the budget.
-        if self._bytes <= self.max_bytes:
+        # ``target < max_bytes`` is the budget-pressure spill path
+        # (_store's degrade), freeing headroom instead of failing.
+        if self._bytes <= target:
             return
         unpinned = sum(1 for e in self._entries.values() if not e.pins)
         for key in list(self._entries):
-            if self._bytes <= self.max_bytes or unpinned <= 1:
+            if self._bytes <= target or unpinned <= 1:
                 break
             e = self._entries[key]
             if e.pins:

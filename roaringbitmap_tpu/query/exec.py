@@ -42,6 +42,8 @@ from typing import Dict, List, Optional, Union
 
 from .. import observe as _observe
 from ..observe import timeline as _timeline
+from ..robust import faults as _faults
+from ..robust import ladder as _ladder
 from ..models.roaring import RoaringBitmap
 from . import kernels
 from .cache import DEFAULT_CACHE, ResultCache, cache_key
@@ -93,17 +95,27 @@ def execute(
     query: Union[Expr, Plan],
     cache: Optional[ResultCache] = DEFAULT_CACHE,
     mode: Optional[str] = None,
+    deadline_s: Optional[float] = None,
 ) -> RoaringBitmap:
     """Plan (if given an expression) and evaluate, memoizing interior
     results in ``cache`` (pass ``cache=None`` to disable memoization;
-    ``mode`` forwards to the planner's engine choice)."""
+    ``mode`` forwards to the planner's engine choice).
+
+    ``deadline_s`` arms a per-query wall-clock budget (ISSUE 7): once it
+    expires, every remaining step cancels its device engine choice down to
+    the cheapest CPU tier — the result stays bit-exact (tiers agree by
+    construction), only the remaining latency profile changes, instead of
+    queueing more device work onto a query that already blew its budget.
+    ``rb_tpu_deadline_total{site="query.exec",outcome}`` counts the
+    outcomes (met | degraded)."""
     from .. import tracing
 
     p = query if isinstance(query, Plan) else _memo_plan(query, mode)
+    degraded = False
     with tracing.op_timer("query.execute"), _timeline.stage(
         _QUERY_LATENCY, "execute", "query.execute", cat="query",
         steps=len(p.steps),
-    ):
+    ), _ladder.deadline_scope(deadline_s):
         leaf_fps = {l.uid: l.fingerprint() for l in p.root.leaves}
         results: Dict[int, RoaringBitmap] = {
             l.uid: l.bitmap for l in p.root.leaves
@@ -119,17 +131,29 @@ def execute(
                     )
                     continue
             inputs = [results[o.uid] for o in step.operands]
+            force_cpu = _ladder.deadline_expired()
+            if force_cpu and not degraded:
+                degraded = True
+                _timeline.instant(
+                    "query.deadline_degrade", "query", engine=step.engine
+                )
             with _timeline.tspan(
                 "query.step", "query", engine=step.engine, op=step.node.op
             ):
-                val = _run_step(step, inputs)
+                val = _run_step(step, inputs, force_cpu=force_cpu)
             if cache is not None:
                 cache.put(key, val)
             results[step.node.uid] = val
+        if deadline_s is not None:
+            _ladder.note_deadline(
+                "query.exec", "degraded" if degraded else "met"
+            )
         return results[p.root.uid].clone()
 
 
-def _run_step(step: PlanStep, inputs: List[RoaringBitmap]) -> RoaringBitmap:
+def _run_step(
+    step: PlanStep, inputs: List[RoaringBitmap], force_cpu: bool = False
+) -> RoaringBitmap:
     from ..parallel.aggregation import FastAggregation as FA
 
     eng, op = step.engine, step.node.op
@@ -143,7 +167,20 @@ def _run_step(step: PlanStep, inputs: List[RoaringBitmap]) -> RoaringBitmap:
         return fn(inputs[0], inputs[1])
     if eng.startswith("device-"):
         fn = {"and": FA.and_, "or": FA.or_, "xor": FA.xor}[op]
-        return fn(*inputs, mode="device")
+        if force_cpu:  # deadline blown: cancel to the cheapest tier
+            return fn(*inputs, mode="cpu")
+
+        def _device_step():
+            _faults.fault_point("query.exec")
+            return fn(*inputs, mode="device")
+
+        return _ladder.LADDER.run(
+            "query.exec",
+            [
+                ("device", _device_step),
+                ("per-container", lambda: fn(*inputs, mode="cpu")),
+            ],
+        )
     if eng == "workshy-and":
         return FA.and_(*inputs, mode="cpu")
     if eng == "naive-or":
@@ -155,9 +192,9 @@ def _run_step(step: PlanStep, inputs: List[RoaringBitmap]) -> RoaringBitmap:
     if eng == "horizontal-xor":
         return FA.horizontal_xor(*inputs)
     if eng.startswith("andnot-batch"):
-        mode = "device" if eng.endswith("[device]") else "cpu"
+        mode = "device" if eng.endswith("[device]") and not force_cpu else "cpu"
         return kernels.andnot_nway(inputs[0], *inputs[1:], mode=mode)
     if eng.startswith("threshold-bitsliced"):
-        mode = "device" if eng.endswith("[device]") else "cpu"
+        mode = "device" if eng.endswith("[device]") and not force_cpu else "cpu"
         return kernels.threshold(step.node.k, inputs, mode=mode)
     raise ValueError(f"unknown engine {eng!r}")  # pragma: no cover

@@ -33,6 +33,8 @@ import numpy as np
 
 from ..models.container import BitmapContainer, best_container_of_words
 from ..models.roaring import RoaringBitmap
+from ..robust import faults as _faults
+from ..robust import ladder as _ladder
 from ..utils import bits
 
 
@@ -110,18 +112,34 @@ def andnot_nway(
     if not rest:
         return first.clone()
     ckeys, crows = _covered(first, rest)
-    if crows and _use_device(first.high_low_container.size + crows, mode):
-        return _device_andnot(first, rest, ckeys)
-    groups = _rest_groups(first, rest)
-    out = RoaringBitmap()
-    for k, c, acc in _cpu_folds(first, groups):
-        if acc is None:
-            out.high_low_container.append(k, c.clone())
-            continue
-        res = best_container_of_words(acc)
-        if res.cardinality:
-            out.high_low_container.append(k, res)
-    return out
+
+    def _cpu_tier() -> RoaringBitmap:
+        groups = _rest_groups(first, rest)
+        out = RoaringBitmap()
+        for k, c, acc in _cpu_folds(first, groups):
+            if acc is None:
+                out.high_low_container.append(k, c.clone())
+                continue
+            res = best_container_of_words(acc)
+            if res.cardinality:
+                out.high_low_container.append(k, res)
+        return out
+
+    if (
+        crows
+        and _use_device(first.high_low_container.size + crows, mode)
+        and not _ladder.deadline_expired()
+    ):
+
+        def _device_tier() -> RoaringBitmap:
+            _faults.fault_point("query.exec")
+            return _device_andnot(first, rest, ckeys)
+
+        return _ladder.LADDER.run(
+            "query.exec",
+            [("device", _device_tier), ("per-container", _cpu_tier)],
+        )
+    return _cpu_tier()
 
 
 def andnot_nway_cardinality(
@@ -134,16 +152,32 @@ def andnot_nway_cardinality(
     if not rest:
         return first.get_cardinality()
     ckeys, crows = _covered(first, rest)
-    if crows and _use_device(first.high_low_container.size + crows, mode):
-        _, cards, passthrough, _keys = _device_andnot_parts(first, rest, ckeys)
-        return int(np.asarray(cards).astype(np.int64).sum()) + sum(
-            c.cardinality for _, c in passthrough
+
+    def _cpu_tier() -> int:
+        groups = _rest_groups(first, rest)
+        return sum(
+            c.cardinality if acc is None else bits.cardinality_of_words(acc)
+            for _k, c, acc in _cpu_folds(first, groups)
         )
-    groups = _rest_groups(first, rest)
-    return sum(
-        c.cardinality if acc is None else bits.cardinality_of_words(acc)
-        for _k, c, acc in _cpu_folds(first, groups)
-    )
+
+    if (
+        crows
+        and _use_device(first.high_low_container.size + crows, mode)
+        and not _ladder.deadline_expired()
+    ):
+
+        def _device_tier() -> int:
+            _faults.fault_point("query.exec")
+            _, cards, passthrough, _keys = _device_andnot_parts(first, rest, ckeys)
+            return int(np.asarray(cards).astype(np.int64).sum()) + sum(
+                c.cardinality for _, c in passthrough
+            )
+
+        return _ladder.LADDER.run(
+            "query.exec",
+            [("device", _device_tier), ("per-container", _cpu_tier)],
+        )
+    return _cpu_tier()
 
 
 def _device_andnot_parts(first: RoaringBitmap, rest, covered_keys: set):
@@ -275,8 +309,18 @@ def threshold(
     if not keys_ok:
         return out
     n_rows = sum(c for key, c in key_counts.items() if key in keys_ok)
-    if aggregation._use_device(n_rows, mode):
-        dev_out = _device_threshold(bms, k, keys_ok)
+    if aggregation._use_device(n_rows, mode) and not _ladder.deadline_expired():
+
+        def _device_tier():
+            _faults.fault_point("query.exec")
+            return _device_threshold(bms, k, keys_ok)
+
+        # a None return is the documented too-skewed-to-pad signal, not a
+        # failure: it falls through to the CPU fold below either way
+        dev_out = _ladder.LADDER.run(
+            "query.exec",
+            [("device", _device_tier), ("per-container", lambda: None)],
+        )
         if dev_out is not None:
             return dev_out
     groups = store.group_by_key(bms, keys_filter=keys_ok)

@@ -1,0 +1,65 @@
+"""Fault model & degradation ladder (ISSUE 7 tentpole).
+
+The robustness substrate under the pack/query pipeline: a deterministic
+fault-injection framework with named sites threaded through the real
+marshal path (``faults``), a project exception taxonomy with a
+classify-then-route contract (``errors``), and the execution-tier ladder —
+device → columnar-CPU → per-container → pure-python — with per-tier
+health tracking, circuit breakers, retry-with-jittered-backoff, and
+per-query deadline budgets (``ladder``). See ARCHITECTURE.md "Fault model
+& degradation ladder".
+
+Importing this package arms the ``RB_TPU_FAULTS`` seeded chaos schedule
+when the env var is set (the CI chaos gate's entry point).
+"""
+
+from .errors import (
+    DeadlineExceeded,
+    ResourceExhausted,
+    RobustError,
+    TierUnavailable,
+    TransientDeviceError,
+    classify,
+    simulated_oom,
+)
+from .faults import SITES, clear, fault_point, inject, install, suspended
+from .faults import active
+from .ladder import (
+    LADDER,
+    TIERS,
+    Ladder,
+    deadline_expired,
+    deadline_remaining,
+    deadline_scope,
+    retry,
+)
+
+__all__ = [
+    "RobustError",
+    "TransientDeviceError",
+    "ResourceExhausted",
+    "TierUnavailable",
+    "DeadlineExceeded",
+    "classify",
+    "simulated_oom",
+    "SITES",
+    "active",
+    "fault_point",
+    "inject",
+    "install",
+    "suspended",
+    "clear",
+    "LADDER",
+    "TIERS",
+    "Ladder",
+    "retry",
+    "deadline_scope",
+    "deadline_remaining",
+    "deadline_expired",
+]
+
+# Arm the env-specified chaos schedule once, at first import of the fault
+# framework (scripts/ci.sh: RB_TPU_FAULTS=ci-chaos-seed).
+from .faults import install_env_schedule as _install_env_schedule
+
+_install_env_schedule()

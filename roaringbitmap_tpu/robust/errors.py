@@ -1,0 +1,146 @@
+"""Project exception taxonomy for the fault model (ISSUE 7 satellite).
+
+Every fallback chain in the framework — device → columnar-CPU →
+per-container → pure-python, native C → banded-numpy, PACK_CACHE resident
+→ delta → cold repack — degrades on *some* failure; before this module
+each chain decided ad hoc what "some" meant, usually with a broad except.
+The taxonomy makes the routing decision a declared, classifiable fact:
+
+* :class:`TransientDeviceError` — a transfer/dispatch hiccup that may
+  succeed on retry (tunnel drop, queue timeout). Retried with jittered
+  backoff at the site; degrades a tier only once retries are exhausted.
+* :class:`ResourceExhausted` — HBM OOM, cache byte-budget pressure. Never
+  retried at the same tier (the resource will still be exhausted);
+  degrades immediately (or, for caches, evicts/spills).
+* :class:`TierUnavailable` — the tier cannot serve at all right now:
+  circuit breaker open, backend missing, toolchain absent. Routed past
+  without retry.
+* :class:`DeadlineExceeded` — a per-query deadline budget blew; remaining
+  work cancels to the cheapest tier instead of blowing the caller's
+  latency.
+
+``classify(exc)`` maps *any* exception — ours, jax's ``XlaRuntimeError``
+family, OS-level transport errors — onto those categories, with one
+deliberate asymmetry: programming errors (``TypeError``, ``ValueError``,
+``KeyError``, ``AssertionError``...) classify **fatal** and are re-raised
+by the ladder. A wrong-answer bug must never be silently laundered into a
+degrade — bit-exactness across tiers is the contract that makes
+degradation safe in the first place (PAPER.md §L0-L4; arXiv:1709.07821's
+cross-implementation equivalence argument).
+"""
+
+from __future__ import annotations
+
+# classification categories (returned by classify())
+TRANSIENT = "transient"
+RESOURCE = "resource"
+UNAVAILABLE = "unavailable"
+DEADLINE = "deadline"
+FATAL = "fatal"
+
+
+class RobustError(Exception):
+    """Base of the fault-model taxonomy."""
+
+    category = FATAL
+
+
+class TransientDeviceError(RobustError):
+    """Retryable transfer/dispatch failure (tunnel drop, queue timeout)."""
+
+    category = TRANSIENT
+
+
+class ResourceExhausted(RobustError):
+    """HBM / cache-budget exhaustion: degrade or spill, never retry."""
+
+    category = RESOURCE
+
+
+class TierUnavailable(RobustError):
+    """The tier cannot serve (breaker open, backend/toolchain missing)."""
+
+    category = UNAVAILABLE
+
+
+class DeadlineExceeded(RobustError):
+    """A per-query deadline budget expired mid-flight."""
+
+    category = DEADLINE
+
+
+# Substrings in an XlaRuntimeError/RuntimeError message that identify the
+# runtime's own status codes (jax surfaces absl::Status codes as text).
+# Only the resource family needs markers: every OTHER runtime-family error
+# deliberately defaults to transient (see classify below).
+_RESOURCE_MARKERS = ("RESOURCE_EXHAUSTED", "OUT_OF_MEMORY", "out of memory")
+
+
+def _xla_error_types() -> tuple:
+    """The live jaxlib runtime-error types, when importable (CPU-only and
+    jax-free installs simply classify by the stdlib rules)."""
+    types = []
+    try:
+        from jax.errors import JaxRuntimeError  # jax >= 0.4.14
+
+        types.append(JaxRuntimeError)
+    except (ImportError, AttributeError):
+        pass
+    try:
+        from jax._src.lib import xla_client
+
+        types.append(xla_client.XlaRuntimeError)
+    except (ImportError, AttributeError):
+        pass
+    return tuple(types)
+
+
+def classify(exc: BaseException) -> str:
+    """Map an exception to a fault category: ``"transient"``,
+    ``"resource"``, ``"unavailable"``, ``"deadline"``, or ``"fatal"``.
+
+    The ladder degrades on everything except ``"fatal"``; retry loops act
+    only on ``"transient"``. Unknown ``RuntimeError`` kinds (and the
+    transport ``OSError`` subclasses) default to transient — the device
+    runtimes surface transport and scheduling failures as bare
+    RuntimeErrors, and misclassifying one as fatal turns a recoverable
+    blip into an outage, while misclassifying it as transient costs one
+    bounded retry before degrading (results stay bit-exact on the lower
+    tier either way). Bare ``OSError`` stays fatal: a missing file or a
+    permission error is a deterministic misconfiguration to surface."""
+    if isinstance(exc, RobustError):
+        return exc.category
+    if isinstance(exc, MemoryError):
+        return RESOURCE
+    if isinstance(exc, (RuntimeError,) + _xla_error_types()):
+        msg = str(exc)
+        if any(m in msg for m in _RESOURCE_MARKERS):
+            return RESOURCE
+        return TRANSIENT
+    # transport errors only — NOT bare OSError: FileNotFoundError /
+    # PermissionError and friends are deterministic misconfigurations that
+    # must surface, not be retried and silently degraded around
+    if isinstance(exc, (ConnectionError, TimeoutError, InterruptedError)):
+        return TRANSIENT
+    return FATAL
+
+
+def simulated_oom(site: str) -> Exception:
+    """An HBM-OOM lookalike for fault injection: the real
+    ``XlaRuntimeError`` class carrying a ``RESOURCE_EXHAUSTED`` status
+    message when jaxlib exposes a constructible one, else
+    :class:`ResourceExhausted`. Either way ``classify()`` returns
+    ``"resource"`` — injection tests exercise the same routing the real
+    allocator failure would."""
+    msg = (
+        f"RESOURCE_EXHAUSTED: simulated HBM OOM injected at fault site "
+        f"{site!r} (rb_tpu fault injection)"
+    )
+    for t in _xla_error_types():
+        try:
+            e = t(msg)
+        except TypeError:  # non-constructible binding
+            continue
+        if classify(e) == RESOURCE:
+            return e
+    return ResourceExhausted(msg)

@@ -87,6 +87,58 @@ print("columnar parity ok (%d op pairs; %d batched container-pairs)"
       % (checked, sum(counts.values())))
 EOF
 
+step "chaos gate (ISSUE 7): tier-1 subset + differential under RB_TPU_FAULTS"
+# a tier-1 subset runs once under the fixed seeded fault schedule: every
+# injected fault must be absorbed by the degradation ladder (zero escaped
+# exceptions) and every asserted result must stay bit-exact (zero
+# divergence — the tests assert values, so a stale/partial degrade fails)
+JAX_PLATFORMS=cpu RB_TPU_FAULTS=ci-chaos-seed \
+  python -m pytest tests/test_aggregation.py tests/test_query.py -q
+# then the explicit differential: randomized op/query sequences under
+# seeded schedules vs the mid-schedule no-fault oracle, plus the fixed
+# ci-chaos-seed schedule exercised end-to-end with the new rb_tpu_*
+# robustness metric names validated against the naming convention
+JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+from roaringbitmap_tpu import fuzz, insights, observe
+from roaringbitmap_tpu.models.roaring import RoaringBitmap as RB
+from roaringbitmap_tpu.parallel.aggregation import FastAggregation as FA
+from roaringbitmap_tpu.robust import faults, ladder
+
+fuzz.verify_fault_schedule_invariance("ci-fault-differential", iterations=150, seed=56)
+print("fault-schedule differential ok (150 randomized schedules)")
+
+# the fired/degraded assertions below must gate THIS loop, not counts the
+# differential above already accumulated in the same interpreter: snapshot
+# first, assert on the delta
+before = insights.robust_counters()
+faults.install("ci-chaos-seed:0.3")
+rng = np.random.default_rng(0)
+bms = [RB(np.sort(rng.choice(1 << 20, 3000, replace=False)).astype(np.uint32))
+       for _ in range(4)]
+with faults.suspended():
+    want = FA.or_(*bms, mode="cpu")
+for _ in range(30):
+    ladder.LADDER.reset()  # keep the device tier attempting every round
+    if FA.or_(*bms, mode="device") != want:
+        raise SystemExit("chaos gate: result diverged under ci-chaos-seed")
+faults.clear()
+rc = insights.robust_counters()
+fired = sum(rc["faults"].values()) - sum(before["faults"].values())
+degraded = sum(rc["degrade"].values()) - sum(before["degrade"].values())
+if fired <= 0:
+    raise SystemExit("chaos gate: the ci-chaos-seed schedule never fired")
+if degraded <= 0:
+    raise SystemExit("chaos gate: no ladder degradations recorded under chaos")
+for name in (observe.DEGRADE_TOTAL, observe.BREAKER_TRANSITIONS_TOTAL,
+             observe.RETRY_TOTAL, observe.FAULT_INJECTED_TOTAL,
+             observe.DEADLINE_TOTAL):
+    if not (name.startswith("rb_tpu_") and name.endswith("_total")):
+        raise SystemExit("robustness metric violates naming convention: %r" % name)
+print("chaos gate ok (faults fired at %d sites; degrades %s)"
+      % (len(rc["faults"]), sorted(rc["degrade"])))
+EOF
+
 step "bench.py --smoke (end-to-end north-star path, CPU)"
 # validate the driver contract, not just the exit code: exactly the keys
 # BENCH_r*.json records, with a sane positive speedup
@@ -125,8 +177,12 @@ if m["pack_delta_rows"] != m["pack_mutated_containers"]:
                      % (m["pack_delta_rows"], m["pack_mutated_containers"]))
 if not m["delta_repack_s"] > 0:
     raise SystemExit("bench pack-cache contract: non-positive delta_repack_s %r" % m)
-print("pack-cache rows ok (hit ratio %s, delta %s rows in %ss)"
-      % (m["pack_cache_hit_ratio"], m["pack_delta_rows"], m["delta_repack_s"]))'
+if not m.get("degraded_fold_s", 0) > 0:
+    raise SystemExit("bench robustness contract: missing/non-positive degraded_fold_s %r"
+                     % m.get("degraded_fold_s"))
+print("pack-cache rows ok (hit ratio %s, delta %s rows in %ss; degraded_fold_s %s)"
+      % (m["pack_cache_hit_ratio"], m["pack_delta_rows"], m["delta_repack_s"],
+         m["degraded_fold_s"]))'
 
 step "columnar dispatch floor in the bench artifact (ISSUE 5 contract)"
 # the bench must have run its in-bench parity gate and recorded the
@@ -179,9 +235,13 @@ if not pack:
 col = m.get("registry", {}).get("rb_tpu_columnar_batch_total", {}).get("samples", [])
 if not col:
     raise SystemExit("metrics sidecar recorded no columnar batches (ISSUE 5)")
-print("metrics sidecar ok (layouts %s, %d span paths, pack-cache hits %s, columnar pairs %s)"
+deg = m.get("registry", {}).get("rb_tpu_degrade_total", {}).get("samples", [])
+if not deg:
+    raise SystemExit("metrics sidecar recorded no ladder degradations (ISSUE 7: "
+                     "the degraded_fold_s row must ride the ladder)")
+print("metrics sidecar ok (layouts %s, %d span paths, pack-cache hits %s, columnar pairs %s, degrades %s)"
       % (m["layout"], len(m["spans"]), sum(s["value"] for s in pack),
-         sum(s["value"] for s in col)))'
+         sum(s["value"] for s in col), sum(s["value"] for s in deg)))'
 
 step "timeline artifact (BENCH_TIMELINE.json schema + stage attribution, ISSUE 6)"
 # the flight-recorder artifact must be Perfetto-loadable trace-event JSON

@@ -294,6 +294,68 @@ def test_exception_hygiene(tmp_path, handler, n):
     assert len(res.findings) == n, src
 
 
+def test_exception_hygiene_classify_then_route_exempt(tmp_path):
+    """The ladder's declared degradation idiom (ISSUE 7): classify() plus
+    a (possibly nested) fatal re-raise is not a swallow."""
+    src = (
+        "def f():\n"
+        "    try:\n"
+        "        pass\n"
+        "    except Exception as e:\n"
+        "        if errors.classify(e) == errors.FATAL:\n"
+        "            raise\n"
+        "        route_down(e)\n"
+    )
+    res = _run_snippet(tmp_path, src, rules=["exception-hygiene"])
+    assert res.findings == []
+
+
+def test_exception_hygiene_classify_without_reraise_flagged(tmp_path):
+    """classify() alone is not the idiom — without a fatal re-raise path a
+    programming error is still swallowed."""
+    src = (
+        "def f():\n"
+        "    try:\n"
+        "        pass\n"
+        "    except Exception as e:\n"
+        "        log(classify(e))\n"
+    )
+    res = _run_snippet(tmp_path, src, rules=["exception-hygiene"])
+    assert len(res.findings) == 1
+
+
+def test_exception_hygiene_fault_site_rejects_pragma(tmp_path):
+    """Inside a function containing a registered fault site, a raw broad
+    except is flagged even when pragma'd (ISSUE 7 satellite: swallowing on
+    a fault-site path defeats the chaos gate)."""
+    src = (
+        "def g():\n"
+        '    faults.fault_point("store.ship")\n'
+        "    try:\n"
+        "        pass\n"
+        "    except Exception:  # rb-ok: exception-hygiene -- swallowed anyway\n"
+        "        pass\n"
+    )
+    res = _run_snippet(tmp_path, src, rules=["exception-hygiene"])
+    assert len(res.findings) == 1
+    assert "fault-site" in res.findings[0].message
+
+
+def test_exception_hygiene_fault_site_accepts_classify_route(tmp_path):
+    src = (
+        "def g():\n"
+        '    faults.fault_point("store.ship")\n'
+        "    try:\n"
+        "        pass\n"
+        "    except Exception as e:\n"
+        "        if classify(e) == FATAL:\n"
+        "            raise\n"
+        "        degrade(e)\n"
+    )
+    res = _run_snippet(tmp_path, src, rules=["exception-hygiene"])
+    assert res.findings == []
+
+
 # ---------------------------------------------------------------------------
 # metric-naming
 # ---------------------------------------------------------------------------

@@ -279,6 +279,14 @@ def test_pallas_wide_reduce_variants_interpret():
         {"fold": "linear"},
         {"w_tile": 1024, "fold": "linear", "dimsem": True},
     ):
+        if kw.get("dimsem") and not pk.supports_dimension_semantics():
+            # capability-probed skip (ISSUE 7): this jaxlib's pallas lacks
+            # GridDimensionSemantics/CompilerParams; the plain variants
+            # above were still asserted before skipping
+            pytest.skip(
+                "jax.experimental.pallas.tpu lacks GridDimensionSemantics: "
+                "the dimsem kernel variant cannot run on this jaxlib"
+            )
         red, card = pk.wide_reduce_cardinality_pallas(arr, op="or", interpret=True, **kw)
         assert np.array_equal(np.asarray(red), want), kw
         assert int(card) == want_card, kw
@@ -303,6 +311,12 @@ def test_pallas_grouped_reduce_variants_interpret():
         {"fold": "linear", "row_tile": 24},  # 24 % 8 == 0, not a power of two
         {"w_tile": 1024, "fold": "linear", "dimsem": True},
     ):
+        if kw.get("dimsem") and not pk.supports_dimension_semantics():
+            # capability-probed skip (ISSUE 7): see the wide variant above
+            pytest.skip(
+                "jax.experimental.pallas.tpu lacks GridDimensionSemantics: "
+                "the dimsem kernel variant cannot run on this jaxlib"
+            )
         red, cards = pk.grouped_reduce_cardinality_pallas(
             arr, op="or", interpret=True, **kw
         )

@@ -1,0 +1,530 @@
+"""Fault model & degradation ladder (ISSUE 7): taxonomy classification,
+deterministic fault schedules, breaker trip/half-open/recover (incl. under
+a thread hammer with the lock witness attached), retry backoff, deadline
+cancellation onto a cheaper tier, pack-cache pressure spill, and the
+end-to-end bit-exactness of every injected degradation."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import RoaringBitmap, observe, robust
+from roaringbitmap_tpu.parallel import store
+from roaringbitmap_tpu.parallel.aggregation import FastAggregation as FA
+from roaringbitmap_tpu.robust import errors, faults, ladder
+
+
+@pytest.fixture(autouse=True)
+def _fresh_robust_state():
+    """Every test starts with no armed faults, closed breakers, default
+    breaker policy, and an empty pack cache."""
+    faults.clear()
+    ladder.LADDER.reset()
+    ladder.LADDER.configure(trip_after=3, cooldown_s=5.0)
+    store.PACK_CACHE.close()
+    yield
+    faults.clear()
+    ladder.LADDER.reset()
+    ladder.LADDER.configure(trip_after=3, cooldown_s=5.0)
+    store.PACK_CACHE.close()
+
+
+def _bitmaps(n=4, seed=7):
+    rng = np.random.default_rng(seed)
+    return [
+        RoaringBitmap(
+            np.sort(rng.choice(1 << 20, 4000, replace=False)).astype(np.uint32)
+        )
+        for _ in range(n)
+    ]
+
+
+def _series(name):
+    m = observe.REGISTRY.get(name)
+    return m.series() if m else {}
+
+
+# ---------------------------------------------------------------------------
+# taxonomy
+# ---------------------------------------------------------------------------
+
+
+def test_classify_taxonomy():
+    assert errors.classify(robust.TransientDeviceError("x")) == errors.TRANSIENT
+    assert errors.classify(robust.ResourceExhausted("x")) == errors.RESOURCE
+    assert errors.classify(robust.TierUnavailable("x")) == errors.UNAVAILABLE
+    assert errors.classify(robust.DeadlineExceeded("x")) == errors.DEADLINE
+    # runtime errors carrying status text classify by marker
+    assert errors.classify(RuntimeError("RESOURCE_EXHAUSTED: hbm")) == errors.RESOURCE
+    assert errors.classify(RuntimeError("UNAVAILABLE: socket closed")) == errors.TRANSIENT
+    assert errors.classify(ConnectionError("reset")) == errors.TRANSIENT
+    assert errors.classify(MemoryError()) == errors.RESOURCE
+    # programming errors are fatal: never laundered into a degrade
+    for exc in (ValueError("v"), TypeError("t"), KeyError("k"), AssertionError("a")):
+        assert errors.classify(exc) == errors.FATAL, exc
+
+
+def test_simulated_oom_classifies_resource():
+    e = robust.simulated_oom("store.hbm")
+    assert errors.classify(e) == errors.RESOURCE
+    assert "RESOURCE_EXHAUSTED" in str(e) or isinstance(e, robust.ResourceExhausted)
+
+
+# ---------------------------------------------------------------------------
+# fault injection framework
+# ---------------------------------------------------------------------------
+
+
+def test_inject_every_after_times_semantics():
+    fired = []
+    with faults.inject("ops.dispatch", robust.TransientDeviceError, every=2):
+        for _ in range(6):
+            try:
+                faults.fault_point("ops.dispatch")
+                fired.append(0)
+            except robust.TransientDeviceError:
+                fired.append(1)
+    assert fired == [0, 1, 0, 1, 0, 1]
+    faults.clear()
+    with faults.inject("ops.dispatch", robust.TransientDeviceError, after=2):
+        fired = []
+        for _ in range(4):
+            try:
+                faults.fault_point("ops.dispatch")
+                fired.append(0)
+            except robust.TransientDeviceError:
+                fired.append(1)
+    assert fired == [0, 0, 1, 1]
+    faults.clear()
+    with faults.inject("ops.dispatch", robust.TransientDeviceError, every=1, times=2) as inj:
+        for _ in range(5):
+            try:
+                faults.fault_point("ops.dispatch")
+            except robust.TransientDeviceError:
+                pass
+        assert inj.fired == 2
+
+
+def test_unknown_site_is_loud():
+    with pytest.raises(ValueError):
+        faults.inject("no.such.site", robust.TransientDeviceError, every=1)
+
+
+def test_bad_rule_arguments_are_loud():
+    """Misuse fails at construction with ValueError, never later inside a
+    production fault_point (an every=0 would otherwise surface as a
+    ZeroDivisionError deep in store/ops code)."""
+    for kw in ({"every": 0}, {"every": -1}, {"after": -1},
+               {"every": 1, "times": 0}, {"prob": 1.5}, {}):
+        with pytest.raises(ValueError):
+            faults.inject("ops.dispatch", robust.TransientDeviceError, **kw)
+
+
+def test_active_reflects_armed_scopes():
+    assert not faults.active()
+    with faults.inject("ops.dispatch", robust.TransientDeviceError, every=1):
+        assert faults.active()
+    assert not faults.active()
+
+
+def test_suspended_masks_faults_without_advancing_hits():
+    with faults.inject("ops.dispatch", robust.TransientDeviceError, every=1):
+        with faults.suspended():
+            for _ in range(5):
+                faults.fault_point("ops.dispatch")  # must not raise
+        assert faults.site_hits().get("ops.dispatch", 0) == 0
+        with pytest.raises(robust.TransientDeviceError):
+            faults.fault_point("ops.dispatch")
+
+
+def test_schedule_replay_is_deterministic():
+    """Same RB_TPU_FAULTS spec -> byte-identical fire/no-fire decision
+    sequence at every site (the chaos gate's reproducibility contract)."""
+
+    def decisions(spec):
+        faults.install(spec)
+        out = {}
+        for site in faults.SITES:
+            seq = []
+            for _ in range(40):
+                try:
+                    faults.fault_point(site)
+                    seq.append(0)
+                except Exception:
+                    seq.append(1)
+            out[site] = seq
+        faults.clear()
+        return out
+
+    a = decisions("ci-chaos-seed:0.3")
+    b = decisions("ci-chaos-seed:0.3")
+    assert a == b
+    assert any(any(seq) for seq in a.values()), "schedule never fired at p=0.3"
+    c = decisions("other-seed:0.3")
+    assert c != a, "different seeds should give different schedules"
+
+
+def test_env_schedule_install(monkeypatch):
+    monkeypatch.setenv("RB_TPU_FAULTS", "test-seed:0.5:ops.dispatch")
+    from roaringbitmap_tpu.robust.faults import install_env_schedule
+
+    assert install_env_schedule()
+    hits = 0
+    for _ in range(30):
+        try:
+            faults.fault_point("ops.dispatch")
+        except robust.TransientDeviceError:
+            hits += 1
+        faults.fault_point("store.ship")  # unlisted site: never fires
+    assert hits > 0
+
+
+# ---------------------------------------------------------------------------
+# ladder + breaker
+# ---------------------------------------------------------------------------
+
+
+def test_ladder_degrades_and_counts():
+    calls = []
+
+    def bad():
+        calls.append("device")
+        raise robust.TransientDeviceError("x")
+
+    def good():
+        calls.append("cpu")
+        return 41
+
+    before = dict(_series(observe.DEGRADE_TOTAL))
+    assert ladder.LADDER.run("agg", [("device", bad), ("per-container", good)]) == 41
+    assert calls == ["device", "cpu"]
+    after = _series(observe.DEGRADE_TOTAL)
+    key = ("agg", "device", "per-container")
+    assert after.get(key, 0) == before.get(key, 0) + 1
+
+
+def test_ladder_fatal_errors_propagate():
+    def buggy():
+        raise ValueError("a real bug")
+
+    with pytest.raises(ValueError):
+        ladder.LADDER.run("agg", [("device", buggy), ("per-container", lambda: 1)])
+    # and the breaker did NOT count it as tier ill-health
+    assert ladder.LADDER.breaker_state("agg", "device") == "closed"
+
+
+def test_bottom_tier_failure_escapes():
+    def bad():
+        raise robust.TransientDeviceError("x")
+
+    with pytest.raises(robust.TransientDeviceError):
+        ladder.LADDER.run("agg", [("pure-python", bad)])
+
+
+def test_breaker_trips_skips_and_recovers():
+    ladder.LADDER.configure(trip_after=3, cooldown_s=0.05)
+    attempts = []
+
+    def bad():
+        attempts.append(1)
+        raise robust.TransientDeviceError("x")
+
+    for _ in range(5):
+        ladder.LADDER.run("agg", [("device", bad), ("per-container", lambda: 0)])
+    # attempts 1-3 trip the breaker; 4 and 5 are skipped without attempting
+    assert len(attempts) == 3
+    assert ladder.LADDER.breaker_state("agg", "device") == "open"
+    # cooldown elapses -> half-open admits ONE probe; success closes
+    time.sleep(0.06)
+    ok = []
+    ladder.LADDER.run("agg", [("device", lambda: ok.append(1) or 7), ("per-container", lambda: 0)])
+    assert ok and ladder.LADDER.breaker_state("agg", "device") == "closed"
+    tr = _series(observe.BREAKER_TRANSITIONS_TOTAL)
+    assert tr.get(("agg", "device", "open"), 0) >= 1
+    assert tr.get(("agg", "device", "half_open"), 0) >= 1
+    assert tr.get(("agg", "device", "closed"), 0) >= 1
+
+
+def test_breaker_half_open_failure_reopens():
+    ladder.LADDER.configure(trip_after=1, cooldown_s=0.03)
+
+    def bad():
+        raise robust.TransientDeviceError("x")
+
+    ladder.LADDER.run("agg", [("device", bad), ("per-container", lambda: 0)])
+    assert ladder.LADDER.breaker_state("agg", "device") == "open"
+    time.sleep(0.04)
+    ladder.LADDER.run("agg", [("device", bad), ("per-container", lambda: 0)])  # failed probe
+    assert ladder.LADDER.breaker_state("agg", "device") == "open"
+
+
+def test_breaker_thread_hammer_with_lockwitness():
+    """16 threads hammer a flapping tier through the ladder: no exception
+    escapes, the breaker state machine stays consistent, and the health
+    lock is a LEAF — witnessed: no lock is ever acquired while holding it,
+    so it cannot participate in any cycle."""
+    from roaringbitmap_tpu.analysis.lockwitness import LockWitness
+    from roaringbitmap_tpu.observe import timeline as tl
+
+    w = LockWitness()
+    lad = ladder.Ladder(trip_after=3, cooldown_s=0.002)
+    lad._lock = w.wrap("robust.health", lad._lock)
+    reg_lock = observe.REGISTRY._lock
+    observe.REGISTRY._lock = w.wrap("registry", reg_lock)
+    rec_lock = tl.RECORDER._lock
+    tl.RECORDER._lock = w.wrap("recorder", rec_lock)
+    prev_mode = tl.mode_name()
+    tl.configure(mode="on")  # recorder instants active during the hammer
+    stop = time.monotonic() + 1.0
+    errors_seen = []
+
+    def worker(i):
+        flip = 0
+        while time.monotonic() < stop:
+            flip += 1
+
+            def tier():
+                if flip % 3 == 0:
+                    raise robust.TransientDeviceError("flap")
+                return flip
+
+            try:
+                lad.run("agg", [("device", tier), ("per-container", lambda: -1)])
+            except Exception as e:  # nothing may escape  # rb-ok: exception-hygiene -- hammer collects escapes to assert none happened
+                errors_seen.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(16)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        tl.configure(mode=prev_mode)
+        observe.REGISTRY._lock = reg_lock
+        tl.RECORDER._lock = rec_lock
+    assert not errors_seen
+    w.assert_consistent()
+    assert w.acquisitions.get("robust.health", 0) > 0
+    # leaf property: no edge leaves the health lock
+    assert not [e for e in w.edges if e[0] == "robust.health"], sorted(w.edges)
+    assert lad.breaker_state("agg", "device") in ("closed", "open", "half_open")
+
+
+# ---------------------------------------------------------------------------
+# retry
+# ---------------------------------------------------------------------------
+
+
+def test_retry_recovers_and_counts():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise robust.TransientDeviceError("blip")
+        return "ok"
+
+    assert ladder.retry("store.ship", flaky, base_s=0.001) == "ok"
+    assert len(calls) == 3
+
+
+def test_retry_not_retryable_raises_immediately():
+    calls = []
+
+    def oom():
+        calls.append(1)
+        raise robust.ResourceExhausted("hbm full")
+
+    with pytest.raises(robust.ResourceExhausted):
+        ladder.retry("store.ship", oom, base_s=0.001)
+    assert len(calls) == 1
+
+
+def test_retry_exhausts_attempts():
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise robust.TransientDeviceError("down")
+
+    with pytest.raises(robust.TransientDeviceError):
+        ladder.retry("store.ship", always, attempts=3, base_s=0.001)
+    assert len(calls) == 3
+
+
+def test_retry_respects_deadline():
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise robust.TransientDeviceError("down")
+
+    with ladder.deadline_scope(0.0005):
+        time.sleep(0.001)
+        with pytest.raises(robust.TransientDeviceError):
+            ladder.retry("store.ship", always, attempts=10, base_s=0.05)
+    assert len(calls) == 1  # no sleeping past an expired budget
+
+
+def test_jitter_is_bounded_and_deterministic():
+    for a in range(1, 6):
+        d1 = ladder._jitter("store.ship", a, 0.01, 0.25)
+        d2 = ladder._jitter("store.ship", a, 0.01, 0.25)
+        assert d1 == d2
+        assert 0 < d1 <= 0.25
+
+
+# ---------------------------------------------------------------------------
+# pipeline integration: injected faults end-to-end, bit-exact
+# ---------------------------------------------------------------------------
+
+
+def test_device_dispatch_fault_degrades_bit_exact():
+    bms = _bitmaps()
+    want = FA.naive_or(*bms)
+    with faults.inject("ops.dispatch", robust.TransientDeviceError, every=1) as inj:
+        got = FA.or_(*bms, mode="device")
+    assert got == want
+    assert inj.fired >= 1
+    deg = _series(observe.DEGRADE_TOTAL)
+    assert deg.get(("agg", "device", "columnar-cpu"), 0) >= 1 or deg.get(
+        ("agg", "device", "per-container"), 0
+    ) >= 1
+
+
+def test_hbm_oom_fault_degrades_bit_exact():
+    bms = _bitmaps(seed=11)
+    want = FA.naive_or(*bms)
+    with faults.inject("store.hbm", robust.simulated_oom, every=1) as inj:
+        got = FA.or_(*bms, mode="device")
+    assert got == want
+    assert inj.fired >= 1
+
+
+def test_transient_ship_fault_recovers_via_retry():
+    bms = _bitmaps(seed=13)
+    want = FA.naive_or(*bms)
+    with faults.inject("store.ship", robust.TransientDeviceError, every=1, times=1):
+        got = FA.or_(*bms, mode="device")
+    assert got == want
+    retry = _series(observe.RETRY_TOTAL)
+    assert retry.get(("store.ship", "recovered"), 0) >= 1
+    # the ladder saw NO failure: retry absorbed the blip below it
+    assert ladder.LADDER.breaker_state("agg", "device") == "closed"
+
+
+def test_pack_cache_pressure_spills_not_fails():
+    bms = _bitmaps(seed=17)
+    with faults.inject("pack_cache.budget", robust.ResourceExhausted, every=1) as inj:
+        packed = store.packed_for(bms)
+    assert inj.fired >= 1
+    fresh = store.pack_groups(store.group_by_key(bms))
+    assert np.array_equal(packed.words, fresh.words)
+    assert len(store.PACK_CACHE) == 0  # served uncached under pressure
+    deg = _series(observe.DEGRADE_TOTAL)
+    assert deg.get(("pack_cache.budget", "resident", "uncached"), 0) >= 1
+    # pressure gone: the next pack is resident again
+    packed2 = store.packed_for(bms)
+    assert len(store.PACK_CACHE) == 1
+    assert store.packed_for(bms) is packed2
+
+
+def test_columnar_native_fault_routes_to_numpy():
+    from roaringbitmap_tpu import columnar
+    from roaringbitmap_tpu.columnar import kernels as ck
+
+    if not ck.has_native():
+        pytest.skip("no native tier to fault")
+    bms = _bitmaps(2, seed=19)
+    a, b = bms
+    a.run_optimize()
+    with faults.inject("columnar.kernel", robust.TransientDeviceError, every=1):
+        got_and = columnar.pairwise("and", a, b)
+        got_card = columnar.and_cardinality_pair(a, b)
+    with columnar.disabled():
+        assert got_and == RoaringBitmap.and_(a, b)
+        assert got_card == RoaringBitmap.and_cardinality(a, b)
+
+
+def test_native_entry_fault_falls_to_numpy_tier():
+    bms = _bitmaps(seed=23)
+    want = FA.naive_or(*bms)
+    with faults.inject("native.entry", robust.TransientDeviceError, every=1):
+        assert FA.or_(*bms, mode="cpu") == want
+
+
+def test_query_exec_fault_degrades_bit_exact():
+    from roaringbitmap_tpu.query import Q, evaluate_naive, execute
+
+    bms = _bitmaps(seed=29)
+    expr = Q.andnot(Q.leaf(bms[0]), Q.leaf(bms[1]), Q.leaf(bms[2]))
+    with faults.inject("query.exec", robust.TransientDeviceError, every=1):
+        got = execute(expr, cache=None, mode="device")
+    assert got == evaluate_naive(expr)
+
+
+# ---------------------------------------------------------------------------
+# deadlines
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_cancels_to_cheaper_tier():
+    """An expired budget forces every remaining step onto the cheapest CPU
+    tier — same bits, counted as a degraded outcome."""
+    from roaringbitmap_tpu.query import Q, evaluate_naive, execute
+
+    bms = _bitmaps(seed=31)
+    expr = Q.or_(
+        Q.and_(Q.leaf(bms[0]), Q.leaf(bms[1])),
+        Q.xor(Q.leaf(bms[2]), Q.leaf(bms[3])),
+    )
+    before = dict(_series(observe.DEADLINE_TOTAL))
+    got = execute(expr, cache=None, mode="device", deadline_s=0.0)
+    assert got == evaluate_naive(expr)
+    after = _series(observe.DEADLINE_TOTAL)
+    key = ("query.exec", "degraded")
+    assert after.get(key, 0) == before.get(key, 0) + 1
+    # a generous budget reports "met"
+    got2 = execute(expr, cache=None, deadline_s=60.0)
+    assert got2 == evaluate_naive(expr)
+    assert _series(observe.DEADLINE_TOTAL).get(("query.exec", "met"), 0) >= 1
+
+
+def test_deadline_scope_nesting_keeps_tighter():
+    with ladder.deadline_scope(60.0):
+        outer = ladder.deadline_remaining()
+        with ladder.deadline_scope(0.001):
+            inner = ladder.deadline_remaining()
+            assert inner < outer
+            with ladder.deadline_scope(None):  # inherits, never widens
+                assert ladder.deadline_remaining() <= inner
+        assert ladder.deadline_remaining() > 1.0
+    assert ladder.deadline_remaining() is None
+
+
+# ---------------------------------------------------------------------------
+# fuzz family smoke (the 10k campaign runs it at scale)
+# ---------------------------------------------------------------------------
+
+
+def test_fault_schedule_fuzz_family_smoke():
+    from roaringbitmap_tpu import fuzz
+
+    fuzz.verify_fault_schedule_invariance(
+        "fault-schedule-vs-oracle", iterations=25, seed=55
+    )
+
+
+def test_insights_robust_counters_shape():
+    from roaringbitmap_tpu import insights
+
+    bms = _bitmaps(seed=37)
+    with faults.inject("ops.dispatch", robust.TransientDeviceError, every=1):
+        FA.or_(*bms, mode="device")
+    rc = insights.robust_counters()
+    assert set(rc) == {"degrade", "breaker", "retry", "deadline", "faults"}
+    assert rc["faults"].get("ops.dispatch", 0) >= 1
+    assert any(k.startswith("agg/device/") for k in rc["degrade"])
