@@ -43,8 +43,9 @@ _REG_METHODS = {"counter", "gauge", "histogram", "latency_histogram"}
 # registration methods whose metrics measure seconds (unit suffix required)
 _SECONDS_METHODS = {"latency_histogram"}
 _ALL_CAPS = re.compile(r"^[A-Z][A-Z0-9_]*$")
-# constant names that read as canonical metric names (unit-suffixed)
-_SHAPED_CONST = re.compile(r"^[A-Z][A-Z0-9_]*_(TOTAL|SECONDS|BYTES|COUNT)$")
+# constant names that read as canonical metric names (unit-suffixed; RATIO
+# is the dimensionless gauge unit — e.g. rb_tpu_store_overlap_ratio)
+_SHAPED_CONST = re.compile(r"^[A-Z][A-Z0-9_]*_(TOTAL|SECONDS|BYTES|COUNT|RATIO)$")
 
 
 def _literal_label_tuple(node: ast.AST) -> bool:
@@ -113,7 +114,7 @@ class MetricNaming(Checker):
                     # shaped names are validated here where they're defined
                     looks_like_metric = (
                         v.startswith("rb")
-                        or re.search(r"_(total|seconds|bytes|count)$", v)
+                        or re.search(r"_(total|seconds|bytes|count|ratio)$", v)
                         or _SHAPED_CONST.match(t.id)
                     )
                     if looks_like_metric and not v.startswith(PREFIX):
@@ -213,7 +214,7 @@ class MetricNaming(Checker):
                     call,
                     f"metric name constant {term} is neither defined in this "
                     f"module nor unit-suffixed (_TOTAL/_SECONDS/_BYTES/"
-                    f"_COUNT): the prefix cannot be verified",
+                    f"_COUNT/_RATIO): the prefix cannot be verified",
                 )
             return
         yield self.finding(

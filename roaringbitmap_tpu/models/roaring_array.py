@@ -81,6 +81,13 @@ class RoaringArray:
         self._version += 1
         self._unattributed_version = self._version
 
+    def wholesale_since(self, version: int) -> bool:
+        """Did a wholesale (key-less) mutation land after ``version``?
+        The O(1) pre-check that lets the pack cache's delta validator skip
+        the per-key dirty scan entirely when ``mark_all_dirty`` already
+        forced a full repack (ISSUE 8 satellite)."""
+        return self._unattributed_version > int(version)
+
     def dirty_keys_since(self, version: int) -> Optional[Set[int]]:
         """Chunk keys whose containers were mutated after ``version``
         (touched, inserted, replaced, or removed), or ``None`` when the

@@ -44,6 +44,7 @@ from .registry import (
     SPAN_SECONDS,
     STORE_DELTA_STAGE_SECONDS,
     STORE_LAYOUT_TOTAL,
+    STORE_OVERLAP_RATIO,
     STORE_PACK_STAGE_SECONDS,
     STORE_RESIDENT_BYTES,
     STORE_TRANSFER_BYTES_TOTAL,

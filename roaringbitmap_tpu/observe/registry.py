@@ -41,6 +41,9 @@ KERNEL_PROBE_TOTAL = "rb_tpu_kernel_probe_total"
 STORE_LAYOUT_TOTAL = "rb_tpu_store_layout_total"
 STORE_TRANSFER_BYTES_TOTAL = "rb_tpu_store_transfer_bytes_total"
 STORE_RESIDENT_BYTES = "rb_tpu_store_resident_bytes"
+# overlap shipping lane (ISSUE 8): fraction of staged marshal wall hidden
+# behind the previous query's compute (0 = fully serial, 1 = fully hidden)
+STORE_OVERLAP_RATIO = "rb_tpu_store_overlap_ratio"
 PACK_CACHE_HITS_TOTAL = "rb_tpu_pack_cache_hits_total"
 PACK_CACHE_MISSES_TOTAL = "rb_tpu_pack_cache_misses_total"
 PACK_CACHE_DELTA_ROWS_TOTAL = "rb_tpu_pack_cache_delta_rows_total"

@@ -27,6 +27,22 @@ from jax import lax
 DEVICE_WORDS = 2048  # uint32 words per container row
 HOST_WORDS = 1024  # uint64 words per container
 
+
+def pow2(k: int) -> int:
+    """Pow2 bucket length (min 8) for variable-length jit operands — the
+    retrace-bounding discipline shared by the marshal kernels (payload
+    expansion, donated delta scatter)."""
+    return max(8, 1 << (max(1, int(k)) - 1).bit_length())
+
+
+def pad_pow2(arr: np.ndarray, fill) -> np.ndarray:
+    """Pad ``arr`` to its pow2 bucket with ``fill`` (an out-of-range id
+    for index streams — scatter ``mode="drop"`` discards the padding)."""
+    kp = pow2(len(arr))
+    out = np.full(kp, fill, dtype=arr.dtype)
+    out[: len(arr)] = arr
+    return out
+
 _INIT = {
     "or": np.uint32(0),
     "xor": np.uint32(0),

@@ -211,6 +211,29 @@ def _dispatch_prelude(bitmaps: Sequence[RoaringBitmap], op: str):
     return None, sum(bm.high_low_container.size for bm in bitmaps)
 
 
+def prefetch(bitmaps, op: str = "or", mode: Optional[str] = None):
+    """Stage a working set's pack + host→HBM expansion on the overlap
+    shipping lane (ISSUE 8 leg 3): call with the NEXT query's operands
+    while the current query reduces, and its eventual dispatch finds the
+    pack resident. The SAME dispatch prelude as the engines (AND key
+    intersection, device cost gate), so only working sets that would ride
+    the device path stage — a CPU-bound job never burns lane time.
+    Returns the staging ticket, or None when nothing stages (CPU route,
+    trivial AND, lane window full)."""
+    bitmaps = _flatten((bitmaps,)) if hasattr(bitmaps, "high_low_container") \
+        else [b for b in bitmaps]
+    if len(bitmaps) < 2:
+        return None
+    keys, n = _dispatch_prelude(bitmaps, op)
+    if keys is not None and not keys:
+        return None  # trivial AND: nothing will pack
+    if not _use_device(n, mode):
+        return None
+    from . import overlap
+
+    return overlap.LANE.prefetch(bitmaps, keys)
+
+
 def _pure_python_fold(bitmaps: Sequence[RoaringBitmap], op: str) -> RoaringBitmap:
     """The bottom ladder rung: the reference's naive sequential folds with
     every batching layer (columnar router included) pinned off — the

@@ -1,0 +1,308 @@
+"""Double-buffered host→HBM shipping lane (ISSUE 8 tentpole, leg 3).
+
+Back-to-back device queries used to serialize marshal and compute: pack,
+ship, reduce, repeat — the device idles while the host marshals, the host
+idles while the device reduces. This module overlaps them: ``prefetch``
+stages the NEXT query's pack + device expansion on a dedicated shipping
+thread (the work lands in ``store.PACK_CACHE``, so the consumer's normal
+``packed_for`` lookup comes back resident), and ``wait`` joins the staged
+work under the ``overlap_wait`` pack stage — the only marshal time the
+consumer still pays is whatever the previous query's compute did not hide.
+
+Double-buffered, not queued: at most ``depth`` (default 1) stagings are in
+flight; a prefetch past the window is dropped (returns None) rather than
+growing an unbounded backlog of multi-GB working sets. JAX async dispatch
+does the same for the device side; explicit fences
+(``observe.timeline.fence``) keep the traced twin rows truthful.
+
+Adaptive threading: a shipping lane only hides marshal time when there is
+a second core (or a DMA engine) to run it on. On a single-core host the
+lane thread just time-slices against the consumer's reduce — same total
+work plus context-switch and cache-thrash tax (measured ~7% of the
+4-query twin wall on the 1-core bench host). ``threading_mode`` therefore
+defaults to ``"auto"``: threaded when ``os.cpu_count() > 1``, standing
+down to inline staging otherwise (``prefetch`` returns None and the
+consumer's normal ``packed_for`` packs synchronously — the same bits, no
+lane tax). ``configure("on"/"off")`` pins it for tests and tuning.
+
+Fault threading (ISSUE 8 satellite): the staging job runs the REAL
+pipeline, so the ``store.expand`` / ``store.ship`` / ``store.hbm`` fault
+sites fire on the lane thread. A failed staging never propagates from
+``prefetch``; ``wait`` classifies it — FATAL re-raises (degradation must
+never launder a wrong-answer bug), anything else degrades to synchronous
+packing on the consumer thread (``rb_tpu_degrade_total{site="store.expand",
+from="lane",to="sync"}``) which is bit-exact by construction.
+
+``rb_tpu_store_overlap_ratio`` gauges the cumulative fraction of staged
+marshal wall hidden behind compute: 0 = the consumer waited out every
+staging (fully serial), 1 = every staging finished before the consumer
+arrived (fully hidden).
+
+Lock discipline: the lane lock is a leaf over the staging bookkeeping only
+— the staged job itself runs OUTSIDE it (it takes the pack-cache lock), so
+lane -> pack.cache never nests.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .. import observe as _observe
+from ..observe import timeline as _timeline
+from ..robust import errors as _rerrors
+from ..robust import ladder as _ladder
+from . import store
+
+_OVERLAP_RATIO = _observe.gauge(
+    _observe.STORE_OVERLAP_RATIO,
+    "Fraction of staged marshal wall hidden behind compute by the overlap "
+    "shipping lane (cumulative)",
+    ("lane",),
+)
+
+
+class _Staging:
+    __slots__ = ("future", "t_submit", "duration_s")
+
+    def __init__(self, future: Future):
+        self.future = future
+        self.t_submit = time.monotonic()
+        self.duration_s = 0.0  # staged marshal wall, set by the lane thread
+
+
+class ShipLane:
+    """The double-buffered shipping lane (module singleton ``LANE``)."""
+
+    _MODES = ("auto", "on", "off")
+
+    def __init__(self, depth: int = 1, threading_mode: str = "auto"):
+        if depth < 1:
+            raise ValueError(f"lane depth must be >= 1, got {depth}")
+        if threading_mode not in self._MODES:
+            raise ValueError(
+                f"lane threading_mode must be one of {self._MODES}, "
+                f"got {threading_mode!r}"
+            )
+        self.depth = int(depth)
+        self.threading_mode = threading_mode
+        self._lock = threading.Lock()
+        self._pending: Dict[tuple, _Staging] = {}  # guarded-by: self._lock
+        self._staged_s = 0.0  # guarded-by: self._lock
+        self._hidden_s = 0.0  # guarded-by: self._lock
+        self._pool: Optional[ThreadPoolExecutor] = None  # guarded-by: self._lock
+
+    def configure(self, threading_mode: str) -> None:
+        """Pin the lane's threading decision (see the module docstring)."""
+        if threading_mode not in self._MODES:
+            raise ValueError(
+                f"lane threading_mode must be one of {self._MODES}, "
+                f"got {threading_mode!r}"
+            )
+        self.threading_mode = threading_mode
+
+    def threaded(self) -> bool:
+        """Is there parallelism for the lane to exploit? (``auto``: yes iff
+        the host has more than one core.)"""
+        mode = self.threading_mode
+        if mode == "on":
+            return True
+        if mode == "off":
+            return False
+        return (os.cpu_count() or 1) > 1
+
+    # -- internals ---------------------------------------------------------
+
+    def _executor(self) -> ThreadPoolExecutor:
+        with self._lock:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="rb-ship-lane"
+                )
+            return self._pool
+
+    @staticmethod
+    def _key(bitmaps: Sequence, marker: str) -> tuple:
+        return (marker, tuple(bm.fingerprint() for bm in bitmaps))
+
+    def _stage(self, bitmaps: List, keys_filter: Optional[set], st: _Staging):
+        """Runs on the lane thread: the REAL pack + device expansion (all
+        fault sites live), fenced so the staging duration is truthful."""
+        t0 = time.monotonic()
+        try:
+            with _timeline.tspan("overlap.stage", "overlap", n=len(bitmaps)):
+                packed = store.packed_for(bitmaps, keys_filter)
+                _timeline.fence(packed.device_words)
+            return packed
+        finally:
+            st.duration_s = time.monotonic() - t0
+
+    # -- public API --------------------------------------------------------
+
+    def prefetch(self, bitmaps: Sequence, keys_filter: Optional[set] = None):
+        """Stage this working set's pack + expansion on the lane thread.
+        Returns the staging ticket, or None when the window is full (the
+        double-buffer discipline) or the set is already staged/resident.
+        Staging failures surface at ``wait``/``join`` — the only exception
+        this can raise is a FATAL parked in an orphaned staging it reaps
+        (same contract as ``drain``)."""
+        if not self.threaded():
+            # single-core stand-down: staging would time-slice against the
+            # consumer's compute for the same total work plus switch tax —
+            # the consumer's synchronous pack is strictly cheaper
+            _timeline.instant("overlap.inline", "overlap")
+            return None
+        bitmaps = list(bitmaps)
+        key = self._key(bitmaps, "all" if keys_filter is None else "and")
+        reaped: List[_Staging] = []
+        with self._lock:
+            if key in self._pending:
+                return self._pending[key]
+            if len(self._pending) >= self.depth:
+                # self-healing: a staging whose consumer never joined (e.g.
+                # its bitmaps mutated, so the join key no longer matches)
+                # must not wedge the window forever — reap finished futures
+                # before declaring the window full (results stay in the
+                # pack cache; only the bookkeeping is dropped, like drain)
+                for k in [
+                    k for k, s in self._pending.items() if s.future.done()
+                ]:
+                    reaped.append(self._pending.pop(k))
+            full = len(self._pending) >= self.depth
+        # discard orphans BEFORE inserting our own staging: a FATAL parked
+        # in one re-raises here, and an already-inserted entry would be a
+        # never-submitted Future that wedges every later wait on its key
+        for orphan in reaped:
+            _timeline.instant("overlap.reap", "overlap")
+            try:
+                orphan.future.result()
+            except Exception as e:  # rb-ok: exception-hygiene -- reap mirrors drain's non-fatal discard; FATAL re-raises (degradation must never launder a wrong-answer bug)
+                if _rerrors.classify(e) == _rerrors.FATAL:
+                    raise
+        if full:
+            _timeline.instant("overlap.window_full", "overlap")
+            return None
+        with self._lock:
+            st = self._pending.get(key)
+            if st is not None:
+                return st
+            if len(self._pending) >= self.depth:  # lost a concurrent race
+                _timeline.instant("overlap.window_full", "overlap")
+                return None
+            st = _Staging(Future())
+            self._pending[key] = st
+        # submit OUTSIDE the lock: executor init + enqueue take their own
+        # locks, and the job itself takes the pack-cache lock
+        def _run():
+            try:
+                st.future.set_result(self._stage(bitmaps, keys_filter, st))
+            except BaseException as e:  # rb-ok: exception-hygiene -- lane boundary: the exception is parked in the Future and classified at wait(); FATAL re-raises there, everything else degrades to the synchronous pack
+                st.future.set_exception(e)
+
+        try:
+            self._executor().submit(_run)
+        except BaseException:
+            # a failed enqueue must not leave a never-completed Future in
+            # the window (wait on it would block forever)
+            with self._lock:
+                self._pending.pop(key, None)
+            raise
+        return st
+
+    def wait(self, bitmaps: Sequence, keys_filter: Optional[set] = None):
+        """Join this working set's staging (if any): returns the resident
+        pack, or None when nothing was staged or the staging failed
+        non-fatally — the caller's normal ``packed_for`` then packs
+        synchronously, bit-exact. Accounts the ``overlap_wait`` stage and
+        the overlap-ratio gauge."""
+        return self._join(
+            self._key(list(bitmaps), "all" if keys_filter is None else "and")
+        )
+
+    def join(self, bitmaps: Sequence, op: str = "or"):
+        """``wait`` addressed by the op instead of the prelude's keys
+        filter: the lane key only distinguishes AND's key-filtered pack
+        from all-keys packs, so a consumer that has not (yet) paid the
+        dispatch prelude — the AND key intersection the consuming engine
+        will compute anyway — can still pop its staging."""
+        return self._join(
+            self._key(list(bitmaps), "and" if op == "and" else "all")
+        )
+
+    def _join(self, key: tuple):
+        with self._lock:
+            st = self._pending.pop(key, None)
+        if st is None:
+            return None
+        t0 = time.monotonic()
+        try:
+            with _timeline.stage(
+                store._PACK_STAGE_SECONDS, "overlap_wait", "pack.overlap_wait",
+                cat="pack",
+            ):
+                packed = st.future.result()
+        except Exception as e:
+            if _rerrors.classify(e) == _rerrors.FATAL:
+                raise
+            _ladder.LADDER.note_degrade("store.expand", "lane", "sync", e)
+            return None
+        waited = time.monotonic() - t0
+        with self._lock:
+            self._staged_s += st.duration_s
+            self._hidden_s += max(0.0, st.duration_s - waited)
+            ratio = self._hidden_s / self._staged_s if self._staged_s else 0.0
+        _OVERLAP_RATIO.set(round(ratio, 4), ("ship",))
+        return packed
+
+    def drain(self) -> None:
+        """Join every in-flight staging and drop the bookkeeping (tests,
+        mode flips): staged results stay in the pack cache, failures are
+        discarded here exactly like a non-fatal wait."""
+        with self._lock:
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for st in pending:
+            try:
+                st.future.result()
+            except Exception as e:  # rb-ok: exception-hygiene -- drain mirrors wait's non-fatal discard; FATAL would have re-raised at a real wait and the staging result is unused here
+                if _rerrors.classify(e) == _rerrors.FATAL:
+                    raise
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "staged_s": self._staged_s,
+                "hidden_s": self._hidden_s,
+                "pending": len(self._pending),
+            }
+
+
+LANE = ShipLane()
+
+
+def run_pipelined(
+    jobs: Sequence[Tuple[Sequence, str]], mode: Optional[str] = None
+) -> List:
+    """Run back-to-back N-way aggregations with the marshal lane: for each
+    ``(bitmaps, op)`` job, the NEXT job's pack + device expansion stages on
+    the lane thread while the current job reduces — steady-state traffic
+    never idles the device on the host marshal (ISSUE 8 leg 3).
+
+    Equivalent to ``[FastAggregation.<op>(*bitmaps, mode=mode), ...]`` —
+    same engines, same ladder, same bits; only the staging overlaps."""
+    from . import aggregation
+
+    jobs = [(list(bms), op) for bms, op in jobs]
+    out = []
+    for i, (bms, op) in enumerate(jobs):
+        # join our own staging (overlap_wait) by op marker — the dispatch
+        # prelude (AND key intersection) is left to _aggregate, which pays
+        # it exactly once per job
+        LANE.join(bms, op)
+        if i + 1 < len(jobs):
+            aggregation.prefetch(jobs[i + 1][0], jobs[i + 1][1], mode=mode)
+        out.append(aggregation._aggregate(bms, op, mode))
+    return out

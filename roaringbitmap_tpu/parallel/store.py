@@ -9,11 +9,22 @@ key/group tables. Aggregations then run as a single fused XLA/Pallas
 computation over the whole set (ops/device.py) instead of a per-container
 virtual-dispatch fold.
 
-Array and run containers are expanded to bitmap words during packing — the
+Array and run containers are expanded to bitmap words — the
 ``toBitmapContainer`` analogue (Container.java:987) — because on TPU the
 dense form is the only one the VPU can chew on; results are re-compressed to
 the best container form when streamed back (best_container_of_words, the
 ``repairAfterLazy`` + conversion step).
+
+Since ISSUE 8 the expansion no longer happens on the host at pack time:
+packing collects a compact :class:`RowPayload` (zero-copy borrows of array
+values, run intervals, bitmap words), and the expansion to ``[N, 2048]``
+word rows runs device-side at first touch (``ops/pallas_kernels
+.expand_rows_device`` on accelerators; fused expand-into-the-staging-buffer
++ ``device_put`` on the CPU backend). Delta repacks patch the resident flat
+rows with a DONATED row scatter — O(k·2048) words in place, never a
+full-tensor copy — and back-to-back query traffic can stage the next
+working set's expansion on the overlap lane (parallel/overlap.py) while the
+current reduce runs.
 """
 
 from __future__ import annotations
@@ -21,10 +32,10 @@ from __future__ import annotations
 import os
 import threading
 from collections import OrderedDict
-from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from .. import observe as _observe
@@ -40,7 +51,8 @@ from ..robust import ladder as _ladder
 _PACK_STAGE_SECONDS = _observe.latency_histogram(
     _observe.STORE_PACK_STAGE_SECONDS,
     "Wall time of marshal pack stages (key_plan | group_tables | "
-    "host_words | provenance | dense_pad_plan | ship | padded_build | "
+    "payload_build | host_words | fingerprints | provenance | "
+    "dense_pad_plan | device_expand | ship | overlap_wait | padded_build | "
     "bucket_build)",
     ("stage",),
 )
@@ -106,7 +118,12 @@ _PACK_RESIDENT = _observe.gauge(
     ("kind",),
 )
 
-from ..models.container import ArrayContainer, BitmapContainer, Container
+from ..models.container import (
+    ArrayContainer,
+    BitmapContainer,
+    Container,
+    RunContainer,
+)
 from ..models.roaring import RoaringBitmap
 from ..ops import device as dev
 from ..utils import bits
@@ -121,66 +138,443 @@ def container_words_u32(c: Container) -> np.ndarray:
     return np.ascontiguousarray(w, dtype=np.uint64).view(np.uint32)
 
 
-def pack_rows_host(containers: Sequence[Container]) -> np.ndarray:
-    """Expand containers into one uint32 [N, 2048] host array.
+# ---------------------------------------------------------------------------
+# compact marshal payloads + expansion dispatch (ISSUE 8 tentpole, leg 1)
+# ---------------------------------------------------------------------------
 
-    Vectorized toBitmapContainer (Container.java:987) for the packing hot
-    path: bitmap rows are bulk-copied, and all array-container values are
-    scattered in a single ``np.bitwise_or.at`` over the flattened word
-    matrix (one C-level pass over every value) instead of a per-container
-    python loop; run rows (rare in working sets that were not
-    run_optimized) fall back to per-container expansion."""
+# bytes per flat device row (uint32 [2048])
+ROW_BYTES = dev.DEVICE_WORDS * 4
+
+# Expansion mode for the flat device rows (RB_TPU_EXPAND / configure_expansion):
+#   "auto"   — CPU backend: expand straight into the transfer staging buffer
+#              (one materialization) and device_put it; accelerators: ship
+#              the compact payload and run the fused jit expansion kernel.
+#   "device" — force the jit expansion kernel on every backend (tests).
+#   "host"   — the degradation path: host word expansion + device_put ship.
+#   "legacy" — the pre-ISSUE-8 pipeline verbatim (eager ``jnp.asarray``
+#              ship of host words): kept as the serial twin for the bench's
+#              overlap row and as an emergency escape hatch.
+_EXPAND_MODES = ("auto", "device", "host", "legacy")
+_EXPAND_MODE = os.environ.get("RB_TPU_EXPAND", "auto").strip().lower() or "auto"
+if _EXPAND_MODE not in _EXPAND_MODES:
+    raise ValueError(
+        f"RB_TPU_EXPAND must be one of {_EXPAND_MODES}, got {_EXPAND_MODE!r}"
+    )
+
+
+def configure_expansion(mode: str) -> None:
+    """Runtime override of the flat-row expansion mode (see _EXPAND_MODES)."""
+    global _EXPAND_MODE
+    if mode not in _EXPAND_MODES:
+        raise ValueError(f"expansion mode must be one of {_EXPAND_MODES}, got {mode!r}")
+    _EXPAND_MODE = mode
+
+
+def expansion_mode() -> str:
+    return _EXPAND_MODE
+
+
+class RowPayload:
+    """Compact marshal payload for one packed row block: array value
+    vectors, run interval vectors, and bitmap word arrays collected (as
+    zero-copy borrows of the container internals) in ONE pass, instead of
+    expanding every container to 8 KiB of words on the host up front —
+    the r08 ``pack.host_words`` wall. All data movement (value
+    concatenation, bitmap stacking, word expansion, the host→HBM ship)
+    happens at *expansion* time, on whichever side of the PCIe the
+    expansion mode picks.
+
+    Because rows are borrows, the payload snapshots container *identity*,
+    not container bytes: a packed row mutated in place after packing reads
+    through. That is exactly the pack-cache contract — every tracked
+    mutation delta-repacks its rows (``PackedGroups.apply_delta`` row
+    overrides), and untracked mutation-during-use was already unspecified
+    at the bitmap level (see ``apply_delta``)."""
+
+    __slots__ = ("n_rows", "arr_rows", "arr_vals", "bmp_rows", "bmp_list",
+                 "run_rows", "run_starts", "run_lengths", "n_values",
+                 "n_run_intervals", "_mat")
+
+    def __init__(self):
+        self.n_rows = 0
+        self.arr_rows: List[int] = []
+        self.arr_vals: List[np.ndarray] = []
+        self.bmp_rows: List[int] = []
+        self.bmp_list: List[np.ndarray] = []
+        self.run_rows: List[int] = []
+        self.run_starts: List[np.ndarray] = []
+        self.run_lengths: List[np.ndarray] = []
+        self.n_values = 0
+        self.n_run_intervals = 0
+        self._mat = None
+
+    def append(self, c: Container) -> None:
+        """Add one container as the next row (type-partitioned borrow)."""
+        i = self.n_rows
+        self.n_rows = i + 1
+        t = c.__class__
+        if t is ArrayContainer:
+            self.arr_rows.append(i)
+            self.arr_vals.append(c.content)
+            self.n_values += len(c.content)
+        elif t is BitmapContainer:
+            self.bmp_rows.append(i)
+            self.bmp_list.append(c.words)
+        elif t is RunContainer:
+            self.run_rows.append(i)
+            self.run_starts.append(c.starts)
+            self.run_lengths.append(c.lengths)
+            self.n_run_intervals += len(c.starts)
+        else:  # unknown container type: expand now, carry as a word row
+            self.bmp_rows.append(i)
+            self.bmp_list.append(c.to_words())
+
+    @classmethod
+    def from_containers(cls, containers: Sequence[Container]) -> "RowPayload":
+        p = cls()
+        for c in containers:
+            p.append(c)
+        return p
+
+    @property
+    def nbytes(self) -> int:
+        """Wire size of the compact payload (what the device expansion
+        path actually ships, vs ``n_rows * ROW_BYTES`` expanded)."""
+        return (
+            self.n_values * 2
+            + self.n_run_intervals * 4
+            + len(self.bmp_rows) * bits.WORDS_PER_CONTAINER * 8
+            + (len(self.arr_rows) + len(self.run_rows) + len(self.bmp_rows)) * 8
+        )
+
+    def materialize(self):
+        """Concatenate the borrows into flat numpy arrays (cached):
+        ``(arr_rows, arr_offsets, arr_values, bmp_rows, bmp_words64,
+        run_rows, run_counts, run_starts, run_lengths)``."""
+        m = self._mat
+        if m is None:
+            arr_rows = np.asarray(self.arr_rows, dtype=np.int64)
+            lens = np.fromiter(
+                (v.size for v in self.arr_vals), np.int64, len(self.arr_vals)
+            )
+            arr_offsets = np.concatenate(([0], np.cumsum(lens)))
+            arr_values = (
+                np.concatenate(self.arr_vals)
+                if self.arr_vals
+                else np.empty(0, np.uint16)
+            )
+            bmp_rows = np.asarray(self.bmp_rows, dtype=np.int64)
+            bmp_words = (
+                np.stack(self.bmp_list)
+                if self.bmp_list
+                else np.empty((0, bits.WORDS_PER_CONTAINER), np.uint64)
+            )
+            run_rows = np.asarray(self.run_rows, dtype=np.int64)
+            run_counts = np.fromiter(
+                (s.size for s in self.run_starts), np.int64, len(self.run_starts)
+            )
+            run_starts = (
+                np.concatenate(self.run_starts)
+                if self.run_starts
+                else np.empty(0, np.uint16)
+            )
+            run_lengths = (
+                np.concatenate(self.run_lengths)
+                if self.run_lengths
+                else np.empty(0, np.uint16)
+            )
+            m = self._mat = (
+                arr_rows, arr_offsets, arr_values, bmp_rows, bmp_words,
+                run_rows, run_counts, run_starts, run_lengths,
+            )
+        return m
+
+    def expand_host(self, aligned: bool = False) -> np.ndarray:
+        """Expand to the uint32 [n, 2048] host word block — the
+        ``pack.host_words`` path, and the single source of truth the
+        device expansion kernel is differential-tested against. Bitmap
+        rows bulk-copy; array values scatter in one C-level pass (native
+        kernel or ``np.bitwise_or.at``); run rows fill per interval.
+
+        ``aligned=True`` allocates the block 64-byte aligned — the
+        transfer *staging* discipline: jax's CPU client zero-copies
+        aligned host buffers on ``device_put`` (measured 0.6 ms vs 430 ms
+        for 631 MB on jax 0.4.37), and on accelerators pinned/aligned
+        staging is what DMA engines want anyway. Only the expansion
+        staging path uses it (the buffer's sole post-ship holder is the
+        device array); the retained host mirror (``.words``) stays an
+        independent allocation so host-side delta writes can never alias
+        a live device buffer."""
+        (arr_rows, arr_offsets, arr_values, bmp_rows, bmp_words,
+         run_rows, run_counts, run_starts, run_lengths) = self.materialize()
+        out64 = (
+            _aligned_zero_rows(self.n_rows)
+            if aligned
+            else np.zeros((self.n_rows, bits.WORDS_PER_CONTAINER), dtype=np.uint64)
+        )
+        if len(bmp_rows):
+            out64[bmp_rows] = bmp_words
+        if len(arr_rows):
+            from .. import native
+
+            if native.available():
+                native.pack_array_rows(arr_rows, arr_offsets, arr_values, out64)
+            else:
+                lens = np.diff(arr_offsets)
+                rows = np.repeat(arr_rows, lens)
+                v = arr_values.astype(np.int64)
+                flat_idx = rows * bits.WORDS_PER_CONTAINER + (v >> 6)
+                bit = np.uint64(1) << (v & 63).astype(np.uint64)
+                np.bitwise_or.at(out64.reshape(-1), flat_idx, bit)
+        if len(run_rows):
+            off = 0
+            for r, cnt in zip(run_rows.tolist(), run_counts.tolist()):
+                row = out64[r]
+                for s, l in zip(
+                    run_starts[off:off + cnt].tolist(),
+                    run_lengths[off:off + cnt].tolist(),
+                ):
+                    bits.set_bitmap_range(row, s, s + l + 1)
+                off += cnt
+        return out64.view(np.uint32)
+
+    def device_kernel_args(self):
+        """Prep the (pow2-padded) host arrays for
+        ``pallas_kernels.expand_rows_device``: per-value flat word indices
+        + bit masks, run start/stop toggle indices into the compact
+        run-row block, and the bitmap row block in device (uint32) layout.
+        Out-of-range pad ids rely on scatter ``mode="drop"``."""
+        (arr_rows, arr_offsets, arr_values, bmp_rows, bmp_words,
+         run_rows, run_counts, run_starts, run_lengths) = self.materialize()
+        if self.n_rows * dev.DEVICE_WORDS >= (1 << 31):
+            raise _rerrors.TierUnavailable(
+                f"payload expansion: {self.n_rows} rows overflow int32 indexing"
+            )
+        oob_flat = self.n_rows * dev.DEVICE_WORDS
+        lens = np.diff(arr_offsets)
+        rows = np.repeat(arr_rows, lens)
+        v = arr_values.astype(np.int64)
+        val_idx = (rows * dev.DEVICE_WORDS + (v >> 5)).astype(np.int32)
+        val_bits = np.uint32(1) << (v & 31).astype(np.uint32)
+        val_idx = dev.pad_pow2(val_idx, oob_flat)
+        val_bits = dev.pad_pow2(val_bits, 0)
+        kb = len(bmp_rows)
+        kbp = dev.pow2(kb)
+        bmp_rows_p = np.full(kbp, self.n_rows, dtype=np.int32)
+        bmp_rows_p[:kb] = bmp_rows
+        bmp_w = np.zeros((kbp, dev.DEVICE_WORDS), dtype=np.uint32)
+        if kb:
+            bmp_w[:kb] = np.ascontiguousarray(bmp_words).view(np.uint32).reshape(
+                kb, dev.DEVICE_WORDS
+            )
+        kr = len(run_rows)
+        krp = dev.pow2(kr)
+        run_rows_p = np.full(krp, self.n_rows, dtype=np.int32)
+        run_rows_p[:kr] = run_rows
+        # toggle bits: start s turns the fill on, stop e+1 turns it off.
+        # Starts and stops ship as SEPARATE scatter streams (the kernel
+        # XORs the two accumulators): within each stream sorted disjoint
+        # runs make every bit distinct, while a stop may legally land on
+        # the NEXT run's start bit (adjacent runs — the portable format
+        # does not forbid them) and must cancel it, not carry into the
+        # neighbouring bit. A stop past the row end simply never fires.
+        compact = np.repeat(np.arange(kr, dtype=np.int64), run_counts)
+        s = run_starts.astype(np.int64)
+        e1 = s + run_lengths.astype(np.int64) + 1
+        ts_idx = (compact * dev.DEVICE_WORDS + (s >> 5)).astype(np.int32)
+        ts_bit = np.uint32(1) << (s & 31).astype(np.uint32)
+        in_row = e1 < (1 << 16)
+        te_idx = (
+            compact[in_row] * dev.DEVICE_WORDS + (e1[in_row] >> 5)
+        ).astype(np.int32)
+        te_bit = np.uint32(1) << (e1[in_row] & 31).astype(np.uint32)
+        oob_tog = krp * dev.DEVICE_WORDS
+        ts_idx = dev.pad_pow2(ts_idx, oob_tog)
+        ts_bit = dev.pad_pow2(ts_bit, 0)
+        te_idx = dev.pad_pow2(te_idx, oob_tog)
+        te_bit = dev.pad_pow2(te_bit, 0)
+        return (bmp_rows_p, bmp_w, val_idx, val_bits, run_rows_p,
+                ts_idx, ts_bit, te_idx, te_bit)
+
+
+def _aligned_zero_rows(n_rows: int, align: int = 64) -> np.ndarray:
+    """Zeroed uint64 [n_rows, 1024] block whose base address is
+    ``align``-byte aligned (see ``RowPayload.expand_host``). numpy's
+    allocator only guarantees 16 bytes; over-allocate and slice."""
+    n = int(n_rows) * bits.WORDS_PER_CONTAINER * 8
+    raw = np.zeros(n + align, dtype=np.uint8)
+    off = (-raw.ctypes.data) % align
+    return raw[off:off + n].view(np.uint64).reshape(
+        n_rows, bits.WORDS_PER_CONTAINER
+    )
+
+
+def _expand_payload_device(payload: RowPayload):
+    """The device-side expansion dispatch (ISSUE 8 leg 1). On accelerators
+    the compact payload ships and the fused jit kernel scatters/fills it
+    into the flat rows on device (``pack.host_words`` leaves the host
+    timeline entirely). On the CPU backend the "device" is host memory, so
+    the honest expression is expanding straight into the transfer staging
+    buffer and handing it to the device in one step — one materialization
+    where the legacy path paid host-words *plus* a slow eager ship."""
+    if _EXPAND_MODE != "device" and jax.default_backend() == "cpu":
+        # aligned staging: the CPU client zero-copies the buffer, so the
+        # expansion write IS the ship — no second materialization. The
+        # staging array's only holder after this line is the device array.
+        return (
+            jax.device_put(payload.expand_host(aligned=True)),
+            payload.n_rows * ROW_BYTES,
+        )
+    from ..ops import pallas_kernels as pk
+
+    return (
+        pk.expand_rows_device(payload.n_rows, *payload.device_kernel_args()),
+        payload.nbytes,
+    )
+
+
+def _guarded_expand_payload(payload: "RowPayload"):
+    """One device-side expansion through the fault model: the
+    ``store.expand`` (kernel/staging failure) and ``store.hbm`` (OOM)
+    sites fire here; transients retry with jittered bounded backoff
+    (idempotent — the expansion builds a fresh buffer every attempt,
+    unlike the donated delta scatter)."""
+
+    def _attempt():
+        _faults.fault_point("store.expand")
+        _faults.fault_point("store.hbm")
+        d, nbytes = _expand_payload_device(payload)
+        return _timeline.fence(d), nbytes
+
+    return _ladder.retry("store.expand", _attempt)
+
+
+def _expand_host_staged(payload: "RowPayload") -> np.ndarray:
+    """``payload.expand_host()`` under the ``pack.host_words`` staging."""
     from .. import tracing
 
-    n = len(containers)
     with tracing.op_timer("store.pack_rows_host"), _timeline.stage(
-        _PACK_STAGE_SECONDS, "host_words", "pack.host_words", cat="pack", rows=n
+        _PACK_STAGE_SECONDS, "host_words", "pack.host_words", cat="pack",
+        rows=payload.n_rows,
     ):
-        return _pack_rows_host(containers, n)
+        return payload.expand_host()
 
 
-def _pack_rows_host(containers: Sequence[Container], n: int) -> np.ndarray:
-    out64 = np.zeros((n, bits.WORDS_PER_CONTAINER), dtype=np.uint64)
-    arr_rows: List[int] = []
-    arr_vals: List[np.ndarray] = []
-    for i, c in enumerate(containers):
-        if isinstance(c, BitmapContainer):
-            out64[i] = c.words
-        elif isinstance(c, ArrayContainer):
-            arr_rows.append(i)
-            arr_vals.append(c.content)
+def pack_rows_host(containers: Sequence[Container]) -> np.ndarray:
+    """Expand containers into one uint32 [N, 2048] host array (the
+    ``pack.host_words`` path — now the payload's host expansion, so the
+    fallback tier and the device kernel's differential oracle are the same
+    code by construction)."""
+    return _expand_host_staged(RowPayload.from_containers(containers))
+
+
+def _expand_rows_or_ship(payload: Optional["RowPayload"], host_words,
+                         patch=None, retained_mirror=False):
+    """The expand-or-degrade dispatch shared by ``ship_rows`` and
+    ``PackedGroups._expand_or_ship``: returns ``(device_rows, route,
+    bytes)``. Primary: device-side payload expansion (stage
+    ``device_expand``, route ``payload_expand``), with ``patch`` applied to
+    the freshly expanded rows while still inside the degradable region.
+    Fallback (``payload`` None, mode "host"/"legacy", or a non-fatal
+    expansion failure): the ``host_words`` callable's expansion + ship
+    (stage ``ship``, route ``flat_rows``) — exactly the legacy staging, so
+    the degraded timeline shows ``pack.host_words`` + ``pack.ship`` again.
+
+    ``retained_mirror=True`` marks ``host_words`` as returning a block the
+    caller KEEPS and later mutates in place (the ``.words`` delta mirror):
+    jax's CPU client zero-copies chance-64-byte-aligned arrays on
+    ``device_put``, which would alias the live device rows to the mutable
+    mirror — those ship through a fresh aligned staging copy whose sole
+    post-ship holder is the device array."""
+    mode = _EXPAND_MODE
+    if payload is not None and mode not in ("host", "legacy"):
+        try:
+            with _timeline.stage(
+                _PACK_STAGE_SECONDS, "device_expand", "pack.device_expand",
+                cat="pack", rows=payload.n_rows,
+            ):
+                d, nbytes = _guarded_expand_payload(payload)
+            if patch is not None:
+                d = patch(d)
+            return d, "payload_expand", nbytes
+        except Exception as e:
+            if _rerrors.classify(e) == _rerrors.FATAL:
+                raise
+            _ladder.LADDER.note_degrade(
+                "store.expand", "device-expand", "host-words", e
+            )
+    w = host_words()  # materializes under the host_words stage
+    with _timeline.stage(
+        _PACK_STAGE_SECONDS, "ship", "pack.ship", cat="pack",
+        bytes=int(w.nbytes),
+    ):
+        if mode == "legacy":
+            # the pre-ISSUE-8 eager ship, byte for byte — the bench's
+            # serial overlap twin and the emergency escape hatch
+            d = PackedGroups._guarded_ship(lambda: jnp.asarray(w))
+        elif retained_mirror:
+            staging = _aligned_zero_rows(w.shape[0]).view(np.uint32)
+            np.copyto(staging, w)
+            d = PackedGroups._guarded_ship(lambda: jax.device_put(staging))
         else:
-            out64[i] = c.to_words()
-    if arr_rows:
-        from .. import native
-
-        lens = np.fromiter((v.size for v in arr_vals), np.int64, len(arr_vals))
-        vals = np.concatenate(arr_vals)
-        rows_np = np.asarray(arr_rows, dtype=np.int64)
-        if native.available():
-            offsets = np.concatenate(([0], np.cumsum(lens)))
-            native.pack_array_rows(rows_np, offsets, vals, out64)
-        else:
-            rows = np.repeat(rows_np, lens)
-            v = vals.astype(np.int64)
-            flat_idx = rows * bits.WORDS_PER_CONTAINER + (v >> 6)
-            bit = np.uint64(1) << (v & 63).astype(np.uint64)
-            np.bitwise_or.at(out64.reshape(-1), flat_idx, bit)
-    return out64.view(np.uint32)
+            d = PackedGroups._guarded_ship(lambda: jax.device_put(w))
+    return d, "flat_rows", w.nbytes
 
 
-@dataclass
+def ship_rows(containers: Sequence[Container]):
+    """Expand a bare container list straight to flat device rows (uint32
+    [n, 2048]) through the same expansion dispatch + fault path as the
+    packed working sets — the query kernels' first-operand rows ride the
+    device-side expansion too (ISSUE 8), with the host ``pack.host_words``
+    + ship staging as the bit-exact degradation."""
+    payload = RowPayload.from_containers(containers)
+    d, route, nbytes = _expand_rows_or_ship(
+        payload, lambda: _expand_host_staged(payload)
+    )
+    _TRANSFER_TOTAL.inc(int(nbytes), (route,))
+    return d
+
+
 class PackedGroups:
     """Key-grouped containers packed for device reduction.
 
-    ``words``: device uint32 [N, 2048], rows sorted by group.
     ``group_keys``: int64 [G] high-16-bit chunk keys, ascending.
     ``group_offsets``: int64 [G+1] row ranges per group.
-    """
 
-    words: np.ndarray  # host uint32 [N, 2048]; shipped to device at reduce time
-    group_keys: np.ndarray
-    group_offsets: np.ndarray
+    Row data lives in ONE of two forms (ISSUE 8): a compact
+    :class:`RowPayload` (the marshal path — host words and device rows
+    both expand lazily from it), or an eager host word block handed to the
+    constructor (legacy callers and tests). ``words`` is now a *property*:
+    reading it materializes the uint32 [N, 2048] host block on demand —
+    the device paths never touch it, so a device-expanded working set
+    skips the host-words materialization entirely.
+
+    ``_row_overrides`` carries delta rows applied while the host block was
+    not materialized (payload borrows stay pre-delta); both the host
+    materialization and a device re-expansion replay them, so every view
+    converges on the post-delta bits. ``_buffer_gen`` counts donated
+    device-buffer replacements — a consumer that captured the flat rows
+    before a delta must re-read ``device_words`` (the donated buffer is
+    consumed, never served stale; see ``apply_delta``)."""
+
+    def __init__(self, words, group_keys, group_offsets, payload=None):
+        if words is None and payload is None:
+            raise ValueError("PackedGroups needs host words or a RowPayload")
+        self._host_words = words
+        self.group_keys = group_keys
+        self.group_offsets = group_offsets
+        self._payload = payload
+        self._row_overrides: Dict[int, np.ndarray] = {}
+        self._layout_epoch = 0
+        self._buffer_gen = 0
+        self._device_words = None
+        self._padded_cache = None
+        self._bucket_cache = None
+        self._plan_cache = None
+        self._resident_held = None
+        self._resident_cb = None
+        self._cache_held = False
+        self._reduce_touches: Dict[int, int] = {}
 
     @property
     def n_rows(self) -> int:
@@ -190,15 +584,41 @@ class PackedGroups:
     def n_groups(self) -> int:
         return len(self.group_keys)
 
+    @property
+    def words_nbytes(self) -> int:
+        """Expanded size of the flat row block — the working set's weight
+        for cache budgeting, WITHOUT forcing the host materialization."""
+        return self.n_rows * ROW_BYTES
+
+    @property
+    def words(self) -> np.ndarray:
+        """The uint32 [N, 2048] host word block, materialized on demand
+        from the payload (plus any delta row overrides). Device paths
+        never read this; CPU-side consumers (the mesh-sharded reduce,
+        differential tests) pay the expansion on first touch."""
+        w = self._host_words
+        if w is None:
+            from .. import tracing
+
+            with tracing.op_timer("store.pack_rows_host"), _timeline.stage(
+                _PACK_STAGE_SECONDS, "host_words", "pack.host_words",
+                cat="pack", rows=self.n_rows,
+            ):
+                w = self._payload.expand_host()
+            for r, row in self._row_overrides.items():
+                w[r] = row
+            self._row_overrides.clear()  # the mirror is the truth now
+            self._host_words = w
+        return w
+
     def _account_resident(self, kind: str, nbytes: int) -> None:
         """Track this working set's cached device bytes so the resident
         gauge goes back DOWN when the PackedGroups (and with it the cached
         arrays) is freed — a rise-only gauge would report cumulative bytes
         ever cached, not what is resident now."""
-        held = getattr(self, "_resident_held", None)
+        held = self._resident_held
         if held is None:
-            held = {}
-            object.__setattr__(self, "_resident_held", held)
+            held = self._resident_held = {}
         held[kind] = held.get(kind, 0) + int(nbytes)
         _RESIDENT_BYTES.inc(int(nbytes), (kind,))
         self._notify_resident(int(nbytes))
@@ -208,7 +628,7 @@ class PackedGroups:
         any): derived layouts (flat ship, padded blocks, buckets) are built
         lazily AFTER the cache stores the entry, and a byte budget that
         only counted the host words would let real HBM run ~3x past it."""
-        cb = getattr(self, "_resident_cb", None)
+        cb = self._resident_cb
         if cb is not None:
             cb(delta)
 
@@ -226,18 +646,17 @@ class PackedGroups:
         under every other consumer sharing the entry would silently
         re-pack/re-ship on their next touch. The cache's evictor releases
         ownership first and then really closes."""
-        if getattr(self, "_cache_held", False):
+        if self._cache_held:
             return
         self._drop_derived()
-        held = getattr(self, "_resident_held", None)
+        held = self._resident_held
         if held:
             for kind, nbytes in held.items():
                 _RESIDENT_BYTES.dec(nbytes, (kind,))
                 self._notify_resident(-int(nbytes))
             held.clear()
         # drop the flat device rows so HBM actually frees with the gauge
-        if getattr(self, "_device_words", None) is not None:
-            object.__setattr__(self, "_device_words", None)
+        self._device_words = None
 
     def _drop_derived(self) -> None:
         """Drop the padded/bucketed layout caches (and settle their share of
@@ -245,58 +664,89 @@ class PackedGroups:
         repack path updates the flat rows in place and lets the derived
         layouts rebuild from them on next touch (on accelerators that is a
         device-side gather, zero host transfer)."""
-        held = getattr(self, "_resident_held", None)
+        held = self._resident_held
         if held:
             for kind in ("padded_groups", "padded_buckets"):
                 nbytes = held.pop(kind, None)
                 if nbytes:
                     _RESIDENT_BYTES.dec(nbytes, (kind,))
                     self._notify_resident(-int(nbytes))
-        for attr in ("_padded_cache", "_bucket_cache"):
-            if getattr(self, attr, None) is not None:
-                object.__setattr__(self, attr, None)
+        self._padded_cache = None
+        self._bucket_cache = None
 
     def apply_delta(self, rows: np.ndarray, new_words_u32: np.ndarray) -> None:
         """Incremental repack: replace ``rows`` of the flat layout with
-        freshly expanded container words — host copy updated in place, the
-        resident device rows (if shipped) patched with ONE scatter of the
-        delta, derived layouts dropped to rebuild device-side. Ships
-        O(len(rows)) bytes, not O(n_rows); the group structure (keys,
-        offsets, bucket plans) is unchanged by contract — structural
-        changes take the full-repack path in PackCache.
+        freshly expanded container words. The host view updates in place
+        when materialized (row *overrides* otherwise — the compact payload
+        stays untouched and both later materializations replay them), and
+        the resident device rows are patched with ONE **donated** row
+        scatter (``pallas_kernels.scatter_rows_donated``): XLA reuses the
+        existing HBM buffer, so a k-row delta writes O(k·2048) words
+        instead of copying the whole flat tensor — the r08 ``delta.scatter``
+        inversion fix. Ships O(len(rows)) bytes; the group structure
+        (keys, offsets, bucket plans) is unchanged by contract —
+        structural changes take the full-repack path in PackCache.
+
+        Donation consumes the old device array: ``_buffer_gen`` bumps and
+        every derived layout drops, so the cache can never serve the
+        donated-away buffer (a consumer still holding it gets a loud
+        deleted-buffer error, never stale bits — the aliasing guard the
+        lazy builders' retry loop rides on).
 
         The epoch bump FIRST: any lazy layout build in flight on another
-        thread snapshots the epoch before reading ``words`` and discards
-        its result on mismatch, so a concurrent build can never publish a
-        pre-delta (or torn) array as this entry's current layout. (A
-        caller racing a mutation against its own query still gets
-        unspecified transient results — that race exists at the bitmap
-        level already.)"""
-        object.__setattr__(self, "_layout_epoch", self._epoch() + 1)
+        thread snapshots the epoch before reading the flat rows and
+        discards (or retries) its result on mismatch, so a concurrent
+        build can never publish a pre-delta (or torn) array as this
+        entry's current layout. (A caller racing a mutation against its
+        own query still gets unspecified transient results — that race
+        exists at the bitmap level already.)"""
+        self._layout_epoch = self._epoch() + 1
         with _timeline.stage(
             _DELTA_STAGE_SECONDS, "scatter", "delta.scatter", cat="delta",
             rows=len(rows), bytes=int(new_words_u32.nbytes),
         ):
-            self.words[rows] = new_words_u32
-            d = getattr(self, "_device_words", None)
+            if self._host_words is not None:
+                self._host_words[rows] = new_words_u32
+            else:
+                for r, row in zip(rows.tolist(), new_words_u32):
+                    self._row_overrides[int(r)] = np.array(row, copy=True)
+                # override mass beyond a quarter of the block: fold into a
+                # real host mirror once instead of carrying it forever
+                if len(self._row_overrides) * ROW_BYTES > max(
+                    1 << 20, self.words_nbytes // 4
+                ):
+                    _ = self.words  # materializes + clears the overrides
+            d = self._device_words
             if d is not None:
+                from ..ops import pallas_kernels as pk
 
                 def _ship_delta():
-                    delta = jnp.asarray(new_words_u32)
-                    return d.at[jnp.asarray(rows)].set(delta)
+                    # single-shot guard (no retry): donation consumes the
+                    # input buffer, so a second attempt would scatter into
+                    # a dead array — transients degrade to a re-ship below
+                    _faults.fault_point("store.ship")
+                    _faults.fault_point("store.hbm")
+                    return _timeline.fence(
+                        pk.scatter_rows_donated(d, rows, new_words_u32)
+                    )
 
                 try:
-                    shipped = self._guarded_ship(_ship_delta)
+                    shipped = _ship_delta()
                 except Exception as e:
+                    if d.is_deleted():
+                        # the failed scatter consumed the buffer: never
+                        # leave a poisoned array published
+                        self._device_words = None
                     if _rerrors.classify(e) == _rerrors.FATAL:
                         raise
-                    # the host copy is already updated; dropping the device
+                    # the host view is already updated; dropping the device
                     # rows degrades the next consumer to a re-ship instead
                     # of serving a stale resident tensor
                     _ladder.LADDER.note_degrade("store.ship", "device", "re-ship", e)
-                    object.__setattr__(self, "_device_words", None)
+                    self._device_words = None
                 else:
-                    object.__setattr__(self, "_device_words", shipped)
+                    self._device_words = shipped
+                    self._buffer_gen += 1
                     _TRANSFER_TOTAL.inc(int(new_words_u32.nbytes), ("pack_delta",))
         with _timeline.stage(
             _DELTA_STAGE_SECONDS, "republish", "delta.republish", cat="delta"
@@ -321,7 +771,7 @@ class PackedGroups:
         account) a build that raced a delta — the racing consumer still
         gets a usable snapshot for its own call, but a possibly-stale array
         can never outlive the race as the entry's current layout."""
-        return getattr(self, "_layout_epoch", 0)
+        return self._layout_epoch
 
     @staticmethod
     def _guarded_ship(build):
@@ -341,50 +791,101 @@ class PackedGroups:
 
     @property
     def device_words(self) -> jnp.ndarray:
-        """The flat rows on device (transferred once, then cached)."""
-        d = getattr(self, "_device_words", None)
+        """The flat rows on device (built once, then cached). Built by
+        device-side payload expansion when the working set carries a
+        compact payload (ISSUE 8 leg 1) — the ``store.expand`` fault site
+        covers that path, and any non-fatal failure degrades to the host
+        ``pack.host_words`` expansion + ship, bit-exact by construction."""
+        d = self._device_words
         if d is None:
             epoch = self._epoch()
-            with _timeline.stage(
-                _PACK_STAGE_SECONDS, "ship", "pack.ship", cat="pack",
-                bytes=int(self.words.nbytes),
-            ):
-                d = self._guarded_ship(lambda: jnp.asarray(self.words))
+            d, route, nbytes = self._expand_or_ship()
             if self._epoch() != epoch:
                 return d  # raced a delta repack: do not publish
-            _TRANSFER_TOTAL.inc(self.words.nbytes, ("flat_rows",))
-            self._account_resident("flat_rows", self.words.nbytes)
-            object.__setattr__(self, "_device_words", d)
+            _TRANSFER_TOTAL.inc(int(nbytes), (route,))
+            self._account_resident("flat_rows", self.words_nbytes)
+            self._device_words = d
         return d
+
+    def _expand_or_ship(self):
+        """Build the flat device rows: ``(array, transfer_route, bytes)``
+        via the shared :func:`_expand_rows_or_ship` dispatch — the payload
+        leg only when the host mirror is not already materialized, with
+        any pre-materialization delta rows replayed onto the freshly
+        expanded block (donated: it has no other holders yet)."""
+
+        def _replay_overrides(d):
+            if not self._row_overrides:
+                return d
+            from ..ops import pallas_kernels as pk
+
+            rows = np.fromiter(
+                self._row_overrides, np.int64, len(self._row_overrides)
+            )
+            delta = np.stack([self._row_overrides[int(r)] for r in rows])
+            return pk.scatter_rows_donated(d, rows, delta)
+
+        return _expand_rows_or_ship(
+            self._payload if self._host_words is None else None,
+            lambda: self.words,  # materializes under the host_words stage
+            patch=_replay_overrides,
+            retained_mirror=True,  # .words takes in-place delta writes
+        )
+
+    def _gather_guard(self, epoch: int, attempt: int, exc: Exception) -> bool:
+        """The donated-buffer race guard for lazy layout builds: a delta's
+        donated scatter may CONSUME the flat buffer a build captured (the
+        gather then raises a deleted-buffer error instead of reading stale
+        rows — the aliasing guarantee). When the epoch moved, the attempt
+        was invalid anyway: retry against the current rows. Same-epoch
+        failures propagate."""
+        return self._epoch() != epoch and attempt < 4
 
     def padded_device(self, fill: int, row_multiple: int = 1):
         """Dense-padded [G, M, W] rows on device, built once per (fill,
         row_multiple) and cached for the lifetime of the working set (the
         BSI pack-cache pattern; VERDICT r2 weak #8 — repeat aggregations
-        must not re-pad and re-ship). On accelerators the block is built by
-        a device-side gather from the already-resident flat rows (the
-        padded_buckets_device technique), so a delta repack that patched
-        the flat rows rebuilds this layout with ZERO host transfer."""
-        cache = getattr(self, "_padded_cache", None)
+        must not re-pad and re-ship). Built by a device-side gather from
+        the already-resident flat rows on EVERY backend (ISSUE 8: the flat
+        rows are device-built now, so the old host fill would be a second
+        full materialization) — a delta repack that patched the flat rows
+        rebuilds this layout with ZERO host transfer."""
+        cache = self._padded_cache
         if cache is None:
-            cache = {}
-            object.__setattr__(self, "_padded_cache", cache)
+            cache = self._padded_cache = {}
         key = (int(fill), int(row_multiple))
-        if key not in cache:
-            import jax
-
+        attempt = 0
+        while key not in cache:
+            attempt += 1
             epoch = self._epoch()
             g, n = self.n_groups, self.n_rows
             plan = dense_pad_plan(self.group_offsets, row_multiple)
             if plan is None:  # the shared skew guard
                 cache[key] = None
-            elif jax.default_backend() != "cpu":
+                break
+            if _EXPAND_MODE == "legacy" and jax.default_backend() == "cpu":
+                # the pre-ISSUE-8 CPU staging verbatim (host fill + eager
+                # asarray ship) — the bench's serial overlap twin measures
+                # the whole legacy marshal, not just the flat leg
+                with _timeline.stage(
+                    _PACK_STAGE_SECONDS, "padded_build", "pack.padded_build",
+                    cat="pack", groups=self.n_groups, on_device=0,
+                ):
+                    host = pad_groups_dense(self, int(fill), row_multiple)
+                    arr = self._guarded_ship(lambda: jnp.asarray(host))
+                if self._epoch() != epoch:
+                    return arr
+                _TRANSFER_TOTAL.inc(int(host.nbytes), ("padded_groups",))
+                self._account_resident("padded_groups", int(host.nbytes))
+                cache[key] = arr
+                break
+            try:
                 with _timeline.stage(
                     _PACK_STAGE_SECONDS, "padded_build", "pack.padded_build",
                     cat="pack", groups=g, on_device=1,
                 ):
                     m, slots = plan
-                    flat = self.device_words  # one cached ship
+                    flat = self.device_words  # one cached expansion/ship
                     src_map = np.full(g * m, n, dtype=np.int64)
                     src_map[slots] = np.arange(n)
                     arr = self._guarded_ship(
@@ -393,23 +894,15 @@ class PackedGroups:
                             fill_value=np.uint32(fill),
                         ).reshape(g, m, dev.DEVICE_WORDS)
                     )
-                if self._epoch() != epoch:
-                    return arr  # raced a delta repack: do not publish
-                _TRANSFER_TOTAL.inc(int(arr.nbytes), ("padded_groups_built_on_device",))
-                self._account_resident("padded_groups", int(arr.nbytes))
-                cache[key] = arr
-            else:
-                with _timeline.stage(
-                    _PACK_STAGE_SECONDS, "padded_build", "pack.padded_build",
-                    cat="pack", groups=g, on_device=0,
-                ):
-                    host = pad_groups_dense(self, fill, row_multiple)
-                    arr = self._guarded_ship(lambda: jnp.asarray(host))
-                if self._epoch() != epoch:
-                    return arr  # raced a delta repack: do not publish
-                cache[key] = arr
-                _TRANSFER_TOTAL.inc(host.nbytes, ("padded_groups",))
-                self._account_resident("padded_groups", host.nbytes)
+            except Exception as e:
+                if self._gather_guard(epoch, attempt, e):
+                    continue
+                raise
+            if self._epoch() != epoch:
+                return arr  # raced a delta repack: do not publish
+            _TRANSFER_TOTAL.inc(int(arr.nbytes), ("padded_groups_built_on_device",))
+            self._account_resident("padded_groups", int(arr.nbytes))
+            cache[key] = arr
         return cache[key]
 
     def plan_buckets(self, n_buckets: int = 3) -> List[np.ndarray]:
@@ -419,10 +912,9 @@ class PackedGroups:
         accounting all consult the plan — uncached, each recomputed it
         (VERDICT r4 weak #2: the bucketed cold path pays repeated plan +
         fill costs the padded layout never did)."""
-        cache = getattr(self, "_plan_cache", None)
+        cache = self._plan_cache
         if cache is None:
-            cache = {}
-            object.__setattr__(self, "_plan_cache", cache)
+            cache = self._plan_cache = {}
         k = int(n_buckets)
         if k not in cache:
             cache[k] = bucket_plan(np.diff(self.group_offsets), k)
@@ -436,53 +928,76 @@ class PackedGroups:
         (census1881 flagship: 76.5% -> 93.5% occupancy at 3 buckets).
 
         Returns a list of ``(orig_group_idx int64[g_b], jnp [g_b, m_b, W])``
-        pairs, cached per (fill, n_buckets). The fill is one vectorized
-        row scatter per bucket (same shape as pad_groups_dense's), not a
-        per-group copy loop, and an OR-identity fill allocates zero pages
-        lazily instead of writing the whole block twice."""
-        cache = getattr(self, "_bucket_cache", None)
+        pairs, cached per (fill, n_buckets). Every bucket is ONE device
+        gather-with-fill from the already-resident flat rows on every
+        backend (ISSUE 8: the flat rows are device-built, so the old CPU
+        host-fill branch would re-materialize the whole block on the host
+        and pay a second full ship — the r09 48 s ``bucket_build_s``)."""
+        cache = self._bucket_cache
         if cache is None:
-            cache = {}
-            object.__setattr__(self, "_bucket_cache", cache)
+            cache = self._bucket_cache = {}
         key = (int(fill), int(n_buckets))
-        if key not in cache:
-            import jax
-
+        attempt = 0
+        legacy_cpu = _EXPAND_MODE == "legacy" and jax.default_backend() == "cpu"
+        while key not in cache:
+            attempt += 1
             epoch = self._epoch()
-            with _timeline.stage(
-                _PACK_STAGE_SECONDS, "bucket_build", "pack.bucket_build",
-                cat="pack", buckets=int(n_buckets), groups=self.n_groups,
-            ):
-                counts = np.diff(self.group_offsets)
-                on_accel = jax.default_backend() != "cpu"
-                flat = self.device_words if on_accel else None  # one cached ship
-                out = []
-                pending_account = []  # (route, nbytes): published only if no delta raced
-                for idx in self.plan_buckets(n_buckets):
-                    g_b, m_b = len(idx), int(counts[idx].max())
-                    # all live rows of the bucket move in ONE vectorized step:
-                    # group idx[slot]'s local row p lands at flat slot*m_b + p
-                    b_counts = counts[idx]
-                    n_b = int(b_counts.sum())
-                    slot_rows = None
-                    src = None
-                    if n_b:
-                        src = np.concatenate(
-                            [
-                                np.arange(self.group_offsets[gi], self.group_offsets[gi + 1])
-                                for gi in idx
-                            ]
-                        )
-                        slot_of_row = np.repeat(np.arange(g_b), b_counts)
-                        local = np.arange(n_b) - np.repeat(
-                            np.cumsum(np.concatenate(([0], b_counts[:-1]))), b_counts
-                        )
-                        slot_rows = slot_of_row * m_b + local
-                    if on_accel:
-                        # device gather-with-fill from the already-shipped flat
-                        # rows: pad cells point out of range so mode="fill"
-                        # writes the op identity — the host never materializes
-                        # (or ships) the padded copy, and the gather rides HBM
+            try:
+                with _timeline.stage(
+                    _PACK_STAGE_SECONDS, "bucket_build", "pack.bucket_build",
+                    cat="pack", buckets=int(n_buckets), groups=self.n_groups,
+                ):
+                    counts = np.diff(self.group_offsets)
+                    # legacy CPU staging (serial overlap twin): host fill +
+                    # eager asarray ship per bucket, no resident flat rows
+                    flat = None if legacy_cpu else self.device_words
+                    out = []
+                    pending_account = []  # published only if no delta raced
+                    for idx in self.plan_buckets(n_buckets):
+                        g_b, m_b = len(idx), int(counts[idx].max())
+                        # all live rows of the bucket move in ONE vectorized
+                        # gather: group idx[slot]'s local row p lands at flat
+                        # slot*m_b + p; pad cells point out of range so
+                        # mode="fill" writes the op identity — the host never
+                        # materializes (or ships) the padded copy
+                        b_counts = counts[idx]
+                        n_b = int(b_counts.sum())
+                        slot_rows = None
+                        src = None
+                        if n_b:
+                            src = np.concatenate(
+                                [
+                                    np.arange(
+                                        self.group_offsets[gi],
+                                        self.group_offsets[gi + 1],
+                                    )
+                                    for gi in idx
+                                ]
+                            )
+                            slot_of_row = np.repeat(np.arange(g_b), b_counts)
+                            local = np.arange(n_b) - np.repeat(
+                                np.cumsum(np.concatenate(([0], b_counts[:-1]))),
+                                b_counts,
+                            )
+                            slot_rows = slot_of_row * m_b + local
+                        if legacy_cpu:
+                            # pre-ISSUE-8 CPU staging verbatim: host fill +
+                            # eager asarray ship of the whole padded block
+                            shape = (g_b, m_b, dev.DEVICE_WORDS)
+                            if fill == 0:
+                                block = np.zeros(shape, dtype=np.uint32)
+                            else:
+                                block = np.full(shape, fill, dtype=np.uint32)
+                            if n_b:
+                                block.reshape(g_b * m_b, dev.DEVICE_WORDS)[
+                                    slot_rows
+                                ] = self.words[src]
+                            arr = self._guarded_ship(lambda: jnp.asarray(block))
+                            pending_account.append(
+                                ("padded_buckets", int(block.nbytes))
+                            )
+                            out.append((idx, arr))
+                            continue
                         src_map = np.full(g_b * m_b, self.n_rows, dtype=np.int64)
                         if n_b:
                             src_map[slot_rows] = src
@@ -492,25 +1007,14 @@ class PackedGroups:
                                 fill_value=np.uint32(fill),
                             ).reshape(g_b, m_b, dev.DEVICE_WORDS)
                         )
-                        # no host->device transfer happened here; tracked under
-                        # its own key so the transfer ledger stays truthful
-                        pending_account.append(("padded_buckets_built_on_device", int(arr.nbytes)))
-                    else:
-                        # CPU backend: a host fill + alias is faster than an
-                        # eager gather (an OR fill allocates its zero pages
-                        # lazily instead of writing the block twice)
-                        shape = (g_b, m_b, dev.DEVICE_WORDS)
-                        if fill == 0:
-                            block = np.zeros(shape, dtype=np.uint32)
-                        else:
-                            block = np.full(shape, fill, dtype=np.uint32)
-                        if n_b:
-                            block.reshape(g_b * m_b, dev.DEVICE_WORDS)[slot_rows] = (
-                                self.words[src]
-                            )
-                        arr = self._guarded_ship(lambda: jnp.asarray(block))
-                        pending_account.append(("padded_buckets", int(block.nbytes)))
-                    out.append((idx, arr))
+                        pending_account.append(
+                            ("padded_buckets_built_on_device", int(arr.nbytes))
+                        )
+                        out.append((idx, arr))
+            except Exception as e:
+                if self._gather_guard(epoch, attempt, e):
+                    continue
+                raise
             if self._epoch() != epoch:
                 return out  # raced a delta repack: do not publish
             for route, nbytes in pending_account:
@@ -553,9 +1057,13 @@ def intersect_keys(bitmaps: Sequence[RoaringBitmap]) -> set:
 
 
 def pack_groups(groups: Dict[int, List[Container]]) -> PackedGroups:
-    """Pack key-major groups into one host SoA array; the device transfer
-    happens once in prepare_reduce after the layout choice, so rows are
-    shipped exactly once in whichever layout they'll be reduced in."""
+    """Pack key-major groups into a compact :class:`RowPayload` working
+    set (ISSUE 8): the pack stage only collects type-partitioned borrows
+    of the container internals — word expansion and the transfer happen
+    once, lazily, on whichever side the expansion mode picks
+    (``PackedGroups.device_words`` / ``.words``). This is what took
+    ``pack.host_words`` (92 % of the r08 cold pack) off the marshal
+    critical path."""
     with _timeline.stage(
         _PACK_STAGE_SECONDS, "group_tables", "pack.group_tables", cat="pack",
         groups=len(groups),
@@ -563,8 +1071,15 @@ def pack_groups(groups: Dict[int, List[Container]]) -> PackedGroups:
         group_keys = np.array(sorted(groups), dtype=np.int64)
         counts = np.array([len(groups[int(k)]) for k in group_keys], dtype=np.int64)
         offsets = np.concatenate(([0], np.cumsum(counts)))
-        rows = [c for k in group_keys for c in groups[int(k)]]
-    return PackedGroups(pack_rows_host(rows), group_keys, offsets)
+    payload = RowPayload()
+    with _timeline.stage(
+        _PACK_STAGE_SECONDS, "payload_build", "pack.payload_build", cat="pack",
+        rows=int(offsets[-1]),
+    ):
+        for k in group_keys:
+            for c in groups[int(k)]:
+                payload.append(c)
+    return PackedGroups(None, group_keys, offsets, payload=payload)
 
 
 def bucket_plan(counts: np.ndarray, n_buckets: int) -> List[np.ndarray]:
@@ -675,6 +1190,52 @@ def prepare_reduce(packed: PackedGroups, op: str = "or"):
     single_rows = int(g * counts.max()) if g else 0
     # empty sets keep the (trivial) single-block path
     if not g or not n or single_rows <= n / 0.9:
+        fill = int(dev._INIT[op])
+        # cold one-shot tiering (ISSUE 8): the FIRST reduce of a freshly
+        # packed working set fuses the dense-pad gather into the reduction
+        # (pallas_kernels.fused_gather_reduce) instead of materializing
+        # the padded block it would use exactly once — half the memory
+        # traffic, the dominant cost of a cold back-to-back query. The
+        # SECOND touch builds the resident [G, M, W] block and every
+        # later reduce rides the cheaper steady-state path (the closure
+        # itself re-checks, so min-of-reps timing loops converge too).
+        # Legacy expansion mode keeps the r09 pipeline verbatim.
+        built = (
+            packed._padded_cache is not None
+            and (fill, 1) in packed._padded_cache
+        )
+        touches = packed._reduce_touches
+        first_prepare = not touches.get(fill, 0)
+        touches[fill] = touches.get(fill, 0) + 1
+        if g and n and not built and first_prepare and _EXPAND_MODE != "legacy":
+            plan = dense_pad_plan(packed.group_offsets, 1)
+            if plan is not None:
+                m, slots = plan
+                src_map = np.full(g * m, n, dtype=np.int64)
+                src_map[slots] = np.arange(n)
+                calls = [0]
+
+                def run_fused():
+                    from .. import tracing
+                    from ..ops import pallas_kernels as pk
+
+                    calls[0] += 1
+                    if calls[0] == 1 and not (
+                        packed._padded_cache is not None
+                        and (fill, 1) in packed._padded_cache
+                    ):
+                        # ops.dispatch fault site fires inside the helper
+                        with tracing.op_timer("store.reduce.padded_fused"):
+                            return pk.fused_gather_reduce(
+                                packed.device_words, src_map, g, int(m),
+                                op=op, fill=fill,
+                            )
+                    arr = packed.padded_device(fill)
+                    with tracing.op_timer("store.reduce.padded"):
+                        return pk.best_grouped_reduce(arr, op=op)
+
+                _LAYOUT_TOTAL.inc(1, ("padded",))
+                return run_fused, "padded"
         dev_arr = packed.padded_device(dev._INIT[op])
         if dev_arr is not None:
 
@@ -948,7 +1509,14 @@ class PackCache:
         delta validator relies on that to detect intersection changes."""
         bitmaps = list(bitmaps)
         marker = "all" if keys_filter is None else "and"
-        fps = tuple(bm.fingerprint() for bm in bitmaps)
+        # stage-attributed (ISSUE 8): with the delta scatter at O(k) the
+        # fingerprint walk is a visible share of the delta wall — the
+        # timeline must name it, not leave it as unattributed residue
+        with _timeline.stage(
+            _PACK_STAGE_SECONDS, "fingerprints", "pack.fingerprints",
+            cat="pack", operands=len(bitmaps),
+        ):
+            fps = tuple(bm.fingerprint() for bm in bitmaps)
         key = ("agg", marker, fps)
         if self.max_bytes <= 0:  # disabled: always a fresh uncached pack
             with self._lock:
@@ -996,7 +1564,7 @@ class PackCache:
             self.misses += 1
         _PACK_MISSES.inc(1, ("agg",))
         entry = _PackEntry(
-            key, "agg", packed, packed.words.nbytes, fps=fps, row_map=row_map,
+            key, "agg", packed, packed.words_nbytes, fps=fps, row_map=row_map,
             refs=static_fp_refs(bitmaps),
         )
         return self._store(entry, ident=ident).value
@@ -1170,8 +1738,8 @@ class PackCache:
                     self._drop(superseded)
                 self._ident[ident] = entry.key
             for pg in self._packed_parts(entry.value):
-                object.__setattr__(pg, "_cache_held", True)
-                object.__setattr__(pg, "_resident_cb", self._resident_cb(entry))
+                pg._cache_held = True
+                pg._resident_cb = self._resident_cb(entry)
             self._entries[entry.key] = entry
             self._bytes += entry.nbytes
             _PACK_RESIDENT.inc(entry.nbytes, (entry.kind,))
@@ -1221,8 +1789,8 @@ class PackCache:
         # them again would double-subtract.
         _PACK_RESIDENT.dec(e.nbytes, (e.kind,))
         for pg in self._packed_parts(e.value):
-            object.__setattr__(pg, "_resident_cb", None)
-            object.__setattr__(pg, "_cache_held", False)
+            pg._resident_cb = None
+            pg._cache_held = False
             pg.close()
 
     def _evict_over_budget(self) -> None:
@@ -1270,6 +1838,22 @@ class PackCache:
         if len(new_fps) != len(e.fps):
             return None
         packed: PackedGroups = e.value
+        # cheap pre-pass (ISSUE 8 satellite): a generation change or a
+        # wholesale mutation (mark_all_dirty) already forces the full
+        # repack — decide from the version counters alone instead of
+        # paying the per-key dirty scan first (the wasted
+        # ``delta.dirty_scan`` time r09's timeline showed on structural
+        # fallbacks)
+        for bi, (old_fp, new_fp) in enumerate(zip(e.fps, new_fps)):
+            if old_fp == new_fp:
+                continue
+            if old_fp[0] != new_fp[0]:  # generation changed (or static id)
+                return None
+            wholesale = getattr(
+                bitmaps[bi].high_low_container, "wholesale_since", None
+            )
+            if wholesale is not None and wholesale(old_fp[1]):
+                return None
         with _timeline.stage(
             _DELTA_STAGE_SECONDS, "dirty_scan", "delta.dirty_scan",
             cat="delta", operands=len(new_fps),

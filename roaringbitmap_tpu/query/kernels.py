@@ -211,8 +211,9 @@ def _device_andnot_parts(first: RoaringBitmap, rest, covered_keys: set):
 
     def build():
         packed = store.pack_groups(_rest_groups(first, rest))
-        first_rows = jnp.asarray(store.pack_rows_host([c for _, c in covered]))
-        return (packed, first_rows), int(packed.words.nbytes) + int(first_rows.nbytes)
+        # first's covered rows ride the device-side expansion too (ISSUE 8)
+        first_rows = store.ship_rows([c for _, c in covered])
+        return (packed, first_rows), packed.words_nbytes + int(first_rows.nbytes)
 
     with tracing.op_timer("query.andnot.device"):
         packed, first_rows = store.PACK_CACHE.get_or_build(
@@ -392,7 +393,7 @@ def _device_threshold(bms, k: int, keys_ok: set) -> Optional[RoaringBitmap]:
 
     def _build():
         p = store.pack_groups(store.group_by_key(bms, keys_filter=keys_ok))
-        return p, int(p.words.nbytes)
+        return p, p.words_nbytes
 
     key = ("threshold", k, tuple(bm.fingerprint() for bm in bms))
     packed = store.PACK_CACHE.get_or_build(

@@ -50,6 +50,7 @@ from .errors import ResourceExhausted, TransientDeviceError, simulated_oom
 SITES: Tuple[str, ...] = (
     "store.ship",        # host->HBM transfer of packed rows (store.py)
     "store.hbm",         # HBM allocation during the ship (OOM simulation)
+    "store.expand",      # device-side payload expansion + overlap lane (ISSUE 8)
     "ops.dispatch",      # device reduce dispatch (store run closures, ops/)
     "query.exec",        # query executor device-engine step dispatch
     "columnar.kernel",   # columnar native batch-kernel entry (kernels.py)

@@ -281,6 +281,38 @@ print("timeline ok (%d events, %d spans; pack %.1f%%, delta %.1f%% attributed; d
       % (len(evs), spans, od["pack"]["coverage"] * 100,
          od["delta"]["coverage"] * 100, od["delta"]["dominant_stage"]))'
 
+step "marshal-wall contract (ISSUE 8): delta < pack, expand attribution, overlap twin"
+# the rebuilt marshal path's invariants, asserted on the smoke artifact:
+# the donated O(k) delta must be strictly cheaper than the payload pack,
+# the device-expansion window must exist and attribute >=90% of its wall,
+# and the overlap twin rows (serial pre-ISSUE-8 pipeline vs the lane) must
+# be present with sane walls (the >=30% reduction claim gates the
+# full-scale committed BENCH_r*.json, not the smoke scale)
+python -c '
+import json
+m = json.load(open("/tmp/ci_bench.json"))["meta"]
+if not (0 < m["delta_repack_s"] < m["pack_s"]):
+    raise SystemExit("marshal-wall: delta_repack_s %s not strictly below pack_s %s"
+                     % (m["delta_repack_s"], m["pack_s"]))
+if not m.get("pack_expand_s", 0) > 0:
+    raise SystemExit("marshal-wall: missing/non-positive pack_expand_s %r"
+                     % m.get("pack_expand_s"))
+ov = m.get("overlap")
+need = {"queries", "bitmaps_per_query", "serial_wall_s", "overlapped_wall_s",
+        "wall_reduction_pct", "lane_staged_s", "lane_hidden_s"}
+if not (isinstance(ov, dict) and need <= set(ov)):
+    raise SystemExit("marshal-wall: overlap twin rows missing/incomplete: %r" % ov)
+if not (ov["serial_wall_s"] > 0 and ov["overlapped_wall_s"] > 0):
+    raise SystemExit("marshal-wall: non-positive overlap walls %r" % ov)
+tl = json.load(open("/tmp/ci_bench_timeline.json"))["otherData"]
+ex = tl.get("expand")
+if not (isinstance(ex, dict) and ex.get("wall_s", 0) > 0
+        and ex.get("coverage", 0) >= 0.9):
+    raise SystemExit("marshal-wall: expand window missing/unattributed: %r" % ex)
+print("marshal-wall ok (pack %ss + expand %ss, delta %ss, overlap %s%% over %s queries)"
+      % (m["pack_s"], m["pack_expand_s"], m["delta_repack_s"],
+         ov["wall_reduction_pct"], ov["queries"]))'
+
 step "latency histogram rows in the metrics sidecar (p50/p99, ISSUE 6)"
 # the log-bucketed latency histograms must surface quantile snapshots in
 # the sidecar (and therefore the JSONL/Prometheus exports they mirror)

@@ -1,0 +1,440 @@
+"""Marshal-wall rebuild (ISSUE 8): device-side container expansion, the
+donated O(k) delta scatter, and the double-buffered overlap shipping lane.
+
+The acceptance claims are asserted the way production observes them: the
+``rb_tpu_store_transfer_bytes_total`` routes prove a k-row delta ships
+O(k·2048) words and never re-materializes a second full flat tensor; the
+donated-buffer checks prove the aliasing guard (a consumed buffer is never
+served, the refreshed pack serves the post-delta bits); the fault-site
+tests prove every new path degrades to the host ``pack.host_words``
+pipeline bit-exactly.
+"""
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import observe
+from roaringbitmap_tpu.models.container import (
+    ArrayContainer,
+    BitmapContainer,
+    RunContainer,
+)
+from roaringbitmap_tpu.models.roaring import RoaringBitmap
+from roaringbitmap_tpu.parallel import overlap, store
+from roaringbitmap_tpu.parallel.aggregation import FastAggregation as FA
+from roaringbitmap_tpu.robust import errors as rerrors
+from roaringbitmap_tpu.robust import faults as rfaults
+from roaringbitmap_tpu.robust import ladder as rladder
+
+
+def _bm(rng, n=2500, spread=1 << 19):
+    return RoaringBitmap(
+        np.sort(rng.choice(spread, size=n, replace=False)).astype(np.uint32)
+    )
+
+
+def _working_set(seed=11, k=5):
+    rng = np.random.default_rng(seed)
+    return [_bm(rng) for _ in range(k)]
+
+
+def _mixed_containers(seed=3):
+    """Array + bitmap + run containers, including run boundary cases (a
+    run starting at bit 0, a run ending at bit 65535)."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for j in range(23):
+        kind = j % 4
+        if kind == 0:
+            out.append(
+                ArrayContainer(
+                    np.sort(rng.choice(65536, 200, replace=False)).astype(np.uint16)
+                )
+            )
+        elif kind == 1:
+            w = np.zeros(1024, np.uint64)
+            for x in rng.choice(65536, 5000, replace=False):
+                w[x >> 6] |= np.uint64(1) << np.uint64(x & 63)
+            out.append(BitmapContainer(w))
+        elif kind == 2:
+            s = np.sort(rng.choice(65530, 8, replace=False)).astype(np.uint16)
+            out.append(RunContainer(s[::2], (s[1::2] - s[::2]).astype(np.uint16)))
+        else:
+            out.append(
+                RunContainer(
+                    np.array([0, 65000], np.uint16), np.array([5, 535], np.uint16)
+                )
+            )
+    return out
+
+
+def _xfer(route: str) -> int:
+    c = observe.REGISTRY.get(observe.STORE_TRANSFER_BYTES_TOTAL)
+    return c.get((route,)) if c is not None else 0
+
+
+@pytest.fixture
+def fresh():
+    store.PACK_CACHE.close()
+    overlap.LANE.drain()
+    # pin the threaded lane so its machinery is exercised even on
+    # single-core CI hosts (where "auto" stands down to inline staging)
+    overlap.LANE.configure("on")
+    rladder.LADDER.reset()
+    yield
+    store.PACK_CACHE.close()
+    overlap.LANE.drain()
+    overlap.LANE.configure("auto")
+    store.configure_expansion("auto")
+
+
+# ---------------------------------------------------------------------------
+# device-side expansion: bit-exact vs the host pack.host_words path
+# ---------------------------------------------------------------------------
+
+
+def test_expansion_kernel_bit_exact_all_container_types(fresh):
+    """The fused jit expansion kernel (forced via mode "device") must agree
+    with the host expansion on every container class, including run
+    boundary cases — the differential that backs the degradation's
+    bit-exactness claim."""
+    containers = _mixed_containers()
+    want = store.pack_rows_host(containers)
+    store.configure_expansion("device")
+    got = np.asarray(store.ship_rows(containers))
+    assert np.array_equal(got, want)
+
+
+def test_adjacent_runs_expand_bit_exact(fresh):
+    """Regression: a stop toggle landing on the NEXT run's start bit
+    (adjacent runs — disjoint, and legal in the portable format) must
+    CANCEL that start toggle, not scatter-add into a carry that inverts
+    the rest of the row's fill."""
+    cs = [
+        RunContainer(np.array([0, 6], np.uint16), np.array([5, 4], np.uint16)),
+        RunContainer(
+            np.array([0, 6, 11], np.uint16), np.array([5, 4, 20], np.uint16)
+        ),
+        # adjacency across a word boundary: 10..42 then 43..143
+        RunContainer(
+            np.array([10, 43], np.uint16), np.array([32, 100], np.uint16)
+        ),
+    ]
+    want = store.pack_rows_host(cs)
+    store.configure_expansion("device")
+    got = np.asarray(store.ship_rows(cs))
+    assert np.array_equal(got, want)
+
+
+def test_host_mode_device_rows_never_alias_the_mirror(fresh):
+    """Regression: jax's CPU client zero-copies chance-64-byte-aligned
+    host arrays on device_put — the retained ``.words`` mirror (mutated in
+    place by apply_delta) must never back the live device rows."""
+    store.configure_expansion("host")
+    for seed in range(8):  # numpy alignment is chance: try several packs
+        packed = store.pack_groups(
+            store.group_by_key(_working_set(seed=100 + seed, k=3))
+        )
+        d0 = np.asarray(packed.device_words).copy()
+        packed.words[...] ^= np.uint32(0xFFFFFFFF)
+        assert np.array_equal(np.asarray(packed.device_words), d0), seed
+
+
+def test_every_expansion_mode_serves_identical_bits(fresh):
+    bms = _working_set(seed=21)
+    bms[1].run_optimize()
+    want = FA.naive_or(*bms)
+    for mode in ("auto", "device", "host", "legacy"):
+        store.configure_expansion(mode)
+        store.PACK_CACHE.close()
+        assert FA.or_(*bms, mode="device") == want, f"mode {mode} diverged"
+
+
+def test_lazy_host_words_equal_eager_pack(fresh):
+    bms = _working_set(seed=22)
+    groups = store.group_by_key(bms)
+    packed = store.pack_groups(groups)
+    rows = [c for k in sorted(groups) for c in groups[k]]
+    assert np.array_equal(packed.words, store.pack_rows_host(rows))
+
+
+def test_expand_fault_degrades_to_host_words_bit_exact(fresh):
+    """ISSUE 8 satellite: the store.expand site must fall back to the host
+    pack.host_words path bit-exactly, recording the degrade edge."""
+    bms = _working_set(seed=23)
+    want = FA.naive_or(*bms)
+    deg = observe.REGISTRY.get(observe.DEGRADE_TOTAL)
+    before = deg.get(("store.expand", "device-expand", "host-words"))
+    with rfaults.inject("store.expand", rerrors.TransientDeviceError, every=1):
+        assert FA.or_(*bms, mode="device") == want
+    after = deg.get(("store.expand", "device-expand", "host-words"))
+    assert after > before, "the fallback must be recorded as a degrade edge"
+    # and the fallback actually host-packed (the legacy pipeline ran)
+    h = observe.REGISTRY.get(observe.HOST_OP_SECONDS)
+    assert h.get(("store.pack_rows_host",)) is not None
+
+
+# ---------------------------------------------------------------------------
+# donated delta scatter: O(k) bytes, no second full tensor, no stale aliases
+# ---------------------------------------------------------------------------
+
+
+def test_delta_ships_o_k_rows_and_never_rematerializes(fresh):
+    """A k-row delta ships exactly k·2048 uint32 words (pack_delta route)
+    and moves NO other full-tensor traffic: the flat rows ship once at
+    cold expansion, and the delta adds only its rows — the transfer
+    ledger is the proof there is no hidden second materialization."""
+    bms = _working_set(seed=31)
+    packed = store.packed_for(bms)
+    _ = packed.device_words  # cold expansion: the one full-block route
+    full_routes = ("payload_expand", "flat_rows")
+    full_before = sum(_xfer(r) for r in full_routes)
+    delta_before = _xfer("pack_delta")
+    k = 3
+    for bm in bms[:k]:
+        hb = int(bm.high_low_container.keys[0])
+        bm.add((hb << 16) | 911)
+    refreshed = store.packed_for(bms)
+    refreshed.device_words.block_until_ready()
+    assert refreshed is packed
+    assert _xfer("pack_delta") - delta_before == k * store.ROW_BYTES
+    assert sum(_xfer(r) for r in full_routes) == full_before, (
+        "the delta path must not re-ship (or re-expand) the full flat tensor"
+    )
+    # pack-cache counters agree: k rows delta-repacked
+    assert store.PACK_CACHE.stats()["delta_rows"] >= k
+
+
+def test_donated_buffer_never_served_stale(fresh):
+    """Donation-aliasing regression: after a delta, the OLD device buffer
+    is consumed (deleted — any holder fails loudly instead of reading
+    post-delta bits through a pre-delta handle), the pack serves a fresh
+    buffer generation, and the served bits are the post-delta truth."""
+    bms = _working_set(seed=32)
+    packed = store.packed_for(bms)
+    old = packed.device_words
+    gen0 = packed._buffer_gen
+    hb = int(bms[0].high_low_container.keys[0])
+    bms[0].add((hb << 16) | 4242)
+    refreshed = store.packed_for(bms)
+    assert refreshed is packed
+    assert packed._buffer_gen == gen0 + 1
+    assert old.is_deleted(), "the donated-away buffer must be consumed"
+    # mutate-after-delta serves correct bits: differential vs a fresh pack
+    fresh_pack = store.pack_groups(store.group_by_key(bms))
+    assert np.array_equal(np.asarray(packed.device_words), fresh_pack.words)
+    # derived layouts rebuilt from the new buffer, not the dead one
+    padded = packed.padded_device(0)
+    if padded is not None:
+        padded.block_until_ready()
+
+
+def test_delta_on_unmaterialized_host_words_converges(fresh):
+    """Deltas applied while the host mirror is NOT materialized ride the
+    row-override path; a later host materialization must replay them."""
+    bms = _working_set(seed=33)
+    packed = store.packed_for(bms)
+    assert packed._host_words is None, "payload path must not host-pack"
+    for bm in bms[:2]:
+        hb = int(bm.high_low_container.keys[0])
+        bm.add((hb << 16) | 1717)
+    refreshed = store.packed_for(bms)
+    assert refreshed is packed and packed._row_overrides
+    want = store.pack_groups(store.group_by_key(bms))
+    assert np.array_equal(packed.words, want.words)  # overrides replayed
+    assert not packed._row_overrides, "materialization folds the overrides"
+
+
+def test_wholesale_mutation_skips_dirty_scan(fresh):
+    """ISSUE 8 small fix: mark_all_dirty already forces the full repack —
+    the delta validator must decide from the version counters alone, not
+    pay a dirty scan first (the wasted delta.dirty_scan of r09)."""
+    bms = _working_set(seed=34)
+    store.packed_for(bms)
+    h = observe.REGISTRY.get(observe.STORE_DELTA_STAGE_SECONDS)
+    scans_before = (h.get(("dirty_scan",)) or {"count": 0})["count"]
+    bms[0].high_low_container.mark_all_dirty()
+    repacked = store.packed_for(bms)  # full repack, no scan
+    scans_after = (h.get(("dirty_scan",)) or {"count": 0})["count"]
+    assert scans_after == scans_before
+    assert np.array_equal(
+        repacked.words, store.pack_groups(store.group_by_key(bms)).words
+    )
+
+
+# ---------------------------------------------------------------------------
+# overlap shipping lane
+# ---------------------------------------------------------------------------
+
+
+def test_prefetch_stages_the_pack_and_wait_joins_it(fresh):
+    bms = _working_set(seed=41)
+    from roaringbitmap_tpu.parallel import aggregation
+
+    ticket = aggregation.prefetch(bms, "or", mode="device")
+    assert ticket is not None
+    staged = overlap.LANE.wait(bms, None)
+    assert staged is not None
+    assert staged.device_words is not None
+    # the consumer's normal lookup is a resident hit on the staged pack
+    assert store.packed_for(bms) is staged
+    g = observe.REGISTRY.get(observe.STORE_OVERLAP_RATIO)
+    assert 0.0 <= g.get(("ship",)) <= 1.0
+
+
+def test_lane_window_is_double_buffered(fresh):
+    sets = [_working_set(seed=50 + i, k=3) for i in range(3)]
+    t0 = overlap.LANE.prefetch(sets[0])
+    assert t0 is not None
+    # depth=1: a second staging while the first is pending is dropped
+    # (either it is still pending, or it finished and the window freed)
+    overlap.LANE.prefetch(sets[1])
+    pending = overlap.LANE.stats()["pending"]
+    assert pending <= overlap.LANE.depth
+    overlap.LANE.drain()
+
+
+def test_lane_stands_down_without_parallelism(fresh):
+    """On a host with nothing to overlap against, the lane must not stage
+    (the thread would time-slice the consumer's core for the same work
+    plus switch tax): prefetch returns None and the pipelined results
+    still match — the consumer just packs synchronously."""
+    overlap.LANE.configure("off")
+    bms = _working_set(seed=55, k=3)
+    assert overlap.LANE.prefetch(bms) is None
+    assert overlap.LANE.stats()["pending"] == 0
+    assert overlap.LANE.wait(bms) is None
+    jobs = [(_working_set(seed=56 + i, k=3), "or") for i in range(2)]
+    want = [FA.naive_or(*b) for b, _ in jobs]
+    got = overlap.run_pipelined(jobs, mode="device")
+    assert all(g == w for g, w in zip(got, want))
+    # "auto" resolves from the core count — on a 1-core host it inlines
+    overlap.LANE.configure("auto")
+    import os as _os
+
+    assert overlap.LANE.threaded() == ((_os.cpu_count() or 1) > 1)
+
+
+def test_run_pipelined_matches_serial_bits(fresh):
+    jobs = [(_working_set(seed=60 + i, k=4), op)
+            for i, op in enumerate(("or", "xor", "and", "or"))]
+    want = [
+        getattr(FA, {"or": "naive_or", "xor": "naive_xor", "and": "naive_and"}[op])(*b)
+        for b, op in jobs
+    ]
+    got = overlap.run_pipelined(jobs, mode="device")
+    assert all(g == w for g, w in zip(got, want))
+
+
+def test_lane_fault_degrades_to_sync_bit_exact(fresh):
+    """A fault on the lane thread (store.expand fires during staging) must
+    never escape prefetch/wait: the consumer packs synchronously and the
+    bits stay exact (fuzz family 26's invariant, unit-sized)."""
+    jobs = [(_working_set(seed=70 + i, k=3), "or") for i in range(2)]
+    want = [FA.naive_or(*b) for b, _ in jobs]
+    with rfaults.inject("store.expand", rerrors.TransientDeviceError, every=1):
+        got = overlap.run_pipelined(jobs, mode="device")
+    assert all(g == w for g, w in zip(got, want))
+
+
+def test_execute_pipelined_matches_execute(fresh):
+    from roaringbitmap_tpu.query import Q, execute
+    from roaringbitmap_tpu.query.exec import execute_pipelined
+
+    bms = _working_set(seed=80, k=5)
+    exprs = [
+        Q.or_(*[Q.leaf(b) for b in bms]),
+        Q.xor(*[Q.leaf(b) for b in bms[:3]]),
+        Q.and_(*[Q.leaf(b) for b in bms[1:]]),
+    ]
+    want = [execute(e, cache=None, mode="device") for e in exprs]
+    store.PACK_CACHE.close()
+    got = execute_pipelined(exprs, cache=None, mode="device")
+    assert all(g == w for g, w in zip(got, want))
+
+
+def test_pipelined_consumers_pop_their_stagings(fresh):
+    """Regression: a pipelined run must JOIN every staging it prefetches —
+    an unjoined staging would hold the depth-1 window (and the staged
+    working set) for the life of the process, silently degrading every
+    later prefetch to window_full."""
+    from roaringbitmap_tpu.parallel import aggregation
+    from roaringbitmap_tpu.query import Q
+    from roaringbitmap_tpu.query.exec import execute_pipelined
+
+    jobs = [(_working_set(seed=90 + i, k=3), "or") for i in range(3)]
+    overlap.run_pipelined(jobs, mode="device")
+    assert overlap.LANE.stats()["pending"] == 0
+
+    bms = _working_set(seed=95, k=5)
+    exprs = [
+        Q.or_(*[Q.leaf(b) for b in bms]),
+        Q.xor(*[Q.leaf(b) for b in bms[:3]]),
+    ]
+    execute_pipelined(exprs, cache=None, mode="device")
+    assert overlap.LANE.stats()["pending"] == 0
+    # the window is free: the next prefetch stages instead of dropping
+    ticket = aggregation.prefetch(
+        _working_set(seed=96, k=3), "or", mode="device"
+    )
+    assert ticket is not None
+    overlap.LANE.drain()
+
+
+def test_lane_reaps_orphaned_stagings(fresh):
+    """Regression: a done-but-never-joined staging (e.g. the consumer's
+    bitmaps mutated, so the join key no longer matches) must not wedge the
+    depth-1 window forever — prefetch reaps finished futures before
+    declaring the window full."""
+    a, b = _working_set(seed=97, k=3), _working_set(seed=98, k=3)
+    t0 = overlap.LANE.prefetch(a)
+    assert t0 is not None
+    t0.future.result()  # staged and done, but never joined
+    t1 = overlap.LANE.prefetch(b)
+    assert t1 is not None  # the orphan was reaped, the window is free
+    assert overlap.LANE.stats()["pending"] == 1
+    overlap.LANE.drain()
+
+
+def test_fatal_in_reaped_orphan_does_not_wedge_the_window(fresh):
+    """Regression: when prefetch reaps an orphaned staging whose parked
+    error classifies FATAL, the re-raise must happen BEFORE the new
+    staging is inserted — a never-submitted Future left in the window
+    would block every later wait on its key forever."""
+    a, b = _working_set(seed=110, k=3), _working_set(seed=111, k=3)
+    with rfaults.inject("store.expand", ValueError, every=1):
+        t0 = overlap.LANE.prefetch(a)
+        assert t0 is not None
+        assert isinstance(t0.future.exception(), ValueError)  # parked FATAL
+    with pytest.raises(ValueError):
+        overlap.LANE.prefetch(b)  # reaps the orphan, re-raises its FATAL
+    assert overlap.LANE.stats()["pending"] == 0  # b was never inserted
+    t1 = overlap.LANE.prefetch(b)  # the window is usable again
+    assert t1 is not None
+    overlap.LANE.drain()
+
+
+def test_join_pops_staging_by_op_marker(fresh):
+    """LANE.join addresses a staging by (op, fingerprints) without paying
+    the dispatch prelude a second time — including AND's key-filtered
+    marker."""
+    from roaringbitmap_tpu.parallel import aggregation
+
+    bms = _working_set(seed=99, k=3)
+    assert aggregation.prefetch(bms, "and", mode="device") is not None
+    staged = overlap.LANE.join(bms, "and")
+    assert staged is not None
+    assert overlap.LANE.stats()["pending"] == 0
+
+
+# ---------------------------------------------------------------------------
+# ship_rows (query kernels' first-operand rows)
+# ---------------------------------------------------------------------------
+
+
+def test_ship_rows_matches_host_pack(fresh):
+    containers = _mixed_containers(seed=5)
+    want = store.pack_rows_host(containers)
+    assert np.array_equal(np.asarray(store.ship_rows(containers)), want)
+    with rfaults.inject("store.expand", rerrors.TransientDeviceError, every=1):
+        assert np.array_equal(np.asarray(store.ship_rows(containers)), want)

@@ -314,11 +314,15 @@ def test_facade_parity_shapes_and_determinism():
             isinstance(k, str) and isinstance(v, int)
             for k, v in first_counters[section].items()
         )
-    assert first_counters["kernel"] == {"grouped/xla": 1}
+    # ISSUE 8 tiering: a freshly packed set's first (and here only)
+    # reduce rides the fused gather+reduce dispatch
+    assert first_counters["kernel"] == {"grouped_fused/xla": 1}
     assert sum(first_counters["layout"].values()) == 1
     for entry in first_timings.values():
         assert set(entry) == {"count", "total_s", "mean_ms"}
-    assert first_timings["store.pack_rows_host"]["count"] == 1
+    # ISSUE 8: the cold marshal no longer host-packs (device-side
+    # expansion); the unpack span is the stable host phase of the workload
+    assert first_timings["store.unpack_to_bitmap"]["count"] == 1
 
     insights.reset_dispatch_counters()
     tracing.reset_timings()
@@ -334,7 +338,11 @@ def test_facades_are_registry_views():
     insights.reset_dispatch_counters()
     _workload()
     reg_counter = observe.REGISTRY.get(observe.KERNEL_DISPATCH_TOTAL)
-    assert reg_counter.get(("grouped", "xla")) == pk.DISPATCH_COUNTS[("grouped", "xla")] == 1
+    assert (
+        reg_counter.get(("grouped_fused", "xla"))
+        == pk.DISPATCH_COUNTS[("grouped_fused", "xla")]
+        == 1
+    )
     layout = observe.REGISTRY.get(observe.STORE_LAYOUT_TOTAL)
     assert {lv[0]: v for lv, v in layout.series().items()} == dict(store.LAYOUT_COUNTS)
     xfer = observe.REGISTRY.get(observe.STORE_TRANSFER_BYTES_TOTAL)
@@ -434,12 +442,15 @@ def test_sidecar_snapshot_reflects_workload():
     tracing.reset_timings()
     _workload()
     side = observe.sidecar_snapshot()
-    assert side["kernel"] == {"grouped/xla": 1}
+    assert side["kernel"] == {"grouped_fused/xla": 1}
     assert sum(side["layout"].values()) == 1
     assert side["transfer_bytes"]  # the working set shipped at least once
-    assert "store.pack_rows_host" in side["spans"]
+    assert "store.unpack_to_bitmap" in side["spans"]
     # reduce span nests the probe/dispatch work under the layout it chose
     assert any(p.startswith("store.reduce.") for p in side["spans"])
+    # ISSUE 8: the marshal records as the device_expand pack stage now
+    lat = observe.sidecar_snapshot()["latency"]
+    assert "device_expand" in lat["rb_tpu_store_pack_stage_seconds"]
 
 
 # ---------------------------------------------------------------------------

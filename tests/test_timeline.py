@@ -309,10 +309,19 @@ def test_pack_and_delta_stages_attribute_the_walls(recording):
     pack_wall = time.perf_counter() - t0
     pack_stages = tl.stage_totals(
         tl.RECORDER.events(),
-        ["pack.key_plan", "pack.group_tables", "pack.host_words", "pack.provenance"],
+        # ISSUE 8: the cold pack builds a compact payload (pack.payload_build);
+        # word expansion moved off the pack wall into pack.device_expand at
+        # first device touch (asserted below)
+        ["pack.key_plan", "pack.group_tables", "pack.payload_build",
+         "pack.provenance"],
     )
     assert all(v > 0 for v in pack_stages.values())
     assert sum(pack_stages.values()) <= pack_wall * 1.01
+
+    tl.RECORDER.clear()
+    _ = packed.device_words
+    expand_stages = tl.stage_totals(tl.RECORDER.events(), ["pack.device_expand"])
+    assert expand_stages["pack.device_expand"] > 0
 
     _ = packed.device_words
     for bm in bms[:3]:
