@@ -24,6 +24,28 @@ registration call (``observe.counter(...)`` / ``_observe.gauge(...)`` /
   in-file constant is validated directly, a cross-module constant must be
   ``*_SECONDS``-shaped so the defining module's check covers it.
 
+**Label-value cardinality** (ISSUE 9): metric *mutations* on module-level
+metric constants (``_FOO_TOTAL.inc(1, (value,))`` / ``.observe`` /
+``.set`` / ``.dec``) must not pass unbounded-cardinality label values —
+a trace id, fingerprint, or raw container key as a label value mints a
+new time series per query and melts any scrape backend. Each element of
+a literal label tuple must be:
+
+* a string literal, or
+* a subscript of an in-file ALL_CAPS constant collection
+  (``CLASS_NAMES[ci]`` — a member of a frozen declared set), or
+* a name/attribute whose terminal identifier does NOT read as an
+  unbounded value (``trace``/``fingerprint``/``uid``/``hash``/``key``/
+  ... — see ``_UNBOUNDED``); benign enumerator names (``kind``, ``op``,
+  ``site``, ``tier``) pass, pinned by false-positive fixtures.
+
+f-strings, string concatenation, and call results (``bm.fingerprint()``)
+are computed values and always flagged (``str(name)`` of a benign name is
+the one exemption — it stringifies, it does not fabricate). Unbounded
+values belong on flight-recorder events and decision-log entries, which
+are bounded rings. A labels argument that is itself a variable is out of
+lexical scope, like aliasing in lock-discipline.
+
 Forwarding wrappers (a call whose name argument is the enclosing
 function's own ``name`` parameter, e.g. the module-level ``counter()``
 helpers in registry.py) are exempt — the real declaration is at their
@@ -42,6 +64,19 @@ PREFIX = "rb_tpu_"
 _REG_METHODS = {"counter", "gauge", "histogram", "latency_histogram"}
 # registration methods whose metrics measure seconds (unit suffix required)
 _SECONDS_METHODS = {"latency_histogram"}
+# metric mutation methods whose label values are cardinality-checked
+_MUT_METHODS = {"inc", "dec", "set", "observe"}
+# receivers checked for mutations: module-level metric constants
+# (optionally underscore-private), the registration convention throughout
+_METRIC_CONST = re.compile(r"^_?[A-Z][A-Z0-9_]*$")
+# identifier fragments that read as unbounded-cardinality values: one per
+# query / operand / container, never a closed enumeration. Word-bounded so
+# benign enumerators (kind, op, site, tier, stage, route, state) pass.
+_UNBOUNDED = re.compile(
+    r"(^|_)(trace|traceid|span_id|fingerprint|fingerprints|fp|fps|uid|"
+    r"uuid|digest|hash|hashes|token|key|keys|qid|query_id|request_id|"
+    r"id)(_|$)"
+)
 _ALL_CAPS = re.compile(r"^[A-Z][A-Z0-9_]*$")
 # constant names that read as canonical metric names (unit-suffixed; RATIO
 # is the dimensionless gauge unit — e.g. rb_tpu_store_overlap_ratio)
@@ -133,6 +168,8 @@ class MetricNaming(Checker):
             if fname is None:
                 continue
             tail = fname.rsplit(".", 1)[-1]
+            if tail in _MUT_METHODS:
+                yield from self._check_label_values(ctx, node, tail)
             if tail not in _REG_METHODS:
                 continue
             # registration needs at least the name argument
@@ -223,6 +260,71 @@ class MetricNaming(Checker):
             "metric name must be a string literal or ALL_CAPS constant "
             "(computed names fork the metric namespace)",
         )
+
+    def _check_label_values(self, ctx, call, method) -> Iterable[Finding]:
+        """Unbounded-cardinality guard on metric *mutations* (ISSUE 9):
+        ``_FOO_TOTAL.inc(1, (trace_id,))`` mints a series per query."""
+        # receiver must be a module-level metric constant (_FOO_TOTAL.inc /
+        # mod._FOO_SECONDS.observe); instance attrs and locals are other
+        # objects wearing the same method names
+        if not isinstance(call.func, ast.Attribute):
+            return
+        recv = call.func.value
+        term = recv.attr if isinstance(recv, ast.Attribute) else (
+            recv.id if isinstance(recv, ast.Name) else None
+        )
+        if term is None or not _METRIC_CONST.match(term):
+            return
+        label_arg = call.args[1] if len(call.args) >= 2 else None
+        for kw in call.keywords:
+            if kw.arg == "labels":
+                label_arg = kw.value
+        # a non-tuple labels argument (a variable) is out of lexical scope,
+        # like aliasing in lock-discipline
+        if not isinstance(label_arg, (ast.Tuple, ast.List)):
+            return
+        for el in label_arg.elts:
+            yield from self._check_label_value(ctx, call, el)
+
+    def _check_label_value(self, ctx, call, el) -> Iterable[Finding]:
+        if isinstance(el, ast.Constant):
+            return  # literal: declared, bounded
+        if isinstance(el, ast.Subscript) and isinstance(el.value, ast.Name) \
+                and _METRIC_CONST.match(el.value.id):
+            return  # member of an in-file ALL_CAPS constant collection
+        if isinstance(el, (ast.JoinedStr, ast.BinOp)):
+            yield self.finding(
+                ctx, call,
+                "computed metric label value (f-string/concatenation): "
+                "unbounded values belong on recorder events or the "
+                "decision log, not in label sets",
+            )
+            return
+        if isinstance(el, ast.Call):
+            # str(<benign name>) merely stringifies: check the inner name
+            if (
+                isinstance(el.func, ast.Name) and el.func.id == "str"
+                and len(el.args) == 1 and isinstance(el.args[0], ast.Name)
+            ):
+                yield from self._check_label_value(ctx, call, el.args[0])
+                return
+            yield self.finding(
+                ctx, call,
+                "metric label value computed by a call: unbounded values "
+                "(fingerprints, ids) belong on recorder events or the "
+                "decision log, not in label sets",
+            )
+            return
+        term = dotted_name(el)
+        term = term.rsplit(".", 1)[-1] if term else None
+        if term is not None and _UNBOUNDED.search(term.lower()):
+            yield self.finding(
+                ctx, call,
+                f"metric label value `{term}` reads as unbounded "
+                "cardinality (per-query/per-operand): use a literal or a "
+                "member of a declared frozen set, and put the raw value "
+                "on a recorder event or decision-log entry instead",
+            )
 
     def _check_labels(self, ctx, call) -> Iterable[Finding]:
         label_arg = None

@@ -30,6 +30,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from .. import observe as _observe
+from ..observe import decisions as _decisions
 from ..observe import timeline as _timeline
 from ..robust import errors as _rerrors
 from ..robust import ladder as _ladder
@@ -170,21 +171,50 @@ def _dense_hint(hlc) -> bool:
 def enabled_for(a_hlc, b_hlc) -> bool:
     """Route this pair columnar? Cheap pre-plan gate: container counts in
     [min_containers, max_containers] on BOTH sides plus a sampled
-    dense-shape hint on either side."""
+    dense-shape hint on either side.
+
+    Decision provenance (ISSUE 9): verdicts record into the decision log
+    only once the count gate passes — above it the op costs tens of
+    microseconds and a record is noise-free signal; below it the
+    per-container walk sits at its ~2 µs C floor and must not pay even a
+    deque append (the jmh small-operand grids pin that floor)."""
     if not _routing_on():
         return False
     na, nb = a_hlc.size, b_hlc.size
-    return (
+    if not (
         na >= config.min_containers
         and nb >= config.min_containers
         and na <= config.max_containers
         and nb <= config.max_containers
-        and (_dense_hint(a_hlc) or _dense_hint(b_hlc))
+    ):
+        return False
+    if _dense_hint(a_hlc) or _dense_hint(b_hlc):
+        _decisions.record_decision(
+            "columnar.cutoff", "columnar", reason="dense-hint", na=na, nb=nb
+        )
+        return True
+    _decisions.record_decision(
+        "columnar.cutoff", "per-container", reason="array-only", na=na, nb=nb
     )
+    return False
 
 
 def enabled_for_fold(n_rows: int) -> bool:
-    return _routing_on() and n_rows >= config.min_fold_rows
+    """Route an N-way fold through the columnar batch engine? One verdict
+    per fold (milliseconds of work), so both outcomes record."""
+    if not _routing_on():
+        return False
+    verdict = n_rows >= config.min_fold_rows
+    _decisions.record_decision(
+        "columnar.cutoff", "columnar-fold" if verdict else "per-container-fold",
+        rows=n_rows, min_fold_rows=config.min_fold_rows,
+    )
+    return verdict
+
+
+# declared fold-op label values (the metric-naming rule rejects computed
+# label values — the label set is a frozen enumeration, so declare it)
+_FOLD_LABELS = {"or": "fold_or", "xor": "fold_xor", "and": "fold_and"}
 
 
 def _record(op: str, codes_a: np.ndarray, codes_b: np.ndarray) -> None:
@@ -645,7 +675,7 @@ def fold(groups: Dict[int, List[Container]], op: str) -> RoaringBitmap:
             multi_cs.append(cs)
             n_rows += len(cs)
     if n_rows:
-        _COLUMNAR_TOTAL.inc(n_rows, labels=(f"fold_{op}", "rows"))
+        _COLUMNAR_TOTAL.inc(n_rows, labels=(_FOLD_LABELS[op], "rows"))
     out = RoaringBitmap()
     hlc = out.high_low_container
     results: Dict[int, Optional[Container]] = {}

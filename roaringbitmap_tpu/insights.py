@@ -178,6 +178,42 @@ def robust_counters() -> dict:
     }
 
 
+def decisions(n: int = None) -> list:
+    """Decision provenance (ISSUE 9): the newest ``n`` entries of the
+    bounded decision log (all retained when None), oldest first. Each
+    entry names the deciding site, the decision, the inputs that drove
+    it, and the query trace id it was made under — "why was this slow"
+    as one artifact (planner engine choices, dispatch start tiers, ladder
+    degrades/breaker flips, pack-cache admission/eviction/spill, columnar
+    cutoff verdicts)."""
+    from . import observe
+
+    return observe.decisions.decisions(n)
+
+
+def observatory() -> dict:
+    """Resource-observatory snapshot (ISSUE 9): lock-wait quantiles over
+    the framework locks (empty until ``observe.lockstats.install()``),
+    per-fn jit compile/retrace counts, the device-memory reconciliation
+    report (computed fresh), current breaker states, pack-cache stats,
+    and the decision-log tail. ``scripts/rb_top.py`` renders exactly
+    this."""
+    from . import observe
+    from .observe import lockstats
+    from .parallel import store
+    from .robust import ladder
+
+    return {
+        "locks": lockstats.wait_stats(),
+        "lock_timing": lockstats.timing_enabled(),
+        "compile": observe.compilewatch.compile_counts(),
+        "hbm": store.hbm_reconciliation(),
+        "breakers": ladder.LADDER.states(),
+        "pack_cache": store.PACK_CACHE.stats(),
+        "decisions": decisions(32),
+    }
+
+
 def metrics_snapshot() -> dict:
     """The full labeled registry snapshot (every rb_tpu_* metric incl.
     histograms) — the machine-readable superset of dispatch_counters();

@@ -23,11 +23,15 @@ from .registry import (
     BREAKER_TRANSITIONS_TOTAL,
     COLUMNAR_BATCH_TOTAL,
     COLUMNAR_CLASS_SECONDS,
+    COMPILE_TOTAL,
     DEADLINE_TOTAL,
+    DECISION_TOTAL,
     DEFAULT_TIME_BUCKETS,
     DEGRADE_TOTAL,
     FAULT_INJECTED_TOTAL,
+    HBM_ACCOUNTING_DRIFT_BYTES,
     HOST_OP_SECONDS,
+    LOCK_WAIT_SECONDS,
     KERNEL_DISPATCH_TOTAL,
     KERNEL_PROBE_TOTAL,
     PACK_CACHE_DELTA_ROWS_TOTAL,
@@ -71,6 +75,14 @@ from .histogram import (
 )
 from . import timeline
 from .timeline import FlightRecorder, TimelineEvent
+# query-scoped trace context + decision provenance (ISSUE 9); the lock
+# observatory (observe.lockstats) is import-on-demand — it patches locks
+# across the whole framework and must never load mid-import-cycle
+from . import context
+from . import decisions
+from . import compilewatch
+from .context import adopt, current_trace, new_trace_id, trace_scope
+from .decisions import DecisionLog, record_decision
 from .spans import current_path, depth, reset_spans, span, span_timings
 
 # the .histogram submodule import above shadows the registration helper on
@@ -152,4 +164,17 @@ __all__ = [
     "RETRY_TOTAL",
     "FAULT_INJECTED_TOTAL",
     "DEADLINE_TOTAL",
+    "LOCK_WAIT_SECONDS",
+    "COMPILE_TOTAL",
+    "HBM_ACCOUNTING_DRIFT_BYTES",
+    "DECISION_TOTAL",
+    "context",
+    "decisions",
+    "compilewatch",
+    "trace_scope",
+    "adopt",
+    "current_trace",
+    "new_trace_id",
+    "record_decision",
+    "DecisionLog",
 ]

@@ -1,0 +1,136 @@
+"""jit compile/retrace observatory (ISSUE 9 tentpole, leg 3b).
+
+PR 8's marshal rebuild claims its pow2-padded kernel arguments *bound*
+retraces — but nothing counted them, so a shape-leak regression would
+surface only as mysteriously slow steady state. This module counts every
+XLA trace of the pipeline's jitted entry points into
+``rb_tpu_compile_total{fn}``.
+
+Mechanism: ``tracked(name)`` wraps the *pre-jit* Python callable. Under
+``jax.jit`` the Python body runs exactly once per compilation (tracing
+executes it; cache hits do not), so a counter bump inside the wrapper
+counts compiles/retraces precisely — no polling, no jax internals. The
+wrapper preserves the signature (``functools.wraps``), so
+``static_argnames``/``donate_argnums`` resolve unchanged::
+
+    @functools.partial(jax.jit, static_argnames=("op",))
+    @compilewatch.tracked("wide_reduce")
+    def wide_reduce(words, op="or"): ...
+
+Per-call steady-state cost: zero — the wrapper body only runs while XLA
+is already spending milliseconds-to-seconds compiling.
+
+**Anomaly hook**: when any fn's trace count passes the budget
+(``RB_TPU_COMPILE_BUDGET``, default 32; ``configure(budget=...)``;
+``<= 0`` disables), the flight recorder flushes to a JSONL artifact
+(``RB_TPU_COMPILE_DUMP``, default ``rb_tpu_compile_anomaly.jsonl``) with
+the offending fn in the trigger header — the "what shapes led up to
+this" context a post-hoc counter cannot reconstruct. Dumps are throttled
+to one per second; ``rb_tpu_timeline_anomaly_total{cat="compile"}``
+counts every overrun regardless.
+
+``compile_counts()`` is the read API; bench.py snapshots it around the
+timed reduction reps to *prove* the north-star pipeline reaches steady
+state with zero retraces after warmup (the acceptance row).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from . import registry as _registry
+from . import timeline as _timeline
+
+_COMPILE_TOTAL = _registry.counter(
+    _registry.COMPILE_TOTAL,
+    "XLA traces (compiles + retraces) of tracked jitted entry points",
+    ("fn",),
+)
+
+DEFAULT_BUDGET = 32
+
+
+def _init_budget() -> int:
+    raw = os.environ.get("RB_TPU_COMPILE_BUDGET")
+    try:
+        return int(raw) if raw else DEFAULT_BUDGET
+    except ValueError:  # malformed env must not break package import
+        return DEFAULT_BUDGET
+
+
+_BUDGET = _init_budget()
+_DUMP_PATH = os.environ.get("RB_TPU_COMPILE_DUMP") or "rb_tpu_compile_anomaly.jsonl"
+
+_THROTTLE_LOCK = threading.Lock()
+_LAST_DUMP_NS = 0  # guarded-by: _THROTTLE_LOCK
+_DUMP_MIN_INTERVAL_NS = 1_000_000_000
+
+
+def configure(
+    budget: Optional[int] = None, dump_path: Optional[str] = None
+) -> None:
+    """Runtime overrides: ``budget <= 0`` disables the anomaly hook."""
+    global _BUDGET, _DUMP_PATH
+    if budget is not None:
+        _BUDGET = int(budget)
+    if dump_path is not None:
+        _DUMP_PATH = dump_path
+
+
+def tracked(name: str) -> Callable:
+    """Decorator (applied UNDER ``jax.jit``) counting each trace of the
+    wrapped callable as one compile of ``name``."""
+
+    def deco(fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            _note_trace(name)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def _note_trace(name: str) -> None:
+    _COMPILE_TOTAL.inc(1, (name,))
+    total = _COMPILE_TOTAL.get((name,))
+    if _timeline.enabled():
+        _timeline.instant("compile.trace", "compile", fn=name, total=total)
+    if _BUDGET > 0 and total > _BUDGET:
+        _anomaly(name, total)
+
+
+def _anomaly(name: str, total: int) -> None:
+    global _LAST_DUMP_NS
+    _timeline._ANOMALY_TOTAL.inc(1, ("compile",))
+    _timeline.instant(
+        "compile.anomaly", "anomaly", fn=name, total=total, budget=_BUDGET
+    )
+    now = time.perf_counter_ns()
+    with _THROTTLE_LOCK:
+        if _LAST_DUMP_NS and now - _LAST_DUMP_NS < _DUMP_MIN_INTERVAL_NS:
+            return
+        _LAST_DUMP_NS = now
+        path = _DUMP_PATH
+    try:
+        _timeline.dump_jsonl(
+            path,
+            trigger={"compile_fn": name, "traces": total, "budget": _BUDGET},
+        )
+    except OSError:  # rb-ok: exception-hygiene -- diagnostics must never fail a compile; the anomaly counter above already recorded the overrun
+        pass
+
+
+def compile_counts() -> Dict[str, int]:
+    """{fn: traces-so-far} for every tracked entry point."""
+    return {lv[0]: int(v) for lv, v in _COMPILE_TOTAL.series().items()}
+
+
+def reset_counts() -> None:
+    """Clear the per-fn series (tests; the metric stays registered)."""
+    _COMPILE_TOTAL.clear()

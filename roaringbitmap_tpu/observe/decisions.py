@@ -1,0 +1,162 @@
+"""Decision provenance: a bounded in-memory log of every choice the
+pipeline makes (ISSUE 9 tentpole, leg 2).
+
+The system decides constantly — the planner picks an engine per node, the
+dispatch prelude picks a start tier, the ladder degrades and trips
+breakers, the pack cache admits/evicts/spills, the columnar router
+accepts or rejects a cutoff — and before this module each decision left
+at best a counter bump: "why was this query slow" required reverse-
+engineering aggregate metrics. Now every decision site calls
+:func:`record_decision` with the decision **and the inputs that drove
+it**, landing in one bounded ring (``insights.decisions()`` is the read
+API, ``scripts/rb_top.py`` renders the tail) and — when a timeline mode
+is active — mirroring onto the flight recorder as a ``decision.<site>``
+instant, so Perfetto shows the choice at the moment it was made, on the
+thread and under the trace id that made it.
+
+Entry shape (plain dicts, json-dumpable)::
+
+    {"ts_ns": ..., "site": "query.plan", "decision": "device-or",
+     "trace": "q00002a", "inputs": {"op": "or", "est_rows": 308211}}
+
+Bounds & cost: the ring holds ``RB_TPU_DECISIONS_CAPACITY`` entries
+(default 512) under a leaf lock — recording is a deque append plus one
+labeled counter bump (``rb_tpu_decision_total{site}``), nanoseconds
+against the microsecond-to-second decisions it records. Hot per-pair
+sites (the columnar cutoff) only record above the count gate, where the
+op itself costs tens of microseconds — the 2 µs per-container floor
+never pays a record (see columnar/engine.py). ``configure(enabled=
+False)`` is the bench twin's kill switch.
+
+Trace ids, fingerprints, and other unbounded values belong in the entry
+payload — never in metric labels (the metric-naming analysis rule now
+rejects that).
+
+Lock discipline: the log lock is a leaf — record() takes it only around
+the deque append, so decision sites inside other framework locks (the
+pack-cache evictor) nest safely.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from . import context as _context
+from . import registry as _registry
+from . import timeline as _timeline
+
+DEFAULT_CAPACITY = 512
+
+_DECISION_TOTAL = _registry.counter(
+    _registry.DECISION_TOTAL,
+    "Decisions recorded into the provenance log by deciding site",
+    ("site",),
+)
+
+_ENABLED = True
+
+
+class DecisionLog:
+    """Thread-safe bounded ring of decision entries (newest last)."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self._lock = threading.Lock()  # leaf: guards the deque only
+        self._ring: "deque[dict]" = deque(maxlen=int(capacity))  # guarded-by: self._lock
+        self._total = 0  # guarded-by: self._lock
+
+    @property
+    def capacity(self) -> int:
+        return self._ring.maxlen
+
+    def record(self, entry: dict) -> None:
+        with self._lock:
+            self._ring.append(entry)
+            self._total += 1
+
+    def tail(self, n: Optional[int] = None) -> List[dict]:
+        """The newest ``n`` entries (all retained when None), oldest
+        first — point-in-time copies, safe to mutate."""
+        with self._lock:
+            entries = list(self._ring)
+        if n is not None:
+            entries = entries[-int(n):] if n > 0 else []
+        return [dict(e) for e in entries]
+
+    def total(self) -> int:
+        """Decisions ever recorded (retained + overwritten)."""
+        with self._lock:
+            return self._total
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._total = 0
+
+    def resize(self, capacity: int) -> None:
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        with self._lock:
+            self._ring = deque(self._ring, maxlen=int(capacity))
+
+
+def _init_capacity() -> int:
+    raw = os.environ.get("RB_TPU_DECISIONS_CAPACITY")
+    try:
+        return max(1, int(raw)) if raw else DEFAULT_CAPACITY
+    except ValueError:
+        return DEFAULT_CAPACITY
+
+
+# The process-wide log every decision site records into.
+LOG = DecisionLog(_init_capacity())
+
+
+def configure(
+    enabled: Optional[bool] = None, capacity: Optional[int] = None
+) -> None:
+    """Runtime overrides: ``enabled=False`` is the bench twin's kill
+    switch (recording reduces to one bool check); ``capacity`` re-bounds
+    the ring keeping the newest entries."""
+    global _ENABLED
+    if enabled is not None:
+        _ENABLED = bool(enabled)
+    if capacity is not None:
+        LOG.resize(capacity)
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def record_decision(site: str, decision: str, /, **inputs) -> None:
+    """Record one decision: what was chosen at ``site`` and the inputs
+    that drove the choice. Also bumps ``rb_tpu_decision_total{site}`` and
+    mirrors a ``decision.<site>`` flight-recorder instant when a timeline
+    mode is active (the instant carries the ambient trace id)."""
+    if not _ENABLED:
+        return
+    entry: Dict = {
+        "ts_ns": time.perf_counter_ns(),
+        "site": site,
+        "decision": decision,
+        "trace": _context.current_trace(),
+    }
+    if inputs:
+        entry["inputs"] = inputs
+    LOG.record(entry)
+    _DECISION_TOTAL.inc(1, (site,))
+    if _timeline.enabled():
+        _timeline.instant(
+            "decision." + site, "decision", decision=decision, **inputs
+        )
+
+
+def decisions(n: Optional[int] = None) -> List[dict]:
+    """The decision-log tail (newest ``n``, oldest first)."""
+    return LOG.tail(n)

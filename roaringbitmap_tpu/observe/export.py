@@ -208,6 +208,14 @@ def sidecar_snapshot(registry: Optional[Registry] = None) -> dict:
         "timings": _histogram_timings(snap, _registry.HOST_OP_SECONDS),
         "spans": _histogram_timings(snap, _registry.SPAN_SECONDS),
         "latency": _latency_summaries(_reg(registry)),
+        # resource observatory (ISSUE 9): lock-wait totals (quantiles ride
+        # in the latency block above), per-fn compile/retrace counts, the
+        # device-memory accounting drift gauges, and decision volume —
+        # the blocks scripts/ci.sh gates next to the pack/delta rows
+        "lock_wait": _histogram_timings(snap, _registry.LOCK_WAIT_SECONDS),
+        "compile": _counter_map(snap, _registry.COMPILE_TOTAL),
+        "hbm_drift": _counter_map(snap, _registry.HBM_ACCOUNTING_DRIFT_BYTES),
+        "decisions": _counter_map(snap, _registry.DECISION_TOTAL),
         "registry": snap,
     }
 

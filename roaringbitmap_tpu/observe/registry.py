@@ -72,6 +72,14 @@ BREAKER_TRANSITIONS_TOTAL = "rb_tpu_breaker_transitions_total"
 RETRY_TOTAL = "rb_tpu_retry_total"
 FAULT_INJECTED_TOTAL = "rb_tpu_fault_injected_total"
 DEADLINE_TOTAL = "rb_tpu_deadline_total"
+# resource observatory + decision provenance (ISSUE 9): lock-wait
+# histograms over the framework locks, jit compile/retrace counts per
+# tracked entry point, device-memory accounting drift (gauge vs reality),
+# and the decision-log volume per deciding site
+LOCK_WAIT_SECONDS = "rb_tpu_lock_wait_seconds"
+COMPILE_TOTAL = "rb_tpu_compile_total"
+HBM_ACCOUNTING_DRIFT_BYTES = "rb_tpu_hbm_accounting_drift_bytes"
+DECISION_TOTAL = "rb_tpu_decision_total"
 
 # upper bucket bounds (seconds) for wall-time histograms: host phases span
 # ~100 µs packing steps to multi-second CPU folds; +Inf is implicit

@@ -56,6 +56,7 @@ import threading
 import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
+from . import context as _context
 from . import registry as _registry
 from .histogram import latency_histogram
 
@@ -80,11 +81,15 @@ _ANOMALY_TOTAL = _registry.counter(
 
 class TimelineEvent:
     """One recorded event. ``ph`` follows the trace-event format: ``"X"``
-    (complete span, has ``dur_ns``) or ``"i"`` (instant)."""
+    (complete span, has ``dur_ns``), ``"i"`` (instant), or ``"s"``/``"t"``/
+    ``"f"`` (flow start/step/finish — producer/consumer links across
+    threads; the flow id lives in ``attrs["flow"]``). ``trace`` is the
+    query-scoped trace id active when the event was recorded (ISSUE 9) —
+    None outside any trace scope."""
 
-    __slots__ = ("name", "cat", "ph", "ts_ns", "dur_ns", "tid", "attrs")
+    __slots__ = ("name", "cat", "ph", "ts_ns", "dur_ns", "tid", "attrs", "trace")
 
-    def __init__(self, name, cat, ph, ts_ns, dur_ns, tid, attrs):
+    def __init__(self, name, cat, ph, ts_ns, dur_ns, tid, attrs, trace=None):
         self.name = name
         self.cat = cat
         self.ph = ph
@@ -92,6 +97,7 @@ class TimelineEvent:
         self.dur_ns = dur_ns
         self.tid = tid
         self.attrs = attrs
+        self.trace = trace
 
     def to_dict(self) -> dict:
         d = {
@@ -103,6 +109,8 @@ class TimelineEvent:
         }
         if self.ph == "X":
             d["dur_us"] = self.dur_ns / 1e3
+        if self.trace is not None:
+            d["trace"] = self.trace
         if self.attrs:
             d["args"] = dict(self.attrs)
         return d
@@ -254,19 +262,44 @@ def fence(x):
     return x
 
 
+def register_thread(name: Optional[str] = None) -> None:
+    """Eagerly register this thread's display name for the Chrome-trace
+    ``thread_name`` metadata (mode-independent). Recording registers names
+    lazily as a backstop, but a dedicated worker (the ShipLane pool) must
+    register at thread start so it is named from its very first event —
+    a bare tid in Perfetto is an attribution dead end (ISSUE 9
+    satellite)."""
+    tid = threading.get_ident()
+    with _STATE_LOCK:
+        _THREAD_NAMES[tid] = name or threading.current_thread().name
+
+
+def thread_names() -> Dict[int, str]:
+    """Point-in-time copy of the tid -> display-name registry."""
+    with _STATE_LOCK:
+        return dict(_THREAD_NAMES)
+
+
 def _record_complete(name, cat, t0_ns, dur_ns, attrs) -> None:
     tid = threading.get_ident()
     with _STATE_LOCK:
         _THREAD_NAMES[tid] = threading.current_thread().name
         budget = _BUDGET_NS
-    RECORDER.record(TimelineEvent(name, cat, "X", t0_ns, dur_ns, tid, attrs))
+    RECORDER.record(
+        TimelineEvent(
+            name, cat, "X", t0_ns, dur_ns, tid, attrs,
+            trace=_context.current_trace(),
+        )
+    )
     _SPAN_SECONDS.observe(dur_ns / 1e9, (cat,))
     if budget is not None and dur_ns > budget:
         _anomaly(name, cat, dur_ns, budget)
 
 
-def instant(name: str, cat: str = "event", **attrs) -> None:
-    """Record a zero-duration marker (cache hit/miss/evict, epoch flip)."""
+def instant(name: str, cat: str = "event", /, **attrs) -> None:
+    """Record a zero-duration marker (cache hit/miss/evict, epoch flip).
+    ``name``/``cat`` are positional-only so attrs may carry those keys
+    (decision inputs are arbitrary key/value pairs)."""
     if _MODE == OFF:
         return
     tid = threading.get_ident()
@@ -274,9 +307,40 @@ def instant(name: str, cat: str = "event", **attrs) -> None:
         _THREAD_NAMES[tid] = threading.current_thread().name
     RECORDER.record(
         TimelineEvent(
-            name, cat, "i", time.perf_counter_ns(), 0, tid, attrs or None
+            name, cat, "i", time.perf_counter_ns(), 0, tid, attrs or None,
+            trace=_context.current_trace(),
         )
     )
+
+
+def flow_point(name: str, phase: str, flow_id: int, cat: str = "flow") -> None:
+    """Record one flow event: ``phase`` is ``"s"`` (start, at the
+    producer), ``"t"`` (step), or ``"f"`` (finish, at the consumer).
+    Events sharing a ``flow_id`` render as one arrow chain in Perfetto —
+    the cross-thread producer/consumer link (e.g. a query's prefetch
+    handoff to the ShipLane and back to its ``overlap_wait``) that
+    same-thread nesting cannot express. No-op when recording is off."""
+    if _MODE == OFF:
+        return
+    if phase not in ("s", "t", "f"):
+        raise ValueError(f"flow phase must be 's'/'t'/'f', got {phase!r}")
+    tid = threading.get_ident()
+    with _STATE_LOCK:
+        _THREAD_NAMES[tid] = threading.current_thread().name
+    RECORDER.record(
+        TimelineEvent(
+            name, cat, phase, time.perf_counter_ns(), 0, tid,
+            {"flow": int(flow_id)}, trace=_context.current_trace(),
+        )
+    )
+
+
+def flow_id(*parts) -> int:
+    """A stable 32-bit flow id from hashable parts (trace id + handoff
+    key): producer and consumer compute the same id independently."""
+    import zlib
+
+    return zlib.crc32(repr(parts).encode()) & 0x7FFFFFFF
 
 
 class _Span:
@@ -460,10 +524,19 @@ def chrome_trace(
         }
         if e.ph == "X":
             rec["dur"] = e.dur_ns / 1e3
+        elif e.ph in ("s", "t", "f"):
+            # flow events: the id binds start/step/finish into one arrow;
+            # "bp": "e" binds the finish to its enclosing slice
+            rec["id"] = (e.attrs or {}).get("flow", 0)
+            if e.ph == "f":
+                rec["bp"] = "e"
         else:
             rec["s"] = "t"
-        if e.attrs:
-            rec["args"] = dict(e.attrs)
+        args = dict(e.attrs) if e.attrs else {}
+        if e.trace is not None:
+            args["trace"] = e.trace
+        if args:
+            rec["args"] = args
         out.append(rec)
     with _STATE_LOCK:
         names = {tid: _THREAD_NAMES.get(tid) for tid in tids}
@@ -492,18 +565,32 @@ def write_chrome_trace(
 
 
 def stage_totals(
-    events: Iterable[TimelineEvent], names: Iterable[str]
-) -> Dict[str, float]:
+    events: Iterable[TimelineEvent],
+    names: Iterable[str],
+    per_trace: bool = False,
+):
     """Sum complete-span durations (seconds) per stage name, restricted to
     ``names`` — the attribution primitive bench.py uses to check that named
     stages account for >= 90 % of a measured wall clock. The caller picks a
-    non-overlapping stage set; nested helper spans are simply not named."""
+    non-overlapping stage set; nested helper spans are simply not named.
+
+    ``per_trace=True`` keys the sums by the events' query trace ids
+    (ISSUE 9): ``{trace_id_or_"": {stage: seconds}}`` — a multi-query run
+    decomposes per query (events recorded outside any trace scope land
+    under ``""``)."""
     wanted = set(names)
-    out: Dict[str, float] = {n: 0.0 for n in wanted}
+    if not per_trace:
+        out: Dict[str, float] = {n: 0.0 for n in wanted}
+        for e in events:
+            if e.ph == "X" and e.name in wanted:
+                out[e.name] += e.dur_ns / 1e9
+        return out
+    by_trace: Dict[str, Dict[str, float]] = {}
     for e in events:
         if e.ph == "X" and e.name in wanted:
-            out[e.name] += e.dur_ns / 1e9
-    return out
+            tr = by_trace.setdefault(e.trace or "", {})
+            tr[e.name] = tr.get(e.name, 0.0) + e.dur_ns / 1e9
+    return by_trace
 
 
 _init_from_env()

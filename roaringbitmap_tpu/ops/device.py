@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..observe import compilewatch as _compilewatch
+
 DEVICE_WORDS = 2048  # uint32 words per container row
 HOST_WORDS = 1024  # uint64 words per container
 
@@ -73,26 +75,31 @@ def from_device_words(dev_words) -> np.ndarray:
 
 
 @jax.jit
+@_compilewatch.tracked("batched_or")
 def batched_or(a, b):
     return a | b
 
 
 @jax.jit
+@_compilewatch.tracked("batched_and")
 def batched_and(a, b):
     return a & b
 
 
 @jax.jit
+@_compilewatch.tracked("batched_xor")
 def batched_xor(a, b):
     return a ^ b
 
 
 @jax.jit
+@_compilewatch.tracked("batched_andnot")
 def batched_andnot(a, b):
     return a & ~b
 
 
 @jax.jit
+@_compilewatch.tracked("popcount_rows")
 def popcount_rows(words):
     """Per-row cardinality: fused population_count + row sum."""
     return jnp.sum(lax.population_count(words).astype(jnp.int32), axis=-1)
@@ -104,12 +111,14 @@ def popcount_rows(words):
 
 
 @functools.partial(jax.jit, static_argnames=("op",))
+@_compilewatch.tracked("wide_reduce")
 def wide_reduce(words, op: str = "or"):
     """Reduce [N, W] -> [W] with a bitwise op (the wide-OR/AND/XOR kernel)."""
     return lax.reduce(words, _INIT[op], _OPS[op], dimensions=(0,))
 
 
 @functools.partial(jax.jit, static_argnames=("op",))
+@_compilewatch.tracked("wide_reduce_with_cardinality")
 def wide_reduce_with_cardinality(words, op: str = "or"):
     """Fused reduce + popcount: returns (result [W], cardinality scalar).
 
@@ -123,6 +132,7 @@ def wide_reduce_with_cardinality(words, op: str = "or"):
 
 
 @functools.partial(jax.jit, static_argnames=("op", "stage_groups"))
+@_compilewatch.tracked("wide_reduce_two_stage")
 def wide_reduce_two_stage(words, op: str = "or", stage_groups: int = 128):
     """Two-stage wide reduce: view [N, W] as [G, N/G, W], grouped-reduce the
     inner axis, then fold the G partial rows.
@@ -146,6 +156,7 @@ def wide_reduce_two_stage(words, op: str = "or", stage_groups: int = 128):
 
 
 @functools.partial(jax.jit, static_argnames=("op",))
+@_compilewatch.tracked("grouped_reduce")
 def grouped_reduce(words3, op: str = "or"):
     """Reduce padded groups: [G, M, W] -> [G, W].
 
@@ -157,6 +168,7 @@ def grouped_reduce(words3, op: str = "or"):
 
 
 @functools.partial(jax.jit, static_argnames=("op",))
+@_compilewatch.tracked("grouped_reduce_with_cardinality")
 def grouped_reduce_with_cardinality(words3, op: str = "or"):
     red = lax.reduce(words3, _INIT[op], _OPS[op], dimensions=(1,))
     card = jnp.sum(lax.population_count(red).astype(jnp.int32), axis=-1)
@@ -164,6 +176,7 @@ def grouped_reduce_with_cardinality(words3, op: str = "or"):
 
 
 @functools.partial(jax.jit, static_argnames=("op",))
+@_compilewatch.tracked("segmented_reduce")
 def segmented_reduce(words, seg_start, op: str = "or"):
     """Segmented reduce over sorted segments without padding.
 
@@ -193,6 +206,7 @@ def segmented_reduce(words, seg_start, op: str = "or"):
 
 
 @jax.jit
+@_compilewatch.tracked("rank_rows")
 def rank_rows(words, positions):
     """Per-row rank: number of set bits at index <= position (int32 [N]).
 

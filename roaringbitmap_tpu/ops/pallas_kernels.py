@@ -35,6 +35,7 @@ from jax import lax
 
 from . import device as dev
 from .. import observe as _observe
+from ..observe import compilewatch as _compilewatch
 
 try:  # pallas is optional at import time (e.g. stripped CPU envs)
     from jax.experimental import pallas as pl
@@ -309,6 +310,7 @@ def _grid_compiler_params(plan, dimsem: bool):
 @functools.partial(
     jax.jit, static_argnames=("op", "interpret", "row_tile", "w_tile", "fold", "dimsem")
 )
+@_compilewatch.tracked("wide_reduce_pallas")
 def wide_reduce_pallas(
     words,
     op: str = "or",
@@ -361,6 +363,7 @@ def wide_reduce_pallas(
 @functools.partial(
     jax.jit, static_argnames=("op", "interpret", "row_tile", "w_tile", "fold", "dimsem")
 )
+@_compilewatch.tracked("wide_reduce_cardinality_pallas")
 def wide_reduce_cardinality_pallas(
     words,
     op: str = "or",
@@ -390,6 +393,7 @@ def wide_reduce_cardinality_pallas(
     jax.jit,
     static_argnames=("op", "interpret", "g_tile", "row_tile", "fold", "w_tile", "dimsem"),
 )
+@_compilewatch.tracked("grouped_reduce_pallas")
 def grouped_reduce_pallas(
     words3,
     op: str = "or",
@@ -444,6 +448,7 @@ def grouped_reduce_pallas(
     jax.jit,
     static_argnames=("op", "interpret", "g_tile", "row_tile", "fold", "w_tile", "dimsem"),
 )
+@_compilewatch.tracked("grouped_reduce_cardinality_pallas")
 def grouped_reduce_cardinality_pallas(
     words3,
     op: str = "or",
@@ -534,6 +539,7 @@ def _make_seg_kernel(op, fill, row_tile: int):
 
 
 @functools.partial(jax.jit, static_argnames=("op", "interpret", "row_tile"))
+@_compilewatch.tracked("segmented_reduce_pallas")
 def segmented_reduce_pallas(
     words, seg_start, op: str = "or", interpret: bool = False, row_tile: int = SEG_ROW_TILE
 ):
@@ -689,6 +695,7 @@ def _make_oneil_kernel(s_count: int, op_name: str, dual: bool):
 
 
 @functools.partial(jax.jit, static_argnames=("op", "interpret", "k_tile", "w_tile"))
+@_compilewatch.tracked("oneil_compare_pallas")
 def oneil_compare_pallas(
     slices_w,
     bits_rev,
@@ -918,6 +925,7 @@ def best_grouped_reduce(words3, op: str = "or"):
 
 
 @functools.partial(jax.jit, static_argnames=("g", "m", "op", "fill"))
+@_compilewatch.tracked("fused_gather_reduce")
 def _fused_gather_reduce_jit(flat, src_map, g, m, op, fill):
     # identity row appended so out-of-range pad slots (index n) read the op
     # identity — jit-safe stand-in for take(mode="fill"), whose fill_value
@@ -997,6 +1005,7 @@ def _parity_u32(x):
 
 
 @functools.partial(jax.jit, static_argnums=(0,))
+@_compilewatch.tracked("expand_rows_device")
 def _expand_rows_jit(n_rows, bmp_rows, bmp_words, val_idx, val_bits,
                      run_rows, tog_s_idx, tog_s_bits, tog_e_idx, tog_e_bits):
     out = jnp.zeros((n_rows * dev.DEVICE_WORDS,), jnp.uint32)
@@ -1053,6 +1062,7 @@ def expand_rows_device(n_rows, bmp_rows, bmp_words_u32, val_idx, val_bits,
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
+@_compilewatch.tracked("scatter_rows_donated")
 def _scatter_rows_jit(dst, rows, new_rows):
     return dst.at[rows].set(new_rows, mode="drop")
 

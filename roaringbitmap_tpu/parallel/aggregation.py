@@ -42,6 +42,8 @@ from ..models.container import (
     best_container_of_words,
 )
 from ..models.roaring import RoaringBitmap
+from ..observe import context as _context
+from ..observe import decisions as _decisions
 from ..observe import timeline as _timeline
 from ..robust import errors as _rerrors
 from ..robust import ladder as _ladder
@@ -302,20 +304,29 @@ def _aggregate(
     happens when a tier fails — classify, record tier health, ride the
     next tier down, emit ``rb_tpu_degrade_total`` — one code path for
     every degradation instead of per-site try/except scatter. Every tier
-    computes the same bits (the fuzz oracle family pins this)."""
+    computes the same bits (the fuzz oracle family pins this).
+
+    Top-level trace entry (ISSUE 9): opens a query trace scope (reusing
+    the ambient one when called from the query executor) and records the
+    start-tier decision with the cost-model inputs that drove it."""
     bitmaps = [b for b in bitmaps]
     if not bitmaps:
         return RoaringBitmap()
     if len(bitmaps) == 1:
         return bitmaps[0].clone()
-    keys, n = _dispatch_prelude(bitmaps, op)
-    if keys is not None and not keys:
-        return RoaringBitmap()
-    tiers = []
-    if _use_device(n, mode):
-        tiers.append(("device", lambda: _device_aggregate(bitmaps, keys, op)))
-    tiers.extend(_cpu_tiers(bitmaps, keys, n, op, pool=pool))
-    return _ladder.LADDER.run("agg", tiers)
+    with _context.trace_scope():
+        keys, n = _dispatch_prelude(bitmaps, op)
+        if keys is not None and not keys:
+            return RoaringBitmap()
+        tiers = []
+        if _use_device(n, mode):
+            tiers.append(("device", lambda: _device_aggregate(bitmaps, keys, op)))
+        tiers.extend(_cpu_tiers(bitmaps, keys, n, op, pool=pool))
+        _decisions.record_decision(
+            "agg.dispatch", tiers[0][0], op=op, rows=n,
+            operands=len(bitmaps), mode=mode or config.mode,
+        )
+        return _ladder.LADDER.run("agg", tiers)
 
 
 # ---------------------------------------------------------------------------
@@ -562,28 +573,35 @@ def _aggregate_cardinality(bitmaps: List[RoaringBitmap], op: str, mode) -> int:
         return 0
     if len(bitmaps) == 1:
         return bitmaps[0].get_cardinality()
-    keys, n = _dispatch_prelude(bitmaps, op)
-    if keys is not None and not keys:
-        return 0
-    tiers = []
-    if _use_device(n, mode):
+    with _context.trace_scope():
+        keys, n = _dispatch_prelude(bitmaps, op)
+        if keys is not None and not keys:
+            return 0
+        tiers = []
+        if _use_device(n, mode):
 
-        def _device_tier() -> int:
-            packed = store.packed_for(bitmaps, keys)  # resident-cache routed
-            if config.mesh is not None:  # same ICI-sharded reduce as _device_aggregate
-                _none, cards = _sharded_reduce(packed, op, cards_only=True)
-            else:
-                cards = store.reduce_packed_cardinality(packed, op=op)
-            return int(cards.sum())
+            def _device_tier() -> int:
+                packed = store.packed_for(bitmaps, keys)  # resident-cache routed
+                if config.mesh is not None:  # same ICI-sharded reduce as _device_aggregate
+                    _none, cards = _sharded_reduce(packed, op, cards_only=True)
+                else:
+                    cards = store.reduce_packed_cardinality(packed, op=op)
+                return int(cards.sum())
 
-        tiers.append(("device", _device_tier))
-    # the SAME cpu rungs as _aggregate (so degrade/breaker series name the
-    # tier that actually absorbs the traffic), counted instead of kept
-    tiers.extend(
-        (name, (lambda fn=fn: fn().get_cardinality()))
-        for name, fn in _cpu_tiers(bitmaps, keys, n, op)
-    )
-    return _ladder.LADDER.run("agg", tiers)
+            tiers.append(("device", _device_tier))
+        # the SAME cpu rungs as _aggregate (so degrade/breaker series name
+        # the tier that actually absorbs the traffic), counted instead of
+        # kept
+        tiers.extend(
+            (name, (lambda fn=fn: fn().get_cardinality()))
+            for name, fn in _cpu_tiers(bitmaps, keys, n, op)
+        )
+        _decisions.record_decision(
+            "agg.dispatch", tiers[0][0], op=op, rows=n,
+            operands=len(bitmaps), mode=mode or config.mode,
+            cardinality_only=True,
+        )
+        return _ladder.LADDER.run("agg", tiers)
 
 
 class ParallelAggregation:

@@ -52,6 +52,7 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from .. import observe as _observe
+from ..observe import context as _context
 from ..observe import timeline as _timeline
 from ..robust import errors as _rerrors
 from ..robust import ladder as _ladder
@@ -66,12 +67,18 @@ _OVERLAP_RATIO = _observe.gauge(
 
 
 class _Staging:
-    __slots__ = ("future", "t_submit", "duration_s")
+    __slots__ = ("future", "t_submit", "duration_s", "trace", "flow")
 
     def __init__(self, future: Future):
         self.future = future
         self.t_submit = time.monotonic()
         self.duration_s = 0.0  # staged marshal wall, set by the lane thread
+        # explicit trace handoff (ISSUE 9): contextvars do not cross the
+        # lane-thread boundary, so the submitter's query trace id rides the
+        # staging and the lane adopts it — every recorder event the staged
+        # pack emits carries the originating query's id
+        self.trace = None
+        self.flow = 0
 
 
 class ShipLane:
@@ -119,8 +126,13 @@ class ShipLane:
     def _executor(self) -> ThreadPoolExecutor:
         with self._lock:
             if self._pool is None:
+                # eager thread-name registration (ISSUE 9 satellite): a
+                # lane thread that only ever emits instants must still be
+                # named in the Perfetto export, so register at thread
+                # start, not lazily at first record
                 self._pool = ThreadPoolExecutor(
-                    max_workers=1, thread_name_prefix="rb-ship-lane"
+                    max_workers=1, thread_name_prefix="rb-ship-lane",
+                    initializer=_timeline.register_thread,
                 )
             return self._pool
 
@@ -130,12 +142,19 @@ class ShipLane:
 
     def _stage(self, bitmaps: List, keys_filter: Optional[set], st: _Staging):
         """Runs on the lane thread: the REAL pack + device expansion (all
-        fault sites live), fenced so the staging duration is truthful."""
+        fault sites live), fenced so the staging duration is truthful.
+        Adopts the submitting query's trace id (explicit handoff — see
+        ``_Staging.trace``) so every recorder event underneath carries
+        it, and marks the flow step linking the prefetch to this span."""
         t0 = time.monotonic()
         try:
-            with _timeline.tspan("overlap.stage", "overlap", n=len(bitmaps)):
-                packed = store.packed_for(bitmaps, keys_filter)
-                _timeline.fence(packed.device_words)
+            with _context.adopt(st.trace):
+                _timeline.flow_point("overlap.handoff", "t", st.flow)
+                with _timeline.tspan(
+                    "overlap.stage", "overlap", n=len(bitmaps)
+                ):
+                    packed = store.packed_for(bitmaps, keys_filter)
+                    _timeline.fence(packed.device_words)
             return packed
         finally:
             st.duration_s = time.monotonic() - t0
@@ -193,7 +212,13 @@ class ShipLane:
                 _timeline.instant("overlap.window_full", "overlap")
                 return None
             st = _Staging(Future())
+            st.trace = _context.current_trace()
+            st.flow = _timeline.flow_id(st.trace, key)
             self._pending[key] = st
+        # flow start at the submitter: Perfetto draws the handoff arrow
+        # from here to the lane's staging span and on to the consumer's
+        # overlap_wait (no-op while recording is off)
+        _timeline.flow_point("overlap.handoff", "s", st.flow)
         # submit OUTSIDE the lock: executor init + enqueue take their own
         # locks, and the job itself takes the pack-cache lock
         def _run():
@@ -244,6 +269,7 @@ class ShipLane:
                 cat="pack",
             ):
                 packed = st.future.result()
+                _timeline.flow_point("overlap.handoff", "f", st.flow)
         except Exception as e:
             if _rerrors.classify(e) == _rerrors.FATAL:
                 raise
@@ -292,17 +318,26 @@ def run_pipelined(
     never idles the device on the host marshal (ISSUE 8 leg 3).
 
     Equivalent to ``[FastAggregation.<op>(*bitmaps, mode=mode), ...]`` —
-    same engines, same ladder, same bits; only the staging overlaps."""
+    same engines, same ladder, same bits; only the staging overlaps.
+
+    Trace attribution (ISSUE 9): every job gets its own pre-assigned
+    trace id, and job i+1's *prefetch* runs under job i+1's id even
+    though job i's loop iteration drives it — the staged lane work is the
+    consumer query's marshal, so that is the query it must attribute to."""
     from . import aggregation
 
     jobs = [(list(bms), op) for bms, op in jobs]
+    tids = [_context.new_trace_id() for _ in jobs]
     out = []
     for i, (bms, op) in enumerate(jobs):
-        # join our own staging (overlap_wait) by op marker — the dispatch
-        # prelude (AND key intersection) is left to _aggregate, which pays
-        # it exactly once per job
-        LANE.join(bms, op)
+        with _context.trace_scope(tids[i]):
+            # join our own staging (overlap_wait) by op marker — the
+            # dispatch prelude (AND key intersection) is left to
+            # _aggregate, which pays it exactly once per job
+            LANE.join(bms, op)
         if i + 1 < len(jobs):
-            aggregation.prefetch(jobs[i + 1][0], jobs[i + 1][1], mode=mode)
-        out.append(aggregation._aggregate(bms, op, mode))
+            with _context.trace_scope(tids[i + 1]):
+                aggregation.prefetch(jobs[i + 1][0], jobs[i + 1][1], mode=mode)
+        with _context.trace_scope(tids[i]):
+            out.append(aggregation._aggregate(bms, op, mode))
     return out

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import os
 import threading
+import weakref
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -39,6 +40,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import observe as _observe
+from ..observe import decisions as _decisions
 from ..observe import timeline as _timeline
 from ..robust import errors as _rerrors
 from ..robust import faults as _faults
@@ -116,6 +118,19 @@ _PACK_RESIDENT = _observe.gauge(
     _observe.PACK_CACHE_RESIDENT_BYTES,
     "Bytes currently resident in the pack cache by entry kind",
     ("kind",),
+)
+# device-memory reconciliation (ISSUE 9): accounting drift between what
+# the gauges claim and independent ground truth — "ledger" checks the
+# resident gauge against the cache's own entry-byte ledger (an internal
+# invariant; nonzero = an accounting bug like the donation-consumed
+# buffer leak this PR fixes), "device" checks it against the jax
+# backend's bytes_in_use (framework-external residency; meaningful on
+# accelerators, absent on backends without memory_stats)
+_HBM_DRIFT = _observe.gauge(
+    _observe.HBM_ACCOUNTING_DRIFT_BYTES,
+    "Device-memory accounting drift: pack-cache resident gauge minus the "
+    "named reconciliation source",
+    ("source",),
 )
 
 from ..models.container import (
@@ -674,6 +689,23 @@ class PackedGroups:
         self._padded_cache = None
         self._bucket_cache = None
 
+    def _drop_flat(self) -> None:
+        """Drop the flat device rows AND settle their resident accounting
+        (gauge + cache byte ledger) in the same step. The delta path's
+        donation-failure branches used to null ``_device_words`` bare,
+        leaving ``flat_rows`` bytes on the gauge with no backing array —
+        the next ``device_words`` rebuild then re-accounted the same rows
+        and the gauge drifted one block high per failed delta (ISSUE 9
+        satellite; the ``hbm_reconciliation`` ledger check now watches
+        for exactly this class of leak)."""
+        self._device_words = None
+        held = self._resident_held
+        if held:
+            nbytes = held.pop("flat_rows", None)
+            if nbytes:
+                _RESIDENT_BYTES.dec(nbytes, ("flat_rows",))
+                self._notify_resident(-int(nbytes))
+
     def apply_delta(self, rows: np.ndarray, new_words_u32: np.ndarray) -> None:
         """Incremental repack: replace ``rows`` of the flat layout with
         freshly expanded container words. The host view updates in place
@@ -735,15 +767,18 @@ class PackedGroups:
                 except Exception as e:
                     if d.is_deleted():
                         # the failed scatter consumed the buffer: never
-                        # leave a poisoned array published
-                        self._device_words = None
+                        # leave a poisoned array published (accounting
+                        # settled too — see _drop_flat)
+                        self._drop_flat()
                     if _rerrors.classify(e) == _rerrors.FATAL:
                         raise
                     # the host view is already updated; dropping the device
                     # rows degrades the next consumer to a re-ship instead
-                    # of serving a stale resident tensor
+                    # of serving a stale resident tensor — with the
+                    # flat_rows bytes released alongside, so the resident
+                    # gauge never carries a donation-consumed buffer
                     _ladder.LADDER.note_degrade("store.ship", "device", "re-ship", e)
-                    self._device_words = None
+                    self._drop_flat()
                 else:
                     self._device_words = shipped
                     self._buffer_gen += 1
@@ -1302,6 +1337,7 @@ def prepare_reduce_bucketed(packed: PackedGroups, op: str = "or", n_buckets: int
     # and cannot sit under this outer jit — and XLA is the measured flagship
     # winner anyway (BENCH_NOTES flagship post-mortem)
     @jax.jit
+    @_observe.compilewatch.tracked("store.reduce_all_bucketed")
     def reduce_all(arrs):
         reds, cards = [], []
         # rb-ok: trace-safety -- arrs is a tuple-of-arrays pytree: the loop
@@ -1489,6 +1525,8 @@ class PackCache:
         # RLock: the delta path drops derived layouts under the lock, and
         # their residency callbacks re-enter to settle the byte accounting
         self._lock = threading.RLock()
+        with _ALL_CACHES_LOCK:  # WeakSet add vs reconcile iteration race
+            _ALL_CACHES.add(self)
         self.max_bytes = int(max_bytes)  # guarded-by: self._lock
         self._entries: "OrderedDict[tuple, _PackEntry]" = OrderedDict()  # guarded-by: self._lock
         self._ident: Dict[tuple, tuple] = {}  # guarded-by: self._lock
@@ -1669,6 +1707,18 @@ class PackCache:
             self._ident.clear()
             self._bytes = 0
 
+    def __del__(self):
+        # a dropped secondary cache (tests, fuzz campaigns) must settle
+        # the process-wide resident gauge: its entries' PackedGroups are
+        # _cache_held, so their own __del__ is a deliberate no-op and only
+        # this release path returns the bytes (hbm_reconciliation's
+        # ledger check counts LIVE caches — an unsettled dead one would
+        # read as permanent drift)
+        try:
+            self.close()
+        except Exception:  # pragma: no cover - interpreter teardown  # rb-ok: exception-hygiene -- __del__ during teardown: modules may already be torn down; raising here aborts GC
+            pass
+
     def configure(self, max_bytes: int) -> None:
         """Set the byte budget and evict down to it. ``max_bytes <= 0``
         disables caching AND releases every resident entry (pinned
@@ -1723,6 +1773,11 @@ class PackCache:
                 "pack_cache.pressure", "cache", kind=entry.kind,
                 bytes=entry.nbytes,
             )
+            _decisions.record_decision(
+                "pack_cache.admit", "spill-and-serve-uncached",
+                kind=entry.kind, bytes=entry.nbytes,
+                target_bytes=self.max_bytes // 2,
+            )
             return entry  # consumer-owned: never marked cache-held
         with self._lock:
             existing = self._entries.get(entry.key)
@@ -1743,6 +1798,10 @@ class PackCache:
             self._entries[entry.key] = entry
             self._bytes += entry.nbytes
             _PACK_RESIDENT.inc(entry.nbytes, (entry.kind,))
+            _decisions.record_decision(
+                "pack_cache.admit", "resident", kind=entry.kind,
+                bytes=entry.nbytes, cache_bytes=self._bytes,
+            )
             self._evict_over_budget()
             return entry
 
@@ -1824,6 +1883,10 @@ class PackCache:
             _timeline.instant(
                 "pack_cache.evict", "cache", kind=e.kind, bytes=e.nbytes
             )
+            _decisions.record_decision(
+                "pack_cache.evict", "lru", kind=e.kind, bytes=e.nbytes,
+                target_bytes=target,
+            )
             ident = ("agg", e.key[1], tuple(_fp_ident(fp) for fp in e.fps)) \
                 if e.kind == "agg" else None
             if ident is not None and self._ident.get(ident) == key:
@@ -1903,6 +1966,15 @@ class PackCache:
         return rows
 
 
+# Every live cache instance, for gauge reconciliation: the resident-bytes
+# gauge is process-global while entry ledgers are per-cache, so the ledger
+# drift check must sum over ALL live caches (tests and fuzz campaigns run
+# secondary instances; a dead one settles its share via __del__ -> close).
+# The lock covers add-vs-iterate: WeakSet iteration defers removals but a
+# concurrent add raises "set changed size during iteration".
+_ALL_CACHES_LOCK = threading.Lock()
+_ALL_CACHES: "weakref.WeakSet[PackCache]" = weakref.WeakSet()  # guarded-by: _ALL_CACHES_LOCK
+
 # The process-wide cache every routed consumer shares (aggregation engines,
 # BSI device packs, query kernels) — ONE eviction budget for all of them.
 # RB_TPU_PACK_CACHE_BYTES overrides the 2 GiB default; 0 disables caching.
@@ -1916,3 +1988,88 @@ def packed_for(
     on device paths: warm working sets come back resident (zero host work),
     mutated ones delta-repack O(changed rows)."""
     return PACK_CACHE.get_packed(bitmaps, keys_filter)
+
+
+def hbm_reconciliation() -> dict:
+    """Reconcile the pack cache's resident-bytes accounting against
+    independent ground truth (ISSUE 9 tentpole, leg 3c) and export the
+    drift as ``rb_tpu_hbm_accounting_drift_bytes{source}``:
+
+    * ``ledger`` — the ``rb_tpu_pack_cache_resident_bytes`` gauge total
+      vs the cache's internal entry-byte ledger. These are maintained by
+      the same locked code paths, so nonzero drift means an accounting
+      bug (the donation-consumed-buffer leak this PR fixes was exactly
+      such a bug — one block of phantom bytes per failed delta scatter);
+    * ``device`` — the gauge total vs the jax backend's reported
+      ``bytes_in_use``. The device holds more than the pack cache (jit
+      executables, scratch, other consumers), so this drift is expected
+      to be *negative or zero-crossing noise is a red flag the other
+      way*: the gauge claiming MORE than the device holds (positive
+      drift) means the cache is accounting for freed arrays. Absent on
+      backends without ``memory_stats`` (the CPU client).
+
+    Returns the reconciliation report; ``scripts/rb_top.py`` renders it
+    and bench.py snapshots it into the metrics sidecar.
+
+    The ledger side sums over every LIVE cache instance (the gauge is
+    process-global; tests/fuzz run secondary caches, and a dropped cache
+    settles its share via ``__del__`` -> ``close``)."""
+
+    def _sides():
+        lb = en = es = 0
+        with _ALL_CACHES_LOCK:
+            caches = list(_ALL_CACHES)
+        for cache in caches:
+            with cache._lock:
+                lb += cache._bytes
+                en += len(cache._entries)
+                es += sum(e.nbytes for e in cache._entries.values())
+        return lb, en, es, sum(_PACK_RESIDENT.series().values())
+
+    def _stable_sides():
+        # the ledger scan and the gauge read are not one atomic snapshot:
+        # an admit/evict completing between them shows as phantom drift on
+        # a path whose contract is "nonzero = accounting bug". Two
+        # CONSECUTIVE equal reads mean no mutation straddled the pair —
+        # retry briefly until stable (a diagnostics read polled under
+        # churn keeps the last pair rather than spinning forever).
+        prev = _sides()
+        for _ in range(4):
+            cur = _sides()
+            if cur == prev:
+                return cur
+            prev = cur
+        return prev
+
+    ledger_bytes, entries, entry_sum, gauge_bytes = _stable_sides()
+    if gauge_bytes != ledger_bytes:
+        # apparent drift may be a dropped secondary cache whose __del__
+        # has not run (reference cycles): collect and re-read before
+        # reporting. The collect is deliberately NOT unconditional — this
+        # sits on polled monitoring read paths (rb_top, observatory), and
+        # a full cyclic-GC pass per clean snapshot would be pure tax.
+        import gc
+
+        gc.collect()
+        ledger_bytes, entries, entry_sum, gauge_bytes = _stable_sides()
+    ledger_drift = int(gauge_bytes - ledger_bytes)
+    _HBM_DRIFT.set(ledger_drift, ("ledger",))
+    report = {
+        "gauge_bytes": int(gauge_bytes),
+        "ledger_bytes": int(ledger_bytes),
+        "entry_sum_bytes": int(entry_sum),
+        "entries": entries,
+        "ledger_drift_bytes": ledger_drift,
+    }
+    try:
+        stats = jax.devices()[0].memory_stats()
+    except (RuntimeError, AttributeError, IndexError):  # no usable backend
+        stats = None
+    if stats and "bytes_in_use" in stats:
+        in_use = int(stats["bytes_in_use"])
+        device_drift = int(gauge_bytes - in_use)
+        _HBM_DRIFT.set(device_drift, ("device",))
+        report.update(
+            device_bytes_in_use=in_use, device_drift_bytes=device_drift
+        )
+    return report

@@ -32,7 +32,7 @@ Quick start::
 """
 
 from .cache import DEFAULT_CACHE, ResultCache, cache_key
-from .exec import execute
+from .exec import execute, execute_pipelined
 from .expr import Expr, Leaf, Q, as_expr, evaluate_naive
 from .kernels import andnot_nway, andnot_nway_cardinality, threshold
 from .plan import Plan, PlanStep, plan, rewrite
@@ -48,6 +48,7 @@ __all__ = [
     "Plan",
     "PlanStep",
     "execute",
+    "execute_pipelined",
     "ResultCache",
     "DEFAULT_CACHE",
     "cache_key",

@@ -41,6 +41,7 @@ from collections import OrderedDict
 from typing import Dict, List, Optional, Union
 
 from .. import observe as _observe
+from ..observe import context as _context
 from ..observe import timeline as _timeline
 from ..robust import faults as _faults
 from ..robust import ladder as _ladder
@@ -108,6 +109,14 @@ def execute(
     queueing more device work onto a query that already blew its budget.
     ``rb_tpu_deadline_total{site="query.exec",outcome}`` counts the
     outcomes (met | degraded)."""
+    # top-level trace entry (ISSUE 9): the whole plan+execute runs under
+    # one query trace id (reused when a pipelined driver pre-assigned it),
+    # so every step span, engine span, and cache instant attributes here
+    with _context.trace_scope():
+        return _execute_traced(query, cache, mode, deadline_s)
+
+
+def _execute_traced(query, cache, mode, deadline_s) -> RoaringBitmap:
     from .. import tracing
 
     p = query if isinstance(query, Plan) else _memo_plan(query, mode)
@@ -162,18 +171,28 @@ def execute_pipelined(
     working sets stage host→HBM on the lane thread, so steady-state
     multi-query traffic never idles the device on the marshal. Results are
     identical to ``[execute(q, ...) for q in queries]`` — staging only
-    warms the resident pack cache the engines read anyway."""
+    warms the resident pack cache the engines read anyway.
+
+    Every query gets its own pre-assigned trace id (ISSUE 9); query
+    i+1's prefetch runs under query i+1's id even though query i's loop
+    iteration drives it — the staged marshal belongs to its consumer."""
     plans = [q if isinstance(q, Plan) else _memo_plan(q, mode) for q in queries]
+    tids = [_context.new_trace_id() for _ in plans]
     out = []
     for i, p in enumerate(plans):
         # join our own stagings FIRST (prefetched while query i-1 ran):
         # popping them frees the lane window for the next prefetch and
         # accounts the overlap_wait stage; the staged packs are resident
         # in PACK_CACHE, so the engines' lookups below hit warm
-        _join_plan(p)
+        with _context.trace_scope(tids[i]):
+            _join_plan(p)
         if i + 1 < len(plans):
-            _prefetch_plan(plans[i + 1], mode)
-        out.append(execute(p, cache=cache, mode=mode, deadline_s=deadline_s))
+            with _context.trace_scope(tids[i + 1]):
+                _prefetch_plan(plans[i + 1], mode)
+        with _context.trace_scope(tids[i]):
+            out.append(
+                execute(p, cache=cache, mode=mode, deadline_s=deadline_s)
+            )
     return out
 
 

@@ -53,6 +53,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Tuple
 
 from .. import observe as _observe
+from ..observe import decisions as _decisions
 from .expr import Expr, Leaf, Q
 
 _MAX32 = 1 << 32
@@ -375,6 +376,14 @@ def plan(expr: Expr, mode: Optional[str] = None) -> Plan:
             engine = _choose_engine(node, rows, mode)
             _PLAN_TOTAL.inc(1, (engine,))
             labels[node.uid] = f"s{len(steps)}"
+            # decision provenance (ISSUE 9): the per-node engine choice
+            # with the cost-model inputs that drove it — "why did this
+            # node ride the device" is answerable from insights.decisions()
+            _decisions.record_decision(
+                "query.plan", engine, op=node.op,
+                est_card=int(card), est_rows=int(rows),
+                operands=len(node.children), mode=mode,
+            )
             steps.append(PlanStep(len(steps), node, engine, operands, card, rows))
         leaf_cards = {l.uid: _leaf_card(l, cards) for l in root.leaves}
         return Plan(root, steps, labels, leaf_cards)

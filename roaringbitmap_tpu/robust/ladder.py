@@ -48,6 +48,7 @@ import zlib
 from typing import Callable, List, Optional, Sequence, Tuple
 
 from .. import observe as _observe
+from ..observe import decisions as _decisions
 from ..observe import timeline as _timeline
 from .errors import FATAL, TRANSIENT, classify
 
@@ -172,6 +173,16 @@ class Ladder:
             b = self._breakers.get((site, tier))
             return b.state if b is not None else CLOSED
 
+    def states(self) -> dict:
+        """Point-in-time ``{"site/tier": state}`` over every breaker that
+        has seen traffic — the resource observatory's breaker panel
+        (scripts/rb_top.py)."""
+        with self._lock:
+            return {
+                f"{site}/{tier}": b.state
+                for (site, tier), b in sorted(self._breakers.items())
+            }
+
     # -- recording helpers (metrics OUTSIDE the health lock) ---------------
 
     def _transition(self, site: str, tier: str, state: Optional[str]) -> None:
@@ -179,6 +190,9 @@ class Ladder:
             _BREAKER_TOTAL.inc(1, (site, tier, state))
             _timeline.instant(
                 "ladder.breaker", "robust", site=site, tier=tier, state=state
+            )
+            _decisions.record_decision(
+                "ladder.breaker", state, site=site, tier=tier
             )
 
     def note_degrade(self, site: str, frm: str, to: str,
@@ -190,6 +204,10 @@ class Ladder:
         _timeline.instant(
             "ladder.degrade", "robust", site=site,
             frm=frm, to=to, error=type(exc).__name__ if exc else None,
+        )
+        _decisions.record_decision(
+            "ladder.degrade", f"{frm}->{to}", site=site,
+            error=type(exc).__name__ if exc else None,
         )
 
     def record_failure(self, site: str, tier: str) -> None:

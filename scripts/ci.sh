@@ -345,6 +345,98 @@ stages = sorted(lat["rb_tpu_store_pack_stage_seconds"])
 print("latency rows ok (%d pack stages %s; delta stages %s)"
       % (len(stages), stages, sorted(lat["rb_tpu_store_delta_stage_seconds"])))'
 
+step "resource observatory blocks in the sidecar (lock-wait/compile/drift, ISSUE 9)"
+# the sidecar must carry the observatory's new blocks: lock-wait rows for
+# the framework locks (bench installs the timed wrappers), per-fn compile
+# counts, the device-memory drift gauges (ledger drift must be exactly 0
+# — nonzero means the resident gauge and the cache ledger disagree), and
+# decision-log volume per site
+python -c '
+import json
+m = json.load(open("/tmp/ci_bench_metrics.json"))
+for key in ("lock_wait", "compile", "hbm_drift", "decisions"):
+    if key not in m:
+        raise SystemExit("metrics sidecar lacks the %s block" % key)
+if not m["lock_wait"]:
+    raise SystemExit("no lock-wait rows: lockstats did not run in bench")
+if "observe.registry" not in m["lock_wait"]:
+    raise SystemExit("lock-wait rows lack the registry lock: %s" % sorted(m["lock_wait"]))
+if not m["compile"] or not all(v > 0 for v in m["compile"].values()):
+    raise SystemExit("compile block empty/non-positive: %r" % m["compile"])
+if m["hbm_drift"].get("ledger") != 0:
+    raise SystemExit("pack-cache accounting drift: %r" % m["hbm_drift"])
+need_dec = {"agg.dispatch", "pack_cache.admit", "columnar.cutoff"}
+missing = need_dec - set(m["decisions"])
+if missing:
+    raise SystemExit("decision log missing sites %s (has %s)"
+                     % (sorted(missing), sorted(m["decisions"])))
+lat = m.get("latency", {})
+lw = lat.get("rb_tpu_lock_wait_seconds")
+if not lw or not all({"p50", "p99"} <= set(v) for v in lw.values()):
+    raise SystemExit("lock-wait latency quantiles missing: %r" % lw)
+print("observatory blocks ok (locks %s; compiles %s; ledger drift 0; decisions %s)"
+      % (sorted(m["lock_wait"]), sum(m["compile"].values()),
+         sum(m["decisions"].values())))'
+
+step "query-scoped tracing + off-mode twin rows (ISSUE 9 acceptance)"
+# 100% of lane-emitted events must carry the originating query trace id
+# (explicit handoff across the lane thread), per-trace stage attribution
+# must cover every query, the observability off-mode overhead twin must
+# stay under 1% (with the bench's 5 ms absolute noise slack), and the
+# north-star reduce must reach steady state with zero retraces
+python -c '
+import json
+m = json.load(open("/tmp/ci_bench.json"))["meta"]
+tr = m.get("tracing")
+if not isinstance(tr, dict):
+    raise SystemExit("bench meta lacks the tracing block")
+if tr["lane_traced_pct"] != 100.0:
+    raise SystemExit("lane trace attribution only %s%%" % tr["lane_traced_pct"])
+if tr["traces_attributed"] < tr["queries"]:
+    raise SystemExit("per-trace attribution covers %s of %s queries"
+                     % (tr["traces_attributed"], tr["queries"]))
+if not tr["per_trace_stage_s"]:
+    raise SystemExit("tracing block carries no per-trace stage sums")
+obs = m.get("observability")
+if not isinstance(obs, dict):
+    raise SystemExit("bench meta lacks the observability twin rows")
+if not (obs["off_overhead_pct"] < 1.0 or obs["off_delta_s"] < 0.005):
+    raise SystemExit("observability off-mode overhead %s%% (%ss) over the 1%% budget"
+                     % (obs["off_overhead_pct"], obs["off_delta_s"]))
+comp = m.get("compile", {})
+if comp.get("steady_state_retraces") != 0:
+    raise SystemExit("north-star reduce retraced in steady state: %r" % comp)
+print("tracing ok (lane %s events 100%% attributed over %s queries; off-mode %s%%; 0 retraces)"
+      % (tr["lane_events"], tr["queries"], obs["off_overhead_pct"]))'
+
+step "rb_top observatory report (schema rb_tpu_top/1, ISSUE 9)"
+# the snapshot CLI must produce a schema-valid JSON report with every
+# panel populated from its in-process demo workload
+JAX_PLATFORMS=cpu python scripts/rb_top.py --demo --json > /tmp/ci_rb_top.json
+python -c '
+import json
+r = json.load(open("/tmp/ci_rb_top.json"))
+if r.get("schema") != "rb_tpu_top/1":
+    raise SystemExit("rb_top: bad schema %r" % r.get("schema"))
+need = {"schema", "generated_utc", "source", "counters", "latency",
+        "locks", "breakers", "cache", "decisions_tail"}
+missing = need - set(r)
+if missing:
+    raise SystemExit("rb_top report lacks %s" % sorted(missing))
+if not r["locks"]:
+    raise SystemExit("rb_top demo recorded no lock waits")
+if not r["counters"]["compile"]:
+    raise SystemExit("rb_top demo recorded no compiles")
+if r["cache"]["hbm"].get("ledger_drift_bytes") != 0:
+    raise SystemExit("rb_top demo shows accounting drift: %r" % r["cache"]["hbm"])
+if not r["decisions_tail"]:
+    raise SystemExit("rb_top demo decision log is empty")
+sites = {d["site"] for d in r["decisions_tail"]}
+print("rb_top ok (locks %s; %d decisions over sites %s)"
+      % (sorted(r["locks"]), len(r["decisions_tail"]), sorted(sites)))'
+# the sidecar-sourced rendering must parse the bench artifact too
+python scripts/rb_top.py --from /tmp/ci_bench_metrics.json --json > /dev/null
+
 step "bench trend gate (>15% vs best comparable prior round)"
 python scripts/bench_trend.py --check
 

@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""rb_top — one-shot resource observatory report (ISSUE 9).
+
+Renders the framework's observability surface as a single console or
+JSON report: registry counters (kernel dispatch, layouts, pack cache,
+degradations, compiles), latency histograms with p50/p99, lock-wait
+quantiles over the framework locks, circuit-breaker states, pack-cache
+residency + device-memory accounting drift, and the decision-log tail —
+"where did time, memory, and traffic go" in one artifact.
+
+Three sources::
+
+    python scripts/rb_top.py --demo            # run a small in-process
+                                               # workload, report live state
+    python scripts/rb_top.py --from BENCH_METRICS.json
+                                               # render a bench sidecar
+    python scripts/rb_top.py                   # live state of THIS process
+                                               # (useful when imported:
+                                               #  rb_top.report())
+
+``--json`` emits the machine-readable report (schema ``rb_tpu_top/1``;
+scripts/ci.sh validates it). Breaker states and the decision log are
+process-local, so a sidecar-sourced report carries the sidecar's counter
+view of them (transition counts) rather than live states.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+SCHEMA = "rb_tpu_top/1"
+
+
+def _live_report(tail: int) -> dict:
+    from roaringbitmap_tpu import insights, observe
+    from roaringbitmap_tpu.observe import export as obs_export
+
+    side = obs_export.sidecar_snapshot()
+    obs = insights.observatory()
+    return {
+        "schema": SCHEMA,
+        "generated_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "source": "live",
+        "counters": {
+            "kernel": side["kernel"],
+            "layout": side["layout"],
+            "pack_cache": insights.pack_cache_counters(),
+            "robust": insights.robust_counters(),
+            "compile": side["compile"],
+            "decisions": side["decisions"],
+        },
+        "latency": side["latency"],
+        "locks": obs["locks"],
+        "lock_timing": obs["lock_timing"],
+        "breakers": obs["breakers"],
+        "cache": {"stats": obs["pack_cache"], "hbm": obs["hbm"]},
+        "decisions_tail": insights.decisions(tail),
+    }
+
+
+def _sidecar_report(path: str, tail: int) -> dict:
+    with open(path) as f:
+        side = json.load(f)
+    reg = side.get("registry", {})
+
+    def counter_map(name):
+        out = {}
+        for s in reg.get(name, {}).get("samples", []):
+            out["/".join(s["labels"].values())] = s.get("value")
+        return out
+
+    return {
+        "schema": SCHEMA,
+        "generated_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "source": "sidecar:" + path,
+        "counters": {
+            "kernel": side.get("kernel", {}),
+            "layout": side.get("layout", {}),
+            "pack_cache": {
+                "hits": counter_map("rb_tpu_pack_cache_hits_total"),
+                "misses": counter_map("rb_tpu_pack_cache_misses_total"),
+                "resident_bytes": counter_map("rb_tpu_pack_cache_resident_bytes"),
+            },
+            "robust": {
+                "degrade": counter_map("rb_tpu_degrade_total"),
+                "breaker": counter_map("rb_tpu_breaker_transitions_total"),
+            },
+            "compile": side.get("compile", {}),
+            "decisions": side.get("decisions", {}),
+        },
+        "latency": side.get("latency", {}),
+        # lock-wait quantiles ride in the sidecar latency block; the flat
+        # count/total view is the lock_wait block
+        "locks": side.get("latency", {}).get("rb_tpu_lock_wait_seconds", {}),
+        "lock_timing": bool(side.get("lock_wait")),
+        "breakers": counter_map("rb_tpu_breaker_transitions_total"),
+        "cache": {"stats": None, "hbm": counter_map("rb_tpu_hbm_accounting_drift_bytes")},
+        "decisions_tail": [],
+    }
+
+
+def _demo_workload() -> None:
+    """A small end-to-end exercise so the live report has every panel
+    populated: lock timing on, folds (cpu + forced-device), a planned
+    query, a delta repack, and an HBM reconciliation."""
+    import numpy as np
+
+    from roaringbitmap_tpu import RoaringBitmap
+    from roaringbitmap_tpu.observe import lockstats
+    from roaringbitmap_tpu.parallel import aggregation, store
+    from roaringbitmap_tpu.query import Q, execute
+
+    lockstats.install()
+    rng = np.random.default_rng(7)
+    bms = [
+        RoaringBitmap(
+            np.sort(rng.choice(1 << 18, 1500, replace=False)).astype(np.uint32)
+        )
+        for _ in range(8)
+    ]
+    aggregation.FastAggregation.or_(*bms, mode="cpu")
+    aggregation.FastAggregation.or_(*bms, mode="device")
+    execute((Q.leaf(bms[0]) & Q.leaf(bms[1])) | Q.leaf(bms[2]))
+    hb = int(bms[0].high_low_container.keys[0])
+    bms[0].add((hb << 16) | 4242)
+    store.packed_for(bms)
+    store.hbm_reconciliation()
+
+
+def _fmt_table(rows, indent="  "):
+    if not rows:
+        return [indent + "(none)"]
+    w = max(len(str(k)) for k, _ in rows)
+    return [f"{indent}{str(k):<{w}}  {v}" for k, v in rows]
+
+
+def _render_console(r: dict) -> str:
+    lines = [f"rb_top — {r['source']}  ({r['generated_utc']})"]
+
+    def section(title, rows):
+        lines.append("")
+        lines.append(title)
+        lines.extend(_fmt_table(rows))
+
+    c = r["counters"]
+    section("kernel dispatch", sorted(c.get("kernel", {}).items()))
+    section("layouts", sorted(c.get("layout", {}).items()))
+    pc = c.get("pack_cache", {})
+    section(
+        "pack cache",
+        [(k, pc[k]) for k in sorted(pc) if pc[k]],
+    )
+    section("compiles (rb_tpu_compile_total)", sorted(c.get("compile", {}).items()))
+    section(
+        "locks (wait p99 s)" if r.get("lock_timing") else "locks (timing off)",
+        sorted(
+            (k, v.get("p99", v.get("mean_ms"))) for k, v in r.get("locks", {}).items()
+        ),
+    )
+    section("breakers", sorted(r.get("breakers", {}).items()))
+    cache = r.get("cache", {})
+    hbm = cache.get("hbm") or {}
+    section("hbm accounting", sorted(hbm.items()))
+    lat = r.get("latency", {})
+    lat_rows = []
+    for metric in sorted(lat):
+        for series, st in sorted(lat[metric].items()):
+            lat_rows.append(
+                (f"{metric}{{{series}}}",
+                 f"n={st['count']} p50={st['p50']:.6f} p99={st['p99']:.6f}")
+            )
+    section("latency (p50/p99 s)", lat_rows[:40])
+    dec_rows = [
+        (d.get("trace") or "-",
+         f"{d['site']}: {d['decision']} {d.get('inputs', '')}")
+        for d in r.get("decisions_tail", [])
+    ]
+    section("decision log (tail)", dec_rows)
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--json", action="store_true", help="JSON report")
+    ap.add_argument("--from", dest="from_path", default=None,
+                    help="render a metrics sidecar file instead of live state")
+    ap.add_argument("--demo", action="store_true",
+                    help="run a small in-process workload first")
+    ap.add_argument("--tail", type=int, default=16,
+                    help="decision-log tail length (default %(default)s)")
+    args = ap.parse_args(argv)
+
+    if args.from_path:
+        r = _sidecar_report(args.from_path, args.tail)
+    else:
+        if args.demo:
+            _demo_workload()
+        r = _live_report(args.tail)
+        if args.demo:
+            r["source"] = "demo"
+    if args.json:
+        print(json.dumps(r, indent=1, default=str))
+    else:
+        print(_render_console(r), end="")
+    return 0
+
+
+def report(tail: int = 16) -> dict:
+    """Library entry: the live observatory report for this process."""
+    return _live_report(tail)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,636 @@
+"""Query-scoped tracing, decision provenance, and the resource
+observatory (ISSUE 9): trace-context scoping + explicit lane handoff
+(incl. the 16-thread no-bleed hammer), the bounded decision log wired to
+every deciding site, lock-wait histograms with the off-mode contract and
+lockwitness leaf-safety, the jit compile/retrace counter + anomaly dump,
+device-memory reconciliation, flow events, and golden exporter output
+for the new metrics."""
+
+import json
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import RoaringBitmap, insights, observe
+from roaringbitmap_tpu.analysis.lockwitness import LockWitness, WitnessedLock
+from roaringbitmap_tpu.observe import Registry, latency_histogram
+from roaringbitmap_tpu.observe import compilewatch, context, decisions, lockstats
+from roaringbitmap_tpu.observe import timeline as tl
+from roaringbitmap_tpu.parallel import aggregation, overlap, store
+from roaringbitmap_tpu.query import Q, execute
+
+
+@pytest.fixture
+def recording():
+    prev = tl.mode_name()
+    tl.configure(mode="on", budget_ms=0)
+    tl.RECORDER.clear()
+    try:
+        yield tl.RECORDER
+    finally:
+        tl.configure(mode=prev, budget_ms=0)
+        tl.RECORDER.clear()
+
+
+def _bitmaps(n=4, size=1200, span=1 << 18, seed=3):
+    rng = np.random.default_rng(seed)
+    return [
+        RoaringBitmap(
+            np.sort(rng.choice(span, size, replace=False)).astype(np.uint32)
+        )
+        for _ in range(n)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# trace context: scoping rules
+# ---------------------------------------------------------------------------
+
+
+def test_trace_scope_mints_reuses_and_resets():
+    assert context.current_trace() is None
+    with context.trace_scope() as outer:
+        assert outer.trace_id is not None
+        assert context.current_trace() == outer.trace_id
+        with context.trace_scope() as inner:  # nested: same query
+            assert inner.trace_id == outer.trace_id
+        with context.trace_scope("pinned") as pinned:  # explicit: pins
+            assert context.current_trace() == "pinned"
+            assert pinned.trace_id == "pinned"
+        assert context.current_trace() == outer.trace_id
+    assert context.current_trace() is None
+
+
+def test_trace_ids_are_process_unique():
+    ids = {context.new_trace_id() for _ in range(1000)}
+    assert len(ids) == 1000
+
+
+def test_adopt_is_explicit_and_none_safe():
+    with context.adopt(None):
+        assert context.current_trace() is None
+    with context.adopt("handed-off"):
+        assert context.current_trace() == "handed-off"
+    assert context.current_trace() is None
+
+
+def test_context_kill_switch():
+    context.configure(enabled=False)
+    try:
+        with context.trace_scope() as s:
+            assert s.trace_id is None
+            assert context.current_trace() is None
+    finally:
+        context.configure(enabled=True)
+
+
+def test_threads_do_not_inherit_context_implicitly():
+    got = []
+    with context.trace_scope():
+        t = threading.Thread(target=lambda: got.append(context.current_trace()))
+        t.start()
+        t.join()
+    assert got == [None]  # handoff is explicit by design
+
+
+# ---------------------------------------------------------------------------
+# 16-thread hammer: trace ids never bleed across concurrent queries
+# ---------------------------------------------------------------------------
+
+
+def test_sixteen_thread_trace_isolation_hammer(recording):
+    """Each worker runs real query executions under explicit per-worker
+    trace ids; afterwards every recorded event must carry a trace id of
+    the worker that owns the event's thread — a single cross-thread bleed
+    fails (satellite: contextvar isolation)."""
+    bms = _bitmaps(6, size=400)
+    exprs = [
+        (Q.leaf(bms[i % 6]) & Q.leaf(bms[(i + 1) % 6])) | Q.leaf(bms[(i + 2) % 6])
+        for i in range(4)
+    ]
+    errors = []
+    tid_to_worker = {}
+    barrier = threading.Barrier(16)
+
+    def worker(w):
+        tid_to_worker[threading.get_ident()] = w
+        barrier.wait()
+        for j in range(12):
+            tid = f"w{w}.{j}"
+            with context.trace_scope(tid):
+                execute(exprs[j % len(exprs)], cache=None)
+                if context.current_trace() != tid:
+                    errors.append(f"worker {w} lost its id at iter {j}")
+            if context.current_trace() is not None:
+                errors.append(f"worker {w} leaked a trace id")
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    evs = [e for e in tl.RECORDER.events() if e.tid in tid_to_worker]
+    assert evs, "hammer recorded no events on worker threads"
+    for e in evs:
+        if e.trace is None:
+            continue  # events outside any scope (none expected, but benign)
+        want = f"w{tid_to_worker[e.tid]}."
+        assert e.trace.startswith(want), (
+            f"event {e.name} on worker {tid_to_worker[e.tid]} carries "
+            f"foreign trace {e.trace}"
+        )
+
+
+def test_lane_handoff_attributes_stagings_to_their_queries(recording):
+    """Explicit handoff across the ShipLane thread boundary: two stagings
+    submitted under different trace ids; the lane-thread events of each
+    must carry the submitting query's id (satellite: lane handoff)."""
+    set_a = _bitmaps(2, size=600, seed=11)
+    set_b = _bitmaps(3, size=600, seed=12)
+    store.PACK_CACHE.close()
+    overlap.LANE.drain()
+    prev = overlap.LANE.threading_mode
+    overlap.LANE.configure("on")
+    try:
+        with context.trace_scope("lane-a"):
+            st_a = overlap.LANE.prefetch(set_a)
+        assert st_a is not None and st_a.trace == "lane-a"
+        with context.trace_scope("lane-a"):
+            assert overlap.LANE.wait(set_a) is not None
+        with context.trace_scope("lane-b"):
+            st_b = overlap.LANE.prefetch(set_b)
+        assert st_b is not None and st_b.trace == "lane-b"
+        with context.trace_scope("lane-b"):
+            assert overlap.LANE.wait(set_b) is not None
+    finally:
+        overlap.LANE.drain()
+        overlap.LANE.configure(prev)
+        store.PACK_CACHE.close()
+    names = tl.thread_names()
+    lane_evs = [
+        e for e in tl.RECORDER.events()
+        if names.get(e.tid, "").startswith("rb-ship-lane")
+    ]
+    assert lane_evs, "lane thread recorded nothing"
+    assert all(e.trace in ("lane-a", "lane-b") for e in lane_evs), [
+        (e.name, e.trace) for e in lane_evs
+    ]
+    # the two stagings are distinguishable by operand count; each span
+    # must carry ITS OWN query's id, not the other's
+    for e in lane_evs:
+        if e.name == "overlap.stage":
+            want = "lane-a" if e.attrs["n"] == 2 else "lane-b"
+            assert e.trace == want, (e.attrs, e.trace)
+    # flow events link submit -> stage -> join under matching flow ids
+    flows = {}
+    for e in tl.RECORDER.events():
+        if e.ph in ("s", "t", "f"):
+            flows.setdefault(e.attrs["flow"], []).append(e.ph)
+    assert len(flows) == 2
+    for phases in flows.values():
+        assert phases == ["s", "t", "f"]
+
+
+def test_lane_thread_name_registered_eagerly_without_any_event():
+    """The satellite fix: the lane pool registers its thread name at
+    thread START (executor initializer), so even a staging that records
+    zero events (timeline off) leaves the tid named for later exports."""
+    assert tl.mode_name() == "off"
+    bms = _bitmaps(2, size=300, seed=21)
+    store.PACK_CACHE.close()
+    prev = overlap.LANE.threading_mode
+    overlap.LANE.configure("on")
+    try:
+        with context.trace_scope("eager"):
+            st = overlap.LANE.prefetch(bms)
+        assert st is not None
+        overlap.LANE.wait(bms)
+    finally:
+        overlap.LANE.drain()
+        overlap.LANE.configure(prev)
+        store.PACK_CACHE.close()
+    assert any(
+        n.startswith("rb-ship-lane") for n in tl.thread_names().values()
+    )
+
+
+# ---------------------------------------------------------------------------
+# per-trace attribution + flow rendering
+# ---------------------------------------------------------------------------
+
+
+def test_stage_totals_per_trace(recording):
+    with context.trace_scope("qa"):
+        with tl.tspan("stage.x", "t"):
+            time.sleep(0.002)
+    with context.trace_scope("qb"):
+        with tl.tspan("stage.x", "t"):
+            time.sleep(0.002)
+        with tl.tspan("stage.y", "t"):
+            pass
+    evs = tl.RECORDER.events()
+    flat = tl.stage_totals(evs, ["stage.x", "stage.y"])
+    per = tl.stage_totals(evs, ["stage.x", "stage.y"], per_trace=True)
+    assert set(per) == {"qa", "qb"}
+    assert per["qa"]["stage.x"] > 0 and "stage.y" not in per["qa"]
+    assert flat["stage.x"] == pytest.approx(
+        per["qa"]["stage.x"] + per["qb"]["stage.x"]
+    )
+
+
+def test_chrome_trace_renders_flows_and_trace_args(recording):
+    fid = tl.flow_id("q1", "key")
+    with context.trace_scope("q1"):
+        tl.flow_point("handoff", "s", fid)
+        with tl.tspan("work", "t"):
+            pass
+        tl.flow_point("handoff", "f", fid)
+    trace = tl.chrome_trace()
+    by_ph = {}
+    for rec in trace["traceEvents"]:
+        by_ph.setdefault(rec["ph"], []).append(rec)
+    assert by_ph["s"][0]["id"] == fid
+    assert by_ph["f"][0]["id"] == fid and by_ph["f"][0]["bp"] == "e"
+    assert by_ph["X"][0]["args"]["trace"] == "q1"
+    with pytest.raises(ValueError):
+        tl.flow_point("handoff", "x", fid)
+
+
+def test_timeline_event_trace_arg_is_optional():
+    e = tl.TimelineEvent("n", "c", "X", 0, 5, 1, None)  # legacy 7-arg form
+    assert e.trace is None and "trace" not in e.to_dict()
+    e2 = tl.TimelineEvent("n", "c", "X", 0, 5, 1, None, trace="q1")
+    assert e2.to_dict()["trace"] == "q1"
+
+
+# ---------------------------------------------------------------------------
+# decision provenance
+# ---------------------------------------------------------------------------
+
+
+def test_decision_log_bounded_ring_and_tail():
+    log = decisions.DecisionLog(capacity=4)
+    for i in range(10):
+        log.record({"site": "s", "decision": str(i)})
+    assert log.total() == 10
+    tail = log.tail()
+    assert [e["decision"] for e in tail] == ["6", "7", "8", "9"]
+    assert [e["decision"] for e in log.tail(2)] == ["8", "9"]
+    tail[0]["decision"] = "mutated"  # copies: the ring is unaffected
+    assert log.tail()[0]["decision"] == "6"
+    log.resize(2)
+    assert [e["decision"] for e in log.tail()] == ["8", "9"]
+
+
+def test_decisions_carry_trace_and_mirror_to_recorder(recording):
+    with context.trace_scope("qd"):
+        decisions.record_decision("test.site", "chosen", rows=7)
+    entry = decisions.decisions(1)[0]
+    assert entry["site"] == "test.site" and entry["decision"] == "chosen"
+    assert entry["trace"] == "qd" and entry["inputs"] == {"rows": 7}
+    evs = [e for e in tl.RECORDER.events() if e.name == "decision.test.site"]
+    assert evs and evs[0].trace == "qd"
+    assert evs[0].attrs["decision"] == "chosen"
+
+
+def test_decisions_kill_switch():
+    before = decisions.LOG.total()
+    decisions.configure(enabled=False)
+    try:
+        decisions.record_decision("test.site", "nope")
+    finally:
+        decisions.configure(enabled=True)
+    assert decisions.LOG.total() == before
+
+
+def test_dispatch_planner_ladder_and_cache_decisions_end_to_end():
+    from roaringbitmap_tpu.robust import ladder
+
+    bms = _bitmaps(4, size=800, seed=5)
+    store.PACK_CACHE.close()
+    aggregation.FastAggregation.or_(*bms, mode="cpu")
+    execute(Q.leaf(bms[0]) | Q.leaf(bms[1]), cache=None)
+    aggregation.FastAggregation.or_(*bms, mode="device")
+    ladder.LADDER.note_degrade("test.site", "device", "cpu")
+    got = insights.decisions()
+    sites = {d["site"] for d in got}
+    assert {"agg.dispatch", "query.plan", "ladder.degrade",
+            "pack_cache.admit", "columnar.cutoff"} <= sites
+    disp = [d for d in got if d["site"] == "agg.dispatch"][-1]
+    assert {"op", "rows", "operands"} <= set(disp["inputs"])
+    plan_d = [d for d in got if d["site"] == "query.plan"][-1]
+    assert {"op", "est_card", "est_rows"} <= set(plan_d["inputs"])
+    # the fold entries ran inside a trace scope, so they carry an id
+    assert disp["trace"]
+    store.PACK_CACHE.close()
+
+
+def test_columnar_cutoff_not_recorded_below_count_gate():
+    """The 2 µs per-container floor must not pay a decision record: a
+    small pair (below min_containers) routes without logging."""
+    from roaringbitmap_tpu import columnar
+
+    a = RoaringBitmap(np.array([1, 2, 3], dtype=np.uint32))
+    b = RoaringBitmap(np.array([2, 3, 4], dtype=np.uint32))
+    before = decisions.LOG.total()
+    assert columnar.engine.enabled_for(
+        a.high_low_container, b.high_low_container
+    ) is False
+    assert decisions.LOG.total() == before
+
+
+# ---------------------------------------------------------------------------
+# lock-wait observatory
+# ---------------------------------------------------------------------------
+
+
+def test_lockstats_install_uninstall_roundtrip():
+    from roaringbitmap_tpu import native, tracing
+
+    raw = tracing._TIMINGS_LOCK
+    raw_native = native._lock
+    lockstats.install(enable_timing=False)
+    try:
+        assert isinstance(tracing._TIMINGS_LOCK, lockstats.TimedLock)
+        assert tracing._TIMINGS_LOCK._inner is raw
+        names = set(lockstats.installed())
+        assert {"tracing.timings", "observe.registry", "query.expr.intern",
+                "query.exec.plan_memo", "query.cache", "agg.pool",
+                "native.loader"} == names
+        lockstats.install(enable_timing=False)  # idempotent
+        assert tracing._TIMINGS_LOCK._inner is raw
+    finally:
+        lockstats.uninstall()
+    assert tracing._TIMINGS_LOCK is raw
+    assert native._lock is raw_native
+    assert lockstats.installed() == []
+    # metrics' captured registry-lock references are restored too
+    m = observe.REGISTRY.get(observe.LOCK_WAIT_SECONDS)
+    assert not isinstance(m._lock, lockstats.TimedLock)
+
+
+def test_lockstats_records_waits_when_enabled_and_not_when_off():
+    hist = observe.REGISTRY.get(observe.LOCK_WAIT_SECONDS)
+    lockstats.install(enable_timing=True)
+    try:
+        observe.counter("rb_tpu_lockstats_probe_total", "", ("k",)).inc(1, ("x",))
+        st = hist.get(("observe.registry",))
+        assert st is not None and st["count"] > 0
+        count_on = st["count"]
+        lockstats.enable(False)
+        for _ in range(50):
+            observe.REGISTRY.get(observe.LOCK_WAIT_SECONDS)  # takes the lock
+        st2 = hist.get(("observe.registry",))
+        assert st2["count"] == count_on  # off-mode: the int compare only
+    finally:
+        lockstats.uninstall()
+
+
+def test_lockstats_sampling():
+    lockstats.install(enable_timing=True, sample=1000)
+    try:
+        hist = observe.REGISTRY.get(observe.LOCK_WAIT_SECONDS)
+        before = (hist.get(("observe.registry",)) or {"count": 0})["count"]
+        for i in range(50):
+            observe.counter(
+                "rb_tpu_lockstats_probe_total", "", ("k",)
+            ).inc(1, ("y",))
+        after = (hist.get(("observe.registry",)) or {"count": 0})["count"]
+        assert after - before < 5  # ~1/1000 sampled, not every acquire
+    finally:
+        lockstats.uninstall()
+
+
+def test_lock_wait_observe_is_leaf_safe_under_witness():
+    """The observatory's histogram observe runs while HOLDING the wrapped
+    lock — witness every inner lock under a query-execute hammer and
+    assert the acquisition-order graph stays acyclic (the lockwitness
+    leaf-safety contract from the ISSUE)."""
+    bms = _bitmaps(4, size=500, seed=9)
+    exprs = [
+        Q.leaf(bms[0]) | Q.leaf(bms[1]),
+        (Q.leaf(bms[1]) & Q.leaf(bms[2])) | Q.leaf(bms[3]),
+    ]
+    lockstats.install(enable_timing=True)
+    w = LockWitness()
+    try:
+        for name, (tlock, _set) in list(lockstats._INSTALLED.items()):
+            tlock._inner = w.wrap(name, tlock._inner)
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            list(
+                pool.map(
+                    lambda i: execute(exprs[i % 2], cache=None),
+                    range(32),
+                )
+            )
+        w.assert_consistent()
+        # the known nesting (metrics recorded under the cache lock) was
+        # actually exercised THROUGH the timed proxies
+        assert any(b == "observe.registry" for _a, b in w.edges)
+    finally:
+        for _name, (tlock, _set) in list(lockstats._INSTALLED.items()):
+            if isinstance(tlock._inner, WitnessedLock):
+                tlock._inner = tlock._inner._inner
+        lockstats.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# compile/retrace watcher
+# ---------------------------------------------------------------------------
+
+
+def test_tracked_counts_traces_not_calls():
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    @compilewatch.tracked("observatory_test_fn")
+    def f(x, k=1):
+        return x * k
+
+    def count():
+        return compilewatch.compile_counts().get("observatory_test_fn", 0)
+
+    base = count()
+    x4 = jnp.arange(4, dtype=jnp.int32)
+    f(x4, k=2)
+    f(x4, k=2)  # cache hit: no retrace
+    assert count() == base + 1
+    f(x4, k=3)  # new static arg: retrace
+    assert count() == base + 2
+    f(jnp.arange(8, dtype=jnp.int32), k=3)  # new shape: retrace
+    assert count() == base + 3
+    f(x4, k=2)  # old signature still cached
+    assert count() == base + 3
+
+
+def test_tracked_preserves_donation():
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    @compilewatch.tracked("observatory_donate_fn")
+    def g(x):
+        return x + 1
+
+    out = g(jnp.arange(4, dtype=jnp.int32))
+    assert np.array_equal(np.asarray(out), [1, 2, 3, 4])
+    assert compilewatch.compile_counts()["observatory_donate_fn"] >= 1
+
+
+def test_compile_budget_anomaly_dump(tmp_path, recording, monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    dump = tmp_path / "compile_anomaly.jsonl"
+    monkeypatch.setattr(compilewatch, "_BUDGET", 2)
+    monkeypatch.setattr(compilewatch, "_DUMP_PATH", str(dump))
+    monkeypatch.setattr(compilewatch, "_LAST_DUMP_NS", 0)
+
+    @jax.jit
+    @compilewatch.tracked("observatory_budget_fn")
+    def h(x):
+        return x + 1
+
+    for n in (2, 4, 8, 16):  # 4 shapes: 4 traces > budget 2
+        h(jnp.arange(n, dtype=jnp.int32))
+    assert dump.is_file()
+    header = json.loads(dump.read_text().splitlines()[0])
+    assert header["trigger"]["compile_fn"] == "observatory_budget_fn"
+    assert header["trigger"]["budget"] == 2
+    anomalies = [
+        e for e in tl.RECORDER.events() if e.name == "compile.anomaly"
+    ]
+    assert anomalies and anomalies[0].attrs["fn"] == "observatory_budget_fn"
+
+
+def test_north_star_reduce_reaches_steady_state_with_zero_retraces():
+    bms = _bitmaps(6, size=1500, seed=13)
+    store.PACK_CACHE.close()
+    packed = store.packed_for(bms)
+    run, _layout = store.prepare_reduce(packed, op="or")
+    run()  # cold one-shot (fused gather+reduce)
+    run()  # second touch builds the resident padded block + compiles
+    before = compilewatch.compile_counts()
+    for _ in range(4):
+        run()
+    after = compilewatch.compile_counts()
+    assert sum(after.values()) == sum(before.values()), (
+        "steady-state reduce retraced: "
+        f"{ {k: after[k] - before.get(k, 0) for k in after} }"
+    )
+    store.PACK_CACHE.close()
+
+
+# ---------------------------------------------------------------------------
+# device-memory reconciliation
+# ---------------------------------------------------------------------------
+
+
+def test_hbm_reconciliation_ledger_agrees():
+    store.PACK_CACHE.close()
+    recon0 = store.hbm_reconciliation()
+    assert recon0["ledger_drift_bytes"] == 0
+    bms = _bitmaps(4, size=900, seed=17)
+    packed = store.packed_for(bms)
+    packed.device_words.block_until_ready()
+    recon = store.hbm_reconciliation()
+    assert recon["entries"] >= 1
+    assert recon["gauge_bytes"] == recon["ledger_bytes"] == recon["entry_sum_bytes"]
+    assert recon["ledger_drift_bytes"] == 0
+    drift = observe.REGISTRY.get(observe.HBM_ACCOUNTING_DRIFT_BYTES)
+    assert drift.get(("ledger",)) == 0
+    store.PACK_CACHE.close()
+    assert store.hbm_reconciliation()["gauge_bytes"] == 0
+
+
+def test_observatory_snapshot_shape():
+    obs = insights.observatory()
+    assert {"locks", "compile", "hbm", "breakers", "pack_cache",
+            "decisions"} <= set(obs)
+    assert isinstance(obs["decisions"], list)
+    assert "ledger_drift_bytes" in obs["hbm"]
+
+
+# ---------------------------------------------------------------------------
+# golden exporter output for the new metrics (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _observatory_registry() -> Registry:
+    reg = Registry()
+    lw = latency_histogram(
+        "rb_tpu_lock_wait_seconds", "lock waits", ("lock",),
+        buckets=(0.001, 0.1), registry=reg,
+    )
+    lw.observe(0.0005, ("pack.cache",))
+    lw.observe(0.05, ("pack.cache",))
+    lw.observe(0.05, ("pack.cache",))
+    c = reg.counter("rb_tpu_compile_total", "traces", ("fn",))
+    c.inc(3, ("wide_reduce",))
+    g = reg.gauge("rb_tpu_hbm_accounting_drift_bytes", "drift", ("source",))
+    g.set(0, ("ledger",))
+    return reg
+
+
+def test_prometheus_golden_lock_wait_and_compile():
+    text = observe.prometheus_text(_observatory_registry())
+    assert text.splitlines() == [
+        "# HELP rb_tpu_compile_total traces",
+        "# TYPE rb_tpu_compile_total counter",
+        'rb_tpu_compile_total{fn="wide_reduce"} 3',
+        "# HELP rb_tpu_hbm_accounting_drift_bytes drift",
+        "# TYPE rb_tpu_hbm_accounting_drift_bytes gauge",
+        'rb_tpu_hbm_accounting_drift_bytes{source="ledger"} 0',
+        "# HELP rb_tpu_lock_wait_seconds lock waits",
+        "# TYPE rb_tpu_lock_wait_seconds histogram",
+        'rb_tpu_lock_wait_seconds_bucket{lock="pack.cache",le="0.001"} 1',
+        'rb_tpu_lock_wait_seconds_bucket{lock="pack.cache",le="0.1"} 3',
+        'rb_tpu_lock_wait_seconds_bucket{lock="pack.cache",le="+Inf"} 3',
+        'rb_tpu_lock_wait_seconds_sum{lock="pack.cache"} 0.1005',
+        'rb_tpu_lock_wait_seconds_count{lock="pack.cache"} 3',
+        'rb_tpu_lock_wait_seconds{lock="pack.cache",quantile="0.5"} '
+        "0.025750000000000002",
+        'rb_tpu_lock_wait_seconds{lock="pack.cache",quantile="0.9"} '
+        "0.08515000000000002",
+        'rb_tpu_lock_wait_seconds{lock="pack.cache",quantile="0.99"} '
+        "0.09851499999999999",
+    ]
+
+
+def test_jsonl_golden_lock_wait_and_compile():
+    recs = [json.loads(l) for l in observe.jsonl_lines(_observatory_registry())]
+    assert [r["name"] for r in recs] == [
+        "rb_tpu_compile_total",
+        "rb_tpu_hbm_accounting_drift_bytes",
+        "rb_tpu_lock_wait_seconds",
+    ]
+    assert recs[0] == {
+        "labels": {"fn": "wide_reduce"},
+        "name": "rb_tpu_compile_total",
+        "type": "counter",
+        "value": 3,
+    }
+    lw = recs[2]
+    assert lw["count"] == 3
+    assert lw["buckets"] == {"0.001": 1, "0.1": 3, "+Inf": 3}
+    assert set(lw["quantiles"]) == {"p50", "p90", "p99"}
+    assert lw["quantiles"]["p50"] == pytest.approx(0.02575)
+
+
+def test_sidecar_carries_observatory_blocks():
+    side = observe.sidecar_snapshot(_observatory_registry())
+    assert side["compile"] == {"wide_reduce": 3}
+    assert side["hbm_drift"] == {"ledger": 0}
+    assert side["lock_wait"]["pack.cache"]["count"] == 3
+    assert "rb_tpu_lock_wait_seconds" in side["latency"]
+    q = side["latency"]["rb_tpu_lock_wait_seconds"]["pack.cache"]
+    assert {"count", "sum", "p50", "p90", "p99"} <= set(q)

@@ -661,3 +661,64 @@ def test_pack_cache_lock_joins_order_graph_cycle_free(monkeypatch):
     assert w.acquisitions.get("pack.cache", 0) > 0
     assert ("pack.cache", "observe.registry") in w.edges
     w.assert_consistent()
+
+
+# ---------------------------------------------------------------------------
+# resident-gauge reconciliation after a donation-consumed buffer (ISSUE 9
+# satellite): the delta path's donation-failure branches used to null the
+# flat device rows WITHOUT settling their resident accounting — the next
+# rebuild then re-accounted the same rows and the gauge drifted one block
+# high per failed delta. The fix (_drop_flat) releases bytes with the
+# buffer; this regression asserts gauge == sum of live entries across a
+# full delta + failed-donation + rebuild cycle.
+# ---------------------------------------------------------------------------
+
+
+def test_resident_gauge_reconciles_after_failed_donation_delta():
+    from roaringbitmap_tpu import robust
+    from roaringbitmap_tpu.robust import faults
+
+    gauge = observe.REGISTRY.get(observe.STORE_RESIDENT_BYTES)
+    store.PACK_CACHE.close()
+    store.hbm_reconciliation()  # settle any dropped test caches first
+    bms = _working_set(seed=91, k=4)
+    base_flat = gauge.get(("flat_rows",))
+    packed = store.packed_for(bms)
+    packed.device_words.block_until_ready()
+    assert gauge.get(("flat_rows",)) - base_flat == packed.words_nbytes
+
+    # a successful delta first (donation path), so the failed one below
+    # patches a resident, already-delta'd buffer — the exact r10 shape
+    hb = int(bms[0].high_low_container.keys[0])
+    bms[0].add((hb << 16) | 901)
+    assert store.packed_for(bms) is packed
+    packed.device_words.block_until_ready()
+    assert gauge.get(("flat_rows",)) - base_flat == packed.words_nbytes
+
+    # now a delta whose donated scatter FAILS (transient at store.ship):
+    # the flat rows drop AND their bytes settle — the gauge must return
+    # to base, not carry phantom bytes for a consumed buffer
+    bms[0].add((hb << 16) | 902)
+    with faults.inject("store.ship", robust.TransientDeviceError, every=1):
+        p2 = store.packed_for(bms)
+    assert p2 is packed
+    assert packed._device_words is None
+    assert gauge.get(("flat_rows",)) - base_flat == 0, (
+        "failed donation left phantom flat_rows bytes on the gauge"
+    )
+
+    # rebuild re-accounts exactly once (pre-fix this doubled)
+    packed.device_words.block_until_ready()
+    assert gauge.get(("flat_rows",)) - base_flat == packed.words_nbytes
+
+    # and the cache-level invariant: resident gauge == entry ledger ==
+    # sum of live entries (hbm_reconciliation's ledger check)
+    recon = store.hbm_reconciliation()
+    assert recon["ledger_drift_bytes"] == 0
+    assert recon["gauge_bytes"] == recon["entry_sum_bytes"]
+    # bits stayed correct through the degrade: delta == full repack
+    fresh = store.pack_groups(store.group_by_key(bms))
+    assert np.array_equal(packed.words, fresh.words)
+    store.PACK_CACHE.close()
+    del fresh  # its __del__ settles its own (uncached) flat rows
+    assert gauge.get(("flat_rows",)) - base_flat == 0
