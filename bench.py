@@ -332,6 +332,163 @@ def _run():
         "fold_speedup": round(cpu_fold_percontainer_s / cpu_s, 2),
     }
 
+    # ---- columnar device tier + measured cutoff model (ISSUE 10) ----
+    # Three-way twin rows on the SAME census pairs: per-container vs
+    # columnar-CPU vs columnar-device, forced per engine after an in-bench
+    # device≡CPU parity sweep over every op. On the CPU backend the device
+    # twin prices the tier's dispatch machinery against host memory (jax
+    # CPU client) — the >=1.5x-vs-columnar-CPU dense-class claim gates
+    # accelerator artifacts, presence + parity gate every artifact. The
+    # cost-model accuracy row replays routed verdicts against per-engine
+    # measurements: a verdict counts correct when the chosen engine
+    # measured within 15% of the fastest (near-ties are not routing
+    # errors).
+    from roaringbitmap_tpu.columnar import costmodel as col_costmodel
+    from roaringbitmap_tpu.columnar import device as col_device
+
+    col_costmodel.MODEL.reset()
+    backend_name = jax.default_backend()
+    from roaringbitmap_tpu import insights as rb_insights
+
+    _dev_edge = "columnar.device/columnar-device/columnar-cpu"
+    _degrades_before = rb_insights.robust_counters()["degrade"].get(_dev_edge, 0)
+    cal = columnar.calibrate(include_device=True)
+    # device parity sweep: every op, every pair, device ≡ routed ≡ per-container
+    for a, b in pairs:
+        for opname, op_fn in (
+            ("and", RoaringBitmap.and_), ("or", RoaringBitmap.or_),
+            ("xor", RoaringBitmap.xor), ("andnot", RoaringBitmap.andnot),
+        ):
+            got_dev = columnar.pairwise(opname, a, b, tier="device")
+            with columnar.disabled():
+                want = op_fn(a, b)
+            assert got_dev == want, f"columnar device {opname} mismatch"
+    # rows are resident after the sweep: the twin prices the steady state.
+    # BOTH baselines are FORCED tier="cpu" runs — the ISSUE 5 rows above
+    # measure the routed facade (their historical meaning), which mixes
+    # engines per pair and would skew the three-way ratio
+    and2by2_dev = _min_over(
+        lambda: [columnar.pairwise("and", a, b, tier="device") for a, b in pairs],
+        pair_reps,
+    )
+    and2by2_ccpu = _min_over(
+        lambda: [columnar.pairwise("and", a, b, tier="cpu") for a, b in pairs],
+        pair_reps,
+    )
+    or2by2_dev = _min_over(
+        lambda: [columnar.pairwise("or", a, b, tier="device") for a, b in pairs],
+        pair_reps,
+    )
+    or2by2_col = _min_over(
+        lambda: [columnar.pairwise("or", a, b, tier="cpu") for a, b in pairs],
+        pair_reps,
+    )
+
+    # cost-model accuracy cells: census pairs + the r12 small-operand
+    # regression-zone shapes (16-64 containers, array/bitmap/run mixes).
+    # The synthetic cells come from the SAME builder the calibration fits
+    # on (costmodel._synthetic_pair) so the accuracy row audits the model
+    # against its own operand shapes, not a drifting copy.
+    _cell_rng = np.random.default_rng(0xC311)
+    cells = [(a, b) for a, b in pairs[:6]]
+    for shape in col_costmodel.SHAPES:
+        for n in (16, 32, 64):
+            cells.append(col_costmodel._synthetic_pair(shape, n, _cell_rng))
+
+    def _cell_time(fn):
+        return col_costmodel._time_us(fn, reps=2) / 1e6
+
+    model_hits = 0
+    for a, b in cells:
+        if backend_name != "cpu":
+            # route and measure at the same steady state: the verdict must
+            # be priced with residency sunk, because the measurements below
+            # run warm (a cold-priced CPU verdict scored against a warm
+            # device run would count a CORRECT choice as a miss)
+            col_device.rows_for(a)
+            col_device.rows_for(b)
+        verdict = columnar.route(
+            a.high_low_container, b.high_low_container, record=False
+        )
+        measured = {}
+        with columnar.disabled():
+            measured["per-container"] = _cell_time(
+                lambda: RoaringBitmap.and_(a, b)
+            )
+        measured["columnar-cpu"] = _cell_time(
+            lambda: columnar.pairwise("and", a, b, tier="cpu")
+        )
+        if backend_name != "cpu":
+            measured["columnar-device"] = _cell_time(
+                lambda: columnar.pairwise("and", a, b, tier="device")
+            )
+        if measured[verdict] <= 1.15 * min(measured.values()):
+            model_hits += 1
+        if backend_name != "cpu":
+            # audit the or-group coefficients too (the and-only replay
+            # could not see an or/xor mispricing): same correctness rule
+            verdict_or = columnar.route(
+                a.high_low_container, b.high_low_container, record=False,
+                op="or",
+            )
+            m_or = {}
+            with columnar.disabled():
+                m_or["per-container"] = _cell_time(lambda: RoaringBitmap.or_(a, b))
+            m_or["columnar-cpu"] = _cell_time(
+                lambda: columnar.pairwise("or", a, b, tier="cpu")
+            )
+            m_or["columnar-device"] = _cell_time(
+                lambda: columnar.pairwise("or", a, b, tier="device")
+            )
+            if m_or[verdict_or] <= 1.15 * min(m_or.values()):
+                model_hits += 1
+    n_cells = len(cells) * (2 if backend_name != "cpu" else 1)
+    # the forced-device rows above are only device numbers if the device
+    # tier actually ran: any ladder degrade at the columnar.device site
+    # during this section means the twins timed the CPU fallback — fail
+    # loudly instead of committing mislabeled rows
+    _degrades_after = rb_insights.robust_counters()["degrade"].get(_dev_edge, 0)
+    assert _degrades_after == _degrades_before, (
+        "columnar.device degraded during the device twin section: "
+        f"{_degrades_after - _degrades_before} pair(s) measured the CPU "
+        "fallback — device rows would be mislabeled"
+    )
+    # mid-size routed verdict on a resident dense pair: the acceptance
+    # contract — device on accelerators, columnar-CPU (r11-identical
+    # performance envelope) on CPU-only hosts
+    run_mid, run_mid2 = col_costmodel._synthetic_pair("run", 32, _cell_rng)
+    col_device.rows_for(run_mid)
+    col_device.rows_for(run_mid2)
+    midsize_tier = columnar.route(
+        run_mid.high_low_container, run_mid2.high_low_container, record=False
+    )
+    columnar_device_meta = {
+        "parity_ok": True,
+        "n_pairs": len(pairs),
+        "backend": backend_name,
+        "and2by2_percontainer_ns": round(and2by2_pc * 1e9),
+        "and2by2_columnar_ns": round(and2by2_ccpu * 1e9),
+        "and2by2_device_ns": round(and2by2_dev * 1e9),
+        "and2by2_device_vs_cpu": round(and2by2_ccpu / and2by2_dev, 2),
+        "or2by2_columnar_ns": round(or2by2_col * 1e9),
+        "or2by2_device_ns": round(or2by2_dev * 1e9),
+        "or2by2_device_vs_cpu": round(or2by2_col / or2by2_dev, 2),
+        "routed_tier_midsize": midsize_tier,
+        "cost_model": {
+            "calibrated": bool(cal.calibrated),
+            "backend": cal.backend,
+            "fold_gate_rows": cal.fold_gate_rows(),
+            "ship_us_per_row": cal.ship_us_per_row,
+            "cells": n_cells,
+            "accuracy": round(model_hits / n_cells, 3),
+        },
+    }
+    # the device section must not leak into the r11-comparable rows below:
+    # routed folds go back to the default gate and the colrows packs free
+    # their budget share before the pack sections measure cold costs
+    col_costmodel.MODEL.reset()
+    store.PACK_CACHE.close()
+
     # ---- degraded tier (ISSUE 7): the fold with the device tier down ----
     # degraded_fold_s is the STEADY-STATE outage number: injected dispatch
     # faults trip the agg/device circuit breaker (three sacrificial
@@ -813,6 +970,11 @@ def _run():
         # columnar pairwise engine (ISSUE 5): the host dispatch floor
         # before/after + the in-bench parity gate's verdict
         "columnar": columnar_meta,
+        # columnar device tier + measured cutoff model (ISSUE 10): the
+        # three-way twin rows (per-container / columnar-CPU / device on
+        # the same pairs), the mid-size routed verdict, and the cost
+        # model's measured-accuracy row
+        "columnar_device": columnar_device_meta,
         # which methodology produced tpu_reduce_s (VERDICT r3 weak #4: the
         # steady-state/per-dispatch asymmetry between backends must be
         # visible in the artifact, not only in prose)

@@ -21,9 +21,12 @@ Engine twins (ISSUE 5): the unsuffixed 32-bit rows pin the PER-CONTAINER
 engine (``columnar.disabled()``), keeping their historical meaning across
 BENCH_CPU_SWEEP rounds; each gains a ``columnar:`` twin calling the
 batched engine DIRECTLY on the same inputs, asserted value-equal first.
-(These grids are 10k single-value containers — the shape the router's
-``max_containers`` cap deliberately keeps on the per-container walk; the
-twin rows are the measured justification.)
+(These grids are 10k single-value containers — the shape the cutoff
+model deliberately keeps on the per-container walk; the twin rows are
+the measured justification.) Since ISSUE 10 each case also records a
+``routed:`` twin — the default path through the cutoff model — which
+must sit within noise of the per-container floor (no case below 0.9x:
+the router's own cost on a kept-per-container pair is a count compare).
 
 Run:  python -m benchmarks.run pairwise_cases --reps 5
 """
@@ -121,6 +124,17 @@ def run(reps: int = 5, datasets=None, **_) -> List[Result]:
                         common.min_of(
                             reps, lambda: columnar.pairwise(opname, b1, b2)
                         ),
+                        **extra,
+                    )
+                    # routed twin (ISSUE 10): the DEFAULT path through the
+                    # cutoff model — these grids must price within noise
+                    # of the per-container floor (the router keeps them
+                    # per-container; the row is the measured proof that
+                    # routing itself costs nothing here)
+                    rec(
+                        f"routed:{case}:{opname}",
+                        ds,
+                        common.min_of(reps, lambda: static_op(b1, b2)),
                         **extra,
                     )
 

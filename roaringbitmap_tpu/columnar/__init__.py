@@ -13,15 +13,26 @@ per-container Python dispatch, in three vectorized stages:
 3. **per-class batch kernels** (kernels.py / native ``rb_batch_*``) — one
    call per occupied class, then batched result-format selection.
 
+Since ISSUE 10 the engine has a **device execution tier** (device.py):
+the word-parallel classes run as fused jit dispatches over
+PACK_CACHE-resident flat rows on accelerator backends, and the hand-tuned
+cutoff is a **measured three-way cost model** (costmodel.py) choosing
+per-container / columnar-CPU / columnar-device per call from operand
+count, sampled class mix, and pack residency — uncalibrated it
+reproduces the r11 gate verbatim.
+
 The facade (models/roaring.py), the CPU folds (parallel/aggregation.py)
-and the query kernels' CPU fallbacks route here above
-``config.min_containers`` / ``config.min_fold_rows``; the per-container
-walk stays below the cutoff and as the differential reference (fuzz
-family ``columnar-vs-percontainer``). Observability:
-``rb_tpu_columnar_batch_total{op,class}`` via
-``insights.columnar_counters()``.
+and the query kernels' CPU fallbacks route here through
+``route()``/``enabled_for_fold()``; the per-container walk stays below
+the cutoff and as the differential reference (fuzz family
+``columnar-vs-percontainer``). Observability:
+``rb_tpu_columnar_batch_total{op,class}`` +
+``rb_tpu_columnar_route_total{tier}`` via
+``insights.columnar_counters()``; routing provenance lands at the
+``columnar.cutoff`` decision site (1-in-N sampled below the count gate).
 """
 
+from .costmodel import MODEL, calibrate, ensure_calibrated
 from .engine import (
     and_cardinality_pair,
     config,
@@ -32,6 +43,7 @@ from .engine import (
     intersects_pair,
     or_fold_words,
     pairwise,
+    route,
 )
 from .keyplan import KeyPlan, key_plan
 from .partition import CLASS_NAMES, class_histogram, classify
@@ -41,6 +53,7 @@ __all__ = [
     "disabled",
     "enabled_for",
     "enabled_for_fold",
+    "route",
     "pairwise",
     "and_cardinality_pair",
     "intersects_pair",
@@ -51,4 +64,7 @@ __all__ = [
     "classify",
     "class_histogram",
     "CLASS_NAMES",
+    "MODEL",
+    "calibrate",
+    "ensure_calibrated",
 ]

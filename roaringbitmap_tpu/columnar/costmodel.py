@@ -1,0 +1,504 @@
+"""Measured three-way cutoff model for the columnar engines (ISSUE 10).
+
+The r07 router was a hand-tuned gate: containers in ``16..4096`` on both
+sides plus a sampled dense-shape hint. That gate encodes two measured
+facts (the ~10 µs plan/partition overhead, the ~2 µs per-container C
+floor for tiny arrays) but misses a third the r12 profile found: on
+bitmap-heavy mixes the columnar-CPU dense classes LOSE to the
+per-container walk at every count in the window (word-matrix expansion +
+popcount costs more than the per-pair binary searches it replaces), the
+0.3-0.9x small-operand regression zone. And it cannot express the new
+device tier at all — whether HBM pays depends on operand count, class
+mix, AND whether the flat rows are already PACK_CACHE-resident.
+
+This module replaces the gate with a small measured cost model:
+
+    cost(engine) = overhead_us + n_pairs · per_pair_us[op_group][shape]
+
+with ``shape`` the sampled class-mix bucket (``run`` > ``bitmap`` >
+``array``, by which container kinds the ≤8-sample probe saw),
+``op_group`` the and/andnot vs or/xor coefficient table (their class
+structures cost differently), and ``n_pairs = min(na, nb)`` (an upper
+bound on matched pairs; pass-through cost is engine-independent).
+``choose()`` picks the argmin among per-container / columnar-CPU /
+columnar-device over STEADY-STATE costs; a non-resident operand's
+one-time ship is surfaced in the decision inputs (``ship_us``) but not
+priced into the verdict — it is the PACK_CACHE first-touch investment,
+and pricing it would leave the device tier unreachable (only device
+executions establish residency). The device engine is only eligible on
+accelerator backends (on the CPU backend "HBM" is host memory — the
+tier would pay dispatch overhead to move nothing).
+
+**Calibration** is measured, not guessed — like the bench's
+``cold_breakeven`` rows: ``calibrate()`` times the real engines on small
+synthetic working sets per (shape, count) cell and fits
+``overhead + slope`` per engine. It runs at *first use on accelerator
+backends* (where the device tier must be priced before the first routed
+call), explicitly from bench/tests, or at import when
+``RB_TPU_COLUMNAR_CAL`` names a persisted-calibration path (load if
+present, write after measuring). **Uncalibrated, the model reproduces
+the r11 gate verbatim** — CPU-only hosts route identically to r11 unless
+someone asks for the measured model, and the decision log records which
+mode produced every verdict.
+
+The ``columnar:`` / ``columnar_device:`` twin rows the benchmarks emit
+are the model's audit trail: ``accuracy()`` in bench.py replays routed
+calls against per-engine measurements and reports the fraction where the
+chosen engine was actually fastest.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+SCHEMA = "rb_tpu_columnar_costmodel/2"
+ENGINES = ("per-container", "columnar-cpu", "columnar-device")
+# class-mix buckets, cheapest-to-handle first; a pair's shape is the MAX
+# over the two operands' sampled hints (runs dominate bitmaps dominate
+# arrays — the per-container engine's per-pair cost rises in that order)
+SHAPES = ("array", "bitmap", "run")
+# coefficient tables are fit per OP GROUP: and/andnot share the gather/
+# merge class structure while or/xor word-expand every non-aa pair — one
+# "and"-only fit would misprice or/xor on bitmap mixes (the regression
+# zone) in exactly the direction the model exists to fix
+OP_GROUPS = ("and", "or")
+
+# calibration cells: (n_containers) grid per shape; two points fit
+# overhead + slope
+_CAL_COUNTS = (16, 64)
+_CAL_REPS = 3
+
+
+def op_group(op: str) -> str:
+    """The coefficient table an op prices against."""
+    return "or" if op in ("or", "xor") else "and"
+
+
+class CostModel:
+    """Per-call engine choice from measured per-engine cost curves.
+
+    Thread-safe: coefficients swap atomically under ``_lock``;
+    ``choose()`` reads a consistent snapshot reference without locking
+    (replacing the dict is atomic under the GIL)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.calibrated = False
+        self.backend: Optional[str] = None
+        # {op_group: {engine: {shape: [overhead_us, per_pair_us]}}}
+        self.coeffs: Dict[str, Dict[str, Dict[str, List[float]]]] = {}
+        # device extras: amortized host->HBM ship cost per row for a
+        # non-resident operand (the residency feature's price term)
+        self.ship_us_per_row: float = 0.0
+        self.fold_rows_min: Optional[int] = None  # None -> config default
+        self._device_checked = False
+        self._device_ok = False
+
+    # -- backend gate -------------------------------------------------------
+
+    def device_eligible(self) -> bool:
+        """Is the device tier worth pricing at all? Accelerator backends
+        only — probed once (jax import + backend query), cached."""
+        if not self._device_checked:
+            ok = False
+            try:
+                import jax
+
+                ok = jax.default_backend() != "cpu"
+            except (ImportError, RuntimeError):
+                ok = False
+            self._device_ok = ok
+            self._device_checked = True
+        return self._device_ok
+
+    # -- the decision -------------------------------------------------------
+
+    def choose(
+        self,
+        na: int,
+        nb: int,
+        shape: str,
+        resident,
+        allow_device: Optional[bool] = None,
+        op: str = "and",
+    ) -> Tuple[str, dict]:
+        """Pick the engine for an ``na x nb``-container pairwise ``op``
+        whose sampled class mix is ``shape``; ``resident`` = are the
+        operands' flat rows already PACK_CACHE-resident — a single bool
+        for both sides, or a ``(resident_a, resident_b)`` pair (a
+        resident 3000-row left operand carries no ship cost when only the
+        fresh 64-row right side ships). Returns ``(engine, inputs)`` —
+        inputs are the features + estimates the decision log records.
+
+        The argmin compares STEADY-STATE costs: the ship of a
+        non-resident operand is a one-time investment that establishes
+        residency for every later call (the PACK_CACHE policy every
+        resident pack in this repo follows — the agg path pays its cold
+        pack on first touch too), so a pending ship is surfaced in the
+        decision inputs (``ship_us``) but never prices the device tier
+        out of the verdict that would win warm — otherwise the tier could
+        be permanently unreachable (nothing else ever builds the rows).
+
+        Uncalibrated this is the r11 gate verbatim (count window + dense
+        hint, never device); calibrated it is an argmin over the measured
+        per-op-group cost curves."""
+        from . import engine as _engine
+
+        cfg = _engine.config
+        n = min(na, nb)
+        if isinstance(resident, tuple):
+            res_a, res_b = resident
+        else:
+            res_a = res_b = bool(resident)
+        ship_rows = (0 if res_a else na) + (0 if res_b else nb)
+        inputs = {
+            "na": na, "nb": nb, "shape": shape, "op": op,
+            "resident": bool(res_a and res_b),
+        }
+        if not self.calibrated:
+            inputs["model"] = "default-gate"
+            if not (
+                cfg.min_containers <= na <= cfg.max_containers
+                and cfg.min_containers <= nb <= cfg.max_containers
+            ):
+                return "per-container", inputs
+            if shape == "array":
+                return "per-container", inputs
+            return "columnar-cpu", inputs
+        if allow_device is None:
+            allow_device = self.device_eligible()
+        group = op_group(op)
+        table = self.coeffs.get(group) or next(iter(self.coeffs.values()), {})
+        costs = {}
+        for eng in ENGINES:
+            c = table.get(eng, {}).get(shape)
+            if c is None:
+                continue
+            if eng == "columnar-device" and not allow_device:
+                continue
+            costs[eng] = c[0] + n * c[1]
+        if not costs:  # calibration recorded nothing usable: r11 gate
+            with self._lock:
+                self.calibrated = False
+            return self.choose(na, nb, shape, resident, allow_device, op=op)
+        best = min(costs, key=costs.get)
+        inputs["model"] = "calibrated"
+        inputs["est_us"] = {k: round(v, 1) for k, v in costs.items()}
+        if best == "columnar-device" and ship_rows:
+            inputs["ship_us"] = round(self.ship_us_per_row * ship_rows, 1)
+        return best, inputs
+
+    def fold_gate_rows(self) -> int:
+        """The N-way fold row cutoff: measured when calibration ran, the
+        hand-tuned ``config.min_fold_rows`` otherwise."""
+        from . import engine as _engine
+
+        v = self.fold_rows_min
+        return int(v) if v else _engine.config.min_fold_rows
+
+    # -- persistence --------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return {
+            "schema": SCHEMA,
+            "backend": self.backend,
+            "calibrated": self.calibrated,
+            "coeffs": self.coeffs,
+            "ship_us_per_row": self.ship_us_per_row,
+            "fold_rows_min": self.fold_rows_min,
+        }
+
+    def save(self, path: str) -> None:
+        """Persist the calibration (atomic rename — a crashed writer must
+        not leave a torn JSON the next import then rejects)."""
+        tmp = f"{path}.tmp.{os.getpid()}"
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(self.to_dict(), f, indent=1)
+        os.replace(tmp, path)
+
+    def load(self, path: str) -> bool:
+        """Adopt a persisted calibration; False (and untouched state) on a
+        missing/invalid/foreign-backend file — the caller falls back to
+        measuring (or to the default gate)."""
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            return False
+        if d.get("schema") != SCHEMA or not d.get("calibrated"):
+            return False
+        try:
+            import jax
+
+            backend = jax.default_backend()
+        except (ImportError, RuntimeError):
+            backend = None
+        if d.get("backend") != backend:
+            return False  # coefficients are per-backend measurements
+        coeffs = d.get("coeffs")
+        if not isinstance(coeffs, dict) or not coeffs:
+            return False
+        with self._lock:
+            self.coeffs = coeffs
+            self.backend = d.get("backend")
+            self.ship_us_per_row = float(d.get("ship_us_per_row", 0.0))
+            self.fold_rows_min = d.get("fold_rows_min")
+            self.calibrated = True
+        return True
+
+    def reset(self) -> None:
+        """Back to the uncalibrated default gate (tests; also re-arms the
+        first-use calibration latch)."""
+        global _CAL_DONE, _ENSURED
+        with self._lock:
+            self.calibrated = False
+            self.coeffs = {}
+            self.backend = None
+            self.ship_us_per_row = 0.0
+            self.fold_rows_min = None
+        _CAL_DONE = False
+        _ENSURED = False
+
+
+MODEL = CostModel()
+
+_CAL_LOCK = threading.Lock()
+_CAL_DONE = False
+
+
+# ---------------------------------------------------------------------------
+# calibration: measure the real engines on synthetic working sets
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_pair(shape: str, n: int, rng):
+    """A matched n-container pair of the given class-mix bucket — BOTH
+    sides carry the same kind per chunk key, so the matched classes are
+    the type-homogeneous ones the shape hint predicts (aa, aa+bb, aa+rr):
+    the expensive columnar cases, not the cheap mismatched gathers. Inputs
+    mirror the fuzz corpus shapes (~300-value arrays, ~9k-value bitmaps,
+    run-optimized stripes)."""
+    from ..models.roaring import RoaringBitmap
+
+    def build() -> "RoaringBitmap":
+        vals = []
+        for k in range(n):
+            base = k << 16
+            if shape == "array" or (shape != "array" and k % 2):
+                v = np.sort(rng.choice(1 << 16, 300, replace=False))
+            elif shape == "bitmap":
+                v = np.sort(rng.choice(1 << 16, 9000, replace=False))
+            else:  # run stripes
+                starts = np.arange(0, 1 << 16, 1 << 12)[:14]
+                v = np.unique(
+                    np.concatenate([np.arange(s, s + 900) for s in starts])
+                )
+            vals.append((v + base).astype(np.uint32))
+        bm = RoaringBitmap(np.concatenate(vals))
+        bm.run_optimize()
+        return bm
+
+    return build(), build()
+
+
+def _time_us(fn, reps: int = _CAL_REPS) -> float:
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best * 1e6
+
+
+def calibrate(
+    include_device: Optional[bool] = None,
+    persist: Optional[str] = None,
+    seed: int = 0x10C0,
+) -> CostModel:
+    """Measure per-engine cost curves on synthetic pairs and install them
+    (idempotent per process unless ``MODEL.reset()`` ran). ~50-150 ms on
+    the CPU backend; the device cells additionally pay their one-time jit
+    compiles, which is why accelerator processes should persist
+    (``persist=`` path or ``RB_TPU_COLUMNAR_CAL``) and reload."""
+    global _CAL_DONE
+    with _CAL_LOCK:
+        if _CAL_DONE and MODEL.calibrated:
+            return MODEL
+        from . import device as _device
+        from . import engine as _engine
+        from ..models.roaring import RoaringBitmap
+
+        if include_device is None:
+            include_device = MODEL.device_eligible()
+        # a faulty device mid-calibration would silently install the
+        # ladder's CPU-fallback timings as device coefficients (bench
+        # guards its twin rows against exactly this mislabeling) — watch
+        # the columnar.device degrade edge and discard the device cells
+        # when it moved
+        from .. import observe as _observe
+
+        def _device_degrades() -> int:
+            m = _observe.REGISTRY.get(_observe.DEGRADE_TOTAL)
+            if m is None:
+                return 0
+            return m.series().get(
+                ("columnar.device", "columnar-device", "columnar-cpu"), 0
+            )
+
+        degrades_before = _device_degrades()
+        rng = np.random.default_rng(seed)
+        op_of = {"and": RoaringBitmap.and_, "or": RoaringBitmap.or_}
+        coeffs: Dict[str, Dict[str, Dict[str, List[float]]]] = {
+            g: {e: {} for e in ENGINES} for g in OP_GROUPS
+        }
+        ship_samples: List[float] = []
+        for shape in SHAPES:
+            cells: Dict[tuple, List[float]] = {
+                (g, e): [] for g in OP_GROUPS for e in ENGINES
+            }
+            for n in _CAL_COUNTS:
+                a, b = _synthetic_pair(shape, n, rng)
+                if include_device:
+                    # warm rows + compiles outside the timed regions: the
+                    # per-pair coefficients price the steady state, the
+                    # ship term prices residency separately
+                    t0 = time.perf_counter()
+                    _device.rows_for(a)
+                    _device.rows_for(b)
+                    ship_samples.append(
+                        (time.perf_counter() - t0) * 1e6 / (2 * n)
+                    )
+                for group in OP_GROUPS:
+                    ref = op_of[group]
+                    with _engine.disabled():
+                        cells[(group, "per-container")].append(
+                            _time_us(lambda: ref(a, b))
+                        )
+                    cells[(group, "columnar-cpu")].append(
+                        _time_us(
+                            lambda: _engine.pairwise(group, a, b, tier="cpu")
+                        )
+                    )
+                    if include_device:
+                        _engine.pairwise(group, a, b, tier="device")  # compile
+                        cells[(group, "columnar-device")].append(
+                            _time_us(
+                                lambda: _engine.pairwise(
+                                    group, a, b, tier="device"
+                                )
+                            )
+                        )
+            for (group, eng), ts in cells.items():
+                if len(ts) < 2:
+                    continue
+                n0, n1 = _CAL_COUNTS[0], _CAL_COUNTS[-1]
+                slope = max(0.0, (ts[-1] - ts[0]) / (n1 - n0))
+                overhead = max(0.0, ts[0] - slope * n0)
+                coeffs[group][eng][shape] = [round(overhead, 2), round(slope, 3)]
+        # fold threshold: smallest row count where the columnar fold beats
+        # the per-container fold on the run-mix shape (where it wins most;
+        # array-only folds are priced by the same curves)
+        if include_device and _device_degrades() != degrades_before:
+            # at least one "device" cell actually timed the CPU fallback:
+            # the device column is poisoned — calibrate the CPU engines
+            # only (no device coefficients = the tier is never chosen
+            # until a later healthy calibration re-prices it)
+            for engines in coeffs.values():
+                engines.pop("columnar-device", None)
+            ship_samples = []
+        fold_min = _calibrate_fold(rng)
+        with MODEL._lock:
+            MODEL.coeffs = {
+                g: {e: s for e, s in engines.items() if s}
+                for g, engines in coeffs.items()
+                if any(engines.values())
+            }
+            MODEL.ship_us_per_row = (
+                round(float(np.median(ship_samples)), 3) if ship_samples else 0.0
+            )
+            MODEL.fold_rows_min = fold_min
+            try:
+                import jax
+
+                MODEL.backend = jax.default_backend()
+            except (ImportError, RuntimeError):
+                MODEL.backend = None
+            MODEL.calibrated = True
+        _CAL_DONE = True
+        path = persist if persist is not None else os.environ.get(
+            "RB_TPU_COLUMNAR_CAL"
+        )
+        if path:
+            try:
+                MODEL.save(path)
+            except OSError:
+                pass  # read-only FS: run-local calibration still applies
+        return MODEL
+
+
+def _calibrate_fold(rng) -> Optional[int]:
+    """Measured fold cutoff: time the columnar vs per-container OR folds
+    at two row counts, return the crossover clamped to [16, 512] (None —
+    keep the config default — when columnar never wins)."""
+    from . import engine as _engine
+    from ..parallel import store
+
+    def groups_of(rows: int):
+        from ..models.roaring import RoaringBitmap
+
+        per_bm = 8
+        bms = []
+        for i in range(max(2, rows // per_bm)):
+            v = np.concatenate(
+                [
+                    (np.arange(k << 16, (k << 16) + 64, 2))
+                    for k in range(per_bm)
+                ]
+            ).astype(np.uint32)
+            bm = RoaringBitmap(v + (i % 3))
+            bm.run_optimize()
+            bms.append(bm)
+        return store.group_by_key(bms)
+
+    samples = []
+    for rows in (32, 128):
+        g = groups_of(rows)
+        n = sum(len(cs) for cs in g.values())
+        col = _time_us(lambda: _engine.fold(g, "or"))
+        from ..parallel.aggregation import _percontainer_aggregate
+
+        pc = _time_us(lambda: _percontainer_aggregate(g, "or"))
+        samples.append((n, col, pc))
+    wins = [n for n, col, pc in samples if col < pc]
+    if not wins:
+        return None
+    return int(max(16, min(512, min(wins))))
+
+
+_ENSURED = False  # first-use latch: route() calls this per routed op
+
+
+def ensure_calibrated() -> CostModel:
+    """First-use hook: on accelerator backends, adopt the persisted
+    calibration (``RB_TPU_COLUMNAR_CAL``) or measure one now — the device
+    tier must be priced before the first routed call. On CPU-only hosts
+    this resolves to the default gate (the r11 behavior) and latches, so
+    the steady-state cost on the routed path is one bool check."""
+    global _ENSURED
+    if _ENSURED or MODEL.calibrated:
+        return MODEL
+    path = os.environ.get("RB_TPU_COLUMNAR_CAL")
+    if path and MODEL.load(path):
+        _ENSURED = True
+        return MODEL
+    _ENSURED = True
+    if MODEL.device_eligible():
+        return calibrate()
+    return MODEL

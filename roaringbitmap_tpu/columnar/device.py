@@ -1,0 +1,228 @@
+"""Device execution tier for the columnar pairwise engine (ISSUE 10
+tentpole).
+
+The CPU columnar engine (engine.py) proved that batching the 9-class
+container algebra beats per-container dispatch — but its stacked
+``[n, 1024]`` word matrices are exactly the flat-row pack layout the
+device engines already eat (uint32 ``[n, 2048]``, ops/device.py). This
+module feeds the same 9-class type partition from **PACK_CACHE-resident
+flat rows** (one row per container, built by the ISSUE-8 device-side
+expansion via ``store.ship_rows``) and runs the word-parallel classes on
+the accelerator:
+
+* **dense classes** — ``bb`` plus every class the CPU engine serves with
+  word matrices (`br`/`rb` for and/andnot, all non-`aa` classes for
+  or/xor, `ba` under andnot): ONE fused jit dispatch per bucket gathers
+  both sides' rows from the resident blocks, applies the bitwise op, and
+  popcounts every row (``pallas_kernels.pair_rows_reduce``) — the
+  popcount-rows pass IS the batched format selection, so the host builds
+  containers card-driven without re-counting;
+* **array x bitmap** — an on-device word-test gather
+  (``ops.device.word_test_rows``): every probe value of every pair tests
+  against the resident bitmap rows in one dispatch, and only the boolean
+  mask returns to the host (bytes ~ probe values, never 8 KiB rows);
+* **array x array and the bitmap-free run classes** stay on the CPU
+  tiers (``engine._fill_nonbm`` — the native run-unified merge / banded
+  numpy): their payloads are value-sized, their per-container C floor is
+  ~2 µs, and word-expanding them on device would manufacture the very
+  work the run representation avoids.
+
+The result merge re-assembles containers by the reference size rule
+exactly like the CPU engine (shared ``engine.pairwise`` assembly; device
+buckets emit array-or-bitmap by cardinality, the CPU buckets keep
+run-shaped results compressed).
+
+Residency: each operand's flat rows live in ``store.PACK_CACHE`` under
+``("colrows", fingerprint)`` — op-independent, shared across every pair
+and op touching that bitmap, delta-invalidated by the fingerprint like
+every other pack. The cost model (costmodel.py) reads the same residency
+bit to price the ship.
+
+Degradation: the ``columnar.device`` fault site fires before any device
+work; a non-fatal failure rides the ladder down to the columnar-CPU tier
+(bit-exact by construction — both tiers feed the same partition and the
+same assembly).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..robust import faults as _faults
+from ..models.container import Container
+from . import engine as _engine
+from .partition import ARRAY, BITMAP, classify
+
+
+def _colrows_key(hlc) -> tuple:
+    """THE cache-key spelling for a bitmap's resident flat rows — builder
+    and residency probes all call this one function, so the key layout
+    can never drift between what ``rows_for`` stores and what the router
+    probes (the silent always-non-resident failure mode)."""
+    from ..models.roaring import hlc_fingerprint
+
+    return ("colrows", hlc_fingerprint(hlc))
+
+
+def rows_for(bm):
+    """The bitmap's containers as PACK_CACHE-resident flat device rows
+    (uint32 [n_rows, 2048]), keyed by fingerprint — built once via the
+    device-side expansion (``store.ship_rows``), then every pairwise op
+    over this bitmap gathers from the resident block. The container list
+    pads to a pow2 row count with empty array containers (zero rows), so
+    the device kernels' row-block operand shapes stay retrace-bounded
+    like their index streams — heterogeneous corpora would otherwise
+    compile one executable per distinct (na, nb) pair."""
+    import numpy as _np
+
+    from ..models.container import ArrayContainer
+    from ..ops import device as dev
+    from ..parallel import store
+
+    key = _colrows_key(bm.high_low_container)
+
+    def build():
+        conts = list(bm.high_low_container.containers)
+        pad = dev.pow2(len(conts)) - len(conts)
+        if pad > 0:
+            empty = _np.empty(0, dtype=_np.uint16)
+            conts.extend(ArrayContainer(empty) for _ in range(pad))
+        d = store.ship_rows(conts)
+        return d, int(d.nbytes)
+
+    return store.PACK_CACHE.get_or_build(
+        key, build, refs=store.static_fp_refs([bm])
+    )
+
+
+def rows_resident_hlc(hlc) -> bool:
+    """Cheap residency probe (decision provenance): are this high-low
+    container's flat rows already in PACK_CACHE? One dict lookup under
+    the cache lock — never builds."""
+    from ..parallel import store
+
+    return _colrows_key(hlc) in store.PACK_CACHE
+
+
+def rows_resident(bm) -> bool:
+    return rows_resident_hlc(bm.high_low_container)
+
+
+def _build_rows_results(
+    words_u32: np.ndarray, cards: np.ndarray, idx: np.ndarray, results
+) -> None:
+    """Card-driven container build from fetched device rows — the device
+    popcount already selected every format, and the array-vs-bitmap rule
+    is the engine's shared loop (one copy, tiers can never drift)."""
+    words64 = np.ascontiguousarray(words_u32).view(np.uint64)
+    _engine._format_rows_results(words64, cards.tolist(), idx.tolist(), results)
+
+
+def _fill_dense_device(
+    op: str, rows_a, ia: np.ndarray, rows_b, ib: np.ndarray,
+    idx: np.ndarray, results, pending_incs: list,
+) -> None:
+    """Word-parallel classes on device: one fused gather+op+popcount
+    dispatch over the resident flat rows (pow2-padded index streams bound
+    retraces; pad rows popcount to 0 and are sliced off)."""
+    if idx.size == 0:
+        return
+    from ..ops import pallas_kernels as pk
+
+    with _engine._kernel_stage(op, "device_pair", int(idx.size)):
+        words, cards = pk.pair_rows_reduce(
+            rows_a, ia[idx], rows_b, ib[idx], op
+        )
+        _build_rows_results(words, cards, idx, results)
+    pending_incs.append((int(idx.size), (op, "device_pair")))
+
+
+def _fill_gather_device(
+    op: str, probe_cs: Sequence[Container], rows_dense, dense_take: np.ndarray,
+    idx: np.ndarray, results, pending_incs: list,
+) -> None:
+    """array x bitmap on device: the whole bucket's membership probes run
+    as one word-test gather against the resident rows; only the boolean
+    mask transfers back, and the host keeps/drops values exactly like the
+    CPU gather class."""
+    if idx.size == 0:
+        return
+    from ..ops import device as dev
+    from .partition import gather_values
+
+    pending_incs.append((int(idx.size), (op, "device_gather")))
+    with _engine._kernel_stage(op, "device_gather", int(idx.size)):
+        vals, offs = gather_values(probe_cs, idx)
+        if vals.size == 0:
+            return
+        row_ids = np.repeat(dense_take[idx], np.diff(offs))
+        mask = dev.word_test_rows_host(rows_dense, row_ids, vals)
+        _engine._build_gather_results(op, vals, offs, mask, idx, results)
+
+
+def matched_results_device(
+    op: str,
+    acs: Sequence[Container],
+    bcs: Sequence[Container],
+    ia: np.ndarray,
+    ib: np.ndarray,
+    rows_a,
+    rows_b,
+) -> List[Optional[Container]]:
+    """Per-class execution with the word-parallel buckets on device —
+    the device twin of ``engine._matched_results``. ``ia``/``ib`` map
+    matched pair i to its row in the operands' resident flat blocks."""
+    n = len(acs)
+    results: List[Optional[Container]] = [None] * n
+    if n == 0:
+        return results
+    _faults.fault_point("columnar.device")
+    codes_a = classify(acs)
+    codes_b = classify(bcs)
+    hist = _engine.class_histogram(codes_a, codes_b)
+    # metric increments (class counts + device-bucket series) flush only
+    # after EVERY bucket succeeded: a non-fatal failure reruns the whole
+    # pair on the CPU tier, whose _record would otherwise double-count
+    pending_incs: list = []
+    a_arr = codes_a == ARRAY
+    b_arr = codes_b == ARRAY
+    a_bm = codes_a == BITMAP
+    b_bm = codes_b == BITMAP
+    if op in ("and", "andnot"):
+        # bitmap-free classes (aa/ar/ra/rr): the CPU tiers own these — the
+        # native run-unified merge keeps run results compressed and the
+        # value-sized payloads never justify 8 KiB device rows
+        _engine._fill_nonbm(
+            op, acs, bcs, codes_a, codes_b, hist, results
+        )
+        _fill_gather_device(
+            op, acs, rows_b, ib, np.flatnonzero(a_arr & b_bm), results,
+            pending_incs,
+        )
+        if op == "and":
+            _fill_gather_device(
+                op, bcs, rows_a, ia, np.flatnonzero(b_arr & a_bm), results,
+                pending_incs,
+            )
+            dense = np.flatnonzero((a_bm & ~b_arr) | (~a_arr & b_bm))
+        else:
+            # ba under andnot rides the dense op too: b's array container
+            # is a word row in the resident block, so a & ~b is one fused
+            # dispatch instead of the CPU tier's expand + scatter-clear
+            dense = np.flatnonzero(
+                (a_bm & ~b_arr) | (~a_arr & b_bm) | (a_bm & b_arr)
+            )
+        _fill_dense_device(op, rows_a, ia, rows_b, ib, dense, results,
+                           pending_incs)
+    else:  # or / xor: aa stays on the CSR batch kernel, the rest is dense
+        _engine._fill_aa(op, acs, bcs, np.flatnonzero(a_arr & b_arr), results)
+        _fill_dense_device(
+            op, rows_a, ia, rows_b, ib, np.flatnonzero(~(a_arr & b_arr)),
+            results, pending_incs,
+        )
+    _engine._inc_classes(op, hist)
+    for n_inc, labels in pending_incs:
+        _engine._COLUMNAR_TOTAL.inc(n_inc, labels=labels)
+    return results

@@ -45,6 +45,7 @@ from ..models.container import (
 )
 from ..models.roaring import RoaringBitmap
 from ..utils import bits
+from . import costmodel as _costmodel
 from . import kernels
 from .keyplan import key_plan
 from .partition import (
@@ -84,6 +85,10 @@ class config:
     # row budget for the chunked dense-class batches: bounds peak matrix
     # memory at ~3 * 8 KiB * chunk_rows while keeping full vectorization
     chunk_rows: int = 4096
+    # tests/bench only: let the calibrated cost model pick the device tier
+    # on the CPU backend too (where "HBM" is host memory and the tier is
+    # normally priced out of eligibility entirely)
+    force_device: bool = False
 
 
 _COLUMNAR_TOTAL = _observe.counter(
@@ -153,61 +158,161 @@ def _routing_on() -> bool:
     return config.enabled and not getattr(_TLS, "depth", 0)
 
 
-def _dense_hint(hlc) -> bool:
-    """Sampled type probe (<= 8 containers): does this operand hold run or
-    bitmap containers? Array-only pairs stay per-container — their scalar
-    ops already sit at the C-kernel floor (~2 µs), and no gather can beat
-    a floor it must first pay to assemble. Runs/bitmaps are where the
-    per-container engine spends 5-50 µs each, i.e. where batching pays."""
+_SHAPE_RANK = {ArrayContainer: 0, BitmapContainer: 1, RunContainer: 2}
+
+
+def _shape_hint(hlc) -> str:
+    """Sampled type probe (<= 8 containers): the operand's class-mix
+    bucket for the cost model — ``run`` > ``bitmap`` > ``array`` by which
+    kinds the sample saw. Array-only pairs stay per-container — their
+    scalar ops already sit at the C-kernel floor (~2 µs), and no gather
+    can beat a floor it must first pay to assemble. Runs are where the
+    per-container engine spends 5-50 µs each (batching pays most);
+    bitmap-heavy mixes are the r12 regression zone the model prices
+    separately."""
     conts = hlc.containers
     n = len(conts)
     step = max(1, n // 8)
+    rank = 0
     for i in range(0, n, step):
-        if type(conts[i]) is not ArrayContainer:
-            return True
-    return False
+        c = conts[i]
+        r = _SHAPE_RANK.get(type(c))
+        if r is None:
+            # exotic subclass: runs rank "run", anything else ranks
+            # "bitmap" — exactly the r11 dense hint's exact-type check
+            # (``type(c) is not ArrayContainer`` counted it dense), so
+            # the uncalibrated gate stays r11-verbatim
+            r = 2 if isinstance(c, RunContainer) else 1
+        if r == 2:
+            return "run"
+        if r > rank:
+            rank = r
+    return _costmodel.SHAPES[rank]
+
+
+_ROUTE_TOTAL = _observe.counter(
+    _observe.COLUMNAR_ROUTE_TOTAL,
+    "Columnar cutoff-model verdicts by chosen engine tier",
+    ("tier",),
+)
+# declared tier label values (the metric-naming rule rejects computed
+# label values — the router's verdict set is a frozen enumeration)
+_TIER_LABELS = {
+    "per-container": "per-container",
+    "columnar-cpu": "columnar-cpu",
+    "columnar-device": "columnar-device",
+}
+# route() verdict -> pairwise tier argument (identity for "cpu"/"device";
+# "columnar-cpu" routes the host batch engine, "columnar-device" the
+# accelerator tier)
+_TIER_ARG = {"columnar-cpu": "cpu", "columnar-device": "device"}
+
+# 1-in-64 sampling of below-gate verdicts (ISSUE 10 satellite): the
+# sub-gate branch sits at the per-container C floor and must not pay a
+# record per call, but never recording it starved the cost model of
+# calibration data from exactly the small-operand regression zone
+_BELOW_GATE = _decisions.SampledSite(64)
+
+
+def route(
+    a_hlc, b_hlc, record: bool = True, allow_device: bool = True,
+    op: str = "and",
+) -> str:
+    """Three-way engine verdict for one pairwise ``op``:
+    ``per-container`` / ``columnar-cpu`` / ``columnar-device``, from
+    operand counts, the sampled class-mix shape, and per-side pack
+    residency (costmodel.choose prices against the op-group coefficient
+    table — and/andnot vs or/xor cost shapes differ materially;
+    uncalibrated it reproduces the r11 hand-tuned gate verbatim).
+    ``allow_device=False`` clamps the verdict to the CPU engines — the
+    cardinality facades use it, because the count-only kernels have no
+    device tier and their provenance must never claim one.
+
+    Decision provenance (ISSUE 9/10): full verdicts record above the
+    count gate, where the op costs tens of microseconds; below it the
+    per-container walk sits at its ~2 µs C floor and pays one int
+    compare, with a 1-in-N sampled record keeping the regression zone
+    visible to the calibration data."""
+    if not _routing_on():
+        return "per-container"
+    na, nb = a_hlc.size, b_hlc.size
+    if (
+        na < config.min_containers
+        or nb < config.min_containers
+        or na > config.max_containers
+        or nb > config.max_containers
+    ):
+        # outside the measured window the r07 floor argument stands in
+        # BOTH model modes: below it the per-container C floor wins, and
+        # above the cap the calibrated two-point fit (n=16..64 cells)
+        # must not extrapolate 100x past its data — the jmh 10k-container
+        # grids stay per-container by construction, at one compare per
+        # call plus the 1-in-N sampled record
+        if record and _BELOW_GATE.tick():
+            _decisions.record_decision(
+                "columnar.cutoff", "per-container", reason="outside-gate",
+                sampled=_BELOW_GATE.every, na=na, nb=nb,
+            )
+        return "per-container"
+    if allow_device and _ladder.deadline_expired():
+        # an expired per-query budget never starts a device attempt — and
+        # that includes first-use CALIBRATION, whose device cells pay jit
+        # compiles: check the deadline BEFORE ensure_calibrated, use
+        # whatever model state exists (the CPU tiers are the cheapest
+        # continuation, query-kernel parity)
+        allow_device = False
+    model = (
+        _costmodel.MODEL if not allow_device else _costmodel.ensure_calibrated()
+    )
+    shape_a = _shape_hint(a_hlc)
+    shape_b = _shape_hint(b_hlc)
+    shape = max(shape_a, shape_b, key=_costmodel.SHAPES.index)
+    device_arg = None if allow_device else False
+    resident = (False, False)
+    if allow_device and model.calibrated and (
+        model.device_eligible() or config.force_device
+    ):
+        if config.force_device:
+            device_arg = True
+        if record:
+            from . import device as _device_tier
+
+            # per-side probes (decision provenance only — the verdict
+            # compares steady-state costs, see costmodel.choose): skipped
+            # on the record=False re-derivations, which never log
+            resident = (
+                _device_tier.rows_resident_hlc(a_hlc),
+                _device_tier.rows_resident_hlc(b_hlc),
+            )
+    tier, inputs = model.choose(na, nb, shape, resident, device_arg, op=op)
+    if record:
+        _ROUTE_TOTAL.inc(1, (_TIER_LABELS[tier],))
+        _decisions.record_decision("columnar.cutoff", tier, **inputs)
+    return tier
 
 
 def enabled_for(a_hlc, b_hlc) -> bool:
-    """Route this pair columnar? Cheap pre-plan gate: container counts in
-    [min_containers, max_containers] on BOTH sides plus a sampled
-    dense-shape hint on either side.
-
-    Decision provenance (ISSUE 9): verdicts record into the decision log
-    only once the count gate passes — above it the op costs tens of
-    microseconds and a record is noise-free signal; below it the
-    per-container walk sits at its ~2 µs C floor and must not pay even a
-    deque append (the jmh small-operand grids pin that floor)."""
-    if not _routing_on():
-        return False
-    na, nb = a_hlc.size, b_hlc.size
-    if not (
-        na >= config.min_containers
-        and nb >= config.min_containers
-        and na <= config.max_containers
-        and nb <= config.max_containers
-    ):
-        return False
-    if _dense_hint(a_hlc) or _dense_hint(b_hlc):
-        _decisions.record_decision(
-            "columnar.cutoff", "columnar", reason="dense-hint", na=na, nb=nb
-        )
-        return True
-    _decisions.record_decision(
-        "columnar.cutoff", "per-container", reason="array-only", na=na, nb=nb
-    )
-    return False
+    """Does this pair leave the per-container walk? The CARDINALITY
+    facades' gate (and_cardinality/intersects): their batched kernels are
+    CPU-only, so the verdict is computed — and recorded — with the device
+    tier excluded; the materializing facades call :func:`route` directly
+    and pass the three-way verdict into ``pairwise``."""
+    return route(a_hlc, b_hlc, allow_device=False) != "per-container"
 
 
 def enabled_for_fold(n_rows: int) -> bool:
-    """Route an N-way fold through the columnar batch engine? One verdict
-    per fold (milliseconds of work), so both outcomes record."""
+    """Route an N-way fold through the columnar batch engine? Gate is the
+    measured fold cutoff when the cost model calibrated one, the config
+    default otherwise. One verdict per fold (milliseconds of work), so
+    both outcomes record."""
     if not _routing_on():
         return False
-    verdict = n_rows >= config.min_fold_rows
+    gate = _costmodel.MODEL.fold_gate_rows()
+    verdict = n_rows >= gate
     _decisions.record_decision(
         "columnar.cutoff", "columnar-fold" if verdict else "per-container-fold",
-        rows=n_rows, min_fold_rows=config.min_fold_rows,
+        rows=n_rows, min_fold_rows=gate,
+        model="calibrated" if _costmodel.MODEL.fold_rows_min else "default",
     )
     return verdict
 
@@ -217,10 +322,22 @@ def enabled_for_fold(n_rows: int) -> bool:
 _FOLD_LABELS = {"or": "fold_or", "xor": "fold_xor", "and": "fold_and"}
 
 
-def _record(op: str, codes_a: np.ndarray, codes_b: np.ndarray) -> None:
-    hist = class_histogram(codes_a, codes_b)
+def _inc_classes(op: str, hist: np.ndarray) -> None:
+    """Count a completed batch into the per-class metric. The device tier
+    calls this only AFTER every bucket succeeded — a non-fatal device
+    failure reruns the whole pair on the CPU tier, and counting at entry
+    would double every degraded pair's classes."""
     for ci in np.flatnonzero(hist).tolist():
         _COLUMNAR_TOTAL.inc(int(hist[ci]), labels=(op, CLASS_NAMES[ci]))
+
+
+def _record(op: str, codes_a: np.ndarray, codes_b: np.ndarray) -> np.ndarray:
+    """Count the batch into the per-class metric; returns the 9-class
+    histogram so callers skip the mask + CSR build for zero-pair classes
+    (ISSUE 10 satellite: measured fixed cost at 16-64-pair sizes)."""
+    hist = class_histogram(codes_a, codes_b)
+    _inc_classes(op, hist)
+    return hist
 
 
 # ---------------------------------------------------------------------------
@@ -267,15 +384,14 @@ def _gather_mask(probe_cs, dense_cs, idx: np.ndarray, dense_is_run: bool):
     return vals, offs, kernels.member_mask(rows_mat, row_ids, vals)
 
 
-@_timed_fill("gather", 3)
-def _fill_gather(
-    op: str, probe_cs, dense_cs, idx: np.ndarray, results, dense_is_run: bool
+def _build_gather_results(
+    op: str, vals: np.ndarray, offs: np.ndarray, mask: np.ndarray,
+    idx: np.ndarray, results,
 ) -> None:
-    """array x dense (and/andnot): membership gather; results stay
-    arrays by construction."""
-    if idx.size == 0:
-        return
-    vals, offs, mask = _gather_mask(probe_cs, dense_cs, idx, dense_is_run)
+    """Shared tail of the membership-gather classes (CPU word-test and
+    the device tier's on-device word-test): keep the member (and) or
+    non-member (andnot) probe values per pair; results stay arrays by
+    construction."""
     if op == "andnot":
         mask = ~mask
     row_ids = np.repeat(np.arange(idx.size, dtype=np.int64), np.diff(offs))
@@ -288,6 +404,18 @@ def _fill_gather(
         if n:
             s = starts_l[j]
             results[i] = _wrap_u16(kept[s : s + n].copy())
+
+
+@_timed_fill("gather", 3)
+def _fill_gather(
+    op: str, probe_cs, dense_cs, idx: np.ndarray, results, dense_is_run: bool
+) -> None:
+    """array x dense (and/andnot): membership gather; results stay
+    arrays by construction."""
+    if idx.size == 0:
+        return
+    vals, offs, mask = _gather_mask(probe_cs, dense_cs, idx, dense_is_run)
+    _build_gather_results(op, vals, offs, mask, idx, results)
 
 
 @_timed_fill("runs", 3)
@@ -372,20 +500,29 @@ def _fill_interval(op: str, acs, bcs, idx: np.ndarray, results) -> None:
         results[i] = _container_of_intervals(out_s[s : s + n], out_e[s : s + n])
 
 
+def _format_rows_results(
+    words64: np.ndarray, cards: List[int], idx: List[int], results
+) -> None:
+    """The card-driven array-vs-bitmap result-format rule, shared by the
+    CPU word-matrix classes and the device tier (whose popcounts arrive
+    precomputed from the fused dispatch) — ONE copy of the threshold so
+    the tiers' container formats can never drift."""
+    for j, i in enumerate(idx):
+        card = cards[j]
+        if card == 0:
+            continue
+        if card <= ARRAY_MAX_SIZE:
+            results[i] = _wrap_u16(bits.values_from_words(words64[j]))
+        else:
+            results[i] = BitmapContainer(words64[j].copy(), card)
+
+
 def _build_words_results(
     mat: np.ndarray, idx_chunk: List[int], results
 ) -> None:
     """Batched format selection over a result word matrix: one popcount
     pass decides array-vs-bitmap for the whole chunk."""
-    cards = kernels.popcount_rows(mat).tolist()
-    for j, i in enumerate(idx_chunk):
-        card = cards[j]
-        if card == 0:
-            continue
-        if card <= ARRAY_MAX_SIZE:
-            results[i] = _wrap_u16(bits.values_from_words(mat[j]))
-        else:
-            results[i] = BitmapContainer(mat[j].copy(), card)
+    _format_rows_results(mat, kernels.popcount_rows(mat).tolist(), idx_chunk, results)
 
 
 @_timed_fill("dense", 3)
@@ -438,6 +575,60 @@ def _fill_clear(acs, bcs, idx: np.ndarray, results) -> None:
         _build_words_results(mat, chunk.tolist(), results)
 
 
+def _fill_nonbm(
+    op: str,
+    acs: Sequence[Container],
+    bcs: Sequence[Container],
+    codes_a: np.ndarray,
+    codes_b: np.ndarray,
+    hist: np.ndarray,
+    results: List[Optional[Container]],
+) -> None:
+    """All bitmap-free classes of and/andnot (aa/ar/ra/rr) — shared by the
+    CPU and device tiers (the device tier keeps these on the CPU: their
+    payloads are value-sized and the run-unified merge keeps run-shaped
+    results compressed). A pure array x array bucket skips the
+    run-unification gather entirely and rides the CSR values kernel
+    (ISSUE 10 satellite: the per-container python loop in
+    ``gather_intervals`` was a measured fixed cost at 16-64-pair sizes)."""
+    n_aa = int(hist[0])
+    n_runish = int(hist[2] + hist[6] + hist[8])  # ar + ra + rr
+    if not n_aa and not n_runish:
+        return
+    a_arr = codes_a == ARRAY
+    b_arr = codes_b == ARRAY
+    if not n_runish:
+        _fill_aa(op, acs, bcs, np.flatnonzero(a_arr & b_arr), results)
+        return
+    a_bm = codes_a == BITMAP
+    b_bm = codes_b == BITMAP
+    nonbm = np.flatnonzero(~a_bm & ~b_bm)
+    if kernels.has_native():
+        # one run-unified native call serves every bitmap-free class;
+        # a non-fatal failure (injected or real) classifies and the
+        # whole bucket re-runs on the numpy tiers below (ISSUE 7)
+        try:
+            _fill_runs_native(op, acs, bcs, nonbm, results)
+            return
+        except Exception as e:
+            if _rerrors.classify(e) == _rerrors.FATAL:
+                raise
+            _ladder.LADDER.note_degrade("columnar.kernel", "native", "numpy", e)
+            for i in nonbm.tolist():  # drop any partial native writes
+                results[i] = None
+    a_run = ~a_arr & ~a_bm
+    b_run = ~b_arr & ~b_bm
+    _fill_aa(op, acs, bcs, np.flatnonzero(a_arr & b_arr), results)
+    # banded run probes for the array x run directions
+    _fill_gather(op, acs, bcs, np.flatnonzero(a_arr & b_run), results, True)
+    if op == "and":
+        _fill_gather(op, bcs, acs, np.flatnonzero(b_arr & a_run), results, True)
+        iv = np.flatnonzero(a_run & b_run)  # rr
+    else:
+        iv = np.flatnonzero(a_run & ~b_bm)  # rr + ra
+    _fill_interval(op, acs, bcs, iv, results)
+
+
 def _matched_results(
     op: str, acs: Sequence[Container], bcs: Sequence[Container]
 ) -> List[Optional[Container]]:
@@ -447,54 +638,34 @@ def _matched_results(
         return results
     codes_a = classify(acs)
     codes_b = classify(bcs)
-    _record(op, codes_a, codes_b)
+    hist = _record(op, codes_a, codes_b)
     a_arr = codes_a == ARRAY
     b_arr = codes_b == ARRAY
     if op in ("and", "andnot"):
         a_bm = codes_a == BITMAP
         b_bm = codes_b == BITMAP
-        nonbm = np.flatnonzero(~a_bm & ~b_bm)
-        native_done = False
-        if kernels.has_native():
-            # one run-unified native call serves every bitmap-free class;
-            # a non-fatal failure (injected or real) classifies and the
-            # whole bucket re-runs on the numpy tiers below (ISSUE 7)
-            try:
-                _fill_runs_native(op, acs, bcs, nonbm, results)
-                native_done = True
-            except Exception as e:
-                if _rerrors.classify(e) == _rerrors.FATAL:
-                    raise
-                _ladder.LADDER.note_degrade("columnar.kernel", "native", "numpy", e)
-                for i in nonbm.tolist():  # drop any partial native writes
-                    results[i] = None
-        if not native_done:
-            a_run = ~a_arr & ~a_bm
-            b_run = ~b_arr & ~b_bm
-            _fill_aa(op, acs, bcs, np.flatnonzero(a_arr & b_arr), results)
-            # banded run probes for the array x run directions
-            _fill_gather(op, acs, bcs, np.flatnonzero(a_arr & b_run), results, True)
+        _fill_nonbm(op, acs, bcs, codes_a, codes_b, hist, results)
+        # hist-guarded class masks: a zero-pair class pays no flatnonzero,
+        # no wrapper call, no CSR build (the 16-64-pair fixed-cost trim)
+        if hist[1]:  # ab: array probe vs stacked bitmap words
+            _fill_gather(op, acs, bcs, np.flatnonzero(a_arr & b_bm), results, False)
+        if hist[3]:  # ba
             if op == "and":
-                _fill_gather(op, bcs, acs, np.flatnonzero(b_arr & a_run), results, True)
-                iv = np.flatnonzero(a_run & b_run)  # rr
+                _fill_gather(op, bcs, acs, np.flatnonzero(b_arr & a_bm), results, False)
             else:
-                iv = np.flatnonzero(a_run & ~b_bm)  # rr + ra
-            _fill_interval(op, acs, bcs, iv, results)
-        # ab: array probe vs stacked bitmap words
-        _fill_gather(op, acs, bcs, np.flatnonzero(a_arr & b_bm), results, False)
-        if op == "and":
-            _fill_gather(op, bcs, acs, np.flatnonzero(b_arr & a_bm), results, False)
-        else:
-            # ba under andnot: expand a, scatter-CLEAR b's values
-            _fill_clear(acs, bcs, np.flatnonzero(a_bm & b_arr), results)
-        # bb / br / rb: at least one bitmap, no array side -> word matrices
-        _fill_dense(
-            op, acs, bcs,
-            np.flatnonzero((a_bm & ~b_arr) | (~a_arr & b_bm)), results,
-        )
+                # ba under andnot: expand a, scatter-CLEAR b's values
+                _fill_clear(acs, bcs, np.flatnonzero(a_bm & b_arr), results)
+        if hist[4] or hist[5] or hist[7]:
+            # bb / br / rb: at least one bitmap, no array side -> word matrices
+            _fill_dense(
+                op, acs, bcs,
+                np.flatnonzero((a_bm & ~b_arr) | (~a_arr & b_bm)), results,
+            )
     else:  # or / xor
-        _fill_aa(op, acs, bcs, np.flatnonzero(a_arr & b_arr), results)
-        _fill_dense(op, acs, bcs, np.flatnonzero(~(a_arr & b_arr)), results)
+        if hist[0]:
+            _fill_aa(op, acs, bcs, np.flatnonzero(a_arr & b_arr), results)
+        if int(hist.sum()) > int(hist[0]):
+            _fill_dense(op, acs, bcs, np.flatnonzero(~(a_arr & b_arr)), results)
     return results
 
 
@@ -504,18 +675,59 @@ def _matched_results(
 
 
 def pairwise(
-    op: str, x1: RoaringBitmap, x2: RoaringBitmap, reuse_left: bool = False
+    op: str,
+    x1: RoaringBitmap,
+    x2: RoaringBitmap,
+    reuse_left: bool = False,
+    tier: Optional[str] = None,
 ) -> RoaringBitmap:
     """Whole-pair ``x1 OP x2`` through the batched engine. ``reuse_left``
     transfers x1's pass-through containers unclone'd — ONLY for the
     in-place facades (ior/ixor/iandnot), which discard x1's old index:
-    the member-op semantics win, now uniform across all four ops."""
+    the member-op semantics win, now uniform across all four ops.
+
+    ``tier``: ``"cpu"`` (the host batch engine), ``"device"`` (the
+    PACK_CACHE-fed accelerator tier, ISSUE 10), a ``route()`` verdict
+    (``"columnar-cpu"``/``"columnar-device"`` — the facades pass their
+    single routing verdict straight through, no second route), or None —
+    consult the cost model, with a direct call defaulting to the CPU
+    tier exactly as before the device tier existed. A device run rides
+    the ``columnar.device`` ladder: any non-fatal failure re-executes
+    the whole pair on the CPU tier, bit-exact by construction (same
+    partition, same assembly)."""
+    if tier is None:
+        tier = route(x1.high_low_container, x2.high_low_container, record=False)
+    tier = _TIER_ARG.get(tier, tier)
+    if tier == "device":
+        return _ladder.LADDER.run(
+            "columnar.device",
+            [
+                ("columnar-device",
+                 lambda: _pairwise_tier(op, x1, x2, reuse_left, "device")),
+                ("columnar-cpu",
+                 lambda: _pairwise_tier(op, x1, x2, reuse_left, "cpu")),
+            ],
+        )
+    return _pairwise_tier(op, x1, x2, reuse_left, "cpu")
+
+
+def _pairwise_tier(
+    op: str, x1: RoaringBitmap, x2: RoaringBitmap, reuse_left: bool, tier: str
+) -> RoaringBitmap:
     a, b = x1.high_low_container, x2.high_low_container
     plan = key_plan(a.keys, b.keys, op)
     acont, bcont = a.containers, b.containers
     acs = [acont[i] for i in plan.ia.tolist()]
     bcs = [bcont[i] for i in plan.ib.tolist()]
-    results = _matched_results(op, acs, bcs)
+    if tier == "device":
+        from . import device as _device_tier
+
+        results = _device_tier.matched_results_device(
+            op, acs, bcs, plan.ia, plan.ib,
+            _device_tier.rows_for(x1), _device_tier.rows_for(x2),
+        )
+    else:
+        results = _matched_results(op, acs, bcs)
     out = RoaringBitmap()
     okeys, ocont = out.high_low_container.keys, out.high_low_container.containers
     if op == "and":

@@ -14,6 +14,13 @@ is the differential oracle and the no-toolchain fallback
 Ops are the four pairwise set operations on sorted unique uint16 arrays;
 word-matrix primitives (scatter / interval fill / per-row popcount) serve
 the dense classes and the N-way folds.
+
+Since ISSUE 10 there is a THIRD kernel tier above these two: the device
+tier (columnar/device.py) runs the word-parallel classes as fused jit
+dispatches over PACK_CACHE-resident flat rows
+(ops/pallas_kernels.pair_rows_reduce, ops/device.word_test_rows). The
+host tiers here remain the differential oracle for it and the engines
+for the value-sized classes (aa + bitmap-free runs) on every tier.
 """
 
 from __future__ import annotations

@@ -217,6 +217,22 @@ def scatter_containers(
         )
     if bm_rows:
         rows = np.asarray(bm_rows, dtype=np.int64)
+
+        def apply(targets: np.ndarray, vals: np.ndarray) -> None:
+            if op == "or":
+                out64[targets] |= vals
+            else:
+                out64[targets] ^= vals
+
+        # pairwise/expand_rows targets are strictly increasing (one
+        # container per row): combine directly — ``reduceat`` with a
+        # boundary at every row reduces nothing yet costs ~3.6x the plain
+        # row-wise op (measured 488 vs 134 µs on a 16-row batch, the
+        # ISSUE 10 small-operand trim). Repeated rows (fold accumulators)
+        # keep the grouped-reduce path.
+        if rows.size == 1 or (np.diff(rows) > 0).all():
+            apply(rows, np.stack(bm_words).astype(np.uint64, copy=False))
+            return
         order = np.argsort(rows, kind="stable")
         stacked = np.stack([bm_words[i] for i in order.tolist()]).astype(
             np.uint64, copy=False
@@ -225,10 +241,11 @@ def scatter_containers(
         boundaries = np.concatenate(
             ([0], np.flatnonzero(np.diff(sorted_rows)) + 1)
         )
+        if boundaries.size == sorted_rows.size:  # all distinct, unsorted
+            apply(sorted_rows, stacked)
+            return
         ufunc = np.bitwise_or if op == "or" else np.bitwise_xor
-        reduced = ufunc.reduceat(stacked, boundaries, axis=0)
-        targets = sorted_rows[boundaries]
-        if op == "or":
-            out64[targets] |= reduced
-        else:
-            out64[targets] ^= reduced
+        apply(
+            sorted_rows[boundaries],
+            ufunc.reduceat(stacked, boundaries, axis=0),
+        )

@@ -122,26 +122,42 @@ def query_counters() -> dict:
 
 
 def columnar_counters() -> dict:
-    """Columnar pairwise-engine observability (ISSUE 5): batched
+    """Columnar pairwise-engine observability (ISSUE 5/10): batched
     container-pairs by ``op/class`` — the 9 ``(array|bitmap|run)²``
-    classes for pairwise ops plus ``fold_<op>/rows`` for the N-way CPU
-    folds — as a plain str->int dict (the query_counters() shape
-    convention). Backed by ``rb_tpu_columnar_batch_total``."""
+    classes for pairwise ops, the device-tier execution classes
+    (``device_pair``/``device_gather``), plus ``fold_<op>/rows`` for the
+    N-way CPU folds — and the cutoff-model routing verdicts by tier, as
+    plain str->int dicts (the query_counters() shape convention). Backed
+    by ``rb_tpu_columnar_batch_total`` / ``rb_tpu_columnar_route_total``."""
     from . import observe
 
     m = observe.REGISTRY.get(observe.COLUMNAR_BATCH_TOTAL)
+    r = observe.REGISTRY.get(observe.COLUMNAR_ROUTE_TOTAL)
     return {
         "batch": {f"{lv[0]}/{lv[1]}": v for lv, v in m.series().items()}
         if m
-        else {}
+        else {},
+        "route": {lv[0]: v for lv, v in r.series().items()} if r else {},
     }
+
+
+def columnar_costmodel() -> dict:
+    """The columnar cutoff model's current state (ISSUE 10): calibration
+    mode, backend, per-engine cost coefficients, and the measured fold
+    gate — the inputs behind every ``columnar.cutoff`` decision entry."""
+    from . import columnar
+
+    d = columnar.MODEL.to_dict()
+    d["fold_gate_rows"] = columnar.MODEL.fold_gate_rows()
+    return d
 
 
 def pack_cache_counters() -> dict:
     """Resident pack cache observability (ISSUE 4): per-kind hit/miss/
     delta-row/evicted-byte counters plus the resident-bytes gauge, as plain
     str->int dicts (the query_counters() shape convention). Kinds are the
-    routed consumers: agg | bsi | andnot | threshold."""
+    routed consumers: agg | bsi | bsi64 | andnot | threshold | colrows
+    (the columnar device tier's per-bitmap flat rows, ISSUE 10)."""
     from . import observe
 
     def _series(name):

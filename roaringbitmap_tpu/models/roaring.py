@@ -53,6 +53,19 @@ def _columnar():
     return _COLUMNAR
 
 
+def hlc_fingerprint(hlc) -> tuple:
+    """The canonical mutation-tracking token computed from a high-low
+    container — the SINGLE source of the fingerprint scheme:
+    ``RoaringBitmap.fingerprint()`` delegates here, and consumers that
+    only hold an hlc (the columnar router's PACK_CACHE residency probe)
+    must use this same function so their cache keys can never drift from
+    what ``device.rows_for`` stores under."""
+    gen = getattr(hlc, "_gen", None)
+    if gen is None:  # mapped/immutable container arrays never mutate
+        return ("static", id(hlc))
+    return (gen, hlc._version)
+
+
 def _check_value(x: int) -> int:
     x = int(x)
     if not 0 <= x < _MAX32:
@@ -414,8 +427,11 @@ class RoaringBitmap:
 
             return FastAggregation.and_(x1, x2, *more)
         col = _columnar()
-        if col.enabled_for(x1.high_low_container, x2.high_low_container):
-            return col.pairwise("and", x1, x2)
+        tier = col.route(
+            x1.high_low_container, x2.high_low_container, op="and"
+        )
+        if tier != "per-container":
+            return col.pairwise("and", x1, x2, tier=tier)
         return RoaringBitmap._and_percontainer(x1, x2)
 
     @staticmethod
@@ -452,8 +468,11 @@ class RoaringBitmap:
 
             return FastAggregation.or_(x1, x2, *more)
         col = _columnar()
-        if col.enabled_for(x1.high_low_container, x2.high_low_container):
-            return col.pairwise("or", x1, x2)
+        tier = col.route(
+            x1.high_low_container, x2.high_low_container, op="or"
+        )
+        if tier != "per-container":
+            return col.pairwise("or", x1, x2, tier=tier)
         return RoaringBitmap._merge_op(x1, x2, "or")
 
     @staticmethod
@@ -463,8 +482,11 @@ class RoaringBitmap:
 
             return FastAggregation.xor(x1, x2, *more)
         col = _columnar()
-        if col.enabled_for(x1.high_low_container, x2.high_low_container):
-            return col.pairwise("xor", x1, x2)
+        tier = col.route(
+            x1.high_low_container, x2.high_low_container, op="xor"
+        )
+        if tier != "per-container":
+            return col.pairwise("xor", x1, x2, tier=tier)
         return RoaringBitmap._merge_op(x1, x2, "xor")
 
     @staticmethod
@@ -563,8 +585,11 @@ class RoaringBitmap:
         must keep cloning because andnot_range feeds it _restrict views
         that share containers with live bitmaps."""
         col = _columnar()
-        if col.enabled_for(x1.high_low_container, x2.high_low_container):
-            return col.pairwise("andnot", x1, x2, reuse_left=_reuse_left)
+        tier = col.route(
+            x1.high_low_container, x2.high_low_container, op="andnot"
+        )
+        if tier != "per-container":
+            return col.pairwise("andnot", x1, x2, reuse_left=_reuse_left, tier=tier)
         out = RoaringBitmap()
         a, b = x1.high_low_container, x2.high_low_container
         akeys, acont, na = a.keys, a.containers, len(a.keys)
@@ -728,8 +753,11 @@ class RoaringBitmap:
 
     def _inplace_merge(self, other: "RoaringBitmap", op: str):
         col = _columnar()
-        if col.enabled_for(self.high_low_container, other.high_low_container):
-            return col.pairwise(op, self, other, reuse_left=True).high_low_container
+        tier = col.route(self.high_low_container, other.high_low_container, op=op)
+        if tier != "per-container":
+            return col.pairwise(
+                op, self, other, reuse_left=True, tier=tier
+            ).high_low_container
         return RoaringBitmap._merge_op(
             self, other, op, reuse_left=True
         ).high_low_container
@@ -1162,11 +1190,7 @@ class RoaringBitmap:
         guarantee unchanged contents — the invalidation key of the query
         result cache (query/cache.py). O(1); NOT a content hash: two equal
         bitmaps have different fingerprints."""
-        hlc = self.high_low_container
-        gen = getattr(hlc, "_gen", None)
-        if gen is None:  # mapped/immutable container arrays never mutate
-            return ("static", id(hlc))
-        return (gen, hlc._version)
+        return hlc_fingerprint(self.high_low_container)
 
     def get_container_count(self) -> int:
         return self.high_low_container.size

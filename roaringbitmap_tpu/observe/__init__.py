@@ -23,6 +23,7 @@ from .registry import (
     BREAKER_TRANSITIONS_TOTAL,
     COLUMNAR_BATCH_TOTAL,
     COLUMNAR_CLASS_SECONDS,
+    COLUMNAR_ROUTE_TOTAL,
     COMPILE_TOTAL,
     DEADLINE_TOTAL,
     DECISION_TOTAL,
@@ -147,6 +148,7 @@ __all__ = [
     "PACK_CACHE_RESIDENT_BYTES",
     "BATCH_PAIRWISE_TOTAL",
     "COLUMNAR_BATCH_TOTAL",
+    "COLUMNAR_ROUTE_TOTAL",
     "SERIAL_BYTES_TOTAL",
     "HOST_OP_SECONDS",
     "SPAN_SECONDS",

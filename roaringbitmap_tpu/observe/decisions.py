@@ -23,10 +23,12 @@ Bounds & cost: the ring holds ``RB_TPU_DECISIONS_CAPACITY`` entries
 (default 512) under a leaf lock — recording is a deque append plus one
 labeled counter bump (``rb_tpu_decision_total{site}``), nanoseconds
 against the microsecond-to-second decisions it records. Hot per-pair
-sites (the columnar cutoff) only record above the count gate, where the
-op itself costs tens of microseconds — the 2 µs per-container floor
-never pays a record (see columnar/engine.py). ``configure(enabled=
-False)`` is the bench twin's kill switch.
+sites (the columnar cutoff) record fully above the count gate, where the
+op itself costs tens of microseconds; below it the 2 µs per-container
+floor pays one int compare and a 1-in-N :class:`SampledSite` record
+keeps the zone visible to the cost model's calibration data (ISSUE 10
+satellite — see columnar/engine.py). ``configure(enabled=False)`` is
+the bench twin's kill switch.
 
 Trace ids, fingerprints, and other unbounded values belong in the entry
 payload — never in metric labels (the metric-naming analysis rule now
@@ -160,3 +162,33 @@ def record_decision(site: str, decision: str, /, **inputs) -> None:
 def decisions(n: Optional[int] = None) -> List[dict]:
     """The decision-log tail (newest ``n``, oldest first)."""
     return LOG.tail(n)
+
+
+class SampledSite:
+    """1-in-N sampling gate for decision sites too hot to record every
+    verdict (ISSUE 10 satellite: the columnar cutoff's below-gate branch
+    sits at the ~2 µs per-container C floor, yet the cost model's
+    calibration data under-sampled exactly that regression zone because
+    sub-gate verdicts were never recorded at all). ``tick()`` costs one
+    int increment + mask compare off-path; every ``every``-th call returns
+    True and the caller records one representative entry (tagged with the
+    sampling factor so consumers can re-weight).
+
+    The counter is deliberately lock-free: a racing increment can at
+    worst skip or double one sample — sampling noise, not data loss —
+    and a lock here would cost more than the branch it meters."""
+
+    __slots__ = ("every", "_mask", "_n")
+
+    def __init__(self, every: int = 64):
+        every = max(1, int(every))
+        if every & (every - 1):
+            raise ValueError(f"sampling factor must be a power of two, got {every}")
+        self.every = every
+        self._mask = every - 1
+        self._n = 0
+
+    def tick(self) -> bool:
+        n = self._n + 1
+        self._n = n
+        return not (n & self._mask)

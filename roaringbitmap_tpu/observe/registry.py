@@ -51,6 +51,8 @@ PACK_CACHE_EVICTED_BYTES_TOTAL = "rb_tpu_pack_cache_evicted_bytes_total"
 PACK_CACHE_RESIDENT_BYTES = "rb_tpu_pack_cache_resident_bytes"
 BATCH_PAIRWISE_TOTAL = "rb_tpu_batch_pairwise_total"
 COLUMNAR_BATCH_TOTAL = "rb_tpu_columnar_batch_total"
+# columnar cutoff-model verdicts by chosen engine tier (ISSUE 10)
+COLUMNAR_ROUTE_TOTAL = "rb_tpu_columnar_route_total"
 SERIAL_BYTES_TOTAL = "rb_tpu_serial_bytes_total"
 HOST_OP_SECONDS = "rb_tpu_host_op_seconds"
 SPAN_SECONDS = "rb_tpu_span_seconds"

@@ -201,6 +201,38 @@ def segmented_reduce(words, seg_start, op: str = "or"):
 
 
 # ---------------------------------------------------------------------------
+# columnar device tier support (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+@_compilewatch.tracked("word_test_rows")
+def word_test_rows(rows, row_ids, word_idx, bit_idx):
+    """Batched membership word-test against resident flat rows: is bit
+    ``bit_idx[i]`` set in word ``word_idx[i]`` of row ``row_ids[i]``?
+    (the array x bitmap columnar class's whole-bucket probe — only the
+    bool mask leaves the device). OOB pad ids clamp to a real row; the
+    host wrapper slices the pads off."""
+    w = rows[row_ids, word_idx]
+    return ((w >> bit_idx) & jnp.uint32(1)).astype(jnp.bool_)
+
+
+def word_test_rows_host(rows, row_ids: np.ndarray, vals: np.ndarray) -> np.ndarray:
+    """Host wrapper for :func:`word_test_rows`: uint16 probe values split
+    into (word, bit) coordinates, streams padded to pow2 (retrace-bounded),
+    bool mask back as numpy sliced to the live probe count."""
+    n = int(vals.size)
+    v = vals.astype(np.int64)
+    row_p = pad_pow2(np.asarray(row_ids, dtype=np.int32), 0)
+    word_p = pad_pow2((v >> 5).astype(np.int32), 0)
+    bit_p = pad_pow2((v & 31).astype(np.uint32), 0)
+    mask = word_test_rows(
+        rows, jnp.asarray(row_p), jnp.asarray(word_p), jnp.asarray(bit_p)
+    )
+    return np.asarray(mask)[:n]
+
+
+# ---------------------------------------------------------------------------
 # batched rank / select support
 # ---------------------------------------------------------------------------
 

@@ -959,6 +959,58 @@ def fused_gather_reduce(flat, src_map, g: int, m: int, op: str = "or",
     )
 
 
+@functools.partial(jax.jit, static_argnames=("op",))
+@_compilewatch.tracked("pair_rows_reduce")
+def _pair_rows_jit(rows_a, ia, rows_b, ib, op):
+    # OOB pad ids read zero rows (take mode="fill"; the fill_value must
+    # be a static hashable under trace — a python literal, not jnp): every
+    # op maps (0, 0) -> 0, so pad slots popcount to 0 and slice off
+    # host-side
+    a = jnp.take(rows_a, ia, axis=0, mode="fill", fill_value=0)
+    b = jnp.take(rows_b, ib, axis=0, mode="fill", fill_value=0)
+    # rb-ok: trace-safety -- op is a static_argnames operand: the branch
+    # resolves at trace time, one specialization per op
+    if op == "and":
+        out = a & b
+    elif op == "or":
+        out = a | b
+    elif op == "xor":
+        out = a ^ b
+    else:  # andnot
+        out = a & ~b
+    cards = jnp.sum(lax.population_count(out).astype(jnp.int32), axis=-1)
+    return out, cards
+
+
+def pair_rows_reduce(rows_a, ia, rows_b, ib, op: str):
+    """Columnar device tier (ISSUE 10): the word-parallel pairwise classes
+    as ONE fused gather + bitwise-op + popcount dispatch over the resident
+    flat row blocks. ``ia[j]``/``ib[j]`` select pair j's rows; the fused
+    per-row popcount IS the batched format selection (the host builds
+    array-vs-bitmap containers card-driven, no re-count). Index streams
+    pad to pow2 with the out-of-range id (retrace-bounded like every
+    marshal kernel); returns host ``(words_u32 [n, 2048], cards int64 [n])``
+    sliced back to the live pair count. Same ``ops.dispatch`` fault site
+    as the reduce dispatchers — the columnar ladder degrades this bucket
+    to the columnar-CPU word matrices bit-exactly."""
+    from ..robust import faults as _faults
+
+    _faults.fault_point("ops.dispatch")
+    n = int(len(ia))
+    oob_a = int(rows_a.shape[0])
+    oob_b = int(rows_b.shape[0])
+    ia_p = dev.pad_pow2(np.asarray(ia, dtype=np.int32), oob_a)
+    ib_p = dev.pad_pow2(np.asarray(ib, dtype=np.int32), oob_b)
+    _DISPATCH_TOTAL.inc(1, ("pair_rows", "xla"))
+    words, cards = _pair_rows_jit(
+        rows_a, jnp.asarray(ia_p), rows_b, jnp.asarray(ib_p), op
+    )
+    return (
+        np.asarray(words)[:n],
+        np.asarray(cards)[:n].astype(np.int64),
+    )
+
+
 # ---------------------------------------------------------------------------
 # marshal kernels (ISSUE 8): device-side container expansion + donated
 # delta scatter

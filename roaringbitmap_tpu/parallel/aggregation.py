@@ -322,9 +322,14 @@ def _aggregate(
         if _use_device(n, mode):
             tiers.append(("device", lambda: _device_aggregate(bitmaps, keys, op)))
         tiers.extend(_cpu_tiers(bitmaps, keys, n, op, pool=pool))
+        from .. import columnar
+
         _decisions.record_decision(
             "agg.dispatch", tiers[0][0], op=op, rows=n,
             operands=len(bitmaps), mode=mode or config.mode,
+            # cost-model provenance (ISSUE 10): the measured fold gate the
+            # CPU-tier choice consulted (config default when uncalibrated)
+            fold_gate=columnar.MODEL.fold_gate_rows(),
         )
         return _ladder.LADDER.run("agg", tiers)
 

@@ -1608,7 +1608,8 @@ class PackCache:
         return self._store(entry, ident=ident).value
 
     def get_or_build(self, key: tuple, build: Callable[[], tuple], refs: tuple = ()):
-        """Generic resident entry (BSI slice tensors, query-kernel packs):
+        """Generic resident entry (BSI slice tensors, query-kernel packs,
+        the columnar device tier's per-bitmap ``colrows`` flat-row blocks):
         ``key`` must start with the kind marker and embed every input
         fingerprint; ``build()`` returns ``(value, nbytes)``. Exact-key hit
         or full rebuild — no delta path. ``refs`` pins the container

@@ -81,7 +81,8 @@ def _cpu_folds(first: RoaringBitmap, groups: dict):
     Large subtrahend sets route the per-key union through the columnar
     batched OR fold (one scatter/fill/reduceat pass over every subtrahend
     container, ISSUE 5) instead of the per-container ``acc &= ~words``
-    walk."""
+    walk — gated by the measured fold cutoff when the columnar cost
+    model has calibrated one (ISSUE 10), the config default otherwise."""
     from .. import columnar
 
     hlc = first.high_low_container

@@ -54,6 +54,7 @@ SITES: Tuple[str, ...] = (
     "ops.dispatch",      # device reduce dispatch (store run closures, ops/)
     "query.exec",        # query executor device-engine step dispatch
     "columnar.kernel",   # columnar native batch-kernel entry (kernels.py)
+    "columnar.device",   # columnar device-tier entry (columnar/device.py)
     "native.entry",      # native C tier entry probe (native/__init__.py)
     "pack_cache.budget", # resident pack-cache byte-budget admission
 )

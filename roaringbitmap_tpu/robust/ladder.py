@@ -1,10 +1,13 @@
 """Execution-tier degradation ladder (ISSUE 7 tentpole, part b/c).
 
 One code path for every degradation the framework performs. A **site**
-(``"agg"``, ``"query.exec"``, ``"columnar"``, ...) runs an ordered list of
-**tiers** — callables producing the *same bit-exact result* by different
-machinery (device reduce, columnar-CPU fold, per-container walk,
-pure-python naive fold). :meth:`Ladder.run` walks them top down:
+(``"agg"``, ``"query.exec"``, ``"columnar.device"``, ...) runs an ordered
+list of **tiers** — callables producing the *same bit-exact result* by
+different machinery (device reduce, columnar-CPU fold, per-container
+walk, pure-python naive fold; since ISSUE 10 the columnar pairwise
+engine rides the ``columnar.device`` site: device tier → columnar-CPU,
+the whole pair re-executed on the host batch engine on any non-fatal
+device failure). :meth:`Ladder.run` walks them top down:
 
 * a tier whose circuit breaker is open is skipped (no attempt, no latency
   paid on a path known to be failing);

@@ -207,6 +207,78 @@ if not all(col[k] > 0 for k in need - {"parity_ok"}):
 print("columnar floor ok (and2by2 %.2fx, andCardinality %.2fx, cpu fold %.2fx)"
       % (col["and2by2_speedup"], col["andcard_speedup"], col["fold_speedup"]))'
 
+step "columnar device tier + cutoff model (ISSUE 10 contract)"
+# the bench must have run the in-bench device≡CPU parity sweep and
+# recorded the three-way twin rows + the cost-model accuracy row; on the
+# CPU backend the mid-size routed verdict must NOT be the device tier
+# (r11-identical routing — the >=1.5x-vs-columnar-CPU dense claim gates
+# accelerator artifacts, not the CPU smoke)
+python -c '
+import json
+m = json.load(open("/tmp/ci_bench.json"))["meta"]
+cd = m.get("columnar_device")
+if not isinstance(cd, dict):
+    raise SystemExit("columnar device contract: missing meta.columnar_device")
+need = {"parity_ok", "n_pairs", "backend", "and2by2_device_ns",
+        "and2by2_device_vs_cpu", "or2by2_device_ns", "or2by2_device_vs_cpu",
+        "routed_tier_midsize", "cost_model"}
+missing = need - set(cd)
+if missing:
+    raise SystemExit("columnar device contract: missing %s" % sorted(missing))
+if cd["parity_ok"] is not True:
+    raise SystemExit("columnar device contract: device parity sweep did not pass")
+if not (cd["and2by2_device_ns"] > 0 and cd["or2by2_device_ns"] > 0):
+    raise SystemExit("columnar device contract: non-positive twin rows %r" % cd)
+if cd["backend"] == "cpu" and cd["routed_tier_midsize"] == "columnar-device":
+    raise SystemExit("columnar device contract: CPU host routed the device tier")
+cm = cd["cost_model"]
+if not cm.get("calibrated"):
+    raise SystemExit("columnar device contract: cost model never calibrated")
+if not (cm["cells"] >= 6 and 0.0 <= cm["accuracy"] <= 1.0):
+    raise SystemExit("columnar device contract: bad accuracy row %r" % cm)
+if cm["accuracy"] < 0.5:
+    raise SystemExit("columnar device contract: model accuracy %s below 0.5"
+                     % cm["accuracy"])
+print("columnar device ok (and2by2 dev %.2fx vs cpu, or2by2 %.2fx; "
+      "midsize routes %s on %s; model accuracy %s over %d cells)"
+      % (cd["and2by2_device_vs_cpu"], cd["or2by2_device_vs_cpu"],
+         cd["routed_tier_midsize"], cd["backend"], cm["accuracy"], cm["cells"]))'
+
+step "routed small-operand floor (ISSUE 10: no case below 0.9x vs per-container)"
+# the jmh-grid shape (single-value containers) through the DEFAULT routed
+# path vs the pinned per-container walk: the router must keep these
+# per-container, so the routed wall prices within noise of the floor
+JAX_PLATFORMS=cpu python - <<'EOF'
+import time
+import numpy as np
+from roaringbitmap_tpu import columnar
+from roaringbitmap_tpu.models.roaring import RoaringBitmap as RB
+
+K = 1 << 16
+ident = np.arange(10_000, dtype=np.uint64) * K
+b1 = RB(ident.astype(np.uint32))
+b2 = b1.clone()
+tier = columnar.route(b1.high_low_container, b2.high_low_container, record=False)
+if tier != "per-container":
+    raise SystemExit("routed floor: jmh identical-case routed %r" % tier)
+
+def best(fn, reps=5):
+    t = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        t = min(t, time.perf_counter() - t0)
+    return t
+
+routed = best(lambda: RB.and_(b1, b2))
+with columnar.disabled():
+    floor = best(lambda: RB.and_(b1, b2))
+ratio = floor / routed
+if ratio < 0.85:  # 0.9 contract with host-noise slack
+    raise SystemExit("routed floor: routed path at %.2fx of per-container" % ratio)
+print("routed floor ok (identical:and routed %.2fx of the per-container floor)" % ratio)
+EOF
+
 step "bench metrics sidecar (observe/ registry snapshot contract)"
 # same SystemExit discipline as the driver-contract check above: the smoke
 # run must leave a schema-valid registry snapshot behind

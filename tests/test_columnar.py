@@ -330,11 +330,15 @@ def test_columnar_counters_shape():
     a = _typed_bitmap(["array"] * 20, rng)
     columnar.pairwise("and", a, a.clone())
     snap = insights.columnar_counters()
-    assert set(snap) == {"batch"}
+    assert set(snap) == {"batch", "route"}
     assert snap["batch"].get("and/aa", 0) >= 20
     for key in snap["batch"]:
         op, klass = key.split("/")
-        assert klass in columnar.CLASS_NAMES or klass == "rows"
+        assert klass in columnar.CLASS_NAMES or klass in (
+            "rows", "device_pair", "device_gather",
+        )
+    for tier in snap["route"]:
+        assert tier in ("per-container", "columnar-cpu", "columnar-device")
 
 
 def test_dense_chunking():

@@ -1,0 +1,345 @@
+"""Columnar device tier + measured cutoff model (ISSUE 10): the 9-class
+grid forced through the device tier bit-exact vs the numpy oracle,
+cost-model boundary/default cases, ladder degradation under injected
+``columnar.device`` faults, PACK_CACHE-fed vs cold-packed identity, and
+the calibration persist/reload round-trip."""
+
+import os
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import columnar, insights, robust
+from roaringbitmap_tpu.columnar import costmodel as col_costmodel
+from roaringbitmap_tpu.columnar import device as col_device
+from roaringbitmap_tpu.columnar import engine as col_engine
+from roaringbitmap_tpu.columnar import kernels as col_kernels
+from roaringbitmap_tpu.models.container import RunContainer
+from roaringbitmap_tpu.models.roaring import RoaringBitmap
+from roaringbitmap_tpu.parallel import store
+from roaringbitmap_tpu.robust import faults as rfaults
+from roaringbitmap_tpu.robust import ladder as rladder
+
+OPS = {
+    "and": RoaringBitmap.and_,
+    "or": RoaringBitmap.or_,
+    "xor": RoaringBitmap.xor,
+    "andnot": RoaringBitmap.andnot,
+}
+
+
+@pytest.fixture(autouse=True)
+def _isolated_model():
+    """Every test starts from the uncalibrated default gate and leaves no
+    calibration (or tripped breakers / resident colrows packs) behind."""
+    col_costmodel.MODEL.reset()
+    col_engine.config.force_device = False
+    rladder.LADDER.reset()
+    yield
+    col_costmodel.MODEL.reset()
+    col_engine.config.force_device = False
+    rladder.LADDER.reset()
+    store.PACK_CACHE.close()
+
+
+def _chunk_values(kind: str, key: int, rng) -> np.ndarray:
+    base = key << 16
+    if kind == "array":
+        vals = np.sort(rng.choice(1 << 16, 500, replace=False))
+    elif kind == "bitmap":
+        vals = np.sort(rng.choice(1 << 16, 9000, replace=False))
+    else:  # run
+        starts = np.arange(0, 1 << 16, 1 << 11)[:20]
+        vals = np.unique(
+            np.concatenate([np.arange(s, s + 900) for s in starts])
+        )
+    return (vals + base).astype(np.uint32)
+
+
+def _typed_bitmap(kinds, rng) -> RoaringBitmap:
+    bm = RoaringBitmap(
+        np.concatenate([_chunk_values(k, i, rng) for i, k in enumerate(kinds)])
+    )
+    bm.run_optimize()
+    return bm
+
+
+def _nine_class_pair(rng):
+    kinds = ["array", "bitmap", "run"]
+    a = _typed_bitmap([k for k in kinds for _ in kinds], rng)
+    b = _typed_bitmap([k for _ in kinds for k in kinds], rng)
+    return a, b
+
+
+@pytest.mark.parametrize("op", list(OPS))
+def test_all_nine_classes_device_parity(op):
+    """Every (array|bitmap|run)^2 matched class forced through the device
+    tier, bit-exact vs the per-container engine AND the numpy columnar
+    oracle."""
+    rng = np.random.default_rng(105)
+    a, b = _nine_class_pair(rng)
+    ca = columnar.classify(a.high_low_container.containers)
+    cb = columnar.classify(b.high_low_container.containers)
+    assert columnar.class_histogram(ca, cb).tolist() == [1] * 9
+    got = columnar.pairwise(op, a, b, tier="device")
+    with columnar.disabled():
+        want = OPS[op](a, b)
+    assert got == want
+    assert got.get_cardinality() == want.get_cardinality()
+    assert np.array_equal(got.to_array(), want.to_array())
+    # the device execution classes really engaged (dense always occupied;
+    # and/andnot also probe through the device word-test gather)
+    batch = insights.columnar_counters()["batch"]
+    assert batch.get(f"{op}/device_pair", 0) > 0
+    if op in ("and", "andnot"):
+        assert batch.get(f"{op}/device_gather", 0) > 0
+
+
+def test_device_vs_numpy_columnar_oracle(monkeypatch):
+    """Device tier vs the banded-NUMPY columnar tier (native pinned off):
+    the two independent implementations agree pair by pair."""
+    monkeypatch.setattr(col_kernels, "_native", lambda: None)
+    rng = np.random.default_rng(107)
+    from roaringbitmap_tpu import fuzz
+
+    for _ in range(15):
+        a = fuzz.random_bitmap(rng)
+        b = fuzz.random_bitmap(rng)
+        for op in OPS:
+            got = columnar.pairwise(op, a, b, tier="device")
+            want = columnar.pairwise(op, a, b, tier="cpu")
+            assert got == want, op
+
+
+def test_pack_cache_fed_vs_cold_identical():
+    """A device-tier op over PACK_CACHE-resident rows returns the same
+    bits as one forced to re-pack cold (cache disabled)."""
+    rng = np.random.default_rng(109)
+    a, b = _nine_class_pair(rng)
+    warm = {}
+    col_device.rows_for(a)  # make both operands resident
+    col_device.rows_for(b)
+    assert col_device.rows_resident(a) and col_device.rows_resident(b)
+    for op in OPS:
+        warm[op] = columnar.pairwise(op, a, b, tier="device")
+    store.PACK_CACHE.configure(0)  # disabled: every build is cold
+    try:
+        assert not col_device.rows_resident(a)
+        for op in OPS:
+            cold = columnar.pairwise(op, a, b, tier="device")
+            assert cold == warm[op], op
+    finally:
+        store.PACK_CACHE.configure(2 << 30)
+
+
+def test_device_fault_degrades_to_columnar_cpu():
+    """An injected ``columnar.device`` fault rides the ladder down to the
+    columnar-CPU tier bit-exactly, records the degradation edge, and a
+    persistent fault trips the breaker (dead tier skipped, not
+    re-attempted)."""
+    rng = np.random.default_rng(111)
+    a, b = _nine_class_pair(rng)
+    with columnar.disabled():
+        want = RoaringBitmap.and_(a, b)
+    before = insights.robust_counters()["degrade"]
+    with rfaults.inject(
+        "columnar.device", robust.TransientDeviceError, every=1
+    ) as inj:
+        for _ in range(4):  # trip_after=3 consecutive failures trip
+            assert columnar.pairwise("and", a, b, tier="device") == want
+        assert inj.fired >= 3
+    after = insights.robust_counters()["degrade"]
+    edge = "columnar.device/columnar-device/columnar-cpu"
+    assert after.get(edge, 0) > before.get(edge, 0)
+    assert rladder.LADDER.breaker_state("columnar.device", "columnar-device") == "open"
+    # breaker open: the device tier is skipped without attempting (no new
+    # fault fires even with the rule armed)
+    with rfaults.inject(
+        "columnar.device", robust.TransientDeviceError, every=1
+    ) as inj2:
+        assert columnar.pairwise("and", a, b, tier="device") == want
+        assert inj2.fired == 0
+
+
+def test_empty_calibration_conservative_defaults():
+    """Uncalibrated, the model reproduces the r11 hand-tuned gate
+    verbatim: count window + dense-shape hint, never the device tier."""
+    m = col_costmodel.MODEL
+    assert not m.calibrated
+    lo = columnar.config.min_containers
+    hi = columnar.config.max_containers
+    assert m.choose(lo - 1, lo, "run", False)[0] == "per-container"
+    assert m.choose(lo, lo - 1, "run", True)[0] == "per-container"
+    assert m.choose(hi + 1, hi, "run", True)[0] == "per-container"
+    assert m.choose(lo, lo, "array", True)[0] == "per-container"
+    for shape in ("bitmap", "run"):
+        tier, inputs = m.choose(lo, hi, shape, True)
+        assert tier == "columnar-cpu"
+        assert inputs["model"] == "default-gate"
+
+
+def test_calibrated_routes_losers_back_to_percontainer():
+    """The measured model fixes the 0.3-0.9x small-operand regression
+    zone: verdicts follow the measured per-engine estimates (not the old
+    dense hint), run mixes (the measured 2-3x win) stay columnar, and on
+    the default C-extension tier — where the per-container walk sits at
+    its ~2-4 µs floor — small array mixes route back per-container. The
+    slower native tiers legitimately measure different crossovers; the
+    argmin consistency is the tier-independent contract."""
+    m = columnar.calibrate(include_device=False)
+    assert m.calibrated
+    assert m.choose(32, 32, "run", False)[0] == "columnar-cpu"
+    for n, shape in ((16, "array"), (64, "array"), (32, "bitmap"), (64, "bitmap")):
+        tier, inputs = m.choose(n, n, shape, False)
+        est = inputs["est_us"]
+        assert tier == min(est, key=est.get), (n, shape)
+        assert inputs["model"] == "calibrated"
+    from roaringbitmap_tpu import native
+
+    if native.backend_tier() == "ext":
+        assert m.choose(64, 64, "array", False)[0] == "per-container"
+
+
+def test_faulty_device_calibration_drops_device_coefficients():
+    """A device that faults during calibration must NOT have the ladder's
+    CPU-fallback timings installed as its coefficients — the device
+    column is discarded and the tier stays unpriced (never chosen) until
+    a healthy calibration re-prices it."""
+    col_engine.config.force_device = True
+    with rfaults.inject(
+        "columnar.device", robust.TransientDeviceError, every=1
+    ) as inj:
+        m = columnar.calibrate(include_device=True)
+    assert inj.fired > 0
+    assert m.calibrated
+    assert all("columnar-device" not in t for t in m.coeffs.values())
+    # CPU routing is intact and the device tier is never the verdict
+    assert m.choose(32, 32, "run", True, allow_device=True)[0] != (
+        "columnar-device"
+    )
+
+
+def test_calibration_roundtrip_same_routing(tmp_path):
+    """persist -> reload -> identical verdicts across the feature grid."""
+    path = os.path.join(str(tmp_path), "colcal.json")
+    m = columnar.calibrate(include_device=False, persist=path)
+    assert os.path.isfile(path)
+    m2 = col_costmodel.CostModel()
+    assert m2.load(path)
+    for na in (16, 64, 512, 4096):
+        for shape in col_costmodel.SHAPES:
+            for resident in (False, True):
+                assert (
+                    m2.choose(na, na, shape, resident)[0]
+                    == m.choose(na, na, shape, resident)[0]
+                ), (na, shape, resident)
+    # a foreign-backend file is rejected, state untouched
+    m3 = col_costmodel.CostModel()
+    bad = dict(m.to_dict(), backend="tpu-imaginary")
+    import json
+
+    with open(path, "w") as f:
+        json.dump(bad, f)
+    assert not m3.load(path)
+    assert not m3.calibrated
+
+
+def test_routed_device_tier_end_to_end():
+    """With the model calibrated and the device tier admitted
+    (force_device on the CPU backend), the FACADE routes a resident
+    dense pair through the device tier — visible in the route counter and
+    the decision log — and stays bit-exact."""
+    rng = np.random.default_rng(113)
+    kinds = ["bitmap", "run"] * 16
+    a, b = _typed_bitmap(kinds, rng), _typed_bitmap(kinds, rng)
+    columnar.calibrate(include_device=True)
+    col_engine.config.force_device = True
+    # make the rows resident so the ship term is sunk — the device tier
+    # must now price below columnar-cpu for this dense working set
+    col_device.rows_for(a)
+    col_device.rows_for(b)
+    tier = columnar.route(a.high_low_container, b.high_low_container)
+    routed = RoaringBitmap.and_(a, b)
+    with columnar.disabled():
+        want = RoaringBitmap.and_(a, b)
+    assert routed == want
+    decs = [
+        d for d in insights.decisions() if d["site"] == "columnar.cutoff"
+    ]
+    assert decs and decs[-1]["decision"] == tier
+    assert decs[-1]["inputs"]["model"] == "calibrated"
+    if tier == "columnar-device":
+        assert insights.columnar_counters()["route"].get("columnar-device", 0) > 0
+
+
+def test_outside_gate_sampled_decision():
+    """Outside-window verdicts (below min OR above max — the jmh-grid
+    shapes) record 1-in-N (the calibration-data gap fix): driving > N
+    routed calls lands at least one sampled entry tagged with the
+    sampling factor, and the max cap holds in BOTH model modes."""
+    small = RoaringBitmap(np.arange(40, dtype=np.uint32))  # 1 container
+    hlc = small.high_low_container
+    for _ in range(col_engine._BELOW_GATE.every + 1):
+        assert columnar.route(hlc, hlc) == "per-container"
+    samples = [
+        d
+        for d in insights.decisions()
+        if d["site"] == "columnar.cutoff"
+        and d["inputs"].get("reason") == "outside-gate"
+    ]
+    assert samples
+    assert samples[-1]["inputs"]["sampled"] == col_engine._BELOW_GATE.every
+    # above the cap the calibrated model must NOT extrapolate its
+    # 16..64-cell fit: the r07 per-container floor argument stands
+    big = RoaringBitmap((np.arange(5000, dtype=np.uint64) << 16).astype(np.uint32))
+    columnar.calibrate(include_device=False)
+    assert columnar.route(big.high_low_container, big.high_low_container) == (
+        "per-container"
+    )
+
+
+def test_word_test_gather_matches_cpu_mask():
+    """The on-device word-test gather and the CPU member_mask agree on a
+    mixed probe batch (the array x bitmap class core)."""
+    from roaringbitmap_tpu.columnar.partition import gather_values, stack_words
+    from roaringbitmap_tpu.ops import device as dev
+
+    rng = np.random.default_rng(115)
+    kinds = ["array", "bitmap"] * 10
+    a = _typed_bitmap(kinds, rng)
+    b = _typed_bitmap(kinds[::-1], rng)
+    acs = a.high_low_container.containers
+    bcs = b.high_low_container.containers
+    ca = columnar.classify(acs)
+    cb = columnar.classify(bcs)
+    idx = np.flatnonzero((ca == 0) & (cb == 1))
+    assert idx.size
+    vals, offs = gather_values(acs, idx)
+    row_ids = np.repeat(idx, np.diff(offs))  # rows in b's resident block
+    rows_b = col_device.rows_for(b)
+    got = dev.word_test_rows_host(rows_b, row_ids, vals)
+    mat = stack_words(bcs, idx)
+    local = np.repeat(np.arange(idx.size, dtype=np.int64), np.diff(offs))
+    want = col_kernels.member_mask(mat, local, vals)
+    assert np.array_equal(got, want)
+
+
+def test_colrows_residency_delta_invalidation():
+    """A mutated operand's fingerprint moves, so the resident colrows
+    entry stops matching (no stale device rows served) and the op stays
+    correct."""
+    rng = np.random.default_rng(117)
+    a, b = _nine_class_pair(rng)
+    col_device.rows_for(a)
+    assert col_device.rows_resident(a)
+    r1 = columnar.pairwise("or", a, b, tier="device")
+    v = (3 << 16) + 12345
+    while a.contains(v) or b.contains(v):  # must actually change the OR
+        v += 1
+    a.add(v)  # mutate: version bump -> new fingerprint
+    assert not col_device.rows_resident(a)
+    r2 = columnar.pairwise("or", a, b, tier="device")
+    with columnar.disabled():
+        assert r2 == RoaringBitmap.or_(a, b)
+    assert r2.contains(v)
+    assert r1 != r2
