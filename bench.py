@@ -192,14 +192,16 @@ def _run():
     cpu_s = min(cpu_times) if cpu_times else cpu_first_s
     cpu_card = cpu_result.get_cardinality()
 
-    # ---- observability off-mode twin (ISSUE 9) ----
-    # The trace context + decision log are always-on (cheap) paths riding
-    # every fold; this twin re-times the SAME fold with both fully killed,
-    # bounding their off-mode cost in the artifact itself. Both sides are
-    # warm min-of-reps; the gate is <1% relative with a 5 ms absolute
-    # slack (smoke-scale folds are noise-bound below that).
+    # ---- observability off-mode twin (ISSUE 9 + 11) ----
+    # The trace context + decision log + outcome join are always-on
+    # (cheap) paths riding every fold; this twin re-times the SAME fold
+    # with all three fully killed, bounding their off-mode cost in the
+    # artifact itself. Both sides are warm min-of-reps; the gate is <1%
+    # relative with a 5 ms absolute slack (smoke-scale folds are
+    # noise-bound below that).
     from roaringbitmap_tpu.observe import context as obs_context
     from roaringbitmap_tpu.observe import decisions as obs_decisions
+    from roaringbitmap_tpu.observe import outcomes as obs_outcomes
 
     # INTERLEAVED pairs with ALTERNATING order (on-off, off-on, ...):
     # back-to-back folds drift by several percent on this host
@@ -221,11 +223,13 @@ def _run():
     def _fold_disabled(times):
         obs_context.configure(enabled=False)
         obs_decisions.configure(enabled=False)
+        obs_outcomes.configure(enabled=False)
         try:
             return _fold_once(times)
         finally:
             obs_context.configure(enabled=True)
             obs_decisions.configure(enabled=True)
+            obs_outcomes.configure(enabled=True)
 
     try:
         for i in range(obs_pairs):
@@ -238,6 +242,7 @@ def _run():
     finally:
         obs_context.configure(enabled=True)
         obs_decisions.configure(enabled=True)
+        obs_outcomes.configure(enabled=True)
     fold_obs_on_s = min(obs_on_times)
     fold_obs_disabled_s = min(obs_off_times)
     assert obs_off_result == cpu_result, "observability-off fold mismatch"
@@ -483,6 +488,116 @@ def _run():
             "accuracy": round(model_hits / n_cells, 3),
         },
     }
+    # ---- decision-outcome ledger: routing regret + refit (ISSUE 11) ----
+    # A scoped window of routed traffic (the same census pairs through the
+    # DEFAULT facades, folds, and a planned query) with the ledger reset
+    # at entry: routing_regret = wall-clock lost to wrong verdicts /
+    # window wall — the row every later PR must hold (<= 5% of measured
+    # wall, the ci.sh gate). The window runs under the CALIBRATED model
+    # (est_us on every verdict), so each join prices its alternatives.
+    from roaringbitmap_tpu.observe import outcomes as rb_outcomes
+    from roaringbitmap_tpu.query import Q, execute as q_execute
+
+    rb_outcomes.reset()
+    t0 = time.time()
+    for a, b in pairs:
+        RoaringBitmap.and_(a, b)
+        RoaringBitmap.or_(a, b)
+    aggregation.FastAggregation.or_(*bitmaps[:256], mode="cpu")
+    q_execute(
+        (Q.leaf(sample[0]) & Q.leaf(sample[1])) | Q.leaf(sample[2]),
+        cache=None,
+    )
+    regret_window_s = time.time() - t0
+    reg_sum = rb_outcomes.summary()
+    regret_total_s = sum(s["regret_s"] for s in reg_sum.values())
+    routing_regret = regret_total_s / regret_window_s
+    # predicted-vs-measured error-ratio row: the columnar cutoff site's
+    # median ratio over the window (1.0 = the curves price live census
+    # traffic truthfully), plus per-site geomeans in the decomposition
+    cutoff_ratios = sorted(
+        e["error_ratio"] for e in rb_outcomes.tail()
+        if e["site"] == "columnar.cutoff" and e.get("error_ratio")
+    )
+    err_ratio_p50 = (
+        round(cutoff_ratios[len(cutoff_ratios) // 2], 4) if cutoff_ratios else None
+    )
+    assert reg_sum.get("columnar.cutoff", {}).get("count", 0) > 0, (
+        "regret window joined no columnar.cutoff outcomes"
+    )
+    assert routing_regret <= 0.05, (
+        f"routing_regret {routing_regret:.4f} blew the 5% budget "
+        f"(regret {regret_total_s:.4f}s of {regret_window_s:.4f}s wall): {reg_sum}"
+    )
+
+    # seeded mispriced scenario: poison the coefficients of the cell the
+    # routed mid-size pair lands on, gather live joins under the poisoned
+    # model, refit_from_outcomes(), and check the refit moved the cell
+    # back toward the measured truth — the acceptance demonstration that
+    # the loop actually closes (a wrong pricing authority heals from
+    # traffic instead of waiting for a human with twin benchmark rows).
+    import copy as _copy
+
+    refit_tier = str(columnar.route(
+        run_mid.high_low_container, run_mid2.high_low_container, record=False,
+    ))
+    refit_group = col_costmodel.op_group("and")
+    refit_shape = "run"
+    true_cell = list(
+        col_costmodel.MODEL.coeffs[refit_group][refit_tier][refit_shape]
+    )
+    poisoned_cell = [round(true_cell[0] / 16, 3), round(true_cell[1] / 16, 4)]
+    with col_costmodel.MODEL._lock:
+        col_costmodel.MODEL.coeffs = _copy.deepcopy(col_costmodel.MODEL.coeffs)
+        col_costmodel.MODEL.coeffs[refit_group][refit_tier][refit_shape] = list(
+            poisoned_cell
+        )
+    rb_outcomes.reset()
+    for _ in range(8):  # routed joins under the poisoned pricing
+        RoaringBitmap.and_(run_mid, run_mid2)
+    refit_report = columnar.refit_from_outcomes(min_samples=4)
+    refit_cell = col_costmodel.MODEL.coeffs[refit_group][refit_tier][refit_shape]
+    n_mid = min(run_mid.get_container_count(), run_mid2.get_container_count())
+    measured_mid_us = float(np.median([
+        s["measured_us"] for s in rb_outcomes.samples()
+        if s["engine"] == refit_tier and s["shape"] == refit_shape
+    ]))
+
+    def _cell_cost(c):
+        return c[0] + n_mid * c[1]
+
+    refit_err = abs(_cell_cost(refit_cell) - measured_mid_us)
+    poisoned_err = abs(_cell_cost(poisoned_cell) - measured_mid_us)
+    assert refit_err < poisoned_err, (
+        f"refit did not move the {refit_group}/{refit_tier}/{refit_shape} "
+        f"cell toward measured truth: poisoned {poisoned_cell} "
+        f"(err {poisoned_err:.1f}us) -> refit {refit_cell} "
+        f"(err {refit_err:.1f}us) vs measured {measured_mid_us:.1f}us"
+    )
+    assert col_costmodel.MODEL.provenance == "refit-from-traffic", (
+        "refit provenance not recorded on the model"
+    )
+    regret_meta = {
+        "window_wall_s": round(regret_window_s, 4),
+        "regret_s": round(regret_total_s, 6),
+        "routing_regret": round(routing_regret, 5),
+        "error_ratio_p50": err_ratio_p50,
+        "per_site": {
+            site: {k: s[k] for k in ("count", "regret_s", "error_ratio_geomean")}
+            for site, s in reg_sum.items()
+        },
+        "refit": {
+            "cell": f"{refit_group}/{refit_tier}/{refit_shape}",
+            "calibrated": [round(v, 4) for v in true_cell],
+            "poisoned": poisoned_cell,
+            "refit": [round(v, 4) for v in refit_cell],
+            "measured_mid_us": round(measured_mid_us, 1),
+            "moved_toward_truth": True,
+            "provenance": refit_report.get("provenance"),
+        },
+    }
+    rb_outcomes.reset()
+
     # the device section must not leak into the r11-comparable rows below:
     # routed folds go back to the default gate and the colrows packs free
     # their budget share before the pack sections measure cold costs
@@ -688,11 +803,19 @@ def _run():
     # ---- resident pack cache: warm hit + incremental delta repack ----
     # (ISSUE 4 acceptance: a repeated aggregation over unchanged bitmaps
     # performs zero host packs; mutating k of N containers ships O(k) rows)
+    # Both ms-scale rows are measured min-of-k with the observed rep
+    # spread recorded as meta.host_noise (ISSUE 11 satellite): these rows
+    # oscillated around the fixed 15% trend gate across same-code runs —
+    # the recorded band is what bench_trend now gates against.
     from roaringbitmap_tpu import insights
 
-    t0 = time.time()
-    warm = store.packed_for(bitmaps)
-    warm_pack_s = time.time() - t0
+    noise_reps = 3
+    warm_times = []
+    for _ in range(noise_reps):
+        t0 = time.time()
+        warm = store.packed_for(bitmaps)
+        warm_times.append(time.time() - t0)
+    warm_pack_s = min(warm_times)
     assert warm is packed, "warm lookup must return the resident pack"
 
     k_mut = 5
@@ -703,6 +826,17 @@ def _run():
         hb = int(bm.high_low_container.keys[0])
         bm.add((hb << 16) | 910)
     store.packed_for(bitmaps).device_words.block_until_ready()
+    # noise-probe deltas (same shape, fresh mutations each) — every rep
+    # is a real k-container delta repack; the LAST rep carries the
+    # delta-row accounting the O(k) contract asserts on
+    delta_times = []
+    for rep in range(noise_reps - 1):
+        for bm in bitmaps[:k_mut]:
+            hb = int(bm.high_low_container.keys[0])
+            bm.add((hb << 16) | (900 + rep))
+        t0 = time.time()
+        store.packed_for(bitmaps).device_words.block_until_ready()
+        delta_times.append(time.time() - t0)
     pc_before = insights.pack_cache_counters()
     for bm in bitmaps[:k_mut]:
         hb = int(bm.high_low_container.keys[0])
@@ -710,7 +844,8 @@ def _run():
     t0 = time.time()
     delta_packed = store.packed_for(bitmaps)
     delta_packed.device_words.block_until_ready()
-    delta_repack_s = time.time() - t0
+    delta_times.append(time.time() - t0)
+    delta_repack_s = min(delta_times)
     pc = insights.pack_cache_counters()
     delta_rows = pc["delta_rows"].get("agg", 0) - pc_before["delta_rows"].get("agg", 0)
     assert delta_packed is packed, "delta must refresh the resident pack in place"
@@ -719,6 +854,25 @@ def _run():
     assert np.array_equal(delta_packed.words, fresh.words), "delta != full repack"
     hits = sum(pc["hits"].values())
     misses = sum(pc["misses"].values())
+
+    def _spread(times):
+        # spread is median-vs-min (robust to one outlier rep — the first
+        # rep routinely pays residual cache/allocator state the row's
+        # min-of-k number does not describe); max is recorded for the
+        # artifact reader but does not widen the trend gate
+        med = sorted(times)[len(times) // 2]
+        return {
+            "reps": len(times),
+            "min": round(min(times), 6),
+            "median": round(med, 6),
+            "max": round(max(times), 6),
+            "spread_pct": round((med / min(times) - 1) * 100, 1),
+        }
+
+    host_noise = {
+        "pack_warm_s": _spread(warm_times),
+        "delta_repack_s": _spread(delta_times),
+    }
 
     # ---- pipeline timeline (ISSUE 6): traced twin rows + BENCH_TIMELINE ----
     # Re-run the cold pack and the k-container delta with the flight
@@ -1000,6 +1154,15 @@ def _run():
         "pack_mutated_containers": k_mut,
         "pack_delta_rows": int(delta_rows),
         "pack_cache_hit_ratio": round(hits / max(1, hits + misses), 3),
+        # recorded host-noise bands for the ms-scale rows (ISSUE 11
+        # satellite): bench_trend gates these rows on max(15%, band)
+        "host_noise": host_noise,
+        # decision-outcome ledger rows (ISSUE 11): routing regret over a
+        # scoped routed-traffic window, the predicted-vs-measured error
+        # ratio, per-site decomposition, and the seeded-mispricing refit
+        # demonstration (coefficients demonstrably move toward measured
+        # truth, provenance recorded)
+        "regret": regret_meta,
         # timeline twin rows (ISSUE 6): traced (fenced flight recorder)
         # vs untraced walls for the same operations, the named-stage
         # attribution sums, and where the artifact landed — overhead_pct

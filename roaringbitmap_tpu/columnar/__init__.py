@@ -32,8 +32,9 @@ the cutoff and as the differential reference (fuzz family
 ``columnar.cutoff`` decision site (1-in-N sampled below the count gate).
 """
 
-from .costmodel import MODEL, calibrate, ensure_calibrated
+from .costmodel import MODEL, calibrate, ensure_calibrated, refit_from_outcomes
 from .engine import (
+    Verdict,
     and_cardinality_pair,
     config,
     disabled,
@@ -42,6 +43,7 @@ from .engine import (
     fold,
     intersects_pair,
     or_fold_words,
+    outcome,
     pairwise,
     route,
 )
@@ -67,4 +69,7 @@ __all__ = [
     "MODEL",
     "calibrate",
     "ensure_calibrated",
+    "refit_from_outcomes",
+    "outcome",
+    "Verdict",
 ]

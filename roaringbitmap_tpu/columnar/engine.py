@@ -24,13 +24,14 @@ from __future__ import annotations
 import functools
 import os
 import threading
-from contextlib import contextmanager
+from contextlib import contextmanager, nullcontext
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
 from .. import observe as _observe
 from ..observe import decisions as _decisions
+from ..observe import outcomes as _outcomes
 from ..observe import timeline as _timeline
 from ..robust import errors as _rerrors
 from ..robust import ladder as _ladder
@@ -214,9 +215,39 @@ _TIER_ARG = {"columnar-cpu": "cpu", "columnar-device": "device"}
 _BELOW_GATE = _decisions.SampledSite(64)
 
 
+class Verdict(str):
+    """A :func:`route` verdict that compares, hashes, and renders exactly
+    as its tier string but additionally carries the decision serial
+    (``.seq``) for the outcome join (ISSUE 11) — call sites that only
+    ever treated the verdict as a string keep working unchanged."""
+
+    seq: Optional[int] = None
+
+
+_NULL_OUTCOME = nullcontext()
+
+
+def outcome(tier):
+    """The measured-outcome scope for one routed verdict: the facades wrap
+    the chosen engine's execution in it, and the join prices the verdict
+    against what actually happened::
+
+        tier = route(a, b, op="and")
+        with outcome(tier):
+            <run whichever engine tier names>
+
+    A verdict without a serial (below-gate, ``record=False``, outcomes
+    off) returns a shared null context — the per-container C floor pays
+    one getattr."""
+    seq = getattr(tier, "seq", None)
+    if seq is None:
+        return _NULL_OUTCOME
+    return _outcomes.measure(seq, "columnar.cutoff", engine=str(tier))
+
+
 def route(
     a_hlc, b_hlc, record: bool = True, allow_device: bool = True,
-    op: str = "and",
+    op: str = "and", join: bool = True,
 ) -> str:
     """Three-way engine verdict for one pairwise ``op``:
     ``per-container`` / ``columnar-cpu`` / ``columnar-device``, from
@@ -287,7 +318,21 @@ def route(
     tier, inputs = model.choose(na, nb, shape, resident, device_arg, op=op)
     if record:
         _ROUTE_TOTAL.inc(1, (_TIER_LABELS[tier],))
-        _decisions.record_decision("columnar.cutoff", tier, **inputs)
+        # outcome join (ISSUE 11): above-gate verdicts are measurable ops
+        # (tens of µs up), so every recorded one registers for a measured
+        # join — per-container verdicts included, which is what gives the
+        # refit live samples from ALL engines, not only the routed winner.
+        # ``join=False`` (the cardinality facades' gate probe) records
+        # provenance only: their execution happens in kernels this scope
+        # cannot see, and an unjoinable pending entry is pure ring litter.
+        seq = _decisions.record_decision(
+            "columnar.cutoff", tier, outcome=join and _outcomes.enabled(),
+            **inputs,
+        )
+        if join and seq is not None and _outcomes.enabled():
+            v = Verdict(tier)
+            v.seq = seq
+            return v
     return tier
 
 
@@ -296,8 +341,11 @@ def enabled_for(a_hlc, b_hlc) -> bool:
     facades' gate (and_cardinality/intersects): their batched kernels are
     CPU-only, so the verdict is computed — and recorded — with the device
     tier excluded; the materializing facades call :func:`route` directly
-    and pass the three-way verdict into ``pairwise``."""
-    return route(a_hlc, b_hlc, allow_device=False) != "per-container"
+    and pass the three-way verdict into ``pairwise``. ``join=False``:
+    the count-only kernels run outside any scope that could resolve the
+    outcome, so the verdict records provenance without parking a pending
+    join (ISSUE 11)."""
+    return route(a_hlc, b_hlc, allow_device=False, join=False) != "per-container"
 
 
 def enabled_for_fold(n_rows: int) -> bool:

@@ -207,6 +207,45 @@ def decisions(n: int = None) -> list:
     return observe.decisions.decisions(n)
 
 
+def outcomes(n: int = None) -> list:
+    """Decision-outcome joins (ISSUE 11): the newest ``n`` entries of the
+    bounded ledger (all retained when None), oldest first. Each entry is
+    one verdict scored against reality: the deciding site, the engine
+    that actually ran, the measured wall, the prediction it was made
+    under (``predicted_us`` / ``inputs.est_card``), the
+    predicted/measured error ratio, and the regret seconds — wall lost
+    to the wrong verdict, either priced from the not-taken alternatives'
+    calibrated curves or measured outright (evict-then-repack, wasted
+    ladder attempts)."""
+    from . import observe
+
+    return observe.outcomes.tail(n)
+
+
+def regret_summary() -> dict:
+    """Per-site regret rollup (ISSUE 11): join counts, total regret
+    seconds, geometric-mean error ratio, and the worst recent decision
+    with its inputs — plus the per-coefficient calibration-drift gauges
+    and the cost models' provenance. ``scripts/rb_top.py`` renders this
+    as the regret panel."""
+    from . import columnar, observe
+    # the query package re-exports plan() the function; the module itself
+    # is reachable via the from-import form (sys.modules resolution, the
+    # observe.histogram import-note pattern)
+    from .query.plan import CARD_MODEL
+
+    return {
+        "sites": observe.outcomes.summary(),
+        "drift": observe.outcomes.drift(),
+        "pending": observe.outcomes.LEDGER.pending_count(),
+        "provenance": {
+            "columnar": columnar.MODEL.provenance if columnar.MODEL.calibrated
+            else "default-gate",
+            "planner_cardinality": CARD_MODEL.provenance,
+        },
+    }
+
+
 def observatory() -> dict:
     """Resource-observatory snapshot (ISSUE 9): lock-wait quantiles over
     the framework locks (empty until ``observe.lockstats.install()``),
@@ -227,6 +266,7 @@ def observatory() -> dict:
         "breakers": ladder.LADDER.states(),
         "pack_cache": store.PACK_CACHE.stats(),
         "decisions": decisions(32),
+        "regret": regret_summary(),
     }
 
 

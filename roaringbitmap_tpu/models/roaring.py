@@ -59,11 +59,26 @@ def hlc_fingerprint(hlc) -> tuple:
     ``RoaringBitmap.fingerprint()`` delegates here, and consumers that
     only hold an hlc (the columnar router's PACK_CACHE residency probe)
     must use this same function so their cache keys can never drift from
-    what ``device.rows_for`` stores under."""
+    what ``device.rows_for`` stores under.
+
+    The tuple is CACHED on the container array (``_fp``, invalidated by
+    every version bump — ISSUE 11 satellite): the warm pack-cache lookup
+    walks 10k of these per call, and rebuilding 10k tuples per lookup was
+    the delta wall's dominant stage (r12). A cached fingerprint is also
+    the SAME object across calls, so the pack-cache key comparison on a
+    warm hit degenerates to identity checks."""
+    fp = getattr(hlc, "_fp", None)
+    if fp is not None:
+        return fp
     gen = getattr(hlc, "_gen", None)
     if gen is None:  # mapped/immutable container arrays never mutate
         return ("static", id(hlc))
-    return (gen, hlc._version)
+    fp = (gen, hlc._version)
+    try:
+        hlc._fp = fp
+    except AttributeError:  # foreign mutable hlc without the cache slot
+        pass
+    return fp
 
 
 def _check_value(x: int) -> int:
@@ -430,9 +445,13 @@ class RoaringBitmap:
         tier = col.route(
             x1.high_low_container, x2.high_low_container, op="and"
         )
-        if tier != "per-container":
-            return col.pairwise("and", x1, x2, tier=tier)
-        return RoaringBitmap._and_percontainer(x1, x2)
+        # outcome scope (ISSUE 11): the verdict's measured wall joins the
+        # decision it came from; per-container executions join too (the
+        # refit needs live samples from every engine)
+        with col.outcome(tier):
+            if tier != "per-container":
+                return col.pairwise("and", x1, x2, tier=tier)
+            return RoaringBitmap._and_percontainer(x1, x2)
 
     @staticmethod
     def _and_percontainer(x1: "RoaringBitmap", x2: "RoaringBitmap") -> "RoaringBitmap":
@@ -471,9 +490,10 @@ class RoaringBitmap:
         tier = col.route(
             x1.high_low_container, x2.high_low_container, op="or"
         )
-        if tier != "per-container":
-            return col.pairwise("or", x1, x2, tier=tier)
-        return RoaringBitmap._merge_op(x1, x2, "or")
+        with col.outcome(tier):
+            if tier != "per-container":
+                return col.pairwise("or", x1, x2, tier=tier)
+            return RoaringBitmap._merge_op(x1, x2, "or")
 
     @staticmethod
     def xor(x1: "RoaringBitmap", x2: "RoaringBitmap", *more: "RoaringBitmap") -> "RoaringBitmap":
@@ -485,9 +505,10 @@ class RoaringBitmap:
         tier = col.route(
             x1.high_low_container, x2.high_low_container, op="xor"
         )
-        if tier != "per-container":
-            return col.pairwise("xor", x1, x2, tier=tier)
-        return RoaringBitmap._merge_op(x1, x2, "xor")
+        with col.outcome(tier):
+            if tier != "per-container":
+                return col.pairwise("xor", x1, x2, tier=tier)
+            return RoaringBitmap._merge_op(x1, x2, "xor")
 
     @staticmethod
     def _merge_op(x1, x2, op: str, reuse_left: bool = False) -> "RoaringBitmap":
@@ -588,8 +609,17 @@ class RoaringBitmap:
         tier = col.route(
             x1.high_low_container, x2.high_low_container, op="andnot"
         )
-        if tier != "per-container":
-            return col.pairwise("andnot", x1, x2, reuse_left=_reuse_left, tier=tier)
+        with col.outcome(tier):
+            if tier != "per-container":
+                return col.pairwise(
+                    "andnot", x1, x2, reuse_left=_reuse_left, tier=tier
+                )
+            return RoaringBitmap._andnot_percontainer(x1, x2, _reuse_left)
+
+    @staticmethod
+    def _andnot_percontainer(
+        x1: "RoaringBitmap", x2: "RoaringBitmap", _reuse_left: bool
+    ) -> "RoaringBitmap":
         out = RoaringBitmap()
         a, b = x1.high_low_container, x2.high_low_container
         akeys, acont, na = a.keys, a.containers, len(a.keys)
@@ -754,13 +784,14 @@ class RoaringBitmap:
     def _inplace_merge(self, other: "RoaringBitmap", op: str):
         col = _columnar()
         tier = col.route(self.high_low_container, other.high_low_container, op=op)
-        if tier != "per-container":
-            return col.pairwise(
-                op, self, other, reuse_left=True, tier=tier
+        with col.outcome(tier):
+            if tier != "per-container":
+                return col.pairwise(
+                    op, self, other, reuse_left=True, tier=tier
+                ).high_low_container
+            return RoaringBitmap._merge_op(
+                self, other, op, reuse_left=True
             ).high_low_container
-        return RoaringBitmap._merge_op(
-            self, other, op, reuse_left=True
-        ).high_low_container
 
     def iandnot(self, other: "RoaringBitmap") -> "RoaringBitmap":
         self.high_low_container = RoaringBitmap.andnot(

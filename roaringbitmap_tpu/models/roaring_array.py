@@ -33,7 +33,7 @@ _GEN = itertools.count(1)
 
 class RoaringArray:
     __slots__ = ("keys", "containers", "_gen", "_version", "_key_versions",
-                 "_unattributed_version")
+                 "_unattributed_version", "_fp", "_fp_ident")
 
     def __init__(self):
         self.keys: List[int] = []
@@ -45,6 +45,16 @@ class RoaringArray:
         # version of the most recent wholesale (key-less) mutation; dirty
         # queries with an older baseline cannot be answered incrementally
         self._unattributed_version = 0
+        # cached fingerprint tuple + cache-identity tuple (ISSUE 11
+        # satellite): every mutator invalidates _fp (the version moved);
+        # _fp_ident depends only on the generation, which is fixed at
+        # construction, so it never invalidates. The 10k-operand warm
+        # lookup path walks fingerprints on EVERY call — caching turns
+        # that walk from 2 tuple allocations per bitmap per call into two
+        # attribute loads (and stops the allocation burst that made the
+        # walk the delta wall's dominant stage, BENCH_NOTES r12).
+        self._fp: "Optional[Tuple[int, int]]" = None
+        self._fp_ident: "Optional[Tuple[str, int]]" = None
 
     @property
     def size(self) -> int:
@@ -73,6 +83,7 @@ class RoaringArray:
         without going through a mutator method."""
         self._version += 1
         self._key_versions[key] = self._version
+        self._fp = None
 
     def mark_all_dirty(self) -> None:
         """Record a wholesale mutation that cannot be attributed to
@@ -80,6 +91,7 @@ class RoaringArray:
         an older baseline will answer None (full repack)."""
         self._version += 1
         self._unattributed_version = self._version
+        self._fp = None
 
     def wholesale_since(self, version: int) -> bool:
         """Did a wholesale (key-less) mutation land after ``version``?

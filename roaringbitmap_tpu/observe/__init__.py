@@ -25,7 +25,10 @@ from .registry import (
     COLUMNAR_CLASS_SECONDS,
     COLUMNAR_ROUTE_TOTAL,
     COMPILE_TOTAL,
+    COSTMODEL_DRIFT_RATIO,
     DEADLINE_TOTAL,
+    DECISION_ERROR_RATIO,
+    DECISION_REGRET_SECONDS,
     DECISION_TOTAL,
     DEFAULT_TIME_BUCKETS,
     DEGRADE_TOTAL,
@@ -35,6 +38,9 @@ from .registry import (
     LOCK_WAIT_SECONDS,
     KERNEL_DISPATCH_TOTAL,
     KERNEL_PROBE_TOTAL,
+    OUTCOME_ANOMALY_TOTAL,
+    OUTCOME_JOIN_TOTAL,
+    OUTCOME_ORPHANS_TOTAL,
     PACK_CACHE_DELTA_ROWS_TOTAL,
     PACK_CACHE_EVICTED_BYTES_TOTAL,
     PACK_CACHE_HITS_TOTAL,
@@ -82,8 +88,12 @@ from .timeline import FlightRecorder, TimelineEvent
 from . import context
 from . import decisions
 from . import compilewatch
+# the decision-outcome ledger (ISSUE 11): joins decisions to measured
+# executions; imported after decisions (it is decisions' lazy dependency)
+from . import outcomes
 from .context import adopt, current_trace, new_trace_id, trace_scope
 from .decisions import DecisionLog, record_decision
+from .outcomes import OutcomeLedger
 from .spans import current_path, depth, reset_spans, span, span_timings
 
 # the .histogram submodule import above shadows the registration helper on
@@ -170,8 +180,15 @@ __all__ = [
     "COMPILE_TOTAL",
     "HBM_ACCOUNTING_DRIFT_BYTES",
     "DECISION_TOTAL",
+    "DECISION_REGRET_SECONDS",
+    "DECISION_ERROR_RATIO",
+    "OUTCOME_JOIN_TOTAL",
+    "OUTCOME_ORPHANS_TOTAL",
+    "OUTCOME_ANOMALY_TOTAL",
+    "COSTMODEL_DRIFT_RATIO",
     "context",
     "decisions",
+    "outcomes",
     "compilewatch",
     "trace_scope",
     "adopt",
@@ -179,4 +196,5 @@ __all__ = [
     "new_trace_id",
     "record_decision",
     "DecisionLog",
+    "OutcomeLedger",
 ]

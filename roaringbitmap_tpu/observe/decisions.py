@@ -41,6 +41,7 @@ pack-cache evictor) nest safely.
 
 from __future__ import annotations
 
+import itertools
 import os
 import threading
 import time
@@ -136,27 +137,54 @@ def enabled() -> bool:
     return _ENABLED
 
 
-def record_decision(site: str, decision: str, /, **inputs) -> None:
+# process-unique decision serials (itertools.count.__next__ is atomic
+# under the GIL): the outcome join key (ISSUE 11) — a serial + the trace
+# id identifies one verdict across the decision log, the pending outcome
+# ledger, and the flight-recorder span attrs it gets threaded into
+_SEQ = itertools.count(1)
+
+
+def record_decision(
+    site: str, decision: str, /, outcome: bool = False, **inputs
+) -> Optional[int]:
     """Record one decision: what was chosen at ``site`` and the inputs
     that drove the choice. Also bumps ``rb_tpu_decision_total{site}`` and
     mirrors a ``decision.<site>`` flight-recorder instant when a timeline
-    mode is active (the instant carries the ambient trace id)."""
+    mode is active (the instant carries the ambient trace id).
+
+    Returns the decision's process-unique serial (``entry["seq"]``).
+    ``outcome=True`` additionally parks the decision in the outcome
+    ledger's pending ring (ISSUE 11) — the site promises to resolve it
+    with the measured execution (``outcomes.resolve``/``measure``), and
+    the returned serial is the join key to thread into the measured
+    span's attrs. Sites whose verdicts have no measurable execution
+    (breaker flips, admits) record as before and stay out of the pending
+    ring. Returns None when recording is disabled."""
     if not _ENABLED:
-        return
+        return None
+    seq = next(_SEQ)
+    trace = _context.current_trace()
     entry: Dict = {
         "ts_ns": time.perf_counter_ns(),
+        "seq": seq,
         "site": site,
         "decision": decision,
-        "trace": _context.current_trace(),
+        "trace": trace,
     }
     if inputs:
         entry["inputs"] = inputs
     LOG.record(entry)
     _DECISION_TOTAL.inc(1, (site,))
+    if outcome:
+        from . import outcomes as _outcomes
+
+        _outcomes.register(seq, site, inputs, trace)
     if _timeline.enabled():
         _timeline.instant(
-            "decision." + site, "decision", decision=decision, **inputs
+            "decision." + site, "decision", decision=decision, seq=seq,
+            **inputs
         )
+    return seq
 
 
 def decisions(n: Optional[int] = None) -> List[dict]:

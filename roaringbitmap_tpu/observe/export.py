@@ -190,6 +190,38 @@ def _latency_summaries(registry: Registry) -> dict:
     return out
 
 
+def _regret_block(snap: dict, registry: Registry) -> dict:
+    """The decision-outcome ledger's sidecar block (ISSUE 11): per-site
+    regret totals + error-ratio quantiles derived from the registry
+    histograms, join/orphan/anomaly volume, and the per-coefficient-cell
+    drift gauges — a pure function of the registry (like everything in
+    the sidecar), so a ``--from`` rendering needs no live process."""
+    sites: dict = {}
+    regret = registry.get(_registry.DECISION_REGRET_SECONDS)
+    if isinstance(regret, LatencyHistogram):
+        for lv, st in sorted(regret.series().items()):
+            sites.setdefault("/".join(lv), {}).update(
+                regret_events=st["count"],
+                regret_s=round(st["sum"], 6),
+            )
+    err = snap.get(_registry.DECISION_ERROR_RATIO)
+    if err is not None:
+        err_m = registry.get(_registry.DECISION_ERROR_RATIO)
+        for lv, st in sorted(err_m.series().items()):
+            c = st["count"]
+            sites.setdefault("/".join(lv), {}).update(
+                error_samples=c,
+                error_ratio_mean=round(st["sum"] / c, 4) if c else None,
+            )
+    return {
+        "sites": sites,
+        "joins": _counter_map(snap, _registry.OUTCOME_JOIN_TOTAL),
+        "orphans": _counter_map(snap, _registry.OUTCOME_ORPHANS_TOTAL),
+        "anomalies": _counter_map(snap, _registry.OUTCOME_ANOMALY_TOTAL),
+        "drift": _counter_map(snap, _registry.COSTMODEL_DRIFT_RATIO, joined=True),
+    }
+
+
 def sidecar_snapshot(registry: Optional[Registry] = None) -> dict:
     """The structured summary the bench sidecar persists. Top-level keys
     ``kernel``/``layout``/``transfer_bytes``/``spans`` are the contract
@@ -216,6 +248,9 @@ def sidecar_snapshot(registry: Optional[Registry] = None) -> dict:
         "compile": _counter_map(snap, _registry.COMPILE_TOTAL),
         "hbm_drift": _counter_map(snap, _registry.HBM_ACCOUNTING_DRIFT_BYTES),
         "decisions": _counter_map(snap, _registry.DECISION_TOTAL),
+        # decision-outcome ledger (ISSUE 11): per-site regret + error
+        # ratios, join/orphan/anomaly volume, coefficient drift
+        "regret": _regret_block(snap, _reg(registry)),
         "registry": snap,
     }
 

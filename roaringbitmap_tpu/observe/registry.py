@@ -82,6 +82,15 @@ LOCK_WAIT_SECONDS = "rb_tpu_lock_wait_seconds"
 COMPILE_TOTAL = "rb_tpu_compile_total"
 HBM_ACCOUNTING_DRIFT_BYTES = "rb_tpu_hbm_accounting_drift_bytes"
 DECISION_TOTAL = "rb_tpu_decision_total"
+# decision-outcome ledger (ISSUE 11): per-site routing regret and
+# predicted-vs-measured error, join/orphan/anomaly volume, and the
+# per-coefficient-cell calibration-drift gauge over the cost model
+DECISION_REGRET_SECONDS = "rb_tpu_decision_regret_seconds"
+DECISION_ERROR_RATIO = "rb_tpu_decision_error_ratio"
+OUTCOME_JOIN_TOTAL = "rb_tpu_outcome_join_total"
+OUTCOME_ORPHANS_TOTAL = "rb_tpu_outcome_orphans_total"
+OUTCOME_ANOMALY_TOTAL = "rb_tpu_outcome_anomaly_total"
+COSTMODEL_DRIFT_RATIO = "rb_tpu_costmodel_drift_ratio"
 
 # upper bucket bounds (seconds) for wall-time histograms: host phases span
 # ~100 µs packing steps to multi-second CPU folds; +Inf is implicit

@@ -324,14 +324,18 @@ def _aggregate(
         tiers.extend(_cpu_tiers(bitmaps, keys, n, op, pool=pool))
         from .. import columnar
 
-        _decisions.record_decision(
-            "agg.dispatch", tiers[0][0], op=op, rows=n,
+        # outcome=True (ISSUE 11): the ladder resolves this decision with
+        # the tier that actually absorbed the traffic + its measured wall
+        seq = _decisions.record_decision(
+            "agg.dispatch", tiers[0][0], outcome=True, op=op, rows=n,
             operands=len(bitmaps), mode=mode or config.mode,
             # cost-model provenance (ISSUE 10): the measured fold gate the
             # CPU-tier choice consulted (config default when uncalibrated)
             fold_gate=columnar.MODEL.fold_gate_rows(),
         )
-        return _ladder.LADDER.run("agg", tiers)
+        return _ladder.LADDER.run(
+            "agg", tiers, outcome_seq=seq, outcome_site="agg.dispatch"
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -601,12 +605,14 @@ def _aggregate_cardinality(bitmaps: List[RoaringBitmap], op: str, mode) -> int:
             (name, (lambda fn=fn: fn().get_cardinality()))
             for name, fn in _cpu_tiers(bitmaps, keys, n, op)
         )
-        _decisions.record_decision(
-            "agg.dispatch", tiers[0][0], op=op, rows=n,
+        seq = _decisions.record_decision(
+            "agg.dispatch", tiers[0][0], outcome=True, op=op, rows=n,
             operands=len(bitmaps), mode=mode or config.mode,
             cardinality_only=True,
         )
-        return _ladder.LADDER.run("agg", tiers)
+        return _ladder.LADDER.run(
+            "agg", tiers, outcome_seq=seq, outcome_site="agg.dispatch"
+        )
 
 
 class ParallelAggregation:

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time as _time
 import weakref
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -41,6 +42,7 @@ import jax.numpy as jnp
 
 from .. import observe as _observe
 from ..observe import decisions as _decisions
+from ..observe import outcomes as _outcomes
 from ..observe import timeline as _timeline
 from ..robust import errors as _rerrors
 from ..robust import faults as _faults
@@ -1482,6 +1484,49 @@ def _fp_ident(fp: tuple):
     return ("g", fp[0])
 
 
+def _walk_fingerprints(bitmaps) -> Tuple[tuple, tuple]:
+    """ONE fused pass over the working set producing ``(fps, idents)``
+    with per-hlc caching (ISSUE 11 satellite: the warm/delta path walked
+    fingerprints once and identities again — 2 method calls + 2 tuple
+    allocations per bitmap per lookup, the dominant stage of the O(k)
+    delta wall at 10k operands). Fingerprint tuples cache on the array
+    (invalidated per version bump); identity tuples depend only on the
+    generation and cache for the array's lifetime. A warm lookup
+    therefore allocates nothing per bitmap."""
+    fps: List[tuple] = []
+    idents: List[tuple] = []
+    fps_append, idents_append = fps.append, idents.append
+    for bm in bitmaps:
+        hlc = bm.high_low_container
+        fp = getattr(hlc, "_fp", None)
+        if fp is None:
+            gen = getattr(hlc, "_gen", None)
+            if gen is None:  # static (mapped/immutable): never mutates
+                fp = ("static", id(hlc))
+                fps_append(fp)
+                idents_append(("s",) + fp[1:])
+                continue
+            fp = (gen, hlc._version)
+            try:
+                hlc._fp = fp
+            except AttributeError:  # foreign mutable hlc without the slot
+                fps_append(fp)
+                idents_append(("g", gen))
+                continue
+        # guarded like _fp: a foreign mutable hlc with a __dict__ caches
+        # _fp successfully yet has no _fp_ident until we store one
+        ident = getattr(hlc, "_fp_ident", None)
+        if ident is None:
+            ident = ("g", hlc._gen)
+            try:
+                hlc._fp_ident = ident
+            except AttributeError:
+                pass
+        fps_append(fp)
+        idents_append(ident)
+    return tuple(fps), tuple(idents)
+
+
 def static_fp_refs(bitmaps: Sequence[RoaringBitmap]) -> tuple:
     """The container arrays of operands with ("static", id) fingerprints —
     cache entries hold these so the ids stay live (see _PackEntry.refs)."""
@@ -1530,11 +1575,19 @@ class PackCache:
         self.max_bytes = int(max_bytes)  # guarded-by: self._lock
         self._entries: "OrderedDict[tuple, _PackEntry]" = OrderedDict()  # guarded-by: self._lock
         self._ident: Dict[tuple, tuple] = {}  # guarded-by: self._lock
+        # recently evicted working sets -> eviction decision serial
+        # (ISSUE 11): a miss that re-packs a remembered eviction joins the
+        # evict decision with the re-pack wall as measured regret — the
+        # eviction was wrong exactly when its key came back while we still
+        # remember throwing it out. Bounded ring, oldest forgotten.
+        self._evicted_seqs: "OrderedDict[tuple, int]" = OrderedDict()  # guarded-by: self._lock
         self._bytes = 0  # guarded-by: self._lock
         self.hits = 0  # guarded-by: self._lock
         self.misses = 0  # guarded-by: self._lock
         self.delta_rows = 0  # guarded-by: self._lock
         self.evictions = 0  # guarded-by: self._lock
+
+    _EVICTED_SEQS_CAP = 256
 
     # -- public API --------------------------------------------------------
 
@@ -1549,12 +1602,14 @@ class PackCache:
         marker = "all" if keys_filter is None else "and"
         # stage-attributed (ISSUE 8): with the delta scatter at O(k) the
         # fingerprint walk is a visible share of the delta wall — the
-        # timeline must name it, not leave it as unattributed residue
+        # timeline must name it, not leave it as unattributed residue.
+        # Since ISSUE 11 it is ONE fused, per-hlc-cached pass producing
+        # fingerprints AND identities (zero allocations per bitmap warm).
         with _timeline.stage(
             _PACK_STAGE_SECONDS, "fingerprints", "pack.fingerprints",
             cat="pack", operands=len(bitmaps),
         ):
-            fps = tuple(bm.fingerprint() for bm in bitmaps)
+            fps, idents = _walk_fingerprints(bitmaps)
         key = ("agg", marker, fps)
         if self.max_bytes <= 0:  # disabled: always a fresh uncached pack
             with self._lock:
@@ -1562,7 +1617,7 @@ class PackCache:
             _PACK_MISSES.inc(1, ("agg",))
             # no entry will exist, so skip the (discarded) row provenance
             return pack_groups(group_by_key(bitmaps, keys_filter=keys_filter))
-        ident = ("agg", marker, tuple(_fp_ident(fp) for fp in fps))
+        ident = ("agg", marker, idents)
         with self._lock:
             e = self._entries.get(key)
             if e is not None:
@@ -1597,7 +1652,20 @@ class PackCache:
         # full repack outside the lock (packing dominates; a racing thread
         # packing the same key is benign — first store wins)
         _timeline.instant("pack_cache.miss", "cache", kind="agg")
+        with self._lock:
+            evict_seq = self._evicted_seqs.pop(ident, None)
+        t0 = _time.perf_counter()
         packed, row_map = pack_groups_with_provenance(bitmaps, keys_filter)
+        if evict_seq is not None:
+            # the evicted working set came back while its eviction is
+            # still remembered: the re-pack wall is the eviction's
+            # measured regret (ISSUE 11 — the decision-outcome join's
+            # measured-counterfactual form)
+            repack_s = _time.perf_counter() - t0
+            _outcomes.resolve(
+                evict_seq, "pack_cache.evict", repack_s, engine="repack",
+                regret_s=repack_s,
+            )
         with self._lock:
             self.misses += 1
         _PACK_MISSES.inc(1, ("agg",))
@@ -1633,7 +1701,17 @@ class PackCache:
                 )
                 return e.value
         _timeline.instant("pack_cache.miss", "cache", kind=kind)
+        with self._lock:
+            evict_seq = self._evicted_seqs.pop(key, None)
+        t0 = _time.perf_counter()
         value, nbytes = build()
+        if evict_seq is not None:
+            # re-build of a remembered eviction: measured regret (ISSUE 11)
+            rebuild_s = _time.perf_counter() - t0
+            _outcomes.resolve(
+                evict_seq, "pack_cache.evict", rebuild_s, engine="rebuild",
+                regret_s=rebuild_s,
+            )
         with self._lock:
             self.misses += 1
         _PACK_MISSES.inc(1, (kind,))
@@ -1884,14 +1962,21 @@ class PackCache:
             _timeline.instant(
                 "pack_cache.evict", "cache", kind=e.kind, bytes=e.nbytes
             )
-            _decisions.record_decision(
-                "pack_cache.evict", "lru", kind=e.kind, bytes=e.nbytes,
-                target_bytes=target,
+            seq = _decisions.record_decision(
+                "pack_cache.evict", "lru", outcome=True, kind=e.kind,
+                bytes=e.nbytes, target_bytes=target,
             )
             ident = ("agg", e.key[1], tuple(_fp_ident(fp) for fp in e.fps)) \
                 if e.kind == "agg" else None
             if ident is not None and self._ident.get(ident) == key:
                 del self._ident[ident]  # rb-ok: lock-discipline -- caller holds self._lock
+            if seq is not None:
+                # remember the eviction by its identity (agg: the gen
+                # tuple, so a delta-mutated return still matches) for the
+                # miss-side regret join
+                self._evicted_seqs[ident if ident is not None else key] = seq  # rb-ok: lock-discipline -- caller holds self._lock
+                while len(self._evicted_seqs) > self._EVICTED_SEQS_CAP:
+                    self._evicted_seqs.popitem(last=False)  # rb-ok: lock-discipline -- caller holds self._lock
             self._release(e)
 
     def _try_delta(self, e, bitmaps, keys_filter, new_fps):

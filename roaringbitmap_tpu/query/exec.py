@@ -37,11 +37,13 @@ cache, ``rb_tpu_query_plan_total{engine}`` from the planner, and
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 from typing import Dict, List, Optional, Union
 
 from .. import observe as _observe
 from ..observe import context as _context
+from ..observe import outcomes as _outcomes
 from ..observe import timeline as _timeline
 from ..robust import faults as _faults
 from ..robust import ladder as _ladder
@@ -146,10 +148,23 @@ def _execute_traced(query, cache, mode, deadline_s) -> RoaringBitmap:
                 _timeline.instant(
                     "query.deadline_degrade", "query", engine=step.engine
                 )
+            seq = step.decision_seq
+            t0 = time.perf_counter() if seq is not None else 0.0
             with _timeline.tspan(
-                "query.step", "query", engine=step.engine, op=step.node.op
+                "query.step", "query", engine=step.engine, op=step.node.op,
+                decision=seq,
             ):
                 val = _run_step(step, inputs, force_cpu=force_cpu)
+            if seq is not None:
+                # resolve the planner decision ONCE (ISSUE 11): measured
+                # step wall + actual result cardinality against the
+                # plan-time estimate; a memoized plan's later executions
+                # ride with the serial already cleared
+                step.decision_seq = None
+                _outcomes.resolve(
+                    seq, "query.plan", time.perf_counter() - t0,
+                    engine=step.engine, actual=max(1, val.get_cardinality()),
+                )
             if cache is not None:
                 cache.put(key, val)
             results[step.node.uid] = val

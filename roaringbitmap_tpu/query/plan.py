@@ -50,6 +50,8 @@ executor (exec.py) runs bottom-up with result memoization.
 
 from __future__ import annotations
 
+import math
+import threading
 from typing import Dict, List, Optional, Tuple
 
 from .. import observe as _observe
@@ -63,6 +65,116 @@ _PLAN_TOTAL = _observe.counter(
     "Planned query steps by chosen engine",
     ("engine",),
 )
+
+
+class CardinalityModel:
+    """The planner's refittable cardinality model (ISSUE 11).
+
+    The structural estimators below (and=min, or/xor=capped sum,
+    andnot=minuend, threshold=sum/k) are exact bounds but systematically
+    biased on real traffic (an AND of correlated filters lands far under
+    ``min``; a union of overlapping dimensions far under ``sum``). Each
+    op carries a multiplicative correction, 1.0 until
+    :meth:`refit_from_outcomes` learns a better one from the decision–
+    outcome join: every executed plan step resolves its ``query.plan``
+    decision with the measured result cardinality, and the refit moves
+    ``correction[op]`` by the geometric mean of measured/estimated over
+    the joined samples — the same measured-not-guessed discipline as
+    ``columnar.costmodel``, applied to the planner's own prediction.
+
+    Thread-safe: corrections swap under a leaf lock; reads are lock-free
+    dict gets (atomic under the GIL)."""
+
+    OPS = ("and", "or", "xor", "andnot", "threshold")
+    # a single refit moves a correction at most this factor either way —
+    # one weird traffic window must not be able to invert the planner's
+    # operand ordering outright
+    MAX_STEP = 8.0
+    MAX_CORRECTION = 64.0
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.corrections: Dict[str, float] = {op: 1.0 for op in self.OPS}
+        self.provenance = "default"
+
+    def corrected(self, op: str, est: int) -> int:
+        c = self.corrections.get(op, 1.0)
+        if c == 1.0:
+            return est
+        return max(0, min(_MAX32, int(est * c)))
+
+    def refit_from_outcomes(
+        self, samples: Optional[List[dict]] = None, min_samples: int = 4
+    ) -> dict:
+        """Refit the per-op corrections from joined ``query.plan``
+        outcomes (default: the live outcome ledger). A sample must carry
+        the op, a positive estimate, and a positive measured cardinality;
+        ratios outside ``[2^-20, 2^20]`` are poisoned (a joined sample
+        cannot legitimately miss by a million-fold — that is corrupt
+        telemetry, not bias) and are rejected, counted in the report."""
+        if samples is None:
+            from ..observe import outcomes as _outcomes
+
+            samples = _outcomes.tail()
+        ratios: Dict[str, List[float]] = {}
+        rejected = 0
+        for s in samples:
+            if s.get("site") not in (None, "query.plan"):
+                continue
+            inputs = s.get("inputs") or {}
+            op = inputs.get("op") or s.get("op")
+            est = inputs.get("est_card", s.get("est_card"))
+            actual = s.get("actual")
+            if op not in self.corrections:
+                continue
+            try:
+                est = float(est)
+                actual = float(actual)
+            except (TypeError, ValueError):
+                rejected += 1
+                continue
+            if not (est > 0 and actual > 0 and math.isfinite(est)
+                    and math.isfinite(actual)):
+                rejected += 1
+                continue
+            r = actual / est
+            if not (2.0 ** -20 <= r <= 2.0 ** 20):
+                rejected += 1
+                continue
+            ratios.setdefault(op, []).append(r)
+        moved = {}
+        with self._lock:
+            for op, rs in ratios.items():
+                if len(rs) < min_samples:
+                    continue
+                step = math.exp(sum(math.log(r) for r in rs) / len(rs))
+                step = min(self.MAX_STEP, max(1.0 / self.MAX_STEP, step))
+                new = self.corrections[op] * step
+                new = min(self.MAX_CORRECTION, max(1.0 / self.MAX_CORRECTION, new))
+                if new != self.corrections[op]:
+                    moved[op] = {
+                        "from": round(self.corrections[op], 4),
+                        "to": round(new, 4),
+                        "samples": len(rs),
+                    }
+                    self.corrections[op] = new
+            if moved:
+                self.provenance = "refit-from-traffic"
+        report = {"moved": moved, "rejected": rejected,
+                  "provenance": self.provenance}
+        _decisions.record_decision(
+            "costmodel.refit", "query-cardinality",
+            moved=len(moved), rejected=rejected, provenance=self.provenance,
+        )
+        return report
+
+    def reset(self) -> None:
+        with self._lock:
+            self.corrections = {op: 1.0 for op in self.OPS}
+            self.provenance = "default"
+
+
+CARD_MODEL = CardinalityModel()
 
 
 # ---------------------------------------------------------------------------
@@ -241,17 +353,23 @@ def _fold_not(x: Expr, universe: Expr, fold, cards) -> Expr:
 
 class PlanStep:
     """One executable node: ``engine`` applied to ``operands`` (child nodes
-    in chosen evaluation order)."""
+    in chosen evaluation order). ``decision_seq`` is the planner
+    decision's serial (ISSUE 11) — the executor resolves it once with the
+    measured step wall + result cardinality, then clears it (a memoized
+    plan re-executes, but one decision joins one outcome)."""
 
-    __slots__ = ("index", "node", "engine", "operands", "est_card", "est_rows")
+    __slots__ = ("index", "node", "engine", "operands", "est_card",
+                 "est_rows", "decision_seq")
 
-    def __init__(self, index, node, engine, operands, est_card, est_rows):
+    def __init__(self, index, node, engine, operands, est_card, est_rows,
+                 decision_seq=None):
         self.index = index
         self.node = node
         self.engine = engine
         self.operands = operands
         self.est_card = est_card
         self.est_rows = est_rows
+        self.decision_seq = decision_seq
 
 
 class Plan:
@@ -304,17 +422,22 @@ def _estimates(node: Expr, est: Dict[int, Tuple[int, int]], cards) -> Tuple[int,
                 rows = max(1, card // 4096)
         return card, rows
     kid = [est[c.uid] for c in node.children]
+    # structural bound first, then the refittable per-op correction
+    # (ISSUE 11): CARD_MODEL learns the traffic's systematic bias from
+    # the decision-outcome join (measured result cardinalities)
     if node.op == "and":
-        return min(c for c, _ in kid), len(kid) * min(r for _, r in kid)
-    if node.op in ("or", "xor"):
-        return min(sum(c for c, _ in kid), _MAX32), sum(r for _, r in kid)
-    if node.op == "andnot":
+        card, rows = min(c for c, _ in kid), len(kid) * min(r for _, r in kid)
+    elif node.op in ("or", "xor"):
+        card, rows = min(sum(c for c, _ in kid), _MAX32), sum(r for _, r in kid)
+    elif node.op == "andnot":
         # the difference is bounded by the minuend; subtrahend rows count
         # because the n-way kernel folds them over the minuend's keys
-        return kid[0][0], sum(r for _, r in kid)
-    if node.op == "threshold":
-        return sum(c for c, _ in kid) // node.k, sum(r for _, r in kid)
-    raise ValueError(f"unplannable op {node.op!r} (rewrite should have lowered it)")
+        card, rows = kid[0][0], sum(r for _, r in kid)
+    elif node.op == "threshold":
+        card, rows = sum(c for c, _ in kid) // node.k, sum(r for _, r in kid)
+    else:
+        raise ValueError(f"unplannable op {node.op!r} (rewrite should have lowered it)")
+    return CARD_MODEL.corrected(node.op, card), rows
 
 
 def _choose_engine(node: Expr, est_rows: int, mode: Optional[str]) -> str:
@@ -378,13 +501,19 @@ def plan(expr: Expr, mode: Optional[str] = None) -> Plan:
             labels[node.uid] = f"s{len(steps)}"
             # decision provenance (ISSUE 9): the per-node engine choice
             # with the cost-model inputs that drove it — "why did this
-            # node ride the device" is answerable from insights.decisions()
-            _decisions.record_decision(
-                "query.plan", engine, op=node.op,
+            # node ride the device" is answerable from insights.decisions().
+            # outcome=True (ISSUE 11): the executor resolves the serial
+            # with the measured step wall + actual result cardinality,
+            # which is what the cardinality model refits from.
+            seq = _decisions.record_decision(
+                "query.plan", engine, outcome=True, op=node.op,
                 est_card=int(card), est_rows=int(rows),
                 operands=len(node.children), mode=mode,
             )
-            steps.append(PlanStep(len(steps), node, engine, operands, card, rows))
+            steps.append(
+                PlanStep(len(steps), node, engine, operands, card, rows,
+                         decision_seq=seq)
+            )
         leaf_cards = {l.uid: _leaf_card(l, cards) for l in root.leaves}
         return Plan(root, steps, labels, leaf_cards)
 

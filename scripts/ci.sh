@@ -450,6 +450,79 @@ print("observatory blocks ok (locks %s; compiles %s; ledger drift 0; decisions %
       % (sorted(m["lock_wait"]), sum(m["compile"].values()),
          sum(m["decisions"].values())))'
 
+step "decision-outcome ledger: regret rows + sidecar block (ISSUE 11)"
+# the bench must commit the routing_regret row (fraction of measured wall
+# lost to wrong verdicts over the routed window — gated <= 5%), the
+# predicted-vs-measured error-ratio row, the per-site decomposition, the
+# seeded-mispricing refit demonstration (coefficient moved toward
+# measured truth, provenance flipped), and the host-noise bands the
+# variance-aware trend gate consumes; the sidecar must carry the regret
+# block (pure registry derivation) with live joins recorded
+python -c '
+import json
+m = json.load(open("/tmp/ci_bench.json"))["meta"]
+reg = m.get("regret")
+if not isinstance(reg, dict):
+    raise SystemExit("bench meta lacks the regret block")
+need = {"window_wall_s", "regret_s", "routing_regret", "error_ratio_p50",
+        "per_site", "refit"}
+missing = need - set(reg)
+if missing:
+    raise SystemExit("regret block lacks %s" % sorted(missing))
+if not (0.0 <= reg["routing_regret"] <= 0.05):
+    raise SystemExit("routing_regret %s blew the 5%% budget" % reg["routing_regret"])
+if not reg["per_site"].get("columnar.cutoff", {}).get("count", 0) > 0:
+    raise SystemExit("regret window joined no columnar.cutoff outcomes: %r"
+                     % reg["per_site"])
+rf = reg["refit"]
+if rf.get("moved_toward_truth") is not True:
+    raise SystemExit("refit did not move the seeded mispriced cell: %r" % rf)
+if rf.get("provenance") != "refit-from-traffic":
+    raise SystemExit("refit provenance missing: %r" % rf)
+noise = m.get("host_noise")
+if not (isinstance(noise, dict)
+        and {"delta_repack_s", "pack_warm_s"} <= set(noise)):
+    raise SystemExit("host_noise bands missing: %r" % noise)
+for row, rec in noise.items():
+    if not ({"reps", "min", "median", "max", "spread_pct"} <= set(rec)
+            and rec["reps"] >= 2 and 0 < rec["min"] <= rec["max"]):
+        raise SystemExit("host_noise band for %s malformed: %r" % (row, rec))
+side = json.load(open("/tmp/ci_bench_metrics.json"))
+sreg = side.get("regret")
+if not isinstance(sreg, dict):
+    raise SystemExit("metrics sidecar lacks the regret block")
+smissing = {"sites", "joins", "orphans", "anomalies", "drift"} - set(sreg)
+if smissing:
+    raise SystemExit("sidecar regret block lacks %s" % sorted(smissing))
+if not sreg["joins"].get("columnar.cutoff", 0) > 0:
+    raise SystemExit("sidecar records no columnar.cutoff joins: %r" % sreg["joins"])
+if not sreg["drift"]:
+    raise SystemExit("sidecar records no coefficient drift gauges")
+print("regret rows ok (routing_regret %s over %ss window, err p50 %s; "
+      "refit %s -> %s; %d joined sites; noise bands %s)"
+      % (reg["routing_regret"], reg["window_wall_s"], reg["error_ratio_p50"],
+         rf["poisoned"], rf["refit"], len(sreg["joins"]),
+         {k: v["spread_pct"] for k, v in noise.items()}))'
+# the new metric names must pass the naming convention (declared label
+# sets are enforced by analyze --check; this pins the unit suffixes)
+JAX_PLATFORMS=cpu python -c '
+from roaringbitmap_tpu import observe
+for name, suffix in ((observe.DECISION_REGRET_SECONDS, "_seconds"),
+                     (observe.DECISION_ERROR_RATIO, "_ratio"),
+                     (observe.COSTMODEL_DRIFT_RATIO, "_ratio"),
+                     (observe.OUTCOME_JOIN_TOTAL, "_total"),
+                     (observe.OUTCOME_ORPHANS_TOTAL, "_total"),
+                     (observe.OUTCOME_ANOMALY_TOTAL, "_total")):
+    if not (name.startswith("rb_tpu_") and name.endswith(suffix)):
+        raise SystemExit("outcome metric violates naming convention: %r" % name)
+m = observe.REGISTRY.get(observe.DECISION_REGRET_SECONDS)
+if m is None or m.labelnames != ("site",):
+    raise SystemExit("regret histogram label set is not the declared (site,)")
+d = observe.REGISTRY.get(observe.COSTMODEL_DRIFT_RATIO)
+if d is None or d.labelnames != ("group", "engine", "shape"):
+    raise SystemExit("drift gauge label set is not the declared cell tuple")
+print("outcome metric names ok (suffixes + declared label sets)")'
+
 step "query-scoped tracing + off-mode twin rows (ISSUE 9 acceptance)"
 # 100% of lane-emitted events must carry the originating query trace id
 # (explicit handoff across the lane thread), per-trace stage attribution
@@ -481,17 +554,18 @@ if comp.get("steady_state_retraces") != 0:
 print("tracing ok (lane %s events 100%% attributed over %s queries; off-mode %s%%; 0 retraces)"
       % (tr["lane_events"], tr["queries"], obs["off_overhead_pct"]))'
 
-step "rb_top observatory report (schema rb_tpu_top/1, ISSUE 9)"
+step "rb_top observatory report (schema rb_tpu_top/2, ISSUE 9 + 11)"
 # the snapshot CLI must produce a schema-valid JSON report with every
-# panel populated from its in-process demo workload
+# panel populated from its in-process demo workload — incl. the regret
+# panel (per-site joins from the decision-outcome ledger)
 JAX_PLATFORMS=cpu python scripts/rb_top.py --demo --json > /tmp/ci_rb_top.json
 python -c '
 import json
 r = json.load(open("/tmp/ci_rb_top.json"))
-if r.get("schema") != "rb_tpu_top/1":
+if r.get("schema") != "rb_tpu_top/2":
     raise SystemExit("rb_top: bad schema %r" % r.get("schema"))
 need = {"schema", "generated_utc", "source", "counters", "latency",
-        "locks", "breakers", "cache", "decisions_tail"}
+        "locks", "breakers", "cache", "decisions_tail", "regret"}
 missing = need - set(r)
 if missing:
     raise SystemExit("rb_top report lacks %s" % sorted(missing))
@@ -503,9 +577,15 @@ if r["cache"]["hbm"].get("ledger_drift_bytes") != 0:
     raise SystemExit("rb_top demo shows accounting drift: %r" % r["cache"]["hbm"])
 if not r["decisions_tail"]:
     raise SystemExit("rb_top demo decision log is empty")
+reg = r["regret"]
+if not reg.get("sites"):
+    raise SystemExit("rb_top demo joined no decision outcomes: %r" % reg)
+if "provenance" not in reg:
+    raise SystemExit("rb_top regret panel lacks model provenance: %r" % sorted(reg))
 sites = {d["site"] for d in r["decisions_tail"]}
-print("rb_top ok (locks %s; %d decisions over sites %s)"
-      % (sorted(r["locks"]), len(r["decisions_tail"]), sorted(sites)))'
+print("rb_top ok (locks %s; %d decisions over sites %s; regret sites %s)"
+      % (sorted(r["locks"]), len(r["decisions_tail"]), sorted(sites),
+         sorted(reg["sites"])))'
 # the sidecar-sourced rendering must parse the bench artifact too
 python scripts/rb_top.py --from /tmp/ci_bench_metrics.json --json > /dev/null
 

@@ -5,8 +5,11 @@ Renders the framework's observability surface as a single console or
 JSON report: registry counters (kernel dispatch, layouts, pack cache,
 degradations, compiles), latency histograms with p50/p99, lock-wait
 quantiles over the framework locks, circuit-breaker states, pack-cache
-residency + device-memory accounting drift, and the decision-log tail —
-"where did time, memory, and traffic go" in one artifact.
+residency + device-memory accounting drift, the decision-log tail, and
+— since ISSUE 11 — the regret panel: per-site routing regret and
+predicted-vs-measured error from the decision-outcome ledger, with the
+worst recent decision and its inputs — "where did time, memory, traffic,
+and WRONG VERDICTS go" in one artifact.
 
 Three sources::
 
@@ -18,10 +21,11 @@ Three sources::
                                                # (useful when imported:
                                                #  rb_top.report())
 
-``--json`` emits the machine-readable report (schema ``rb_tpu_top/1``;
-scripts/ci.sh validates it). Breaker states and the decision log are
-process-local, so a sidecar-sourced report carries the sidecar's counter
-view of them (transition counts) rather than live states.
+``--json`` emits the machine-readable report (schema ``rb_tpu_top/2``:
+the ``regret`` key landed in /2; scripts/ci.sh validates it). Breaker
+states, the decision log, and the outcome ledger are process-local, so a
+sidecar-sourced report carries the sidecar's registry view of them
+(counter totals + the ``regret`` block) rather than live states.
 """
 
 from __future__ import annotations
@@ -35,7 +39,7 @@ import time
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
-SCHEMA = "rb_tpu_top/1"
+SCHEMA = "rb_tpu_top/2"
 
 
 def _live_report(tail: int) -> dict:
@@ -62,6 +66,9 @@ def _live_report(tail: int) -> dict:
         "breakers": obs["breakers"],
         "cache": {"stats": obs["pack_cache"], "hbm": obs["hbm"]},
         "decisions_tail": insights.decisions(tail),
+        # decision-outcome ledger (ISSUE 11): per-site regret + error
+        # rollup, coefficient drift, model provenance
+        "regret": insights.regret_summary(),
     }
 
 
@@ -103,6 +110,10 @@ def _sidecar_report(path: str, tail: int) -> dict:
         "breakers": counter_map("rb_tpu_breaker_transitions_total"),
         "cache": {"stats": None, "hbm": counter_map("rb_tpu_hbm_accounting_drift_bytes")},
         "decisions_tail": [],
+        # the sidecar's registry-derived regret block (sites carry
+        # regret_s + error means; joins/orphans/anomalies/drift ride
+        # alongside) — rendered under the same panel as the live rollup
+        "regret": side.get("regret", {}),
     }
 
 
@@ -177,6 +188,46 @@ def _render_console(r: dict) -> str:
                  f"n={st['count']} p50={st['p50']:.6f} p99={st['p99']:.6f}")
             )
     section("latency (p50/p99 s)", lat_rows[:40])
+    # regret panel (ISSUE 11): per-site wall lost to wrong verdicts +
+    # predicted-vs-measured error, then the worst recent decision with
+    # the inputs that drove it (live reports) — the "which pricing
+    # authority is lying, and how badly" view
+    reg = r.get("regret", {}) or {}
+    reg_rows = []
+    worst_rows = []
+    for site, s in sorted((reg.get("sites") or {}).items()):
+        if "count" in s:  # live rollup shape
+            err = s.get("error_ratio_geomean")
+            reg_rows.append(
+                (site,
+                 f"joins={s['count']} regret={s['regret_s']:.6f}s"
+                 + (f" err_geomean={err}" if err is not None else ""))
+            )
+            w = s.get("worst")
+            if w and w.get("regret_s"):
+                worst_rows.append(
+                    (site,
+                     f"{w.get('engine')} measured={w['measured_s']:.6f}s "
+                     f"regret={w['regret_s']:.6f}s inputs={w.get('inputs', {})}")
+                )
+        else:  # sidecar registry shape
+            reg_rows.append(
+                (site,
+                 f"regret={s.get('regret_s', 0)}s over "
+                 f"{s.get('regret_events', 0)} event(s), "
+                 f"err_mean={s.get('error_ratio_mean')}")
+            )
+    for cell, v in sorted((reg.get("drift") or {}).items()):
+        reg_rows.append((f"drift {cell}", v))
+    orphans = reg.get("orphans")
+    if orphans:
+        reg_rows.append(("orphans", dict(orphans)))
+    prov = reg.get("provenance")
+    if prov:
+        reg_rows.append(("provenance", prov))
+    section("regret (decision-outcome ledger)", reg_rows)
+    if worst_rows:
+        section("worst recent decisions", worst_rows)
     dec_rows = [
         (d.get("trace") or "-",
          f"{d['site']}: {d['decision']} {d.get('inputs', '')}")

@@ -1,0 +1,551 @@
+"""Decision-outcome ledger (ISSUE 11): join mechanics (pending ring
+overflow -> orphans, never a crash), regret pricing from the not-taken
+alternatives, the calibrated-band anomaly watch, the 16-thread hammer
+with the lock witness proving the ledger lock stays a leaf, the
+refit round trip (poisoned outcomes rejected, provenance recorded and
+persisted), the planner cardinality-model refit, the end-to-end joins at
+every instrumented site, and the cached fingerprint walk satellite."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import RoaringBitmap, columnar, insights, observe
+from roaringbitmap_tpu.analysis.lockwitness import LockWitness
+from roaringbitmap_tpu.columnar import costmodel
+from roaringbitmap_tpu.observe import decisions, outcomes
+from roaringbitmap_tpu.observe import timeline as tl
+from roaringbitmap_tpu.parallel import aggregation, store
+from roaringbitmap_tpu.query import Q, execute
+from roaringbitmap_tpu.query.plan import CARD_MODEL
+
+
+@pytest.fixture(autouse=True)
+def clean_ledger():
+    outcomes.reset()
+    outcomes.configure(enabled=True, band=outcomes.DEFAULT_BAND)
+    try:
+        yield
+    finally:
+        outcomes.reset()
+        outcomes.configure(
+            enabled=True, band=outcomes.DEFAULT_BAND,
+            capacity=outcomes.DEFAULT_CAPACITY,
+            pending=outcomes.DEFAULT_PENDING,
+        )
+
+
+def _counter(name, labels):
+    m = observe.REGISTRY.get(name)
+    return m.series().get(labels, 0) if m is not None else 0
+
+
+def _bitmaps(n=4, size=1200, span=1 << 18, seed=3):
+    rng = np.random.default_rng(seed)
+    return [
+        RoaringBitmap(
+            np.sort(rng.choice(span, size, replace=False)).astype(np.uint32)
+        )
+        for _ in range(n)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# join mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_register_resolve_joins_and_prices_regret():
+    seq = decisions.record_decision(
+        "columnar.cutoff", "columnar-cpu", outcome=True,
+        op="and", na=32, nb=32, shape="run",
+        est_us={"columnar-cpu": 100.0, "per-container": 2000.0},
+    )
+    joined = outcomes.resolve(seq, "columnar.cutoff", 120e-6, engine="columnar-cpu")
+    assert joined is not None
+    # model predicted 100us, measured 120us: truthful-ish pricing, and the
+    # alternative (2000us) was predicted slower than what happened -> no
+    # wall was lost to this verdict
+    assert joined["error_ratio"] == pytest.approx(100.0 / 120.0, rel=1e-3)
+    assert joined["regret_s"] == 0.0
+    summ = outcomes.summary()["columnar.cutoff"]
+    assert summ["count"] == 1 and summ["regret_s"] == 0.0
+
+
+def test_regret_prices_the_not_taken_alternative():
+    seq = decisions.record_decision(
+        "columnar.cutoff", "columnar-cpu", outcome=True,
+        op="and", na=32, nb=32, shape="bitmap",
+        est_us={"columnar-cpu": 100.0, "per-container": 150.0},
+    )
+    # the chosen engine measured 500us; the alternative was predicted at
+    # 150us: 350us of wall was lost to the wrong verdict
+    joined = outcomes.resolve(seq, "columnar.cutoff", 500e-6, engine="columnar-cpu")
+    assert joined["regret_s"] == pytest.approx(350e-6, rel=1e-6)
+    worst = outcomes.summary()["columnar.cutoff"]["worst"]
+    assert worst["seq"] == seq and worst["inputs"]["shape"] == "bitmap"
+
+
+def test_pending_overflow_orphans_never_crash():
+    outcomes.configure(pending=8)
+    seqs = [
+        decisions.record_decision(
+            "columnar.cutoff", "columnar-cpu", outcome=True, na=20, nb=20
+        )
+        for _ in range(32)
+    ]
+    assert outcomes.LEDGER.pending_count() == 8
+    before = _counter(observe.OUTCOME_ORPHANS_TOTAL, ("columnar.cutoff",))
+    # the outcome of an evicted decision arrives late: counted, dropped
+    assert outcomes.resolve(seqs[0], "columnar.cutoff", 1e-4, engine="x") is None
+    assert (
+        _counter(observe.OUTCOME_ORPHANS_TOTAL, ("columnar.cutoff",))
+        == before + 1
+    )
+    # the newest pending still joins fine
+    assert outcomes.resolve(
+        seqs[-1], "columnar.cutoff", 1e-4, engine="columnar-cpu"
+    ) is not None
+
+
+def test_measure_scope_and_exception_drop():
+    seq = decisions.record_decision(
+        "columnar.cutoff", "per-container", outcome=True, na=20, nb=20
+    )
+    with pytest.raises(ValueError):
+        with outcomes.measure(seq, "columnar.cutoff", engine="per-container"):
+            raise ValueError("engine blew up")
+    # the pending entry was dropped silently: no join, no orphan later
+    assert outcomes.LEDGER.pending_count() == 0
+    assert "columnar.cutoff" not in outcomes.summary()
+    # seq=None scope is a no-op
+    with outcomes.measure(None, "columnar.cutoff"):
+        pass
+
+
+def test_band_anomaly_counts_and_dumps(tmp_path):
+    dump = str(tmp_path / "anomaly.jsonl")
+    outcomes.configure(band=(0.5, 2.0), dump_path=dump)
+    outcomes._LAST_DUMP_NS = 0  # re-arm the throttle for this test
+    seq = decisions.record_decision(
+        "columnar.cutoff", "columnar-cpu", outcome=True, na=32, nb=32,
+        shape="run", op="and", est_us={"columnar-cpu": 10.0},
+    )
+    before = _counter(observe.OUTCOME_ANOMALY_TOTAL, ("columnar.cutoff",))
+    # measured 100x the prediction: far outside the (0.5, 2.0) band
+    outcomes.resolve(seq, "columnar.cutoff", 1000e-6, engine="columnar-cpu")
+    assert (
+        _counter(observe.OUTCOME_ANOMALY_TOTAL, ("columnar.cutoff",))
+        == before + 1
+    )
+    for _ in range(100):  # dump thread races the assert
+        try:
+            lines = open(dump).read().splitlines()
+            break
+        except OSError:
+            time.sleep(0.01)
+    else:
+        pytest.fail("anomaly dump never appeared")
+    header = json.loads(lines[0])
+    assert header["schema"] == outcomes.DUMP_SCHEMA
+    assert header["trigger"]["site"] == "columnar.cutoff"
+    assert header["band"] == [0.5, 2.0]
+
+
+def test_band_exempts_unpriced_cardinality_ratios():
+    outcomes.configure(band=(0.5, 2.0))
+    before = _counter(observe.OUTCOME_ANOMALY_TOTAL, ("query.plan",))
+    seq = decisions.record_decision(
+        "query.plan", "pairwise", outcome=True, op="and", est_card=100_000
+    )
+    # the planner's structural bound missed 1000x — expected bias, not a
+    # pricing anomaly: the error ratio records, the band does not fire
+    joined = outcomes.resolve(
+        seq, "query.plan", 1e-4, engine="pairwise", actual=100
+    )
+    assert joined["error_ratio"] == pytest.approx(1000.0)
+    assert _counter(observe.OUTCOME_ANOMALY_TOTAL, ("query.plan",)) == before
+
+
+def test_off_mode_is_inert():
+    outcomes.configure(enabled=False)
+    seq = decisions.record_decision(
+        "columnar.cutoff", "columnar-cpu", outcome=True, na=20, nb=20
+    )
+    assert outcomes.LEDGER.pending_count() == 0  # nothing parked
+    assert outcomes.resolve(seq, "columnar.cutoff", 1e-4, engine="x") is None
+    assert outcomes.summary() == {}
+    outcomes.configure(enabled=True)
+
+
+# ---------------------------------------------------------------------------
+# hammer + lock witness: the ledger lock is a leaf
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_hammer_16_threads_lockwitness_leaf():
+    w = LockWitness()
+    led_lock = outcomes.LEDGER._lock
+    outcomes.LEDGER._lock = w.wrap("outcomes.ledger", led_lock)
+    reg_lock = observe.REGISTRY._lock
+    observe.REGISTRY._lock = w.wrap("registry", reg_lock)
+    log_lock = decisions.LOG._lock
+    decisions.LOG._lock = w.wrap("decisions.log", log_lock)
+    rec_lock = tl.RECORDER._lock
+    tl.RECORDER._lock = w.wrap("recorder", rec_lock)
+    prev_mode = tl.mode_name()
+    tl.configure(mode="on")
+    stop = time.monotonic() + 1.0
+    errors = []
+
+    def worker(i):
+        k = 0
+        while time.monotonic() < stop:
+            k += 1
+            try:
+                seq = decisions.record_decision(
+                    "columnar.cutoff", "columnar-cpu", outcome=True,
+                    na=20 + i, nb=20, shape="run", op="and",
+                    est_us={"columnar-cpu": 50.0, "per-container": 80.0},
+                )
+                if k % 3 == 0:
+                    outcomes.summary()  # concurrent reader
+                if k % 5 == 0:
+                    outcomes.resolve(seq + 104729, "columnar.cutoff", 1e-5,
+                                     engine="x")  # deliberate orphan
+                else:
+                    outcomes.resolve(seq, "columnar.cutoff", 60e-6,
+                                     engine="columnar-cpu")
+            except Exception as e:  # nothing may escape  # rb-ok: exception-hygiene -- hammer collects escapes to assert none happened
+                errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(16)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        tl.configure(mode=prev_mode)
+        outcomes.LEDGER._lock = led_lock
+        observe.REGISTRY._lock = reg_lock
+        decisions.LOG._lock = log_lock
+        tl.RECORDER._lock = rec_lock
+    assert not errors
+    w.assert_consistent()
+    assert w.acquisitions.get("outcomes.ledger", 0) > 0
+    # leaf property: no lock is ever acquired while holding the ledger lock
+    assert not [e for e in w.edges if e[0] == "outcomes.ledger"], sorted(w.edges)
+
+
+# ---------------------------------------------------------------------------
+# refit round trip (cost model + planner cardinality)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def calibrated_model():
+    costmodel.MODEL.reset()
+    columnar.calibrate(include_device=False)
+    try:
+        yield costmodel.MODEL
+    finally:
+        costmodel.MODEL.reset()
+
+
+def test_refit_rejects_poison_and_records_provenance(calibrated_model, tmp_path):
+    cell = calibrated_model.coeffs["and"]["columnar-cpu"].get("run")
+    assert cell is not None
+    # clean samples at two counts describing overhead=50, slope=3 ...
+    samples = [
+        {"op": "and", "engine": "columnar-cpu", "shape": "run",
+         "n": n, "measured_us": 50.0 + 3.0 * n + jit}
+        for n in (16, 64) for jit in (0.0, 0.5, -0.5)
+    ]
+    # ... plus poisoned ones: non-positive, NaN, unknown engine/shape,
+    # and a 1000x outlier — all rejected, none crash the fit
+    poison = [
+        {"op": "and", "engine": "columnar-cpu", "shape": "run",
+         "n": 16, "measured_us": -5.0},
+        {"op": "and", "engine": "columnar-cpu", "shape": "run",
+         "n": 16, "measured_us": float("nan")},
+        {"op": "and", "engine": "warp-drive", "shape": "run",
+         "n": 16, "measured_us": 10.0},
+        {"op": "and", "engine": "columnar-cpu", "shape": "klein-bottle",
+         "n": 16, "measured_us": 10.0},
+        {"op": "and", "engine": "columnar-cpu", "shape": "run",
+         "n": 16, "measured_us": 98_000.0},
+        {"engine": "columnar-cpu"},  # missing fields
+    ]
+    path = str(tmp_path / "cal.json")
+    report = columnar.refit_from_outcomes(
+        samples + poison, min_samples=4, persist=path
+    )
+    assert report["rejected"] == len(poison)
+    new = calibrated_model.coeffs["and"]["columnar-cpu"]["run"]
+    assert new[0] == pytest.approx(50.0, abs=2.0)
+    assert new[1] == pytest.approx(3.0, abs=0.2)
+    assert calibrated_model.provenance == "refit-from-traffic"
+    assert report["provenance"] == "refit-from-traffic"
+    # provenance survives the persistence lifecycle
+    fresh = costmodel.CostModel()
+    assert fresh.load(path)
+    assert fresh.provenance == "refit-from-traffic"
+    assert fresh.coeffs["and"]["columnar-cpu"]["run"] == new
+    # the refit decision landed in the provenance log
+    sites = [d["site"] for d in insights.decisions()]
+    assert "costmodel.refit" in sites
+
+
+def test_refit_refuses_uncalibrated():
+    costmodel.MODEL.reset()
+    report = columnar.refit_from_outcomes([], min_samples=1)
+    assert "refused" in report
+    assert costmodel.MODEL.calibrated is False
+
+
+def test_refit_moves_seeded_mispriced_cell_toward_truth(calibrated_model):
+    # seed a mispricing: the cell claims 1/16th of its calibrated cost
+    true_cell = list(calibrated_model.coeffs["and"]["columnar-cpu"]["run"])
+    with calibrated_model._lock:
+        calibrated_model.coeffs["and"]["columnar-cpu"]["run"] = [
+            true_cell[0] / 16, true_cell[1] / 16,
+        ]
+    rng = np.random.default_rng(11)
+    a, b = costmodel._synthetic_pair("run", 32, rng)
+    outcomes.reset()
+    for _ in range(6):  # live routed traffic under the poisoned pricing
+        RoaringBitmap.and_(a, b)
+    samples = outcomes.samples("columnar.cutoff")
+    assert len(samples) >= 4
+    report = columnar.refit_from_outcomes(min_samples=4)
+    assert report["moved"], report
+    refit_cell = calibrated_model.coeffs["and"]["columnar-cpu"]["run"]
+    measured = np.median([
+        s["measured_us"] for s in samples
+        if s["engine"] == "columnar-cpu" and s["shape"] == "run"
+    ])
+    n = 32
+
+    def cost(c):
+        return c[0] + n * c[1]
+
+    assert abs(cost(refit_cell) - measured) < abs(
+        cost([true_cell[0] / 16, true_cell[1] / 16]) - measured
+    )
+    # routing decisions now carry the refit provenance
+    tier = columnar.route(a.high_low_container, b.high_low_container, op="and")
+    entry = [d for d in insights.decisions() if d["site"] == "columnar.cutoff"][-1]
+    assert entry["inputs"]["model"] == "refit-from-traffic"
+    with columnar.outcome(tier):
+        pass  # drain the pending join this route registered
+
+
+def test_cardinality_model_refit_and_reset():
+    CARD_MODEL.reset()
+    base = CARD_MODEL.corrected("and", 1000)
+    assert base == 1000
+    samples = [
+        {"site": "query.plan", "inputs": {"op": "and", "est_card": 1000},
+         "actual": 4000.0}
+        for _ in range(6)
+    ] + [
+        # poisoned: million-fold miss and non-positive measurements
+        {"site": "query.plan", "inputs": {"op": "and", "est_card": 1000},
+         "actual": 1e12},
+        {"site": "query.plan", "inputs": {"op": "and", "est_card": 1000},
+         "actual": 0},
+    ]
+    try:
+        report = CARD_MODEL.refit_from_outcomes(samples, min_samples=4)
+        assert report["rejected"] == 2
+        assert report["moved"]["and"]["to"] == pytest.approx(4.0, rel=0.01)
+        assert CARD_MODEL.provenance == "refit-from-traffic"
+        assert CARD_MODEL.corrected("and", 1000) == 4000
+    finally:
+        CARD_MODEL.reset()
+    assert CARD_MODEL.provenance == "default"
+
+
+# ---------------------------------------------------------------------------
+# end-to-end joins at the instrumented sites
+# ---------------------------------------------------------------------------
+
+
+def test_agg_dispatch_join_records_absorbing_tier():
+    bms = _bitmaps(6, seed=7)
+    outcomes.reset()
+    aggregation.FastAggregation.or_(*bms, mode="cpu")
+    entries = [e for e in outcomes.tail() if e["site"] == "agg.dispatch"]
+    assert entries, "agg dispatch produced no joined outcome"
+    e = entries[-1]
+    assert e["engine"] in ("columnar-cpu", "per-container", "pure-python")
+    assert e["measured_s"] > 0
+    assert e["inputs"]["op"] == "or"
+
+
+def test_query_plan_join_carries_actual_cardinality():
+    bms = _bitmaps(3, seed=9)
+    outcomes.reset()
+    res = execute((Q.leaf(bms[0]) & Q.leaf(bms[1])) | Q.leaf(bms[2]), cache=None)
+    entries = [e for e in outcomes.tail() if e["site"] == "query.plan"]
+    assert len(entries) == 2  # and-step + or-step
+    for e in entries:
+        assert e["actual"] >= 1
+        assert e["error_ratio"] is not None  # est_card / actual
+    # the or-step's actual is the final result's cardinality
+    assert entries[-1]["actual"] == res.get_cardinality()
+
+
+def test_memoized_plan_joins_once_no_orphans():
+    bms = _bitmaps(2, seed=13)
+    expr = Q.leaf(bms[0]) & Q.leaf(bms[1])
+    outcomes.reset()
+    before = _counter(observe.OUTCOME_ORPHANS_TOTAL, ("query.plan",))
+    execute(expr, cache=None)
+    first = len([e for e in outcomes.tail() if e["site"] == "query.plan"])
+    execute(expr, cache=None)  # memoized plan: serial already cleared
+    second = len([e for e in outcomes.tail() if e["site"] == "query.plan"])
+    assert first == second == 1
+    assert _counter(observe.OUTCOME_ORPHANS_TOTAL, ("query.plan",)) == before
+
+
+def test_pack_cache_evict_regret_join():
+    cache = store.PackCache(max_bytes=1)  # one survivor entry only
+    rng = np.random.default_rng(5)
+    sets = []
+    for s in range(2):
+        sets.append([
+            RoaringBitmap(
+                np.sort(rng.choice(1 << 20, 4000, replace=False)).astype(np.uint32)
+            )
+            for _ in range(3)
+        ])
+    outcomes.reset()
+    cache.get_packed(sets[0])
+    cache.get_packed(sets[1])   # evicts set 0 (budget of ~one entry)
+    cache.get_packed(sets[0])   # re-pack of a remembered eviction
+    entries = [e for e in outcomes.tail() if e["site"] == "pack_cache.evict"]
+    assert entries, "evict-then-repack produced no regret join"
+    e = entries[-1]
+    assert e["engine"] == "repack"
+    assert e["regret_s"] > 0
+    assert e["regret_s"] == pytest.approx(e["measured_s"], rel=1e-6)
+    cache.close()
+
+
+def test_ladder_degrade_joins_wasted_wall():
+    from roaringbitmap_tpu import robust
+    from roaringbitmap_tpu.robust import ladder
+
+    lad = ladder.Ladder(trip_after=5, cooldown_s=5.0)
+
+    def bad():
+        time.sleep(0.002)
+        raise robust.TransientDeviceError("x")
+
+    outcomes.reset()
+    assert lad.run("agg", [("device", bad), ("per-container", lambda: 42)]) == 42
+    entries = [e for e in outcomes.tail() if e["site"] == "ladder.degrade"]
+    assert entries and entries[-1]["engine"] == "device"
+    assert entries[-1]["regret_s"] >= 0.002
+
+
+def test_columnar_route_join_above_gate(calibrated_model):
+    rng = np.random.default_rng(21)
+    a, b = costmodel._synthetic_pair("bitmap", 24, rng)
+    outcomes.reset()
+    RoaringBitmap.or_(a, b)
+    entries = [e for e in outcomes.tail() if e["site"] == "columnar.cutoff"]
+    assert entries
+    e = entries[-1]
+    assert e["engine"] in costmodel.ENGINES
+    assert e["predicted_us"] is not None and e["error_ratio"] is not None
+    # the join fed the per-coefficient drift gauge for this cell
+    assert any(k.startswith("or/") for k in outcomes.drift())
+
+
+def test_join_recorder_offline(calibrated_model):
+    rng = np.random.default_rng(23)
+    a, b = costmodel._synthetic_pair("run", 24, rng)
+    prev = tl.mode_name()
+    tl.configure(mode="on")
+    tl.RECORDER.clear()
+    try:
+        outcomes.reset()
+        RoaringBitmap.and_(a, b)
+        events = tl.RECORDER.events()
+    finally:
+        tl.configure(mode=prev)
+    joined = outcomes.join_recorder(events)
+    assert joined, "no recorder span carried a decision serial"
+    cut = [j for j in joined if j["site"] == "columnar.cutoff"]
+    assert cut and cut[-1]["measured_s"] > 0
+    assert cut[-1]["span"].startswith("outcome.")
+
+
+# ---------------------------------------------------------------------------
+# fingerprint-walk satellite (cached per-hlc fingerprints)
+# ---------------------------------------------------------------------------
+
+
+def test_fingerprint_cached_identity_and_invalidation():
+    bm = RoaringBitmap([1, 2, 3, 70000])
+    fp1 = bm.fingerprint()
+    fp2 = bm.fingerprint()
+    assert fp1 is fp2  # cached: the SAME tuple object until a mutation
+    bm.add(5)
+    fp3 = bm.fingerprint()
+    assert fp3 is not fp1 and fp3 != fp1
+    assert fp3[0] == fp1[0] and fp3[1] > fp1[1]  # same gen, moved version
+    # wholesale mutations invalidate too
+    bm.high_low_container.mark_all_dirty()
+    assert bm.fingerprint() != fp3
+    # clones get a fresh identity, not the parent's cached tuple
+    cl = bm.clone()
+    assert cl.fingerprint()[0] != bm.fingerprint()[0]
+
+
+def test_walk_fingerprints_matches_percall_walk():
+    bms = _bitmaps(8, seed=31)
+    bms[3].high_low_container  # touch
+    fps, idents = store._walk_fingerprints(bms)
+    assert fps == tuple(bm.fingerprint() for bm in bms)
+    assert idents == tuple(store._fp_ident(fp) for fp in fps)
+    # warm second walk returns identical objects (zero fresh tuples)
+    fps2, idents2 = store._walk_fingerprints(bms)
+    assert all(a is b for a, b in zip(fps, fps2))
+    assert all(a is b for a, b in zip(idents, idents2))
+    # a mutation refreshes exactly the mutated operand's fingerprint
+    bms[2].add(424242)
+    fps3, _ = store._walk_fingerprints(bms)
+    assert fps3[2] != fps[2]
+    assert all(fps3[i] is fps[i] for i in range(8) if i != 2)
+
+
+def test_walk_fingerprints_foreign_hlc_fallbacks():
+    class SlottedForeign:  # mutable, no cache slots: per-call tuples
+        __slots__ = ("_gen", "_version")
+
+        def __init__(self):
+            self._gen, self._version = 987654321, 3
+
+    class DictForeign:  # mutable, __dict__: caches land in the dict
+        def __init__(self):
+            self._gen, self._version = 987654322, 4
+
+    class Box:
+        def __init__(self, hlc):
+            self.high_low_container = hlc
+
+    bms = [Box(SlottedForeign()), Box(DictForeign())]
+    fps, idents = store._walk_fingerprints(bms)
+    assert fps == ((987654321, 3), (987654322, 4))
+    assert idents == (("g", 987654321), ("g", 987654322))
+    # warm: the dict-carrying foreign hlc serves its cached tuples
+    fps2, idents2 = store._walk_fingerprints(bms)
+    assert fps2 == fps and idents2 == idents
+    assert fps2[1] is fps[1] and idents2[1] is idents[1]
