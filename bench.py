@@ -211,7 +211,11 @@ def _run():
     # real cost of ~4 µs/fold (measured: trace_scope 0.9 µs + two
     # decision records ~5 µs) under millisecond-scale jitter. Smoke-scale
     # folds (~65 ms) are noise-bound at min-of-3, so smoke takes 8 pairs.
-    obs_pairs = 8 if "--smoke" in sys.argv else max(3, REPS_CPU)
+    # full scale previously sampled only max(3, REPS_CPU) pairs; on this
+    # host's ms-scale jitter (the r13 notes record ±18% same-code session
+    # ranges) that under-resolves a measured ~4 µs/fold cost against a
+    # ~0.9 s fold — 8 pairs at both scales lets min-of-k converge
+    obs_pairs = 8
     obs_on_times, obs_off_times = [], []
 
     def _fold_once(times):
@@ -598,6 +602,132 @@ def _run():
     }
     rb_outcomes.reset()
 
+    # ---- health sentinel (ISSUE 12): the seeded drift now trips the ----
+    # ---- SUPERVISOR, which auto-refits through the cost facade      ----
+    # The manual refit above proved refit_from_outcomes() works when
+    # called; this demo proves nobody needs to call it. Poison the same
+    # cell again, run routed traffic so the drift gauge leaves its band,
+    # and tick the process sentinel: the costmodel-drift rule fires after
+    # its 2-tick hysteresis, actuates cost.refit_all() inside the refit
+    # cooldown (ROADMAP item 4's automatic drift-triggered refit), the
+    # red episode writes exactly one manifest-indexed flight bundle into
+    # the artifact sink, the refit re-bases the drift cells, and the
+    # process returns green — the whole closed loop as committed numbers.
+    import tempfile as _tempfile
+
+    from roaringbitmap_tpu.observe import artifacts as rb_artifacts
+    from roaringbitmap_tpu.observe import bundle as rb_bundle
+    from roaringbitmap_tpu.observe import sentinel as rb_sentinel
+
+    cal_fd, sentinel_cal_path = _tempfile.mkstemp(
+        prefix="rb_tpu_sentinel_cal_", suffix=".json"
+    )
+    os.close(cal_fd)
+    os.unlink(sentinel_cal_path)  # the refit writes it atomically
+    prev_cal_env = os.environ.get("RB_TPU_COLUMNAR_CAL")
+    os.environ["RB_TPU_COLUMNAR_CAL"] = sentinel_cal_path
+    with col_costmodel.MODEL._lock:
+        col_costmodel.MODEL.coeffs = _copy.deepcopy(col_costmodel.MODEL.coeffs)
+        col_costmodel.MODEL.coeffs[refit_group][refit_tier][refit_shape] = (
+            list(poisoned_cell)
+        )
+        col_costmodel.MODEL.provenance = "calibrated"
+    rb_sentinel.SENTINEL.reset()
+    for _ in range(8):  # routed joins under the re-poisoned pricing
+        RoaringBitmap.and_(run_mid, run_mid2)
+    drift_cell = (refit_group, refit_tier, refit_shape)
+    drift_seeded = rb_outcomes.LEDGER.drift().get(drift_cell)
+    assert drift_seeded is not None and not (0.25 <= drift_seeded <= 4.0), (
+        f"seeded poisoning left drift in band: {drift_seeded}"
+    )
+    t_sent = time.monotonic()
+    rb_sentinel.SENTINEL.tick(now=t_sent)
+    tick2 = rb_sentinel.SENTINEL.tick(now=t_sent + 1.0)
+    assert tick2["status_name"] == "red", (
+        f"seeded drift did not judge red: {tick2['rules']['costmodel-drift']}"
+    )
+    auto_kinds = sorted(a["kind"] for a in tick2["actuated"])
+    assert "refit" in auto_kinds, (
+        f"sentinel did not auto-refit within its cooldown: {auto_kinds}"
+    )
+    sentinel_cell = col_costmodel.MODEL.coeffs[refit_group][refit_tier][refit_shape]
+    measured_sentinel_us = float(np.median([
+        s["measured_us"] for s in rb_outcomes.samples()
+        if s["engine"] == refit_tier and s["shape"] == refit_shape
+    ]))
+    assert abs(_cell_cost(sentinel_cell) - measured_sentinel_us) < abs(
+        _cell_cost(poisoned_cell) - measured_sentinel_us
+    ), (
+        f"auto-refit did not move the cell toward truth: poisoned "
+        f"{poisoned_cell} -> {sentinel_cell} vs {measured_sentinel_us:.1f}us"
+    )
+    assert col_costmodel.MODEL.provenance == "refit-from-traffic"
+    persisted_model = col_costmodel.CostModel()
+    assert persisted_model.load(sentinel_cal_path), (
+        "auto-refit did not persist through RB_TPU_COLUMNAR_CAL"
+    )
+    assert persisted_model.provenance == "refit-from-traffic", (
+        "persisted calibration lost the refit-from-traffic provenance"
+    )
+    sentinel_bundles = [a for a in tick2["actuated"] if a["kind"] == "bundle"]
+    assert len(sentinel_bundles) == 1 and "path" in sentinel_bundles[0], (
+        f"red episode did not write exactly one bundle: {sentinel_bundles}"
+    )
+    bundle_path = sentinel_bundles[0]["path"]
+    bundle_manifest = rb_bundle.read_manifest(bundle_path)  # sizes + sha256
+    assert os.path.dirname(bundle_path) == rb_artifacts.artifact_dir(), (
+        f"bundle escaped the artifact sink: {bundle_path}"
+    )
+    refit_act = next(
+        a for a in tick2["actuated"] if a["kind"] == "refit"
+    )
+    sentinel_status_end = None
+    ticks_to_green = None
+    for i in range(2, 8):
+        rep = rb_sentinel.SENTINEL.tick(now=t_sent + float(i))
+        sentinel_status_end = rep["status_name"]
+        if sentinel_status_end == "green":
+            ticks_to_green = rep["tick"]
+            break
+    assert sentinel_status_end == "green", (
+        f"process did not return green after the auto-refit: {sentinel_status_end}"
+    )
+    assert rb_outcomes.LEDGER.drift().get(drift_cell) == 1.0, (
+        "refit did not re-base the moved cell's drift EWMA"
+    )
+    sentinel_meta = {
+        "rule": "costmodel-drift",
+        "cell": f"{refit_group}/{refit_tier}/{refit_shape}",
+        "drift_seeded": round(drift_seeded, 2),
+        "ticks_to_refit": 2,  # the rule's committed fire_after hysteresis
+        "poisoned": poisoned_cell,
+        "refit": [round(v, 4) for v in sentinel_cell],
+        "measured_mid_us": round(measured_sentinel_us, 1),
+        "moved_toward_truth": True,
+        "provenance_live": col_costmodel.MODEL.provenance,
+        "provenance_persisted": persisted_model.provenance,
+        "refit_authorities": {
+            name: rep.get("provenance")
+            for name, rep in (refit_act.get("authorities") or {}).items()
+        },
+        "bundle": {
+            "path": bundle_path,
+            "files": len(bundle_manifest["files"]),
+            "manifest_ok": True,
+        },
+        "status_end": sentinel_status_end,
+        "ticks_to_green": ticks_to_green,
+        "artifact_dir": rb_artifacts.artifact_dir(),
+    }
+    if prev_cal_env is None:
+        os.environ.pop("RB_TPU_COLUMNAR_CAL", None)
+    else:
+        os.environ["RB_TPU_COLUMNAR_CAL"] = prev_cal_env
+    if os.path.isfile(sentinel_cal_path):
+        os.unlink(sentinel_cal_path)
+    rb_sentinel.SENTINEL.reset()
+    rb_outcomes.reset()
+
     # the device section must not leak into the r11-comparable rows below:
     # routed folds go back to the default gate and the colrows packs free
     # their budget share before the pack sections measure cold costs
@@ -644,6 +774,11 @@ def _run():
     # tripped breakers / stretched cooldown must not leak into the TPU path
     rladder.LADDER.reset()
     rladder.LADDER.configure(cooldown_s=5.0)
+    # ... and neither may the outage window's wasted-wall regret joins:
+    # the end-of-run health judgement (meta.health below) must measure the
+    # steady state, not the injected outage (ISSUE 12 — the same
+    # discipline as the breaker reset above)
+    rb_outcomes.reset()
 
     # ---- TPU path: pack once via the resident pack cache (ISSUE 4), ----
     # ---- reduce on device                                           ----
@@ -1098,6 +1233,47 @@ def _run():
         "hbm": hbm_recon,
     }
 
+    # ---- end-of-run health judgement (ISSUE 12) ----
+    # After everything the bench did — seeded drift, injected outages,
+    # device twins — the committed claim is that the process ENDS green.
+    # The judgement window is a fresh ledger + a short burst of REAL
+    # steady-state traffic: the bench's cumulative ledger is NOT serving
+    # traffic (every deliberate section cold-start prices its close() ->
+    # repack as evict regret by ISSUE-11 design, and the dedicated
+    # meta.regret window above already gates routed regret <= 5%), so
+    # the end judgement measures what an operator's sentinel would see —
+    # the final registries, breaker states, drift cells, and a live
+    # traffic window — over three ticks (enough consecutive evaluations
+    # for every rule's fire_after to have fired if anything were wrong).
+    rb_sentinel.SENTINEL.reset()
+    rb_outcomes.reset()
+    health_end = None
+    for _ in range(3):
+        aggregation.ParallelAggregation.or_(*bitmaps[:64], mode="cpu")
+        health_end = rb_sentinel.SENTINEL.tick()
+    assert health_end["status_name"] == "green", (
+        f"end-of-bench health is {health_end['status_name']}: "
+        f"{ {n: e for n, e in health_end['rules'].items() if e['level']} }; "
+        f"ledger {rb_outcomes.summary()}"
+    )
+    cwd_strays = sorted(
+        f for f in os.listdir(".")
+        if (f.startswith("rb_tpu_") and f.endswith(".jsonl"))
+        or f.startswith("bundle_")
+    )
+    assert not cwd_strays, (
+        f"diagnostic artifacts leaked into the CWD: {cwd_strays}"
+    )
+    health_meta = {
+        "status_end": health_end["status_name"],
+        "rules": {
+            name: ev["level"] for name, ev in health_end["rules"].items()
+        },
+        "ticks": health_end["tick"],
+        "cwd_clean": True,
+        "artifact_dir": rb_artifacts.artifact_dir(),
+    }
+
     dataset = "census1881" if real else "synthetic-census-like"
     fold_engine = (
         "columnar-fold"
@@ -1163,6 +1339,13 @@ def _run():
         # demonstration (coefficients demonstrably move toward measured
         # truth, provenance recorded)
         "regret": regret_meta,
+        # health sentinel rows (ISSUE 12): the seeded-drift -> auto-refit
+        # closed-loop demo (drift out of band -> red -> cost.refit_all
+        # within the cooldown -> coefficients toward truth -> provenance
+        # persisted -> bundle written -> green), and the end-of-run
+        # judgement every later PR must hold
+        "sentinel": sentinel_meta,
+        "health": health_meta,
         # timeline twin rows (ISSUE 6): traced (fenced flight recorder)
         # vs untraced walls for the same operations, the named-stage
         # attribution sums, and where the artifact landed — overhead_pct

@@ -22,7 +22,12 @@ registration call (``observe.counter(...)`` / ``_observe.gauge(...)`` /
 * **latency histograms** (``latency_histogram(...)``, ISSUE 6) measure
   seconds and must carry the ``_seconds`` unit suffix — a literal or
   in-file constant is validated directly, a cross-module constant must be
-  ``*_SECONDS``-shaped so the defining module's check covers it.
+  ``*_SECONDS``-shaped so the defining module's check covers it;
+* **enum gauges** (ISSUE 12): ``_state``/``_status`` join the recognised
+  unit suffixes — an integer level from a declared enum (the health
+  sentinel's ``rb_tpu_health_status`` 0/1/2 = green/yellow/red and
+  ``rb_tpu_health_rule_state{rule}`` 0/1/2 = ok/warn/critical), so their
+  cross-module constants validate like the other shaped names.
 
 **Label-value cardinality** (ISSUE 9): metric *mutations* on module-level
 metric constants (``_FOO_TOTAL.inc(1, (value,))`` / ``.observe`` /
@@ -79,8 +84,13 @@ _UNBOUNDED = re.compile(
 )
 _ALL_CAPS = re.compile(r"^[A-Z][A-Z0-9_]*$")
 # constant names that read as canonical metric names (unit-suffixed; RATIO
-# is the dimensionless gauge unit — e.g. rb_tpu_store_overlap_ratio)
-_SHAPED_CONST = re.compile(r"^[A-Z][A-Z0-9_]*_(TOTAL|SECONDS|BYTES|COUNT|RATIO)$")
+# is the dimensionless gauge unit — e.g. rb_tpu_store_overlap_ratio;
+# STATE/STATUS are the enum-gauge suffixes, ISSUE 12 — an integer level
+# from a declared enum, e.g. rb_tpu_health_status 0/1/2 = green/yellow/red
+# and rb_tpu_health_rule_state{rule} 0/1/2 = ok/warn/critical)
+_SHAPED_CONST = re.compile(
+    r"^[A-Z][A-Z0-9_]*_(TOTAL|SECONDS|BYTES|COUNT|RATIO|STATE|STATUS)$"
+)
 
 
 def _literal_label_tuple(node: ast.AST) -> bool:
@@ -149,7 +159,10 @@ class MetricNaming(Checker):
                     # shaped names are validated here where they're defined
                     looks_like_metric = (
                         v.startswith("rb")
-                        or re.search(r"_(total|seconds|bytes|count|ratio)$", v)
+                        or re.search(
+                            r"_(total|seconds|bytes|count|ratio|state|status)$",
+                            v,
+                        )
                         or _SHAPED_CONST.match(t.id)
                     )
                     if looks_like_metric and not v.startswith(PREFIX):
@@ -251,7 +264,8 @@ class MetricNaming(Checker):
                     call,
                     f"metric name constant {term} is neither defined in this "
                     f"module nor unit-suffixed (_TOTAL/_SECONDS/_BYTES/"
-                    f"_COUNT/_RATIO): the prefix cannot be verified",
+                    f"_COUNT/_RATIO/_STATE/_STATUS): the prefix cannot be "
+                    f"verified",
                 )
             return
         yield self.finding(

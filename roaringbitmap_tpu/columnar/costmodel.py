@@ -230,25 +230,24 @@ class CostModel:
             json.dump(self.to_dict(), f, indent=1)
         os.replace(tmp, path)
 
-    def load(self, path: str) -> bool:
-        """Adopt a persisted calibration; False (and untouched state) on a
-        missing/invalid/foreign-backend file — the caller falls back to
-        measuring (or to the default gate)."""
-        try:
-            with open(path) as f:
-                d = json.load(f)
-        except (OSError, ValueError):
+    def from_dict(self, d: dict, check_backend: bool = True) -> bool:
+        """Adopt a serialized calibration state (the ``to_dict`` shape);
+        False (and untouched state) on schema/backend mismatch. The
+        ``cost/`` facade's unified state lifecycle loads through here
+        (ISSUE 12), same validation as a file load."""
+        if not isinstance(d, dict):
             return False
         if d.get("schema") != SCHEMA or not d.get("calibrated"):
             return False
-        try:
-            import jax
+        if check_backend:
+            try:
+                import jax
 
-            backend = jax.default_backend()
-        except (ImportError, RuntimeError):
-            backend = None
-        if d.get("backend") != backend:
-            return False  # coefficients are per-backend measurements
+                backend = jax.default_backend()
+            except (ImportError, RuntimeError):
+                backend = None
+            if d.get("backend") != backend:
+                return False  # coefficients are per-backend measurements
         coeffs = d.get("coeffs")
         if not isinstance(coeffs, dict) or not coeffs:
             return False
@@ -262,6 +261,17 @@ class CostModel:
             self.provenance = str(d.get("provenance") or "calibrated")
             self.calibrated = True
         return True
+
+    def load(self, path: str) -> bool:
+        """Adopt a persisted calibration; False (and untouched state) on a
+        missing/invalid/foreign-backend file — the caller falls back to
+        measuring (or to the default gate)."""
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            return False
+        return self.from_dict(d)
 
     def reset(self) -> None:
         """Back to the uncalibrated default gate (tests; also re-arms the

@@ -1,0 +1,44 @@
+"""Unified cost substrate (ISSUE 12; ROADMAP item 4's closing half).
+
+One facade over the four pricing authorities — the columnar cutoff
+model, the planner's cardinality corrections, the device-breakeven
+dispatch gate, and pack/ship residency pricing — behind a shared
+curves / provenance / drift / refit / state protocol, with ONE
+persistence lifecycle (``RB_TPU_COST_STATE``). The health sentinel
+(``observe.sentinel``) actuates ``refit_all()`` when a drift gauge
+leaves its band, which is what makes the authorities self-tuning
+instead of calibrated-once-per-host. See ``cost/facade.py``.
+"""
+
+from .facade import (
+    AUTHORITIES,
+    STATE_SCHEMA,
+    Authority,
+    authority,
+    calibration_state,
+    drift_summary,
+    load_state,
+    names,
+    provenances,
+    refit_all,
+    reset_all,
+    save_state,
+)
+from . import breakeven, residency
+
+__all__ = [
+    "AUTHORITIES",
+    "STATE_SCHEMA",
+    "Authority",
+    "authority",
+    "breakeven",
+    "calibration_state",
+    "drift_summary",
+    "load_state",
+    "names",
+    "provenances",
+    "refit_all",
+    "reset_all",
+    "residency",
+    "save_state",
+]

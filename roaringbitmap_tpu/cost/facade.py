@@ -1,0 +1,338 @@
+"""The unified cost facade: every pricing authority behind one
+curves / drift / refit / state protocol (ISSUE 12 tentpole, leg 2 —
+closing ROADMAP item 4).
+
+The system grew four pricing authorities, each calibrated differently:
+
+========================= ===============================================
+authority                 wraps
+========================= ===============================================
+``columnar-cutoff``       ``columnar.costmodel.MODEL`` — the measured
+                          three-way per-pair engine curves (ISSUE 10)
+``planner-cardinality``   ``query.plan.CARD_MODEL`` — per-op cardinality
+                          corrections (ISSUE 11)
+``device-breakeven``      ``cost.breakeven.MODEL`` — the agg dispatch
+                          gate, the bench's ``cold_breakeven`` story as a
+                          live refittable curve
+``pack-residency``        ``cost.residency.MODEL`` — ship µs/row (shared
+                          with the columnar calibration) + per-kind
+                          measured re-pack cost
+========================= ===============================================
+
+Each adapter answers the same five questions — ``curves()`` (what do you
+currently believe), ``provenance()`` (where did that belief come from:
+static / calibrated / refit-from-traffic), ``drift()`` (how far is live
+traffic from the belief), ``refit_from_outcomes()`` (update the belief
+from the decision–outcome ledger), ``state()``/``load_state()`` (one
+serialization lifecycle) — so the health sentinel can actuate a refit
+without knowing which authority drifted, and a flight bundle captures
+every authority's calibration in one ``calibration.json``.
+
+**One persistence lifecycle**: ``save_state()``/``load_state()`` round-
+trip ALL authorities through one JSON file (``RB_TPU_COST_STATE``); the
+columnar model's own ``RB_TPU_COLUMNAR_CAL`` path keeps working (its
+refit persists there too) — the unified file is a superset, not a
+replacement.
+
+Lock discipline: the facade holds no lock of its own — every adapter
+delegates to its model's existing leaf lock; ``refit_all`` runs the
+refits sequentially, each under its own model's lock only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+STATE_SCHEMA = "rb_tpu_cost_state/1"
+
+
+class Authority:
+    """Adapter protocol (duck-typed base). Subclasses delegate to the
+    underlying model singletons; all methods return plain json-able
+    data."""
+
+    name: str = "?"
+
+    def curves(self) -> dict:
+        raise NotImplementedError
+
+    def provenance(self) -> str:
+        raise NotImplementedError
+
+    def drift(self) -> Dict[str, float]:
+        """{cell: measured/believed ratio} — {} when nothing to judge."""
+        return {}
+
+    def refit_from_outcomes(self, samples: Optional[List[dict]] = None) -> dict:
+        raise NotImplementedError
+
+    def state(self) -> dict:
+        raise NotImplementedError
+
+    def load_state(self, d: dict) -> bool:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+
+class ColumnarCutoffAuthority(Authority):
+    name = "columnar-cutoff"
+
+    def _model(self):
+        from ..columnar import costmodel as _costmodel
+
+        return _costmodel.MODEL
+
+    def curves(self) -> dict:
+        m = self._model()
+        return {
+            "calibrated": m.calibrated,
+            "backend": m.backend,
+            "coeffs": m.coeffs,
+            "ship_us_per_row": m.ship_us_per_row,
+            "fold_rows_min": m.fold_rows_min,
+        }
+
+    def provenance(self) -> str:
+        m = self._model()
+        return m.provenance if m.calibrated else "default-gate"
+
+    def drift(self) -> Dict[str, float]:
+        from ..observe import outcomes as _outcomes
+
+        # the per-coefficient-cell gauge IS this authority's drift view
+        # (every cell is a columnar (group, engine, shape) coefficient)
+        return _outcomes.drift()
+
+    def refit_from_outcomes(self, samples: Optional[List[dict]] = None) -> dict:
+        from ..columnar import costmodel as _costmodel
+        from ..observe import outcomes as _outcomes
+
+        report = _costmodel.refit_from_outcomes(samples=samples)
+        moved = report.get("moved") or {}
+        if moved:
+            # the refit replaced these cells' coefficients: their drift
+            # EWMAs measured the OLD curves and must re-base, or the
+            # sentinel's drift rule would re-fire against beliefs that
+            # already moved (ISSUE 12)
+            _outcomes.rebase_drift(list(moved))
+        return report
+
+    def state(self) -> dict:
+        return self._model().to_dict()
+
+    def load_state(self, d: dict) -> bool:
+        return self._model().from_dict(d)
+
+    def reset(self) -> None:
+        self._model().reset()
+
+
+class PlannerCardinalityAuthority(Authority):
+    name = "planner-cardinality"
+
+    def _model(self):
+        from ..query.plan import CARD_MODEL
+
+        return CARD_MODEL
+
+    def curves(self) -> dict:
+        m = self._model()
+        return {"corrections": dict(m.corrections)}
+
+    def provenance(self) -> str:
+        return self._model().provenance
+
+    def refit_from_outcomes(self, samples: Optional[List[dict]] = None) -> dict:
+        return self._model().refit_from_outcomes(samples=samples)
+
+    def state(self) -> dict:
+        return self._model().to_dict()
+
+    def load_state(self, d: dict) -> bool:
+        return self._model().from_dict(d)
+
+    def reset(self) -> None:
+        self._model().reset()
+
+
+class DeviceBreakevenAuthority(Authority):
+    name = "device-breakeven"
+
+    def _model(self):
+        from . import breakeven as _breakeven
+
+        return _breakeven.MODEL
+
+    def curves(self) -> dict:
+        return self._model().curves_view()
+
+    def provenance(self) -> str:
+        return self._model().provenance
+
+    def drift(self) -> Dict[str, float]:
+        return self._model().drift()
+
+    def refit_from_outcomes(self, samples: Optional[List[dict]] = None) -> dict:
+        return self._model().refit_from_outcomes(samples=samples)
+
+    def state(self) -> dict:
+        return self._model().to_dict()
+
+    def load_state(self, d: dict) -> bool:
+        return self._model().from_dict(d)
+
+    def reset(self) -> None:
+        self._model().reset()
+
+
+class PackResidencyAuthority(Authority):
+    name = "pack-residency"
+
+    def _model(self):
+        from . import residency as _residency
+
+        return _residency.MODEL
+
+    def curves(self) -> dict:
+        return self._model().curves_view()
+
+    def provenance(self) -> str:
+        return self._model().provenance
+
+    def drift(self) -> Dict[str, float]:
+        return self._model().drift()
+
+    def refit_from_outcomes(self, samples: Optional[List[dict]] = None) -> dict:
+        return self._model().refit_from_outcomes(samples=samples)
+
+    def state(self) -> dict:
+        return self._model().to_dict()
+
+    def load_state(self, d: dict) -> bool:
+        return self._model().from_dict(d)
+
+    def reset(self) -> None:
+        self._model().reset()
+
+
+AUTHORITIES: Dict[str, Authority] = {
+    a.name: a
+    for a in (
+        ColumnarCutoffAuthority(),
+        PlannerCardinalityAuthority(),
+        DeviceBreakevenAuthority(),
+        PackResidencyAuthority(),
+    )
+}
+
+
+def names() -> List[str]:
+    return sorted(AUTHORITIES)
+
+
+def authority(name: str) -> Authority:
+    try:
+        return AUTHORITIES[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown pricing authority {name!r} (have {names()})"
+        ) from None
+
+
+def refit_all(samples: Optional[List[dict]] = None) -> Dict[str, dict]:
+    """Refit every authority from the live decision–outcome ledger (or an
+    explicit sample list, passed to each adapter — adapters filter by
+    site). This is the sentinel's drift actuation: one call, every
+    pricing authority self-tunes, each recording its own provenance."""
+    return {
+        name: AUTHORITIES[name].refit_from_outcomes(samples=samples)
+        for name in names()
+    }
+
+
+def provenances() -> Dict[str, str]:
+    return {name: AUTHORITIES[name].provenance() for name in names()}
+
+
+def drift_summary() -> Dict[str, Dict[str, float]]:
+    """{authority: {cell: ratio}} over every authority reporting drift."""
+    out = {}
+    for name in names():
+        d = AUTHORITIES[name].drift()
+        if d:
+            out[name] = d
+    return out
+
+
+def calibration_state() -> dict:
+    """Every authority's current belief + provenance + drift — the flight
+    bundle's ``calibration.json`` and the rb_top cost panel's feed."""
+    return {
+        "schema": STATE_SCHEMA,
+        "authorities": {
+            name: {
+                "curves": AUTHORITIES[name].curves(),
+                "provenance": AUTHORITIES[name].provenance(),
+                "drift": AUTHORITIES[name].drift(),
+            }
+            for name in names()
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# one persistence lifecycle (RB_TPU_COST_STATE)
+# ---------------------------------------------------------------------------
+
+
+def save_state(path: Optional[str] = None) -> Optional[str]:
+    """Persist all authorities' state to one JSON file (atomic write);
+    ``path`` defaults to ``RB_TPU_COST_STATE`` — None (and no-op) when
+    neither names a destination. Returns the path written."""
+    path = path if path is not None else os.environ.get("RB_TPU_COST_STATE")
+    if not path:
+        return None
+    from ..observe.export import _atomic_write
+
+    doc = {
+        "schema": STATE_SCHEMA,
+        "generated_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "authorities": {name: AUTHORITIES[name].state() for name in names()},
+    }
+    _atomic_write(path, json.dumps(doc, indent=1, sort_keys=True) + "\n")
+    return path
+
+
+def load_state(path: Optional[str] = None) -> Dict[str, bool]:
+    """Adopt a persisted unified state; per-authority verdicts (an
+    authority whose sub-state fails validation — foreign backend, bad
+    schema — is left untouched and reported False). Missing/corrupt file
+    → all False."""
+    path = path if path is not None else os.environ.get("RB_TPU_COST_STATE")
+    verdicts = {name: False for name in names()}
+    if not path:
+        return verdicts
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return verdicts
+    if not isinstance(doc, dict) or doc.get("schema") != STATE_SCHEMA:
+        return verdicts
+    states = doc.get("authorities") or {}
+    for name in names():
+        sub = states.get(name)
+        if isinstance(sub, dict):
+            verdicts[name] = bool(AUTHORITIES[name].load_state(sub))
+    return verdicts
+
+
+def reset_all() -> None:
+    """Every authority back to its pre-calibration default (tests)."""
+    for name in names():
+        AUTHORITIES[name].reset()

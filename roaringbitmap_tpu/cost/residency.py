@@ -1,0 +1,218 @@
+"""Pack/ship residency-pricing authority (ISSUE 12).
+
+The fourth pricing authority has always been implicit: PACK_CACHE's
+byte-budget LRU decides what stays HBM-resident, the marshal path pays a
+measured ship cost per row (``columnar.MODEL.ship_us_per_row`` — the
+SHARED coefficient this authority exposes rather than re-measuring), and
+an eviction's true price is the re-pack wall paid when the working set
+comes back. Since ISSUE 11 that price is *measured*: a re-pack of a
+remembered eviction joins the evict decision with its wall as regret.
+
+This model turns those joins into curves: a per-kind geometric EWMA of
+the measured re-pack/rebuild cost (``repack_s``), refit from the
+``pack_cache.evict`` ledger samples — each of which carries the evicted
+entry's ``kind`` and ``bytes`` in the decision inputs (parallel/store.py
+records them at eviction time). The curve is what a future admission/
+protection policy prices against (ROADMAP item 1's per-tenant budget
+partitions); today it already powers the drift view — a cache whose
+re-pack costs are drifting up is one whose budget no longer fits the
+traffic, and the sentinel surfaces that through the same facade as every
+other authority.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional
+
+SCHEMA = "rb_tpu_cost_residency/1"
+# EWMA weight for the per-kind repack cost: evictions are rare events, so
+# adapt faster than the per-join drift EWMA (~8-sample memory)
+_ALPHA = 0.25
+
+
+class ResidencyModel:
+    """Per-kind measured re-pack cost EWMAs + the shared ship pricing."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.repack_s: Dict[str, float] = {}  # guarded-by: self._lock
+        self.samples: Dict[str, int] = {}  # guarded-by: self._lock
+        self.provenance = "static"  # guarded-by: self._lock
+        self.backend: Optional[str] = None  # guarded-by: self._lock
+        # highest decision serial already folded into the EWMAs: the
+        # sentinel re-runs refit_all every cooldown against the SAME
+        # retained ledger tail, and re-folding consumed joins would walk
+        # the EWMA and double-count samples on every pass (idempotence:
+        # a refit over an unchanged ledger is a no-op)
+        self._seen_seq = 0  # guarded-by: self._lock
+
+    def curves_view(self) -> dict:
+        from ..columnar import costmodel as _costmodel
+
+        with self._lock:
+            repack = {k: round(v, 6) for k, v in sorted(self.repack_s.items())}
+        view = {
+            # the ship coefficient is SHARED with the columnar calibration
+            # (one curve, two consumers — the unification ROADMAP item 4
+            # asked for), not a second measurement that could disagree
+            "ship_us_per_row": _costmodel.MODEL.ship_us_per_row,
+            "repack_s": repack,
+        }
+        try:
+            from ..parallel import store as _store
+
+            view["budget_bytes"] = _store.PACK_CACHE.max_bytes
+        except Exception:  # rb-ok: exception-hygiene -- a curves read must not fail because the cache is mid-teardown; the pricing curves above are still valid
+            pass
+        return view
+
+    def repack_estimate(self, kind: str) -> Optional[float]:
+        """The learned re-pack cost (seconds) for one cache kind — what
+        the pack cache prices an eviction of that kind at (None until
+        evict-regret traffic has taught the curve). The evict decision
+        records it as ``est_us`` so the ledger join scores the residency
+        authority's pricing exactly like the other three (ISSUE 12)."""
+        with self._lock:
+            return self.repack_s.get(kind)
+
+    def drift(self) -> Dict[str, float]:
+        """Latest-sample vs EWMA ratio per kind — a kind whose newest
+        measured re-pack sits far off its learned curve is drifting."""
+        latest: Dict[str, float] = {}
+        for e in _evict_samples():
+            kind = (e.get("inputs") or {}).get("kind")
+            if kind and e.get("measured_s"):
+                latest[str(kind)] = float(e["measured_s"])  # newest wins
+        with self._lock:
+            ewma = dict(self.repack_s)
+        out = {}
+        for kind, s in sorted(latest.items()):
+            base = ewma.get(kind)
+            if base and base > 0 and s > 0:
+                out[kind] = round(s / base, 4)
+        return out
+
+    def refit_from_outcomes(
+        self, samples: Optional[List[dict]] = None, min_samples: int = 1
+    ) -> dict:
+        """Fold joined evict-regret samples into the per-kind EWMAs.
+        Ledger-sourced samples (carrying a decision ``seq``) are consumed
+        AT MOST ONCE across calls — re-refitting an unchanged ledger is a
+        no-op; explicit caller-owned sample lists without serials are
+        folded as given. Returns the facade-shape report."""
+        moved: Dict[str, dict] = {}
+        rejected = 0
+        by_kind: Dict[str, List[float]] = {}
+        with self._lock:
+            seen = self._seen_seq
+        max_seq = seen
+        for e in _evict_samples(samples):
+            seq = e.get("seq")
+            if seq is not None:
+                if seq <= seen:
+                    continue  # already folded by an earlier refit
+                max_seq = max(max_seq, seq)
+            kind = (e.get("inputs") or {}).get("kind")
+            s = e.get("measured_s")
+            if kind is None or s is None:
+                rejected += 1
+                continue
+            s = float(s)
+            if not math.isfinite(s) or s <= 0:
+                rejected += 1
+                continue
+            by_kind.setdefault(str(kind), []).append(s)
+        with self._lock:
+            self._seen_seq = max(self._seen_seq, max_seq)
+            for kind, ss in sorted(by_kind.items()):
+                if len(ss) < min_samples:
+                    continue
+                old = self.repack_s.get(kind)
+                cur = old
+                for s in ss:
+                    if cur is None or cur <= 0:
+                        cur = s
+                    else:
+                        cur = math.exp(
+                            (1 - _ALPHA) * math.log(cur) + _ALPHA * math.log(s)
+                        )
+                cur = round(cur, 9)
+                self.samples[kind] = self.samples.get(kind, 0) + len(ss)
+                if cur != old:
+                    self.repack_s[kind] = cur
+                    moved[kind] = {"from": old, "to": cur, "samples": len(ss)}
+            if moved:
+                self.provenance = "refit-from-traffic"
+                self.backend = _current_backend()
+            prov = self.provenance
+        return {"moved": moved, "rejected": rejected, "provenance": prov,
+                "samples": sum(len(s) for s in by_kind.values())}
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            return {
+                "schema": SCHEMA,
+                "backend": self.backend,
+                "repack_s": {k: v for k, v in sorted(self.repack_s.items())},
+                "samples": dict(self.samples),
+                "provenance": self.provenance,
+            }
+
+    def from_dict(self, d: dict) -> bool:
+        if not isinstance(d, dict) or d.get("schema") != SCHEMA:
+            return False
+        # re-pack walls are per-host measurements: a state measured on a
+        # different backend must not price this host's evictions (the
+        # columnar model's per-backend discipline)
+        if d.get("backend") is not None and d["backend"] != _current_backend():
+            return False
+        repack = d.get("repack_s")
+        if not isinstance(repack, dict):
+            return False
+        clean: Dict[str, float] = {}
+        for kind, v in repack.items():
+            try:
+                v = float(v)
+            except (TypeError, ValueError):
+                return False
+            if not (math.isfinite(v) and v > 0):
+                return False
+            clean[str(kind)] = v
+        with self._lock:
+            self.repack_s = clean
+            self.samples = {
+                str(k): int(v) for k, v in (d.get("samples") or {}).items()
+            }
+            self.provenance = str(d.get("provenance") or "static")
+            self.backend = d.get("backend")
+        return True
+
+    def reset(self) -> None:
+        with self._lock:
+            self.repack_s = {}
+            self.samples = {}
+            self.provenance = "static"
+            self.backend = None
+            self._seen_seq = 0
+
+
+def _current_backend() -> Optional[str]:
+    try:
+        import jax
+
+        return jax.default_backend()
+    except (ImportError, RuntimeError):
+        return None
+
+
+def _evict_samples(samples: Optional[List[dict]] = None) -> List[dict]:
+    if samples is not None:
+        return list(samples)
+    from ..observe import outcomes as _outcomes
+
+    return [e for e in _outcomes.tail() if e.get("site") == "pack_cache.evict"]
+
+
+MODEL = ResidencyModel()

@@ -246,12 +246,41 @@ def regret_summary() -> dict:
     }
 
 
+def health() -> dict:
+    """Health-sentinel snapshot (ISSUE 12): the process status
+    (green/yellow/red), every rule's post-hysteresis level with its
+    current value and committed thresholds, and the recent actuation log
+    (auto-refits with per-authority provenance, alerts, flight bundles).
+    ``scripts/rb_top.py`` renders this as the health panel."""
+    from . import observe
+
+    s = observe.sentinel.SENTINEL
+    level, name = s.status()
+    return {
+        "status": level,
+        "status_name": name,
+        "rules": s.rule_states(),
+        "actuations": s.actuations(8),
+        "sentinel_running": observe.sentinel.running(),
+    }
+
+
+def cost_authorities() -> dict:
+    """The unified cost facade's view (ISSUE 12): every pricing
+    authority's curves, provenance, and live drift — ROADMAP item 4's
+    "one self-tuning cost brain" as a read API."""
+    from . import cost
+
+    return cost.calibration_state()
+
+
 def observatory() -> dict:
     """Resource-observatory snapshot (ISSUE 9): lock-wait quantiles over
     the framework locks (empty until ``observe.lockstats.install()``),
     per-fn jit compile/retrace counts, the device-memory reconciliation
     report (computed fresh), current breaker states, pack-cache stats,
-    and the decision-log tail. ``scripts/rb_top.py`` renders exactly
+    the decision-log tail, and — since ISSUE 12 — the health sentinel's
+    status/rules/actuations. ``scripts/rb_top.py`` renders exactly
     this."""
     from . import observe
     from .observe import lockstats
@@ -267,6 +296,7 @@ def observatory() -> dict:
         "pack_cache": store.PACK_CACHE.stats(),
         "decisions": decisions(32),
         "regret": regret_summary(),
+        "health": health(),
     }
 
 

@@ -38,6 +38,9 @@ from .registry import (
     LOCK_WAIT_SECONDS,
     KERNEL_DISPATCH_TOTAL,
     KERNEL_PROBE_TOTAL,
+    HEALTH_ACTUATION_TOTAL,
+    HEALTH_RULE_STATE,
+    HEALTH_STATUS,
     OUTCOME_ANOMALY_TOTAL,
     OUTCOME_JOIN_TOTAL,
     OUTCOME_ORPHANS_TOTAL,
@@ -91,9 +94,18 @@ from . import compilewatch
 # the decision-outcome ledger (ISSUE 11): joins decisions to measured
 # executions; imported after decisions (it is decisions' lazy dependency)
 from . import outcomes
+# the health sentinel tier (ISSUE 12): unified artifact sink, declarative
+# health rules, the supervisor (opt-in thread via RB_TPU_SENTINEL), and
+# flight bundles; imported last — sentinel reads every registry above
+from . import artifacts
+from . import health
+from . import sentinel
+from . import bundle
 from .context import adopt, current_trace, new_trace_id, trace_scope
 from .decisions import DecisionLog, record_decision
 from .outcomes import OutcomeLedger
+from .sentinel import SENTINEL, Sentinel
+from .health import Rule, RuleState
 from .spans import current_path, depth, reset_spans, span, span_timings
 
 # the .histogram submodule import above shadows the registration helper on
@@ -186,10 +198,21 @@ __all__ = [
     "OUTCOME_ORPHANS_TOTAL",
     "OUTCOME_ANOMALY_TOTAL",
     "COSTMODEL_DRIFT_RATIO",
+    "HEALTH_STATUS",
+    "HEALTH_RULE_STATE",
+    "HEALTH_ACTUATION_TOTAL",
     "context",
     "decisions",
     "outcomes",
     "compilewatch",
+    "artifacts",
+    "health",
+    "sentinel",
+    "bundle",
+    "Rule",
+    "RuleState",
+    "Sentinel",
+    "SENTINEL",
     "trace_scope",
     "adopt",
     "current_trace",

@@ -23,7 +23,8 @@ is already spending milliseconds-to-seconds compiling.
 **Anomaly hook**: when any fn's trace count passes the budget
 (``RB_TPU_COMPILE_BUDGET``, default 32; ``configure(budget=...)``;
 ``<= 0`` disables), the flight recorder flushes to a JSONL artifact
-(``RB_TPU_COMPILE_DUMP``, default ``rb_tpu_compile_anomaly.jsonl``) with
+(``RB_TPU_COMPILE_DUMP``, default ``rb_tpu_compile_anomaly.jsonl`` inside
+the unified ``RB_TPU_ARTIFACT_DIR`` sink — see ``observe.artifacts``) with
 the offending fn in the trigger header — the "what shapes led up to
 this" context a post-hoc counter cannot reconstruct. Dumps are throttled
 to one per second; ``rb_tpu_timeline_anomaly_total{cat="compile"}``

@@ -222,6 +222,29 @@ def _regret_block(snap: dict, registry: Registry) -> dict:
     }
 
 
+def _health_block(snap: dict) -> dict:
+    """The health sentinel's sidecar block (ISSUE 12), derived PURELY
+    from the registry gauges (like the regret block) so a ``--from``
+    rendering needs no live sentinel: the process status enum, per-rule
+    state enums, and the actuation counters. ``status`` is None when no
+    sentinel tick ever exported (the gauge has no unlabeled series)."""
+    status = None
+    m = snap.get(_registry.HEALTH_STATUS)
+    if m is not None:
+        for s in m["samples"]:
+            if not s["labels"]:
+                status = s["value"]
+    names = {0: "green", 1: "yellow", 2: "red"}
+    return {
+        "status": status,
+        "status_name": names.get(status),
+        "rules": _counter_map(snap, _registry.HEALTH_RULE_STATE),
+        "actuations": _counter_map(
+            snap, _registry.HEALTH_ACTUATION_TOTAL, joined=True
+        ),
+    }
+
+
 def sidecar_snapshot(registry: Optional[Registry] = None) -> dict:
     """The structured summary the bench sidecar persists. Top-level keys
     ``kernel``/``layout``/``transfer_bytes``/``spans`` are the contract
@@ -251,6 +274,9 @@ def sidecar_snapshot(registry: Optional[Registry] = None) -> dict:
         # decision-outcome ledger (ISSUE 11): per-site regret + error
         # ratios, join/orphan/anomaly volume, coefficient drift
         "regret": _regret_block(snap, _reg(registry)),
+        # health sentinel (ISSUE 12): the status/rule-state enum gauges
+        # and actuation counters, registry-derived like everything here
+        "health": _health_block(snap),
         "registry": snap,
     }
 

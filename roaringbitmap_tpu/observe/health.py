@@ -1,0 +1,362 @@
+"""Declarative health rules over the telemetry surface (ISSUE 12
+tentpole, leg 1).
+
+Every prior observability PR added *signals* — drift gauges, breaker
+states, regret rollups, anomaly counters, accounting reconciliation — but
+"is this process healthy?" still required a human reading rb_top. This
+module is the judgement layer: a **rule table** evaluated over point-in-
+time snapshots of those registries, folding into one process status.
+
+* :class:`Rule` — a named probe over a :class:`Snapshot` returning a
+  scalar "badness" (bigger is worse), with **warn/critical bands**
+  (``value >= warn`` → WARN, ``>= critical`` → CRITICAL), **hysteresis**
+  (``fire_after`` consecutive out-of-band ticks to raise the level,
+  ``clear_after`` consecutive in-band ticks to lower it — a single noisy
+  sample never flips the status), and **flap suppression** (a rule whose
+  raw band changed ``flap_limit`` times within the last ``flap_window``
+  ticks is *flapping*: it holds its fired level and suppresses downward
+  transitions until the signal stabilises — an oscillating input produces
+  one alert, not an alert storm).
+* :class:`RuleState` — the per-rule evaluation state machine. Pure data +
+  arithmetic: no clocks, no locks, no I/O — the sentinel owns locking and
+  pacing, which is what makes the fake-clock tests deterministic.
+* :class:`Snapshot` — what probes see: the metrics-registry snapshot,
+  breaker open-ages, the cost-model drift cells, and the outcome ledger's
+  per-site rollup, plus ``counter_delta`` (per-tick counter movement
+  against the previous tick's totals — rate rules without a clock).
+
+Levels are the Prometheus-style enum-gauge encoding the new metrics
+export: per-rule ``rb_tpu_health_rule_state{rule}`` ∈ {0 ok, 1 warn,
+2 critical} and the process rollup ``rb_tpu_health_status`` ∈ {0 green,
+1 yellow, 2 red} = max over rules.
+
+The **default rule table** below is the committed production judgement
+(thresholds in-repo, gated by scripts/ci.sh — the bench must end green):
+
+====================== ======================================== ===== =====
+rule                   badness value                            warn  crit
+====================== ======================================== ===== =====
+costmodel-drift        max over drift cells of max(r, 1/r)      2.0   4.0
+routing-regret         cumulative regret_s / measured_s         0.05  0.20
+breaker-stuck-open     max seconds any breaker has been open    30    300
+outcome-anomaly-burst  out-of-band joins since last tick        1     16
+hbm-accounting-drift   max |accounting drift| bytes             1     2^20
+compile-storm          jit traces since last tick               8     32
+====================== ======================================== ===== =====
+
+Actuations (the sentinel's closed-loop half — see ``observe.sentinel``):
+``costmodel-drift`` actuates ``"refit"`` (the ``cost/`` facade's
+``refit_all``, ROADMAP item 4's auto-trigger); the rest actuate
+``"alert"`` (a structured instant + decision entry on the fire
+transition); any rule reaching CRITICAL additionally triggers a one-shot
+flight bundle (``observe.bundle``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from collections import deque
+from typing import Callable, Dict, Optional, Tuple
+
+from . import registry as _registry
+
+OK, WARN, CRITICAL = 0, 1, 2
+LEVEL_NAMES = {OK: "ok", WARN: "warn", CRITICAL: "critical"}
+STATUS_NAMES = {OK: "green", WARN: "yellow", CRITICAL: "red"}
+
+# enum gauges (see module docstring for the encoding); registered here so
+# the series exist for the export/health-block derivation even before the
+# first sentinel tick
+HEALTH_STATUS = _registry.gauge(
+    _registry.HEALTH_STATUS,
+    "Process health rollup from the sentinel rule table "
+    "(0 green / 1 yellow / 2 red = max over rule states)",
+)
+HEALTH_RULE_STATE = _registry.gauge(
+    _registry.HEALTH_RULE_STATE,
+    "Per-rule health level after hysteresis/flap suppression "
+    "(0 ok / 1 warn / 2 critical)",
+    ("rule",),
+)
+
+
+@dataclass(frozen=True)
+class Rule:
+    """One declarative health judgement. ``probe(snapshot)`` returns the
+    scalar badness (bigger is worse; None = no data, treated as OK);
+    ``actuation`` names the closed-loop response the sentinel runs when
+    the rule fires (``"refit"`` / ``"alert"`` / None)."""
+
+    name: str
+    help: str
+    probe: Callable[["Snapshot"], Optional[float]]
+    warn: float
+    critical: float
+    fire_after: int = 2
+    clear_after: int = 2
+    flap_window: int = 16
+    flap_limit: int = 4
+    actuation: Optional[str] = None
+
+    def band(self, value: Optional[float]) -> int:
+        """The raw (pre-hysteresis) level of one sample."""
+        if value is None:
+            return OK
+        if value >= self.critical:
+            return CRITICAL
+        if value >= self.warn:
+            return WARN
+        return OK
+
+
+class RuleState:
+    """The per-rule hysteresis + flap-suppression state machine. Owned
+    and locked by the sentinel; this class itself is pure bookkeeping so
+    tests drive it tick-by-tick with no clock at all."""
+
+    __slots__ = (
+        "level", "streak_worse", "streak_better", "last_raw", "last_value",
+        "flapping", "_band_changes", "history",
+    )
+
+    def __init__(self, history: int = 64):
+        self.level = OK
+        self.streak_worse = 0
+        self.streak_better = 0
+        self.last_raw: Optional[int] = None
+        self.last_value: Optional[float] = None
+        self.flapping = False
+        # tick numbers at which the RAW band changed (the flap signal —
+        # counting applied transitions would self-sustain: a suppressed
+        # clear would count as instability and pin the rule flapping)
+        self._band_changes: "deque[int]" = deque()
+        self.history: "deque[dict]" = deque(maxlen=history)
+
+    def step(self, rule: Rule, value: Optional[float], tick_no: int) -> dict:
+        """Advance one tick; returns the evaluation record (also appended
+        to ``history``): value, raw band, applied level, the transition
+        (``(from, to)`` or None), and whether flap suppression held a
+        would-be clear."""
+        raw = rule.band(value)
+        # flap bookkeeping first: raw band movement within the window
+        if self.last_raw is not None and raw != self.last_raw:
+            self._band_changes.append(tick_no)
+        self.last_raw = raw
+        self.last_value = value
+        floor = tick_no - rule.flap_window
+        while self._band_changes and self._band_changes[0] <= floor:
+            self._band_changes.popleft()
+        self.flapping = len(self._band_changes) >= rule.flap_limit
+        transition: Optional[Tuple[int, int]] = None
+        suppressed = False
+        if raw > self.level:
+            self.streak_worse += 1
+            self.streak_better = 0
+            if self.streak_worse >= rule.fire_after:
+                transition = (self.level, raw)
+                self.level = raw
+                self.streak_worse = 0
+        elif raw < self.level:
+            self.streak_better += 1
+            self.streak_worse = 0
+            if self.streak_better >= rule.clear_after:
+                if self.flapping:
+                    # hold the fired level: an oscillating signal must not
+                    # clear-and-refire its way into an alert storm
+                    suppressed = True
+                else:
+                    transition = (self.level, raw)
+                    self.level = raw
+                self.streak_better = 0
+        else:
+            self.streak_worse = 0
+            self.streak_better = 0
+        rec = {
+            "tick": tick_no,
+            "value": value,
+            "raw": raw,
+            "level": self.level,
+            "transition": transition,
+            "flapping": self.flapping,
+            "suppressed": suppressed,
+        }
+        self.history.append(rec)
+        return rec
+
+    def as_dict(self) -> dict:
+        return {
+            "level": self.level,
+            "level_name": LEVEL_NAMES[self.level],
+            "value": self.last_value,
+            "flapping": self.flapping,
+        }
+
+
+# ---------------------------------------------------------------------------
+# snapshot: what rule probes see
+# ---------------------------------------------------------------------------
+
+
+class Snapshot:
+    """Point-in-time view of every registry a rule may judge. Built by
+    ``snapshot()`` OUTSIDE the sentinel lock (gathering takes the
+    registry/ladder/ledger leaf locks); probes then run against plain
+    data. ``counter_delta`` compares against the previous tick's totals
+    (``prev_sums``) — the first tick reports 0 so pre-existing totals
+    never fire a rate rule."""
+
+    def __init__(
+        self,
+        metrics: dict,
+        breaker_open_ages: Dict[str, float],
+        drift: Dict[Tuple[str, str, str], float],
+        outcome_sites: Dict[str, dict],
+        now: float,
+        prev_sums: Optional[Dict[str, float]] = None,
+    ):
+        self.metrics = metrics
+        self.breaker_open_ages = breaker_open_ages
+        self.drift = drift
+        self.outcome_sites = outcome_sites
+        self.now = now
+        self._prev = prev_sums or {}
+        self.sums: Dict[str, float] = {}  # totals touched this tick
+
+    def counter_sum(self, name: str) -> float:
+        m = self.metrics.get(name)
+        if m is None:
+            return 0.0
+        return float(sum(s.get("value", 0) for s in m.get("samples", ())))
+
+    def counter_delta(self, name: str) -> float:
+        cur = self.counter_sum(name)
+        self.sums[name] = cur
+        prev = self._prev.get(name)
+        if prev is None:
+            return 0.0
+        return max(0.0, cur - prev)
+
+    def gauge_max_abs(self, name: str) -> float:
+        m = self.metrics.get(name)
+        if m is None:
+            return 0.0
+        vals = [abs(s.get("value", 0)) for s in m.get("samples", ())]
+        return float(max(vals)) if vals else 0.0
+
+
+def snapshot(
+    prev_sums: Optional[Dict[str, float]] = None,
+    now: Optional[float] = None,
+    refresh_hbm: bool = True,
+) -> Snapshot:
+    """Gather the rule-probe view. ``refresh_hbm`` additionally runs the
+    device-memory reconciliation so the drift gauges judge CURRENT
+    reality, not the last time someone happened to reconcile; any failure
+    there leaves the stale gauges in place (judging stale telemetry beats
+    killing the supervisor)."""
+    import time as _time
+
+    from . import outcomes as _outcomes
+
+    if refresh_hbm:
+        try:
+            from ..parallel import store as _store
+
+            _store.hbm_reconciliation()
+        except Exception:  # rb-ok: exception-hygiene -- the supervisor must keep judging on stale gauges when a refresh fails (e.g. a backend probe raising mid-teardown); the stale values are still real telemetry
+            pass
+    ages: Dict[str, float] = {}
+    try:
+        from ..robust import ladder as _ladder
+
+        ages = _ladder.LADDER.open_ages()
+    except Exception:  # rb-ok: exception-hygiene -- same stale-beats-dead contract as the hbm refresh above
+        pass
+    return Snapshot(
+        metrics=_registry.REGISTRY.snapshot(),
+        breaker_open_ages=ages,
+        drift=_outcomes.LEDGER.drift(),
+        outcome_sites=_outcomes.LEDGER.summary(),
+        now=_time.monotonic() if now is None else now,
+        prev_sums=prev_sums,
+    )
+
+
+# ---------------------------------------------------------------------------
+# the committed default rule table
+# ---------------------------------------------------------------------------
+
+
+def _drift_badness(s: Snapshot) -> float:
+    """Worst coefficient-cell drift, symmetric: max(r, 1/r) over the
+    geometric-EWMA cells (1.0 = every calibrated curve still truthful)."""
+    worst = 1.0
+    for r in s.drift.values():
+        if r > 0:
+            worst = max(worst, r, 1.0 / r)
+    return worst
+
+
+def _regret_fraction(s: Snapshot) -> float:
+    """Cumulative wall lost to wrong verdicts as a fraction of the joined
+    measured wall (the ROADMAP item 4 gate, judged continuously)."""
+    regret = sum(a.get("regret_s", 0.0) for a in s.outcome_sites.values())
+    measured = sum(a.get("measured_s", 0.0) for a in s.outcome_sites.values())
+    if measured <= 0:
+        return 0.0
+    return regret / measured
+
+
+def _max_open_age(s: Snapshot) -> float:
+    return max(s.breaker_open_ages.values(), default=0.0)
+
+
+DEFAULT_RULES: Tuple[Rule, ...] = (
+    Rule(
+        "costmodel-drift",
+        "a pricing authority's coefficient cell no longer describes live "
+        "traffic (geometric-EWMA drift left its band)",
+        _drift_badness,
+        warn=2.0, critical=4.0, fire_after=2, clear_after=2,
+        actuation="refit",
+    ),
+    Rule(
+        "routing-regret",
+        "wall-clock lost to wrong routing verdicts exceeds the regret "
+        "budget (fraction of joined measured wall)",
+        _regret_fraction,
+        warn=0.05, critical=0.20, fire_after=3, clear_after=3,
+        actuation="alert",
+    ),
+    Rule(
+        "breaker-stuck-open",
+        "a circuit breaker has been continuously open past recovery "
+        "expectations (seconds)",
+        _max_open_age,
+        warn=30.0, critical=300.0, fire_after=1, clear_after=1,
+        actuation="alert",
+    ),
+    Rule(
+        "outcome-anomaly-burst",
+        "out-of-band predicted-vs-measured joins since the last tick",
+        lambda s: s.counter_delta(_registry.OUTCOME_ANOMALY_TOTAL),
+        warn=1.0, critical=16.0, fire_after=1, clear_after=2,
+        actuation="alert",
+    ),
+    Rule(
+        "hbm-accounting-drift",
+        "device-memory accounting drift (resident gauge vs cache "
+        "ledgers), max |bytes| over sources",
+        lambda s: s.gauge_max_abs(_registry.HBM_ACCOUNTING_DRIFT_BYTES),
+        warn=1.0, critical=float(1 << 20), fire_after=1, clear_after=1,
+        actuation="alert",
+    ),
+    Rule(
+        "compile-storm",
+        "XLA traces (compiles + retraces) since the last tick — steady "
+        "state must not retrace",
+        lambda s: s.counter_delta(_registry.COMPILE_TOTAL),
+        warn=8.0, critical=32.0, fire_after=1, clear_after=2,
+        actuation="alert",
+    ),
+)

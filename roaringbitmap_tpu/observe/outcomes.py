@@ -191,16 +191,20 @@ class OutcomeLedger:
         site = joined["site"]
         regret = joined.get("regret_s") or 0.0
         err = joined.get("error_ratio")
+        measured = joined.get("measured_s") or 0.0
         with self._lock:
             self._ring.append(joined)
             agg = self._sites.get(site)
             if agg is None:
                 agg = self._sites[site] = {
-                    "count": 0, "regret_s": 0.0,
+                    "count": 0, "regret_s": 0.0, "measured_s": 0.0,
                     "log_err_sum": 0.0, "log_err_n": 0, "worst": None,
                 }
             agg["count"] += 1
             agg["regret_s"] += regret
+            # cumulative measured wall: the denominator of the health
+            # sentinel's routing-regret fraction (ISSUE 12)
+            agg["measured_s"] += measured
             if err is not None and err > 0:
                 import math
 
@@ -231,6 +235,16 @@ class OutcomeLedger:
         with self._lock:
             return dict(self._drift)
 
+    def rebase_drift(self, cells) -> None:
+        """Re-base the given cells' EWMAs to 1.0 — called after a refit
+        replaced their coefficients (ISSUE 12): the accumulated drift
+        measured the OLD curve's error; leaving it would re-trigger the
+        sentinel's drift rule against coefficients that already moved."""
+        with self._lock:
+            for cell in cells:
+                if cell in self._drift:
+                    self._drift[cell] = 1.0
+
     def tail(self, n: Optional[int] = None) -> List[dict]:
         """The newest ``n`` joined entries (all retained when None),
         oldest first — point-in-time copies, safe to mutate."""
@@ -253,6 +267,7 @@ class OutcomeLedger:
                 out[site] = {
                     "count": agg["count"],
                     "regret_s": round(agg["regret_s"], 6),
+                    "measured_s": round(agg["measured_s"], 6),
                     "error_ratio_geomean": (
                         round(math.exp(agg["log_err_sum"] / n), 4) if n else None
                     ),
@@ -575,6 +590,26 @@ def drift() -> Dict[str, float]:
     return {"/".join(cell): round(v, 4) for cell, v in sorted(LEDGER.drift().items())}
 
 
+def rebase_drift(cells=None) -> None:
+    """Re-base drift EWMAs (and their gauge series) to 1.0 after a refit
+    replaced the underlying coefficients; ``cells`` is an iterable of
+    ``(group, engine, shape)`` tuples or ``"group/engine/shape"`` strings
+    (None = every tracked cell). The cost facade calls this with exactly
+    the cells a refit moved (ISSUE 12)."""
+    tracked = LEDGER.drift()
+    if cells is None:
+        chosen = list(tracked)
+    else:
+        chosen = []
+        for c in cells:
+            cell = tuple(c.split("/")) if isinstance(c, str) else tuple(c)
+            if cell in tracked:
+                chosen.append(cell)
+    LEDGER.rebase_drift(chosen)
+    for cell in chosen:
+        _DRIFT_RATIO.set(1.0, cell)
+
+
 def reset() -> None:
     """Drop all ledger state (tests, bench windows); metrics keep their
     registry series (reset those via observe.reset like everything else)."""
@@ -607,12 +642,15 @@ def _anomaly(site: str, joined: dict) -> None:
     }
 
     def _write():
+        from . import artifacts as _artifacts
         from .export import _atomic_write
 
         try:
             lines = [json.dumps(header, sort_keys=True)]
             lines.extend(json.dumps(e, sort_keys=True, default=str) for e in entries)
-            _atomic_write(path, "\n".join(lines) + "\n")
+            # unified artifact sink (ISSUE 12): bare filenames land in
+            # RB_TPU_ARTIFACT_DIR, never loose in the CWD
+            _atomic_write(_artifacts.resolve(path), "\n".join(lines) + "\n")
         except OSError:  # rb-ok: exception-hygiene -- diagnostics must never kill the instrumented pipeline; the anomaly counter above still recorded the trigger
             pass
 

@@ -91,6 +91,15 @@ OUTCOME_JOIN_TOTAL = "rb_tpu_outcome_join_total"
 OUTCOME_ORPHANS_TOTAL = "rb_tpu_outcome_orphans_total"
 OUTCOME_ANOMALY_TOTAL = "rb_tpu_outcome_anomaly_total"
 COSTMODEL_DRIFT_RATIO = "rb_tpu_costmodel_drift_ratio"
+# health sentinel (ISSUE 12): enum gauges — _status is the process rollup
+# (0 green / 1 yellow / 2 red), _state the per-rule level (same encoding);
+# the _state/_status suffix marks an enum gauge by convention (the
+# metric-naming rule validates it like the _total/_seconds unit suffixes)
+HEALTH_STATUS = "rb_tpu_health_status"
+HEALTH_RULE_STATE = "rb_tpu_health_rule_state"
+# sentinel actuations (auto-refit, alert instants, flight bundles) by
+# rule and action kind
+HEALTH_ACTUATION_TOTAL = "rb_tpu_health_actuation_total"
 
 # upper bucket bounds (seconds) for wall-time histograms: host phases span
 # ~100 µs packing steps to multi-second CPU folds; +Inf is implicit

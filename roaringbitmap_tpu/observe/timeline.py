@@ -37,8 +37,10 @@ instrumentation appears on the timeline for free.
 **Dump-on-anomaly**: when a span exceeds the configured budget
 (``RB_TPU_TIMELINE_BUDGET_MS`` / ``configure(budget_ms=...)``), the whole
 flight recorder flushes to a JSONL artifact (``RB_TPU_TIMELINE_DUMP``,
-default ``rb_tpu_timeline_anomaly.jsonl``) — the "what led up to this"
-context a post-hoc aggregate can never reconstruct. Dumps are throttled to
+default ``rb_tpu_timeline_anomaly.jsonl`` inside the unified artifact
+sink ``RB_TPU_ARTIFACT_DIR`` — see ``observe.artifacts``; an explicit
+path with a directory component is honoured verbatim) — the "what led up
+to this" context a post-hoc aggregate can never reconstruct. Dumps are throttled to
 one per second so a pathological run cannot turn into an I/O storm;
 ``rb_tpu_timeline_anomaly_total{cat}`` counts every trigger regardless.
 
@@ -477,8 +479,12 @@ def dump_jsonl(
 
 
 def _dump_events(path, events, capacity, dropped, trigger) -> None:
+    from . import artifacts as _artifacts
     from .export import _atomic_write
 
+    # unified artifact sink (ISSUE 12): a bare-filename dump path (the
+    # default) lands in RB_TPU_ARTIFACT_DIR, never loose in the CWD
+    path = _artifacts.resolve(path)
     header = {
         "schema": DUMP_SCHEMA,
         "generated_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),

@@ -44,6 +44,7 @@ from ..models.container import (
 from ..models.roaring import RoaringBitmap
 from ..observe import context as _context
 from ..observe import decisions as _decisions
+from ..observe import sentinel as _sentinel
 from ..observe import timeline as _timeline
 from ..robust import errors as _rerrors
 from ..robust import ladder as _ladder
@@ -314,6 +315,9 @@ def _aggregate(
         return RoaringBitmap()
     if len(bitmaps) == 1:
         return bitmaps[0].clone()
+    # inline sentinel pacing (ISSUE 12): single-threaded serving loops get
+    # health supervision on the dispatch path; off (default) = one bool
+    _sentinel.maybe_tick()
     with _context.trace_scope():
         keys, n = _dispatch_prelude(bitmaps, op)
         if keys is not None and not keys:

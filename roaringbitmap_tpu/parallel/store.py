@@ -1537,6 +1537,19 @@ def static_fp_refs(bitmaps: Sequence[RoaringBitmap]) -> tuple:
     )
 
 
+def _repack_estimate_s(kind: str):
+    """The residency authority's learned re-pack cost for ``kind``
+    (ISSUE 12) — None until evict-regret traffic taught the curve, or
+    when the cost facade is unavailable (pricing an eviction must never
+    be able to fail the eviction)."""
+    try:
+        from ..cost import residency as _residency
+
+        return _residency.MODEL.repack_estimate(kind)
+    except Exception:  # rb-ok: exception-hygiene -- the eviction itself must proceed unpriced rather than fail on a diagnostics import/path error
+        return None
+
+
 class PackCache:
     """Process-wide device-resident working-set cache (ISSUE 4 tentpole).
 
@@ -1962,9 +1975,20 @@ class PackCache:
             _timeline.instant(
                 "pack_cache.evict", "cache", kind=e.kind, bytes=e.nbytes
             )
+            # the residency authority's learned re-pack cost prices this
+            # eviction (ISSUE 12): the evict-regret join then scores the
+            # pricing (predicted vs measured re-pack wall) exactly like
+            # the other pricing authorities' verdicts
+            est_repack_s = _repack_estimate_s(e.kind)
+            evict_inputs = {"kind": e.kind, "bytes": e.nbytes,
+                            "target_bytes": target}
+            if est_repack_s:
+                evict_inputs["est_us"] = {
+                    "repack": round(est_repack_s * 1e6, 1),
+                    "rebuild": round(est_repack_s * 1e6, 1),
+                }
             seq = _decisions.record_decision(
-                "pack_cache.evict", "lru", outcome=True, kind=e.kind,
-                bytes=e.nbytes, target_bytes=target,
+                "pack_cache.evict", "lru", outcome=True, **evict_inputs
             )
             ident = ("agg", e.key[1], tuple(_fp_ident(fp) for fp in e.fps)) \
                 if e.kind == "agg" else None

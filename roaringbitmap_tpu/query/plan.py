@@ -168,6 +168,43 @@ class CardinalityModel:
         )
         return report
 
+    SCHEMA = "rb_tpu_planner_cardmodel/1"
+
+    def to_dict(self) -> dict:
+        """Serializable correction state — the planner's half of the
+        unified ``cost/`` calibration lifecycle (ISSUE 12)."""
+        with self._lock:
+            return {
+                "schema": self.SCHEMA,
+                "corrections": dict(self.corrections),
+                "provenance": self.provenance,
+            }
+
+    def from_dict(self, d: dict) -> bool:
+        """Adopt serialized corrections; False (state untouched) on a
+        schema mismatch or out-of-clamp values — a corrupt state file
+        must not hand the planner an inverted operand ordering."""
+        if not isinstance(d, dict) or d.get("schema") != self.SCHEMA:
+            return False
+        corrections = d.get("corrections")
+        if not isinstance(corrections, dict):
+            return False
+        clean = {op: 1.0 for op in self.OPS}
+        for op, c in corrections.items():
+            if op not in clean:
+                continue
+            try:
+                c = float(c)
+            except (TypeError, ValueError):
+                return False
+            if not (1.0 / self.MAX_CORRECTION <= c <= self.MAX_CORRECTION):
+                return False
+            clean[op] = c
+        with self._lock:
+            self.corrections = clean
+            self.provenance = str(d.get("provenance") or "default")
+        return True
+
     def reset(self) -> None:
         with self._lock:
             self.corrections = {op: 1.0 for op in self.OPS}

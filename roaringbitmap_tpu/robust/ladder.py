@@ -89,13 +89,19 @@ class Breaker:
     guarded by the owning Ladder's health lock; transition METRICS are
     returned to the caller and emitted outside it (leaf-lock discipline)."""
 
-    __slots__ = ("state", "consecutive", "opened_at", "trip_after",
-                 "cooldown_s", "probing")
+    __slots__ = ("state", "consecutive", "opened_at", "first_opened_at",
+                 "trip_after", "cooldown_s", "probing")
 
     def __init__(self, trip_after: int, cooldown_s: float):
         self.state = CLOSED
         self.consecutive = 0       # consecutive failures while closed
         self.opened_at = 0.0       # monotonic time of the last trip
+        # when the CURRENT unhealthy episode began: set on the CLOSED->OPEN
+        # trip, NOT reset by failed half-open probes (each probe failure
+        # re-trips and moves opened_at, so opened_at alone can never age
+        # past one cooldown under traffic — the stuck-open health rule
+        # needs the episode start, ISSUE 12), cleared on recovery
+        self.first_opened_at = 0.0
         self.trip_after = trip_after
         self.cooldown_s = cooldown_s
         self.probing = False       # a half-open probe is in flight
@@ -118,6 +124,7 @@ class Breaker:
     def success(self) -> Optional[str]:
         self.consecutive = 0
         self.probing = False
+        self.first_opened_at = 0.0
         if self.state != CLOSED:
             self.state = CLOSED
             return CLOSED
@@ -126,6 +133,7 @@ class Breaker:
     def failure(self, now: float) -> Optional[str]:
         self.probing = False
         if self.state == HALF_OPEN:
+            # failed probe re-trips: the episode continues, its start stays
             self.state = OPEN
             self.opened_at = now
             return OPEN
@@ -133,6 +141,7 @@ class Breaker:
         if self.state == CLOSED and self.consecutive >= self.trip_after:
             self.state = OPEN
             self.opened_at = now
+            self.first_opened_at = now
             return OPEN
         return None
 
@@ -184,6 +193,27 @@ class Ladder:
             return {
                 f"{site}/{tier}": b.state
                 for (site, tier), b in sorted(self._breakers.items())
+            }
+
+    def open_ages(self, now: Optional[float] = None) -> dict:
+        """``{"site/tier": seconds-since-the-episode-opened}`` for every
+        breaker currently OPEN (half-open probes in flight count as open —
+        the tier is still not absorbing traffic). Ages are measured from
+        the EPISODE start (``first_opened_at``), not the last re-trip:
+        under steady traffic a stuck tier fails one half-open probe per
+        cooldown, each re-trip moving ``opened_at`` — measured from there
+        the age could never exceed one cooldown. The health sentinel's
+        breaker-stuck-open rule judges the max (ISSUE 12); ``now`` is
+        injectable monotonic time for fake-clock tests."""
+        if now is None:
+            now = time.monotonic()
+        with self._lock:
+            return {
+                f"{site}/{tier}": max(
+                    0.0, now - (b.first_opened_at or b.opened_at)
+                )
+                for (site, tier), b in sorted(self._breakers.items())
+                if b.state in (OPEN, HALF_OPEN)
             }
 
     # -- recording helpers (metrics OUTSIDE the health lock) ---------------

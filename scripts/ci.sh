@@ -143,9 +143,11 @@ step "bench.py --smoke (end-to-end north-star path, CPU)"
 # validate the driver contract, not just the exit code: exactly the keys
 # BENCH_r*.json records, with a sane positive speedup
 rm -f /tmp/ci_bench_metrics.json /tmp/ci_bench.json /tmp/ci_bench_timeline.json
+rm -rf /tmp/ci_artifacts
 JAX_PLATFORMS=cpu BENCH_METRICS_OUT=/tmp/ci_bench_metrics.json \
   BENCH_JSON_OUT=/tmp/ci_bench.json \
   BENCH_TIMELINE_OUT=/tmp/ci_bench_timeline.json \
+  RB_TPU_ARTIFACT_DIR=/tmp/ci_artifacts \
   python bench.py --smoke | python -c '
 import json, sys
 line = sys.stdin.readlines()[-1]
@@ -523,6 +525,130 @@ if d is None or d.labelnames != ("group", "engine", "shape"):
     raise SystemExit("drift gauge label set is not the declared cell tuple")
 print("outcome metric names ok (suffixes + declared label sets)")'
 
+step "health sentinel: green end state, auto-refit demo, flight bundle (ISSUE 12)"
+# the bench must commit the closed-loop demo (seeded drift -> red ->
+# cost.refit_all within the cooldown -> coefficients toward truth ->
+# provenance persisted through RB_TPU_COLUMNAR_CAL -> exactly one
+# manifest-indexed bundle in the artifact sink -> green), the end-of-run
+# judgement must be green over the committed in-repo rule table, the
+# sidecar must carry the registry-derived health block, and NO diagnostic
+# artifact may sit loose in the repo CWD (the unified sink contract)
+python -c '
+import json, os
+m = json.load(open("/tmp/ci_bench.json"))["meta"]
+sent = m.get("sentinel")
+if not isinstance(sent, dict):
+    raise SystemExit("bench meta lacks the sentinel demo block")
+need = {"rule", "cell", "drift_seeded", "ticks_to_refit", "poisoned", "refit",
+        "moved_toward_truth", "provenance_live", "provenance_persisted",
+        "refit_authorities", "bundle", "status_end"}
+missing = need - set(sent)
+if missing:
+    raise SystemExit("sentinel block lacks %s" % sorted(missing))
+if sent["rule"] != "costmodel-drift":
+    raise SystemExit("auto-refit actuated by the wrong rule: %r" % sent["rule"])
+if 0.25 <= sent["drift_seeded"] <= 4.0:
+    raise SystemExit("seeded drift %s never left the band" % sent["drift_seeded"])
+if sent["moved_toward_truth"] is not True:
+    raise SystemExit("auto-refit did not move the poisoned cell: %r" % sent)
+if sent["provenance_live"] != "refit-from-traffic" \
+        or sent["provenance_persisted"] != "refit-from-traffic":
+    raise SystemExit("auto-refit provenance missing/unpersisted: %r"
+                     % {k: sent[k] for k in ("provenance_live", "provenance_persisted")})
+if sent["refit_authorities"].get("columnar-cutoff") != "refit-from-traffic":
+    raise SystemExit("actuation log lacks the columnar authority provenance: %r"
+                     % sent["refit_authorities"])
+bun = sent["bundle"]
+if not (bun.get("manifest_ok") is True and bun.get("files", 0) >= 7):
+    raise SystemExit("red episode bundle missing/incomplete: %r" % bun)
+if sent["status_end"] != "green":
+    raise SystemExit("demo did not return green: %r" % sent["status_end"])
+h = m.get("health")
+if not (isinstance(h, dict) and h.get("status_end") == "green"):
+    raise SystemExit("end-of-bench health is not green: %r" % h)
+if h.get("cwd_clean") is not True or any(h.get("rules", {}).values()):
+    raise SystemExit("end-of-bench rules firing / CWD dirty: %r" % h)
+need_rules = {"costmodel-drift", "routing-regret", "breaker-stuck-open",
+              "outcome-anomaly-burst", "hbm-accounting-drift", "compile-storm"}
+if set(h.get("rules", {})) != need_rules:
+    raise SystemExit("committed rule table changed: %r" % sorted(h.get("rules", {})))
+side = json.load(open("/tmp/ci_bench_metrics.json"))
+sh = side.get("health")
+if not isinstance(sh, dict):
+    raise SystemExit("metrics sidecar lacks the health block")
+if sh.get("status") != 0 or sh.get("status_name") != "green":
+    raise SystemExit("sidecar health status not green: %r" % sh)
+if set(sh.get("rules", {})) != need_rules or any(sh["rules"].values()):
+    raise SystemExit("sidecar rule states wrong/firing: %r" % sh.get("rules"))
+strays = sorted(f for f in os.listdir(".")
+                if (f.startswith("rb_tpu_") and f.endswith(".jsonl"))
+                or f.startswith("bundle_"))
+if strays:
+    raise SystemExit("diagnostic artifacts loose in the repo CWD: %r" % strays)
+if not os.path.isdir("/tmp/ci_artifacts"):
+    raise SystemExit("artifact sink dir never materialized")
+print("health sentinel ok (drift %s -> refit %s in %s ticks, bundle %s files, "
+      "end %s; sink %s)"
+      % (sent["drift_seeded"], sent["refit"], sent["ticks_to_refit"],
+         bun["files"], h["status_end"], sorted(os.listdir("/tmp/ci_artifacts"))[:3]))'
+# bundle schema validated end-to-end by forcing one red tick in a FRESH
+# subprocess (not the bench state): a synthetic critical rule goes red on
+# its first evaluation, the bundle must land manifest-indexed in the
+# artifact sink (never the CWD), and the manifest must re-verify
+JAX_PLATFORMS=cpu RB_TPU_ARTIFACT_DIR=/tmp/ci_artifacts python - <<'EOF'
+import json, os
+from roaringbitmap_tpu.observe import artifacts, bundle, health, sentinel
+
+cwd_before = set(os.listdir("."))
+rule = health.Rule("ci-forced-red", "forced", lambda s: 1e9,
+                   warn=1.0, critical=2.0, fire_after=1, clear_after=1)
+s = sentinel.Sentinel(rules=(rule,), clock=lambda: 0.0)
+rep = s.tick(now=0.0)
+if rep["status_name"] != "red":
+    raise SystemExit("forced red tick judged %r" % rep["status_name"])
+bundles = [a for a in rep["actuated"] if a["kind"] == "bundle"]
+if len(bundles) != 1 or "path" not in bundles[0]:
+    raise SystemExit("forced red tick wrote %d bundle(s)" % len(bundles))
+path = bundles[0]["path"]
+if os.path.dirname(path) != artifacts.artifact_dir():
+    raise SystemExit("bundle escaped the sink: %r" % path)
+manifest = bundle.read_manifest(path)  # schema + sizes + sha256
+need = {"timeline.jsonl", "decisions.json", "outcomes.json", "metrics.jsonl",
+        "calibration.json", "observatory.json", "health.json"}
+if set(manifest["files"]) != need:
+    raise SystemExit("bundle file set wrong: %r" % sorted(manifest["files"]))
+hd = json.load(open(os.path.join(path, "health.json")))
+if hd["rules"]["ci-forced-red"]["level"] != 2 or not hd["rules"]["ci-forced-red"]["history"]:
+    raise SystemExit("bundle health.json lacks the red rule state/history")
+cal = json.load(open(os.path.join(path, "calibration.json")))
+if set(cal.get("authorities", {})) != {"columnar-cutoff", "device-breakeven",
+                                       "pack-residency", "planner-cardinality"}:
+    raise SystemExit("bundle calibration.json lacks the four authorities: %r"
+                     % sorted(cal.get("authorities", {})))
+new_cwd = sorted(set(os.listdir(".")) - cwd_before)
+if new_cwd:
+    raise SystemExit("forced red tick wrote into the CWD: %r" % new_cwd)
+print("bundle schema ok (%s, %d files, manifest verified)"
+      % (os.path.basename(path), len(manifest["files"])))
+EOF
+# the health metric names must pass the naming convention (enum-gauge
+# _state/_status suffixes + declared label sets)
+JAX_PLATFORMS=cpu python -c '
+from roaringbitmap_tpu import observe
+for name, suffix in ((observe.HEALTH_STATUS, "_status"),
+                     (observe.HEALTH_RULE_STATE, "_state"),
+                     (observe.HEALTH_ACTUATION_TOTAL, "_total")):
+    if not (name.startswith("rb_tpu_") and name.endswith(suffix)):
+        raise SystemExit("health metric violates naming convention: %r" % name)
+g = observe.REGISTRY.get(observe.HEALTH_RULE_STATE)
+if g is None or g.labelnames != ("rule",):
+    raise SystemExit("rule-state gauge label set is not the declared (rule,)")
+a = observe.REGISTRY.get(observe.HEALTH_ACTUATION_TOTAL)
+if a is None or a.labelnames != ("rule", "kind"):
+    raise SystemExit("actuation counter label set is not the declared (rule, kind)")
+print("health metric names ok (enum-gauge suffixes + declared label sets)"
+)'
+
 step "query-scoped tracing + off-mode twin rows (ISSUE 9 acceptance)"
 # 100% of lane-emitted events must carry the originating query trace id
 # (explicit handoff across the lane thread), per-trace stage attribution
@@ -554,18 +680,20 @@ if comp.get("steady_state_retraces") != 0:
 print("tracing ok (lane %s events 100%% attributed over %s queries; off-mode %s%%; 0 retraces)"
       % (tr["lane_events"], tr["queries"], obs["off_overhead_pct"]))'
 
-step "rb_top observatory report (schema rb_tpu_top/2, ISSUE 9 + 11)"
+step "rb_top observatory report (schema rb_tpu_top/3, ISSUE 9 + 11 + 12)"
 # the snapshot CLI must produce a schema-valid JSON report with every
 # panel populated from its in-process demo workload — incl. the regret
-# panel (per-site joins from the decision-outcome ledger)
-JAX_PLATFORMS=cpu python scripts/rb_top.py --demo --json > /tmp/ci_rb_top.json
+# panel (per-site joins from the decision-outcome ledger) and the health
+# panel (sentinel status + the committed rule table, judged green)
+JAX_PLATFORMS=cpu RB_TPU_ARTIFACT_DIR=/tmp/ci_artifacts \
+  python scripts/rb_top.py --demo --json > /tmp/ci_rb_top.json
 python -c '
 import json
 r = json.load(open("/tmp/ci_rb_top.json"))
-if r.get("schema") != "rb_tpu_top/2":
+if r.get("schema") != "rb_tpu_top/3":
     raise SystemExit("rb_top: bad schema %r" % r.get("schema"))
 need = {"schema", "generated_utc", "source", "counters", "latency",
-        "locks", "breakers", "cache", "decisions_tail", "regret"}
+        "locks", "breakers", "cache", "decisions_tail", "regret", "health"}
 missing = need - set(r)
 if missing:
     raise SystemExit("rb_top report lacks %s" % sorted(missing))
@@ -582,10 +710,19 @@ if not reg.get("sites"):
     raise SystemExit("rb_top demo joined no decision outcomes: %r" % reg)
 if "provenance" not in reg:
     raise SystemExit("rb_top regret panel lacks model provenance: %r" % sorted(reg))
+h = r["health"]
+if h.get("status_name") != "green":
+    raise SystemExit("rb_top demo health not green: %r" % h.get("status_name"))
+if not h.get("rules"):
+    raise SystemExit("rb_top health panel carries no rule states")
+for rule, st in h["rules"].items():
+    if not ({"level", "level_name", "warn", "critical"} <= set(st)):
+        raise SystemExit("rb_top health rule %s lacks thresholds: %r" % (rule, st))
 sites = {d["site"] for d in r["decisions_tail"]}
-print("rb_top ok (locks %s; %d decisions over sites %s; regret sites %s)"
+print("rb_top ok (locks %s; %d decisions over sites %s; regret sites %s; "
+      "health %s over %d rules)"
       % (sorted(r["locks"]), len(r["decisions_tail"]), sorted(sites),
-         sorted(reg["sites"])))'
+         sorted(reg["sites"]), h["status_name"], len(h["rules"])))'
 # the sidecar-sourced rendering must parse the bench artifact too
 python scripts/rb_top.py --from /tmp/ci_bench_metrics.json --json > /dev/null
 

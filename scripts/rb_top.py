@@ -5,11 +5,14 @@ Renders the framework's observability surface as a single console or
 JSON report: registry counters (kernel dispatch, layouts, pack cache,
 degradations, compiles), latency histograms with p50/p99, lock-wait
 quantiles over the framework locks, circuit-breaker states, pack-cache
-residency + device-memory accounting drift, the decision-log tail, and
-— since ISSUE 11 — the regret panel: per-site routing regret and
-predicted-vs-measured error from the decision-outcome ledger, with the
-worst recent decision and its inputs — "where did time, memory, traffic,
-and WRONG VERDICTS go" in one artifact.
+residency + device-memory accounting drift, the decision-log tail, the
+regret panel (ISSUE 11: per-site routing regret and predicted-vs-
+measured error from the decision-outcome ledger), and — since ISSUE 12
+— the **health panel**: the sentinel's process status (green/yellow/
+red), every firing rule with its current value against its committed
+thresholds, and the last actuations (auto-refits with per-authority
+provenance, alerts, flight bundles) — "is this process healthy, and
+what did the supervisor do about it" in one artifact.
 
 Three sources::
 
@@ -21,11 +24,13 @@ Three sources::
                                                # (useful when imported:
                                                #  rb_top.report())
 
-``--json`` emits the machine-readable report (schema ``rb_tpu_top/2``:
-the ``regret`` key landed in /2; scripts/ci.sh validates it). Breaker
-states, the decision log, and the outcome ledger are process-local, so a
-sidecar-sourced report carries the sidecar's registry view of them
-(counter totals + the ``regret`` block) rather than live states.
+``--json`` emits the machine-readable report (schema ``rb_tpu_top/3``:
+the ``health`` key landed in /3, ``regret`` in /2; scripts/ci.sh
+validates it). Breaker states, the decision log, the outcome ledger, and
+sentinel rule states are process-local, so a sidecar-sourced report
+carries the sidecar's registry view of them (counter totals + the
+``regret``/``health`` blocks derived in export.py) rather than live
+states.
 """
 
 from __future__ import annotations
@@ -39,7 +44,7 @@ import time
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
-SCHEMA = "rb_tpu_top/2"
+SCHEMA = "rb_tpu_top/3"
 
 
 def _live_report(tail: int) -> dict:
@@ -69,6 +74,9 @@ def _live_report(tail: int) -> dict:
         # decision-outcome ledger (ISSUE 11): per-site regret + error
         # rollup, coefficient drift, model provenance
         "regret": insights.regret_summary(),
+        # health sentinel (ISSUE 12): status + per-rule states vs their
+        # committed thresholds + the recent actuation log
+        "health": insights.health(),
     }
 
 
@@ -114,6 +122,9 @@ def _sidecar_report(path: str, tail: int) -> dict:
         # regret_s + error means; joins/orphans/anomalies/drift ride
         # alongside) — rendered under the same panel as the live rollup
         "regret": side.get("regret", {}),
+        # the sidecar's registry-derived health block (status enum +
+        # per-rule state enums + actuation counters, export.py)
+        "health": side.get("health", {}),
     }
 
 
@@ -143,6 +154,12 @@ def _demo_workload() -> None:
     bms[0].add((hb << 16) | 4242)
     store.packed_for(bms)
     store.hbm_reconciliation()
+    # a couple of sentinel ticks so the health panel reports a judged
+    # status (hysteresis needs consecutive evaluations), not "never ran"
+    from roaringbitmap_tpu.observe import sentinel
+
+    sentinel.SENTINEL.tick()
+    sentinel.SENTINEL.tick()
 
 
 def _fmt_table(rows, indent="  "):
@@ -228,6 +245,48 @@ def _render_console(r: dict) -> str:
     section("regret (decision-outcome ledger)", reg_rows)
     if worst_rows:
         section("worst recent decisions", worst_rows)
+    # health panel (ISSUE 12): process status, firing rules with current
+    # value vs the committed thresholds, then the last actuations (auto-
+    # refit provenance included) — live reports carry rule dicts, sidecar
+    # reports carry the registry's state enums
+    h = r.get("health", {}) or {}
+    h_rows = []
+    status = h.get("status_name") or h.get("status")
+    h_rows.append(("status", status if status is not None else "(no sentinel tick)"))
+    rules = h.get("rules") or {}
+    for rule, st in sorted(rules.items()):
+        if isinstance(st, dict):  # live rule-state shape
+            if st.get("level", 0) or st.get("flapping"):
+                h_rows.append(
+                    (rule,
+                     f"{st.get('level_name')} value={st.get('value')} "
+                     f"warn>={st.get('warn')} crit>={st.get('critical')}"
+                     + (" FLAPPING" if st.get("flapping") else ""))
+                )
+        elif st:  # sidecar enum shape: nonzero = firing
+            h_rows.append((rule, f"state={st}"))
+    act_rows = []
+    for a in (h.get("actuations") or [])[-8:] if isinstance(
+            h.get("actuations"), list) else []:
+        desc = a.get("kind", "?")
+        if a.get("kind") == "refit":
+            provs = {
+                name: rep.get("provenance")
+                for name, rep in (a.get("authorities") or {}).items()
+                if rep.get("moved")
+            }
+            desc += f" rule={a.get('rule')} moved={provs}"
+        elif a.get("kind") == "bundle":
+            desc += f" rules={a.get('rules')} path={a.get('path')}"
+        else:
+            desc += f" rule={a.get('rule')} value={a.get('value')}"
+        act_rows.append((f"tick {a.get('tick')}", desc))
+    if isinstance(h.get("actuations"), dict):  # sidecar counter shape
+        for key, v in sorted(h["actuations"].items()):
+            act_rows.append((key, v))
+    section("health (sentinel)", h_rows)
+    if act_rows:
+        section("health actuations", act_rows)
     dec_rows = [
         (d.get("trace") or "-",
          f"{d['site']}: {d['decision']} {d.get('inputs', '')}")

@@ -752,6 +752,27 @@ def test_metric_naming_shaped_constant_definition_validated(tmp_path):
     assert len(res.findings) == 1
 
 
+def test_metric_naming_enum_gauge_state_status_suffixes(tmp_path):
+    """_STATE/_STATUS are shaped enum-gauge suffixes (ISSUE 12): cross-
+    module constants wearing them are accepted (their defining module
+    validates the value), and a bad definition-site value is flagged."""
+    src = """from roaringbitmap_tpu import observe
+from somewhere import HEALTH_STATUS, HEALTH_RULE_STATE
+A = observe.gauge(HEALTH_STATUS, "shaped: validated at definition")
+B = observe.gauge(HEALTH_RULE_STATE, "shaped: validated at definition", ("rule",))
+"""
+    res = _run_snippet(tmp_path, src, rules=["metric-naming"])
+    assert res.findings == []
+
+
+def test_metric_naming_state_status_values_need_prefix(tmp_path):
+    # an enum-gauge-suffixed VALUE without the rb_tpu_ prefix is flagged
+    # at its definition, exactly like the _total/_seconds shapes
+    src = 'WORKER_STATUS = "worker_status"\nPOOL_STATE = "pool_state"\n'
+    res = _run_snippet(tmp_path, src, rules=["metric-naming"])
+    assert len(res.findings) == 2
+
+
 def test_dtype_bare_from_import_cast_flagged(tmp_path):
     src = """# rb-payload-path
 from numpy import int32

@@ -1,0 +1,872 @@
+"""Health sentinel, declarative rules, cost facade, and flight bundles
+(ISSUE 12): rule hysteresis + flap suppression on a fake clock, actuation
+cooldown/idempotence, the seeded-drift → auto-refit e2e with provenance
+persisted through RB_TPU_COLUMNAR_CAL, bundle write → manifest
+round-trip, the unified artifact sink, the 16-thread hammer with the
+lock witness proving sentinel state is a leaf lock, and the off-mode
+zero-allocation pin on the inline pacing hook."""
+
+import copy
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import columnar, cost, insights, observe
+from roaringbitmap_tpu.analysis.lockwitness import LockWitness
+from roaringbitmap_tpu.columnar import costmodel
+from roaringbitmap_tpu.models.roaring import RoaringBitmap
+from roaringbitmap_tpu.observe import (
+    artifacts,
+    bundle,
+    decisions,
+    health,
+    outcomes,
+    sentinel,
+)
+from roaringbitmap_tpu.observe import timeline as tl
+from roaringbitmap_tpu.query.plan import CARD_MODEL
+from roaringbitmap_tpu.robust import ladder as rladder
+
+
+# ---------------------------------------------------------------------------
+# helpers: a dial-driven rule + a snapshot stub (no registries involved)
+# ---------------------------------------------------------------------------
+
+
+class _Dial:
+    """A probe whose value tests turn by hand."""
+
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, snap):
+        return self.value
+
+
+def _stub_snap():
+    return health.Snapshot(
+        metrics={}, breaker_open_ages={}, drift={}, outcome_sites={}, now=0.0
+    )
+
+
+def _mk(rule, **kw):
+    """A private sentinel on a fake clock with the given single rule."""
+    clock = kw.pop("clock", lambda: 0.0)
+    return sentinel.Sentinel(rules=(rule,), clock=clock, **kw)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    outcomes.reset()
+    sentinel.SENTINEL.reset()
+    yield
+    outcomes.reset()
+    sentinel.SENTINEL.reset()
+    sentinel.configure(inline=False)
+
+
+# ---------------------------------------------------------------------------
+# rule hysteresis + bands (fake clock: every tick is explicit)
+# ---------------------------------------------------------------------------
+
+
+def test_rule_fires_only_after_n_consecutive_ticks():
+    dial = _Dial(0.0)
+    rule = health.Rule("r", "", dial, warn=10.0, critical=100.0,
+                       fire_after=3, clear_after=2)
+    s = _mk(rule)
+    dial.value = 50.0  # warn band
+    for i in range(2):
+        r = s.tick(now=float(i), snap=_stub_snap())
+        assert r["rules"]["r"]["level"] == health.OK, f"fired early at {i}"
+    r = s.tick(now=2.0, snap=_stub_snap())
+    assert r["rules"]["r"]["level"] == health.WARN
+    assert r["rules"]["r"]["transition"] == (health.OK, health.WARN)
+    assert r["status_name"] == "yellow"
+
+
+def test_rule_clears_only_after_m_consecutive_ok_ticks():
+    dial = _Dial(50.0)
+    rule = health.Rule("r", "", dial, warn=10.0, critical=100.0,
+                       fire_after=1, clear_after=3)
+    s = _mk(rule)
+    s.tick(now=0.0, snap=_stub_snap())
+    assert s.status()[1] == "yellow"
+    dial.value = 0.0
+    for i in range(2):
+        s.tick(now=1.0 + i, snap=_stub_snap())
+        assert s.status()[1] == "yellow", "cleared early"
+    s.tick(now=3.0, snap=_stub_snap())
+    assert s.status()[1] == "green"
+
+
+def test_warn_vs_critical_bands_and_escalation():
+    dial = _Dial(50.0)
+    rule = health.Rule("r", "", dial, warn=10.0, critical=100.0,
+                       fire_after=2, clear_after=2)
+    s = _mk(rule)
+    s.tick(now=0.0, snap=_stub_snap())
+    s.tick(now=1.0, snap=_stub_snap())
+    assert s.rule_states()["r"]["level"] == health.WARN
+    dial.value = 500.0  # escalate: needs fire_after ticks above critical
+    s.tick(now=2.0, snap=_stub_snap())
+    assert s.rule_states()["r"]["level"] == health.WARN
+    r = s.tick(now=3.0, snap=_stub_snap())
+    assert r["rules"]["r"]["transition"] == (health.WARN, health.CRITICAL)
+    assert s.status()[1] == "red"
+
+
+def test_none_value_is_no_data_not_a_fire():
+    rule = health.Rule("r", "", lambda s: None, warn=1.0, critical=2.0,
+                       fire_after=1, clear_after=1)
+    s = _mk(rule)
+    r = s.tick(now=0.0, snap=_stub_snap())
+    assert r["rules"]["r"]["level"] == health.OK
+
+
+def test_probe_exception_is_reported_not_fatal():
+    def boom(snap):
+        raise RuntimeError("probe broke")
+
+    rule = health.Rule("r", "", boom, warn=1.0, critical=2.0)
+    s = _mk(rule)
+    r = s.tick(now=0.0, snap=_stub_snap())
+    assert r["status_name"] == "green"
+    assert "probe broke" in r["probe_errors"]["r"]
+
+
+def test_flap_suppression_holds_fired_level_and_then_recovers():
+    dial = _Dial(0.0)
+    rule = health.Rule("r", "", dial, warn=10.0, critical=100.0,
+                       fire_after=1, clear_after=1,
+                       flap_window=8, flap_limit=4)
+    s = _mk(rule)
+    # oscillate: each tick crosses the warn band boundary
+    held_at_warn = 0
+    for i in range(16):
+        dial.value = 50.0 if i % 2 == 0 else 0.0
+        r = s.tick(now=float(i), snap=_stub_snap())
+    st = s.rule_states()["r"]
+    assert st["flapping"], "oscillating input must mark the rule flapping"
+    # while flapping, the fired level is held (downward suppressed): the
+    # last oscillation ticks must all report WARN
+    hist = s.history("r", 6)
+    assert all(h["level"] == health.WARN for h in hist), hist
+    assert any(h["suppressed"] for h in hist)
+    # stabilize: band stops changing -> window drains -> flap clears ->
+    # the clear hysteresis finally applies
+    dial.value = 0.0
+    for i in range(16, 16 + rule.flap_window + rule.clear_after + 1):
+        s.tick(now=float(i), snap=_stub_snap())
+    st = s.rule_states()["r"]
+    assert not st["flapping"]
+    assert st["level"] == health.OK
+
+
+# ---------------------------------------------------------------------------
+# actuations: alert on fire transition, refit cooldown + idempotence,
+# bundle once per red episode
+# ---------------------------------------------------------------------------
+
+
+def test_alert_fires_once_per_episode_with_instant(monkeypatch):
+    dial = _Dial(50.0)
+    rule = health.Rule("r", "", dial, warn=10.0, critical=100.0,
+                       fire_after=1, clear_after=1, actuation="alert")
+    s = _mk(rule)
+    prev_mode = tl.mode_name()
+    tl.configure(mode="on")
+    try:
+        r1 = s.tick(now=0.0, snap=_stub_snap())
+        r2 = s.tick(now=1.0, snap=_stub_snap())  # still warn: no re-alert
+    finally:
+        tl.configure(mode=prev_mode)
+    assert [a["kind"] for a in r1["actuated"]] == ["alert"]
+    assert r2["actuated"] == []
+    names = [e.name for e in tl.RECORDER.events()]
+    assert "sentinel.alert" in names
+    acts = s.actuations()
+    assert len(acts) == 1 and acts[0]["rule"] == "r"
+
+
+def test_refit_actuation_cooldown_and_idempotence(monkeypatch):
+    calls = []
+    monkeypatch.setattr(cost, "refit_all", lambda: calls.append(1) or {})
+    dial = _Dial(5.0)
+    rule = health.Rule("r", "", dial, warn=1.0, critical=100.0,
+                       fire_after=1, clear_after=1, actuation="refit")
+    s = _mk(rule, refit_cooldown_s=60.0)
+    s.tick(now=0.0, snap=_stub_snap())
+    assert len(calls) == 1
+    # still firing, inside the cooldown: actuation must NOT re-run
+    s.tick(now=1.0, snap=_stub_snap())
+    s.tick(now=59.0, snap=_stub_snap())
+    assert len(calls) == 1, "refit re-ran inside its cooldown"
+    # past the cooldown and still drifted: one more refit
+    s.tick(now=61.0, snap=_stub_snap())
+    assert len(calls) == 2
+    kinds = [a["kind"] for a in s.actuations()]
+    assert kinds == ["refit", "refit"]
+
+
+def test_bundle_one_shot_per_red_episode(tmp_path, monkeypatch):
+    paths = []
+
+    def fake_bundle(reason, trigger=None, dir=None, health_dump=None):
+        paths.append(reason)
+        return str(tmp_path / f"b{len(paths)}")
+
+    monkeypatch.setattr(bundle, "write_bundle", fake_bundle)
+    dial = _Dial(500.0)
+    rule = health.Rule("r", "", dial, warn=10.0, critical=100.0,
+                       fire_after=1, clear_after=1)
+    s = _mk(rule, bundle_cooldown_s=300.0)
+    s.tick(now=0.0, snap=_stub_snap())
+    assert paths == ["r"], "entering red must write exactly one bundle"
+    # staying red: no second bundle
+    s.tick(now=1.0, snap=_stub_snap())
+    s.tick(now=2.0, snap=_stub_snap())
+    assert paths == ["r"]
+    # clear, then red again AFTER the cooldown: a new episode bundles
+    dial.value = 0.0
+    s.tick(now=3.0, snap=_stub_snap())
+    dial.value = 500.0
+    s.tick(now=400.0, snap=_stub_snap())
+    assert paths == ["r", "r"]
+
+
+def test_health_gauges_exported():
+    dial = _Dial(50.0)
+    rule = health.Rule("gauge-rule", "", dial, warn=10.0, critical=100.0,
+                       fire_after=1, clear_after=1)
+    s = _mk(rule)
+    s.tick(now=0.0, snap=_stub_snap())
+    g = observe.REGISTRY.get(observe.HEALTH_STATUS)
+    assert g.get(()) == health.WARN
+    rs = observe.REGISTRY.get(observe.HEALTH_RULE_STATE)
+    assert rs.get(("gauge-rule",)) == health.WARN
+
+
+# ---------------------------------------------------------------------------
+# default rule probes over real snapshots
+# ---------------------------------------------------------------------------
+
+
+def test_default_rules_green_on_healthy_process():
+    r = sentinel.SENTINEL.tick()
+    assert r["status_name"] == "green", r
+
+
+def test_breaker_stuck_open_rule_sees_ladder_ages():
+    rladder.LADDER.reset()
+    rladder.LADDER.configure(cooldown_s=600.0)
+    try:
+        for _ in range(3):
+            rladder.LADDER.record_failure("sent-test", "device")
+        ages = rladder.LADDER.open_ages(now=time.monotonic() + 120.0)
+        assert ages.get("sent-test/device", 0) >= 120.0
+        snap = health.snapshot(refresh_hbm=False)
+        snap.breaker_open_ages = {"sent-test/device": 120.0}
+        assert health.DEFAULT_RULES[2].probe(snap) == 120.0
+    finally:
+        rladder.LADDER.reset()
+        rladder.LADDER.configure(cooldown_s=5.0)
+
+
+def test_open_age_measures_the_episode_not_the_last_retrip():
+    """A stuck tier under traffic fails one half-open probe per cooldown;
+    each failed probe re-trips the breaker. The age must run from the
+    EPISODE start, or it could never exceed one cooldown and the
+    stuck-open rule could never fire (review regression)."""
+    rladder.LADDER.reset()
+    rladder.LADDER.configure(cooldown_s=5.0)
+    try:
+        t0 = time.monotonic()
+        for _ in range(3):
+            rladder.LADDER.record_failure("age-test", "device")
+        assert rladder.LADDER.breaker_state("age-test", "device") == "open"
+        # simulate 10 failed half-open probes across 10 cooldowns
+        for i in range(10):
+            with rladder.LADDER._lock:
+                b = rladder.LADDER._breaker("age-test", "device")
+                b.allow(t0 + (i + 1) * 5.0)
+                b.failure(t0 + (i + 1) * 5.0)
+        ages = rladder.LADDER.open_ages(now=t0 + 60.0)
+        assert ages["age-test/device"] >= 59.0, ages
+        # recovery clears the episode: a later trip starts a NEW episode
+        rladder.LADDER.record_success("age-test", "device")
+        for _ in range(3):
+            rladder.LADDER.record_failure("age-test", "device")
+        assert rladder.LADDER.open_ages(
+            now=time.monotonic() + 1.0
+        )["age-test/device"] < 10.0
+    finally:
+        rladder.LADDER.reset()
+        rladder.LADDER.configure(cooldown_s=5.0)
+
+
+def test_counter_delta_first_tick_reports_zero():
+    snap = health.snapshot(refresh_hbm=False)
+    assert snap.counter_delta(observe.OUTCOME_ANOMALY_TOTAL) == 0.0
+    # second snapshot with the first's sums: still zero without traffic
+    snap2 = health.snapshot(prev_sums=snap.sums, refresh_hbm=False)
+    assert snap2.counter_delta(observe.OUTCOME_ANOMALY_TOTAL) == 0.0
+
+
+def test_regret_fraction_uses_measured_denominator():
+    seq = decisions.record_decision(
+        "columnar.cutoff", "columnar-cpu", outcome=True, op="and",
+        na=20, nb=20, shape="run",
+        est_us={"columnar-cpu": 50.0, "per-container": 10.0},
+    )
+    outcomes.resolve(seq, "columnar.cutoff", 100e-6, engine="columnar-cpu")
+    snap = health.snapshot(refresh_hbm=False)
+    frac = health._regret_fraction(snap)
+    # regret = 100us measured - 10us predicted alternative = 90us of 100us
+    assert 0.8 < frac <= 1.0
+    summary = outcomes.summary()["columnar.cutoff"]
+    assert summary["measured_s"] == pytest.approx(100e-6, rel=0.01)
+
+
+# ---------------------------------------------------------------------------
+# seeded drift -> auto-refit e2e (the ROADMAP item 4 auto-trigger)
+# ---------------------------------------------------------------------------
+
+
+def _run_mix(n=40):
+    vals = []
+    for k in range(n):
+        base = k << 16
+        starts = np.arange(0, 1 << 16, 1 << 12)[:14]
+        v = np.unique(np.concatenate([np.arange(s, s + 900) for s in starts]))
+        vals.append((v + base).astype(np.uint32))
+    bm = RoaringBitmap(np.concatenate(vals))
+    bm.run_optimize()
+    return bm
+
+
+def test_seeded_drift_auto_refit_e2e(tmp_path, monkeypatch):
+    cal_path = str(tmp_path / "cal.json")
+    monkeypatch.setenv("RB_TPU_COLUMNAR_CAL", cal_path)
+    costmodel.MODEL.reset()
+    columnar.calibrate(include_device=False, persist=cal_path)
+    a, b = _run_mix(), _run_mix()
+    tier = str(columnar.route(
+        a.high_low_container, b.high_low_container, record=False
+    ))
+    group = costmodel.op_group("and")
+    true_cell = list(costmodel.MODEL.coeffs[group][tier]["run"])
+    with costmodel.MODEL._lock:
+        costmodel.MODEL.coeffs = copy.deepcopy(costmodel.MODEL.coeffs)
+        costmodel.MODEL.coeffs[group][tier]["run"] = [
+            round(true_cell[0] / 16, 3), round(true_cell[1] / 16, 4)
+        ]
+    try:
+        for _ in range(8):  # routed joins under the poisoned pricing
+            RoaringBitmap.and_(a, b)
+        cell = (group, tier, "run")
+        drifted = outcomes.LEDGER.drift()[cell]
+        assert drifted > health.DEFAULT_RULES[0].critical, (
+            f"seeded poisoning only drifted to {drifted}"
+        )
+        s = sentinel.Sentinel(clock=lambda: 0.0, refit_cooldown_s=60.0,
+                              bundle_cooldown_s=300.0)
+        # fire_after=2 for costmodel-drift: tick twice
+        r1 = s.tick(now=0.0)
+        assert not any(a_["kind"] == "refit" for a_ in r1["actuated"])
+        r2 = s.tick(now=1.0)
+        kinds = [a_["kind"] for a_ in r2["actuated"]]
+        assert "refit" in kinds, r2
+        # the columnar authority moved the poisoned cell back toward truth
+        refit_cell = costmodel.MODEL.coeffs[group][tier]["run"]
+        n_mid = min(a.get_container_count(), b.get_container_count())
+        measured = float(np.median([
+            sm["measured_us"] for sm in outcomes.samples()
+            if sm["engine"] == tier and sm["shape"] == "run"
+        ]))
+        def cost_of(c):
+            return c[0] + n_mid * c[1]
+        assert abs(cost_of(refit_cell) - measured) < abs(
+            cost_of([true_cell[0] / 16, true_cell[1] / 16]) - measured
+        ), "auto-refit did not move the poisoned cell toward measured truth"
+        assert costmodel.MODEL.provenance == "refit-from-traffic"
+        # provenance PERSISTED through RB_TPU_COLUMNAR_CAL: a fresh model
+        # reloading the file keeps the refit-from-traffic lineage
+        fresh = costmodel.CostModel()
+        assert fresh.load(cal_path)
+        assert fresh.provenance == "refit-from-traffic"
+        # the refit actuation log names the authority + provenance
+        refit_acts = [a_ for a_ in s.actuations() if a_["kind"] == "refit"]
+        assert refit_acts and refit_acts[0]["authorities"][
+            "columnar-cutoff"]["provenance"] == "refit-from-traffic"
+        # drift re-based: the rule clears and the process returns green
+        s.tick(now=2.0)
+        r4 = s.tick(now=3.0)
+        assert r4["rules"]["costmodel-drift"]["level"] == health.OK
+        assert outcomes.LEDGER.drift()[cell] == 1.0
+    finally:
+        costmodel.MODEL.reset()
+
+
+# ---------------------------------------------------------------------------
+# cost facade: four authorities, one protocol, one state lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_cost_facade_registers_all_four_authorities():
+    assert cost.names() == [
+        "columnar-cutoff", "device-breakeven", "pack-residency",
+        "planner-cardinality",
+    ]
+    state = cost.calibration_state()
+    assert state["schema"] == cost.STATE_SCHEMA
+    for name in cost.names():
+        sub = state["authorities"][name]
+        assert {"curves", "provenance", "drift"} <= set(sub)
+
+
+def test_cost_state_round_trip(tmp_path):
+    cost.reset_all()
+    costmodel.MODEL.reset()
+    try:
+        columnar.calibrate(include_device=False)
+        with CARD_MODEL._lock:
+            CARD_MODEL.corrections["and"] = 0.25
+            CARD_MODEL.provenance = "refit-from-traffic"
+        path = str(tmp_path / "cost_state.json")
+        assert cost.save_state(path) == path
+        coeffs_before = json.loads(json.dumps(costmodel.MODEL.coeffs))
+        cost.reset_all()
+        assert CARD_MODEL.corrections["and"] == 1.0
+        assert not costmodel.MODEL.calibrated
+        verdicts = cost.load_state(path)
+        assert verdicts["columnar-cutoff"] and verdicts["planner-cardinality"]
+        assert costmodel.MODEL.calibrated
+        assert costmodel.MODEL.coeffs == coeffs_before
+        assert CARD_MODEL.corrections["and"] == 0.25
+        assert CARD_MODEL.provenance == "refit-from-traffic"
+    finally:
+        cost.reset_all()
+        costmodel.MODEL.reset()
+
+
+def test_breakeven_authority_fits_curves_and_moves_gate():
+    from roaringbitmap_tpu.cost import breakeven
+    from roaringbitmap_tpu.parallel import aggregation
+
+    breakeven.MODEL.reset()
+    old_gate = aggregation.config.min_device_containers
+    try:
+        # synthetic joined samples: device has high overhead, low slope;
+        # cpu the reverse -> crossover where device starts winning
+        samples = []
+        for rows in (32, 64, 128, 256):
+            for _ in range(3):
+                samples.append({
+                    "site": "agg.dispatch", "engine": "device",
+                    "measured_s": (500.0 + rows * 1.0) / 1e6,
+                    "inputs": {"rows": rows},
+                })
+                samples.append({
+                    "site": "agg.dispatch", "engine": "per-container",
+                    "measured_s": (10.0 + rows * 5.0) / 1e6,
+                    "inputs": {"rows": rows},
+                })
+        rep = breakeven.MODEL.refit_from_outcomes(samples)
+        assert rep["provenance"] == "refit-from-traffic"
+        assert "gate_rows" in rep["moved"]
+        # crossover of 500 + n = 10 + 5n -> n = 122.5 -> gate 123
+        assert breakeven.MODEL.gate_rows == 123
+        assert aggregation.config.min_device_containers == 123
+        # state round-trips and reapplies the gate
+        d = breakeven.MODEL.to_dict()
+        breakeven.MODEL.reset()
+        aggregation.config.min_device_containers = old_gate
+        assert breakeven.MODEL.from_dict(d)
+        assert aggregation.config.min_device_containers == 123
+    finally:
+        breakeven.MODEL.reset()
+        aggregation.config.min_device_containers = old_gate
+
+
+def test_priced_eviction_scores_residency_pricing():
+    """Once the residency authority has learned a kind's re-pack cost,
+    the pack cache prices evictions of that kind (est_us on the evict
+    decision) and the evict-regret join scores the pricing with an
+    error ratio — the fourth authority's verdicts become auditable like
+    the other three (ISSUE 12)."""
+    from roaringbitmap_tpu.cost import residency
+    from roaringbitmap_tpu.parallel.store import PackCache
+
+    residency.MODEL.reset()
+    cache = PackCache(max_bytes=1000)
+    try:
+        residency.MODEL.refit_from_outcomes([
+            {"site": "pack_cache.evict", "engine": "rebuild",
+             "measured_s": 0.001, "inputs": {"kind": "bsi"}},
+        ])
+        cache.get_or_build(("bsi", "k1"), lambda: ("v1", 800))
+        cache.get_or_build(("bsi", "k2"), lambda: ("v2", 800))  # evicts k1
+        ev = [d for d in decisions.decisions()
+              if d["site"] == "pack_cache.evict"]
+        assert ev, "eviction recorded no decision"
+        est = ev[-1]["inputs"].get("est_us")
+        assert est and est["rebuild"] == pytest.approx(1000.0), ev[-1]
+        # the re-build of the remembered eviction joins with BOTH the
+        # measured regret and a scored prediction
+        def rebuild():
+            time.sleep(0.001)
+            return ("v1b", 800)
+
+        cache.get_or_build(("bsi", "k1"), rebuild)
+        joins = [e for e in outcomes.tail()
+                 if e["site"] == "pack_cache.evict"]
+        assert joins, "re-build did not join the evict decision"
+        assert joins[-1]["regret_s"] > 0
+        assert joins[-1]["error_ratio"] is not None
+    finally:
+        cache.close()
+        residency.MODEL.reset()
+
+
+def test_residency_authority_learns_repack_cost_from_evict_regret():
+    from roaringbitmap_tpu.cost import residency
+
+    residency.MODEL.reset()
+    try:
+        samples = [
+            {"site": "pack_cache.evict", "engine": "repack",
+             "measured_s": 0.04, "inputs": {"kind": "agg", "bytes": 1 << 20}},
+            {"site": "pack_cache.evict", "engine": "repack",
+             "measured_s": 0.06, "inputs": {"kind": "agg", "bytes": 1 << 20}},
+        ]
+        rep = residency.MODEL.refit_from_outcomes(samples)
+        assert rep["provenance"] == "refit-from-traffic"
+        curves = residency.MODEL.curves_view()
+        assert 0.04 <= curves["repack_s"]["agg"] <= 0.06
+        # the ship coefficient is the columnar calibration's — shared,
+        # not re-measured
+        assert curves["ship_us_per_row"] == costmodel.MODEL.ship_us_per_row
+    finally:
+        residency.MODEL.reset()
+
+
+def test_residency_refit_consumes_ledger_samples_at_most_once():
+    """The sentinel re-runs refit_all against the SAME retained ledger
+    every cooldown: ledger-sourced samples (seq-carrying) must fold into
+    the EWMA at most once — a second refit over an unchanged ledger is a
+    no-op (review regression: re-folding walked the EWMA and
+    double-counted samples)."""
+    from roaringbitmap_tpu.cost import residency
+
+    residency.MODEL.reset()
+    try:
+        for s in (0.04, 0.06):
+            seq = decisions.record_decision(
+                "pack_cache.evict", "lru", outcome=True, kind="agg",
+                bytes=1 << 20,
+            )
+            outcomes.resolve(seq, "pack_cache.evict", s, engine="repack",
+                             regret_s=s)
+        residency.MODEL.refit_from_outcomes()
+        first = residency.MODEL.curves_view()["repack_s"]["agg"]
+        n_first = residency.MODEL.samples["agg"]
+        rep2 = residency.MODEL.refit_from_outcomes()
+        assert rep2["moved"] == {}, "unchanged ledger moved the EWMA"
+        assert residency.MODEL.curves_view()["repack_s"]["agg"] == first
+        assert residency.MODEL.samples["agg"] == n_first
+        # NEW traffic still folds
+        seq = decisions.record_decision(
+            "pack_cache.evict", "lru", outcome=True, kind="agg", bytes=1,
+        )
+        outcomes.resolve(seq, "pack_cache.evict", 0.10, engine="repack",
+                         regret_s=0.10)
+        rep3 = residency.MODEL.refit_from_outcomes()
+        assert "agg" in rep3["moved"]
+    finally:
+        residency.MODEL.reset()
+
+
+def test_cost_state_rejects_foreign_backend_for_new_authorities():
+    """Breakeven curves and residency re-pack costs are per-host
+    measurements: a state stamped with another backend must be refused,
+    leaving this host's gate/config untouched (review regression)."""
+    from roaringbitmap_tpu.cost import breakeven, residency
+    from roaringbitmap_tpu.parallel import aggregation
+
+    old_gate = aggregation.config.min_device_containers
+    breakeven.MODEL.reset()
+    residency.MODEL.reset()
+    try:
+        assert not breakeven.MODEL.from_dict({
+            "schema": breakeven.SCHEMA, "backend": "tpu",
+            "curves": {"device": [1.0, 0.01]}, "gate_rows": 16,
+        })
+        assert aggregation.config.min_device_containers == old_gate
+        assert not residency.MODEL.from_dict({
+            "schema": residency.SCHEMA, "backend": "tpu",
+            "repack_s": {"agg": 0.5},
+        })
+        assert residency.MODEL.curves_view()["repack_s"] == {}
+        # backend-less (legacy/hand-written) states still load
+        assert breakeven.MODEL.from_dict({
+            "schema": breakeven.SCHEMA,
+            "curves": {"per-container": [1.0, 0.01]},
+        })
+    finally:
+        breakeven.MODEL.reset()
+        residency.MODEL.reset()
+        aggregation.config.min_device_containers = old_gate
+
+
+# ---------------------------------------------------------------------------
+# flight bundles + the unified artifact sink
+# ---------------------------------------------------------------------------
+
+
+def test_bundle_write_manifest_round_trip(tmp_path):
+    s = sentinel.Sentinel(clock=lambda: 0.0)
+    s.tick(now=0.0, snap=_stub_snap())
+    path = bundle.write_bundle(
+        "test-reason", trigger={"why": "test"}, dir=str(tmp_path),
+        health_dump=s.health_dump(),
+    )
+    assert os.path.dirname(path) == str(tmp_path)
+    m = bundle.read_manifest(path)  # verifies sizes + sha256
+    assert m["schema"] == bundle.SCHEMA
+    assert m["reason"] == "test-reason"
+    assert m["trigger"] == {"why": "test"}
+    assert set(m["files"]) == {
+        "timeline.jsonl", "decisions.json", "outcomes.json", "metrics.jsonl",
+        "calibration.json", "observatory.json", "health.json",
+    }
+    # sections parse and carry their schemas/content
+    with open(os.path.join(path, "calibration.json")) as f:
+        cal = json.load(f)
+    assert cal["schema"] == cost.STATE_SCHEMA
+    with open(os.path.join(path, "health.json")) as f:
+        hd = json.load(f)
+    assert hd["status_name"] == "green"
+    assert "rules" in hd
+    first = open(os.path.join(path, "timeline.jsonl")).readline()
+    assert json.loads(first)["schema"] == tl.DUMP_SCHEMA
+    # tamper detection
+    with open(os.path.join(path, "decisions.json"), "a") as f:
+        f.write("tampered\n")
+    with pytest.raises(ValueError):
+        bundle.read_manifest(path)
+    # no temp directory left behind
+    assert not [d for d in os.listdir(tmp_path) if d.startswith(".tmp-")]
+
+
+def test_artifact_sink_routes_bare_names_not_cwd(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    old = artifacts.artifact_dir()
+    sink = tmp_path / "sink"
+    artifacts.configure(dir=str(sink))
+    try:
+        assert artifacts.resolve("foo.jsonl") == str(sink / "foo.jsonl")
+        # explicit paths (anything with a directory component) win
+        assert artifacts.resolve("/abs/x.jsonl") == "/abs/x.jsonl"
+        assert artifacts.resolve("rel/x.jsonl") == "rel/x.jsonl"
+        # a timeline anomaly dump with the DEFAULT bare name lands in the
+        # sink, and nothing lands loose in the CWD
+        prev_mode = tl.mode_name()
+        tl.configure(mode="on", budget_ms=0.0001,
+                     dump_path="rb_tpu_timeline_anomaly.jsonl")
+        try:
+            with tl.tspan("slow-span", "test"):
+                time.sleep(0.002)
+        finally:
+            tl.configure(mode=prev_mode, budget_ms=0)
+        deadline = time.monotonic() + 5.0
+        target = sink / "rb_tpu_timeline_anomaly.jsonl"
+        while not target.is_file() and time.monotonic() < deadline:
+            time.sleep(0.01)  # the dump writer is a daemon thread
+        assert target.is_file(), "anomaly dump did not land in the sink"
+        assert not [
+            f for f in os.listdir(tmp_path) if f.endswith(".jsonl")
+        ], "anomaly dump leaked into the CWD"
+    finally:
+        artifacts.configure(dir=old)
+        tl.configure(dump_path="rb_tpu_timeline_anomaly.jsonl")
+
+
+def test_sentinel_red_tick_writes_bundle_into_sink(tmp_path):
+    old = artifacts.artifact_dir()
+    artifacts.configure(dir=str(tmp_path / "sink"))
+    try:
+        dial = _Dial(500.0)
+        rule = health.Rule("red-rule", "", dial, warn=10.0, critical=100.0,
+                           fire_after=1, clear_after=1)
+        s = _mk(rule)
+        r = s.tick(now=0.0, snap=_stub_snap())
+        assert r["status_name"] == "red"
+        bundles = [a for a in r["actuated"] if a["kind"] == "bundle"]
+        assert len(bundles) == 1 and "path" in bundles[0]
+        assert bundles[0]["path"].startswith(str(tmp_path / "sink"))
+        m = bundle.read_manifest(bundles[0]["path"])
+        assert m["trigger"]["rules"]["red-rule"]["level"] == "critical"
+        with open(os.path.join(bundles[0]["path"], "health.json")) as f:
+            hd = json.load(f)
+        assert hd["rules"]["red-rule"]["level"] == health.CRITICAL
+        assert hd["rules"]["red-rule"]["history"], "rule history missing"
+    finally:
+        artifacts.configure(dir=old)
+
+
+# ---------------------------------------------------------------------------
+# read APIs: insights.health(), sidecar health block, observatory
+# ---------------------------------------------------------------------------
+
+
+def test_insights_health_and_sidecar_block():
+    dial = _Dial(50.0)
+    rule = health.Rule("side-rule", "", dial, warn=10.0, critical=100.0,
+                       fire_after=1, clear_after=1)
+    s = sentinel.Sentinel(rules=(rule,), clock=lambda: 0.0)
+    s.tick(now=0.0, snap=_stub_snap())
+    # the sidecar block is a pure registry derivation
+    from roaringbitmap_tpu.observe import export as obs_export
+
+    side = obs_export.sidecar_snapshot()
+    h = side["health"]
+    assert h["status"] == health.WARN and h["status_name"] == "yellow"
+    assert h["rules"].get("side-rule") == health.WARN
+    # the live insights view reads the PROCESS sentinel
+    live = insights.health()
+    assert {"status", "status_name", "rules", "actuations"} <= set(live)
+    obs = insights.observatory()
+    assert "health" in obs
+
+
+# ---------------------------------------------------------------------------
+# 16-thread hammer: sentinel state is a leaf lock
+# ---------------------------------------------------------------------------
+
+
+def test_sentinel_hammer_16_threads_lockwitness_leaf():
+    w = LockWitness()
+    s = sentinel.Sentinel(
+        rules=health.DEFAULT_RULES, refit_cooldown_s=1e9, bundle_cooldown_s=1e9
+    )
+    sent_lock = s._lock
+    s._lock = w.wrap("sentinel.state", sent_lock)
+    reg_lock = observe.REGISTRY._lock
+    observe.REGISTRY._lock = w.wrap("registry", reg_lock)
+    led_lock = outcomes.LEDGER._lock
+    outcomes.LEDGER._lock = w.wrap("outcomes.ledger", led_lock)
+    log_lock = decisions.LOG._lock
+    decisions.LOG._lock = w.wrap("decisions.log", log_lock)
+    rec_lock = tl.RECORDER._lock
+    tl.RECORDER._lock = w.wrap("recorder", rec_lock)
+    prev_mode = tl.mode_name()
+    tl.configure(mode="on")
+    stop = time.monotonic() + 1.0
+    errors = []
+
+    def ticker():
+        while time.monotonic() < stop:
+            try:
+                s.tick(snap=health.snapshot(refresh_hbm=False))
+                s.rule_states()
+                s.health_dump()
+            except Exception as e:  # rb-ok: exception-hygiene -- hammer collects escapes to assert none happened
+                errors.append(e)
+
+    def traffic(i):
+        k = 0
+        while time.monotonic() < stop:
+            k += 1
+            try:
+                seq = decisions.record_decision(
+                    "columnar.cutoff", "columnar-cpu", outcome=True,
+                    na=20 + i, nb=20, shape="run", op="and",
+                    est_us={"columnar-cpu": 50.0, "per-container": 80.0},
+                )
+                outcomes.resolve(seq, "columnar.cutoff", 60e-6,
+                                 engine="columnar-cpu")
+            except Exception as e:  # rb-ok: exception-hygiene -- hammer collects escapes to assert none happened
+                errors.append(e)
+
+    threads = [threading.Thread(target=ticker) for _ in range(4)]
+    threads += [threading.Thread(target=traffic, args=(i,)) for i in range(12)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        tl.configure(mode=prev_mode)
+        s._lock = sent_lock
+        observe.REGISTRY._lock = reg_lock
+        outcomes.LEDGER._lock = led_lock
+        decisions.LOG._lock = log_lock
+        tl.RECORDER._lock = rec_lock
+    assert not errors, errors[:3]
+    w.assert_consistent()
+    assert w.acquisitions.get("sentinel.state", 0) > 0
+    # leaf property: NOTHING is acquired while holding the sentinel lock
+    assert not [e for e in w.edges if e[0] == "sentinel.state"], sorted(w.edges)
+
+
+# ---------------------------------------------------------------------------
+# off-mode zero-allocation pin (the inline pacing hook)
+# ---------------------------------------------------------------------------
+
+
+def test_inline_hook_off_mode_allocates_nothing(monkeypatch):
+    """RB_TPU_SENTINEL unset => maybe_tick() is one module-bool check:
+    no snapshot built, no tick run, nothing allocated (the timeline
+    off-mode discipline applied to the sentinel)."""
+    assert not sentinel.running()  # conftest never sets RB_TPU_SENTINEL
+
+    def boom(*a, **k):
+        raise AssertionError("sentinel work ran while inline mode is off")
+
+    monkeypatch.setattr(sentinel.SENTINEL, "tick", boom)
+    monkeypatch.setattr(health, "snapshot", boom)
+    monkeypatch.setattr(health, "Snapshot", boom)
+    for _ in range(100):
+        assert sentinel.maybe_tick() is False
+    # armed inline, the hook ticks at most once per interval
+    ticks = []
+    monkeypatch.setattr(sentinel.SENTINEL, "tick", lambda: ticks.append(1))
+    sentinel.configure(inline=True, inline_interval_s=3600.0)
+    try:
+        for _ in range(50):
+            sentinel.maybe_tick()
+        assert len(ticks) == 1
+    finally:
+        sentinel.configure(inline=False)
+
+
+def test_inline_hook_rides_the_aggregation_dispatch(monkeypatch):
+    from roaringbitmap_tpu.parallel import aggregation
+
+    ticks = []
+    monkeypatch.setattr(sentinel.SENTINEL, "tick", lambda: ticks.append(1))
+    sentinel.configure(inline=True, inline_interval_s=0.0)
+    try:
+        bms = [RoaringBitmap(np.arange(i, 5000 + i, 7)) for i in range(4)]
+        aggregation.FastAggregation.or_(*bms, mode="cpu")
+        assert ticks, "dispatch path never consulted the inline hook"
+    finally:
+        sentinel.configure(inline=False)
+
+
+def test_background_thread_start_stop():
+    sentinel.start(interval_s=0.01)
+    try:
+        assert sentinel.running()
+        deadline = time.monotonic() + 5.0
+        while sentinel.SENTINEL._tick_no == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert sentinel.SENTINEL._tick_no > 0, "thread never ticked"
+    finally:
+        sentinel.stop()
+    assert not sentinel.running()
