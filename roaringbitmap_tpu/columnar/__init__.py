@@ -41,10 +41,12 @@ from .engine import (
     enabled_for,
     enabled_for_fold,
     fold,
+    fold_multi,
     intersects_pair,
     or_fold_words,
     outcome,
     pairwise,
+    pairwise_multi,
     route,
 )
 from .keyplan import KeyPlan, key_plan
@@ -60,7 +62,9 @@ __all__ = [
     "and_cardinality_pair",
     "intersects_pair",
     "fold",
+    "fold_multi",
     "or_fold_words",
+    "pairwise_multi",
     "key_plan",
     "KeyPlan",
     "classify",

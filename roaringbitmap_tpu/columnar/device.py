@@ -162,6 +162,54 @@ def _fill_gather_device(
         _engine._build_gather_results(op, vals, offs, mask, idx, results)
 
 
+def matched_results_device_multi(op: str, jobs) -> List[Optional[Container]]:
+    """Cross-query fused device tier (ISSUE 13): many pairs' matched
+    containers against ONE combined pair of row blocks. ``jobs`` is
+    ``[(x1, x2, keyplan)]``; every distinct operand's resident flat rows
+    concatenate once (``pallas_kernels.concat_rows`` — one device concat,
+    deduped by block identity so a hot shared operand ships no extra
+    bytes), the per-pair row indices shift by their block's offset, and
+    the combined inputs run through :func:`matched_results_device`
+    verbatim — the dense bucket becomes one ``pair_rows_reduce`` launch
+    and the probe bucket one word-test gather for the WHOLE window.
+    Returns the flat result list in job order (each job's slice is its
+    matched-pair count), bit-exact with per-pair execution by
+    construction: same classification, same kernels, same assembly."""
+    from ..ops import pallas_kernels as pk
+
+    def _combine(side):
+        blocks: List = []
+        offsets: dict = {}
+        idx_parts: List[np.ndarray] = []
+        for x1, x2, plan in jobs:
+            bm = x1 if side == 0 else x2
+            idx = plan.ia if side == 0 else plan.ib
+            rows = rows_for(bm)
+            off = offsets.get(id(rows))
+            if off is None:
+                off = sum(int(b.shape[0]) for b in blocks)
+                offsets[id(rows)] = off
+                blocks.append(rows)
+            idx_parts.append(np.asarray(idx, dtype=np.int64) + off)
+        combined = pk.concat_rows(blocks)
+        return combined, np.concatenate(idx_parts) if idx_parts else np.empty(
+            0, dtype=np.int64
+        )
+
+    rows_a_all, ia_all = _combine(0)
+    rows_b_all, ib_all = _combine(1)
+    acs_all: List[Container] = []
+    bcs_all: List[Container] = []
+    for x1, x2, plan in jobs:
+        acont = x1.high_low_container.containers
+        bcont = x2.high_low_container.containers
+        acs_all.extend(acont[i] for i in plan.ia.tolist())
+        bcs_all.extend(bcont[i] for i in plan.ib.tolist())
+    return matched_results_device(
+        op, acs_all, bcs_all, ia_all, ib_all, rows_a_all, rows_b_all
+    )
+
+
 def matched_results_device(
     op: str,
     acs: Sequence[Container],

@@ -776,6 +776,17 @@ def _pairwise_tier(
         )
     else:
         results = _matched_results(op, acs, bcs)
+    return _assemble_pairwise(op, a, b, plan, results, reuse_left)
+
+
+def _assemble_pairwise(
+    op: str, a, b, plan, results, reuse_left: bool
+) -> RoaringBitmap:
+    """Shared result assembly for one pair: matched results (any tier's)
+    merge-sorted with the pass-through containers by the key plan. One
+    copy serves the solo tiers AND the fused cross-query batch
+    (ISSUE 13), so their container layouts can never drift."""
+    acont, bcont = a.containers, b.containers
     out = RoaringBitmap()
     okeys, ocont = out.high_low_container.keys, out.high_low_container.containers
     if op == "and":
@@ -805,6 +816,52 @@ def _pairwise_tier(
         okeys.append(keys_l[idx])
         ocont.append(c)
     return out
+
+
+def pairwise_multi(
+    op: str, pairs: Sequence[tuple], tier: str = "cpu"
+) -> List[RoaringBitmap]:
+    """Cross-query fused pairwise tier (ISSUE 13): execute MANY
+    independent ``a OP b`` pairs through ONE per-class batch pass. The
+    per-pair key plans stay host-side (microseconds), but every pair's
+    matched containers concatenate into one flat batch, so each occupied
+    class pays ONE kernel call for the whole window — on the device tier
+    the dense bucket is one fused gather+op+popcount launch over the
+    concatenated resident row blocks (``matched_results_device_multi``)
+    and the probe bucket one word-test gather. Results are bit-exact
+    with per-pair execution by construction: the class kernels operate
+    per matched pair, and the assembly is the shared
+    :func:`_assemble_pairwise`."""
+    plans = []
+    acs_all: List[Container] = []
+    bcs_all: List[Container] = []
+    spans: List[tuple] = []
+    jobs = []
+    for x1, x2 in pairs:
+        a, b = x1.high_low_container, x2.high_low_container
+        plan = key_plan(a.keys, b.keys, op)
+        acont, bcont = a.containers, b.containers
+        acs = [acont[i] for i in plan.ia.tolist()]
+        bcs = [bcont[i] for i in plan.ib.tolist()]
+        plans.append((a, b, plan))
+        spans.append((len(acs_all), len(acs)))
+        acs_all.extend(acs)
+        bcs_all.extend(bcs)
+        jobs.append((x1, x2, plan))
+    if tier == "device":
+        from . import device as _device_tier
+
+        results_all = _device_tier.matched_results_device_multi(op, jobs)
+    else:
+        results_all = _matched_results(op, acs_all, bcs_all)
+    outs = []
+    for (a, b, plan), (start, count) in zip(plans, spans):
+        outs.append(
+            _assemble_pairwise(
+                op, a, b, plan, results_all[start : start + count], False
+            )
+        )
+    return outs
 
 
 def and_cardinality_pair(x1: RoaringBitmap, x2: RoaringBitmap) -> int:
@@ -986,6 +1043,73 @@ def fold(groups: Dict[int, List[Container]], op: str) -> RoaringBitmap:
         if c is not None and c.cardinality:
             hlc.append(k, c)
     return out
+
+
+def fold_multi(
+    groups_list: Sequence[Dict[int, List[Container]]], op: str
+) -> List[RoaringBitmap]:
+    """N-way or/xor folds for SEVERAL independent working sets through
+    ONE multi-band pass (ISSUE 13): every set's multi-container key
+    groups stack into a single matrix, one ``scatter_containers`` call
+    fills them all, one popcount pass selects every result format —
+    merged-tier execution for the fused executor's CPU fold steps.
+    Value-identical to ``[fold(g, op) for g in groups_list]`` by
+    construction (same scatter op per row, same format rule); singles
+    pass through as type-preserving clones exactly like :func:`fold`."""
+    if op not in ("or", "xor"):
+        raise ValueError(f"fold_multi merges or/xor folds, got {op!r}")
+    multi_keys: List[tuple] = []  # (set index, key)
+    multi_cs: List[List[Container]] = []
+    per_set_singles: List[Dict[int, Container]] = []
+    per_set_keys: List[List[int]] = []
+    for si, groups in enumerate(groups_list):
+        keys = sorted(groups)
+        per_set_keys.append(keys)
+        singles: Dict[int, Container] = {}
+        for k in keys:
+            cs = groups[k]
+            if len(cs) == 1:
+                singles[k] = cs[0]
+            else:
+                multi_keys.append((si, k))
+                multi_cs.append(cs)
+        per_set_singles.append(singles)
+    results: Dict[tuple, Optional[Container]] = {}
+    if multi_keys:
+        n_rows = sum(len(cs) for cs in multi_cs)
+        _COLUMNAR_TOTAL.inc(n_rows, labels=(_FOLD_LABELS[op], "rows"))
+        with _kernel_stage(op, "fold", n_rows):
+            mat = np.zeros(
+                (len(multi_keys), bits.WORDS_PER_CONTAINER), dtype=np.uint64
+            )
+            row_ids = np.repeat(
+                np.arange(len(multi_keys), dtype=np.int64),
+                np.fromiter(
+                    (len(cs) for cs in multi_cs), np.int64, len(multi_cs)
+                ),
+            )
+            flat = [c for cs in multi_cs for c in cs]
+            scatter_containers(mat, row_ids, flat, op=op)
+            cards = kernels.popcount_rows(mat).tolist()
+            for j, sk in enumerate(multi_keys):
+                card = cards[j]
+                if card == 0:
+                    results[sk] = None
+                elif card <= ARRAY_MAX_SIZE:
+                    results[sk] = _wrap_u16(bits.values_from_words(mat[j]))
+                else:
+                    results[sk] = BitmapContainer(mat[j].copy(), card)
+    outs: List[RoaringBitmap] = []
+    for si, keys in enumerate(per_set_keys):
+        out = RoaringBitmap()
+        hlc = out.high_low_container
+        singles = per_set_singles[si]
+        for k in keys:
+            c = singles[k].clone() if k in singles else results[(si, k)]
+            if c is not None and c.cardinality:
+                hlc.append(k, c)
+        outs.append(out)
+    return outs
 
 
 def or_fold_words(groups: Dict[int, List[Container]]) -> Dict[int, np.ndarray]:

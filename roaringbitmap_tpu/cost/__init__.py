@@ -1,8 +1,9 @@
 """Unified cost substrate (ISSUE 12; ROADMAP item 4's closing half).
 
-One facade over the four pricing authorities — the columnar cutoff
+One facade over the five pricing authorities — the columnar cutoff
 model, the planner's cardinality corrections, the device-breakeven
-dispatch gate, and pack/ship residency pricing — behind a shared
+dispatch gate, pack/ship residency pricing, and (ISSUE 13) the fusion
+executor's batch-vs-solo window curves — behind a shared
 curves / provenance / drift / refit / state protocol, with ONE
 persistence lifecycle (``RB_TPU_COST_STATE``). The health sentinel
 (``observe.sentinel``) actuates ``refit_all()`` when a drift gauge
@@ -24,7 +25,7 @@ from .facade import (
     reset_all,
     save_state,
 )
-from . import breakeven, residency
+from . import breakeven, fusion, residency
 
 __all__ = [
     "AUTHORITIES",
@@ -34,6 +35,7 @@ __all__ = [
     "breakeven",
     "calibration_state",
     "drift_summary",
+    "fusion",
     "load_state",
     "names",
     "provenances",

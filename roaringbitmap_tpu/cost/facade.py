@@ -2,7 +2,7 @@
 curves / drift / refit / state protocol (ISSUE 12 tentpole, leg 2 —
 closing ROADMAP item 4).
 
-The system grew four pricing authorities, each calibrated differently:
+The system grew five pricing authorities, each calibrated differently:
 
 ========================= ===============================================
 authority                 wraps
@@ -17,6 +17,9 @@ authority                 wraps
 ``pack-residency``        ``cost.residency.MODEL`` — ship µs/row (shared
                           with the columnar calibration) + per-kind
                           measured re-pack cost
+``fusion-batch``          ``cost.fusion.MODEL`` — the micro-batching
+                          executor's batch-vs-solo window curves
+                          (ISSUE 13)
 ========================= ===============================================
 
 Each adapter answers the same five questions — ``curves()`` (what do you
@@ -220,6 +223,41 @@ class PackResidencyAuthority(Authority):
         self._model().reset()
 
 
+class FusionBatchAuthority(Authority):
+    """The micro-batching executor's batch-vs-solo curves (ISSUE 13):
+    ``fusion.batch`` verdicts price fused-window against per-query
+    dispatch; the ledger joins score them and the refit learns this
+    host's dispatch/merge constants from live windows."""
+
+    name = "fusion-batch"
+
+    def _model(self):
+        from . import fusion as _fusion
+
+        return _fusion.MODEL
+
+    def curves(self) -> dict:
+        return self._model().curves_view()
+
+    def provenance(self) -> str:
+        return self._model().provenance
+
+    def drift(self) -> Dict[str, float]:
+        return self._model().drift()
+
+    def refit_from_outcomes(self, samples: Optional[List[dict]] = None) -> dict:
+        return self._model().refit_from_outcomes(samples=samples)
+
+    def state(self) -> dict:
+        return self._model().to_dict()
+
+    def load_state(self, d: dict) -> bool:
+        return self._model().from_dict(d)
+
+    def reset(self) -> None:
+        self._model().reset()
+
+
 AUTHORITIES: Dict[str, Authority] = {
     a.name: a
     for a in (
@@ -227,6 +265,7 @@ AUTHORITIES: Dict[str, Authority] = {
         PlannerCardinalityAuthority(),
         DeviceBreakevenAuthority(),
         PackResidencyAuthority(),
+        FusionBatchAuthority(),
     )
 }
 

@@ -468,6 +468,78 @@ def verify_fault_schedule_invariance(
             rfaults.clear()
 
 
+def verify_fusion_invariance(
+    name: str,
+    iterations: Optional[int] = None,
+    seed: Optional[int] = None,
+    faults_prob: float = 0.25,
+) -> None:
+    """Fuzz family 27 (ISSUE 13): random OVERLAPPING expression sets
+    executed through the fusion window must be bit-exact with the serial
+    per-query oracle. Overlap is constructed two ways each iteration —
+    a shared random subexpression grafted under several queries' roots
+    (the hash-consed DAG makes it ONE node across plans, exercising the
+    window dedup), and duplicate whole queries (exercising the in-flight
+    join). Every other iteration arms a random seeded fault schedule
+    drawn over the registered sites INCLUDING the new ``query.fusion``
+    site — a fault there must degrade the whole window to per-query
+    serial execution bit-exactly (the ladder's batch rung), and no
+    exception may escape. The oracle is computed mid-schedule inside
+    ``faults.suspended()`` with the serial executor (itself pinned
+    against naive evaluation by family ``query-planner-vs-naive``)."""
+    from contextlib import ExitStack
+
+    from .query import Q, ResultCache, execute, fusion
+    from .robust import faults as rfaults
+    from .robust import ladder as rladder
+
+    rng = np.random.default_rng(seed)
+    for it in range(iterations or default_iterations()):
+        bms = [random_bitmap(rng) for _ in range(int(rng.integers(3, 6)))]
+        shared = random_expression(rng, bms, max_depth=2)
+        queries = []
+        for _ in range(int(rng.integers(2, 6))):
+            own = random_expression(rng, bms, max_depth=2)
+            kind = int(rng.integers(0, 3))
+            if kind == 0:
+                queries.append(Q.or_(shared, own))
+            elif kind == 1:
+                queries.append(Q.andnot(own, shared))
+            else:
+                queries.append(own)
+        if len(queries) > 2 and rng.random() < 0.5:
+            queries.append(queries[int(rng.integers(0, len(queries)))])
+        sched = random_fault_schedule(rng) if it % 2 else []
+        rfaults.clear()
+        rladder.LADDER.reset()
+        try:
+            with ExitStack() as stack:
+                for site, exc, kw in sched:
+                    stack.enter_context(rfaults.inject(site, exc, **kw))
+                with rfaults.suspended():
+                    want = [execute(q, cache=None) for q in queries]
+                got = fusion.execute_fused(
+                    queries, cache=ResultCache(max_entries=64)
+                )
+                for gi, (g, w) in enumerate(zip(got, want)):
+                    if g != w:
+                        raise InvarianceFailure(
+                            name, bms,
+                            detail=f"fused query {gi} diverged from the "
+                            f"serial oracle (schedule={sched})",
+                        )
+        except InvarianceFailure:
+            raise
+        except Exception as e:  # rb-ok: exception-hygiene -- the family's whole point: ANY escape past the fusion ladder is a failure, re-wrapped with the repro schedule
+            raise InvarianceFailure(
+                name, bms,
+                detail=f"exception escaped the fusion ladder: {e!r} "
+                f"(schedule={sched})",
+            ) from e
+        finally:
+            rfaults.clear()
+
+
 def random_expression(rng, leaves: List[RoaringBitmap], max_depth: int = 4):
     """Random query DAG over the given leaf bitmaps: every node kind
     (and/or/xor/n-ary andnot/not-over-explicit-universe/threshold), biased
@@ -827,6 +899,17 @@ def run_campaign(iterations: Optional[int] = None, verbose: bool = True) -> dict
         "fault-schedule-vs-oracle",
         lambda: verify_fault_schedule_invariance(
             "fault-schedule-vs-oracle", iterations=max(1, n // 8), seed=55
+        ),
+        actual=max(1, n // 8),
+    )
+    # ISSUE 13: random overlapping expression sets through the fusion
+    # window vs the serial per-query oracle, incl. seeded fault schedules
+    # over the query.fusion site (derated: each iteration executes a
+    # whole multi-query window plus its per-query oracle)
+    _run(
+        "fused-concurrent-vs-serial",
+        lambda: verify_fusion_invariance(
+            "fused-concurrent-vs-serial", iterations=max(1, n // 8), seed=57
         ),
         actual=max(1, n // 8),
     )

@@ -242,8 +242,15 @@ def regret_summary() -> dict:
             "columnar": columnar.MODEL.provenance if columnar.MODEL.calibrated
             else "default-gate",
             "planner_cardinality": CARD_MODEL.provenance,
+            "fusion_batch": _fusion_model_provenance(),
         },
     }
+
+
+def _fusion_model_provenance() -> str:
+    from .cost import fusion as _fusion_cost
+
+    return _fusion_cost.MODEL.provenance
 
 
 def health() -> dict:
@@ -263,6 +270,23 @@ def health() -> dict:
         "actuations": s.actuations(8),
         "sentinel_running": observe.sentinel.running(),
     }
+
+
+def fusion_counters() -> dict:
+    """Cross-query fusion rollup (ISSUE 13): window volume by outcome,
+    query volume, step fates (executed / merged / deduped), the derived
+    window occupancy and shared-subexpression hit ratio, the in-flight
+    dedup table's live stats, and the current queue depth — the rb_top
+    fusion panel's data, derived from the registry plus the live
+    in-flight table (batch-regret rows ride the regret panel under the
+    ``fusion.batch`` site)."""
+    from . import observe
+    from .observe import export as _export
+    from .query import inflight as _inflight
+
+    block = _export._fusion_block(observe.REGISTRY.snapshot())
+    block["inflight_live"] = _inflight.TABLE.stats()
+    return block
 
 
 def cost_authorities() -> dict:

@@ -33,6 +33,11 @@ from .registry import (
     DEFAULT_TIME_BUCKETS,
     DEGRADE_TOTAL,
     FAULT_INJECTED_TOTAL,
+    FUSION_BATCH_SECONDS,
+    FUSION_BATCH_TOTAL,
+    FUSION_QUERIES_TOTAL,
+    FUSION_QUEUED_COUNT,
+    FUSION_STEPS_TOTAL,
     HBM_ACCOUNTING_DRIFT_BYTES,
     HOST_OP_SECONDS,
     LOCK_WAIT_SECONDS,
@@ -50,6 +55,7 @@ from .registry import (
     PACK_CACHE_MISSES_TOTAL,
     PACK_CACHE_RESIDENT_BYTES,
     QUERY_CACHE_TOTAL,
+    QUERY_INFLIGHT_TOTAL,
     QUERY_LATENCY_SECONDS,
     QUERY_PLAN_TOTAL,
     REGISTRY,
@@ -201,6 +207,12 @@ __all__ = [
     "HEALTH_STATUS",
     "HEALTH_RULE_STATE",
     "HEALTH_ACTUATION_TOTAL",
+    "FUSION_BATCH_TOTAL",
+    "FUSION_QUERIES_TOTAL",
+    "FUSION_STEPS_TOTAL",
+    "FUSION_BATCH_SECONDS",
+    "FUSION_QUEUED_COUNT",
+    "QUERY_INFLIGHT_TOTAL",
     "context",
     "decisions",
     "outcomes",

@@ -222,6 +222,40 @@ def _regret_block(snap: dict, registry: Registry) -> dict:
     }
 
 
+def _fusion_block(snap: dict) -> dict:
+    """The cross-query fusion sidecar block (ISSUE 13), derived purely
+    from the registry like the regret/health blocks: window volume by
+    outcome, queries through windows, step fates, the derived window
+    occupancy (queries per drained window) and shared-subexpression hit
+    ratio (deduped / planned), in-flight dedup joins, and the live queue
+    depth — the rb_top fusion panel's ``--from`` data."""
+    batches = _counter_map(snap, _registry.FUSION_BATCH_TOTAL)
+    steps = _counter_map(snap, _registry.FUSION_STEPS_TOTAL)
+    queries = 0.0
+    m = snap.get(_registry.FUSION_QUERIES_TOTAL)
+    if m is not None:
+        queries = float(sum(s.get("value", 0) for s in m["samples"]))
+    depth = None
+    g = snap.get(_registry.FUSION_QUEUED_COUNT)
+    if g is not None:
+        for s in g["samples"]:
+            if not s["labels"]:
+                depth = s["value"]
+    n_batches = float(sum(batches.values()))
+    executed = float(steps.get("executed", 0))
+    deduped = float(steps.get("deduped", 0))
+    planned = executed + deduped
+    return {
+        "batches": batches,
+        "queries": queries,
+        "steps": steps,
+        "occupancy": round(queries / n_batches, 3) if n_batches else None,
+        "dedup_hit_ratio": round(deduped / planned, 4) if planned else None,
+        "inflight": _counter_map(snap, _registry.QUERY_INFLIGHT_TOTAL),
+        "queue_depth": depth,
+    }
+
+
 def _health_block(snap: dict) -> dict:
     """The health sentinel's sidecar block (ISSUE 12), derived PURELY
     from the registry gauges (like the regret block) so a ``--from``
@@ -277,6 +311,9 @@ def sidecar_snapshot(registry: Optional[Registry] = None) -> dict:
         # health sentinel (ISSUE 12): the status/rule-state enum gauges
         # and actuation counters, registry-derived like everything here
         "health": _health_block(snap),
+        # cross-query fusion (ISSUE 13): window/step volume, occupancy,
+        # shared-subexpression hit ratio, in-flight dedup joins
+        "fusion": _fusion_block(snap),
         "registry": snap,
     }
 

@@ -42,6 +42,7 @@ breaker-stuck-open     max seconds any breaker has been open    30    300
 outcome-anomaly-burst  out-of-band joins since last tick        1     16
 hbm-accounting-drift   max |accounting drift| bytes             1     2^20
 compile-storm          jit traces since last tick               8     32
+fusion-queue-stall     fusion queue depth with no drained batch 1     64
 ====================== ======================================== ===== =====
 
 Actuations (the sentinel's closed-loop half — see ``observe.sentinel``):
@@ -311,6 +312,19 @@ def _max_open_age(s: Snapshot) -> float:
     return max(s.breaker_open_ages.values(), default=0.0)
 
 
+def _fusion_queue_stall(s: Snapshot) -> float:
+    """Queries parked in the fusion window queue while NO batch drained
+    since the last tick (ISSUE 13 — the ~5-line serving-shaped rule the
+    ISSUE-12 note promised): badness is the queue depth gauge, judged
+    against the batch counter's per-tick movement; the batch-latency
+    histogram (``rb_tpu_fusion_batch_seconds``) carries the drill-down.
+    A draining queue — however deep — is healthy backpressure."""
+    depth = s.gauge_max_abs(_registry.FUSION_QUEUED_COUNT)
+    if depth <= 0:
+        return 0.0
+    return depth if s.counter_delta(_registry.FUSION_BATCH_TOTAL) == 0 else 0.0
+
+
 DEFAULT_RULES: Tuple[Rule, ...] = (
     Rule(
         "costmodel-drift",
@@ -357,6 +371,15 @@ DEFAULT_RULES: Tuple[Rule, ...] = (
         "state must not retrace",
         lambda s: s.counter_delta(_registry.COMPILE_TOTAL),
         warn=8.0, critical=32.0, fire_after=1, clear_after=2,
+        actuation="alert",
+    ),
+    Rule(
+        "fusion-queue-stall",
+        "queries waiting in the fusion window queue while no batch "
+        "drained since the last tick (stalled drain loop, not healthy "
+        "backpressure)",
+        _fusion_queue_stall,
+        warn=1.0, critical=64.0, fire_after=2, clear_after=2,
         actuation="alert",
     ),
 )

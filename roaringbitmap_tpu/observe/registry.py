@@ -100,6 +100,17 @@ HEALTH_RULE_STATE = "rb_tpu_health_rule_state"
 # sentinel actuations (auto-refit, alert instants, flight bundles) by
 # rule and action kind
 HEALTH_ACTUATION_TOTAL = "rb_tpu_health_actuation_total"
+# cross-query fusion (ISSUE 13): micro-batch window volume by outcome
+# (fused | per-query | degraded), query volume through windows, step fate
+# (executed | merged | deduped), batch wall + per-query queue wait
+# latency, the live window queue depth, and the in-flight dedup table's
+# event volume (lead | join | stale | fail)
+FUSION_BATCH_TOTAL = "rb_tpu_fusion_batch_total"
+FUSION_QUERIES_TOTAL = "rb_tpu_fusion_queries_total"
+FUSION_STEPS_TOTAL = "rb_tpu_fusion_steps_total"
+FUSION_BATCH_SECONDS = "rb_tpu_fusion_batch_seconds"
+FUSION_QUEUED_COUNT = "rb_tpu_fusion_queued_count"
+QUERY_INFLIGHT_TOTAL = "rb_tpu_query_inflight_total"
 
 # upper bucket bounds (seconds) for wall-time histograms: host phases span
 # ~100 µs packing steps to multi-second CPU folds; +Inf is implicit

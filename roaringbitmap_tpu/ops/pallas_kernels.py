@@ -1011,6 +1011,31 @@ def pair_rows_reduce(rows_a, ia, rows_b, ib, op: str):
     )
 
 
+def concat_rows(blocks):
+    """Concatenate flat device row blocks ``[n_i, W]`` into one combined
+    block padded to a pow2 row count — the cross-query fusion tier's
+    combined operand (ISSUE 13): a window's per-query resident blocks
+    become ONE gather source so ``pair_rows_reduce`` serves every
+    query's pairs in a single launch. One ``jnp.concatenate`` dispatch;
+    pad rows are zero (they are only ever gathered by pad indices, whose
+    results the host wrappers slice off). The pow2 padding bounds
+    retraces of the downstream gather to one compile per combined-block
+    size class, the same discipline as every index stream."""
+    blocks = list(blocks)
+    if not blocks:
+        raise ValueError("concat_rows needs at least one block")
+    total = sum(int(b.shape[0]) for b in blocks)
+    padded = dev.pow2(max(1, total))
+    if len(blocks) == 1 and padded == total:
+        return blocks[0]
+    parts = blocks
+    if padded > total:
+        parts = blocks + [
+            jnp.zeros((padded - total, blocks[0].shape[1]), dtype=blocks[0].dtype)
+        ]
+    return jnp.concatenate(parts, axis=0)
+
+
 # ---------------------------------------------------------------------------
 # marshal kernels (ISSUE 8): device-side container expansion + donated
 # delta scatter

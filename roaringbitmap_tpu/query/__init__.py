@@ -20,6 +20,12 @@ PAPERS.md):
   invalidates by key miss.
 * ``kernels.py`` — the aggregation-gap fillers: n-way ANDNOT and the
   bit-sliced-adder Threshold(k), each with CPU and packed-device paths.
+* ``inflight.py`` / ``fusion.py`` — the serving tier (ISSUE 13): a
+  global in-flight table (a second identical node joins the first's
+  pending computation instead of recomputing — dedup across queries),
+  and the micro-batching executor coalescing windows of concurrent
+  queries into fused per-tier dispatches (``execute_fused`` /
+  ``FusionExecutor``).
 
 Quick start::
 
@@ -31,11 +37,15 @@ Quick start::
     result = execute(q)                # cache hit (bitmaps unchanged)
 """
 
-from .cache import DEFAULT_CACHE, ResultCache, cache_key
+from .cache import DEFAULT_CACHE, ResultCache, cache_key, leaf_fps_current
 from .exec import execute, execute_pipelined
 from .expr import Expr, Leaf, Q, as_expr, evaluate_naive
+from .fusion import FusionExecutor, execute_fused
+from .inflight import TABLE as INFLIGHT
+from .inflight import InflightTable
 from .kernels import andnot_nway, andnot_nway_cardinality, threshold
 from .plan import Plan, PlanStep, plan, rewrite
+from . import fusion
 
 __all__ = [
     "Q",
@@ -49,9 +59,15 @@ __all__ = [
     "PlanStep",
     "execute",
     "execute_pipelined",
+    "execute_fused",
+    "fusion",
+    "FusionExecutor",
+    "InflightTable",
+    "INFLIGHT",
     "ResultCache",
     "DEFAULT_CACHE",
     "cache_key",
+    "leaf_fps_current",
     "andnot_nway",
     "andnot_nway_cardinality",
     "threshold",

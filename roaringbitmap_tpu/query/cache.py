@@ -114,3 +114,16 @@ def cache_key(node, leaf_fps: dict) -> tuple:
     of every leaf feeding it (``leaf_fps``: leaf uid -> fingerprint,
     computed once per execution so all steps see one consistent view)."""
     return (node.uid,) + tuple(leaf_fps[l.uid] for l in node.leaves)
+
+
+def leaf_fps_current(node, leaf_fps: dict) -> bool:
+    """Cross-query key validation (ISSUE 13 satellite): do the node's
+    leaves STILL carry the snapshotted fingerprints? The executor reads
+    live bitmaps, so a leaf mutated mid-computation leaves the computed
+    value matching neither the key's snapshot nor the new contents (a
+    torn read). Every publication — a ``cache.put`` and an in-flight
+    completion alike — re-validates through this one helper and drops
+    stale values instead of keying them under fingerprints they do not
+    correspond to (the entry would otherwise be served to any concurrent
+    joiner holding the pre-mutation key)."""
+    return all(l.fingerprint() == leaf_fps[l.uid] for l in node.leaves)

@@ -39,7 +39,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import OrderedDict
-from typing import Dict, List, Optional, Union
+from typing import Dict, List, Optional, Sequence, Union
 
 from .. import observe as _observe
 from ..observe import context as _context
@@ -48,8 +48,9 @@ from ..observe import timeline as _timeline
 from ..robust import faults as _faults
 from ..robust import ladder as _ladder
 from ..models.roaring import RoaringBitmap
+from . import inflight as _inflight
 from . import kernels
-from .cache import DEFAULT_CACHE, ResultCache, cache_key
+from .cache import DEFAULT_CACHE, ResultCache, cache_key, leaf_fps_current
 from .expr import Expr
 from .plan import Plan, PlanStep
 from .plan import plan as build_plan
@@ -133,6 +134,7 @@ def _execute_traced(query, cache, mode, deadline_s) -> RoaringBitmap:
         }
         for step in p.steps:
             key = cache_key(step.node, leaf_fps)
+            entry = None
             if cache is not None:
                 hit = cache.get(key)
                 if hit is not None:
@@ -141,6 +143,21 @@ def _execute_traced(query, cache, mode, deadline_s) -> RoaringBitmap:
                         "query.cache_hit", "query", op=step.node.op
                     )
                     continue
+                # in-flight dedup (ISSUE 13): an identical node computing
+                # in ANOTHER query right now is joined, not recomputed;
+                # a None join (stale / owner failed / timeout) falls
+                # through to computing it ourselves, unclaimed
+                owner, pending = _inflight.TABLE.begin(key)
+                if owner:
+                    entry = pending
+                else:
+                    joined = _inflight.TABLE.join(pending)
+                    if joined is not None:
+                        results[step.node.uid] = joined
+                        _timeline.instant(
+                            "query.inflight_join", "query", op=step.node.op
+                        )
+                        continue
             inputs = [results[o.uid] for o in step.operands]
             force_cpu = _ladder.deadline_expired()
             if force_cpu and not degraded:
@@ -150,11 +167,16 @@ def _execute_traced(query, cache, mode, deadline_s) -> RoaringBitmap:
                 )
             seq = step.decision_seq
             t0 = time.perf_counter() if seq is not None else 0.0
-            with _timeline.tspan(
-                "query.step", "query", engine=step.engine, op=step.node.op,
-                decision=seq,
-            ):
-                val = _run_step(step, inputs, force_cpu=force_cpu)
+            try:
+                with _timeline.tspan(
+                    "query.step", "query", engine=step.engine, op=step.node.op,
+                    decision=seq,
+                ):
+                    val = _run_step(step, inputs, force_cpu=force_cpu)
+            except BaseException:
+                if entry is not None:  # joiners recompute on their own ladder
+                    _inflight.TABLE.abort(key, entry)
+                raise
             if seq is not None:
                 # resolve the planner decision ONCE (ISSUE 11): measured
                 # step wall + actual result cardinality against the
@@ -166,7 +188,15 @@ def _execute_traced(query, cache, mode, deadline_s) -> RoaringBitmap:
                     engine=step.engine, actual=max(1, val.get_cardinality()),
                 )
             if cache is not None:
-                cache.put(key, val)
+                # validated publication (ISSUE 13 satellite): a leaf
+                # mutated mid-computation makes this value match neither
+                # the key's snapshot nor the new contents — joiners get
+                # None (recompute fresh) and the cache never stores it
+                valid = leaf_fps_current(step.node, leaf_fps)
+                if entry is not None:
+                    _inflight.TABLE.complete(key, entry, val, valid)
+                if valid:
+                    cache.put(key, val)
             results[step.node.uid] = val
         if deadline_s is not None:
             _ladder.note_deadline(

@@ -1,0 +1,737 @@
+"""Cross-query fusion: the micro-batching executor (ISSUE 13 tentpole).
+
+At serving QPS the accelerator is wasted twice: per-dispatch overhead on
+small queries, and duplicated work across concurrent queries over the
+same corpus. This module lifts the batched-per-class argument
+(arXiv:1709.07821) one level — from containers to queries: a window of
+concurrent queries coalesces into fused per-tier device programs instead
+of executing one query, one node, one dispatch at a time.
+
+**The pipeline per drained window:**
+
+1. **Plan + dedup.** Every query plans through the shared memo
+   (exec._memo_plan); the hash-consed DAG (ISSUE 2) makes shared
+   subexpressions across queries the SAME node by construction, so the
+   window's step set dedups on node uid — the hot ``A & B`` under a
+   thousand user predicates is one step, not a thousand. Leaf
+   fingerprints snapshot once for the whole window (one consistent view),
+   and every computed node publishes through the result cache + in-flight
+   table (inflight.py) under validated fingerprints, so the dedup also
+   reaches queries OUTSIDE the window.
+
+2. **Tier merge.** Unique steps level by topological depth, then group
+   by merge class; each merged group executes as ONE dispatch:
+
+   ========================= ============================================
+   merge class               fused execution
+   ========================= ============================================
+   pairwise and/or/xor/      ``columnar.pairwise_multi`` — every pair's
+   andnot                    matched containers in one per-class batch;
+                             on the device tier one ``pair_rows_reduce``
+                             gather+op+popcount launch over the
+                             concatenated resident row blocks, per-query
+                             result slicing
+   or/xor CPU folds          ``columnar.fold_multi`` — all working sets
+                             in one multi-band scatter + popcount pass
+   n-way ANDNOT (CPU)        one ``or_fold_words`` call unions EVERY
+                             query's subtrahend groups (keys namespaced
+                             per query), then per-query word folds
+   n-way ANDNOT (device)     per-query union reduce, then ONE fused
+                             ``first & ~union`` + popcount dispatch over
+                             the concatenated ``[G, 2048]`` blocks
+                             (``pair_rows_reduce`` on row-aligned pairs)
+   Threshold(k) (device)     same-(k, slices, M) blocks concatenate
+                             along G into one bit-sliced-adder dispatch
+   workshy-and / threshold   solo (AND's key-intersection fold and the
+   CPU / device-* n-ary      per-key CPU adder have no batched band to
+                             merge; the n-ary reduces already amortize
+                             their own working set)
+   ========================= ============================================
+
+   Merged results are bit-exact with per-query execution by
+   construction: every fused path feeds the same partition and the same
+   assembly helpers as its solo twin (no second result-format rule
+   anywhere).
+
+3. **Priced verdict + degradation.** Each window records a
+   ``fusion.batch`` decision (batch vs solo, with per-engine ``est_us``
+   from the fusion-batch pricing authority, cost/fusion.py) and executes
+   under the decision–outcome join: measured wall joins the prediction,
+   mispricing shows up as regret/error rows, and the authority refits
+   from live windows through the ``cost/`` facade like every other
+   pricing authority. The fused attempt rides the ``query.fusion``
+   ladder site (fault-injectable): any non-fatal failure degrades the
+   whole window to per-query serial execution — bit-exact, just without
+   the batching win.
+
+**Windowing:** :func:`execute_fused` is the synchronous batch entry
+(callers that already hold a window); :class:`FusionExecutor` is the
+serving shape — ``submit()`` returns a future, a drain loop coalesces up
+to ``RB_TPU_FUSION_WINDOW`` queries (default 8) or whatever arrived
+within ``RB_TPU_FUSION_LATENCY_MS`` (default 2 ms), so the executor
+never waits long for a window that isn't coming. ``RB_TPU_FUSION=off``
+(or ``configure(enabled=False)``) reduces :func:`execute_fused` to the
+plain serial loop — the bench's off-mode twin bounds that path under the
+house <1 % budget.
+
+Observability: ``rb_tpu_fusion_batch_total{outcome}``,
+``rb_tpu_fusion_queries_total``, ``rb_tpu_fusion_steps_total{kind}``,
+``rb_tpu_fusion_batch_seconds{phase}`` (batch wall | queued wait), the
+``rb_tpu_fusion_queued_count`` gauge (the sentinel's
+``fusion-queue-stall`` rule watches it), and the in-flight table's
+``rb_tpu_query_inflight_total{event}`` — all surfaced in the rb_top
+fusion panel and the metrics-sidecar ``fusion`` block.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from .. import observe as _observe
+from ..observe import context as _context
+from ..observe import decisions as _decisions
+from ..observe import outcomes as _outcomes
+from ..observe import timeline as _timeline
+from ..robust import faults as _faults
+from ..robust import ladder as _ladder
+from ..models.roaring import RoaringBitmap
+from ..cost import fusion as _fusion_cost
+from . import exec as _exec
+from . import inflight as _inflight
+from .cache import DEFAULT_CACHE, ResultCache, cache_key, leaf_fps_current
+from .expr import Expr
+from .plan import Plan, PlanStep
+
+_BATCH_TOTAL = _observe.counter(
+    _observe.FUSION_BATCH_TOTAL,
+    "Fusion windows drained, by execution outcome "
+    "(fused | per-query | degraded)",
+    ("outcome",),
+)
+_QUERIES_TOTAL = _observe.counter(
+    _observe.FUSION_QUERIES_TOTAL,
+    "Queries that entered a fusion window",
+)
+_STEPS_TOTAL = _observe.counter(
+    _observe.FUSION_STEPS_TOTAL,
+    "Window plan-step fates (executed = unique steps run, deduped = "
+    "steps shared across the window's queries, merged = steps that rode "
+    "a merged-tier dispatch)",
+    ("kind",),
+)
+_BATCH_SECONDS = _observe.latency_histogram(
+    _observe.FUSION_BATCH_SECONDS,
+    "Fusion latencies by phase (batch = drained-window execution wall, "
+    "queued = per-query wait in the window queue)",
+    ("phase",),
+)
+_QUEUED_COUNT = _observe.gauge(
+    _observe.FUSION_QUEUED_COUNT,
+    "Queries currently waiting across every live fusion window queue "
+    "(the fusion-queue-stall sentinel rule's depth signal)",
+)
+
+# per-executor queue depths folded into ONE gauge value: a process may
+# run several FusionExecutors (per tenant, per cache), and letting each
+# .set() the shared series would have a healthy executor's drains
+# overwrite a stalled one's parked depth — exactly the signal the
+# fusion-queue-stall rule exists to see
+_DEPTH_LOCK = threading.Lock()
+_QUEUE_DEPTHS: Dict[int, int] = {}  # id(executor) -> depth, guarded-by: _DEPTH_LOCK
+
+
+def _publish_depth(executor_id: int, depth: Optional[int]) -> None:
+    """Record one executor's live queue depth (None = executor closed)
+    and export the sum over every live executor."""
+    with _DEPTH_LOCK:
+        if depth is None:
+            _QUEUE_DEPTHS.pop(executor_id, None)
+        else:
+            _QUEUE_DEPTHS[executor_id] = depth
+        total = sum(_QUEUE_DEPTHS.values())
+    _QUEUED_COUNT.set(total)
+
+
+def _env_flag(name: str, default: bool) -> bool:
+    raw = os.environ.get(name, "").strip().lower()
+    if not raw:
+        return default
+    return raw not in ("0", "off", "false", "no")
+
+
+class config:
+    """Fusion dispatch knobs (env-seeded, runtime-overridable via
+    :func:`configure`). ``window`` bounds how many queries one drained
+    batch coalesces; ``max_wait_ms`` bounds how long the serving drain
+    loop holds an open window for stragglers."""
+
+    enabled: bool = _env_flag("RB_TPU_FUSION", True)
+    window: int = max(2, int(os.environ.get("RB_TPU_FUSION_WINDOW") or 8))
+    max_wait_ms: float = float(os.environ.get("RB_TPU_FUSION_LATENCY_MS") or 2.0)
+
+
+def configure(
+    enabled: Optional[bool] = None,
+    window: Optional[int] = None,
+    max_wait_ms: Optional[float] = None,
+) -> None:
+    if enabled is not None:
+        config.enabled = bool(enabled)
+    if window is not None:
+        if window < 2:
+            raise ValueError(f"fusion window must be >= 2, got {window}")
+        config.window = int(window)
+    if max_wait_ms is not None:
+        if max_wait_ms < 0:
+            raise ValueError(f"max_wait_ms must be >= 0, got {max_wait_ms}")
+        config.max_wait_ms = float(max_wait_ms)
+
+
+# ---------------------------------------------------------------------------
+# merge classes
+# ---------------------------------------------------------------------------
+
+# classes the fused tiers can merge into one dispatch; anything else runs
+# solo through the serial executor's step runner (same engines, same
+# ladder, bit-exact by construction)
+_MERGEABLE = ("pairwise", "fold", "andnot", "threshold-device")
+
+
+def _merge_class(step: PlanStep) -> tuple:
+    eng, op = step.engine, step.node.op
+    if eng == "pairwise":
+        return ("pairwise", op)
+    if eng in ("naive-or", "horizontal-or"):
+        return ("fold", "or")
+    if eng in ("naive-xor", "horizontal-xor"):
+        return ("fold", "xor")
+    if eng.startswith("andnot-batch"):
+        return ("andnot", "device" if eng.endswith("[device]") else "cpu")
+    if eng == "threshold-bitsliced[device]":
+        return ("threshold-device",)
+    # workshy-and (key-intersection fold), threshold CPU (per-key python
+    # adder), device-* n-ary reduces (own amortized working set)
+    return ("solo", eng)
+
+
+# ---------------------------------------------------------------------------
+# the batch entry
+# ---------------------------------------------------------------------------
+
+
+def execute_fused(
+    queries: Sequence[Union[Expr, Plan]],
+    cache: Optional[ResultCache] = DEFAULT_CACHE,
+    mode: Optional[str] = None,
+    deadline_s: Optional[float] = None,
+) -> List[RoaringBitmap]:
+    """Execute a window of concurrent queries as fused per-tier device
+    programs. Results are bit-exact with ``[execute(q, ...) for q in
+    queries]`` — fusion is a latency decision, never a correctness one.
+    Fusion off (or a single query) routes straight to the serial loop."""
+    qs = list(queries)
+    if not qs:
+        return []
+    if not config.enabled or len(qs) == 1:
+        return [
+            _exec.execute(q, cache=cache, mode=mode, deadline_s=deadline_s)
+            for q in qs
+        ]
+    with _context.trace_scope():
+        return _execute_window(qs, cache, mode, deadline_s)
+
+
+def _execute_window(qs, cache, mode, deadline_s) -> List[RoaringBitmap]:
+    plans = [q if isinstance(q, Plan) else _exec._memo_plan(q, mode) for q in qs]
+    unique: Dict[int, PlanStep] = {}
+    deduped = 0
+    for p in plans:
+        for s in p.steps:
+            if s.node.uid in unique:
+                deduped += 1
+            else:
+                unique[s.node.uid] = s
+    levels = _levels(unique)
+    # cache-aware pricing: a warm window's steps are dict probes, not
+    # dispatches — price only the steps the cache cannot serve, or the
+    # verdict would predict a full recompute against a near-zero
+    # measured wall on every warm drain (a perpetual mispricing anomaly
+    # the ledger would rightly flag). The probe is __contains__ (no LRU
+    # touch, no hit/miss accounting); cross-thread drift between probe
+    # and execution is ordinary pricing noise.
+    if cache is not None:
+        leaf_fps = {}
+        for p in plans:
+            for l in p.root.leaves:
+                if l.uid not in leaf_fps:
+                    leaf_fps[l.uid] = l.fingerprint()
+        live = {
+            uid for uid, s in unique.items()
+            if cache_key(s.node, leaf_fps) not in cache
+        }
+    else:
+        live = set(unique)
+    n_steps = len(live)
+    n_tiers = sum(
+        len(_group([s for s in steps if s.node.uid in live]))
+        for steps in levels.values()
+    )
+    _QUERIES_TOTAL.inc(len(qs))
+    if n_steps:
+        _STEPS_TOTAL.inc(n_steps, ("executed",))
+    if deduped:
+        _STEPS_TOTAL.inc(deduped, ("deduped",))
+    est = _fusion_cost.MODEL.estimate(n_steps, n_tiers)
+    verdict = "fused" if est["fused"] <= est["per-query"] else "per-query"
+    seq = _decisions.record_decision(
+        "fusion.batch", verdict, outcome=_outcomes.enabled(),
+        est_us=est, queries=len(qs), steps=n_steps, tiers=n_tiers,
+        deduped=deduped,
+    )
+
+    def _serial() -> List[RoaringBitmap]:
+        return [
+            _exec.execute(p, cache=cache, mode=mode, deadline_s=deadline_s)
+            for p in plans
+        ]
+
+    t0 = time.perf_counter()
+    if verdict == "per-query" or n_steps == 0:
+        with _outcomes.measure(seq, "fusion.batch", engine="per-query"):
+            out = _serial()
+        _BATCH_TOTAL.inc(1, ("per-query",))
+        _BATCH_SECONDS.observe(time.perf_counter() - t0, ("batch",))
+        return out
+
+    state = {"degraded": False}
+
+    def _serial_degraded() -> List[RoaringBitmap]:
+        state["degraded"] = True
+        return _serial()
+
+    def _fused() -> List[RoaringBitmap]:
+        _faults.fault_point("query.fusion")
+        return _run_fused(plans, unique, levels, cache, deadline_s)
+
+    out = _ladder.LADDER.run(
+        "query.fusion",
+        [("fused", _fused), ("per-query", _serial_degraded)],
+        outcome_seq=seq, outcome_site="fusion.batch",
+    )
+    outcome = "degraded" if state["degraded"] else "fused"
+    _BATCH_TOTAL.inc(1, (outcome,))
+    _BATCH_SECONDS.observe(time.perf_counter() - t0, ("batch",))
+    return out
+
+
+def _levels(unique: Dict[int, PlanStep]) -> Dict[int, List[PlanStep]]:
+    """Unique steps by topological depth: a tier at depth d has every
+    operand materialized by depths < d, so merged groups never need a
+    barrier inside a level."""
+    depth: Dict[int, int] = {}
+
+    def _depth(node) -> int:
+        d = depth.get(node.uid)
+        if d is not None:
+            return d
+        step = unique.get(node.uid)
+        if step is None:  # leaf
+            depth[node.uid] = 0
+            return 0
+        d = 1 + max((_depth(o) for o in step.operands), default=0)
+        depth[node.uid] = d
+        return d
+
+    levels: Dict[int, List[PlanStep]] = {}
+    for s in unique.values():
+        levels.setdefault(_depth(s.node), []).append(s)
+    return levels
+
+
+def _group(steps: List[PlanStep]) -> Dict[tuple, List[PlanStep]]:
+    groups: Dict[tuple, List[PlanStep]] = {}
+    for s in steps:
+        groups.setdefault(_merge_class(s), []).append(s)
+    return groups
+
+
+def _run_fused(plans, unique, levels, cache, deadline_s) -> List[RoaringBitmap]:
+    leaf_fps: Dict[int, tuple] = {}
+    results: Dict[int, RoaringBitmap] = {}
+    for p in plans:
+        for l in p.root.leaves:
+            if l.uid not in leaf_fps:
+                leaf_fps[l.uid] = l.fingerprint()
+                results[l.uid] = l.bitmap
+    with _timeline.tspan(
+        "fusion.window", "fusion", queries=len(plans), steps=len(unique),
+    ), _ladder.deadline_scope(deadline_s):
+        for d in sorted(levels):
+            for cls, steps in sorted(_group(levels[d]).items()):
+                _run_group(cls, steps, results, leaf_fps, cache)
+    return [results[p.root.uid].clone() for p in plans]
+
+
+def _run_group(cls, steps, results, leaf_fps, cache) -> None:
+    # cache + in-flight claim per step: hits and successful joins drop
+    # out of the merge; owners publish after the group computes
+    ready: List[Tuple[PlanStep, tuple, Optional[object]]] = []
+    for s in steps:
+        key = cache_key(s.node, leaf_fps)
+        if cache is not None:
+            hit = cache.get(key)
+            if hit is not None:
+                results[s.node.uid] = hit
+                continue
+            owner, pending = _inflight.TABLE.begin(key)
+            if not owner:
+                # non-blocking poll, NEVER join(): this executor already
+                # holds unpublished claims for earlier steps of this
+                # group — blocking on a foreign owner here could mutually
+                # stall two windows that claimed shared nodes in opposite
+                # orders (each waiting 30 s on the other's unpublished
+                # claim). A still-computing foreign node is simply
+                # recomputed inside the merge, unclaimed.
+                joined = _inflight.TABLE.poll(pending)
+                if joined is not None:
+                    results[s.node.uid] = joined
+                    continue
+                ready.append((s, key, None))
+            else:
+                ready.append((s, key, pending))
+        else:
+            ready.append((s, key, None))
+    if not ready:
+        return
+    force_cpu = _ladder.deadline_expired()
+    merged = (
+        not force_cpu and len(ready) >= 2 and cls[0] in _MERGEABLE
+    )
+    t0 = time.perf_counter()
+    try:
+        if merged:
+            with _timeline.tspan(
+                "fusion.tier", "fusion", cls="/".join(cls), steps=len(ready),
+            ):
+                vals = _run_merged(cls, ready, results)
+        else:
+            vals = []
+            for s, _key, _entry in ready:
+                inputs = [results[o.uid] for o in s.operands]
+                vals.append(_exec._run_step(s, inputs, force_cpu=force_cpu))
+    except BaseException:
+        for _s, key, entry in ready:
+            if entry is not None:
+                _inflight.TABLE.abort(key, entry)
+        raise
+    wall = time.perf_counter() - t0
+    if merged:
+        _STEPS_TOTAL.inc(len(ready), ("merged",))
+    per_step_s = wall / len(ready)
+    for (s, key, entry), val in zip(ready, vals):
+        seq = s.decision_seq
+        if seq is not None:
+            # the planner decision's measured join (ISSUE 11): merged
+            # steps share the bucket wall pro-rata; the cardinality
+            # refit only needs `actual`, which is exact either way
+            s.decision_seq = None
+            _outcomes.resolve(
+                seq, "query.plan", per_step_s, engine=s.engine,
+                actual=max(1, val.get_cardinality()),
+            )
+        if cache is not None:
+            valid = leaf_fps_current(s.node, leaf_fps)
+            if entry is not None:
+                _inflight.TABLE.complete(key, entry, val, valid)
+            if valid:
+                cache.put(key, val)
+        results[s.node.uid] = val
+
+
+def _run_merged(cls, ready, results) -> List[RoaringBitmap]:
+    if cls[0] == "pairwise":
+        return _merged_pairwise(cls[1], ready, results)
+    if cls[0] == "fold":
+        return _merged_fold(cls[1], ready, results)
+    if cls[0] == "andnot":
+        if cls[1] == "device":
+            return _merged_andnot_device(ready, results)
+        return _merged_andnot_cpu(ready, results)
+    return _merged_threshold_device(ready, results)
+
+
+# ---------------------------------------------------------------------------
+# merged tier implementations (each: ONE dispatch for the whole group)
+# ---------------------------------------------------------------------------
+
+
+def _merged_pairwise(op, ready, results) -> List[RoaringBitmap]:
+    from .. import columnar
+    from ..columnar import engine as _col_engine
+
+    pairs = [
+        (results[s.operands[0].uid], results[s.operands[1].uid])
+        for s, _k, _e in ready
+    ]
+    # the window's largest pair prices the tier for the whole group
+    # (record=False: the fusion.batch site is this window's provenance)
+    big = max(
+        pairs,
+        key=lambda ab: min(
+            ab[0].high_low_container.size, ab[1].high_low_container.size
+        ),
+    )
+    tier = _col_engine.route(
+        big[0].high_low_container, big[1].high_low_container,
+        record=False, op=op,
+    )
+    dev = "device" if str(tier) == "columnar-device" else "cpu"
+    return columnar.pairwise_multi(op, pairs, tier=dev)
+
+
+def _merged_fold(op, ready, results) -> List[RoaringBitmap]:
+    from ..columnar import engine as _col_engine
+    from ..parallel import store
+
+    groups_list = [
+        store.group_by_key([results[o.uid] for o in s.operands])
+        for s, _k, _e in ready
+    ]
+    return _col_engine.fold_multi(groups_list, op)
+
+
+def _merged_andnot_cpu(ready, results) -> List[RoaringBitmap]:
+    from .. import columnar
+    from ..models.container import best_container_of_words
+    from . import kernels as _qk
+
+    jobs = []
+    namespaced: dict = {}
+    for si, (s, _k, _e) in enumerate(ready):
+        first = results[s.operands[0].uid]
+        rest = [results[o.uid] for o in s.operands[1:]]
+        groups = _qk._rest_groups(first, rest)
+        jobs.append((first, groups))
+        for k, cs in groups.items():
+            namespaced[(si, k)] = cs
+    union = columnar.or_fold_words(namespaced) if namespaced else {}
+    outs = []
+    for si, (first, groups) in enumerate(jobs):
+        hlc = first.high_low_container
+        out = RoaringBitmap()
+        for k, c in zip(hlc.keys, hlc.containers):
+            if k not in groups:
+                out.high_low_container.append(k, c.clone())
+                continue
+            acc = c.to_words()
+            acc &= ~union[(si, k)]
+            res = best_container_of_words(acc)
+            if res.cardinality:
+                out.high_low_container.append(k, res)
+        outs.append(out)
+    return outs
+
+
+def _merged_andnot_device(ready, results) -> List[RoaringBitmap]:
+    from ..ops import pallas_kernels as pk
+    from ..parallel import store
+    from . import kernels as _qk
+
+    vals: List[Optional[RoaringBitmap]] = [None] * len(ready)
+    stages = []
+    for i, (s, _k, _e) in enumerate(ready):
+        first = results[s.operands[0].uid]
+        rest = [results[o.uid] for o in s.operands[1:]]
+        ckeys, crows = _qk._covered(first, rest)
+        if not crows:  # no subtrahend overlaps any of first's keys
+            vals[i] = first.clone()
+            continue
+        stages.append((i, _qk._device_andnot_stage(first, rest, ckeys)))
+    if stages:
+        rows_list = [st[0] for _i, st in stages]
+        union_list = [st[1] for _i, st in stages]
+        total = sum(int(r.shape[0]) for r in rows_list)
+        rows_all = pk.concat_rows(rows_list)
+        union_all = pk.concat_rows(union_list)
+        idx = np.arange(total, dtype=np.int64)
+        words, cards = pk.pair_rows_reduce(rows_all, idx, union_all, idx, "andnot")
+        off = 0
+        for i, (first_rows, _union, passthrough, keys) in stages:
+            g = int(first_rows.shape[0])
+            computed = dict(
+                store.iter_group_containers(
+                    keys, words[off : off + g], cards[off : off + g]
+                )
+            )
+            off += g
+            out = RoaringBitmap()
+            by_key = {k: c.clone() for k, c in passthrough}
+            by_key.update(computed)
+            for k in sorted(by_key):
+                out.high_low_container.append(k, by_key[k])
+            vals[i] = out
+    return vals
+
+
+def _merged_threshold_device(ready, results) -> List[RoaringBitmap]:
+    import jax.numpy as jnp
+
+    from ..parallel import store
+    from . import kernels as _qk
+
+    vals: List[Optional[RoaringBitmap]] = [None] * len(ready)
+    buckets: dict = {}
+    for i, (s, _k, _e) in enumerate(ready):
+        bms = [results[o.uid] for o in s.operands]
+        k = s.node.k
+        if k > len(bms):
+            vals[i] = RoaringBitmap()
+            continue
+        keys_ok, _rows = _qk._threshold_keys_ok(bms, k)
+        if not keys_ok:
+            vals[i] = RoaringBitmap()
+            continue
+        block = _qk._threshold_device_block(bms, k, keys_ok)
+        if block is None:  # too skewed to pad: the CPU fold serves it
+            vals[i] = _qk.threshold(k, bms, mode="cpu")
+            continue
+        packed, words3, n_slices = block
+        if (k >> n_slices) != 0:
+            vals[i] = RoaringBitmap()
+            continue
+        buckets.setdefault((k, n_slices, int(words3.shape[1])), []).append(
+            (i, packed, words3)
+        )
+    for (k, n_slices, _m), items in sorted(buckets.items()):
+        words_all = (
+            jnp.concatenate([w for _i, _p, w in items], axis=0)
+            if len(items) > 1 else items[0][2]
+        )
+        red, cards = _qk._threshold_kernel(k, n_slices)(words_all)
+        red = np.asarray(red)
+        cards = np.asarray(cards).astype(np.int64)
+        off = 0
+        for i, packed, w3 in items:
+            g = int(w3.shape[0])
+            vals[i] = store.unpack_to_bitmap(
+                packed.group_keys, red[off : off + g], cards[off : off + g]
+            )
+            off += g
+    return vals
+
+
+# ---------------------------------------------------------------------------
+# the serving window (submit -> future, latency/size-bounded drain)
+# ---------------------------------------------------------------------------
+
+
+class FusionExecutor:
+    """Micro-batching front door: ``submit()`` enqueues and returns a
+    future; the drain loop coalesces up to ``window`` queries (or
+    whatever arrived within ``max_wait_ms`` of the window opening) and
+    executes the batch through :func:`execute_fused`. One drain thread,
+    lazily started; ``close()`` drains what is queued and stops."""
+
+    def __init__(
+        self,
+        window: Optional[int] = None,
+        max_wait_ms: Optional[float] = None,
+        cache: Optional[ResultCache] = DEFAULT_CACHE,
+        mode: Optional[str] = None,
+        deadline_s: Optional[float] = None,
+    ):
+        self.window = int(window) if window is not None else config.window
+        self.max_wait_s = (
+            float(max_wait_ms) if max_wait_ms is not None else config.max_wait_ms
+        ) / 1e3
+        self.cache = cache
+        self.mode = mode
+        self.deadline_s = deadline_s
+        self._cond = threading.Condition()
+        self._queue: "deque[tuple]" = deque()  # guarded-by: self._cond
+        self._closed = False  # guarded-by: self._cond
+        self._thread: Optional[threading.Thread] = None  # guarded-by: self._cond
+        self.batches = 0
+
+    def submit(self, query: Union[Expr, Plan]) -> "Future[RoaringBitmap]":
+        fut: "Future[RoaringBitmap]" = Future()
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("FusionExecutor is closed")
+            self._queue.append((query, fut, time.perf_counter()))
+            _publish_depth(id(self), len(self._queue))
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._drain_loop, name="rb-fusion", daemon=True
+                )
+                self._thread.start()
+            self._cond.notify_all()
+        return fut
+
+    def map(self, queries: Sequence[Union[Expr, Plan]]) -> List[RoaringBitmap]:
+        """Submit all, wait for all — per-query latencies still land in
+        the queued-phase histogram, unlike a direct execute_fused call."""
+        futs = [self.submit(q) for q in queries]
+        return [f.result() for f in futs]
+
+    def _drain_loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._closed:
+                    self._cond.wait()
+                if not self._queue and self._closed:
+                    return
+                t_open = self._queue[0][2]
+                while len(self._queue) < self.window and not self._closed:
+                    remaining = self.max_wait_s - (time.perf_counter() - t_open)
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+                batch = [
+                    self._queue.popleft()
+                    for _ in range(min(self.window, len(self._queue)))
+                ]
+                _publish_depth(id(self), len(self._queue))
+            now = time.perf_counter()
+            for _q, _fut, t_enq in batch:
+                _BATCH_SECONDS.observe(now - t_enq, ("queued",))
+            try:
+                outs = execute_fused(
+                    [q for q, _f, _t in batch],
+                    cache=self.cache, mode=self.mode, deadline_s=self.deadline_s,
+                )
+            except Exception as e:  # rb-ok: exception-hygiene -- a fatal batch error belongs to the submitting callers (their futures), not the drain thread, which must survive to serve the next window
+                for _q, fut, _t in batch:
+                    fut.set_exception(e)
+            else:
+                self.batches += 1
+                for (_q, fut, _t), val in zip(batch, outs):
+                    fut.set_result(val)
+
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+            t = self._thread
+        if t is not None:
+            t.join(timeout=10.0)
+        # a closed executor's parked depth must neither pin the stall
+        # rule firing nor mask another executor's live depth
+        _publish_depth(id(self), None)
+
+    def __enter__(self) -> "FusionExecutor":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -181,11 +181,14 @@ def andnot_nway_cardinality(
     return _cpu_tier()
 
 
-def _device_andnot_parts(first: RoaringBitmap, rest, covered_keys: set):
-    """Shared device core: reduce the subtrahend union per covered key and
-    fuse the ``first & ~union`` mask + popcount into one dispatch. Returns
-    (masked device words [G, 2048], cards [G], passthrough key/container
-    pairs for first's uncovered keys, sorted covered keys int64[G]).
+def _device_andnot_stage(first: RoaringBitmap, rest, covered_keys: set):
+    """The device andnot's union stage: pack (resident) + per-covered-key
+    subtrahend union reduce. Returns (first's covered rows on device
+    [G, 2048], union rows on device [G, 2048], passthrough key/container
+    pairs, sorted covered keys int64[G]) — the solo path fuses the
+    ``first & ~union`` mask + popcount right here; the fused executor
+    (ISSUE 13) collects SEVERAL queries' stages and runs their masks +
+    popcounts as one concatenated dispatch instead.
 
     Both packs — the subtrahend groups AND first's covered rows — live in
     the resident pack cache (store.PACK_CACHE, ISSUE 4) under the operand
@@ -194,7 +197,6 @@ def _device_andnot_parts(first: RoaringBitmap, rest, covered_keys: set):
     packs AND no per-container walk (only the key partition of first)."""
     import jax.numpy as jnp
 
-    from ..ops import device as dev
     from ..parallel import store
     from .. import tracing
 
@@ -222,9 +224,30 @@ def _device_andnot_parts(first: RoaringBitmap, rest, covered_keys: set):
         )
         run, _layout = store.prepare_reduce(packed, op="or")
         union, _ = run()
-        masked = first_rows & ~jnp.asarray(union)
-        cards = dev.popcount_rows(masked)
-    return masked, cards, passthrough, np.asarray(sorted(covered_keys), dtype=np.int64)
+    return (
+        first_rows, jnp.asarray(union), passthrough,
+        np.asarray(sorted(covered_keys), dtype=np.int64),
+    )
+
+
+def _device_andnot_parts(first: RoaringBitmap, rest, covered_keys: set):
+    """Shared device core: the union stage above plus the fused
+    ``first & ~union`` mask + popcount in one dispatch. Returns (masked
+    device words [G, 2048], cards [G], passthrough key/container pairs
+    for first's uncovered keys, sorted covered keys int64[G]).
+
+    No second ``query.andnot.device`` timer here: the stage above owns
+    the op's (one) timing span — the mask + popcount is an async device
+    enqueue, and doubling the span count would halve the op's telemetry
+    mean versus pre-ISSUE-13 rounds."""
+    from ..ops import device as dev
+
+    first_rows, union, passthrough, keys = _device_andnot_stage(
+        first, rest, covered_keys
+    )
+    masked = first_rows & ~union
+    cards = dev.popcount_rows(masked)
+    return masked, cards, passthrough, keys
 
 
 def _device_andnot(first: RoaringBitmap, rest, covered_keys: set) -> RoaringBitmap:
@@ -298,19 +321,10 @@ def threshold(
         return aggregation.FastAggregation.or_(*bms, mode=mode)
     if k == len(bms):
         return aggregation.FastAggregation.and_(*bms, mode=mode)
-    # a key present in fewer than k containers can never reach the
-    # threshold — decided from the key lists alone so the warm device path
-    # (resident pack-cache hit) skips the container transpose entirely
-    from collections import Counter
-
-    key_counts = Counter()
-    for bm in bms:
-        key_counts.update(bm.high_low_container.keys)
-    keys_ok = {key for key, c in key_counts.items() if c >= k}
+    keys_ok, n_rows = _threshold_keys_ok(bms, k)
     out = RoaringBitmap()
     if not keys_ok:
         return out
-    n_rows = sum(c for key, c in key_counts.items() if key in keys_ok)
     if aggregation._use_device(n_rows, mode) and not _ladder.deadline_expired():
 
         def _device_tier():
@@ -337,6 +351,22 @@ def threshold(
         if res.cardinality:
             out.high_low_container.append(key, res)
     return out
+
+
+def _threshold_keys_ok(bms, k: int):
+    """The >= k key pre-filter: a key present in fewer than k containers
+    can never reach the threshold — decided from the key lists alone so
+    the warm device path (resident pack-cache hit) skips the container
+    transpose entirely. Returns (surviving key set, surviving row count);
+    shared by the solo kernel and the fused executor (ISSUE 13)."""
+    from collections import Counter
+
+    key_counts = Counter()
+    for bm in bms:
+        key_counts.update(bm.high_low_container.keys)
+    keys_ok = {key for key, c in key_counts.items() if c >= k}
+    n_rows = sum(c for key, c in key_counts.items() if key in keys_ok)
+    return keys_ok, n_rows
 
 
 _threshold_steps: dict = {}
@@ -383,14 +413,16 @@ def _threshold_kernel(k: int, n_slices: int):
     return fn
 
 
-def _device_threshold(bms, k: int, keys_ok: set) -> Optional[RoaringBitmap]:
-    """Dense-padded device path; None when the group distribution is too
-    skewed to pad (caller falls back to the CPU fold). The pack is resident
-    in the shared cache (k participates in the key: it decides which key
-    groups survive the >= k pre-filter, hence the pack contents); the
-    group transpose runs only inside the miss build."""
+def _threshold_device_block(bms, k: int, keys_ok: set):
+    """The device threshold's resident pack + dense-padded block: returns
+    ``(packed, words3 [G, M, W], n_slices)``, or None when the group
+    distribution is too skewed to pad (callers fall back to the CPU
+    fold). The pack is resident in the shared cache (k participates in
+    the key: it decides which key groups survive the >= k pre-filter,
+    hence the pack contents); the group transpose runs only inside the
+    miss build. Shared by the solo kernel and the fused executor
+    (ISSUE 13), whose windows concatenate same-(k, M) blocks along G."""
     from ..parallel import store
-    from .. import tracing
 
     def _build():
         p = store.pack_groups(store.group_by_key(bms, keys_filter=keys_ok))
@@ -408,6 +440,19 @@ def _device_threshold(bms, k: int, keys_ok: set) -> Optional[RoaringBitmap]:
         return None
     m = int(words3.shape[1])
     n_slices = max(1, m.bit_length())  # counters reach at most m < 2^L
+    return packed, words3, n_slices
+
+
+def _device_threshold(bms, k: int, keys_ok: set) -> Optional[RoaringBitmap]:
+    """Dense-padded device path; None when the group distribution is too
+    skewed to pad (caller falls back to the CPU fold)."""
+    from ..parallel import store
+    from .. import tracing
+
+    block = _threshold_device_block(bms, k, keys_ok)
+    if block is None:
+        return None
+    packed, words3, n_slices = block
     if (k >> n_slices) != 0:
         return RoaringBitmap()
     with tracing.op_timer("query.threshold.device"):

@@ -53,6 +53,7 @@ SITES: Tuple[str, ...] = (
     "store.expand",      # device-side payload expansion + overlap lane (ISSUE 8)
     "ops.dispatch",      # device reduce dispatch (store run closures, ops/)
     "query.exec",        # query executor device-engine step dispatch
+    "query.fusion",      # fused micro-batch execution (query/fusion.py)
     "columnar.kernel",   # columnar native batch-kernel entry (kernels.py)
     "columnar.device",   # columnar device-tier entry (columnar/device.py)
     "native.entry",      # native C tier entry probe (native/__init__.py)

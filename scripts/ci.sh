@@ -26,7 +26,7 @@ if [[ "${1:-}" != "--fast" ]]; then
   python -m pytest tests/ -q
 fi
 
-step "fuzz smoke (500 iterations x 25 invariant families)"
+step "fuzz smoke (500 iterations x 27 invariant families)"
 python -m roaringbitmap_tpu.fuzz 500 > /tmp/ci_fuzz.log 2>&1 \
   || { tail -20 /tmp/ci_fuzz.log; exit 1; }
 tail -1 /tmp/ci_fuzz.log
@@ -93,7 +93,8 @@ step "chaos gate (ISSUE 7): tier-1 subset + differential under RB_TPU_FAULTS"
 # exceptions) and every asserted result must stay bit-exact (zero
 # divergence — the tests assert values, so a stale/partial degrade fails)
 JAX_PLATFORMS=cpu RB_TPU_FAULTS=ci-chaos-seed \
-  python -m pytest tests/test_aggregation.py tests/test_query.py -q
+  python -m pytest tests/test_aggregation.py tests/test_query.py \
+  tests/test_query_fusion.py -q
 # then the explicit differential: randomized op/query sequences under
 # seeded schedules vs the mid-schedule no-fault oracle, plus the fixed
 # ci-chaos-seed schedule exercised end-to-end with the new rb_tpu_*
@@ -569,7 +570,8 @@ if not (isinstance(h, dict) and h.get("status_end") == "green"):
 if h.get("cwd_clean") is not True or any(h.get("rules", {}).values()):
     raise SystemExit("end-of-bench rules firing / CWD dirty: %r" % h)
 need_rules = {"costmodel-drift", "routing-regret", "breaker-stuck-open",
-              "outcome-anomaly-burst", "hbm-accounting-drift", "compile-storm"}
+              "outcome-anomaly-burst", "hbm-accounting-drift", "compile-storm",
+              "fusion-queue-stall"}
 if set(h.get("rules", {})) != need_rules:
     raise SystemExit("committed rule table changed: %r" % sorted(h.get("rules", {})))
 side = json.load(open("/tmp/ci_bench_metrics.json"))
@@ -622,8 +624,9 @@ if hd["rules"]["ci-forced-red"]["level"] != 2 or not hd["rules"]["ci-forced-red"
     raise SystemExit("bundle health.json lacks the red rule state/history")
 cal = json.load(open(os.path.join(path, "calibration.json")))
 if set(cal.get("authorities", {})) != {"columnar-cutoff", "device-breakeven",
-                                       "pack-residency", "planner-cardinality"}:
-    raise SystemExit("bundle calibration.json lacks the four authorities: %r"
+                                       "fusion-batch", "pack-residency",
+                                       "planner-cardinality"}:
+    raise SystemExit("bundle calibration.json lacks the five authorities: %r"
                      % sorted(cal.get("authorities", {})))
 new_cwd = sorted(set(os.listdir(".")) - cwd_before)
 if new_cwd:
@@ -680,23 +683,117 @@ if comp.get("steady_state_retraces") != 0:
 print("tracing ok (lane %s events 100%% attributed over %s queries; off-mode %s%%; 0 retraces)"
       % (tr["lane_events"], tr["queries"], obs["off_overhead_pct"]))'
 
-step "rb_top observatory report (schema rb_tpu_top/3, ISSUE 9 + 11 + 12)"
+step "cross-query fusion: fused-vs-serial twin rows + sidecar block (ISSUE 13)"
+# the bench must commit the fused/serial twin (aggregate QPS on the
+# overlapping-predicate workload, p50/p99 per-query latency, dedup hit
+# ratio): fused must not lose to serial dispatch, results must have been
+# asserted bit-exact, the off-mode twin must be in budget, the window
+# scaling slice must show the shared-subexpression speedup GROWING with
+# window size (the superlinear claim), and the fusion.batch decision
+# site must have joined outcomes with regret inside the 5% budget
+python -c '
+import json
+m = json.load(open("/tmp/ci_bench.json"))["meta"]
+fu = m.get("fusion")
+if not isinstance(fu, dict):
+    raise SystemExit("bench meta lacks the fusion block")
+need = {"queries", "window", "serial_qps", "fused_qps", "qps_speedup",
+        "bitexact", "dedup_hit_ratio", "serial_p50_ms", "serial_p99_ms",
+        "fused_p50_ms", "fused_p99_ms", "off_overhead_pct", "off_delta_s",
+        "scaling", "batch_regret", "batch_joins"}
+missing = need - set(fu)
+if missing:
+    raise SystemExit("fusion block lacks %s" % sorted(missing))
+if fu["bitexact"] is not True:
+    raise SystemExit("fused results were not asserted bit-exact")
+if not (fu["fused_qps"] >= fu["serial_qps"]):
+    raise SystemExit("fused QPS %s lost to serial %s"
+                     % (fu["fused_qps"], fu["serial_qps"]))
+if not (0 < fu["dedup_hit_ratio"] < 1):
+    raise SystemExit("overlapping workload never deduped: %r"
+                     % fu["dedup_hit_ratio"])
+if not (fu["off_overhead_pct"] < 1.0 or fu["off_delta_s"] < 0.005):
+    raise SystemExit("fusion off-mode twin overhead %s%% (%ss) over budget"
+                     % (fu["off_overhead_pct"], fu["off_delta_s"]))
+sc = fu["scaling"]
+if len(sc) < 2:
+    raise SystemExit("fusion scaling slice too small: %r" % sc)
+ws = sorted(int(k) for k in sc)
+if not (sc[str(ws[-1])]["speedup"] > sc[str(ws[0])]["speedup"] * 0.95
+        and sc[str(ws[-1])]["speedup"] >= 1.0):
+    raise SystemExit("shared-subexpression speedup does not scale: %r" % sc)
+if not fu["batch_joins"] > 0:
+    raise SystemExit("no fusion.batch outcomes joined")
+if not (0.0 <= fu["batch_regret"] <= 0.05):
+    raise SystemExit("fusion.batch regret %s blew the 5%% budget"
+                     % fu["batch_regret"])
+side = json.load(open("/tmp/ci_bench_metrics.json"))
+sf = side.get("fusion")
+if not isinstance(sf, dict):
+    raise SystemExit("metrics sidecar lacks the fusion block")
+smissing = {"batches", "queries", "steps", "occupancy", "dedup_hit_ratio",
+            "inflight"} - set(sf)
+if smissing:
+    raise SystemExit("sidecar fusion block lacks %s" % sorted(smissing))
+if not sf["batches"].get("fused"):
+    raise SystemExit("sidecar records no fused windows: %r" % sf["batches"])
+print("fusion rows ok (fused %s vs serial %s q/s, speedup %sx, dedup %s, "
+      "scaling %s, regret %s over %d joins)"
+      % (fu["fused_qps"], fu["serial_qps"], fu["qps_speedup"],
+         fu["dedup_hit_ratio"],
+         {k: v["speedup"] for k, v in sorted(sc.items(), key=lambda kv: int(kv[0]))},
+         fu["batch_regret"], fu["batch_joins"]))'
+# the new fusion metric names must pass the naming convention, with the
+# declared label sets, and the query.fusion fault site must be registered
+JAX_PLATFORMS=cpu python -c '
+from roaringbitmap_tpu import observe
+from roaringbitmap_tpu.robust import faults
+for name, suffix in ((observe.FUSION_BATCH_TOTAL, "_total"),
+                     (observe.FUSION_QUERIES_TOTAL, "_total"),
+                     (observe.FUSION_STEPS_TOTAL, "_total"),
+                     (observe.FUSION_BATCH_SECONDS, "_seconds"),
+                     (observe.FUSION_QUEUED_COUNT, "_count"),
+                     (observe.QUERY_INFLIGHT_TOTAL, "_total")):
+    if not (name.startswith("rb_tpu_") and name.endswith(suffix)):
+        raise SystemExit("fusion metric violates naming convention: %r" % name)
+import roaringbitmap_tpu.query  # registers the fusion metrics
+b = observe.REGISTRY.get(observe.FUSION_BATCH_TOTAL)
+if b is None or b.labelnames != ("outcome",):
+    raise SystemExit("fusion batch counter label set is not the declared (outcome,)")
+s = observe.REGISTRY.get(observe.FUSION_STEPS_TOTAL)
+if s is None or s.labelnames != ("kind",):
+    raise SystemExit("fusion steps counter label set is not the declared (kind,)")
+if "query.fusion" not in faults.SITES:
+    raise SystemExit("query.fusion fault site not registered")
+print("fusion metric names ok (suffixes + declared label sets; fault site registered)")'
+
+step "rb_top observatory report (schema rb_tpu_top/4, ISSUE 9 + 11 + 12 + 13)"
 # the snapshot CLI must produce a schema-valid JSON report with every
 # panel populated from its in-process demo workload — incl. the regret
-# panel (per-site joins from the decision-outcome ledger) and the health
-# panel (sentinel status + the committed rule table, judged green)
+# panel (per-site joins from the decision-outcome ledger), the health
+# panel (sentinel status + the committed rule table, judged green), and
+# the fusion panel (window occupancy + shared-subexpression hit ratio
+# from the demo's fused window)
 JAX_PLATFORMS=cpu RB_TPU_ARTIFACT_DIR=/tmp/ci_artifacts \
   python scripts/rb_top.py --demo --json > /tmp/ci_rb_top.json
 python -c '
 import json
 r = json.load(open("/tmp/ci_rb_top.json"))
-if r.get("schema") != "rb_tpu_top/3":
+if r.get("schema") != "rb_tpu_top/4":
     raise SystemExit("rb_top: bad schema %r" % r.get("schema"))
 need = {"schema", "generated_utc", "source", "counters", "latency",
-        "locks", "breakers", "cache", "decisions_tail", "regret", "health"}
+        "locks", "breakers", "cache", "decisions_tail", "regret", "health",
+        "fusion"}
 missing = need - set(r)
 if missing:
     raise SystemExit("rb_top report lacks %s" % sorted(missing))
+fu = r["fusion"]
+if not fu.get("batches", {}).get("fused"):
+    raise SystemExit("rb_top demo drained no fused window: %r" % fu)
+if not (fu.get("occupancy") and fu["occupancy"] >= 2):
+    raise SystemExit("rb_top fusion occupancy not a real window: %r" % fu)
+if not (fu.get("dedup_hit_ratio") and fu["dedup_hit_ratio"] > 0):
+    raise SystemExit("rb_top demo shared subexpression never deduped: %r" % fu)
 if not r["locks"]:
     raise SystemExit("rb_top demo recorded no lock waits")
 if not r["counters"]["compile"]:

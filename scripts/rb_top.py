@@ -24,13 +24,18 @@ Three sources::
                                                # (useful when imported:
                                                #  rb_top.report())
 
-``--json`` emits the machine-readable report (schema ``rb_tpu_top/3``:
-the ``health`` key landed in /3, ``regret`` in /2; scripts/ci.sh
-validates it). Breaker states, the decision log, the outcome ledger, and
-sentinel rule states are process-local, so a sidecar-sourced report
-carries the sidecar's registry view of them (counter totals + the
-``regret``/``health`` blocks derived in export.py) rather than live
-states.
+Since ISSUE 13 the report also carries the **fusion panel**: the
+micro-batching executor's window occupancy, shared-subexpression hit
+ratio, in-flight dedup joins, and queue depth (batch regret rides the
+regret panel under the ``fusion.batch`` site).
+
+``--json`` emits the machine-readable report (schema ``rb_tpu_top/4``:
+the ``fusion`` key landed in /4, ``health`` in /3, ``regret`` in /2;
+scripts/ci.sh validates it). Breaker states, the decision log, the
+outcome ledger, and sentinel rule states are process-local, so a
+sidecar-sourced report carries the sidecar's registry view of them
+(counter totals + the ``regret``/``health``/``fusion`` blocks derived in
+export.py) rather than live states.
 """
 
 from __future__ import annotations
@@ -44,7 +49,7 @@ import time
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
-SCHEMA = "rb_tpu_top/3"
+SCHEMA = "rb_tpu_top/4"
 
 
 def _live_report(tail: int) -> dict:
@@ -77,6 +82,9 @@ def _live_report(tail: int) -> dict:
         # health sentinel (ISSUE 12): status + per-rule states vs their
         # committed thresholds + the recent actuation log
         "health": insights.health(),
+        # cross-query fusion (ISSUE 13): window occupancy, dedup hit
+        # ratio, in-flight joins, queue depth
+        "fusion": insights.fusion_counters(),
     }
 
 
@@ -125,6 +133,8 @@ def _sidecar_report(path: str, tail: int) -> dict:
         # the sidecar's registry-derived health block (status enum +
         # per-rule state enums + actuation counters, export.py)
         "health": side.get("health", {}),
+        # the sidecar's registry-derived fusion block (export.py)
+        "fusion": side.get("fusion", {}),
     }
 
 
@@ -150,6 +160,14 @@ def _demo_workload() -> None:
     aggregation.FastAggregation.or_(*bms, mode="cpu")
     aggregation.FastAggregation.or_(*bms, mode="device")
     execute((Q.leaf(bms[0]) & Q.leaf(bms[1])) | Q.leaf(bms[2]))
+    # a fused window so the fusion panel reports real occupancy/dedup
+    # numbers (shared hot AND under different predicates, ISSUE 13)
+    from roaringbitmap_tpu.query import execute_fused
+
+    # the shared AND rides under an OR so the flatten rewrite cannot
+    # absorb it — it stays ONE hash-consed node across all three plans
+    hot = Q.leaf(bms[0]) & Q.leaf(bms[1])
+    execute_fused([hot | Q.leaf(bms[i]) for i in (2, 3, 4)])
     hb = int(bms[0].high_low_container.keys[0])
     bms[0].add((hb << 16) | 4242)
     store.packed_for(bms)
@@ -287,6 +305,26 @@ def _render_console(r: dict) -> str:
     section("health (sentinel)", h_rows)
     if act_rows:
         section("health actuations", act_rows)
+    # fusion panel (ISSUE 13): window occupancy, shared-subexpression hit
+    # ratio, in-flight dedup joins, queue depth — batch regret rides the
+    # regret panel above under the fusion.batch site
+    f = r.get("fusion", {}) or {}
+    f_rows = []
+    for outcome, v in sorted((f.get("batches") or {}).items()):
+        f_rows.append((f"batches[{outcome}]", v))
+    if f.get("queries"):
+        f_rows.append(("queries", f["queries"]))
+    if f.get("occupancy") is not None:
+        f_rows.append(("window occupancy", f["occupancy"]))
+    if f.get("dedup_hit_ratio") is not None:
+        f_rows.append(("shared-subexpr hit ratio", f["dedup_hit_ratio"]))
+    for kind, v in sorted((f.get("steps") or {}).items()):
+        f_rows.append((f"steps[{kind}]", v))
+    for event, v in sorted((f.get("inflight") or {}).items()):
+        f_rows.append((f"inflight[{event}]", v))
+    if f.get("queue_depth") is not None:
+        f_rows.append(("queue depth", f["queue_depth"]))
+    section("fusion (cross-query micro-batching)", f_rows)
     dec_rows = [
         (d.get("trace") or "-",
          f"{d['site']}: {d['decision']} {d.get('inputs', '')}")

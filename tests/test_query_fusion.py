@@ -1,0 +1,546 @@
+"""Cross-query fusion (ISSUE 13): the micro-batching executor
+(query/fusion.py), the global in-flight dedup table (query/inflight.py)
+with its validated-publication contract, the fusion-batch pricing
+authority behind the cost facade, the fusion-queue-stall sentinel rule,
+and the rb_top/sidecar fusion panels."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import Q, RoaringBitmap, cost, insights, observe
+from roaringbitmap_tpu.cost import fusion as fusion_cost
+from roaringbitmap_tpu.observe import health, outcomes as rb_outcomes
+from roaringbitmap_tpu.query import (
+    FusionExecutor,
+    ResultCache,
+    evaluate_naive,
+    execute,
+    execute_fused,
+    fusion,
+    inflight,
+)
+from roaringbitmap_tpu.query import exec as query_exec
+from roaringbitmap_tpu.robust import faults, ladder
+
+
+def _bm(rng, n=2000, space=1 << 18):
+    return RoaringBitmap(
+        np.sort(rng.choice(space, n, replace=False)).astype(np.uint32)
+    )
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    # NOTE: no faults.clear() here — the ci.sh chaos gate runs this file
+    # under the env-installed RB_TPU_FAULTS schedule, which a teardown
+    # clear() would silently strip for the rest of the session; scoped
+    # inject() contexts clean up after themselves
+    ladder.LADDER.reset()
+    inflight.TABLE.clear()
+    yield
+    ladder.LADDER.reset()
+    inflight.TABLE.clear()
+    fusion.configure(enabled=True)
+
+
+def _overlapping_queries(rng, bms, n=6):
+    """Shared hot AND under an OR (survives the flatten rewrite) plus
+    per-query unique structure — the serving-shaped workload."""
+    hot = Q.leaf(bms[0]) & Q.leaf(bms[1])
+    qs = []
+    for i in range(n):
+        a = Q.leaf(bms[2 + i % (len(bms) - 2)])
+        b = Q.leaf(bms[2 + (i + 1) % (len(bms) - 2)])
+        qs.append((hot | a) - b if i % 2 else hot | (a & b))
+    return qs
+
+
+# ---------------------------------------------------------------------------
+# fused == serial == naive (the tentpole's correctness contract)
+# ---------------------------------------------------------------------------
+
+
+def test_fused_matches_serial_and_naive():
+    rng = np.random.default_rng(7)
+    bms = [_bm(rng) for _ in range(6)]
+    qs = _overlapping_queries(rng, bms)
+    serial = [execute(q, cache=None) for q in qs]
+    fused = execute_fused(qs, cache=ResultCache(max_entries=64))
+    naive = [evaluate_naive(q) for q in qs]
+    for s, f, nv in zip(serial, fused, naive):
+        assert f == s == nv
+
+
+def test_fused_covers_threshold_and_andnot_kernels():
+    rng = np.random.default_rng(11)
+    bms = [_bm(rng, n=4000) for _ in range(5)]
+    leaves = [Q.leaf(b) for b in bms]
+    qs = [
+        Q.threshold(2, *leaves[:4]),
+        Q.threshold(3, *leaves[1:]),
+        Q.andnot(leaves[0], *leaves[2:4]),
+        Q.andnot(leaves[1], *leaves[3:]),
+        Q.or_(leaves[0], leaves[2], leaves[4]),
+        Q.xor(leaves[1], leaves[2], leaves[3]),
+    ]
+    fused = execute_fused(qs, cache=None)
+    for q, f in zip(qs, fused):
+        assert f == evaluate_naive(q)
+
+
+def test_fused_device_mode_matches_serial():
+    """mode="device" plans device-routed engines; the merged device
+    tiers (concatenated pair rows, fused andnot mask, concatenated
+    threshold blocks) must stay bit-exact on the jax-CPU backend."""
+    from roaringbitmap_tpu.parallel import store
+
+    rng = np.random.default_rng(13)
+    bms = [_bm(rng, n=6000, space=1 << 20) for _ in range(5)]
+    leaves = [Q.leaf(b) for b in bms]
+    hot = leaves[0] & leaves[1]
+    qs = [
+        hot | leaves[2],
+        hot | leaves[3],
+        Q.andnot(leaves[0], leaves[2], leaves[3]),
+        Q.andnot(leaves[1], leaves[3], leaves[4]),
+        Q.threshold(2, *leaves[:4]),
+        Q.threshold(2, leaves[1], leaves[2], leaves[3], leaves[4]),
+    ]
+    store.PACK_CACHE.close()
+    try:
+        serial = [execute(q, cache=None, mode="device") for q in qs]
+        fused = execute_fused(qs, cache=None, mode="device")
+        for s, f in zip(serial, fused):
+            assert f == s
+    finally:
+        store.PACK_CACHE.close()
+
+
+def test_fused_dedups_shared_subexpression_across_queries():
+    rng = np.random.default_rng(17)
+    bms = [_bm(rng) for _ in range(6)]
+    qs = _overlapping_queries(rng, bms)
+    before = {
+        tuple(s["labels"].values()): s["value"]
+        for s in observe.REGISTRY.snapshot()[observe.FUSION_STEPS_TOTAL][
+            "samples"
+        ]
+    } if observe.REGISTRY.get(observe.FUSION_STEPS_TOTAL) else {}
+    execute_fused(qs, cache=None)
+    snap = observe.REGISTRY.snapshot()[observe.FUSION_STEPS_TOTAL]["samples"]
+    after = {tuple(s["labels"].values()): s["value"] for s in snap}
+    deduped = after.get(("deduped",), 0) - before.get(("deduped",), 0)
+    assert deduped > 0, "shared hot AND was not deduped across the window"
+
+
+def test_fusion_off_mode_is_plain_serial():
+    rng = np.random.default_rng(19)
+    bms = [_bm(rng, n=500) for _ in range(4)]
+    qs = _overlapping_queries(rng, bms, n=3)
+    fusion.configure(enabled=False)
+    b = observe.REGISTRY.get(observe.FUSION_BATCH_TOTAL)
+    before = sum(v for _lv, v in b.series().items()) if b else 0
+    out = execute_fused(qs, cache=None)
+    after = sum(v for _lv, v in b.series().items()) if b else 0
+    assert after == before, "off mode must not drain windows"
+    for q, o in zip(qs, out):
+        assert o == evaluate_naive(q)
+
+
+@pytest.mark.parametrize("op", ["and", "or", "xor", "andnot"])
+def test_pairwise_multi_device_tier_matches_solo(op):
+    """The fused device pairwise tier: many pairs (with a SHARED operand,
+    so the combined block dedups) through one concatenated
+    pair_rows_reduce launch, bit-exact vs solo per-pair execution."""
+    from roaringbitmap_tpu import columnar
+    from roaringbitmap_tpu.parallel import store
+
+    rng = np.random.default_rng(47)
+    bms = [_bm(rng, n=5000, space=1 << 20) for _ in range(4)]
+    for b in bms:
+        b.run_optimize()
+    pairs = [
+        (bms[0], bms[1]), (bms[0], bms[2]),  # shared left operand
+        (bms[2], bms[3]), (bms[1], bms[3]),
+    ]
+    store.PACK_CACHE.close()
+    try:
+        # suspended: this is a unit parity test of the merged kernels
+        # called directly (no ladder above them); chaos coverage of the
+        # fused device paths rides the ladder-protected execute_fused
+        # tests + fuzz family 27
+        with faults.suspended():
+            fused = columnar.pairwise_multi(op, pairs, tier="device")
+            solo = [
+                columnar.pairwise(op, a, b, tier="device") for a, b in pairs
+            ]
+            with columnar.disabled():
+                want = [
+                    getattr(RoaringBitmap, {"and": "and_", "or": "or_",
+                                            "xor": "xor", "andnot": "andnot"}[op])(a, b)
+                    for a, b in pairs
+                ]
+        for f, s, w in zip(fused, solo, want):
+            assert f == s == w
+    finally:
+        store.PACK_CACHE.close()
+
+
+def test_fold_multi_matches_per_set_folds():
+    from roaringbitmap_tpu.columnar import engine as col_engine
+    from roaringbitmap_tpu.parallel import store
+
+    rng = np.random.default_rng(53)
+    sets = [
+        [_bm(rng, n=3000) for _ in range(3)],
+        [_bm(rng, n=1000) for _ in range(4)],
+        [_bm(rng, n=200, space=1 << 16) for _ in range(2)],
+    ]
+    for op in ("or", "xor"):
+        groups_list = [store.group_by_key(bms) for bms in sets]
+        fused = col_engine.fold_multi(groups_list, op)
+        want = [
+            col_engine.fold(store.group_by_key(bms), op) for bms in sets
+        ]
+        for f, w in zip(fused, want):
+            assert f == w
+    with pytest.raises(ValueError):
+        col_engine.fold_multi([], "and")
+
+
+# ---------------------------------------------------------------------------
+# faults + ladder: a failed fused batch degrades to per-query, bit-exact
+# ---------------------------------------------------------------------------
+
+
+def test_fused_batch_degrades_to_serial_under_fault():
+    rng = np.random.default_rng(23)
+    bms = [_bm(rng) for _ in range(5)]
+    qs = _overlapping_queries(rng, bms, n=4)
+    want = [execute(q, cache=None) for q in qs]
+    with faults.inject("query.fusion", every=1):
+        got = execute_fused(qs, cache=None)
+    for g, w in zip(got, want):
+        assert g == w
+    snap = observe.REGISTRY.snapshot()[observe.FUSION_BATCH_TOTAL]["samples"]
+    by = {tuple(s["labels"].values()): s["value"] for s in snap}
+    assert by.get(("degraded",), 0) > 0, "fault did not ride the batch ladder"
+
+
+def test_fuzz_family_27_pinned_seed():
+    from roaringbitmap_tpu import fuzz
+
+    fuzz.verify_fusion_invariance("pinned", iterations=25, seed=57)
+
+
+# ---------------------------------------------------------------------------
+# in-flight dedup table (tentpole leg 1) + the cross-query key fix
+# ---------------------------------------------------------------------------
+
+
+def test_inflight_second_thread_joins_first():
+    rng = np.random.default_rng(29)
+    bms = [_bm(rng) for _ in range(3)]
+    q = (Q.leaf(bms[0]) & Q.leaf(bms[1])) | Q.leaf(bms[2])
+    cache = ResultCache(max_entries=32)
+    gate = threading.Event()
+    entered = threading.Event()
+    orig = query_exec._run_step
+
+    def slow_step(step, inputs, force_cpu=False):
+        entered.set()
+        gate.wait(10.0)
+        return orig(step, inputs, force_cpu=force_cpu)
+
+    stats0 = inflight.TABLE.stats()
+    results = {}
+
+    def runner(tag):
+        results[tag] = execute(q, cache=cache)
+
+    query_exec._run_step = slow_step
+    try:
+        t1 = threading.Thread(target=runner, args=("a",))
+        t1.start()
+        assert entered.wait(10.0)
+        query_exec._run_step = orig  # joiner must not need the gate
+        t2 = threading.Thread(target=runner, args=("b",))
+        t2.start()
+        time.sleep(0.05)  # let the joiner reach the pending entry
+        gate.set()
+        t1.join(10.0)
+        t2.join(10.0)
+    finally:
+        query_exec._run_step = orig
+        gate.set()
+    assert results["a"] == results["b"] == evaluate_naive(q)
+    stats1 = inflight.TABLE.stats()
+    assert stats1["joins"] > stats0["joins"], "second thread never joined"
+
+
+def test_joiner_never_observes_stale_bits_on_midflight_mutation():
+    """ISSUE 13 satellite regression: mutate a leaf while an identical
+    query is in flight — the owner's completion fails fingerprint
+    validation, the joiner recomputes against fresh contents, and the
+    stale value never reaches the shared cache."""
+    rng = np.random.default_rng(31)
+    a, b = _bm(rng, n=800), _bm(rng, n=800)
+    q = Q.leaf(a) & Q.leaf(b)
+    cache = ResultCache(max_entries=32)
+    gate = threading.Event()
+    entered = threading.Event()
+    orig = query_exec._run_step
+
+    def slow_step(step, inputs, force_cpu=False):
+        val = orig(step, inputs, force_cpu=force_cpu)
+        entered.set()
+        gate.wait(10.0)  # hold the computed-but-unpublished window open
+        return val
+
+    query_exec._run_step = slow_step
+    out = {}
+    try:
+        t1 = threading.Thread(target=lambda: out.setdefault("a", execute(q, cache=cache)))
+        t1.start()
+        assert entered.wait(10.0)
+        # mutate the leaf while the identical query is in flight
+        added = int(a.to_array()[0]) + 1_000_003
+        a.add(added)
+        query_exec._run_step = orig
+        gate.set()
+        t1.join(10.0)
+        got = execute(q, cache=cache)  # post-mutation: fresh fingerprints
+    finally:
+        query_exec._run_step = orig
+        gate.set()
+    want = evaluate_naive(Q.leaf(a) & Q.leaf(b))
+    assert got == want, "post-mutation execution observed stale bits"
+    assert inflight.TABLE.stats()["stale"] >= 1, (
+        "mid-flight mutation did not trip the validated-publication path"
+    )
+
+
+def test_inflight_poll_never_blocks():
+    """The fused path's non-blocking form: a still-computing foreign
+    entry polls None immediately (a claim-holding executor must never
+    block on another executor's unpublished claim)."""
+    t = inflight.InflightTable(join_timeout_s=60.0)
+    owner, entry = t.begin(("k",))
+    assert owner
+    _o2, e2 = t.begin(("k",))
+    t0 = time.perf_counter()
+    assert t.poll(e2) is None  # still computing: no wait
+    assert time.perf_counter() - t0 < 1.0
+    t.complete(("k",), entry, "v", valid=True)
+    assert t.poll(e2) == "v"
+    owner, entry = t.begin(("k2",))
+    t.complete(("k2",), entry, "stale", valid=False)
+    assert t.poll(entry) is None  # stale publication never shared
+
+
+def test_queue_depth_gauge_aggregates_across_executors():
+    """Two live executors fold into ONE gauge value: a healthy
+    executor's drains must not overwrite a stalled executor's parked
+    depth (the fusion-queue-stall rule's whole signal)."""
+    from roaringbitmap_tpu.query.fusion import _publish_depth
+
+    g = observe.REGISTRY.get(observe.FUSION_QUEUED_COUNT)
+    _publish_depth(101, 40)  # stalled executor, 40 parked
+    _publish_depth(202, 0)   # healthy executor drained
+    assert g.series()[()] == 40
+    _publish_depth(202, 3)
+    assert g.series()[()] == 43
+    _publish_depth(101, None)  # stalled executor closed
+    assert g.series()[()] == 3
+    _publish_depth(202, None)
+    assert g.series()[()] == 0
+
+
+def test_inflight_owner_failure_wakes_joiners_to_recompute():
+    t = inflight.InflightTable(join_timeout_s=5.0)
+    owner, entry = t.begin(("k",))
+    assert owner
+    joined = {}
+
+    def join():
+        _o, e = t.begin(("k",))
+        joined["val"] = t.join(e)
+
+    th = threading.Thread(target=join)
+    th.start()
+    time.sleep(0.05)
+    t.abort(("k",), entry)
+    th.join(5.0)
+    assert joined["val"] is None  # recompute, never inherit the exception
+    assert t.pending_count() == 0
+
+
+# ---------------------------------------------------------------------------
+# the fusion.batch pricing authority (cost facade protocol)
+# ---------------------------------------------------------------------------
+
+
+def test_fusion_batch_site_joins_outcomes_and_prices_engines():
+    rb_outcomes.reset()
+    rng = np.random.default_rng(37)
+    bms = [_bm(rng) for _ in range(5)]
+    try:
+        execute_fused(_overlapping_queries(rng, bms, n=4), cache=None)
+        joins = [e for e in rb_outcomes.tail() if e["site"] == "fusion.batch"]
+        assert joins, "fused window joined no fusion.batch outcome"
+        e = joins[-1]
+        assert e["engine"] in ("fused", "per-query")
+        assert e["predicted_us"] is not None and e["error_ratio"] is not None
+        assert set(e["inputs"]["est_us"]) == {"fused", "per-query"}
+    finally:
+        rb_outcomes.reset()
+
+
+def test_fusion_cost_model_refits_from_samples_and_roundtrips():
+    m = fusion_cost.FusionBatchModel()
+    est0 = m.estimate(10, 3)
+    assert est0["fused"] < est0["per-query"]  # the structural prior
+    samples = [
+        {"site": "fusion.batch", "engine": "fused",
+         "predicted_us": 1000.0, "measured_s": 4000e-6},
+        {"site": "fusion.batch", "engine": "fused",
+         "predicted_us": 1000.0, "measured_s": 4000e-6},
+    ]
+    rep = m.refit_from_outcomes(samples=samples)
+    assert rep["provenance"] == "refit-from-traffic"
+    assert m.coeffs["tier_us"] == pytest.approx(
+        fusion_cost.DEFAULT_COEFFS["tier_us"] * 4.0
+    )
+    d = m.to_dict()
+    m2 = fusion_cost.FusionBatchModel()
+    assert m2.from_dict(d)
+    assert m2.coeffs == m.coeffs and m2.provenance == "refit-from-traffic"
+    assert not m2.from_dict({"schema": "nope"})
+    m2.reset()
+    assert m2.provenance == "default"
+
+
+def test_cost_facade_exposes_fusion_authority():
+    assert "fusion-batch" in cost.names()
+    auth = cost.authority("fusion-batch")
+    assert "coeffs" in auth.curves()
+    state = cost.calibration_state()
+    assert "fusion-batch" in state["authorities"]
+    reports = cost.refit_all()
+    assert "fusion-batch" in reports
+
+
+def test_fusion_state_rides_unified_persistence(tmp_path):
+    path = str(tmp_path / "cost_state.json")
+    try:
+        with fusion_cost.MODEL._lock:
+            fusion_cost.MODEL.coeffs["solo_step_us"] = 333.0
+            fusion_cost.MODEL.provenance = "refit-from-traffic"
+        assert cost.save_state(path) == path
+        fusion_cost.MODEL.reset()
+        verdicts = cost.load_state(path)
+        assert verdicts["fusion-batch"]
+        assert fusion_cost.MODEL.coeffs["solo_step_us"] == 333.0
+        assert fusion_cost.MODEL.provenance == "refit-from-traffic"
+    finally:
+        fusion_cost.MODEL.reset()
+
+
+# ---------------------------------------------------------------------------
+# the serving window (FusionExecutor)
+# ---------------------------------------------------------------------------
+
+
+def test_executor_coalesces_and_respects_latency_bound():
+    rng = np.random.default_rng(41)
+    bms = [_bm(rng, n=500) for _ in range(5)]
+    qs = _overlapping_queries(rng, bms, n=5)
+    want = [evaluate_naive(q) for q in qs]
+    with FusionExecutor(window=8, max_wait_ms=30.0, cache=None) as ex:
+        outs = ex.map(qs)
+        assert ex.batches >= 1
+    for o, w in zip(outs, want):
+        assert o == w
+
+
+def test_executor_propagates_fatal_errors_to_futures():
+    with FusionExecutor(window=2, max_wait_ms=5.0, cache=None) as ex:
+        fut = ex.submit("not a query")  # type: ignore[arg-type]
+        with pytest.raises(Exception):
+            fut.result(timeout=10.0)
+
+
+# ---------------------------------------------------------------------------
+# sentinel rule: fusion-queue-stall
+# ---------------------------------------------------------------------------
+
+
+def test_fusion_queue_stall_rule_fires_on_stalled_depth():
+    rule = next(r for r in health.DEFAULT_RULES if r.name == "fusion-queue-stall")
+    assert rule.actuation == "alert"
+
+    def snap(depth, batches, prev):
+        metrics = {
+            observe.FUSION_QUEUED_COUNT: {
+                "samples": [{"labels": {}, "value": depth}]
+            },
+            observe.FUSION_BATCH_TOTAL: {
+                "samples": [{"labels": {"outcome": "fused"}, "value": batches}]
+            },
+        }
+        return health.Snapshot(
+            metrics=metrics, breaker_open_ages={}, drift={},
+            outcome_sites={}, now=0.0, prev_sums=prev,
+        )
+
+    st = health.RuleState()
+    # tick 1 establishes the counter baseline; depth parked, no drains
+    s1 = snap(depth=4, batches=10, prev=None)
+    st.step(rule, rule.probe(s1), 1)
+    # ticks 2-3: still no drained batch -> fires after the 2-tick hysteresis
+    s2 = snap(depth=4, batches=10, prev=dict(s1.sums))
+    st.step(rule, rule.probe(s2), 2)
+    s3 = snap(depth=4, batches=10, prev=dict(s2.sums))
+    ev = st.step(rule, rule.probe(s3), 3)
+    assert ev["level"] == health.WARN
+    # a draining queue is healthy backpressure: clears after clear_after
+    s4 = snap(depth=4, batches=12, prev=dict(s3.sums))
+    assert rule.probe(s4) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# panels: sidecar fusion block + rb_top + insights
+# ---------------------------------------------------------------------------
+
+
+def test_sidecar_and_insights_fusion_block():
+    rng = np.random.default_rng(43)
+    bms = [_bm(rng, n=500) for _ in range(5)]
+    execute_fused(_overlapping_queries(rng, bms, n=4), cache=None)
+    side = observe.sidecar_snapshot()
+    fu = side["fusion"]
+    assert {"batches", "queries", "steps", "occupancy", "dedup_hit_ratio",
+            "inflight", "queue_depth"} <= set(fu)
+    assert sum(fu["batches"].values()) > 0
+    live = insights.fusion_counters()
+    assert live["queries"] >= 4
+    assert "inflight_live" in live
+
+
+def test_rb_top_report_carries_fusion_panel():
+    import importlib
+    import sys
+
+    sys.path.insert(0, "scripts")
+    try:
+        rb_top = importlib.import_module("rb_top")
+    finally:
+        sys.path.pop(0)
+    r = rb_top.report(tail=4)
+    assert r["schema"] == "rb_tpu_top/4"
+    assert "fusion" in r
+    rendered = rb_top._render_console(r)
+    assert "fusion (cross-query micro-batching)" in rendered

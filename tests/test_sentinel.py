@@ -417,10 +417,10 @@ def test_seeded_drift_auto_refit_e2e(tmp_path, monkeypatch):
 # ---------------------------------------------------------------------------
 
 
-def test_cost_facade_registers_all_four_authorities():
+def test_cost_facade_registers_all_authorities():
     assert cost.names() == [
-        "columnar-cutoff", "device-breakeven", "pack-residency",
-        "planner-cardinality",
+        "columnar-cutoff", "device-breakeven", "fusion-batch",
+        "pack-residency", "planner-cardinality",
     ]
     state = cost.calibration_state()
     assert state["schema"] == cost.STATE_SCHEMA
