@@ -51,6 +51,14 @@ values belong on flight-recorder events and decision-log entries, which
 are bounded rings. A labels argument that is itself a variable is out of
 lexical scope, like aliasing in lock-discipline.
 
+**Tenant label values** (ISSUE 14): a tenant name is user-controlled
+input — the serving tier's ``{tenant, phase}`` label sets stay bounded
+only because every tenant label value resolves through the capacity-
+bounded DECLARED tenant registry (``serve/slo.py`` ``TENANTS``), spelt
+as the ``TENANTS[tenant]`` subscript (the declared-collection escape
+above). A bare ``tenant``-shaped name in a label tuple is flagged with
+its own message pointing at the registry; fixtures pin both directions.
+
 Forwarding wrappers (a call whose name argument is the enclosing
 function's own ``name`` parameter, e.g. the module-level ``counter()``
 helpers in registry.py) are exempt — the real declaration is at their
@@ -82,14 +90,23 @@ _UNBOUNDED = re.compile(
     r"uuid|digest|hash|hashes|token|key|keys|qid|query_id|request_id|"
     r"id)(_|$)"
 )
+# tenant-valued identifiers (ISSUE 14): a tenant name is user-controlled
+# input, so a bare `tenant` variable in a label tuple is the same
+# unbounded-cardinality bug as a trace id — tenant label values must come
+# from the bounded DECLARED tenant registry (serve/slo.py TENANTS), spelt
+# as the `TENANTS[tenant]` subscript the declared-collection escape below
+# already accepts (false-positive fixtures in tests/test_analysis.py)
+_TENANT_VALUE = re.compile(r"(^|_)(tenant|tenants|tenant_name)(_|$)")
 _ALL_CAPS = re.compile(r"^[A-Z][A-Z0-9_]*$")
 # constant names that read as canonical metric names (unit-suffixed; RATIO
 # is the dimensionless gauge unit — e.g. rb_tpu_store_overlap_ratio;
 # STATE/STATUS are the enum-gauge suffixes, ISSUE 12 — an integer level
 # from a declared enum, e.g. rb_tpu_health_status 0/1/2 = green/yellow/red
-# and rb_tpu_health_rule_state{rule} 0/1/2 = ok/warn/critical)
+# and rb_tpu_health_rule_state{rule} 0/1/2 = ok/warn/critical; QPS is the
+# serving tier's requests-per-second gauge unit, ISSUE 14 —
+# rb_tpu_serve_qps{tenant})
 _SHAPED_CONST = re.compile(
-    r"^[A-Z][A-Z0-9_]*_(TOTAL|SECONDS|BYTES|COUNT|RATIO|STATE|STATUS)$"
+    r"^[A-Z][A-Z0-9_]*_(TOTAL|SECONDS|BYTES|COUNT|RATIO|STATE|STATUS|QPS)$"
 )
 
 
@@ -160,7 +177,8 @@ class MetricNaming(Checker):
                     looks_like_metric = (
                         v.startswith("rb")
                         or re.search(
-                            r"_(total|seconds|bytes|count|ratio|state|status)$",
+                            r"_(total|seconds|bytes|count|ratio|state|"
+                            r"status|qps)$",
                             v,
                         )
                         or _SHAPED_CONST.match(t.id)
@@ -331,7 +349,19 @@ class MetricNaming(Checker):
             return
         term = dotted_name(el)
         term = term.rsplit(".", 1)[-1] if term else None
-        if term is not None and _UNBOUNDED.search(term.lower()):
+        if term is None:
+            return
+        if _TENANT_VALUE.search(term.lower()):
+            yield self.finding(
+                ctx, call,
+                f"metric label value `{term}` is a tenant name: tenant "
+                "label values must come from the bounded declared tenant "
+                "registry (spell it TENANTS[" + term + "] — the "
+                "declared-collection subscript — so an undeclared tenant "
+                "can never mint a series)",
+            )
+            return
+        if _UNBOUNDED.search(term.lower()):
             yield self.finding(
                 ctx, call,
                 f"metric label value `{term}` reads as unbounded "

@@ -1,9 +1,10 @@
 """Unified cost substrate (ISSUE 12; ROADMAP item 4's closing half).
 
-One facade over the five pricing authorities — the columnar cutoff
+One facade over the six pricing authorities — the columnar cutoff
 model, the planner's cardinality corrections, the device-breakeven
-dispatch gate, pack/ship residency pricing, and (ISSUE 13) the fusion
-executor's batch-vs-solo window curves — behind a shared
+dispatch gate, pack/ship residency pricing, (ISSUE 13) the fusion
+executor's batch-vs-solo window curves, and (ISSUE 14) the serving
+tier's admission curve — behind a shared
 curves / provenance / drift / refit / state protocol, with ONE
 persistence lifecycle (``RB_TPU_COST_STATE``). The health sentinel
 (``observe.sentinel``) actuates ``refit_all()`` when a drift gauge
@@ -25,12 +26,13 @@ from .facade import (
     reset_all,
     save_state,
 )
-from . import breakeven, fusion, residency
+from . import admission, breakeven, fusion, residency
 
 __all__ = [
     "AUTHORITIES",
     "STATE_SCHEMA",
     "Authority",
+    "admission",
     "authority",
     "breakeven",
     "calibration_state",

@@ -2,7 +2,7 @@
 curves / drift / refit / state protocol (ISSUE 12 tentpole, leg 2 —
 closing ROADMAP item 4).
 
-The system grew five pricing authorities, each calibrated differently:
+The system grew six pricing authorities, each calibrated differently:
 
 ========================= ===============================================
 authority                 wraps
@@ -20,6 +20,9 @@ authority                 wraps
 ``fusion-batch``          ``cost.fusion.MODEL`` — the micro-batching
                           executor's batch-vs-solo window curves
                           (ISSUE 13)
+``serve-admission``       ``cost.admission.MODEL`` — the serving tier's
+                          admission curve: predicted queue wait /
+                          admit cost vs measured (ISSUE 14)
 ========================= ===============================================
 
 Each adapter answers the same five questions — ``curves()`` (what do you
@@ -258,6 +261,41 @@ class FusionBatchAuthority(Authority):
         self._model().reset()
 
 
+class ServeAdmissionAuthority(Authority):
+    """The serving tier's admission curve (ISSUE 14): ``serve.admit``
+    verdicts predict the admission wall (admit bookkeeping / queued
+    backpressure wait); ledger joins score predicted-vs-measured and the
+    refit learns this host's service-rate constants from live traffic."""
+
+    name = "serve-admission"
+
+    def _model(self):
+        from . import admission as _admission
+
+        return _admission.MODEL
+
+    def curves(self) -> dict:
+        return self._model().curves_view()
+
+    def provenance(self) -> str:
+        return self._model().provenance
+
+    def drift(self) -> Dict[str, float]:
+        return self._model().drift()
+
+    def refit_from_outcomes(self, samples: Optional[List[dict]] = None) -> dict:
+        return self._model().refit_from_outcomes(samples=samples)
+
+    def state(self) -> dict:
+        return self._model().to_dict()
+
+    def load_state(self, d: dict) -> bool:
+        return self._model().from_dict(d)
+
+    def reset(self) -> None:
+        self._model().reset()
+
+
 AUTHORITIES: Dict[str, Authority] = {
     a.name: a
     for a in (
@@ -266,6 +304,7 @@ AUTHORITIES: Dict[str, Authority] = {
         DeviceBreakevenAuthority(),
         PackResidencyAuthority(),
         FusionBatchAuthority(),
+        ServeAdmissionAuthority(),
     )
 }
 

@@ -540,6 +540,88 @@ def verify_fusion_invariance(
             rfaults.clear()
 
 
+def verify_serve_invariance(
+    name: str,
+    iterations: Optional[int] = None,
+    seed: Optional[int] = None,
+) -> None:
+    """Fuzz family 28 (ISSUE 14): seeded multi-tenant traffic through
+    the serving harness (admission -> fusion window, 2-4 worker threads)
+    must be bit-exact with the same query multiset executed serially —
+    the request schedule is a pure function of the seed, so the serial
+    oracle replays the exact multiset the concurrent run served.
+    Quotas are generous (no shed): every request must produce a result
+    identical to ``execute(q, cache=None)`` computed inside
+    ``faults.suspended()``. Every other iteration arms a random seeded
+    fault schedule over the registered sites INCLUDING ``serve.admit``
+    (which must fail OPEN — admission is load management, never a
+    correctness gate) and ``query.fusion`` (which degrades the window to
+    per-query serial). A stale cross-request publication, a fault that
+    drops or corrupts a request, and an escaped exception all fail
+    identically, with the schedule in the repro detail."""
+    from contextlib import ExitStack
+
+    from .robust import faults as rfaults
+    from .robust import ladder as rladder
+    from .serve import (
+        AdmissionController, LoadHarness, TenantProfile, build_requests,
+    )
+    from .serve import slo as sslo
+
+    rng = np.random.default_rng(seed)
+    for it in range(iterations or default_iterations()):
+        bms = [random_bitmap(rng) for _ in range(int(rng.integers(4, 7)))]
+        n_tenants = int(rng.integers(2, 4))
+        profiles = [
+            TenantProfile(
+                f"fz-t{i}", weight=float(rng.uniform(0.5, 2.0)),
+                quota_qps=1e6, burst=1e6,
+            )
+            for i in range(n_tenants)
+        ]
+        sched = random_fault_schedule(rng) if it % 2 else []
+        rfaults.clear()
+        rladder.LADDER.reset()
+        sslo.reset()
+        try:
+            harness = LoadHarness(
+                bms, profiles,
+                threads=int(rng.integers(2, 5)),
+                window=int(rng.integers(2, 6)),
+                admission=AdmissionController(
+                    max_inflight=int(rng.integers(1, 9)), queue_limit=64
+                ),
+            )
+            requests = build_requests(
+                bms, profiles, int(rng.integers(4, 13)),
+                seed=int(rng.integers(0, 1 << 16)),
+            )
+            with ExitStack() as stack:
+                for site, exc, kw in sched:
+                    stack.enter_context(rfaults.inject(site, exc, **kw))
+                with rfaults.suspended():
+                    want = harness.run_serial(requests)
+                report = harness.run(requests)
+                for gi, (g, w) in enumerate(zip(report.results, want)):
+                    if g != w:
+                        raise InvarianceFailure(
+                            name, bms,
+                            detail=f"served request {gi} diverged from the "
+                            f"serial oracle (schedule={sched})",
+                        )
+        except InvarianceFailure:
+            raise
+        except Exception as e:  # rb-ok: exception-hygiene -- the family's whole point: ANY escape past the serving harness/ladder is a failure, re-wrapped with the repro schedule
+            raise InvarianceFailure(
+                name, bms,
+                detail=f"exception escaped the serving harness: {e!r} "
+                f"(schedule={sched})",
+            ) from e
+        finally:
+            rfaults.clear()
+            sslo.reset()
+
+
 def random_expression(rng, leaves: List[RoaringBitmap], max_depth: int = 4):
     """Random query DAG over the given leaf bitmaps: every node kind
     (and/or/xor/n-ary andnot/not-over-explicit-universe/threshold), biased
@@ -910,6 +992,18 @@ def run_campaign(iterations: Optional[int] = None, verbose: bool = True) -> dict
         "fused-concurrent-vs-serial",
         lambda: verify_fusion_invariance(
             "fused-concurrent-vs-serial", iterations=max(1, n // 8), seed=57
+        ),
+        actual=max(1, n // 8),
+    )
+    # ISSUE 14: seeded multi-tenant traffic through the serving harness
+    # (admission -> fusion window, multi-threaded) vs the same query
+    # multiset executed serially, incl. seeded fault schedules over the
+    # serve.admit and query.fusion sites (derated: each iteration runs a
+    # whole threaded harness window plus its serial oracle)
+    _run(
+        "concurrent-serve-vs-serial",
+        lambda: verify_serve_invariance(
+            "concurrent-serve-vs-serial", iterations=max(1, n // 8), seed=58
         ),
         actual=max(1, n // 8),
     )

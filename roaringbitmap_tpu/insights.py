@@ -289,6 +289,23 @@ def fusion_counters() -> dict:
     return block
 
 
+def serving() -> dict:
+    """Serving-tier rollup (ISSUE 14): per-tenant rolling QPS, latency
+    p50/p99 per phase, admission verdict volume, queue/in-flight depth,
+    saturation, and PACK_CACHE byte shares — the rb_top serving panel's
+    data (registry-derived, plus the live admission controller's
+    stats)."""
+    from . import observe
+    from .observe import export as _export
+    from .serve import admission as _admission
+
+    block = _export._serving_block(
+        observe.REGISTRY.snapshot(), observe.REGISTRY
+    )
+    block["admission_live"] = _admission.CONTROLLER.stats()
+    return block
+
+
 def cost_authorities() -> dict:
     """The unified cost facade's view (ISSUE 12): every pricing
     authority's curves, provenance, and live drift — ROADMAP item 4's
@@ -321,6 +338,10 @@ def observatory() -> dict:
         "decisions": decisions(32),
         "regret": regret_summary(),
         "health": health(),
+        # serving tier (ISSUE 14): the per-tenant panel rides the
+        # observatory view, so a red episode's flight bundle
+        # (observatory.json) carries the serving state that triggered it
+        "serving": serving(),
     }
 
 

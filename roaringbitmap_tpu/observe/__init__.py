@@ -61,6 +61,14 @@ from .registry import (
     REGISTRY,
     RETRY_TOTAL,
     SERIAL_BYTES_TOTAL,
+    SERVE_ADMIT_TOTAL,
+    SERVE_INFLIGHT_COUNT,
+    SERVE_LATENCY_SECONDS,
+    SERVE_QPS,
+    SERVE_QUEUE_COUNT,
+    SERVE_REQUESTS_TOTAL,
+    SERVE_SATURATION_RATIO,
+    SERVE_TENANT_BYTES,
     SPAN_SECONDS,
     STORE_DELTA_STAGE_SECONDS,
     STORE_LAYOUT_TOTAL,
@@ -207,6 +215,14 @@ __all__ = [
     "HEALTH_STATUS",
     "HEALTH_RULE_STATE",
     "HEALTH_ACTUATION_TOTAL",
+    "SERVE_LATENCY_SECONDS",
+    "SERVE_QPS",
+    "SERVE_ADMIT_TOTAL",
+    "SERVE_REQUESTS_TOTAL",
+    "SERVE_QUEUE_COUNT",
+    "SERVE_INFLIGHT_COUNT",
+    "SERVE_SATURATION_RATIO",
+    "SERVE_TENANT_BYTES",
     "FUSION_BATCH_TOTAL",
     "FUSION_QUERIES_TOTAL",
     "FUSION_STEPS_TOTAL",

@@ -118,6 +118,7 @@ def _collect_sections(health_dump: Optional[dict]) -> Dict[str, str]:
     sections["calibration.json"] = _json_or_error(_calibration)
 
     def _observatory():
+        from .. import insights as _insights
         from ..parallel import store as _store
         from ..robust import ladder as _ladder
         from . import compilewatch as _compilewatch
@@ -131,6 +132,9 @@ def _collect_sections(health_dump: Optional[dict]) -> Dict[str, str]:
             "breaker_open_ages": _ladder.LADDER.open_ages(),
             "pack_cache": _store.PACK_CACHE.stats(),
             "hbm": _store.hbm_reconciliation(),
+            # serving panel (ISSUE 14): a red episode triggered by the
+            # serving rules must ship the per-tenant state that fired it
+            "serving": _insights.serving(),
         }
 
     sections["observatory.json"] = _json_or_error(_observatory)

@@ -256,6 +256,53 @@ def _fusion_block(snap: dict) -> dict:
     }
 
 
+def _serving_block(snap: dict, registry: Registry) -> dict:
+    """The serving tier's sidecar block (ISSUE 14), derived PURELY from
+    the registry like the regret/health/fusion blocks so a ``--from``
+    rendering needs no live process: per-tenant rolling QPS gauges,
+    latency p50/p99 per (tenant, phase), admission verdict volume, the
+    live queue/in-flight depth gauges, per-tenant saturation, and the
+    per-tenant PACK_CACHE byte shares."""
+    tenants: dict = {}
+    lat = registry.get(_registry.SERVE_LATENCY_SECONDS)
+    if isinstance(lat, LatencyHistogram):
+        for lv, st in sorted(lat.series().items()):
+            tenant, phase = lv
+            tenants.setdefault(tenant, {}).setdefault("latency", {})[phase] = {
+                "count": st["count"],
+                **{
+                    "p%g" % (q * 100): round(lat._quantile_of_state(st, q), 6)
+                    for q in SNAPSHOT_QUANTILES
+                },
+            }
+    for name, key in (
+        (_registry.SERVE_QPS, "qps"),
+        (_registry.SERVE_SATURATION_RATIO, "saturation"),
+        (_registry.SERVE_TENANT_BYTES, "bytes"),
+    ):
+        m = snap.get(name)
+        if m is None:
+            continue
+        for s in m["samples"]:
+            tenant = s["labels"].get("tenant")
+            if tenant is not None:
+                tenants.setdefault(tenant, {})[key] = s["value"]
+    def _gauge(name):
+        m = snap.get(name)
+        if m is not None:
+            for s in m["samples"]:
+                if not s["labels"]:
+                    return s["value"]
+        return None
+    return {
+        "tenants": tenants,
+        "admit": _counter_map(snap, _registry.SERVE_ADMIT_TOTAL, joined=True),
+        "requests": _counter_map(snap, _registry.SERVE_REQUESTS_TOTAL, joined=True),
+        "queue_depth": _gauge(_registry.SERVE_QUEUE_COUNT),
+        "inflight": _gauge(_registry.SERVE_INFLIGHT_COUNT),
+    }
+
+
 def _health_block(snap: dict) -> dict:
     """The health sentinel's sidecar block (ISSUE 12), derived PURELY
     from the registry gauges (like the regret block) so a ``--from``
@@ -314,6 +361,9 @@ def sidecar_snapshot(registry: Optional[Registry] = None) -> dict:
         # cross-query fusion (ISSUE 13): window/step volume, occupancy,
         # shared-subexpression hit ratio, in-flight dedup joins
         "fusion": _fusion_block(snap),
+        # serving tier (ISSUE 14): per-tenant QPS/p50/p99, admission
+        # verdicts, queue/in-flight depth, saturation, byte shares
+        "serving": _serving_block(snap, _reg(registry)),
         "registry": snap,
     }
 

@@ -43,6 +43,8 @@ outcome-anomaly-burst  out-of-band joins since last tick        1     16
 hbm-accounting-drift   max |accounting drift| bytes             1     2^20
 compile-storm          jit traces since last tick               8     32
 fusion-queue-stall     fusion queue depth with no drained batch 1     64
+serving-p99-breach     worst per-tenant windowed serving p99 s  0.5   2.0
+tenant-saturation      worst per-tenant shed fraction per tick  0.25  0.75
 ====================== ======================================== ===== =====
 
 Actuations (the sentinel's closed-loop half — see ``observe.sentinel``):
@@ -237,6 +239,85 @@ class Snapshot:
             return 0.0
         return max(0.0, cur - prev)
 
+    def labeled_counter_delta(self, name: str) -> Dict[Tuple[str, ...], float]:
+        """Per-series counter movement since the previous tick (compound
+        ``name|labelvalues`` keys ride the same prev-sums channel as
+        :meth:`counter_delta`; a series first seen this tick reports 0 so
+        pre-existing totals never fire a rate rule)."""
+        m = self.metrics.get(name)
+        out: Dict[Tuple[str, ...], float] = {}
+        if m is None:
+            return out
+        labelnames = m.get("labelnames", [])
+        for s in m.get("samples", ()):
+            lv = tuple(s["labels"].get(n, "") for n in labelnames)
+            key = name + "|" + "|".join(lv)
+            cur = float(s.get("value", 0))
+            self.sums[key] = cur
+            prev = self._prev.get(key)
+            out[lv] = 0.0 if prev is None else max(0.0, cur - prev)
+        return out
+
+    def histogram_delta_quantile(self, name: str, q: float) -> Optional[float]:
+        """Windowed quantile over a histogram's per-tick movement: for
+        each labeled series, rebuild the bucket counts observed SINCE the
+        previous tick (cumulative-``le`` diffs against the prev-sums
+        channel) and estimate the ``q``-quantile by the same
+        cumulative-walk + in-bucket interpolation as LatencyHistogram;
+        returns the max over series, or None when no series moved (first
+        tick, idle window) — cumulative histograms would otherwise pin a
+        breach forever after one bad burst."""
+        m = self.metrics.get(name)
+        if m is None:
+            return None
+        worst: Optional[float] = None
+        for s in m.get("samples", ()):
+            lv = [s["labels"][n] for n in m.get("labelnames", [])]
+            skey = name + "|" + "|".join(lv)
+            buckets = s.get("buckets") or {}
+            count = float(s.get("count", 0))
+            cur = {le: float(c) for le, c in buckets.items()}
+            first = (skey + "|count") not in self._prev
+            prev_count = self._prev.get(skey + "|count", 0.0)
+            self.sums[skey + "|count"] = count
+            for le, c in cur.items():
+                self.sums[skey + "|" + le] = c
+            if first:
+                continue
+            total = count - prev_count
+            if total <= 0:
+                continue
+            keyed = sorted(
+                ((le, float(le)) for le in cur if le != "+Inf"),
+                key=lambda kv: kv[1],
+            )
+            bounds = [b for _le, b in keyed]
+            slots = []
+            prev_cum = 0.0
+            for le, _b in keyed:
+                cum = cur[le] - self._prev.get(skey + "|" + le, 0.0)
+                slots.append(max(0.0, cum - prev_cum))
+                prev_cum = max(prev_cum, cum)
+            slots.append(max(0.0, total - prev_cum))  # +Inf overflow
+            rank = max(1.0, q * total)
+            cum = 0.0
+            est = bounds[-1] if bounds else 0.0
+            for i, n in enumerate(slots):
+                if n <= 0:
+                    continue
+                below = cum
+                cum += n
+                if cum >= rank:
+                    if i >= len(bounds):
+                        est = bounds[-1]  # overflow: clamp
+                    else:
+                        hi = bounds[i]
+                        lo = bounds[i - 1] if i > 0 else 0.0
+                        est = lo + (hi - lo) * ((rank - below) / n)
+                    break
+            worst = est if worst is None else max(worst, est)
+        return worst
+
     def gauge_max_abs(self, name: str) -> float:
         m = self.metrics.get(name)
         if m is None:
@@ -312,6 +393,42 @@ def _max_open_age(s: Snapshot) -> float:
     return max(s.breaker_open_ages.values(), default=0.0)
 
 
+def _serving_p99_breach(s: Snapshot) -> Optional[float]:
+    """Worst windowed p99 (seconds) over the serving tier's per-tenant
+    latency series since the last tick (ISSUE 14 — one of the two
+    serving-shaped rules the ISSUE-12/13 closure notes promised). The
+    window is the per-tick histogram movement, so a single bad burst
+    clears once traffic recovers instead of pinning the cumulative p99
+    red forever; queue-phase series count too — a breach driven by
+    backpressure wait is exactly what an operator needs to see."""
+    return s.histogram_delta_quantile(_registry.SERVE_LATENCY_SECONDS, 0.99)
+
+
+# a tenant must offer at least this many requests in a tick window before
+# its shed fraction is judged — one shed of one request is not saturation
+_SATURATION_MIN_REQUESTS = 8.0
+
+
+def _tenant_saturation(s: Snapshot) -> Optional[float]:
+    """Worst per-tenant shed fraction since the last tick: sheds over
+    offered admission verdicts, judged only for tenants with enough
+    window volume (ISSUE 14 — the per-tenant saturation rule). A tenant
+    over quota sheds a sustained fraction of its traffic; transient
+    single-request noise stays below the volume floor."""
+    deltas = s.labeled_counter_delta(_registry.SERVE_ADMIT_TOTAL)
+    per_tenant: Dict[str, Dict[str, float]] = {}
+    for (tenant, verdict), d in deltas.items():
+        per_tenant.setdefault(tenant, {})[verdict] = d
+    worst: Optional[float] = None
+    for tenant, by_verdict in per_tenant.items():
+        offered = sum(by_verdict.values())
+        if offered < _SATURATION_MIN_REQUESTS:
+            continue
+        frac = by_verdict.get("shed", 0.0) / offered
+        worst = frac if worst is None else max(worst, frac)
+    return worst
+
+
 def _fusion_queue_stall(s: Snapshot) -> float:
     """Queries parked in the fusion window queue while NO batch drained
     since the last tick (ISSUE 13 — the ~5-line serving-shaped rule the
@@ -380,6 +497,26 @@ DEFAULT_RULES: Tuple[Rule, ...] = (
         "backpressure)",
         _fusion_queue_stall,
         warn=1.0, critical=64.0, fire_after=2, clear_after=2,
+        actuation="alert",
+    ),
+    # the two serving-shaped rules ISSUE 12's closure note promised,
+    # judging the serve tier's per-tenant histograms/counters (ISSUE 14);
+    # appended so the earlier rules keep their table positions
+    Rule(
+        "serving-p99-breach",
+        "worst per-tenant serving p99 (seconds, windowed per tick over "
+        "queue+execute phases) breached the latency SLO",
+        _serving_p99_breach,
+        warn=0.5, critical=2.0, fire_after=2, clear_after=2,
+        actuation="alert",
+    ),
+    Rule(
+        "tenant-saturation",
+        "a tenant's shed fraction of offered requests since the last "
+        "tick (sustained quota breach, judged above a per-tick volume "
+        "floor)",
+        _tenant_saturation,
+        warn=0.25, critical=0.75, fire_after=2, clear_after=2,
         actuation="alert",
     ),
 )

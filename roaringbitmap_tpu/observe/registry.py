@@ -111,6 +111,21 @@ FUSION_STEPS_TOTAL = "rb_tpu_fusion_steps_total"
 FUSION_BATCH_SECONDS = "rb_tpu_fusion_batch_seconds"
 FUSION_QUEUED_COUNT = "rb_tpu_fusion_queued_count"
 QUERY_INFLIGHT_TOTAL = "rb_tpu_query_inflight_total"
+# serving tier (ISSUE 14): per-tenant request latency by phase
+# (queue = admission wall incl. any backpressure wait, execute = query
+# execution), rolling per-tenant QPS, admission verdicts, live queue
+# depth / in-flight gauges, per-tenant token-bucket saturation, and the
+# per-tenant byte share of the resident PACK_CACHE working sets. Tenant
+# label VALUES come from the bounded declared tenant registry
+# (serve/slo.py TENANTS — the metric-naming rule enforces it)
+SERVE_LATENCY_SECONDS = "rb_tpu_serve_latency_seconds"
+SERVE_QPS = "rb_tpu_serve_qps"
+SERVE_ADMIT_TOTAL = "rb_tpu_serve_admit_total"
+SERVE_REQUESTS_TOTAL = "rb_tpu_serve_requests_total"
+SERVE_QUEUE_COUNT = "rb_tpu_serve_queue_count"
+SERVE_INFLIGHT_COUNT = "rb_tpu_serve_inflight_count"
+SERVE_SATURATION_RATIO = "rb_tpu_serve_saturation_ratio"
+SERVE_TENANT_BYTES = "rb_tpu_serve_tenant_bytes"
 
 # upper bucket bounds (seconds) for wall-time histograms: host phases span
 # ~100 µs packing steps to multi-second CPU folds; +Inf is implicit

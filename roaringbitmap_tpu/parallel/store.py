@@ -1847,6 +1847,36 @@ class PackCache:
         with self._lock:
             return key in self._entries
 
+    def resident_bytes_for(self, fps) -> int:
+        """Resident bytes attributable to a working set: the sum of
+        entry bytes whose key (or recorded fingerprint tuple) embeds any
+        of the given leaf ``fingerprint()`` tuples. An entry serving
+        several overlapping working sets is charged to each caller — the
+        serving tier's per-tenant byte-share accounting (ISSUE 14) wants
+        shares, not a partition, so the shares may sum past the resident
+        total by design."""
+        want = set(fps)
+        if not want:
+            return 0
+        total = 0
+        with self._lock:
+            for e in self._entries.values():
+                efps = e.fps
+                if efps and any(fp in want for fp in efps):
+                    total += e.nbytes
+                    continue
+                hit = False
+                for el in e.key:
+                    if el in want:
+                        hit = True
+                        break
+                    if isinstance(el, tuple) and any(fp in want for fp in el):
+                        hit = True
+                        break
+                if hit:
+                    total += e.nbytes
+        return total
+
     # -- internals ---------------------------------------------------------
 
     def _store(self, entry: _PackEntry, ident: Optional[tuple] = None) -> _PackEntry:

@@ -54,6 +54,7 @@ SITES: Tuple[str, ...] = (
     "ops.dispatch",      # device reduce dispatch (store run closures, ops/)
     "query.exec",        # query executor device-engine step dispatch
     "query.fusion",      # fused micro-batch execution (query/fusion.py)
+    "serve.admit",       # serving-tier admission verdict (serve/admission.py)
     "columnar.kernel",   # columnar native batch-kernel entry (kernels.py)
     "columnar.device",   # columnar device-tier entry (columnar/device.py)
     "native.entry",      # native C tier entry probe (native/__init__.py)

@@ -1,0 +1,54 @@
+"""Serving tier (ISSUE 14): multi-tenant load harness, per-tenant SLO
+telemetry, and priced admission control over the fused query path.
+
+The three legs (see each module's docstring):
+
+* ``harness.py`` — the multi-threaded load generator: seeded
+  multi-tenant request schedules with overlapping predicates over a
+  shared corpus, every request under its own trace scope, driven
+  through admission into the :class:`~roaringbitmap_tpu.query.FusionExecutor`;
+* ``slo.py`` — the bounded declared tenant registry and the per-tenant
+  labeled telemetry (``rb_tpu_serve_latency_seconds{tenant, phase}``
+  p50/p99, rolling QPS gauges, saturation, PACK_CACHE byte shares);
+* ``admission.py`` — token-bucket per-tenant quotas + a global
+  in-flight cap with shed-or-queue backpressure, every verdict priced
+  at the ``serve.admit`` decision site and scored by the
+  decision–outcome ledger (the sixth cost authority,
+  ``cost/admission.py``).
+
+The health sentinel's ``serving-p99-breach`` and ``tenant-saturation``
+rules (observe/health.py) watch the telemetry this tier emits — the
+serving-shaped signals the ISSUE-12 closure note promised.
+"""
+
+from .admission import CONTROLLER, AdmissionController, ShedRejection, Ticket
+from .harness import (
+    HarnessReport,
+    LoadHarness,
+    Request,
+    TenantProfile,
+    TenantStats,
+    build_requests,
+    default_mix,
+)
+from .slo import TENANTS, TenantRegistry
+from . import admission, harness, slo
+
+__all__ = [
+    "AdmissionController",
+    "CONTROLLER",
+    "HarnessReport",
+    "LoadHarness",
+    "Request",
+    "ShedRejection",
+    "TENANTS",
+    "TenantProfile",
+    "TenantRegistry",
+    "TenantStats",
+    "Ticket",
+    "admission",
+    "build_requests",
+    "default_mix",
+    "harness",
+    "slo",
+]

@@ -1,0 +1,349 @@
+"""Multi-tenant serving load harness (ISSUE 14 tentpole, leg 1).
+
+Everything before this PR measured the pipeline one caller at a time;
+the observability stack (trace ids, decision/outcome ledgers, the
+sentinel, the fusion window) was built for *concurrent* traffic that did
+not exist. This module generates it: a multi-threaded load harness
+driving the fused query path over a shared corpus with a seeded
+multi-tenant workload mix.
+
+* **Workload** — :func:`build_requests` derives, from one seed, a
+  deterministic request schedule over declared tenant profiles: each
+  tenant gets a query mix over the shared corpus with *overlapping
+  predicates* (a hot shared conjunction rides under every tenant's
+  distinct predicates — ONE hash-consed node across tenants, which is
+  exactly what the fusion window dedups across concurrent submitters).
+  The same seed always produces the same query multiset, which is what
+  makes the concurrent-vs-serial differential (fuzz family 28) and the
+  bench's bit-exactness assertion possible.
+
+* **Drive** — :meth:`LoadHarness.run` executes the schedule on
+  ``threads`` worker threads (closed-loop by default; ``target_qps``
+  paces an open-loop schedule instead). Every request runs under its own
+  ``trace_scope`` — admission decisions, SLO instants, and the serve
+  spans all carry the request's trace id, so per-trace attribution
+  stays 100 % under contention (the bench asserts it) — and passes
+  admission (``serve.admit`` priced verdict) before submitting to the
+  shared :class:`~roaringbitmap_tpu.query.FusionExecutor` (or the plain
+  executor with ``use_fusion=False``).
+
+* **Account** — phase latencies land in
+  ``rb_tpu_serve_latency_seconds{tenant, phase}`` (queue = admission
+  wall incl. backpressure, execute = query execution), outcomes in the
+  request counter, rolling QPS in the per-tenant gauge, and each
+  tenant's PACK_CACHE byte share in ``rb_tpu_serve_tenant_bytes`` —
+  the signals the ``serving-p99-breach`` / ``tenant-saturation``
+  sentinel rules judge.
+
+A shed request yields a :class:`~.admission.ShedRejection` *in the
+result slot* — typed, inspectable, and never a bitmap — so the serial
+differential can assert "every served result is bit-exact and every
+unserved one is loudly a shed" (shed-never-loses-a-result).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..observe import context as _context
+from ..observe import timeline as _timeline
+from . import slo as _slo
+from .admission import CONTROLLER, AdmissionController, ShedRejection
+from .slo import TENANTS
+
+
+@dataclass(frozen=True)
+class TenantProfile:
+    """One tenant's declared traffic shape: its share of the request mix
+    (``weight``), its admission quota, and its query profile over the
+    shared corpus (``mix`` draws one expression from a seeded rng)."""
+
+    name: str
+    weight: float = 1.0
+    quota_qps: float = 1000.0
+    burst: Optional[float] = None
+    mix: Optional[Callable] = None  # (rng, corpus, shared) -> Expr
+
+
+@dataclass
+class Request:
+    """One scheduled request (the multiset element the serial oracle
+    replays)."""
+
+    idx: int
+    tenant: str
+    expr: object
+    start_s: Optional[float] = None  # open-loop schedule offset
+
+
+@dataclass
+class TenantStats:
+    served: int = 0
+    shed: int = 0
+    queued: int = 0
+    queue_s: List[float] = field(default_factory=list)
+    execute_s: List[float] = field(default_factory=list)
+
+    def quantile_ms(self, phase: str, q: float) -> Optional[float]:
+        vals = sorted(self.queue_s if phase == "queue" else self.execute_s)
+        if not vals:
+            return None
+        i = min(len(vals) - 1, int(q * len(vals)))
+        return round(vals[i] * 1e3, 3)
+
+
+def default_mix(rng, corpus, shared):
+    """The serving-shaped default query profile: the hot shared
+    conjunction under this draw's own predicates (the overlap the fusion
+    window exists to exploit), occasionally a pure own-predicate scan."""
+    from ..query import Q
+
+    a = Q.leaf(corpus[int(rng.integers(0, len(corpus)))])
+    b = Q.leaf(corpus[int(rng.integers(0, len(corpus)))])
+    kind = int(rng.integers(0, 4))
+    if kind == 0:
+        return shared | a
+    if kind == 1:
+        return (shared | a) - b
+    if kind == 2:
+        return shared | (a & b)
+    return a | b
+
+
+def build_requests(
+    corpus: Sequence,
+    profiles: Sequence[TenantProfile],
+    n_requests: int,
+    seed: int = 0,
+    target_qps: Optional[float] = None,
+) -> List[Request]:
+    """The deterministic request schedule: tenants drawn by weight, each
+    tenant's queries from its own seeded stream (so two tenants never
+    share an rng and the multiset is reproducible per seed), the shared
+    hot conjunction built from the corpus head. ``target_qps`` stamps
+    open-loop start offsets; None leaves the schedule closed-loop."""
+    from ..query import Q
+
+    if len(corpus) < 4:
+        raise ValueError(f"serving corpus needs >= 4 bitmaps, got {len(corpus)}")
+    if not profiles:
+        raise ValueError("at least one tenant profile is required")
+    shared = Q.leaf(corpus[0]) & Q.leaf(corpus[1])
+    weights = np.asarray([max(1e-9, p.weight) for p in profiles], dtype=np.float64)
+    weights /= weights.sum()
+    pick_rng = np.random.default_rng(seed)
+    tenant_rngs = {
+        p.name: np.random.default_rng((seed << 8) ^ zlib_crc(p.name))
+        for p in profiles
+    }
+    out: List[Request] = []
+    for i in range(int(n_requests)):
+        p = profiles[int(pick_rng.choice(len(profiles), p=weights))]
+        mix = p.mix or default_mix
+        expr = mix(tenant_rngs[p.name], corpus, shared)
+        start = (i / target_qps) if target_qps else None
+        out.append(Request(idx=i, tenant=p.name, expr=expr, start_s=start))
+    return out
+
+
+def zlib_crc(s: str) -> int:
+    import zlib
+
+    return zlib.crc32(s.encode())
+
+
+class LoadHarness:
+    """The serving-tier load generator. Construct with the shared corpus
+    and tenant profiles (declared into the tenant registry), then
+    :meth:`run` a request schedule across worker threads."""
+
+    def __init__(
+        self,
+        corpus: Sequence,
+        profiles: Sequence[TenantProfile],
+        threads: int = 4,
+        use_fusion: bool = True,
+        window: Optional[int] = None,
+        max_wait_ms: Optional[float] = None,
+        admission: Optional[AdmissionController] = None,
+        cache_entries: int = 256,
+    ):
+        if threads < 1:
+            raise ValueError(f"threads must be >= 1, got {threads}")
+        self.corpus = list(corpus)
+        self.profiles = list(profiles)
+        self.threads = int(threads)
+        self.use_fusion = bool(use_fusion)
+        self.window = window
+        self.max_wait_ms = max_wait_ms
+        self.admission = admission if admission is not None else CONTROLLER
+        self.cache_entries = int(cache_entries)
+        for p in self.profiles:
+            TENANTS.declare(p.name, quota_qps=p.quota_qps, burst=p.burst)
+
+    # -- the drive -----------------------------------------------------------
+
+    def run(self, requests: Sequence[Request]) -> "HarnessReport":
+        """Execute the schedule: ``threads`` workers pull requests from a
+        shared cursor (contention by construction), each request under
+        its own trace scope through admission -> fused execution -> SLO
+        accounting. Returns the report with per-request results (bitmap
+        or ShedRejection) and per-tenant stats."""
+        from ..query import FusionExecutor, ResultCache
+        from ..query import exec as _exec
+
+        requests = list(requests)
+        # results are POSITIONAL in the schedule as passed (so any
+        # sub-slice of a built schedule lines up with its own serial
+        # oracle), not keyed by Request.idx
+        results: List[object] = [None] * len(requests)
+        stats: Dict[str, TenantStats] = {p.name: TenantStats() for p in self.profiles}
+        stats_lock = threading.Lock()  # leaf: guards the stats dict only
+        cursor = {"i": 0}
+        cursor_lock = threading.Lock()  # leaf: guards the cursor only
+        errors: List[BaseException] = []
+        cache = ResultCache(max_entries=self.cache_entries)
+        executor = (
+            FusionExecutor(
+                window=self.window, max_wait_ms=self.max_wait_ms, cache=cache
+            )
+            if self.use_fusion
+            else None
+        )
+        t_open = time.perf_counter()
+
+        def _next() -> Optional[tuple]:
+            with cursor_lock:
+                i = cursor["i"]
+                if i >= len(requests):
+                    return None
+                cursor["i"] = i + 1
+            return i, requests[i]
+
+        def _serve_one(pos: int, req: Request) -> None:
+            with _context.trace_scope():
+                if req.start_s is not None:  # open-loop pacing
+                    delay = (t_open + req.start_s) - time.perf_counter()
+                    if delay > 0:
+                        time.sleep(delay)
+                with _timeline.tspan(
+                    "serve.request", "serve", tenant=req.tenant, idx=req.idx,
+                ):
+                    t0 = time.perf_counter()
+                    ticket = self.admission.admit(req.tenant)
+                    queue_s = time.perf_counter() - t0
+                    if not ticket.admitted:
+                        results[pos] = ShedRejection(req.tenant, "admission")
+                        _slo.record(req.tenant, "shed", queue_s=queue_s)
+                        with stats_lock:
+                            stats[req.tenant].shed += 1
+                        return
+                    try:
+                        t1 = time.perf_counter()
+                        if executor is not None:
+                            out = executor.submit(req.expr).result()
+                        else:
+                            out = _exec.execute(req.expr, cache=cache)
+                        execute_s = time.perf_counter() - t1
+                    except Exception:
+                        _slo.record(req.tenant, "error", queue_s=queue_s)
+                        raise
+                    finally:
+                        ticket.release()
+                    results[pos] = out
+                    _slo.record(
+                        req.tenant, "ok", queue_s=queue_s, execute_s=execute_s
+                    )
+                    with stats_lock:
+                        st = stats[req.tenant]
+                        st.served += 1
+                        st.queue_s.append(queue_s)
+                        st.execute_s.append(execute_s)
+                        if ticket.verdict == "queue":
+                            st.queued += 1
+
+        def _worker() -> None:
+            while True:
+                nxt = _next()
+                if nxt is None:
+                    return
+                try:
+                    _serve_one(*nxt)
+                except BaseException as e:  # rb-ok: exception-hygiene -- a worker must drain the schedule and surface EVERY failure to the caller afterwards; swallowing one would silently shrink the served multiset the differential checks
+                    with stats_lock:
+                        errors.append(e)
+
+        workers = [
+            threading.Thread(target=_worker, name=f"rb-serve-{i}", daemon=True)
+            for i in range(self.threads)
+        ]
+        t0 = time.perf_counter()
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+        wall_s = time.perf_counter() - t0
+        if executor is not None:
+            executor.close()
+        if errors:
+            raise errors[0]
+        # per-tenant PACK_CACHE byte share: the tenant's reachable corpus
+        # is the whole shared corpus under the default mixes — charge the
+        # resident entries its leaves appear in
+        for p in self.profiles:
+            _slo.note_tenant_bytes(p.name, self.corpus)
+        return HarnessReport(requests, results, stats, wall_s)
+
+    def run_serial(self, requests: Sequence[Request]) -> List[object]:
+        """The serial oracle: the same query multiset, one at a time, no
+        admission, no fusion, no shared cache — what the concurrent run
+        must be bit-exact against (fuzz family 28 / the bench gate)."""
+        from ..query import exec as _exec
+
+        return [_exec.execute(r.expr, cache=None) for r in requests]
+
+
+class HarnessReport:
+    """One run's outcome: per-request results aligned with the schedule,
+    per-tenant stats, and the aggregate wall."""
+
+    def __init__(self, requests, results, stats, wall_s):
+        self.requests = requests
+        self.results = results
+        self.stats = stats
+        self.wall_s = wall_s
+
+    @property
+    def served(self) -> int:
+        return sum(st.served for st in self.stats.values())
+
+    @property
+    def shed(self) -> int:
+        return sum(st.shed for st in self.stats.values())
+
+    def aggregate_qps(self) -> float:
+        return round(self.served / self.wall_s, 1) if self.wall_s > 0 else 0.0
+
+    def tenant_rows(self) -> Dict[str, dict]:
+        """Per-tenant decomposition: served/shed/queued volume, achieved
+        QPS, and harness-side p50/p99 per phase (the registry histograms
+        carry the same answer — tests pin the two within one bucket
+        ratio)."""
+        out = {}
+        for tenant, st in sorted(self.stats.items()):
+            out[tenant] = {
+                "served": st.served,
+                "shed": st.shed,
+                "queued": st.queued,
+                "qps": round(st.served / self.wall_s, 1) if self.wall_s else 0.0,
+                "queue_p50_ms": st.quantile_ms("queue", 0.5),
+                "queue_p99_ms": st.quantile_ms("queue", 0.99),
+                "execute_p50_ms": st.quantile_ms("execute", 0.5),
+                "execute_p99_ms": st.quantile_ms("execute", 0.99),
+            }
+        return out

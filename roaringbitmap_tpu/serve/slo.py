@@ -1,0 +1,302 @@
+"""Per-tenant serving-tier SLO telemetry (ISSUE 14 tentpole, leg 2).
+
+Every prior PR measured the pipeline from a single caller's perspective;
+a serving tier answers a different question — "what latency and
+throughput does each *tenant* see, and who is eating the machine" —
+which needs per-tenant labeled series on the existing registry/histogram
+substrate:
+
+* ``rb_tpu_serve_latency_seconds{tenant, phase}`` — log-bucketed
+  latency histograms (phase ``queue`` = the admission wall including any
+  backpressure wait, ``execute`` = query execution), answering
+  p50/p90/p99 per tenant straight from the registry snapshot;
+* ``rb_tpu_serve_qps{tenant}`` — rolling per-tenant throughput gauges
+  (sliding-window request rate, window ``QPS_WINDOW_S``);
+* ``rb_tpu_serve_requests_total{tenant, outcome}`` — request volume by
+  outcome (``ok`` | ``shed`` | ``error``);
+* ``rb_tpu_serve_queue_count`` / ``rb_tpu_serve_inflight_count`` — the
+  admission controller's live depth gauges (the saturation signals the
+  ISSUE-12/13 closure notes promised the sentinel);
+* ``rb_tpu_serve_saturation_ratio{tenant}`` — per-tenant token-bucket
+  depletion (0 = full budget available, 1 = quota exhausted);
+* ``rb_tpu_serve_tenant_bytes{tenant}`` — the tenant's byte share of
+  the resident PACK_CACHE working sets (entries serving several
+  tenants' overlapping working sets are charged to each — it is a
+  share, not a partition; see :func:`note_tenant_bytes`).
+
+**The bounded tenant registry.** Tenant label values are the classic
+unbounded-cardinality trap (every user id as a label value melts the
+scrape backend), so they come from :data:`TENANTS` — a capacity-bounded
+*declared* registry: ``TENANTS.declare(name, ...)`` registers a tenant
+(loudly failing past ``max_tenants``), and ``TENANTS[name]`` returns the
+canonical label value, raising ``KeyError`` for anything undeclared.
+Metric mutations throughout the serve tier spell tenant label values as
+``TENANTS[tenant]`` — the metric-naming analysis rule (ISSUE 14
+satellite) rejects a bare ``tenant`` variable in a label tuple exactly
+like a trace id, and accepts the declared-registry subscript.
+
+Off mode: ``configure(enabled=False)`` reduces :func:`record` and the
+gauge updates to one module-bool check (the bench's serving off-mode
+twin bounds the cost under the house <1 % budget).
+
+Lock discipline: the SLO lock is a LEAF — it guards only the tenant
+table and the per-tenant QPS rings; every metric bump happens outside
+it, so recording while holding other framework locks nests safely
+(tests/test_serve.py hammers this under the lock witness).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..observe import registry as _registry
+from ..observe.histogram import latency_histogram
+
+# rolling-QPS window: long enough to smooth a drained fusion window's
+# burstiness, short enough that the gauge tracks load shifts the sentinel
+# should see within a few ticks
+QPS_WINDOW_S = 5.0
+DEFAULT_MAX_TENANTS = 64
+
+# request phases and outcomes (declared label sets; the latency histogram
+# registers with labelnames ("tenant", "phase"))
+PHASES = ("queue", "execute")
+OUTCOMES = ("ok", "shed", "error")
+
+_LATENCY = latency_histogram(
+    _registry.SERVE_LATENCY_SECONDS,
+    "Serving-tier request latency by tenant and phase (queue = admission "
+    "wall incl. backpressure wait, execute = query execution)",
+    ("tenant", "phase"),
+)
+_QPS = _registry.gauge(
+    _registry.SERVE_QPS,
+    "Rolling per-tenant request throughput (sliding-window rate over "
+    "QPS_WINDOW_S seconds)",
+    ("tenant",),
+)
+_REQUESTS_TOTAL = _registry.counter(
+    _registry.SERVE_REQUESTS_TOTAL,
+    "Serving-tier requests by tenant and outcome (ok | shed | error)",
+    ("tenant", "outcome"),
+)
+_TENANT_BYTES = _registry.gauge(
+    _registry.SERVE_TENANT_BYTES,
+    "Per-tenant byte share of the resident PACK_CACHE working sets "
+    "(overlapping working sets charge every tenant that touches them)",
+    ("tenant",),
+)
+
+_ENABLED = True
+
+
+def configure(enabled: Optional[bool] = None) -> None:
+    """``enabled=False`` is the serving off-mode twin's kill switch:
+    :func:`record` and the gauge updates reduce to one bool check."""
+    global _ENABLED
+    if enabled is not None:
+        _ENABLED = bool(enabled)
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+class TenantRegistry:
+    """Capacity-bounded declared tenant set — the source of every tenant
+    metric label value. ``declare()`` past ``max_tenants`` raises (a
+    tenant set that grows without bound is the same cardinality bug as a
+    trace-id label, just slower); ``registry[name]`` canonicalizes a
+    tenant to its declared label value and raises ``KeyError`` for
+    anything undeclared, so a typo'd tenant can never mint a series."""
+
+    def __init__(self, max_tenants: int = DEFAULT_MAX_TENANTS):
+        if max_tenants < 1:
+            raise ValueError(f"max_tenants must be >= 1, got {max_tenants}")
+        self.max_tenants = int(max_tenants)
+        self._lock = threading.Lock()  # leaf: guards the tables below only
+        self._tenants: Dict[str, dict] = {}  # guarded-by: self._lock
+        # per-tenant completion-timestamp rings for the rolling QPS gauge
+        self._ticks: Dict[str, "deque[float]"] = {}  # guarded-by: self._lock
+
+    def declare(
+        self,
+        name: str,
+        quota_qps: float = 100.0,
+        burst: Optional[float] = None,
+    ) -> str:
+        """Register a tenant with its admission quota (token-bucket rate
+        ``quota_qps`` and ``burst`` capacity, default 2x the rate).
+        Idempotent for an identical name (the quota updates); loud past
+        capacity."""
+        name = str(name)
+        if not name:
+            raise ValueError("tenant name must be non-empty")
+        spec = {
+            "quota_qps": float(quota_qps),
+            "burst": float(burst) if burst is not None else 2.0 * float(quota_qps),
+        }
+        if spec["quota_qps"] <= 0 or spec["burst"] <= 0:
+            raise ValueError(f"tenant {name!r} quota/burst must be > 0: {spec}")
+        with self._lock:
+            if name not in self._tenants and len(self._tenants) >= self.max_tenants:
+                raise ValueError(
+                    f"tenant registry full ({self.max_tenants}): declaring "
+                    f"{name!r} would unbound the tenant label set"
+                )
+            self._tenants[name] = spec
+            self._ticks.setdefault(name, deque())
+        return name
+
+    def __getitem__(self, name: str) -> str:
+        """Canonical label value for a declared tenant (KeyError for
+        anything undeclared — the bounded-cardinality guarantee)."""
+        with self._lock:
+            if name not in self._tenants:
+                raise KeyError(
+                    f"undeclared tenant {name!r} (declared: {sorted(self._tenants)})"
+                )
+        return name
+
+    def __contains__(self, name: str) -> bool:
+        with self._lock:
+            return name in self._tenants
+
+    def quota(self, name: str) -> dict:
+        with self._lock:
+            spec = self._tenants.get(name)
+            if spec is None:
+                raise KeyError(f"undeclared tenant {name!r}")
+            return dict(spec)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._tenants)
+
+    def reset(self) -> None:
+        """Drop every declared tenant (tests, bench windows)."""
+        with self._lock:
+            self._tenants.clear()
+            self._ticks.clear()
+
+    # -- rolling QPS ---------------------------------------------------------
+
+    def _note_request(self, tenant: str, now: float) -> float:
+        """Append one completion tick and return the tenant's current
+        windowed rate (requests in the window / window seconds)."""
+        floor = now - QPS_WINDOW_S
+        with self._lock:
+            ring = self._ticks.get(tenant)
+            if ring is None:
+                raise KeyError(f"undeclared tenant {tenant!r}")
+            ring.append(now)
+            while ring and ring[0] < floor:
+                ring.popleft()
+            n = len(ring)
+        return n / QPS_WINDOW_S
+
+    def qps(self, tenant: str, now: Optional[float] = None) -> float:
+        """The tenant's current windowed request rate (reads only)."""
+        if now is None:
+            now = time.monotonic()
+        floor = now - QPS_WINDOW_S
+        with self._lock:
+            ring = self._ticks.get(tenant)
+            if ring is None:
+                raise KeyError(f"undeclared tenant {tenant!r}")
+            n = sum(1 for t in ring if t >= floor)
+        return n / QPS_WINDOW_S
+
+
+# The process-wide tenant registry (harness profiles, admission quotas,
+# and every serve-tier metric label value resolve through this).
+TENANTS = TenantRegistry()
+
+
+def record(
+    tenant: str,
+    outcome: str,
+    queue_s: Optional[float] = None,
+    execute_s: Optional[float] = None,
+    now: Optional[float] = None,
+) -> None:
+    """Record one served request: phase latencies into the per-tenant
+    histograms, the outcome counter, and the rolling QPS gauge. Metric
+    bumps happen outside the SLO lock (leaf discipline); disabled mode is
+    one bool check."""
+    if not _ENABLED:
+        return
+    if outcome not in OUTCOMES:
+        raise ValueError(f"unknown serve outcome {outcome!r} (known: {OUTCOMES})")
+    canon = TENANTS[tenant]
+    _REQUESTS_TOTAL.inc(1, (TENANTS[tenant], str(outcome)))
+    if outcome == "ok":
+        # the rolling-QPS gauge is served THROUGHPUT (the help text and
+        # the harness's served/wall rows agree on this); offered volume
+        # incl. sheds rides the requests counter above — a 100%-shed
+        # tenant must read ~0 qps in the serving panel, not healthy
+        rate = TENANTS._note_request(
+            canon, time.monotonic() if now is None else now
+        )
+        _QPS.set(round(rate, 3), (TENANTS[tenant],))
+    if queue_s is not None:
+        _LATENCY.observe(queue_s, (TENANTS[tenant], "queue"))
+    if execute_s is not None:
+        _LATENCY.observe(execute_s, (TENANTS[tenant], "execute"))
+
+
+def note_tenant_bytes(tenant: str, leaves: Iterable) -> int:
+    """Charge ``tenant`` with the resident PACK_CACHE bytes attributable
+    to its working set (the bitmaps its query profile touches): entries
+    whose key embeds any of the leaves' fingerprints. Returns the byte
+    share and exports it as ``rb_tpu_serve_tenant_bytes{tenant}``."""
+    if not _ENABLED:
+        return 0
+    from ..parallel import store as _store
+
+    fps = {bm.fingerprint() for bm in leaves}
+    share = _store.PACK_CACHE.resident_bytes_for(fps)
+    _TENANT_BYTES.set(int(share), (TENANTS[tenant],))
+    return int(share)
+
+
+def quantiles(tenant: str, phase: str) -> dict:
+    """p50/p90/p99 snapshot for one (tenant, phase) latency series —
+    the harness's cross-check against its own collected latencies."""
+    return _LATENCY.quantiles((TENANTS[tenant], str(phase)))
+
+
+def tenant_rows() -> Dict[str, dict]:
+    """Per-tenant rollup (the rb_top serving panel's rows): rolling QPS,
+    p50/p99 per phase, request outcomes, byte share."""
+    out: Dict[str, dict] = {}
+    req = _REQUESTS_TOTAL.series()
+    bytes_g = _TENANT_BYTES.series()
+    qps_g = _QPS.series()
+    for tenant in TENANTS.names():
+        row = {
+            "qps": qps_g.get((tenant,), 0.0),
+            "bytes": bytes_g.get((tenant,), 0),
+            "outcomes": {
+                lv[1]: v for lv, v in req.items() if lv[0] == tenant
+            },
+        }
+        for phase in PHASES:
+            st = _LATENCY.get((tenant, phase))
+            if st is not None:
+                row[phase] = {
+                    "count": st["count"],
+                    **_LATENCY.quantiles((tenant, phase)),
+                }
+        out[tenant] = row
+    return out
+
+
+def reset() -> None:
+    """Drop tenant declarations and QPS rings (tests, bench windows);
+    registry metric series reset via observe.reset like everything
+    else."""
+    TENANTS.reset()

@@ -113,6 +113,7 @@ def load_round(path: str) -> Optional[dict]:
     if isinstance(v, (int, float)) and v > 0:
         rows["value"] = float(v)
     noise = meta.get("host_noise")
+    host = meta.get("host")
     return {
         "round": rnd,
         "path": os.path.basename(path),
@@ -127,6 +128,11 @@ def load_round(path: str) -> Optional[dict]:
         # recorded per-row host-noise bands (ISSUE 11 satellite): absent
         # in pre-r13 artifacts, which keep the fixed 15% gate
         "host_noise": noise if isinstance(noise, dict) else {},
+        # host provenance (ISSUE 14 satellite): cpu_count / device kind
+        # recorded per round so the ROADMAP debt-(a) multi-core/TPU
+        # re-measure campaign compares like-for-like — absent in pre-r16
+        # artifacts, which stay comparable to everything on their triple
+        "host": host if isinstance(host, dict) else None,
     }
 
 
@@ -144,6 +150,28 @@ def load_series(root: str = REPO) -> List[dict]:
 
 def _triple(r: dict):
     return (r["backend"], r["dataset"], r["n_bitmaps"])
+
+
+def _host_key(r: dict):
+    """The comparability half of the recorded host provenance: CPU core
+    count and accelerator kind (ISSUE 14 satellite). None when the round
+    predates meta.host."""
+    h = r.get("host")
+    if not isinstance(h, dict):
+        return None
+    return (h.get("cpu_count"), h.get("device_kind"))
+
+
+def _comparable(a: dict, b: dict) -> bool:
+    """Rounds are comparable when their (backend, dataset, n_bitmaps)
+    triples match AND, when BOTH rounds record host provenance, their
+    host keys match too — a 1-core laptop round must not gate a 96-core
+    TPU-host round (or vice versa). Rounds without provenance (pre-r16)
+    stay comparable on the triple alone."""
+    if _triple(a) != _triple(b):
+        return False
+    ha, hb = _host_key(a), _host_key(b)
+    return ha is None or hb is None or ha == hb
 
 
 # a recorded band wider than this caps at it: a 10x rep spread means the
@@ -174,7 +202,7 @@ def find_regressions(rounds: List[dict], threshold: float = THRESHOLD) -> List[d
     if len(rounds) < 2:
         return []
     latest = rounds[-1]
-    priors = [r for r in rounds[:-1] if _triple(r) == _triple(latest)]
+    priors = [r for r in rounds[:-1] if _comparable(r, latest)]
     if not priors:
         return []
     out = []
@@ -288,7 +316,7 @@ def main(argv=None) -> int:
     else:
         print_trajectory(rounds)
         latest = rounds[-1]
-        priors = [r for r in rounds[:-1] if _triple(r) == _triple(latest)]
+        priors = [r for r in rounds[:-1] if _comparable(r, latest)]
         names = (
             ", ".join("r%02d" % r["round"] for r in priors)
             if priors

@@ -26,7 +26,7 @@ if [[ "${1:-}" != "--fast" ]]; then
   python -m pytest tests/ -q
 fi
 
-step "fuzz smoke (500 iterations x 27 invariant families)"
+step "fuzz smoke (500 iterations x 28 invariant families)"
 python -m roaringbitmap_tpu.fuzz 500 > /tmp/ci_fuzz.log 2>&1 \
   || { tail -20 /tmp/ci_fuzz.log; exit 1; }
 tail -1 /tmp/ci_fuzz.log
@@ -571,7 +571,7 @@ if h.get("cwd_clean") is not True or any(h.get("rules", {}).values()):
     raise SystemExit("end-of-bench rules firing / CWD dirty: %r" % h)
 need_rules = {"costmodel-drift", "routing-regret", "breaker-stuck-open",
               "outcome-anomaly-burst", "hbm-accounting-drift", "compile-storm",
-              "fusion-queue-stall"}
+              "fusion-queue-stall", "serving-p99-breach", "tenant-saturation"}
 if set(h.get("rules", {})) != need_rules:
     raise SystemExit("committed rule table changed: %r" % sorted(h.get("rules", {})))
 side = json.load(open("/tmp/ci_bench_metrics.json"))
@@ -625,9 +625,12 @@ if hd["rules"]["ci-forced-red"]["level"] != 2 or not hd["rules"]["ci-forced-red"
 cal = json.load(open(os.path.join(path, "calibration.json")))
 if set(cal.get("authorities", {})) != {"columnar-cutoff", "device-breakeven",
                                        "fusion-batch", "pack-residency",
-                                       "planner-cardinality"}:
-    raise SystemExit("bundle calibration.json lacks the five authorities: %r"
+                                       "planner-cardinality", "serve-admission"}:
+    raise SystemExit("bundle calibration.json lacks the six authorities: %r"
                      % sorted(cal.get("authorities", {})))
+obs = json.load(open(os.path.join(path, "observatory.json")))
+if "serving" not in obs:
+    raise SystemExit("bundle observatory.json lacks the serving panel")
 new_cwd = sorted(set(os.listdir(".")) - cwd_before)
 if new_cwd:
     raise SystemExit("forced red tick wrote into the CWD: %r" % new_cwd)
@@ -767,7 +770,132 @@ if "query.fusion" not in faults.SITES:
     raise SystemExit("query.fusion fault site not registered")
 print("fusion metric names ok (suffixes + declared label sets; fault site registered)")'
 
-step "rb_top observatory report (schema rb_tpu_top/4, ISSUE 9 + 11 + 12 + 13)"
+step "serving tier: SLO rows, overload demo, admission curve, trace attribution (ISSUE 14)"
+# the bench must commit meta.serving: per-tenant p50/p99 + aggregate QPS
+# at >=2 concurrency levels over >=2 tenants (bit-exact vs the serial
+# oracle), 100% per-trace attribution under contention, the serve.admit
+# site joined with regret <=5%, per-tenant PACK_CACHE byte shares, the
+# off-mode twin in budget, the seeded-overload sentinel demo
+# (tenant-saturation fires red -> bundle carries the serving panel ->
+# clears green), and the fairness row; the metrics sidecar must carry
+# the registry-derived serving block
+python -c '
+import json
+m = json.load(open("/tmp/ci_bench.json"))["meta"]
+sv = m.get("serving")
+if not isinstance(sv, dict):
+    raise SystemExit("bench meta lacks the serving block")
+need = {"host", "tenants", "levels", "bitexact", "trace_attribution_pct",
+        "admission", "byte_share", "off_overhead_pct", "off_delta_s",
+        "overload", "fairness"}
+missing = need - set(sv)
+if missing:
+    raise SystemExit("serving block lacks %s" % sorted(missing))
+if len(sv["tenants"]) < 2:
+    raise SystemExit("serving rows cover fewer than 2 tenants: %r" % sv["tenants"])
+if len(sv["levels"]) < 2:
+    raise SystemExit("serving rows cover fewer than 2 concurrency levels")
+for name, lvl in sv["levels"].items():
+    if not lvl.get("aggregate_qps", 0) > 0:
+        raise SystemExit("serving level %s has no aggregate QPS: %r" % (name, lvl))
+    active = [t for t, r in lvl["per_tenant"].items() if r["served"] > 0]
+    if len(active) < 2:
+        raise SystemExit("serving level %s served fewer than 2 tenants" % name)
+    for t in active:
+        r = lvl["per_tenant"][t]
+        if not (r.get("execute_p50_ms", 0) > 0 and r.get("execute_p99_ms", 0) > 0
+                and r["execute_p99_ms"] >= r["execute_p50_ms"]):
+            raise SystemExit("serving level %s tenant %s p50/p99 malformed: %r"
+                             % (name, t, r))
+if sv["bitexact"] is not True:
+    raise SystemExit("serving results were not asserted bit-exact vs serial")
+if sv["trace_attribution_pct"] != 100.0:
+    raise SystemExit("serving trace attribution only %s%%" % sv["trace_attribution_pct"])
+adm = sv["admission"]
+if not adm.get("joins", 0) > 0:
+    raise SystemExit("no serve.admit outcomes joined: %r" % adm)
+if not (0.0 <= adm.get("regret", 1) <= 0.05):
+    raise SystemExit("serve.admit regret %s blew the 5%% budget" % adm.get("regret"))
+if adm.get("refit", {}).get("provenance") != "refit-from-traffic":
+    raise SystemExit("admission curve never refit from traffic: %r" % adm)
+if not all(v > 0 for v in sv["byte_share"].values()):
+    raise SystemExit("tenant byte shares missing: %r" % sv["byte_share"])
+if not (sv["off_overhead_pct"] < 1.0 or sv["off_delta_s"] < 0.005):
+    raise SystemExit("serving off-mode twin %s%% (%ss) over budget"
+                     % (sv["off_overhead_pct"], sv["off_delta_s"]))
+ov = sv["overload"]
+if ov.get("rule") != "tenant-saturation" or not ov.get("shed", 0) > 0:
+    raise SystemExit("overload demo did not shed via tenant-saturation: %r" % ov)
+if ov.get("status_end") != "green":
+    raise SystemExit("overload demo did not clear green: %r" % ov.get("status_end"))
+if not (ov.get("bundle", {}).get("serving_panel") is True
+        and ov["bundle"].get("files", 0) >= 7):
+    raise SystemExit("overload red bundle missing the serving panel: %r" % ov.get("bundle"))
+fair = sv["fairness"]
+if fair.get("starved") is not False or not fair.get("shed", 0) > 0:
+    raise SystemExit("fairness row vacuous/starved: %r" % fair)
+if not (1.2 <= fair.get("served_ratio", 0) <= 3.4):
+    raise SystemExit("served ratio %s strayed from the quota ratio" % fair.get("served_ratio"))
+side = json.load(open("/tmp/ci_bench_metrics.json"))
+ssv = side.get("serving")
+if not isinstance(ssv, dict):
+    raise SystemExit("metrics sidecar lacks the serving block")
+smissing = {"tenants", "admit", "requests", "queue_depth", "inflight"} - set(ssv)
+if smissing:
+    raise SystemExit("sidecar serving block lacks %s" % sorted(smissing))
+if not ssv["tenants"]:
+    raise SystemExit("sidecar serving block records no tenants")
+for t, row in ssv["tenants"].items():
+    lat = row.get("latency") or {}
+    if "execute" in lat and not lat["execute"].get("p99", 0) > 0:
+        raise SystemExit("sidecar serving tenant %s lacks execute p99: %r" % (t, row))
+print("serving rows ok (%d tenants x %d levels, agg qps %s; admission joins %d "
+      "regret %s err %s; overload shed %d -> red tick %s -> green tick %s; "
+      "fairness %s vs quota 2.0)"
+      % (len(sv["tenants"]), len(sv["levels"]),
+         {k: v["aggregate_qps"] for k, v in sorted(sv["levels"].items())},
+         adm["joins"], adm["regret"], adm.get("error_ratio_geomean"),
+         ov["shed"], ov.get("ticks_to_red"), ov.get("ticks_to_green"),
+         fair["served_ratio"]))'
+# the new serving metric names must pass the naming convention with the
+# declared label sets, the serve.admit fault site must be registered,
+# and host provenance must be stamped into the twin blocks
+JAX_PLATFORMS=cpu python -c '
+import json
+from roaringbitmap_tpu import observe
+from roaringbitmap_tpu.robust import faults
+for name, suffix in ((observe.registry.SERVE_LATENCY_SECONDS, "_seconds"),
+                     (observe.registry.SERVE_QPS, "_qps"),
+                     (observe.registry.SERVE_ADMIT_TOTAL, "_total"),
+                     (observe.registry.SERVE_REQUESTS_TOTAL, "_total"),
+                     (observe.registry.SERVE_QUEUE_COUNT, "_count"),
+                     (observe.registry.SERVE_INFLIGHT_COUNT, "_count"),
+                     (observe.registry.SERVE_SATURATION_RATIO, "_ratio"),
+                     (observe.registry.SERVE_TENANT_BYTES, "_bytes")):
+    if not (name.startswith("rb_tpu_") and name.endswith(suffix)):
+        raise SystemExit("serving metric violates naming convention: %r" % name)
+import roaringbitmap_tpu.serve  # registers the serving metrics
+lat = observe.REGISTRY.get(observe.registry.SERVE_LATENCY_SECONDS)
+if lat is None or lat.labelnames != ("tenant", "phase"):
+    raise SystemExit("serve latency label set is not the declared (tenant, phase)")
+adm = observe.REGISTRY.get(observe.registry.SERVE_ADMIT_TOTAL)
+if adm is None or adm.labelnames != ("tenant", "verdict"):
+    raise SystemExit("serve admit label set is not the declared (tenant, verdict)")
+if "serve.admit" not in faults.SITES:
+    raise SystemExit("serve.admit fault site not registered")
+m = json.load(open("/tmp/ci_bench.json"))["meta"]
+host = m.get("host")
+need_host = {"cpu_count", "backend", "device_kind", "device_count"}
+if not (isinstance(host, dict) and need_host <= set(host)):
+    raise SystemExit("bench meta lacks host provenance: %r" % host)
+for block in ("columnar", "columnar_device", "overlap", "fusion", "serving",
+              "observability"):
+    if m.get(block, {}).get("host") != host:
+        raise SystemExit("twin block %s lacks the host provenance stamp" % block)
+print("serving metric names ok (suffixes + declared label sets; fault site "
+      "registered; host provenance stamped into %d twin blocks)" % 6)'
+
+step "rb_top observatory report (schema rb_tpu_top/5, ISSUE 9 + 11 + 12 + 13 + 14)"
 # the snapshot CLI must produce a schema-valid JSON report with every
 # panel populated from its in-process demo workload — incl. the regret
 # panel (per-site joins from the decision-outcome ledger), the health
@@ -779,14 +907,25 @@ JAX_PLATFORMS=cpu RB_TPU_ARTIFACT_DIR=/tmp/ci_artifacts \
 python -c '
 import json
 r = json.load(open("/tmp/ci_rb_top.json"))
-if r.get("schema") != "rb_tpu_top/4":
+if r.get("schema") != "rb_tpu_top/5":
     raise SystemExit("rb_top: bad schema %r" % r.get("schema"))
 need = {"schema", "generated_utc", "source", "counters", "latency",
         "locks", "breakers", "cache", "decisions_tail", "regret", "health",
-        "fusion"}
+        "fusion", "serving"}
 missing = need - set(r)
 if missing:
     raise SystemExit("rb_top report lacks %s" % sorted(missing))
+sv = r["serving"]
+if not sv.get("tenants"):
+    raise SystemExit("rb_top demo served no tenants: %r" % sv)
+for tenant, row in sv["tenants"].items():
+    ex = (row.get("latency") or {}).get("execute") or {}
+    if not (row.get("qps", 0) >= 0 and ex.get("count", 0) > 0 and ex.get("p99", 0) > 0):
+        raise SystemExit("rb_top serving row for %s lacks QPS/p99: %r" % (tenant, row))
+if not sv.get("admit"):
+    raise SystemExit("rb_top demo recorded no admission verdicts: %r" % sv)
+if not isinstance(sv.get("admission_live"), dict):
+    raise SystemExit("rb_top serving panel lacks live admission stats")
 fu = r["fusion"]
 if not fu.get("batches", {}).get("fused"):
     raise SystemExit("rb_top demo drained no fused window: %r" % fu)
@@ -817,9 +956,10 @@ for rule, st in h["rules"].items():
         raise SystemExit("rb_top health rule %s lacks thresholds: %r" % (rule, st))
 sites = {d["site"] for d in r["decisions_tail"]}
 print("rb_top ok (locks %s; %d decisions over sites %s; regret sites %s; "
-      "health %s over %d rules)"
+      "health %s over %d rules; serving tenants %s)"
       % (sorted(r["locks"]), len(r["decisions_tail"]), sorted(sites),
-         sorted(reg["sites"]), h["status_name"], len(h["rules"])))'
+         sorted(reg["sites"]), h["status_name"], len(h["rules"]),
+         sorted(sv["tenants"])))'
 # the sidecar-sourced rendering must parse the bench artifact too
 python scripts/rb_top.py --from /tmp/ci_bench_metrics.json --json > /dev/null
 

@@ -29,9 +29,14 @@ micro-batching executor's window occupancy, shared-subexpression hit
 ratio, in-flight dedup joins, and queue depth (batch regret rides the
 regret panel under the ``fusion.batch`` site).
 
-``--json`` emits the machine-readable report (schema ``rb_tpu_top/4``:
-the ``fusion`` key landed in /4, ``health`` in /3, ``regret`` in /2;
-scripts/ci.sh validates it). Breaker states, the decision log, the
+Since ISSUE 14 the report carries the **serving panel**: per-tenant
+QPS/p50/p99, queue depth and in-flight, shed counts, saturation, byte
+shares, and the admission curve's joined regret (which rides the regret
+panel under the ``serve.admit`` site).
+
+``--json`` emits the machine-readable report (schema ``rb_tpu_top/5``:
+the ``serving`` key landed in /5, ``fusion`` in /4, ``health`` in /3,
+``regret`` in /2; scripts/ci.sh validates it). Breaker states, the decision log, the
 outcome ledger, and sentinel rule states are process-local, so a
 sidecar-sourced report carries the sidecar's registry view of them
 (counter totals + the ``regret``/``health``/``fusion`` blocks derived in
@@ -49,7 +54,7 @@ import time
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
-SCHEMA = "rb_tpu_top/4"
+SCHEMA = "rb_tpu_top/5"
 
 
 def _live_report(tail: int) -> dict:
@@ -85,6 +90,9 @@ def _live_report(tail: int) -> dict:
         # cross-query fusion (ISSUE 13): window occupancy, dedup hit
         # ratio, in-flight joins, queue depth
         "fusion": insights.fusion_counters(),
+        # serving tier (ISSUE 14): per-tenant QPS/p50/p99, admission
+        # verdicts, queue/in-flight depth, saturation, byte shares
+        "serving": insights.serving(),
     }
 
 
@@ -135,6 +143,8 @@ def _sidecar_report(path: str, tail: int) -> dict:
         "health": side.get("health", {}),
         # the sidecar's registry-derived fusion block (export.py)
         "fusion": side.get("fusion", {}),
+        # the sidecar's registry-derived serving block (export.py)
+        "serving": side.get("serving", {}),
     }
 
 
@@ -172,6 +182,16 @@ def _demo_workload() -> None:
     bms[0].add((hb << 16) | 4242)
     store.packed_for(bms)
     store.hbm_reconciliation()
+    # a tiny serving window so the serving panel reports real tenants
+    # (two profiles, admission + SLO accounting through the harness)
+    from roaringbitmap_tpu.serve import LoadHarness, TenantProfile, build_requests
+
+    profiles = [
+        TenantProfile("demo-gold", weight=2.0, quota_qps=500),
+        TenantProfile("demo-bronze", weight=1.0, quota_qps=250),
+    ]
+    harness = LoadHarness(bms, profiles, threads=2, window=4)
+    harness.run(build_requests(bms, profiles, 12, seed=11))
     # a couple of sentinel ticks so the health panel reports a judged
     # status (hysteresis needs consecutive evaluations), not "never ran"
     from roaringbitmap_tpu.observe import sentinel
@@ -325,6 +345,33 @@ def _render_console(r: dict) -> str:
     if f.get("queue_depth") is not None:
         f_rows.append(("queue depth", f["queue_depth"]))
     section("fusion (cross-query micro-batching)", f_rows)
+    # serving panel (ISSUE 14): per-tenant QPS/p50/p99, admission
+    # verdicts, queue/in-flight depth, saturation, byte shares
+    sv = r.get("serving", {}) or {}
+    sv_rows = []
+    for tenant, row in sorted((sv.get("tenants") or {}).items()):
+        lat = row.get("latency") or {}
+        ex = lat.get("execute") or {}
+        qu = lat.get("queue") or {}
+        sv_rows.append(
+            (tenant,
+             f"qps={row.get('qps')} exec p50={ex.get('p50')} "
+             f"p99={ex.get('p99')} queue p99={qu.get('p99')} "
+             f"sat={row.get('saturation')} bytes={row.get('bytes')}")
+        )
+    for key, v in sorted((sv.get("admit") or {}).items()):
+        sv_rows.append((f"admit[{key}]", v))
+    if sv.get("queue_depth") is not None:
+        sv_rows.append(("queue depth", sv["queue_depth"]))
+    if sv.get("inflight") is not None:
+        sv_rows.append(("in-flight", sv["inflight"]))
+    live_adm = sv.get("admission_live")
+    if isinstance(live_adm, dict):
+        sv_rows.append(
+            ("admission", f"inflight {live_adm.get('inflight')}/"
+             f"{live_adm.get('max_inflight')} queued {live_adm.get('queued')}")
+        )
+    section("serving (per-tenant SLO)", sv_rows)
     dec_rows = [
         (d.get("trace") or "-",
          f"{d['site']}: {d['decision']} {d.get('inputs', '')}")

@@ -983,6 +983,47 @@ def test_metric_label_values_pragma_suppresses(tmp_path):
     assert res.findings == []
 
 
+TENANT_LABEL_SRC = """from roaringbitmap_tpu import observe
+_SV_TOTAL = observe.counter("rb_tpu_sv_total", "", ("tenant", "phase"))
+_SV_SECONDS = observe.latency_histogram(
+    "rb_tpu_sv_seconds", "", ("tenant", "phase"))
+TENANTS = object()
+def record(tenant, phase, tenant_name):
+    _SV_TOTAL.inc(1, (TENANTS[tenant], phase))
+    _SV_SECONDS.observe(0.1, (TENANTS[tenant], "queue"))
+    _SV_TOTAL.inc(1, (tenant, phase))
+    _SV_SECONDS.observe(0.1, (tenant_name, "execute"))
+"""
+
+
+def test_metric_label_values_tenant_needs_declared_registry(tmp_path):
+    # ISSUE 14 satellite: per-tenant label VALUES must come from the
+    # bounded declared tenant registry — the {tenant, phase} LABEL SETS
+    # register fine (lines 2-4), the TENANTS[tenant] subscript spelling
+    # passes (lines 7-8, the declared-collection escape), and the bare
+    # tenant / tenant_name variables are flagged with the
+    # registry-pointing message (lines 9-10)
+    res = _run_snippet(tmp_path, TENANT_LABEL_SRC, rules=["metric-naming"])
+    assert {f.line for f in res.findings} == {9, 10}
+    assert all("tenant registry" in f.message for f in res.findings)
+
+
+def test_live_serve_tree_is_clean_under_tenant_rule():
+    # the serving tier itself must pass the tenant discipline it
+    # motivated: every mutation spells tenant values as TENANTS[...]
+    import roaringbitmap_tpu.serve.admission as sadm
+    import roaringbitmap_tpu.serve.harness as sharn
+    import roaringbitmap_tpu.serve.slo as sslo
+
+    from roaringbitmap_tpu.analysis import run_checks
+
+    res = run_checks(
+        [sslo.__file__, sadm.__file__, sharn.__file__],
+        rules=["metric-naming"],
+    )
+    assert [f for f in res.findings] == []
+
+
 def test_live_tree_has_no_unbounded_label_values():
     # the rule runs over the real package in test_live_tree_is_clean-style
     # gates elsewhere; pin here that the columnar fold labels (the one

@@ -413,14 +413,14 @@ def test_seeded_drift_auto_refit_e2e(tmp_path, monkeypatch):
 
 
 # ---------------------------------------------------------------------------
-# cost facade: four authorities, one protocol, one state lifecycle
+# cost facade: six authorities, one protocol, one state lifecycle
 # ---------------------------------------------------------------------------
 
 
 def test_cost_facade_registers_all_authorities():
     assert cost.names() == [
         "columnar-cutoff", "device-breakeven", "fusion-batch",
-        "pack-residency", "planner-cardinality",
+        "pack-residency", "planner-cardinality", "serve-admission",
     ]
     state = cost.calibration_state()
     assert state["schema"] == cost.STATE_SCHEMA

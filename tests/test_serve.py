@@ -1,0 +1,468 @@
+"""Serving tier tests (ISSUE 14): bounded tenant registry, admission
+determinism under a fake clock, shed-never-loses-a-result semantics,
+the 16-thread hammer with the lock witness proving the serve
+queue/quota locks are leaves, p99-from-histogram vs the numpy
+percentile oracle under concurrent load, the serving sentinel rules,
+per-tenant byte-share accounting, and the concurrent-vs-serial
+differential (fuzz family 28 seed pin)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import observe
+from roaringbitmap_tpu.analysis.lockwitness import LockWitness
+from roaringbitmap_tpu.models.roaring import RoaringBitmap
+from roaringbitmap_tpu.observe import health, outcomes
+from roaringbitmap_tpu.observe import timeline as tl
+from roaringbitmap_tpu.parallel import store
+from roaringbitmap_tpu.robust import faults
+from roaringbitmap_tpu.robust.errors import TransientDeviceError
+from roaringbitmap_tpu.serve import (
+    AdmissionController,
+    LoadHarness,
+    ShedRejection,
+    TenantProfile,
+    build_requests,
+)
+from roaringbitmap_tpu.serve import admission as adm_mod
+from roaringbitmap_tpu.serve import slo
+from roaringbitmap_tpu.cost import admission as admission_cost
+
+
+@pytest.fixture(autouse=True)
+def _serve_state():
+    """Every test starts from a clean tenant registry / admission /
+    ledger state and leaves none behind."""
+    slo.reset()
+    adm_mod.CONTROLLER.reset()
+    outcomes.reset()
+    admission_cost.MODEL.reset()
+    yield
+    slo.reset()
+    adm_mod.CONTROLLER.reset()
+    outcomes.reset()
+    admission_cost.MODEL.reset()
+
+
+def _corpus(n=6, seed=3):
+    rng = np.random.default_rng(seed)
+    return [
+        RoaringBitmap(
+            np.sort(rng.choice(1 << 18, 1200, replace=False)).astype(np.uint32)
+        )
+        for _ in range(n)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# bounded declared tenant registry
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_registry_is_bounded_and_declared():
+    reg = slo.TenantRegistry(max_tenants=2)
+    reg.declare("a", quota_qps=10)
+    reg.declare("b", quota_qps=10)
+    assert reg["a"] == "a" and "b" in reg
+    with pytest.raises(KeyError):
+        reg["undeclared"]
+    with pytest.raises(ValueError):
+        reg.declare("c", quota_qps=10)  # capacity: the cardinality bound
+    # idempotent re-declaration updates the quota, no new slot
+    reg.declare("a", quota_qps=99)
+    assert reg.quota("a")["quota_qps"] == 99
+
+
+def test_record_rejects_undeclared_tenant_and_unknown_outcome():
+    slo.TENANTS.declare("t-known", quota_qps=10)
+    with pytest.raises(KeyError):
+        slo.record("t-unknown", "ok", execute_s=0.01)
+    with pytest.raises(ValueError):
+        slo.record("t-known", "not-an-outcome")
+    slo.record("t-known", "ok", queue_s=0.001, execute_s=0.01)
+    assert slo.quantiles("t-known", "execute")["p99"] > 0
+
+
+# ---------------------------------------------------------------------------
+# admission determinism under a fake clock
+# ---------------------------------------------------------------------------
+
+
+def _verdict_seq(controller, script):
+    out = []
+    for tenant, now in script:
+        t = controller.admit(tenant, now=now, wait=False)
+        out.append(t.verdict)
+        if t.admitted:
+            t.release()
+    return out
+
+
+def test_admission_deterministic_under_fake_clock():
+    slo.TENANTS.declare("det", quota_qps=2.0, burst=2.0)
+    script = [("det", 0.0)] * 4 + [("det", 1.0)] * 3 + [("det", 10.0)] * 3
+    a = AdmissionController(max_inflight=8, queue_limit=0, clock=lambda: 0.0)
+    b = AdmissionController(max_inflight=8, queue_limit=0, clock=lambda: 0.0)
+    va, vb = _verdict_seq(a, script), _verdict_seq(b, script)
+    assert va == vb, "same (tenant, now) script produced different verdicts"
+    # burst 2 at t=0: two admits then sheds; rate 2/s refills 2 by t=1,
+    # and the t=10 batch is back to a full burst
+    assert va[:4] == ["admit", "admit", "shed", "shed"]
+    assert va[4:7] == ["admit", "admit", "shed"]
+    assert va[7:9] == ["admit", "admit"]
+
+
+def test_admission_queue_verdict_blocks_until_release_and_joins():
+    slo.TENANTS.declare("q-t", quota_qps=1000, burst=1000)
+    c = AdmissionController(max_inflight=1, queue_limit=4, queue_timeout_s=5.0)
+    first = c.admit("q-t")
+    assert first.verdict == "admit" and first.admitted
+    got = {}
+
+    def second():
+        got["ticket"] = c.admit("q-t")
+
+    t = threading.Thread(target=second)
+    t.start()
+    time.sleep(0.05)
+    assert "ticket" not in got, "queued request did not block on the full cap"
+    first.release()
+    t.join(timeout=5.0)
+    tk = got["ticket"]
+    assert tk.verdict == "queue" and tk.admitted and tk.queue_s > 0
+    tk.release()
+    # the queue verdict joined its measured wait against the predicted one
+    joined = [e for e in outcomes.tail() if e["site"] == "serve.admit"]
+    assert any(e["engine"] == "queue" and e["measured_s"] > 0 for e in joined)
+
+
+def test_admission_queue_timeout_degrades_to_typed_shed():
+    slo.TENANTS.declare("to-t", quota_qps=1000, burst=1000)
+    c = AdmissionController(max_inflight=1, queue_limit=4, queue_timeout_s=0.05)
+    first = c.admit("to-t")
+    second = c.admit("to-t")  # cap full, queue, times out
+    assert second.verdict == "shed" and not second.admitted
+    first.release()
+    with pytest.raises(ShedRejection):
+        held = c.admit("to-t")
+        try:
+            c.admit_or_raise("to-t")
+        finally:
+            held.release()
+
+
+def test_admission_fails_open_under_injected_fault():
+    slo.TENANTS.declare("fault-t", quota_qps=0.5, burst=1.0)
+    c = AdmissionController(max_inflight=4, queue_limit=0)
+    with faults.inject("serve.admit", TransientDeviceError, every=1):
+        tickets = [c.admit("fault-t") for _ in range(5)]
+    # quota would have shed 4 of 5; the broken verdict path must admit
+    # everything (fail open) — admission is never a correctness gate
+    assert all(t.admitted and t.degraded for t in tickets)
+    for t in tickets:
+        t.release()
+    assert c.stats()["inflight"] == 0
+
+
+# ---------------------------------------------------------------------------
+# shed-never-loses-a-result
+# ---------------------------------------------------------------------------
+
+
+def test_shed_returns_typed_rejection_never_a_wrong_answer():
+    corpus = _corpus()
+    profiles = [TenantProfile("tight", quota_qps=2.0, burst=2.0)]
+    harness = LoadHarness(
+        corpus, profiles, threads=4, use_fusion=False,
+        admission=AdmissionController(max_inflight=8, queue_limit=0),
+    )
+    requests = build_requests(corpus, profiles, 20, seed=5)
+    oracle = harness.run_serial(requests)
+    report = harness.run(requests)
+    assert report.shed > 0, "tight quota shed nothing"
+    assert report.served > 0, "burst budget served nothing"
+    for got, want in zip(report.results, oracle):
+        if isinstance(got, ShedRejection):
+            assert got.tenant == "tight"
+        else:
+            assert got == want, "a served result diverged from the oracle"
+    n_typed = sum(1 for r in report.results if isinstance(r, ShedRejection))
+    assert n_typed == report.shed
+    assert all(r is not None for r in report.results)
+
+
+def test_concurrent_harness_bitexact_vs_serial_two_levels():
+    corpus = _corpus()
+    profiles = [
+        TenantProfile("lv-a", weight=2.0, quota_qps=1e6),
+        TenantProfile("lv-b", weight=1.0, quota_qps=1e6),
+    ]
+    requests = build_requests(corpus, profiles, 18, seed=9)
+    oracle = None
+    for threads in (2, 6):
+        harness = LoadHarness(
+            corpus, profiles, threads=threads,
+            admission=AdmissionController(max_inflight=2 * threads),
+        )
+        if oracle is None:
+            oracle = harness.run_serial(requests)
+        report = harness.run(requests)
+        assert report.shed == 0
+        for got, want in zip(report.results, oracle):
+            assert got == want
+        rows = report.tenant_rows()
+        assert sum(1 for r in rows.values() if r["served"]) == 2
+
+
+def test_fuzz_family_28_seed_pin():
+    from roaringbitmap_tpu import fuzz
+
+    fuzz.verify_serve_invariance(
+        "concurrent-serve-vs-serial", iterations=3, seed=58
+    )
+
+
+# ---------------------------------------------------------------------------
+# 16-thread hammer: serve queue/quota locks are leaves
+# ---------------------------------------------------------------------------
+
+
+def test_serve_locks_are_leaves_hammer_16_threads():
+    slo.TENANTS.declare("hammer-t", quota_qps=1e9, burst=1e9)
+    c = AdmissionController(max_inflight=64, queue_limit=8)
+    w = LockWitness()
+    adm_lock = threading.Lock()
+    c._cond = threading.Condition(w.wrap("serve.admission", adm_lock))
+    slo_lock = slo.TENANTS._lock
+    slo.TENANTS._lock = w.wrap("serve.slo", slo_lock)
+    reg_lock = observe.REGISTRY._lock
+    observe.REGISTRY._lock = w.wrap("registry", reg_lock)
+    rec_lock = tl.RECORDER._lock
+    tl.RECORDER._lock = w.wrap("recorder", rec_lock)
+    prev_mode = tl.mode_name()
+    tl.configure(mode="on")
+    stop = time.monotonic() + 1.0
+    errors = []
+
+    def worker(i):
+        k = 0
+        while time.monotonic() < stop:
+            k += 1
+            try:
+                t = c.admit("hammer-t")
+                slo.record(
+                    "hammer-t", "ok", queue_s=t.queue_s, execute_s=1e-5 * (i + 1)
+                )
+                if k % 3 == 0:
+                    c.stats()
+                if k % 5 == 0:
+                    slo.tenant_rows()  # concurrent reader
+                t.release()
+            except Exception as e:  # rb-ok: exception-hygiene -- hammer collects escapes to assert none happened
+                errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(16)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        tl.configure(mode=prev_mode)
+        slo.TENANTS._lock = slo_lock
+        observe.REGISTRY._lock = reg_lock
+        tl.RECORDER._lock = rec_lock
+    assert not errors
+    w.assert_consistent()
+    assert w.acquisitions.get("serve.admission", 0) > 0
+    assert w.acquisitions.get("serve.slo", 0) > 0
+    # leaf property: nothing is ever acquired while holding a serve lock
+    for leaf in ("serve.admission", "serve.slo"):
+        assert not [e for e in w.edges if e[0] == leaf], sorted(w.edges)
+
+
+# ---------------------------------------------------------------------------
+# p99 from the registry histogram vs the numpy percentile oracle
+# ---------------------------------------------------------------------------
+
+
+def test_p99_histogram_matches_numpy_oracle_under_concurrent_load():
+    slo.TENANTS.declare("p99-t", quota_qps=1e9, burst=1e9)
+    all_vals = []
+    vals_lock = threading.Lock()
+    errors = []
+
+    def worker(i):
+        rng = np.random.default_rng(1000 + i)
+        vals = np.exp(rng.normal(-6.0, 1.0, size=400))  # ~ms-scale, heavy tail
+        try:
+            for v in vals:
+                slo.record("p99-t", "ok", execute_s=float(v))
+        except Exception as e:  # rb-ok: exception-hygiene -- hammer collects escapes to assert none happened
+            errors.append(e)
+        with vals_lock:
+            all_vals.extend(vals.tolist())
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    want = float(np.percentile(np.asarray(all_vals), 99))
+    got = slo.quantiles("p99-t", "execute")["p99"]
+    # the log grid has ratio 10^(1/8) ~ 1.334 between bounds: the
+    # estimate must land within one bucket ratio of the order statistic
+    ratio = 10 ** (1 / 8)
+    assert want / ratio <= got <= want * ratio, (got, want)
+    st = observe.REGISTRY.get(observe.registry.SERVE_LATENCY_SECONDS).get(
+        ("p99-t", "execute")
+    )
+    assert st["count"] == len(all_vals), "concurrent observes lost samples"
+
+
+# ---------------------------------------------------------------------------
+# the serving sentinel rules
+# ---------------------------------------------------------------------------
+
+
+def _snap_pair(traffic_fn):
+    """Two chained health snapshots around ``traffic_fn`` so windowed
+    probes see exactly that traffic as their per-tick delta."""
+    rules = [
+        r for r in health.DEFAULT_RULES
+        if r.name in ("serving-p99-breach", "tenant-saturation")
+    ]
+    s1 = health.snapshot(refresh_hbm=False)
+    for r in rules:
+        r.probe(s1)  # populate s1.sums (the arm tick)
+    traffic_fn()
+    s2 = health.snapshot(prev_sums=s1.sums, refresh_hbm=False)
+    return {r.name: r.probe(s2) for r in rules}
+
+
+def test_serving_p99_breach_rule_windows_the_histogram():
+    slo.TENANTS.declare("slow-t", quota_qps=1e9, burst=1e9)
+    slo.record("slow-t", "ok", execute_s=0.001)  # series exists pre-arm
+
+    def slow_burst():
+        for _ in range(10):
+            slo.record("slow-t", "ok", execute_s=1.2)
+
+    values = _snap_pair(slow_burst)
+    rule = next(r for r in health.DEFAULT_RULES if r.name == "serving-p99-breach")
+    assert values["serving-p99-breach"] is not None
+    assert values["serving-p99-breach"] >= rule.warn
+    # a quiet window clears: the next delta has no movement
+    values2 = _snap_pair(lambda: None)
+    assert rule.band(values2["serving-p99-breach"]) == health.OK
+
+
+def test_tenant_saturation_rule_judges_shed_fraction():
+    slo.TENANTS.declare("sat-t", quota_qps=0.5, burst=1.0)
+    c = AdmissionController(max_inflight=8, queue_limit=0, clock=lambda: 0.0)
+    # series must exist before the arm tick (first sight reports 0)
+    c.admit("sat-t", now=0.0, wait=False)
+    for _ in range(3):
+        c.admit("sat-t", now=0.0, wait=False)  # mint the shed series
+
+    def overload():
+        for _ in range(20):
+            t = c.admit("sat-t", now=0.0, wait=False)
+            if t.admitted:
+                t.release()
+
+    values = _snap_pair(overload)
+    rule = next(r for r in health.DEFAULT_RULES if r.name == "tenant-saturation")
+    assert values["tenant-saturation"] is not None
+    assert values["tenant-saturation"] >= rule.critical
+    # below the per-tick volume floor the rule abstains (no data), so a
+    # single stray shed can never page anyone
+    values2 = _snap_pair(
+        lambda: c.admit("sat-t", now=0.0, wait=False)
+    )
+    assert values2["tenant-saturation"] is None
+
+
+# ---------------------------------------------------------------------------
+# byte share + sidecar/observatory surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_byte_share_over_pack_cache():
+    corpus = _corpus(6, seed=11)
+    other = _corpus(4, seed=12)
+    slo.TENANTS.declare("bs-t", quota_qps=10)
+    store.PACK_CACHE.close()
+    try:
+        store.packed_for(corpus)
+        share = slo.note_tenant_bytes("bs-t", corpus)
+        assert share > 0
+        assert store.PACK_CACHE.resident_bytes_for(
+            {bm.fingerprint() for bm in other}
+        ) == 0
+        g = observe.REGISTRY.get(observe.registry.SERVE_TENANT_BYTES)
+        assert g.get(("bs-t",)) == share
+    finally:
+        store.PACK_CACHE.close()
+
+
+def test_sidecar_and_insights_serving_block():
+    from roaringbitmap_tpu import insights
+    from roaringbitmap_tpu.observe import export as obs_export
+
+    slo.TENANTS.declare("side-t", quota_qps=1e6)
+    c = AdmissionController(max_inflight=4)
+    t = c.admit("side-t")
+    slo.record("side-t", "ok", queue_s=t.queue_s, execute_s=0.002)
+    t.release()
+    side = obs_export.sidecar_snapshot()
+    sv = side["serving"]
+    assert "side-t" in sv["tenants"]
+    row = sv["tenants"]["side-t"]
+    assert row["latency"]["execute"]["p99"] > 0
+    assert any(k.startswith("side-t/") for k in sv["admit"])
+    live = insights.serving()
+    assert isinstance(live["admission_live"], dict)
+    assert "side-t" in live["tenants"]
+
+
+def test_serving_off_mode_is_one_bool_check():
+    slo.TENANTS.declare("off-t", quota_qps=10)
+    slo.configure(enabled=False)
+    try:
+        # disabled: no tenant lookup, no histogram, no KeyError even for
+        # an undeclared tenant — the kill switch short-circuits first
+        slo.record("never-declared", "ok", execute_s=1.0)
+        assert slo.note_tenant_bytes("never-declared", []) == 0
+    finally:
+        slo.configure(enabled=True)
+    assert slo.quantiles("off-t", "execute")["p99"] == 0.0
+
+
+def test_admission_refit_moves_toward_measured_truth():
+    slo.TENANTS.declare("refit-t", quota_qps=1e9, burst=1e9)
+    c = AdmissionController(max_inflight=8)
+    # poison the admit coefficient far from reality, drive traffic, refit
+    with admission_cost.MODEL._lock:
+        admission_cost.MODEL.coeffs = dict(
+            admission_cost.MODEL.coeffs, admit_us=admission_cost.DEFAULT_COEFFS[
+                "admit_us"] * 64,
+        )
+    poisoned = admission_cost.MODEL.coeffs["admit_us"]
+    for _ in range(12):
+        c.admit("refit-t").release()
+    report = admission_cost.MODEL.refit_from_outcomes(min_samples=4)
+    assert "admit_us" in report["moved"]
+    assert admission_cost.MODEL.coeffs["admit_us"] < poisoned
+    assert admission_cost.MODEL.provenance == "refit-from-traffic"
+    # round-trip through the facade state protocol
+    from roaringbitmap_tpu import cost
+
+    state = cost.AUTHORITIES["serve-admission"].state()
+    admission_cost.MODEL.reset()
+    assert cost.AUTHORITIES["serve-admission"].load_state(state)
+    assert admission_cost.MODEL.provenance == "refit-from-traffic"
