@@ -59,6 +59,14 @@ as the ``TENANTS[tenant]`` subscript (the declared-collection escape
 above). A bare ``tenant``-shaped name in a label tuple is flagged with
 its own message pointing at the registry; fixtures pin both directions.
 
+**Epoch label values** (ISSUE 15): epoch ids advance forever — one
+series per flip is the same cardinality melt as a series per trace. An
+``epoch``-shaped name in a label tuple is flagged with its own message:
+the current epoch is a gauge VALUE (``rb_tpu_serve_epoch_count``) and
+lineage lives in the epoch ledger / trace / decision attrs. Flip STAGE
+labels (``drain``/``repack``/``publish``/``reclaim``) are a declared
+frozen set and pass; fixtures pin both directions.
+
 Forwarding wrappers (a call whose name argument is the enclosing
 function's own ``name`` parameter, e.g. the module-level ``counter()``
 helpers in registry.py) are exempt — the real declaration is at their
@@ -97,6 +105,13 @@ _UNBOUNDED = re.compile(
 # as the `TENANTS[tenant]` subscript the declared-collection escape below
 # already accepts (false-positive fixtures in tests/test_analysis.py)
 _TENANT_VALUE = re.compile(r"(^|_)(tenant|tenants|tenant_name)(_|$)")
+# epoch-valued identifiers (ISSUE 15): epoch ids advance forever — one
+# series per flip melts the scrape backend exactly like a trace id. The
+# current epoch is exported as a gauge VALUE (rb_tpu_serve_epoch_count);
+# lineage lives in the epoch ledger and trace/decision attrs, never in
+# label sets (false-positive fixtures pin flip-STAGE labels, which are a
+# declared frozen set and fine)
+_EPOCH_VALUE = re.compile(r"(^|_)(epoch|epochs|epoch_id|epoch_gen)(_|$)")
 _ALL_CAPS = re.compile(r"^[A-Z][A-Z0-9_]*$")
 # constant names that read as canonical metric names (unit-suffixed; RATIO
 # is the dimensionless gauge unit — e.g. rb_tpu_store_overlap_ratio;
@@ -359,6 +374,16 @@ class MetricNaming(Checker):
                 "registry (spell it TENANTS[" + term + "] — the "
                 "declared-collection subscript — so an undeclared tenant "
                 "can never mint a series)",
+            )
+            return
+        if _EPOCH_VALUE.search(term.lower()):
+            yield self.finding(
+                ctx, call,
+                f"metric label value `{term}` is an epoch id: epoch ids "
+                "are unbounded (one per flip, forever) and must never be "
+                "metric label values — export the current epoch as a "
+                "gauge VALUE and put lineage in the epoch ledger / "
+                "trace / decision attrs",
             )
             return
         if _UNBOUNDED.search(term.lower()):

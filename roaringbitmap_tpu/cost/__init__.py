@@ -1,10 +1,11 @@
 """Unified cost substrate (ISSUE 12; ROADMAP item 4's closing half).
 
-One facade over the six pricing authorities — the columnar cutoff
+One facade over the seven pricing authorities — the columnar cutoff
 model, the planner's cardinality corrections, the device-breakeven
 dispatch gate, pack/ship residency pricing, (ISSUE 13) the fusion
-executor's batch-vs-solo window curves, and (ISSUE 14) the serving
-tier's admission curve — behind a shared
+executor's batch-vs-solo window curves, (ISSUE 14) the serving tier's
+admission curve, and (ISSUE 15) the epoch-flip curve (flip-now vs
+accumulate-more over the streaming ingest log) — behind a shared
 curves / provenance / drift / refit / state protocol, with ONE
 persistence lifecycle (``RB_TPU_COST_STATE``). The health sentinel
 (``observe.sentinel``) actuates ``refit_all()`` when a drift gauge
@@ -26,7 +27,7 @@ from .facade import (
     reset_all,
     save_state,
 )
-from . import admission, breakeven, fusion, residency
+from . import admission, breakeven, epoch, fusion, residency
 
 __all__ = [
     "AUTHORITIES",
@@ -37,6 +38,7 @@ __all__ = [
     "breakeven",
     "calibration_state",
     "drift_summary",
+    "epoch",
     "fusion",
     "load_state",
     "names",

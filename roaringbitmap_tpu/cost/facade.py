@@ -2,7 +2,7 @@
 curves / drift / refit / state protocol (ISSUE 12 tentpole, leg 2 —
 closing ROADMAP item 4).
 
-The system grew six pricing authorities, each calibrated differently:
+The system grew seven pricing authorities, each calibrated differently:
 
 ========================= ===============================================
 authority                 wraps
@@ -23,6 +23,10 @@ authority                 wraps
 ``serve-admission``       ``cost.admission.MODEL`` — the serving tier's
                           admission curve: predicted queue wait /
                           admit cost vs measured (ISSUE 14)
+``epoch-flip``            ``cost.epoch.MODEL`` — the epoch ledger's
+                          flip-now vs accumulate-more curve: predicted
+                          flip wall vs measured, staleness priced at the
+                          declared exchange rate (ISSUE 15)
 ========================= ===============================================
 
 Each adapter answers the same five questions — ``curves()`` (what do you
@@ -296,6 +300,42 @@ class ServeAdmissionAuthority(Authority):
         self._model().reset()
 
 
+class EpochFlipAuthority(Authority):
+    """The epoch ledger's flip curve (ISSUE 15): ``epoch.flip`` verdicts
+    price flip-now (predicted flip wall) against accumulate-more
+    (pending staleness at the declared exchange rate); ledger joins
+    score taken flips and the refit learns this host's drain/repack
+    constants from live traffic."""
+
+    name = "epoch-flip"
+
+    def _model(self):
+        from . import epoch as _epoch
+
+        return _epoch.MODEL
+
+    def curves(self) -> dict:
+        return self._model().curves_view()
+
+    def provenance(self) -> str:
+        return self._model().provenance
+
+    def drift(self) -> Dict[str, float]:
+        return self._model().drift()
+
+    def refit_from_outcomes(self, samples: Optional[List[dict]] = None) -> dict:
+        return self._model().refit_from_outcomes(samples=samples)
+
+    def state(self) -> dict:
+        return self._model().to_dict()
+
+    def load_state(self, d: dict) -> bool:
+        return self._model().from_dict(d)
+
+    def reset(self) -> None:
+        self._model().reset()
+
+
 AUTHORITIES: Dict[str, Authority] = {
     a.name: a
     for a in (
@@ -305,6 +345,7 @@ AUTHORITIES: Dict[str, Authority] = {
         PackResidencyAuthority(),
         FusionBatchAuthority(),
         ServeAdmissionAuthority(),
+        EpochFlipAuthority(),
     )
 }
 

@@ -622,6 +622,149 @@ def verify_serve_invariance(
             sslo.reset()
 
 
+def verify_epoch_invariance(
+    name: str,
+    iterations: Optional[int] = None,
+    seed: Optional[int] = None,
+) -> None:
+    """Fuzz family 29 (ISSUE 15): threaded queries under CONCURRENT
+    ingest + epoch flips must each be bit-exact with the snapshot of the
+    epoch they were admitted under — zero torn reads. Each iteration
+    runs query threads (each pinning an epoch via ``EpochStore.reader``
+    and executing a seeded random DAG) against a writer thread
+    submitting stamped mutation batches and forcing flips; every other
+    iteration arms a random seeded fault schedule over the registered
+    sites INCLUDING the new ``epoch.flip`` site (which must fail CLOSED
+    to the old epoch — an aborted flip leaves readers on a stale but
+    consistent snapshot, never a torn one). The oracle replays the
+    published lineage over a pre-run clone: epoch state k+1 = state k +
+    the lineage record's batches, and each query's result must equal its
+    admitted epoch's state (the expression is rebuilt over the clone
+    from the query's own seed). A result matching neither snapshot, a
+    flip that tears a reader, and an escaped exception all fail
+    identically, with the schedule in the repro detail."""
+    import threading
+    from contextlib import ExitStack
+
+    from .query import exec as qexec
+    from .robust import faults as rfaults
+    from .robust import ladder as rladder
+    from .serve import ingest as singest
+    from .serve import slo as sslo
+    from .serve.epochs import EpochStore
+
+    rng = np.random.default_rng(seed)
+    for it in range(iterations or default_iterations()):
+        n_bms = int(rng.integers(4, 7))
+        bms = [random_bitmap(rng) for _ in range(n_bms)]
+        clone = [b.clone() for b in bms]
+        n_queries = int(rng.integers(3, 8))
+        q_seeds = [int(rng.integers(0, 1 << 16)) for _ in range(n_queries)]
+        exprs = [
+            random_expression(np.random.default_rng(s), bms, max_depth=3)
+            for s in q_seeds
+        ]
+        write_muts = [
+            {
+                int(rng.integers(0, n_bms)): rng.integers(
+                    0, 1 << 18, size=int(rng.integers(1, 16))
+                )
+            }
+            for _ in range(int(rng.integers(1, 4)))
+        ]
+        sched = random_fault_schedule(rng) if it % 2 else []
+        rfaults.clear()
+        rladder.LADDER.reset()
+        sslo.reset()
+        sslo.TENANTS.declare("fz-writer", quota_qps=1e6, burst=1e6)
+        es = EpochStore(bms)
+        results: List[Optional[tuple]] = [None] * n_queries
+        submitted = {}
+        errors: List[BaseException] = []
+
+        def _query(qi):
+            try:
+                with es.reader() as tk:
+                    results[qi] = (tk.epoch, qexec.execute(exprs[qi], cache=None))
+            except BaseException as e:  # rb-ok: exception-hygiene -- the family's whole point: ANY escape past the epoch machinery/ladder is a failure, re-wrapped with the repro schedule below
+                errors.append(e)
+
+        def _writer():
+            try:
+                for muts in write_muts:
+                    b = es.submit("fz-writer", muts)
+                    if b is not None:
+                        submitted[b.batch_id] = b
+                    es.flip(reason="fuzz")
+            except BaseException as e:  # rb-ok: exception-hygiene -- same re-wrap contract as the query workers
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=_query, args=(qi,), daemon=True)
+            for qi in range(n_queries)
+        ] + [threading.Thread(target=_writer, daemon=True)]
+        try:
+            with ExitStack() as stack:
+                for site, exc, kw in sched:
+                    stack.enter_context(rfaults.inject(site, exc, **kw))
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+            if errors:
+                raise errors[0]
+            # the lineage replay: epoch state k+1 = state k + the
+            # record's batches, applied to a pre-run clone
+            states = {0: clone}
+            cur = clone
+            for rec in (r for r in es.lineage() if r["outcome"] == "flipped"):
+                cur = [b.clone() for b in cur]
+                singest.apply_batches(
+                    cur, [submitted[bid] for bid in rec["batches"]]
+                )
+                states[rec["epoch"]] = cur
+            with rfaults.suspended():
+                for qi, r in enumerate(results):
+                    if r is None:
+                        raise InvarianceFailure(
+                            name, bms,
+                            detail=f"query {qi} produced no result and no "
+                            f"error (schedule={sched})",
+                        )
+                    ep, got = r
+                    snap = states.get(ep)
+                    if snap is None:
+                        raise InvarianceFailure(
+                            name, bms,
+                            detail=f"query {qi} admitted under unpublished "
+                            f"epoch {ep} (schedule={sched})",
+                        )
+                    want = qexec.execute(
+                        random_expression(
+                            np.random.default_rng(q_seeds[qi]), snap,
+                            max_depth=3,
+                        ),
+                        cache=None,
+                    )
+                    if got != want:
+                        raise InvarianceFailure(
+                            name, bms,
+                            detail=f"TORN READ: query {qi} under epoch {ep} "
+                            f"matches no legal snapshot (schedule={sched})",
+                        )
+        except InvarianceFailure:
+            raise
+        except Exception as e:  # rb-ok: exception-hygiene -- the family's whole point: ANY escape past the epoch machinery/ladder is a failure, re-wrapped with the repro schedule
+            raise InvarianceFailure(
+                name, bms,
+                detail=f"exception escaped the epoch machinery: {e!r} "
+                f"(schedule={sched})",
+            ) from e
+        finally:
+            rfaults.clear()
+            sslo.reset()
+
+
 def random_expression(rng, leaves: List[RoaringBitmap], max_depth: int = 4):
     """Random query DAG over the given leaf bitmaps: every node kind
     (and/or/xor/n-ary andnot/not-over-explicit-universe/threshold), biased
@@ -1004,6 +1147,19 @@ def run_campaign(iterations: Optional[int] = None, verbose: bool = True) -> dict
         "concurrent-serve-vs-serial",
         lambda: verify_serve_invariance(
             "concurrent-serve-vs-serial", iterations=max(1, n // 8), seed=58
+        ),
+        actual=max(1, n // 8),
+    )
+    # ISSUE 15: threaded queries under concurrent ingest + epoch flips
+    # (incl. seeded fault schedules over the epoch.flip site) must each
+    # match the snapshot of the epoch they were admitted under — zero
+    # torn reads (derated: each iteration runs a threaded window plus a
+    # per-epoch lineage-replay oracle)
+    _run(
+        "concurrent-ingest-vs-epoch-oracle",
+        lambda: verify_epoch_invariance(
+            "concurrent-ingest-vs-epoch-oracle", iterations=max(1, n // 8),
+            seed=59,
         ),
         actual=max(1, n // 8),
     )

@@ -306,6 +306,31 @@ def serving() -> dict:
     return block
 
 
+def epochs() -> dict:
+    """Epoch-ledger rollup (ISSUE 15): the current epoch, live
+    mutation-log depth, flip volume by outcome, per-tenant freshness
+    p50/p99 (ingest->queryable lag), flip stage decomposition — all
+    registry-derived — plus the live EpochStore's lineage ledger tail
+    and stats (process-local, like the admission controller's live
+    stats). The rb_top epoch panel renders exactly this, and a red
+    episode's flight bundle carries it via :func:`observatory`."""
+    from . import observe
+    from .observe import export as _export
+    from .serve import epochs as _epochs
+
+    block = _export._epochs_block(
+        observe.REGISTRY.snapshot(), observe.REGISTRY
+    )
+    store = _epochs.current_store()
+    if store is not None:
+        block["store_live"] = store.stats()
+        block["lineage"] = store.lineage(16)
+    else:
+        block["store_live"] = None
+        block["lineage"] = []
+    return block
+
+
 def cost_authorities() -> dict:
     """The unified cost facade's view (ISSUE 12): every pricing
     authority's curves, provenance, and live drift — ROADMAP item 4's
@@ -342,6 +367,10 @@ def observatory() -> dict:
         # observatory view, so a red episode's flight bundle
         # (observatory.json) carries the serving state that triggered it
         "serving": serving(),
+        # epoch ledger (ISSUE 15): current epoch + mutlog depth +
+        # freshness + lineage tail, so a red episode's bundle carries the
+        # epoch panel (which snapshot was serving, and how stale)
+        "epochs": epochs(),
     }
 
 

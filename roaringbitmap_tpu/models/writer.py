@@ -106,12 +106,28 @@ class RoaringBitmapWriter:
 
 class BitmapWriter:
     """Streaming appender. Sorted streams take the constant-memory fast path
-    (one 8 KiB buffer); out-of-order values fall back to per-key buffers."""
+    (one 8 KiB buffer); out-of-order values fall back to per-key buffers.
 
-    def __init__(self, optimise_runs=False, constant_memory=False, fast_rank=False):
+    ``into=`` points the writer at an EXISTING bitmap instead of a fresh
+    one: every emit lands through the bitmap's attributed mutators
+    (``set_container_at_index`` / ``insert_new_key_value_at`` /
+    ``append`` — all of which ``touch_key``), so the pack cache's per-key
+    dirty tracking prices each flushed chunk and a later
+    ``store.packed_for`` repack takes the O(k) delta path. This is the
+    serving tier's ingest surface (serve/ingest.py): the epoch flip
+    drains the mutation log through one writer per touched bitmap."""
+
+    def __init__(self, optimise_runs=False, constant_memory=False, fast_rank=False,
+                 into: Optional[RoaringBitmap] = None):
         self._optimise_runs = optimise_runs
         self._constant_memory = constant_memory
-        self._bitmap = FastRankRoaringBitmap() if fast_rank else RoaringBitmap()
+        if into is not None:
+            if fast_rank and not isinstance(into, FastRankRoaringBitmap):
+                raise ValueError("fast_rank writer cannot stream into a "
+                                 "plain RoaringBitmap")
+            self._bitmap = into
+        else:
+            self._bitmap = FastRankRoaringBitmap() if fast_rank else RoaringBitmap()
         self._current_key: Optional[int] = None
         self._words = bits.new_words()
         self._words_dirty = False

@@ -62,8 +62,14 @@ from .registry import (
     RETRY_TOTAL,
     SERIAL_BYTES_TOTAL,
     SERVE_ADMIT_TOTAL,
+    SERVE_EPOCH_COUNT,
+    SERVE_EPOCH_FLIP_TOTAL,
+    SERVE_FLIP_STAGE_SECONDS,
+    SERVE_FRESHNESS_SECONDS,
     SERVE_INFLIGHT_COUNT,
+    SERVE_INGEST_TOTAL,
     SERVE_LATENCY_SECONDS,
+    SERVE_MUTLOG_COUNT,
     SERVE_QPS,
     SERVE_QUEUE_COUNT,
     SERVE_REQUESTS_TOTAL,

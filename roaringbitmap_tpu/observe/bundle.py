@@ -135,6 +135,10 @@ def _collect_sections(health_dump: Optional[dict]) -> Dict[str, str]:
             # serving panel (ISSUE 14): a red episode triggered by the
             # serving rules must ship the per-tenant state that fired it
             "serving": _insights.serving(),
+            # epoch panel (ISSUE 15): which snapshot was serving, how
+            # stale the log is, and the lineage that led here — the
+            # freshness-lag-breach / epoch-flip-stall episodes' context
+            "epochs": _insights.epochs(),
         }
 
     sections["observatory.json"] = _json_or_error(_observatory)

@@ -303,6 +303,53 @@ def _serving_block(snap: dict, registry: Registry) -> dict:
     }
 
 
+def _epochs_block(snap: dict, registry: Registry) -> dict:
+    """The epoch ledger's sidecar block (ISSUE 15), derived PURELY from
+    the registry like the serving/fusion blocks so a ``--from`` rendering
+    needs no live process: the current epoch gauge, live mutation-log
+    depth, flip volume by outcome, per-tenant freshness p50/p99
+    (ingest->queryable lag), ingest batch volume by tenant, and the flip
+    stage latency decomposition. Epoch LINEAGE is process-local (the
+    EpochStore's bounded ledger) and rides ``insights.epochs()`` /
+    flight bundles, never the registry — epoch ids are unbounded and
+    must not mint series."""
+    freshness: dict = {}
+    fr = registry.get(_registry.SERVE_FRESHNESS_SECONDS)
+    if isinstance(fr, LatencyHistogram):
+        for lv, st in sorted(fr.series().items()):
+            freshness[lv[0]] = {
+                "count": st["count"],
+                **{
+                    "p%g" % (q * 100): round(fr._quantile_of_state(st, q), 6)
+                    for q in SNAPSHOT_QUANTILES
+                },
+            }
+    stages: dict = {}
+    fs = registry.get(_registry.SERVE_FLIP_STAGE_SECONDS)
+    if isinstance(fs, LatencyHistogram):
+        for lv, st in sorted(fs.series().items()):
+            stages[lv[0]] = {
+                "count": st["count"],
+                "sum": round(st["sum"], 6),
+                "p99": round(fs._quantile_of_state(st, 0.99), 6),
+            }
+    def _gauge(name):
+        m = snap.get(name)
+        if m is not None:
+            for s in m["samples"]:
+                if not s["labels"]:
+                    return s["value"]
+        return None
+    return {
+        "epoch": _gauge(_registry.SERVE_EPOCH_COUNT),
+        "mutlog_depth": _gauge(_registry.SERVE_MUTLOG_COUNT),
+        "flips": _counter_map(snap, _registry.SERVE_EPOCH_FLIP_TOTAL),
+        "ingest": _counter_map(snap, _registry.SERVE_INGEST_TOTAL),
+        "freshness": freshness,
+        "flip_stages": stages,
+    }
+
+
 def _health_block(snap: dict) -> dict:
     """The health sentinel's sidecar block (ISSUE 12), derived PURELY
     from the registry gauges (like the regret block) so a ``--from``
@@ -364,6 +411,9 @@ def sidecar_snapshot(registry: Optional[Registry] = None) -> dict:
         # serving tier (ISSUE 14): per-tenant QPS/p50/p99, admission
         # verdicts, queue/in-flight depth, saturation, byte shares
         "serving": _serving_block(snap, _reg(registry)),
+        # epoch ledger (ISSUE 15): current epoch, mutation-log depth,
+        # flip volume + stage decomposition, per-tenant freshness
+        "epochs": _epochs_block(snap, _reg(registry)),
         "registry": snap,
     }
 

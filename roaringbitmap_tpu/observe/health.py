@@ -45,6 +45,8 @@ compile-storm          jit traces since last tick               8     32
 fusion-queue-stall     fusion queue depth with no drained batch 1     64
 serving-p99-breach     worst per-tenant windowed serving p99 s  0.5   2.0
 tenant-saturation      worst per-tenant shed fraction per tick  0.25  0.75
+freshness-lag-breach   worst windowed ingest->queryable p99 s   2.0   10.0
+epoch-flip-stall       mutation-log depth with no epoch flip    4     64
 ====================== ======================================== ===== =====
 
 Actuations (the sentinel's closed-loop half — see ``observe.sentinel``):
@@ -429,6 +431,33 @@ def _tenant_saturation(s: Snapshot) -> Optional[float]:
     return worst
 
 
+def _freshness_lag_breach(s: Snapshot) -> Optional[float]:
+    """Worst windowed ingest->queryable lag p99 (seconds) over the epoch
+    ledger's per-tenant freshness series since the last tick (ISSUE 15 —
+    the freshness half of the serving SLO story). Same per-tick windowing
+    as the serving-p99 rule: a stale flip fires while stale batches keep
+    publishing and clears once fresh flips resume — a cumulative p99
+    would pin one bad backlog red forever."""
+    return s.histogram_delta_quantile(_registry.SERVE_FRESHNESS_SECONDS, 0.99)
+
+
+def _epoch_flip_stall(s: Snapshot) -> float:
+    """Mutation batches parked in the ingest log while NO epoch flip
+    published since the last tick (ISSUE 15 — the write-path twin of
+    fusion-queue-stall): badness is the mutlog depth gauge, judged
+    against the flip counter's per-tick movement. A draining log —
+    however deep — is healthy accumulation; a deep log with a wedged
+    flip loop is data that will never become queryable."""
+    depth = s.gauge_max_abs(_registry.SERVE_MUTLOG_COUNT)
+    if depth <= 0:
+        return 0.0
+    flips = s.labeled_counter_delta(_registry.SERVE_EPOCH_FLIP_TOTAL)
+    drained = sum(
+        d for (outcome,), d in flips.items() if outcome == "flipped"
+    )
+    return depth if drained == 0 else 0.0
+
+
 def _fusion_queue_stall(s: Snapshot) -> float:
     """Queries parked in the fusion window queue while NO batch drained
     since the last tick (ISSUE 13 — the ~5-line serving-shaped rule the
@@ -517,6 +546,28 @@ DEFAULT_RULES: Tuple[Rule, ...] = (
         "floor)",
         _tenant_saturation,
         warn=0.25, critical=0.75, fire_after=2, clear_after=2,
+        actuation="alert",
+    ),
+    # the two epoch-ledger rules (ISSUE 15): data freshness joins the
+    # latency SLOs as a judged signal, and a wedged flip loop is loud
+    # before the backlog becomes an outage; appended so every earlier
+    # rule keeps its table position
+    Rule(
+        "freshness-lag-breach",
+        "worst ingest->queryable lag p99 (seconds, windowed per tick "
+        "over the per-tenant freshness series) breached the freshness "
+        "SLO",
+        _freshness_lag_breach,
+        warn=2.0, critical=10.0, fire_after=2, clear_after=2,
+        actuation="alert",
+    ),
+    Rule(
+        "epoch-flip-stall",
+        "mutation batches pending in the ingest log while no epoch flip "
+        "published since the last tick (wedged flip loop, not healthy "
+        "accumulation)",
+        _epoch_flip_stall,
+        warn=4.0, critical=64.0, fire_after=2, clear_after=2,
         actuation="alert",
     ),
 )

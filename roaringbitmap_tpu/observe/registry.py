@@ -126,6 +126,21 @@ SERVE_QUEUE_COUNT = "rb_tpu_serve_queue_count"
 SERVE_INFLIGHT_COUNT = "rb_tpu_serve_inflight_count"
 SERVE_SATURATION_RATIO = "rb_tpu_serve_saturation_ratio"
 SERVE_TENANT_BYTES = "rb_tpu_serve_tenant_bytes"
+# epoch ledger / streaming ingestion (ISSUE 15): ingest->queryable lag per
+# tenant (observed at epoch publish, per drained mutation batch), flip
+# stage decomposition (the declared FLIP_STAGES set in serve/epochs.py:
+# drain | repack | publish | reclaim), mutation-batch volume by tenant,
+# flip volume by outcome (flipped | aborted | noop), the live mutation-log
+# depth gauge (pending batches), and the current epoch id as a gauge
+# VALUE. Epoch ids are unbounded and must NEVER be metric label values —
+# lineage lives in the epoch ledger and trace/decision attrs (the
+# metric-naming rule enforces it, like trace ids and tenant names)
+SERVE_FRESHNESS_SECONDS = "rb_tpu_serve_freshness_seconds"
+SERVE_FLIP_STAGE_SECONDS = "rb_tpu_serve_flip_stage_seconds"
+SERVE_INGEST_TOTAL = "rb_tpu_serve_ingest_total"
+SERVE_EPOCH_FLIP_TOTAL = "rb_tpu_serve_epoch_flip_total"
+SERVE_MUTLOG_COUNT = "rb_tpu_serve_mutlog_count"
+SERVE_EPOCH_COUNT = "rb_tpu_serve_epoch_count"
 
 # upper bucket bounds (seconds) for wall-time histograms: host phases span
 # ~100 µs packing steps to multi-second CPU folds; +Inf is implicit

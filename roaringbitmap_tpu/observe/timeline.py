@@ -357,6 +357,16 @@ class _Span:
         self._trace = trace
         self._ann = None
 
+    def attr(self, **kw) -> None:
+        """Attach attrs discovered mid-span (e.g. the serving epoch a
+        request was pinned to, known only after admission) — recorded at
+        exit with the rest. Callers must guard for off-mode, where tspan
+        returns a span-less null context."""
+        if self.attrs:
+            self.attrs.update(kw)
+        else:
+            self.attrs = dict(kw)
+
     def __enter__(self) -> "_Span":
         if self._trace:
             try:

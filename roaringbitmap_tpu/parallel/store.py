@@ -1594,6 +1594,11 @@ class PackCache:
         # eviction was wrong exactly when its key came back while we still
         # remember throwing it out. Bounded ring, oldest forgotten.
         self._evicted_seqs: "OrderedDict[tuple, int]" = OrderedDict()  # guarded-by: self._lock
+        # per-THREAD route of the most recent get_packed (ISSUE 15): the
+        # epoch flip's lineage needs per-working-set delta-vs-full
+        # evidence, and a diff of the global hit/miss counters would
+        # race every concurrent cache user; thread-local needs no lock
+        self._route_tls = threading.local()
         self._bytes = 0  # guarded-by: self._lock
         self.hits = 0  # guarded-by: self._lock
         self.misses = 0  # guarded-by: self._lock
@@ -1613,6 +1618,7 @@ class PackCache:
         delta validator relies on that to detect intersection changes."""
         bitmaps = list(bitmaps)
         marker = "all" if keys_filter is None else "and"
+        self._route_tls.route = None  # set on every exit path below
         # stage-attributed (ISSUE 8): with the delta scatter at O(k) the
         # fingerprint walk is a visible share of the delta wall — the
         # timeline must name it, not leave it as unattributed residue.
@@ -1628,6 +1634,7 @@ class PackCache:
             with self._lock:
                 self.misses += 1
             _PACK_MISSES.inc(1, ("agg",))
+            self._route_tls.route = ("disabled", 0)
             # no entry will exist, so skip the (discarded) row provenance
             return pack_groups(group_by_key(bitmaps, keys_filter=keys_filter))
         ident = ("agg", marker, idents)
@@ -1640,6 +1647,7 @@ class PackCache:
                 _timeline.instant(
                     "pack_cache.hit", "cache", kind="agg", bytes=e.nbytes
                 )
+                self._route_tls.route = ("hit", 0)
                 return e.value
             old_key = self._ident.get(ident)
             if old_key is not None:
@@ -1661,6 +1669,7 @@ class PackCache:
                         )
                         if rows:
                             _PACK_DELTA_ROWS.inc(len(rows), ("agg",))
+                        self._route_tls.route = ("delta", len(rows))
                         return e.value
         # full repack outside the lock (packing dominates; a racing thread
         # packing the same key is benign — first store wins)
@@ -1686,7 +1695,19 @@ class PackCache:
             key, "agg", packed, packed.words_nbytes, fps=fps, row_map=row_map,
             refs=static_fp_refs(bitmaps),
         )
+        self._route_tls.route = ("full", 0)
         return self._store(entry, ident=ident).value
+
+    def last_route(self) -> Optional[tuple]:
+        """``(route, delta_rows)`` of THIS thread's most recent
+        :meth:`get_packed` — ``route`` is ``"hit"`` | ``"delta"`` |
+        ``"full"`` | ``"disabled"``, ``delta_rows`` is nonzero only on
+        the delta route. Thread-local by design: the epoch flip
+        (serve/epochs.py) classifies each working-set refresh for its
+        lineage record, and a diff of the global hit/miss counters would
+        race every concurrent cache user. ``None`` before any call on
+        this thread."""
+        return getattr(self._route_tls, "route", None)
 
     def get_or_build(self, key: tuple, build: Callable[[], tuple], refs: tuple = ()):
         """Generic resident entry (BSI slice tensors, query-kernel packs,

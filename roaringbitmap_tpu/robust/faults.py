@@ -55,6 +55,7 @@ SITES: Tuple[str, ...] = (
     "query.exec",        # query executor device-engine step dispatch
     "query.fusion",      # fused micro-batch execution (query/fusion.py)
     "serve.admit",       # serving-tier admission verdict (serve/admission.py)
+    "epoch.flip",        # epoch flip of the streaming ingest log (serve/epochs.py)
     "columnar.kernel",   # columnar native batch-kernel entry (kernels.py)
     "columnar.device",   # columnar device-tier entry (columnar/device.py)
     "native.entry",      # native C tier entry probe (native/__init__.py)

@@ -19,9 +19,23 @@ The three legs (see each module's docstring):
 The health sentinel's ``serving-p99-breach`` and ``tenant-saturation``
 rules (observe/health.py) watch the telemetry this tier emits — the
 serving-shaped signals the ISSUE-12 closure note promised.
+
+Since ISSUE 15 the tier also owns the WRITE path:
+
+* ``ingest.py`` — the batched mutation log: stamped per-tenant batches
+  accumulate while readers keep serving the current epoch untouched;
+* ``epochs.py`` — snapshot-isolated epoch publication: readers pin the
+  epoch they were admitted under, the flip drains the log through the
+  sorted-stream writer surface into ONE O(k) delta repack per touched
+  working set, and every published batch's ingest->queryable lag lands
+  in ``rb_tpu_serve_freshness_seconds{tenant}``. The flip is a priced
+  ``epoch.flip`` decision (the seventh ``cost/`` authority), and the
+  ``freshness-lag-breach`` / ``epoch-flip-stall`` sentinel rules watch
+  the new signals.
 """
 
 from .admission import CONTROLLER, AdmissionController, ShedRejection, Ticket
+from .epochs import EpochStore, EpochTicket, FLIP_STAGES, current_store
 from .harness import (
     HarnessReport,
     LoadHarness,
@@ -31,14 +45,20 @@ from .harness import (
     build_requests,
     default_mix,
 )
+from .ingest import IngestLog, MutationBatch
 from .slo import TENANTS, TenantRegistry
-from . import admission, harness, slo
+from . import admission, epochs, harness, ingest, slo
 
 __all__ = [
     "AdmissionController",
     "CONTROLLER",
+    "EpochStore",
+    "EpochTicket",
+    "FLIP_STAGES",
     "HarnessReport",
+    "IngestLog",
     "LoadHarness",
+    "MutationBatch",
     "Request",
     "ShedRejection",
     "TENANTS",
@@ -48,7 +68,10 @@ __all__ = [
     "Ticket",
     "admission",
     "build_requests",
+    "current_store",
     "default_mix",
+    "epochs",
     "harness",
+    "ingest",
     "slo",
 ]

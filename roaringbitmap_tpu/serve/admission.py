@@ -240,15 +240,20 @@ class AdmissionController:
         tenant: str,
         now: Optional[float] = None,
         wait: bool = True,
+        epoch: Optional[int] = None,
     ) -> Ticket:
         """One admission verdict for ``tenant`` (must be declared in the
         tenant registry). ``now`` pins the quota clock (fake-clock
         determinism); ``wait=False`` makes a queue verdict return
         immediately un-admitted instead of blocking (the determinism
-        tests' non-blocking form). Returns a :class:`Ticket`; a shed
-        verdict's ticket has ``admitted=False`` — callers that cannot
-        degrade raise :class:`ShedRejection` via :meth:`admit_or_raise`."""
+        tests' non-blocking form); ``epoch`` stamps the serving epoch the
+        request was admitted under into the decision inputs (ISSUE 15 —
+        the outcomes ledger then decomposes admission joins by epoch).
+        Returns a :class:`Ticket`; a shed verdict's ticket has
+        ``admitted=False`` — callers that cannot degrade raise
+        :class:`ShedRejection` via :meth:`admit_or_raise`."""
         canon = self.tenants[tenant]
+        extra = {} if epoch is None else {"epoch": int(epoch)}
         try:
             _faults.fault_point("serve.admit")
         except Exception as e:
@@ -264,7 +269,7 @@ class AdmissionController:
             _INFLIGHT_COUNT.set(inflight)
             _ADMIT_TOTAL.inc(1, (TENANTS[tenant], "admit"))
             _decisions.record_decision(
-                "serve.admit", "admit", tenant=canon, degraded=True,
+                "serve.admit", "admit", tenant=canon, degraded=True, **extra,
             )
             return Ticket(self, canon, "admit", True, 0.0, degraded=True)
         t0 = time.perf_counter()
@@ -302,7 +307,7 @@ class AdmissionController:
         if verdict == "shed":
             _decisions.record_decision(
                 "serve.admit", "shed", tenant=canon, depth=depth,
-                inflight=inflight, saturation=saturation,
+                inflight=inflight, saturation=saturation, **extra,
             )
             _timeline.instant(
                 "serve.shed", "serve", tenant=canon, depth=depth,
@@ -312,7 +317,7 @@ class AdmissionController:
         seq = _decisions.record_decision(
             "serve.admit", verdict, outcome=_outcomes.enabled(),
             est_us={verdict: predicted}, tenant=canon, depth=depth,
-            inflight=inflight, saturation=saturation,
+            inflight=inflight, saturation=saturation, **extra,
         )
         if verdict == "admit":
             _outcomes.resolve(

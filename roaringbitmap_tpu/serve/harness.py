@@ -43,6 +43,7 @@ unserved one is loudly a shed" (shed-never-loses-a-result).
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from dataclasses import dataclass, field
@@ -61,24 +62,35 @@ from .slo import TENANTS
 class TenantProfile:
     """One tenant's declared traffic shape: its share of the request mix
     (``weight``), its admission quota, and its query profile over the
-    shared corpus (``mix`` draws one expression from a seeded rng)."""
+    shared corpus (``mix`` draws one expression from a seeded rng).
+
+    ``writes`` makes the tenant a WRITER (ISSUE 15): that fraction of its
+    requests are stamped mutation batches into the epoch store's ingest
+    log instead of queries (``write_values`` values per batch, drawn into
+    the touched bitmap's existing chunk keys so the flip's repack stays
+    on the O(k) delta path)."""
 
     name: str
     weight: float = 1.0
     quota_qps: float = 1000.0
     burst: Optional[float] = None
     mix: Optional[Callable] = None  # (rng, corpus, shared) -> Expr
+    writes: float = 0.0
+    write_values: int = 8
 
 
 @dataclass
 class Request:
     """One scheduled request (the multiset element the serial oracle
-    replays)."""
+    replays). ``kind`` is ``query`` or ``write``; a write carries its
+    per-bitmap-index ``mutations`` instead of an expression."""
 
     idx: int
     tenant: str
     expr: object
     start_s: Optional[float] = None  # open-loop schedule offset
+    kind: str = "query"
+    mutations: Optional[Dict[int, object]] = None
 
 
 @dataclass
@@ -86,6 +98,7 @@ class TenantStats:
     served: int = 0
     shed: int = 0
     queued: int = 0
+    writes: int = 0
     queue_s: List[float] = field(default_factory=list)
     execute_s: List[float] = field(default_factory=list)
 
@@ -115,6 +128,21 @@ def default_mix(rng, corpus, shared):
     return a | b
 
 
+def default_write(rng, corpus, n_values: int = 8):
+    """The default mutation draw for a writer tenant: a few values into
+    ONE bitmap's existing chunk keys (mutating resident containers in
+    place is what keeps the epoch flip's repack on the O(k) delta path;
+    a fresh-key write would legitimately force a structural repack)."""
+    idx = int(rng.integers(0, len(corpus)))
+    hlc = corpus[idx].high_low_container
+    if hlc.size:
+        hb = int(hlc.keys[int(rng.integers(0, hlc.size))])
+    else:
+        hb = 0
+    lows = rng.integers(0, 1 << 16, size=max(1, int(n_values)))
+    return {idx: ((hb << 16) | lows).astype(np.int64)}
+
+
 def build_requests(
     corpus: Sequence,
     profiles: Sequence[TenantProfile],
@@ -125,8 +153,12 @@ def build_requests(
     """The deterministic request schedule: tenants drawn by weight, each
     tenant's queries from its own seeded stream (so two tenants never
     share an rng and the multiset is reproducible per seed), the shared
-    hot conjunction built from the corpus head. ``target_qps`` stamps
-    open-loop start offsets; None leaves the schedule closed-loop."""
+    hot conjunction built from the corpus head. Writer tenants
+    (``writes > 0``) interleave seeded mutation batches with their
+    queries — same determinism, so the epoch-replay oracle
+    (:meth:`LoadHarness.run_serial_epochs`) rebuilds the exact schedule
+    over a cloned corpus. ``target_qps`` stamps open-loop start offsets;
+    None leaves the schedule closed-loop."""
     from ..query import Q
 
     if len(corpus) < 4:
@@ -144,9 +176,17 @@ def build_requests(
     out: List[Request] = []
     for i in range(int(n_requests)):
         p = profiles[int(pick_rng.choice(len(profiles), p=weights))]
-        mix = p.mix or default_mix
-        expr = mix(tenant_rngs[p.name], corpus, shared)
+        rng = tenant_rngs[p.name]
         start = (i / target_qps) if target_qps else None
+        if p.writes > 0 and float(rng.random()) < p.writes:
+            muts = default_write(rng, corpus, n_values=p.write_values)
+            out.append(Request(
+                idx=i, tenant=p.name, expr=None, start_s=start,
+                kind="write", mutations=muts,
+            ))
+            continue
+        mix = p.mix or default_mix
+        expr = mix(rng, corpus, shared)
         out.append(Request(idx=i, tenant=p.name, expr=expr, start_s=start))
     return out
 
@@ -172,6 +212,7 @@ class LoadHarness:
         max_wait_ms: Optional[float] = None,
         admission: Optional[AdmissionController] = None,
         cache_entries: int = 256,
+        epoch_store=None,
     ):
         if threads < 1:
             raise ValueError(f"threads must be >= 1, got {threads}")
@@ -183,6 +224,20 @@ class LoadHarness:
         self.max_wait_ms = max_wait_ms
         self.admission = admission if admission is not None else CONTROLLER
         self.cache_entries = int(cache_entries)
+        # the epoch store (ISSUE 15): when given, every query runs under
+        # a reader pin (snapshot isolation) and write requests feed its
+        # ingest log; required when any profile is a writer
+        self.epoch_store = epoch_store
+        if epoch_store is not None and (
+            len(epoch_store.corpus) != len(self.corpus)
+            or any(
+                a is not b for a, b in zip(epoch_store.corpus, self.corpus)
+            )  # identity, not content: a content compare of serving-scale
+               # bitmaps would cost more than the run it guards
+        ):
+            raise ValueError("epoch store must wrap the harness corpus")
+        if epoch_store is None and any(p.writes > 0 for p in self.profiles):
+            raise ValueError("writer tenants need an epoch_store")
         for p in self.profiles:
             TENANTS.declare(p.name, quota_qps=p.quota_qps, burst=p.burst)
 
@@ -202,11 +257,18 @@ class LoadHarness:
         # sub-slice of a built schedule lines up with its own serial
         # oracle), not keyed by Request.idx
         results: List[object] = [None] * len(requests)
+        # per-position admitted epoch (queries) and minted batch id
+        # (writes) — the epoch-replay oracle's join keys (ISSUE 15)
+        epochs: List[Optional[int]] = [None] * len(requests)
+        batch_ids: List[Optional[int]] = [None] * len(requests)
         stats: Dict[str, TenantStats] = {p.name: TenantStats() for p in self.profiles}
         stats_lock = threading.Lock()  # leaf: guards the stats dict only
         cursor = {"i": 0}
         cursor_lock = threading.Lock()  # leaf: guards the cursor only
         errors: List[BaseException] = []
+        epoch_start = (
+            self.epoch_store.current() if self.epoch_store is not None else 0
+        )
         cache = ResultCache(max_entries=self.cache_entries)
         executor = (
             FusionExecutor(
@@ -231,11 +293,18 @@ class LoadHarness:
                     delay = (t_open + req.start_s) - time.perf_counter()
                     if delay > 0:
                         time.sleep(delay)
+                ambient_epoch = (
+                    self.epoch_store.current()
+                    if self.epoch_store is not None else None
+                )
                 with _timeline.tspan(
                     "serve.request", "serve", tenant=req.tenant, idx=req.idx,
-                ):
+                    kind=req.kind,
+                ) as span:
                     t0 = time.perf_counter()
-                    ticket = self.admission.admit(req.tenant)
+                    ticket = self.admission.admit(
+                        req.tenant, epoch=ambient_epoch
+                    )
                     queue_s = time.perf_counter() - t0
                     if not ticket.admitted:
                         results[pos] = ShedRejection(req.tenant, "admission")
@@ -245,10 +314,36 @@ class LoadHarness:
                         return
                     try:
                         t1 = time.perf_counter()
-                        if executor is not None:
-                            out = executor.submit(req.expr).result()
+                        if req.kind == "write":
+                            # the WRITE path (ISSUE 15): a stamped batch
+                            # into the ingest log — readers untouched —
+                            # then the priced flip-now-vs-accumulate
+                            # verdict; the flip itself (when taken) is
+                            # the only corpus mutation point
+                            batch = self.epoch_store.submit(
+                                req.tenant, req.mutations
+                            )
+                            self.epoch_store.maybe_flip(reason="ingest")
+                            out = ("write", batch.batch_id if batch else None)
+                            batch_ids[pos] = out[1]
                         else:
-                            out = _exec.execute(req.expr, cache=cache)
+                            # snapshot isolation: the reader pin fixes
+                            # the epoch for the whole execution and the
+                            # epoch id rides the request's span attrs
+                            pin = (
+                                self.epoch_store.reader()
+                                if self.epoch_store is not None
+                                else contextlib.nullcontext()
+                            )
+                            with pin as tk:
+                                if tk is not None:
+                                    epochs[pos] = tk.epoch
+                                    if span is not None:  # off-mode: no span
+                                        span.attr(epoch=tk.epoch)
+                                if executor is not None:
+                                    out = executor.submit(req.expr).result()
+                                else:
+                                    out = _exec.execute(req.expr, cache=cache)
                         execute_s = time.perf_counter() - t1
                     except Exception:
                         _slo.record(req.tenant, "error", queue_s=queue_s)
@@ -264,6 +359,8 @@ class LoadHarness:
                         st.served += 1
                         st.queue_s.append(queue_s)
                         st.execute_s.append(execute_s)
+                        if req.kind == "write":
+                            st.writes += 1
                         if ticket.verdict == "queue":
                             st.queued += 1
 
@@ -288,6 +385,13 @@ class LoadHarness:
         for w in workers:
             w.join()
         wall_s = time.perf_counter() - t0
+        # run-end drain (ISSUE 15), AFTER the wall: every accepted batch
+        # becomes queryable — trailing freshness is observed and the
+        # epoch-replay oracle sees a complete lineage. The serving wall
+        # covers the schedule; a steady-state server amortizes this flip
+        # over the traffic that follows, which a bounded window cannot
+        if self.epoch_store is not None and self.epoch_store.log.depth():
+            self.epoch_store.flip(reason="run-end")
         if executor is not None:
             executor.close()
         if errors:
@@ -297,26 +401,121 @@ class LoadHarness:
         # resident entries its leaves appear in
         for p in self.profiles:
             _slo.note_tenant_bytes(p.name, self.corpus)
-        return HarnessReport(requests, results, stats, wall_s)
+        lineage = (
+            self.epoch_store.lineage() if self.epoch_store is not None else []
+        )
+        return HarnessReport(
+            requests, results, stats, wall_s,
+            epochs=epochs, batch_ids=batch_ids, lineage=lineage,
+            epoch_start=epoch_start,
+        )
 
     def run_serial(self, requests: Sequence[Request]) -> List[object]:
         """The serial oracle: the same query multiset, one at a time, no
         admission, no fusion, no shared cache — what the concurrent run
-        must be bit-exact against (fuzz family 28 / the bench gate)."""
+        must be bit-exact against (fuzz family 28 / the bench gate).
+        Read-only schedules only; read-write schedules use
+        :meth:`run_serial_epochs`."""
         from ..query import exec as _exec
 
+        if any(r.kind == "write" for r in requests):
+            raise ValueError(
+                "run_serial replays read-only schedules; use "
+                "run_serial_epochs for a read-write schedule"
+            )
         return [_exec.execute(r.expr, cache=None) for r in requests]
+
+    @staticmethod
+    def run_serial_epochs(
+        clone_requests: Sequence[Request],
+        clone_corpus: Sequence,
+        report: "HarnessReport",
+    ) -> List[object]:
+        """The epoch-replay oracle (ISSUE 15): replay the concurrent
+        run's ADMITTED-EPOCH schedule serially over a cloned corpus.
+
+        ``clone_requests`` is the same seeded schedule rebuilt over
+        ``clone_corpus`` (``build_requests`` is a pure function of the
+        seed, so expressions map 1:1 by position with leaf identity
+        swapped to the clones; the clone must predate the concurrent
+        run). The oracle walks epochs in lineage order: it evaluates
+        every query the concurrent run admitted under epoch ``e``
+        against the clone's epoch-``e`` state, then applies the lineage
+        record's included batches (by the write positions that minted
+        them) to advance the clone to ``e+1``. A query whose concurrent
+        result matches neither its admitted epoch's bits is a TORN READ
+        — the zero-torn-reads gate (fuzz family 29 / meta.epochs) diffs
+        the two result lists positionally."""
+        from ..query import exec as _exec
+        from . import ingest as _ingest_mod
+
+        clone_requests = list(clone_requests)
+        if len(clone_requests) != len(report.results):
+            raise ValueError("oracle schedule does not match the report")
+        # batch id -> the clone-schedule position that minted it
+        pos_of_batch = {
+            bid: pos for pos, bid in enumerate(report.batch_ids)
+            if bid is not None
+        }
+        by_epoch: Dict[int, List[int]] = {}
+        for pos, ep in enumerate(report.epochs):
+            if ep is not None:
+                by_epoch.setdefault(ep, []).append(pos)
+        results: List[object] = [None] * len(clone_requests)
+        for pos, bid in enumerate(report.batch_ids):
+            if bid is not None:
+                results[pos] = ("write", bid)
+        epoch = report.epoch_start
+        # only flips that happened DURING this run advance the clone (the
+        # lineage ring may retain older records from previous windows)
+        lineage = [
+            r for r in report.lineage
+            if r.get("outcome") == "flipped" and r["parent"] >= epoch
+        ]
+        for rec in lineage + [None]:  # None = the final (current) epoch
+            for pos in by_epoch.get(epoch, ()):
+                results[pos] = _exec.execute(
+                    clone_requests[pos].expr, cache=None
+                )
+            if rec is None:
+                break
+            for bid in rec["batches"]:
+                wpos = pos_of_batch.get(bid)
+                if wpos is None:
+                    raise ValueError(
+                        f"lineage batch {bid} has no write position in the "
+                        "schedule (foreign submit during the run?)"
+                    )
+                _ingest_mod.apply_batches(
+                    clone_corpus,
+                    [_ingest_mod.MutationBatch(
+                        clone_requests[wpos].tenant,
+                        clone_requests[wpos].mutations,
+                    )],
+                )
+            epoch = rec["epoch"]
+        return results
 
 
 class HarnessReport:
     """One run's outcome: per-request results aligned with the schedule,
-    per-tenant stats, and the aggregate wall."""
+    per-tenant stats, the aggregate wall, and — for epoch-store runs —
+    the admitted-epoch schedule (per-position epoch for queries, minted
+    batch id for writes) plus the lineage the run published, which is
+    exactly what :meth:`LoadHarness.run_serial_epochs` replays."""
 
-    def __init__(self, requests, results, stats, wall_s):
+    def __init__(self, requests, results, stats, wall_s,
+                 epochs=None, batch_ids=None, lineage=None, epoch_start=0):
         self.requests = requests
         self.results = results
         self.stats = stats
         self.wall_s = wall_s
+        self.epochs = epochs if epochs is not None else [None] * len(requests)
+        self.batch_ids = (
+            batch_ids if batch_ids is not None else [None] * len(requests)
+        )
+        self.lineage = lineage or []
+        self.epoch_start = int(epoch_start)
 
     @property
     def served(self) -> int:
@@ -325,6 +524,10 @@ class HarnessReport:
     @property
     def shed(self) -> int:
         return sum(st.shed for st in self.stats.values())
+
+    @property
+    def writes(self) -> int:
+        return sum(st.writes for st in self.stats.values())
 
     def aggregate_qps(self) -> float:
         return round(self.served / self.wall_s, 1) if self.wall_s > 0 else 0.0
@@ -340,6 +543,7 @@ class HarnessReport:
                 "served": st.served,
                 "shed": st.shed,
                 "queued": st.queued,
+                "writes": st.writes,
                 "qps": round(st.served / self.wall_s, 1) if self.wall_s else 0.0,
                 "queue_p50_ms": st.quantile_ms("queue", 0.5),
                 "queue_p99_ms": st.quantile_ms("queue", 0.99),

@@ -1,0 +1,252 @@
+"""Batched streaming ingestion: the serving tier's WRITE path
+(ISSUE 15 tentpole, leg 1).
+
+PR 14 closed the serving READ path; this module is the other half of
+ROADMAP item 1 — mutations enter the system as **stamped batches** in a
+bounded mutation log while readers keep serving the current epoch's
+packs untouched, and the epoch flip (serve/epochs.py) drains the log
+through the ``models/writer.py`` sorted-stream bulk-add surface into ONE
+O(k) PACK_CACHE delta repack per touched working set.
+
+* :class:`MutationBatch` — one tenant's batch of per-bitmap additions,
+  stamped at ingest (``stamp``, injectable for the staleness demo and
+  fake-clock tests). The stamp is what makes **data freshness** a
+  first-class serving signal: at publish time the epoch flip observes
+  ``now - stamp`` into ``rb_tpu_serve_freshness_seconds{tenant}`` for
+  every batch the new epoch makes queryable — ingest→queryable lag
+  p50/p99 next to the latency SLOs.
+
+* :class:`IngestLog` — the thread-safe bounded log. ``submit()`` is the
+  only write entry point (one leaf-lock append + a counter bump —
+  writers never touch the corpus, so readers are never blocked by
+  ingestion); ``drain()`` is called by the flip, under its
+  writer-exclusive window, and empties the log. The live depth rides
+  ``rb_tpu_serve_mutlog_count`` (pending batches — the
+  ``epoch-flip-stall`` sentinel rule's gauge).
+
+* :func:`apply_batches` — the flip's repack-side helper: merges the
+  drained batches per bitmap index and streams each bitmap's merged,
+  sorted values through a ``BitmapWriter(into=bitmap)`` (the
+  constant-memory sorted-stream path of the reference's
+  ``RoaringBitmapWriter``; arXiv:1709.07821's bulk-construction
+  argument) so every flushed chunk lands through the attributed mutators
+  and the later ``store.packed_for`` repack takes the O(k) delta path.
+
+Tenant label values resolve through the declared ``TENANTS`` registry
+(the metric-naming discipline); batch ids and epoch ids are unbounded
+and live only in the lineage ledger / decision attrs, never in labels.
+
+Lock discipline: the log lock nests over the metrics-registry lock ONLY
+(the PACK_CACHE precedent: ``pack.cache -> observe.registry``, witnessed
+cycle-free): the depth gauge is set while the lock is held, because a
+submit racing a drain could otherwise overwrite the drain's ``set(0)``
+with its own stale pre-drain depth — wedging the gauge nonzero over an
+empty log and firing the ``epoch-flip-stall`` rule on phantom backlog.
+The counter bumps stay outside.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from ..observe import registry as _registry
+from ..observe.histogram import latency_histogram
+from .slo import TENANTS
+
+DEFAULT_MAX_BATCHES = 4096
+
+FRESHNESS = latency_histogram(
+    _registry.SERVE_FRESHNESS_SECONDS,
+    "Data freshness: ingest->queryable lag per tenant, observed at epoch "
+    "publish for every mutation batch the new epoch makes queryable",
+    ("tenant",),
+)
+_INGEST_TOTAL = _registry.counter(
+    _registry.SERVE_INGEST_TOTAL,
+    "Mutation batches accepted into the ingest log by tenant",
+    ("tenant",),
+)
+_MUTLOG_COUNT = _registry.gauge(
+    _registry.SERVE_MUTLOG_COUNT,
+    "Mutation batches currently pending in the ingest log (drained to 0 "
+    "by each epoch flip — the epoch-flip-stall sentinel rule's gauge)",
+)
+
+# process-unique batch ids (atomic under the GIL); lineage-ledger /
+# decision-attr material, never a metric label value
+_BATCH_IDS = itertools.count(1)
+
+
+class MutationBatch:
+    """One stamped mutation batch: ``{bitmap_index: uint32 values}`` from
+    one tenant. ``stamp`` is ``time.monotonic()`` at ingest unless
+    injected (staleness demos, fake clocks)."""
+
+    __slots__ = ("batch_id", "tenant", "mutations", "stamp", "n_values")
+
+    def __init__(self, tenant: str, mutations: Dict[int, np.ndarray],
+                 stamp: Optional[float] = None):
+        self.batch_id = next(_BATCH_IDS)
+        self.tenant = str(tenant)
+        self.mutations: Dict[int, np.ndarray] = {}
+        n = 0
+        for idx, values in mutations.items():
+            v = np.asarray(values, dtype=np.int64).ravel()
+            if v.size == 0:
+                continue
+            if v.min() < 0 or v.max() >= 1 << 32:
+                raise ValueError(
+                    f"batch values for bitmap {idx} outside unsigned 32-bit "
+                    "range"
+                )
+            self.mutations[int(idx)] = v
+            n += int(v.size)
+        self.stamp = time.monotonic() if stamp is None else float(stamp)
+        self.n_values = n
+
+    def touched(self) -> List[int]:
+        return sorted(self.mutations)
+
+    def __repr__(self) -> str:
+        return (f"MutationBatch(id={self.batch_id}, tenant={self.tenant!r}, "
+                f"bitmaps={self.touched()}, values={self.n_values})")
+
+
+class IngestLog:
+    """Thread-safe bounded mutation log. ``submit`` appends (loudly
+    failing past ``max_batches`` — backpressure belongs to admission, not
+    silent drops); ``drain`` empties it for the flip."""
+
+    def __init__(self, max_batches: int = DEFAULT_MAX_BATCHES):
+        if max_batches < 1:
+            raise ValueError(f"max_batches must be >= 1, got {max_batches}")
+        self.max_batches = int(max_batches)
+        # nests over the registry lock only (the depth gauge is set under
+        # it — see the module docstring for why); witnessed cycle-free
+        self._lock = threading.Lock()
+        self._batches: "deque[MutationBatch]" = deque()  # guarded-by: self._lock
+        self._total = 0  # guarded-by: self._lock
+
+    def submit(
+        self,
+        tenant: str,
+        mutations: Dict[int, np.ndarray],
+        stamp: Optional[float] = None,
+    ) -> Optional[MutationBatch]:
+        """Append one stamped batch for a DECLARED tenant; returns the
+        batch (None for an empty mutation set). The corpus is untouched —
+        readers keep serving the current epoch's packs."""
+        canon = TENANTS[tenant]
+        batch = MutationBatch(canon, mutations, stamp=stamp)
+        if not batch.mutations:
+            return None
+        with self._lock:
+            if len(self._batches) >= self.max_batches:
+                raise OverflowError(
+                    f"ingest log full ({self.max_batches} batches): flip or "
+                    "shed before submitting more"
+                )
+            self._batches.append(batch)
+            self._total += 1
+            # gauge set UNDER the lock: racing a drain outside it could
+            # overwrite the drain's 0 with this stale pre-drain depth
+            _MUTLOG_COUNT.set(len(self._batches))
+        _INGEST_TOTAL.inc(1, (TENANTS[tenant],))
+        return batch
+
+    def drain(self) -> List[MutationBatch]:
+        """Pop every pending batch (oldest first). Called by the epoch
+        flip under its writer-exclusive window; the depth gauge drops to
+        0 so a stall (depth with no flip) is visible to the sentinel."""
+        with self._lock:
+            batches = list(self._batches)
+            self._batches.clear()
+            _MUTLOG_COUNT.set(0)
+        return batches
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._batches)
+
+    def pending_values(self) -> int:
+        with self._lock:
+            return sum(b.n_values for b in self._batches)
+
+    def total(self) -> int:
+        """Batches ever accepted (pending + drained)."""
+        with self._lock:
+            return self._total
+
+    def stamps(self) -> List[float]:
+        with self._lock:
+            return [b.stamp for b in self._batches]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._batches.clear()
+            self._total = 0
+            _MUTLOG_COUNT.set(0)
+
+
+def merge_batches(
+    batches: Sequence[MutationBatch],
+) -> Dict[int, np.ndarray]:
+    """Coalesce drained batches into one sorted, deduplicated value array
+    per touched bitmap index — the flip pays ONE writer stream per bitmap
+    regardless of how many batches accumulated (the repack-amortization
+    half of the flip-vs-accumulate trade)."""
+    per_bitmap: Dict[int, List[np.ndarray]] = {}
+    for b in batches:
+        for idx, v in b.mutations.items():
+            per_bitmap.setdefault(idx, []).append(v)
+    return {
+        idx: np.unique(np.concatenate(chunks))
+        for idx, chunks in sorted(per_bitmap.items())
+    }
+
+
+def apply_merged(corpus: Sequence, merged: Dict[int, np.ndarray]) -> int:
+    """Stream pre-merged per-bitmap values into the corpus through the
+    sorted-stream writer surface (``BitmapWriter(into=...)``), one writer
+    per touched bitmap. MUST only run inside the flip's writer-exclusive
+    window (no readers admitted). Returns the number of touched bitmaps."""
+    from ..models.writer import BitmapWriter
+
+    for idx, values in merged.items():
+        if not 0 <= idx < len(corpus):
+            raise IndexError(
+                f"mutation batch touches bitmap {idx} outside the corpus "
+                f"(size {len(corpus)})"
+            )
+        w = BitmapWriter(into=corpus[idx])
+        w.add_many(values)
+        w.flush()
+    return len(merged)
+
+
+def apply_batches(corpus: Sequence, batches: Sequence[MutationBatch]) -> int:
+    """Merge-then-apply convenience over :func:`apply_merged` (the flip
+    merges once itself — it needs the touched set — and applies the
+    merged dict directly; oracles and tests use this form)."""
+    return apply_merged(corpus, merge_batches(batches))
+
+
+def observe_freshness(
+    batches: Iterable[MutationBatch], now: Optional[float] = None
+) -> int:
+    """Record ingest->queryable lag for every published batch (called by
+    the flip's publish stage). Returns the number of observations."""
+    if now is None:
+        now = time.monotonic()
+    n = 0
+    for b in batches:
+        tenant = b.tenant
+        FRESHNESS.observe(max(0.0, now - b.stamp), (TENANTS[tenant],))
+        n += 1
+    return n

@@ -26,7 +26,7 @@ if [[ "${1:-}" != "--fast" ]]; then
   python -m pytest tests/ -q
 fi
 
-step "fuzz smoke (500 iterations x 28 invariant families)"
+step "fuzz smoke (500 iterations x 29 invariant families)"
 python -m roaringbitmap_tpu.fuzz 500 > /tmp/ci_fuzz.log 2>&1 \
   || { tail -20 /tmp/ci_fuzz.log; exit 1; }
 tail -1 /tmp/ci_fuzz.log
@@ -571,7 +571,8 @@ if h.get("cwd_clean") is not True or any(h.get("rules", {}).values()):
     raise SystemExit("end-of-bench rules firing / CWD dirty: %r" % h)
 need_rules = {"costmodel-drift", "routing-regret", "breaker-stuck-open",
               "outcome-anomaly-burst", "hbm-accounting-drift", "compile-storm",
-              "fusion-queue-stall", "serving-p99-breach", "tenant-saturation"}
+              "fusion-queue-stall", "serving-p99-breach", "tenant-saturation",
+              "freshness-lag-breach", "epoch-flip-stall"}
 if set(h.get("rules", {})) != need_rules:
     raise SystemExit("committed rule table changed: %r" % sorted(h.get("rules", {})))
 side = json.load(open("/tmp/ci_bench_metrics.json"))
@@ -624,13 +625,16 @@ if hd["rules"]["ci-forced-red"]["level"] != 2 or not hd["rules"]["ci-forced-red"
     raise SystemExit("bundle health.json lacks the red rule state/history")
 cal = json.load(open(os.path.join(path, "calibration.json")))
 if set(cal.get("authorities", {})) != {"columnar-cutoff", "device-breakeven",
-                                       "fusion-batch", "pack-residency",
+                                       "epoch-flip", "fusion-batch",
+                                       "pack-residency",
                                        "planner-cardinality", "serve-admission"}:
-    raise SystemExit("bundle calibration.json lacks the six authorities: %r"
+    raise SystemExit("bundle calibration.json lacks the seven authorities: %r"
                      % sorted(cal.get("authorities", {})))
 obs = json.load(open(os.path.join(path, "observatory.json")))
 if "serving" not in obs:
     raise SystemExit("bundle observatory.json lacks the serving panel")
+if "epochs" not in obs:
+    raise SystemExit("bundle observatory.json lacks the epoch panel")
 new_cwd = sorted(set(os.listdir(".")) - cwd_before)
 if new_cwd:
     raise SystemExit("forced red tick wrote into the CWD: %r" % new_cwd)
@@ -889,32 +893,170 @@ need_host = {"cpu_count", "backend", "device_kind", "device_count"}
 if not (isinstance(host, dict) and need_host <= set(host)):
     raise SystemExit("bench meta lacks host provenance: %r" % host)
 for block in ("columnar", "columnar_device", "overlap", "fusion", "serving",
-              "observability"):
+              "epochs", "observability"):
     if m.get(block, {}).get("host") != host:
         raise SystemExit("twin block %s lacks the host provenance stamp" % block)
 print("serving metric names ok (suffixes + declared label sets; fault site "
-      "registered; host provenance stamped into %d twin blocks)" % 6)'
+      "registered; host provenance stamped into %d twin blocks)" % 7)'
 
-step "rb_top observatory report (schema rb_tpu_top/5, ISSUE 9 + 11 + 12 + 13 + 14)"
+step "epoch ledger: freshness rows, torn reads, flip attribution, staleness demo (ISSUE 15)"
+# the bench must commit meta.epochs: read-write rows at 2 ingest rates
+# (each bit-exact vs the epoch-replay oracle — zero torn reads),
+# freshness p50/p99 per rate, ZERO full repacks on the warm flip path
+# (the O(k) delta contract), aggregate QPS at the low rate within 10%
+# of the read-only twin, flip-stage timeline attribution >=90%, the
+# epoch.flip site joined with regret <=5% + refit provenance, and the
+# seeded staleness demo (stale publishes -> freshness-lag-breach red ->
+# bundle carries the epoch panel with lineage -> green); the metrics
+# sidecar must carry the registry-derived epochs block
+python -c '
+import json
+m = json.load(open("/tmp/ci_bench.json"))["meta"]
+ep = m.get("epochs")
+if not isinstance(ep, dict):
+    raise SystemExit("bench meta lacks the epochs block")
+need = {"host", "rates", "read_only_qps", "low_rate_qps_ratio", "torn_reads",
+        "bitexact", "flip_attribution_pct", "flip_decision", "staleness_demo",
+        "lineage_tail"}
+missing = need - set(ep)
+if missing:
+    raise SystemExit("epochs block lacks %s" % sorted(missing))
+rates = ep["rates"]
+if set(rates) != {"low", "high"}:
+    raise SystemExit("epochs rows do not cover 2 ingest rates: %r" % sorted(rates))
+for name, row in rates.items():
+    if not row.get("writes", 0) > 0:
+        raise SystemExit("epoch rate %s ingested no batches: %r" % (name, row))
+    if not row.get("flips", 0) > 0:
+        raise SystemExit("epoch rate %s never flipped: %r" % (name, row))
+    fr = row.get("freshness_ms", {})
+    if not (fr.get("p50", 0) > 0 and fr.get("p99", 0) >= fr.get("p50", 0)):
+        raise SystemExit("epoch rate %s freshness p50/p99 malformed: %r" % (name, fr))
+    if row.get("torn_reads") != 0:
+        raise SystemExit("epoch rate %s saw torn reads: %r" % (name, row))
+    d = row.get("delta", {})
+    if d.get("full_repacks") != 0 or not d.get("delta_rows", 0) > 0:
+        raise SystemExit("epoch rate %s flips left the O(k) delta path: %r" % (name, d))
+    if not row.get("aggregate_qps", 0) > 0:
+        raise SystemExit("epoch rate %s has no aggregate QPS" % name)
+if ep["torn_reads"] != 0 or ep["bitexact"] is not True:
+    raise SystemExit("epoch windows were not torn-free bit-exact: %r"
+                     % {"torn": ep["torn_reads"], "bitexact": ep["bitexact"]})
+if not ep["low_rate_qps_ratio"] >= 0.9:
+    raise SystemExit("low-rate ingest taxed read-only QPS past 10%%: %s"
+                     % ep["low_rate_qps_ratio"])
+if not ep["flip_attribution_pct"] >= 90.0:
+    raise SystemExit("flip stages attribute only %s%% of the flip wall"
+                     % ep["flip_attribution_pct"])
+fd = ep["flip_decision"]
+if not fd.get("joins", 0) > 0:
+    raise SystemExit("no epoch.flip outcomes joined: %r" % fd)
+if not (0.0 <= fd.get("regret", 1) <= 0.05):
+    raise SystemExit("epoch.flip regret %s blew the 5%% budget" % fd.get("regret"))
+if fd.get("refit", {}).get("provenance") != "refit-from-traffic":
+    raise SystemExit("epoch-flip curve never refit from traffic: %r" % fd)
+sd = ep["staleness_demo"]
+if sd.get("rule") != "freshness-lag-breach" or sd.get("ticks_to_red") is None:
+    raise SystemExit("staleness demo did not fire freshness-lag-breach: %r" % sd)
+if sd.get("status_end") != "green":
+    raise SystemExit("staleness demo did not clear green: %r" % sd.get("status_end"))
+bun = sd.get("bundle", {})
+if not (bun.get("epoch_panel") is True and bun.get("files", 0) >= 7
+        and bun.get("lineage_epochs")):
+    raise SystemExit("staleness red bundle lacks the epoch panel/lineage: %r" % bun)
+side = json.load(open("/tmp/ci_bench_metrics.json"))
+sep = side.get("epochs")
+if not isinstance(sep, dict):
+    raise SystemExit("metrics sidecar lacks the epochs block")
+smissing = {"epoch", "mutlog_depth", "flips", "ingest", "freshness",
+            "flip_stages"} - set(sep)
+if smissing:
+    raise SystemExit("sidecar epochs block lacks %s" % sorted(smissing))
+if not sep.get("flips", {}).get("flipped"):
+    raise SystemExit("sidecar epochs block records no flips: %r" % sep.get("flips"))
+for stage in ("drain", "repack", "publish", "reclaim"):
+    if stage not in sep.get("flip_stages", {}):
+        raise SystemExit("sidecar epochs block lacks flip stage %r" % stage)
+print("epoch rows ok (freshness p99 low %sms / high %sms; qps ratio %s; "
+      "flips low %d / high %d all-delta; attribution %s%%; flip joins %d "
+      "regret %s err %s; staleness red tick %s -> green tick %s, bundle "
+      "lineage %s)"
+      % (rates["low"]["freshness_ms"]["p99"], rates["high"]["freshness_ms"]["p99"],
+         ep["low_rate_qps_ratio"], rates["low"]["flips"], rates["high"]["flips"],
+         ep["flip_attribution_pct"], fd["joins"], fd["regret"],
+         fd.get("error_ratio_geomean"), sd.get("ticks_to_red"),
+         sd.get("ticks_to_green"), bun.get("lineage_epochs")))'
+# the epoch metric names must pass the naming convention with declared
+# label sets, the epoch.flip fault site and seventh authority must be
+# registered, and epoch ids must never be metric label values (the rule
+# clause rides analyze --check; pinned here against the live registry)
+JAX_PLATFORMS=cpu python -c '
+from roaringbitmap_tpu import cost, observe
+from roaringbitmap_tpu.robust import faults
+for name, suffix in ((observe.SERVE_FRESHNESS_SECONDS, "_seconds"),
+                     (observe.SERVE_FLIP_STAGE_SECONDS, "_seconds"),
+                     (observe.SERVE_INGEST_TOTAL, "_total"),
+                     (observe.SERVE_EPOCH_FLIP_TOTAL, "_total"),
+                     (observe.SERVE_MUTLOG_COUNT, "_count"),
+                     (observe.SERVE_EPOCH_COUNT, "_count")):
+    if not (name.startswith("rb_tpu_") and name.endswith(suffix)):
+        raise SystemExit("epoch metric violates naming convention: %r" % name)
+import roaringbitmap_tpu.serve  # registers the epoch metrics
+fr = observe.REGISTRY.get(observe.SERVE_FRESHNESS_SECONDS)
+if fr is None or fr.labelnames != ("tenant",):
+    raise SystemExit("freshness label set is not the declared (tenant,)")
+fs = observe.REGISTRY.get(observe.SERVE_FLIP_STAGE_SECONDS)
+if fs is None or fs.labelnames != ("stage",):
+    raise SystemExit("flip-stage label set is not the declared (stage,)")
+eg = observe.REGISTRY.get(observe.SERVE_EPOCH_COUNT)
+if eg is None or eg.labelnames != ():
+    raise SystemExit("epoch gauge must be unlabeled (epoch ids are VALUES)")
+if "epoch.flip" not in faults.SITES:
+    raise SystemExit("epoch.flip fault site not registered")
+if "epoch-flip" not in cost.names():
+    raise SystemExit("epoch-flip authority not registered in the cost facade")
+from roaringbitmap_tpu.analysis.rules.metrics import _EPOCH_VALUE
+if not (_EPOCH_VALUE.search("epoch") and _EPOCH_VALUE.search("epoch_id")):
+    raise SystemExit("metric-naming rule lost the epoch label-value clause")
+print("epoch metric names ok (suffixes + declared label sets; fault site + "
+      "seventh authority registered; epoch-id label clause armed)"
+)'
+
+step "rb_top observatory report (schema rb_tpu_top/6, ISSUE 9 + 11 + 12 + 13 + 14 + 15)"
 # the snapshot CLI must produce a schema-valid JSON report with every
 # panel populated from its in-process demo workload — incl. the regret
 # panel (per-site joins from the decision-outcome ledger), the health
-# panel (sentinel status + the committed rule table, judged green), and
-# the fusion panel (window occupancy + shared-subexpression hit ratio
-# from the demo's fused window)
+# panel (sentinel status + the committed rule table, judged green), the
+# fusion panel (window occupancy + shared-subexpression hit ratio from
+# the demo's fused window), and the epoch panel (current epoch, mutlog
+# depth, freshness, flip stages, lineage from the demo's read-write
+# window)
 JAX_PLATFORMS=cpu RB_TPU_ARTIFACT_DIR=/tmp/ci_artifacts \
   python scripts/rb_top.py --demo --json > /tmp/ci_rb_top.json
 python -c '
 import json
 r = json.load(open("/tmp/ci_rb_top.json"))
-if r.get("schema") != "rb_tpu_top/5":
+if r.get("schema") != "rb_tpu_top/6":
     raise SystemExit("rb_top: bad schema %r" % r.get("schema"))
 need = {"schema", "generated_utc", "source", "counters", "latency",
         "locks", "breakers", "cache", "decisions_tail", "regret", "health",
-        "fusion", "serving"}
+        "fusion", "serving", "epochs"}
 missing = need - set(r)
 if missing:
     raise SystemExit("rb_top report lacks %s" % sorted(missing))
+ep = r["epochs"]
+if not (ep.get("epoch", 0) >= 1 and ep.get("mutlog_depth") == 0):
+    raise SystemExit("rb_top demo epoch panel lacks a published flip: %r"
+                     % {k: ep.get(k) for k in ("epoch", "mutlog_depth")})
+if not ep.get("flips", {}).get("flipped"):
+    raise SystemExit("rb_top demo recorded no flip outcome: %r" % ep.get("flips"))
+if not any(row.get("p99", 0) > 0 for row in (ep.get("freshness") or {}).values()):
+    raise SystemExit("rb_top demo freshness p99 missing: %r" % ep.get("freshness"))
+for stage in ("drain", "repack", "publish", "reclaim"):
+    if not (ep.get("flip_stages", {}).get(stage, {}).get("count", 0) >= 1):
+        raise SystemExit("rb_top demo flip stage %r unrecorded" % stage)
+if not (ep.get("lineage") and ep["lineage"][-1].get("epoch") == ep["epoch"]):
+    raise SystemExit("rb_top demo epoch lineage missing/stale: %r" % ep.get("lineage"))
 sv = r["serving"]
 if not sv.get("tenants"):
     raise SystemExit("rb_top demo served no tenants: %r" % sv)

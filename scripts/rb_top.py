@@ -34,13 +34,20 @@ QPS/p50/p99, queue depth and in-flight, shed counts, saturation, byte
 shares, and the admission curve's joined regret (which rides the regret
 panel under the ``serve.admit`` site).
 
-``--json`` emits the machine-readable report (schema ``rb_tpu_top/5``:
-the ``serving`` key landed in /5, ``fusion`` in /4, ``health`` in /3,
-``regret`` in /2; scripts/ci.sh validates it). Breaker states, the decision log, the
-outcome ledger, and sentinel rule states are process-local, so a
-sidecar-sourced report carries the sidecar's registry view of them
-(counter totals + the ``regret``/``health``/``fusion`` blocks derived in
-export.py) rather than live states.
+Since ISSUE 15 the report carries the **epoch panel**: the current
+epoch, live mutation-log depth, per-tenant freshness p50/p99
+(ingest->queryable lag), the last flip's stage breakdown, flip volume by
+outcome, and the live EpochStore's lineage tail (flip regret rides the
+regret panel under the ``epoch.flip`` site).
+
+``--json`` emits the machine-readable report (schema ``rb_tpu_top/6``:
+the ``epochs`` key landed in /6, ``serving`` in /5, ``fusion`` in /4,
+``health`` in /3, ``regret`` in /2; scripts/ci.sh validates it).
+Breaker states, the decision log, the outcome ledger, sentinel rule
+states, and epoch lineage are process-local, so a sidecar-sourced
+report carries the sidecar's registry view of them (counter totals + the
+``regret``/``health``/``fusion``/``epochs`` blocks derived in export.py)
+rather than live states.
 """
 
 from __future__ import annotations
@@ -54,7 +61,7 @@ import time
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
-SCHEMA = "rb_tpu_top/5"
+SCHEMA = "rb_tpu_top/6"
 
 
 def _live_report(tail: int) -> dict:
@@ -93,6 +100,9 @@ def _live_report(tail: int) -> dict:
         # serving tier (ISSUE 14): per-tenant QPS/p50/p99, admission
         # verdicts, queue/in-flight depth, saturation, byte shares
         "serving": insights.serving(),
+        # epoch ledger (ISSUE 15): current epoch, mutlog depth, freshness
+        # p50/p99, flip stage breakdown, live lineage tail
+        "epochs": insights.epochs(),
     }
 
 
@@ -145,7 +155,16 @@ def _sidecar_report(path: str, tail: int) -> dict:
         "fusion": side.get("fusion", {}),
         # the sidecar's registry-derived serving block (export.py)
         "serving": side.get("serving", {}),
+        # the sidecar's registry-derived epochs block (export.py; lineage
+        # is process-local and absent from a sidecar rendering)
+        "epochs": side.get("epochs", {}),
     }
+
+
+# the demo's epoch store must outlive _demo_workload: the epoch panel
+# reads the CURRENT store through a weakref (serve/epochs.py), so a
+# garbage-collected demo store would render an empty lineage
+_DEMO_KEEPALIVE = []
 
 
 def _demo_workload() -> None:
@@ -192,6 +211,21 @@ def _demo_workload() -> None:
     ]
     harness = LoadHarness(bms, profiles, threads=2, window=4)
     harness.run(build_requests(bms, profiles, 12, seed=11))
+    # a read-write window over an epoch store so the epoch panel reports
+    # a real flip: a writer tenant interleaves mutation batches, the flip
+    # publishes, freshness + flip stages land in the registry (ISSUE 15)
+    from roaringbitmap_tpu.serve import EpochStore
+
+    rw_profiles = [
+        TenantProfile("demo-gold", weight=2.0, quota_qps=500),
+        TenantProfile("demo-writer", weight=1.0, quota_qps=500, writes=0.6),
+    ]
+    es = EpochStore(bms)
+    _DEMO_KEEPALIVE.append(es)
+    rw_harness = LoadHarness(
+        bms, rw_profiles, threads=2, window=4, epoch_store=es
+    )
+    rw_harness.run(build_requests(bms, rw_profiles, 12, seed=13))
     # a couple of sentinel ticks so the health panel reports a judged
     # status (hysteresis needs consecutive evaluations), not "never ran"
     from roaringbitmap_tpu.observe import sentinel
@@ -372,6 +406,34 @@ def _render_console(r: dict) -> str:
              f"{live_adm.get('max_inflight')} queued {live_adm.get('queued')}")
         )
     section("serving (per-tenant SLO)", sv_rows)
+    # epoch panel (ISSUE 15): current epoch, log depth, per-tenant
+    # freshness p50/p99, last flip's stage breakdown, lineage tail
+    ep = r.get("epochs", {}) or {}
+    ep_rows = []
+    if ep.get("epoch") is not None:
+        ep_rows.append(("current epoch", ep["epoch"]))
+    if ep.get("mutlog_depth") is not None:
+        ep_rows.append(("mutation-log depth", ep["mutlog_depth"]))
+    for outcome, v in sorted((ep.get("flips") or {}).items()):
+        ep_rows.append((f"flips[{outcome}]", v))
+    for tenant, row in sorted((ep.get("freshness") or {}).items()):
+        ep_rows.append(
+            (f"freshness[{tenant}]",
+             f"n={row.get('count')} p50={row.get('p50')} p99={row.get('p99')}")
+        )
+    for stage_name, row in sorted((ep.get("flip_stages") or {}).items()):
+        ep_rows.append(
+            (f"stage[{stage_name}]",
+             f"n={row.get('count')} sum={row.get('sum')}s p99={row.get('p99')}")
+        )
+    for rec in (ep.get("lineage") or [])[-4:]:
+        ep_rows.append(
+            (f"epoch {rec.get('epoch')}",
+             f"parent={rec.get('parent')} batches={rec.get('batches')} "
+             f"values={rec.get('values')} wall={rec.get('wall_s')}s "
+             f"delta_rows={rec.get('delta', {}).get('delta_rows')}")
+        )
+    section("epochs (ingest & freshness)", ep_rows)
     dec_rows = [
         (d.get("trace") or "-",
          f"{d['site']}: {d['decision']} {d.get('inputs', '')}")

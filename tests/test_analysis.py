@@ -1024,6 +1024,47 @@ def test_live_serve_tree_is_clean_under_tenant_rule():
     assert [f for f in res.findings] == []
 
 
+EPOCH_LABEL_SRC = """from roaringbitmap_tpu import observe
+_EP_TOTAL = observe.counter("rb_tpu_ep_total", "", ("stage",))
+_EP_SECONDS = observe.latency_histogram(
+    "rb_tpu_ep_seconds", "", ("stage",))
+FLIP_STAGES = ("drain", "repack")
+def flip(epoch, epoch_id, si, stage):
+    _EP_SECONDS.observe(0.1, (FLIP_STAGES[si],))
+    _EP_TOTAL.inc(1, ("drain",))
+    _EP_TOTAL.inc(1, (stage,))
+    _EP_TOTAL.inc(1, (epoch,))
+    _EP_SECONDS.observe(0.1, (epoch_id,))
+"""
+
+
+def test_metric_label_values_epoch_ids_never_labels(tmp_path):
+    # ISSUE 15 satellite: epoch ids are unbounded (one per flip,
+    # forever) and must never be metric label values — the declared
+    # FLIP_STAGES subscript (line 7), a stage literal (line 8), and a
+    # benign `stage` enumerator variable (line 9) all pass; the bare
+    # epoch / epoch_id variables (lines 10-11) are flagged with the
+    # ledger-pointing message
+    res = _run_snippet(tmp_path, EPOCH_LABEL_SRC, rules=["metric-naming"])
+    assert {f.line for f in res.findings} == {10, 11}
+    assert all("epoch ledger" in f.message for f in res.findings)
+
+
+def test_live_epoch_tree_is_clean_under_epoch_rule():
+    # the epoch tier itself must pass the discipline it motivated: epoch
+    # ids ride gauges/ledger/attrs, stage labels come from the declared
+    # FLIP_STAGES set, freshness labels from TENANTS[...]
+    import roaringbitmap_tpu.serve.epochs as seps
+    import roaringbitmap_tpu.serve.ingest as sing
+
+    from roaringbitmap_tpu.analysis import run_checks
+
+    res = run_checks(
+        [seps.__file__, sing.__file__], rules=["metric-naming"],
+    )
+    assert [f for f in res.findings] == []
+
+
 def test_live_tree_has_no_unbounded_label_values():
     # the rule runs over the real package in test_live_tree_is_clean-style
     # gates elsewhere; pin here that the columnar fold labels (the one

@@ -1,0 +1,758 @@
+"""Epoch ledger tests (ISSUE 15): the writer ingest surface, the
+stamped mutation log, snapshot-isolated epoch flips (drain / repack /
+publish / reclaim), the O(k) delta contract on the flip path, freshness
+observability, the epoch.flip fault site failing CLOSED, the seventh
+cost authority's round-trip + refit, the two new sentinel rules, the
+read-write harness vs the epoch-replay oracle (fuzz family 29 seed
+pin), validated publication across a flip, and the 16-thread hammer
+with the lock witness proving the epoch store/ingest locks are leaves."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import cost, insights, observe
+from roaringbitmap_tpu.analysis.lockwitness import LockWitness
+from roaringbitmap_tpu.cost import epoch as epoch_cost
+from roaringbitmap_tpu.models.roaring import RoaringBitmap
+from roaringbitmap_tpu.models.writer import BitmapWriter
+from roaringbitmap_tpu.observe import health, outcomes
+from roaringbitmap_tpu.observe import timeline as tl
+from roaringbitmap_tpu.parallel import store
+from roaringbitmap_tpu.robust import faults
+from roaringbitmap_tpu.robust.errors import TransientDeviceError
+from roaringbitmap_tpu.serve import (
+    AdmissionController,
+    EpochStore,
+    LoadHarness,
+    TenantProfile,
+    build_requests,
+)
+from roaringbitmap_tpu.serve import epochs as epochs_mod
+from roaringbitmap_tpu.serve import ingest as ingest_mod
+from roaringbitmap_tpu.serve import slo
+
+
+@pytest.fixture(autouse=True)
+def _epoch_state():
+    """Every test starts from a clean tenant/ledger/model/fault state
+    and leaves none behind."""
+    slo.reset()
+    outcomes.reset()
+    epoch_cost.MODEL.reset()
+    faults.clear()
+    yield
+    slo.reset()
+    outcomes.reset()
+    epoch_cost.MODEL.reset()
+    faults.clear()
+    store.PACK_CACHE.close()  # flip repacks must not leak residency
+
+
+def _corpus(n=6, seed=3, card=1200):
+    rng = np.random.default_rng(seed)
+    return [
+        RoaringBitmap(
+            np.sort(rng.choice(1 << 18, card, replace=False)).astype(np.uint32)
+        )
+        for _ in range(n)
+    ]
+
+
+def _declare(name="ep-t"):
+    slo.TENANTS.declare(name, quota_qps=1e9, burst=1e9)
+    return name
+
+
+# ---------------------------------------------------------------------------
+# the writer ingest surface (models/writer.py into=)
+# ---------------------------------------------------------------------------
+
+
+def test_writer_into_streams_into_existing_bitmap_with_attribution():
+    bm = RoaringBitmap(np.array([1, 2, (5 << 16) | 7], dtype=np.uint32))
+    base_version = bm.high_low_container._version
+    w = BitmapWriter(into=bm)
+    w.add_many(np.array([3, (5 << 16) | 8, (9 << 16) | 1], dtype=np.int64))
+    w.flush()
+    assert bm.contains(3) and bm.contains((5 << 16) | 8)
+    assert bm.contains((9 << 16) | 1) and bm.contains(1)
+    # every flushed chunk landed through the attributed mutators: the
+    # dirty scan names exactly the touched chunk keys (the O(k) delta
+    # contract's substrate)
+    dirty = bm.high_low_container.dirty_keys_since(base_version)
+    assert dirty == {0, 5, 9}
+    assert w.get() is bm
+
+
+def test_writer_into_rejects_fast_rank_mismatch():
+    with pytest.raises(ValueError):
+        BitmapWriter(fast_rank=True, into=RoaringBitmap())
+
+
+# ---------------------------------------------------------------------------
+# the stamped mutation log
+# ---------------------------------------------------------------------------
+
+
+def test_ingest_log_submit_drain_and_depth_gauge():
+    t = _declare()
+    log = ingest_mod.IngestLog(max_batches=2)
+    b1 = log.submit(t, {0: np.array([1, 2])}, stamp=10.0)
+    b2 = log.submit(t, {1: np.array([3])}, stamp=11.0)
+    assert log.depth() == 2 and log.pending_values() == 3
+    assert log.stamps() == [10.0, 11.0]
+    g = observe.REGISTRY.get(observe.SERVE_MUTLOG_COUNT)
+    assert g.series().get(()) == 2
+    with pytest.raises(OverflowError):
+        log.submit(t, {0: np.array([9])})
+    drained = log.drain()
+    assert [b.batch_id for b in drained] == [b1.batch_id, b2.batch_id]
+    assert log.depth() == 0 and g.series().get(()) == 0
+    assert log.total() == 2
+    # an empty mutation set is a no-op, not a batch
+    assert log.submit(t, {0: np.array([], dtype=np.int64)}) is None
+
+
+def test_ingest_log_rejects_undeclared_tenant_and_bad_values():
+    log = ingest_mod.IngestLog()
+    with pytest.raises(KeyError):
+        log.submit("never-declared", {0: np.array([1])})
+    t = _declare()
+    with pytest.raises(ValueError):
+        log.submit(t, {0: np.array([1 << 32])})
+
+
+def test_merge_batches_coalesces_sorted_unique():
+    t = _declare()
+    b1 = ingest_mod.MutationBatch(t, {0: np.array([5, 1]), 2: np.array([7])})
+    b2 = ingest_mod.MutationBatch(t, {0: np.array([5, 3])})
+    merged = ingest_mod.merge_batches([b1, b2])
+    assert list(merged) == [0, 2]
+    assert merged[0].tolist() == [1, 3, 5]
+
+
+def test_apply_batches_out_of_range_raises():
+    t = _declare()
+    corpus = _corpus(2)
+    b = ingest_mod.MutationBatch(t, {5: np.array([1])})
+    with pytest.raises(IndexError):
+        ingest_mod.apply_batches(corpus, [b])
+
+
+# ---------------------------------------------------------------------------
+# the flip: publication, lineage, stages, delta contract
+# ---------------------------------------------------------------------------
+
+
+def test_flip_publishes_epoch_with_lineage_record():
+    t = _declare()
+    corpus = _corpus(4)
+    es = EpochStore(corpus)
+    assert es.current() == 0
+    assert es.flip()["outcome"] == "noop"  # empty log: no epoch burned
+    assert es.current() == 0
+    b = es.submit(t, {1: np.array([7, 9])}, stamp=0.0)
+    rec = es.flip(reason="test")
+    assert rec["outcome"] == "flipped" and rec["epoch"] == 1
+    assert rec["parent"] == 0 and rec["batches"] == [b.batch_id]
+    assert rec["touched_bitmaps"] == [1] and rec["values"] == 2
+    assert rec["wall_s"] > 0
+    assert corpus[1].contains(7) and corpus[1].contains(9)
+    assert es.current() == 1
+    lin = es.lineage()
+    assert lin[-1]["epoch"] == 1 and lin[-1]["tenants"] == [t]
+    g = observe.REGISTRY.get(observe.SERVE_EPOCH_COUNT)
+    assert g.series().get(()) == 1
+
+
+def test_warm_flip_takes_the_delta_path_not_full_repack():
+    t = _declare()
+    corpus = _corpus(4)
+    es = EpochStore(corpus)
+    store.PACK_CACHE.close()
+    try:
+        store.packed_for(corpus)  # resident (cold pack happens HERE)
+        hb = int(corpus[0].high_low_container.keys[0])
+        es.submit(t, {0: np.array([(hb << 16) | 4242, (hb << 16) | 4243])})
+        rec = es.flip()
+        # the flip path itself pays ONE O(k) apply_delta, zero full packs
+        assert rec["delta"]["full_repacks"] == 0, rec["delta"]
+        assert rec["delta"]["delta_rows"] == 1
+        assert rec["delta"]["working_sets"] == 1
+    finally:
+        store.PACK_CACHE.close()
+
+
+def test_pack_cache_last_route_is_thread_local_classification():
+    corpus = _corpus(4)
+    store.PACK_CACHE.close()
+    try:
+        store.packed_for(corpus)
+        assert store.PACK_CACHE.last_route() == ("full", 0)
+        store.packed_for(corpus)
+        assert store.PACK_CACHE.last_route() == ("hit", 0)
+        hb = int(corpus[0].high_low_container.keys[0])
+        corpus[0].add((hb << 16) | 4242)
+        store.packed_for(corpus)
+        assert store.PACK_CACHE.last_route() == ("delta", 1)
+        # another thread's calls never clobber this thread's read
+        done = {}
+
+        def other():
+            store.packed_for([bm.clone() for bm in corpus])  # a full pack
+            done["route"] = store.PACK_CACHE.last_route()
+
+        th = threading.Thread(target=other, daemon=True)
+        th.start()
+        th.join(10.0)
+        assert done["route"] == ("full", 0)
+        assert store.PACK_CACHE.last_route() == ("delta", 1)
+    finally:
+        store.PACK_CACHE.close()
+
+
+def test_flip_stages_land_in_histogram_and_timeline():
+    t = _declare()
+    corpus = _corpus(4)
+    es = EpochStore(corpus)
+    hist = observe.REGISTRY.get(observe.SERVE_FLIP_STAGE_SECONDS)
+    before = {
+        stage: (hist.series().get((stage,)) or {"count": 0})["count"]
+        for stage in epochs_mod.FLIP_STAGES
+    }
+    prev = tl.mode_name()
+    tl.configure(mode="on")
+    tl.RECORDER.clear()
+    try:
+        es.submit(t, {0: np.array([3])})
+        es.flip()
+    finally:
+        tl.configure(mode=prev)
+    after = {
+        stage: hist.series()[(stage,)]["count"]
+        for stage in epochs_mod.FLIP_STAGES
+    }
+    for stage in epochs_mod.FLIP_STAGES:
+        assert after[stage] == before[stage] + 1, stage
+    names = [e.name for e in tl.RECORDER.events()]
+    assert "epoch.flip" in names
+    for span in ("epoch.drain", "epoch.repack", "epoch.publish", "epoch.reclaim"):
+        assert span in names, names
+    pub = next(e for e in tl.RECORDER.events() if e.name == "epoch.publish")
+    assert pub.attrs["epoch"] == 1  # the epoch id rides span ATTRS
+
+
+def test_freshness_observed_at_publish_with_injected_stamps():
+    t = _declare("fresh-t")
+    corpus = _corpus(4)
+    fake = [100.0]
+    es = EpochStore(corpus, clock=lambda: fake[0])
+    es.submit(t, {0: np.array([1])}, stamp=95.0)  # 5 s stale at publish
+    es.submit(t, {1: np.array([2])}, stamp=99.0)  # 1 s stale
+    es.flip()
+    st = ingest_mod.FRESHNESS.series()[(t,)]
+    assert st["count"] == 2
+    assert 5.9 <= st["sum"] <= 6.1  # 5 + 1 seconds of lag
+
+
+# ---------------------------------------------------------------------------
+# snapshot isolation
+# ---------------------------------------------------------------------------
+
+
+def test_reader_pin_blocks_flip_until_release():
+    t = _declare()
+    corpus = _corpus(4)
+    es = EpochStore(corpus)
+    es.submit(t, {0: np.array([1])})
+    ticket = es.reader()
+    done = threading.Event()
+    rec_box = {}
+
+    def flipper():
+        rec_box["rec"] = es.flip()
+        done.set()
+
+    th = threading.Thread(target=flipper, daemon=True)
+    th.start()
+    # the flip cannot publish while the reader pin is held
+    assert not done.wait(0.15)
+    assert es.current() == 0
+    ticket.release()
+    assert done.wait(5.0)
+    assert rec_box["rec"]["outcome"] == "flipped" and es.current() == 1
+
+
+def test_reader_admitted_during_flip_waits_and_gets_new_epoch():
+    t = _declare()
+    corpus = _corpus(4)
+    # a slow flip window: the repack is real work, so park a reader pin
+    # and release it from a timer to widen the drain stage
+    es = EpochStore(corpus)
+    es.submit(t, {0: np.array([1])})
+    pin = es.reader()
+    got = {}
+    started = threading.Event()
+
+    def flipper():
+        started.set()
+        es.flip()
+
+    def late_reader():
+        started.wait()
+        time.sleep(0.05)  # flip is now draining on the held pin
+        with es.reader() as tk2:
+            got["epoch"] = tk2.epoch
+
+    th1 = threading.Thread(target=flipper, daemon=True)
+    th2 = threading.Thread(target=late_reader, daemon=True)
+    th1.start()
+    th2.start()
+    time.sleep(0.15)
+    pin.release()
+    th1.join(5.0)
+    th2.join(5.0)
+    assert got["epoch"] == 1  # parked through the flip, woke on the NEW epoch
+
+
+def test_snapshot_isolation_hammer_no_torn_reads():
+    """XOR witness: each flip adds the SAME fresh value to bitmaps 0 and
+    1 in one batch. A snapshot reader computing xor(bm0, bm1) must never
+    see the value (pre-flip: in neither; post-flip: in both; torn: in
+    exactly one — which is what the xor would expose)."""
+    t = _declare()
+    corpus = _corpus(4)
+    es = EpochStore(corpus)
+    # a chunk key past the corpus range (values < 2^18 = keys 0..3), so
+    # the witness values are guaranteed absent from every bitmap
+    witness = [(7 << 16) | (60000 + i) for i in range(40)]
+    for v in witness:
+        assert not corpus[0].contains(v) and not corpus[1].contains(v)
+    stop = threading.Event()
+    errors = []
+
+    def reader():
+        while not stop.is_set():
+            try:
+                with es.reader():
+                    x = RoaringBitmap.xor(corpus[0], corpus[1])
+                    for v in witness:
+                        assert not x.contains(v), f"torn read: {v}"
+            except Exception as e:  # rb-ok: exception-hygiene -- hammer collects escapes to assert none happened
+                errors.append(e)
+                return
+
+    readers = [threading.Thread(target=reader, daemon=True) for _ in range(6)]
+    for th in readers:
+        th.start()
+    try:
+        for v in witness:
+            es.submit(t, {0: np.array([v]), 1: np.array([v])})
+            rec = es.flip()
+            assert rec["outcome"] == "flipped"
+    finally:
+        stop.set()
+        for th in readers:
+            th.join(10.0)
+    assert not errors, errors[0]
+    assert es.current() == len(witness)
+    assert all(corpus[0].contains(v) and corpus[1].contains(v) for v in witness)
+
+
+# ---------------------------------------------------------------------------
+# fault site + drain stall
+# ---------------------------------------------------------------------------
+
+
+def test_epoch_flip_fault_fails_closed_to_old_epoch():
+    t = _declare()
+    corpus = _corpus(4)
+    es = EpochStore(corpus)
+    es.submit(t, {0: np.array([1])})
+    with faults.inject("epoch.flip", TransientDeviceError("boom"), every=1):
+        rec = es.flip()
+    assert rec["outcome"] == "aborted"
+    assert es.current() == 0
+    assert es.log.depth() == 1  # the log keeps accumulating
+    assert not corpus[0].contains(1)  # stale, never torn
+    # a FATAL (programming) error is never laundered into a degrade
+    with faults.inject("epoch.flip", ValueError("bug"), every=1):
+        with pytest.raises(ValueError):
+            es.flip()
+    # after the fault clears, the flip drains everything
+    rec = es.flip()
+    assert rec["outcome"] == "flipped" and corpus[0].contains(1)
+
+
+def test_drain_timeout_stalls_cleanly_and_recovers():
+    t = _declare()
+    corpus = _corpus(4)
+    es = EpochStore(corpus, drain_timeout_s=0.05)
+    es.submit(t, {0: np.array([1])})
+    pin = es.reader()
+    rec = es.flip()
+    assert rec["outcome"] == "stalled" and es.current() == 0
+    assert es.stats()["flipping"] is False  # admission reopened
+    # new readers are not wedged by the aborted drain
+    with es.reader() as tk:
+        assert tk.epoch == 0
+    pin.release()
+    assert es.flip()["outcome"] == "flipped"
+
+
+# ---------------------------------------------------------------------------
+# the priced verdict + the seventh cost authority
+# ---------------------------------------------------------------------------
+
+
+def test_maybe_flip_accumulates_fresh_and_flips_stale():
+    t = _declare()
+    corpus = _corpus(4)
+    fake = [1000.0]
+    es = EpochStore(corpus, clock=lambda: fake[0])
+    es.submit(t, {0: np.array([1])}, stamp=1000.0)
+    # fresh log: accumulate (decision recorded, nothing joined)
+    r = es.maybe_flip(now=1000.0001)
+    assert r["outcome"] == "accumulate" and es.current() == 0
+    d = insights.decisions(4)[-1]
+    assert d["site"] == "epoch.flip" and d["decision"] == "accumulate"
+    assert d["inputs"]["depth"] == 1 and "est_us" in d["inputs"]
+    assert d["inputs"]["epoch"] == 0
+    # stale log: flip, and the taken verdict joins with its measured wall
+    r = es.maybe_flip(now=1030.0)
+    assert r["outcome"] == "flipped" and es.current() == 1
+    joined = [s for s in outcomes.tail() if s["site"] == "epoch.flip"]
+    assert len(joined) == 1
+    j = joined[0]
+    assert j["engine"] == "flip" and j["predicted_us"] > 0
+    assert j["measured_s"] > 0 and j["error_ratio"] is not None
+
+
+def test_epoch_authority_registered_with_full_protocol():
+    assert "epoch-flip" in cost.names()
+    a = cost.authority("epoch-flip")
+    assert a.provenance() == "default"
+    curves = a.curves()
+    assert curves["coeffs"]["staleness_us_per_s"] > 0
+    assert set(curves["refit_keys"]) == {
+        "flip_overhead_us", "repack_value_us", "drain_reader_us",
+    }
+    state = cost.calibration_state()
+    assert "epoch-flip" in state["authorities"]
+
+
+def test_epoch_refit_moves_toward_measured_truth_staleness_pinned():
+    samples = [
+        {"site": "epoch.flip", "engine": "flip",
+         "predicted_us": 100.0, "measured_s": 0.0004}
+        for _ in range(4)
+    ]
+    before = dict(epoch_cost.MODEL.coeffs)
+    report = epoch_cost.MODEL.refit_from_outcomes(samples=samples)
+    assert set(report["moved"]) == {
+        "flip_overhead_us", "repack_value_us", "drain_reader_us",
+    }
+    assert report["provenance"] == "refit-from-traffic"
+    after = epoch_cost.MODEL.coeffs
+    # measured 4x the prediction: both flip coefficients scale up...
+    assert after["flip_overhead_us"] == pytest.approx(
+        before["flip_overhead_us"] * 4.0
+    )
+    # ...and the declared staleness exchange rate NEVER moves on refit
+    assert after["staleness_us_per_s"] == before["staleness_us_per_s"]
+    # poison is rejected, not averaged in
+    bad = [{"site": "epoch.flip", "engine": "flip",
+            "predicted_us": -1.0, "measured_s": 0.001}] * 3
+    report2 = epoch_cost.MODEL.refit_from_outcomes(samples=bad)
+    assert report2["rejected"] == 3 and not report2["moved"]
+
+
+def test_epoch_model_state_roundtrip_and_foreign_rejection():
+    epoch_cost.MODEL.refit_from_outcomes(samples=[
+        {"site": "epoch.flip", "engine": "flip",
+         "predicted_us": 100.0, "measured_s": 0.0002}
+        for _ in range(2)
+    ])
+    d = epoch_cost.MODEL.to_dict()
+    m2 = epoch_cost.EpochFlipModel()
+    assert m2.from_dict(d) is True
+    assert m2.coeffs == epoch_cost.MODEL.coeffs
+    assert m2.provenance == "refit-from-traffic"
+    assert m2.from_dict({"schema": "other/1"}) is False
+    assert m2.from_dict({"schema": epoch_cost.SCHEMA,
+                         "coeffs": {"flip_overhead_us": 1e12}}) is False
+
+
+# ---------------------------------------------------------------------------
+# sentinel rules
+# ---------------------------------------------------------------------------
+
+
+def _snap_pair(traffic_fn, rule_names):
+    rules = [r for r in health.DEFAULT_RULES if r.name in rule_names]
+    s1 = health.snapshot(refresh_hbm=False)
+    for r in rules:
+        r.probe(s1)  # arm the per-tick deltas
+    traffic_fn()
+    s2 = health.snapshot(prev_sums=s1.sums, refresh_hbm=False)
+    return {r.name: r.probe(s2) for r in rules}
+
+
+def test_freshness_lag_breach_rule_windows_the_histogram():
+    t = _declare("lag-t")
+    corpus = _corpus(4)
+    fake = [50.0]
+    es = EpochStore(corpus, clock=lambda: fake[0])
+    # the series must exist before the arm tick (first sight reports 0)
+    es.submit(t, {0: np.array([1])}, stamp=50.0)
+    es.flip()
+
+    def stale_publish():
+        es.submit(t, {0: np.array([2])}, stamp=20.0)  # 30 s stale
+        es.flip()
+
+    values = _snap_pair(stale_publish, ("freshness-lag-breach",))
+    rule = next(
+        r for r in health.DEFAULT_RULES if r.name == "freshness-lag-breach"
+    )
+    assert values["freshness-lag-breach"] is not None
+    assert values["freshness-lag-breach"] >= rule.critical
+    # a quiet window clears (no histogram movement -> no data -> OK)
+    values2 = _snap_pair(lambda: None, ("freshness-lag-breach",))
+    assert rule.band(values2["freshness-lag-breach"]) == health.OK
+
+
+def test_epoch_flip_stall_rule_judges_depth_without_flips():
+    t = _declare("stall-t")
+    corpus = _corpus(4)
+    es = EpochStore(corpus)
+    rule = next(
+        r for r in health.DEFAULT_RULES if r.name == "epoch-flip-stall"
+    )
+
+    def park_batches():
+        for i in range(6):
+            es.submit(t, {0: np.array([i])})
+
+    values = _snap_pair(park_batches, ("epoch-flip-stall",))
+    assert values["epoch-flip-stall"] == 6.0
+    assert rule.band(values["epoch-flip-stall"]) >= health.WARN
+    # a window that flips is healthy accumulation, however deep
+    def flip_and_refill():
+        es.flip()
+        es.submit(t, {0: np.array([99])})
+
+    values2 = _snap_pair(flip_and_refill, ("epoch-flip-stall",))
+    assert values2["epoch-flip-stall"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# the read-write harness vs the epoch-replay oracle
+# ---------------------------------------------------------------------------
+
+
+def test_harness_read_write_mix_bitexact_vs_epoch_oracle():
+    corpus = _corpus(6, seed=7)
+    clone = [bm.clone() for bm in corpus]
+    profiles = [
+        TenantProfile("rw-r", weight=3.0, quota_qps=1e6, burst=1e6),
+        TenantProfile("rw-w", weight=1.0, quota_qps=1e6, burst=1e6,
+                      writes=0.5),
+    ]
+    clone_reqs = build_requests(clone, profiles, 30, seed=99)
+    reqs = build_requests(corpus, profiles, 30, seed=99)
+    assert [(r.kind, r.tenant) for r in reqs] == \
+        [(r.kind, r.tenant) for r in clone_reqs]
+    es = EpochStore(corpus)
+    h = LoadHarness(
+        corpus, profiles, threads=4, window=4,
+        admission=AdmissionController(max_inflight=8, queue_limit=64),
+        epoch_store=es,
+    )
+    report = h.run(reqs)
+    assert report.writes > 0 and report.shed == 0
+    assert report.epoch_start == 0
+    # run-end drain: every accepted batch became queryable
+    assert es.log.depth() == 0
+    want = LoadHarness.run_serial_epochs(clone_reqs, clone, report)
+    for i, (g, w) in enumerate(zip(report.results, want)):
+        assert g == w, f"position {i} diverged (epoch {report.epochs[i]})"
+    # every query slot carries its admitted epoch
+    for pos, r in enumerate(reqs):
+        if r.kind == "query":
+            assert report.epochs[pos] is not None
+        else:
+            assert report.batch_ids[pos] is not None
+
+
+def test_harness_requires_epoch_store_for_writer_tenants():
+    corpus = _corpus(4)
+    with pytest.raises(ValueError):
+        LoadHarness(
+            corpus,
+            [TenantProfile("w", quota_qps=10, writes=0.5)],
+            threads=1,
+        )
+    with pytest.raises(ValueError):
+        LoadHarness(
+            corpus, [TenantProfile("r", quota_qps=10)], threads=1,
+            epoch_store=EpochStore(_corpus(4, seed=8)),
+        )
+
+
+def test_fuzz_family_29_seed_pin():
+    from roaringbitmap_tpu import fuzz
+
+    fuzz.verify_epoch_invariance(
+        "concurrent-ingest-vs-epoch-oracle", iterations=3, seed=59
+    )
+
+
+# ---------------------------------------------------------------------------
+# validated publication across a flip
+# ---------------------------------------------------------------------------
+
+
+def test_publication_from_outside_a_reader_pin_is_dropped_after_flip():
+    """The in-flight table's validated-publication contract extends to
+    epoch generation: a rogue computation racing a flip (no reader pin)
+    still cannot publish under the pre-flip fingerprints — the flip's
+    writer bumps every touched bitmap's fingerprint, so the completion
+    re-validation fails and joiners recompute against fresh bits."""
+    from roaringbitmap_tpu.query import Q
+    from roaringbitmap_tpu.query import cache as qcache
+    from roaringbitmap_tpu.query import inflight as qinflight
+
+    t = _declare()
+    corpus = _corpus(4)
+    es = EpochStore(corpus)
+    node = Q.leaf(corpus[0]) & Q.leaf(corpus[1])
+    leaf_fps = {l.uid: l.fingerprint() for l in node.leaves}
+    key = qcache.cache_key(node, leaf_fps)
+    table = qinflight.InflightTable()
+    owner, entry = table.begin(key)
+    assert owner
+    # ... the owner computes while a flip mutates its leaves ...
+    es.submit(t, {0: np.array([123456])})
+    assert es.flip()["outcome"] == "flipped"
+    valid = qcache.leaf_fps_current(node, leaf_fps)
+    assert valid is False  # the epoch moved: the snapshot is stale
+    table.complete(key, entry, RoaringBitmap(), valid)
+    assert table.poll(entry) is None  # joiners recompute, never stale bits
+    assert table.stats()["stale"] == 1
+
+
+def test_admission_decision_carries_the_epoch():
+    t = _declare()
+    c = AdmissionController(max_inflight=4, queue_limit=4)
+    ticket = c.admit(t, epoch=7)
+    ticket.release()
+    d = [e for e in insights.decisions(8) if e["site"] == "serve.admit"][-1]
+    assert d["inputs"]["epoch"] == 7
+
+
+# ---------------------------------------------------------------------------
+# surfaces: sidecar block, insights, observatory
+# ---------------------------------------------------------------------------
+
+
+def test_sidecar_epochs_block_and_insights_lineage():
+    from roaringbitmap_tpu.observe import export as obs_export
+
+    t = _declare("side-t")
+    corpus = _corpus(4)
+    es = EpochStore(corpus)
+    es.submit(t, {0: np.array([5])})
+    es.flip()
+    side = obs_export.sidecar_snapshot()
+    ep = side["epochs"]
+    assert ep["epoch"] == 1 and ep["mutlog_depth"] == 0
+    assert ep["flips"].get("flipped", 0) >= 1
+    assert ep["ingest"].get("side-t") == 1
+    assert ep["freshness"]["side-t"]["count"] >= 1
+    assert set(ep["flip_stages"]) >= set(epochs_mod.FLIP_STAGES)
+    blk = insights.epochs()
+    assert blk["store_live"]["epoch"] == 1
+    assert blk["lineage"][-1]["epoch"] == 1
+    # the observatory view (the flight bundle's observatory.json) carries
+    # the epoch panel, lineage included
+    obs = insights.observatory()
+    assert obs["epochs"]["lineage"][-1]["epoch"] == 1
+
+
+# ---------------------------------------------------------------------------
+# lock discipline
+# ---------------------------------------------------------------------------
+
+
+def test_epoch_locks_are_leaves_hammer():
+    t = _declare("hammer-ep")
+    corpus = _corpus(4, card=400)
+    es = EpochStore(corpus)
+    w = LockWitness()
+    es._cond = threading.Condition(w.wrap("epoch.store", threading.Lock()))
+    log_lock = es.log._lock
+    es.log._lock = w.wrap("epoch.ingest", log_lock)
+    reg_lock = observe.REGISTRY._lock
+    observe.REGISTRY._lock = w.wrap("registry", reg_lock)
+    rec_lock = tl.RECORDER._lock
+    tl.RECORDER._lock = w.wrap("recorder", rec_lock)
+    prev_mode = tl.mode_name()
+    tl.configure(mode="on")
+    stop = time.monotonic() + 1.0
+    errors = []
+
+    def reader(i):
+        while time.monotonic() < stop:
+            try:
+                with es.reader():
+                    RoaringBitmap.and_(corpus[0], corpus[1])
+            except Exception as e:  # rb-ok: exception-hygiene -- hammer collects escapes to assert none happened
+                errors.append(e)
+                return
+
+    def writer(i):
+        k = 0
+        while time.monotonic() < stop:
+            k += 1
+            try:
+                es.submit(t, {k % 4: np.array([k % (1 << 16)])})
+                if k % 3 == 0:
+                    es.maybe_flip(now=time.monotonic() + 1e9)  # force-stale
+                if k % 5 == 0:
+                    es.lineage(4)
+                    es.stats()
+            except Exception as e:  # rb-ok: exception-hygiene -- hammer collects escapes to assert none happened
+                errors.append(e)
+                return
+
+    threads = [
+        threading.Thread(target=reader, args=(i,), daemon=True)
+        for i in range(12)
+    ] + [
+        threading.Thread(target=writer, args=(i,), daemon=True)
+        for i in range(4)
+    ]
+    try:
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+    finally:
+        tl.configure(mode=prev_mode)
+        observe.REGISTRY._lock = reg_lock
+        tl.RECORDER._lock = rec_lock
+    assert not errors, errors[0]
+    w.assert_consistent()
+    assert w.acquisitions.get("epoch.store", 0) > 0
+    assert w.acquisitions.get("epoch.ingest", 0) > 0
+    # epoch.store is a LEAF: nothing is ever acquired while holding it.
+    # epoch.ingest nests over the registry lock ONLY (the depth gauge is
+    # set under it so a racing drain cannot be overwritten by a stale
+    # pre-drain depth — the PACK_CACHE -> registry precedent)
+    assert not [e for e in w.edges if e[0] == "epoch.store"], sorted(w.edges)
+    ingest_edges = {e for e in w.edges if e[0] == "epoch.ingest"}
+    assert ingest_edges <= {("epoch.ingest", "registry")}, sorted(w.edges)
