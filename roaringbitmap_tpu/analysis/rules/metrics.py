@@ -67,6 +67,17 @@ lineage lives in the epoch ledger / trace / decision attrs. Flip STAGE
 labels (``drain``/``repack``/``publish``/``reclaim``) are a declared
 frozen set and pass; fixtures pin both directions.
 
+**Container-format label values** (ISSUE 16): the structure census
+gauge (``rb_tpu_structure_containers{format}``) labels by container
+format — a set closed by construction (array | bitmap | run), but only
+while every label value resolves through the DECLARED frozen format set
+(``observe/structure.py`` ``FORMATS``), spelt as the ``FORMATS[fmt]``
+subscript. A bare ``format``-shaped name in a label tuple is flagged
+with its own message pointing at the declared set; the ``_containers``
+census suffix joins the recognised unit suffixes so the cross-module
+``STRUCTURE_CONTAINERS`` constant validates like the other shaped
+names. Fixtures pin both directions.
+
 Forwarding wrappers (a call whose name argument is the enclosing
 function's own ``name`` parameter, e.g. the module-level ``counter()``
 helpers in registry.py) are exempt — the real declaration is at their
@@ -112,6 +123,16 @@ _TENANT_VALUE = re.compile(r"(^|_)(tenant|tenants|tenant_name)(_|$)")
 # label sets (false-positive fixtures pin flip-STAGE labels, which are a
 # declared frozen set and fine)
 _EPOCH_VALUE = re.compile(r"(^|_)(epoch|epochs|epoch_id|epoch_gen)(_|$)")
+# container-format identifiers (ISSUE 16): the structure census gauge
+# (rb_tpu_structure_containers{format}) labels by container format. The
+# format set is closed by construction (Chambi et al.: array | bitmap |
+# run) but only as long as every label value resolves through the
+# DECLARED frozen format set (observe/structure.py FORMATS) — a bare
+# `fmt` variable carrying Container.TYPE would silently mint a series
+# for any future/typo'd format string, so it is flagged like a bare
+# tenant name: spell it FORMATS[fmt] (false-positive fixtures pin
+# literal "run"/"array" labels, which are declared and fine)
+_FORMAT_VALUE = re.compile(r"(^|_)(format|formats|fmt|container_format)(_|$)")
 _ALL_CAPS = re.compile(r"^[A-Z][A-Z0-9_]*$")
 # constant names that read as canonical metric names (unit-suffixed; RATIO
 # is the dimensionless gauge unit — e.g. rb_tpu_store_overlap_ratio;
@@ -119,9 +140,12 @@ _ALL_CAPS = re.compile(r"^[A-Z][A-Z0-9_]*$")
 # from a declared enum, e.g. rb_tpu_health_status 0/1/2 = green/yellow/red
 # and rb_tpu_health_rule_state{rule} 0/1/2 = ok/warn/critical; QPS is the
 # serving tier's requests-per-second gauge unit, ISSUE 14 —
-# rb_tpu_serve_qps{tenant})
+# rb_tpu_serve_qps{tenant}; CONTAINERS is the structure observatory's
+# census-gauge unit, ISSUE 16 — rb_tpu_structure_containers{format}, a
+# live-object count by declared format)
 _SHAPED_CONST = re.compile(
-    r"^[A-Z][A-Z0-9_]*_(TOTAL|SECONDS|BYTES|COUNT|RATIO|STATE|STATUS|QPS)$"
+    r"^[A-Z][A-Z0-9_]*_(TOTAL|SECONDS|BYTES|COUNT|RATIO|STATE|STATUS|QPS|"
+    r"CONTAINERS)$"
 )
 
 
@@ -193,7 +217,7 @@ class MetricNaming(Checker):
                         v.startswith("rb")
                         or re.search(
                             r"_(total|seconds|bytes|count|ratio|state|"
-                            r"status|qps)$",
+                            r"status|qps|containers)$",
                             v,
                         )
                         or _SHAPED_CONST.match(t.id)
@@ -384,6 +408,16 @@ class MetricNaming(Checker):
                 "metric label values — export the current epoch as a "
                 "gauge VALUE and put lineage in the epoch ledger / "
                 "trace / decision attrs",
+            )
+            return
+        if _FORMAT_VALUE.search(term.lower()):
+            yield self.finding(
+                ctx, call,
+                f"metric label value `{term}` is a container format: "
+                "format label values must come from the declared frozen "
+                "format set (spell it FORMATS[" + term + "] — the "
+                "declared-collection subscript — so a future or typo'd "
+                "format string can never mint a series)",
             )
             return
         if _UNBOUNDED.search(term.lower()):

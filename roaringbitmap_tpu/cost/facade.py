@@ -2,7 +2,7 @@
 curves / drift / refit / state protocol (ISSUE 12 tentpole, leg 2 —
 closing ROADMAP item 4).
 
-The system grew seven pricing authorities, each calibrated differently:
+The system grew eight pricing authorities, each calibrated differently:
 
 ========================= ===============================================
 authority                 wraps
@@ -27,6 +27,11 @@ authority                 wraps
                           flip-now vs accumulate-more curve: predicted
                           flip wall vs measured, staleness priced at the
                           declared exchange rate (ISSUE 15)
+``compaction``            ``cost.compaction.MODEL`` — the maintenance
+                          tier's compact-now vs let-it-ride curve:
+                          predicted pass wall vs measured, structure
+                          drift priced at the declared exchange rate
+                          (ISSUE 16)
 ========================= ===============================================
 
 Each adapter answers the same five questions — ``curves()`` (what do you
@@ -336,6 +341,42 @@ class EpochFlipAuthority(Authority):
         self._model().reset()
 
 
+class CompactionAuthority(Authority):
+    """The maintenance tier's compaction curve (ISSUE 16):
+    ``serve.maintain`` verdicts price compact-now (predicted pass wall)
+    against let-it-ride (bytes-over-optimal drift at the declared
+    exchange rate); ledger joins score taken passes and the refit
+    learns this host's rewrite/merge constants from live maintenance."""
+
+    name = "compaction"
+
+    def _model(self):
+        from . import compaction as _compaction
+
+        return _compaction.MODEL
+
+    def curves(self) -> dict:
+        return self._model().curves_view()
+
+    def provenance(self) -> str:
+        return self._model().provenance
+
+    def drift(self) -> Dict[str, float]:
+        return self._model().drift()
+
+    def refit_from_outcomes(self, samples: Optional[List[dict]] = None) -> dict:
+        return self._model().refit_from_outcomes(samples=samples)
+
+    def state(self) -> dict:
+        return self._model().to_dict()
+
+    def load_state(self, d: dict) -> bool:
+        return self._model().from_dict(d)
+
+    def reset(self) -> None:
+        self._model().reset()
+
+
 AUTHORITIES: Dict[str, Authority] = {
     a.name: a
     for a in (
@@ -346,6 +387,7 @@ AUTHORITIES: Dict[str, Authority] = {
         FusionBatchAuthority(),
         ServeAdmissionAuthority(),
         EpochFlipAuthority(),
+        CompactionAuthority(),
     )
 }
 

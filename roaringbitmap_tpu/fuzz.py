@@ -765,6 +765,133 @@ def verify_epoch_invariance(
             sslo.reset()
 
 
+def verify_compaction_invariance(
+    name: str,
+    iterations: Optional[int] = None,
+    seed: Optional[int] = None,
+) -> None:
+    """Fuzz family 30 (ISSUE 16): background compaction may change
+    *representation*, never *content*. Each iteration drives a random
+    ingest sequence (mixing scatter batches with run-friendly contiguous
+    ranges, so ``run_optimize`` has real rewrites to make) through an
+    ``EpochStore`` with FORCED maintenance passes interleaved between
+    flips; every other iteration arms a random seeded fault schedule
+    biased to include the new ``serve.maintain`` site (which must fail
+    CLOSED — an aborted pass leaves the uncompacted epoch serving
+    exactly the bits it had). The oracle is a no-compaction twin: the
+    pre-run clone plus every published lineage record's batches, with
+    every fault suspended — the live corpus must equal the twin
+    bit-exactly, and the passes' own bit-identity audits must report
+    zero anomalies (a nonzero count means ``run_optimize`` changed bits
+    and only the audit saved the corpus)."""
+    from contextlib import ExitStack
+
+    from .observe import structure as ostructure
+    from .robust import faults as rfaults
+    from .robust import ladder as rladder
+    from .robust.errors import TransientDeviceError
+    from .serve import ingest as singest
+    from .serve import maintain as smaintain
+    from .serve import slo as sslo
+    from .serve.epochs import EpochStore
+
+    rng = np.random.default_rng(seed)
+    for it in range(iterations or default_iterations()):
+        n_bms = int(rng.integers(3, 6))
+        bms = [random_bitmap(rng) for _ in range(n_bms)]
+        clone = [b.clone() for b in bms]
+        write_muts = []
+        for _ in range(int(rng.integers(2, 6))):
+            muts: dict = {}
+            for _ in range(int(rng.integers(1, 3))):
+                tgt = int(rng.integers(0, n_bms))
+                if rng.random() < 0.5:
+                    # a contiguous run so format re-selection has work
+                    start = int(rng.integers(0, 1 << 17))
+                    vals = np.arange(start, start + int(rng.integers(64, 2048)))
+                else:
+                    vals = rng.integers(0, 1 << 18, size=int(rng.integers(1, 32)))
+                muts[tgt] = np.union1d(
+                    muts.get(tgt, np.empty(0, np.int64)), vals
+                )
+            write_muts.append(muts)
+        sched = random_fault_schedule(rng) if it % 2 else []
+        if sched and rng.random() < 0.7:
+            # bias toward the site under test: the pass entry's fail-closed
+            # gate is the family's whole point
+            sched.append(
+                ("serve.maintain", TransientDeviceError,
+                 {"prob": float(rng.uniform(0.2, 0.9)),
+                  "seed": int(rng.integers(0, 1 << 16))})
+            )
+        rfaults.clear()
+        rladder.LADDER.reset()
+        sslo.reset()
+        sslo.TENANTS.declare("fz-writer", quota_qps=1e6, burst=1e6)
+        ostructure.LEDGER.reset()
+        smaintain.reset()
+        es = EpochStore(bms)
+        ostructure.LEDGER.watch("fz-compact", bms)
+        submitted = {}
+        anomalies = 0
+        try:
+            with ExitStack() as stack:
+                for site, exc, kw in sched:
+                    stack.enter_context(rfaults.inject(site, exc, **kw))
+                for muts in write_muts:
+                    try:
+                        b = es.submit("fz-writer", muts)
+                    except Exception:
+                        b = None  # rb-ok: exception-hygiene -- an injected fault at submit leaves the batch unsubmitted; the twin replays only PUBLISHED lineage, so a lost batch stays consistent
+                    if b is not None:
+                        submitted[b.batch_id] = b
+                    try:
+                        es.flip(reason="fuzz")
+                    except Exception:
+                        pass  # rb-ok: exception-hygiene -- an aborted flip (injected epoch.flip fault) keeps the old epoch; the lineage replay below only sees published flips
+                    rec = smaintain.run_pass(
+                        store=es, reason="fuzz", force=True,
+                    )
+                    anomalies += int(rec.get("anomalies") or 0)
+            # the no-compaction twin: pre-run clone + every PUBLISHED
+            # record's batches, faults suspended (invisible to schedules)
+            with rfaults.suspended():
+                twin = [b.clone() for b in clone]
+                for rec in (
+                    r for r in es.lineage() if r["outcome"] == "flipped"
+                ):
+                    singest.apply_batches(
+                        twin, [submitted[bid] for bid in rec["batches"]]
+                    )
+                if anomalies:
+                    raise InvarianceFailure(
+                        name, bms,
+                        detail=f"bit-identity audit caught {anomalies} lossy "
+                        f"rewrite(s): run_optimize changed content "
+                        f"(schedule={sched})",
+                    )
+                for i, (got, want) in enumerate(zip(es.corpus, twin)):
+                    if got != want:
+                        raise InvarianceFailure(
+                            name, bms,
+                            detail=f"compacted corpus[{i}] diverged from the "
+                            f"no-compaction twin (schedule={sched})",
+                        )
+        except InvarianceFailure:
+            raise
+        except Exception as e:  # rb-ok: exception-hygiene -- the family's whole point: ANY escape past the maintenance tier's fail-closed gate is a failure, re-wrapped with the repro schedule
+            raise InvarianceFailure(
+                name, bms,
+                detail=f"exception escaped the maintenance tier: {e!r} "
+                f"(schedule={sched})",
+            ) from e
+        finally:
+            rfaults.clear()
+            sslo.reset()
+            ostructure.LEDGER.reset()
+            smaintain.reset()
+
+
 def random_expression(rng, leaves: List[RoaringBitmap], max_depth: int = 4):
     """Random query DAG over the given leaf bitmaps: every node kind
     (and/or/xor/n-ary andnot/not-over-explicit-universe/threshold), biased
@@ -1160,6 +1287,20 @@ def run_campaign(iterations: Optional[int] = None, verbose: bool = True) -> dict
         lambda: verify_epoch_invariance(
             "concurrent-ingest-vs-epoch-oracle", iterations=max(1, n // 8),
             seed=59,
+        ),
+        actual=max(1, n // 8),
+    )
+    # ISSUE 16: randomized ingest with FORCED maintenance passes (incl.
+    # seeded fault schedules biased toward the serve.maintain site, which
+    # must fail closed) vs a no-compaction twin — compaction may change
+    # representation, never content, and the passes' bit-identity audits
+    # must report zero anomalies (derated: each iteration replays its
+    # whole lineage into the twin)
+    _run(
+        "compaction-vs-identity-oracle",
+        lambda: verify_compaction_invariance(
+            "compaction-vs-identity-oracle", iterations=max(1, n // 8),
+            seed=60,
         ),
         actual=max(1, n // 8),
     )

@@ -331,6 +331,29 @@ def epochs() -> dict:
     return block
 
 
+def structure() -> dict:
+    """Structure-observatory rollup (ISSUE 16): the container-format
+    census, actual/optimal serialized bytes + drift ratio, run
+    fragmentation p99, epoch-delta accretion depth, maintenance-pass
+    volume — all registry-derived — plus the live ledger's stats, the
+    last taken pass's record, and the compaction authority's provenance
+    (process-local, like the admission controller's live stats). The
+    rb_top structure panel renders exactly this."""
+    from . import observe
+    from .cost import compaction as _compaction_cost
+    from .observe import export as _export
+    from .observe import structure as _structure
+    from .serve import maintain as _maintain
+
+    block = _export._structure_block(observe.REGISTRY.snapshot())
+    block["ledger_live"] = (
+        _structure.LEDGER.stats() if _structure.LEDGER.watched() else None
+    )
+    block["last_pass"] = _maintain.last_pass() or None
+    block["authority"] = _compaction_cost.MODEL.provenance
+    return block
+
+
 def cost_authorities() -> dict:
     """The unified cost facade's view (ISSUE 12): every pricing
     authority's curves, provenance, and live drift — ROADMAP item 4's
@@ -371,6 +394,10 @@ def observatory() -> dict:
         # freshness + lineage tail, so a red episode's bundle carries the
         # epoch panel (which snapshot was serving, and how stale)
         "epochs": epochs(),
+        # structure observatory (ISSUE 16): format census + drift +
+        # maintenance-pass state, so a red episode's bundle carries the
+        # corpus shape that triggered the structure-drift rule
+        "structure": structure(),
     }
 
 

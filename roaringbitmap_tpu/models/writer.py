@@ -184,7 +184,17 @@ class BitmapWriter:
         hlc = self._bitmap.high_low_container
         i = hlc.get_index(key)
         if i >= 0:
-            hlc.set_container_at_index(i, hlc.get_container_at_index(i).or_(container))
+            merged = hlc.get_container_at_index(i).or_(container)
+            if self._optimise_runs:
+                # re-select the MERGED result's format, not just the
+                # emitted chunk's: or_ returns arrays/bitmaps by
+                # construction, so without this the serving ingest path
+                # (into= an existing corpus bitmap) drifts every
+                # write-hot container away from the size rule no matter
+                # how run-friendly the stream is (ISSUE 16) — only the
+                # already-dirty merged row is touched, never a scan
+                merged = merged.run_optimize()
+            hlc.set_container_at_index(i, merged)
         elif hlc.size == 0 or key > hlc.keys[-1]:
             hlc.append(key, container)
         else:

@@ -69,6 +69,10 @@ from .registry import (
     SERVE_INFLIGHT_COUNT,
     SERVE_INGEST_TOTAL,
     SERVE_LATENCY_SECONDS,
+    SERVE_MAINTAIN_KEYS_TOTAL,
+    SERVE_MAINTAIN_RECLAIMED_BYTES_TOTAL,
+    SERVE_MAINTAIN_SECONDS,
+    SERVE_MAINTAIN_TOTAL,
     SERVE_MUTLOG_COUNT,
     SERVE_QPS,
     SERVE_QUEUE_COUNT,
@@ -82,6 +86,11 @@ from .registry import (
     STORE_PACK_STAGE_SECONDS,
     STORE_RESIDENT_BYTES,
     STORE_TRANSFER_BYTES_TOTAL,
+    STRUCTURE_ACCRETION_COUNT,
+    STRUCTURE_BYTES,
+    STRUCTURE_CONTAINERS,
+    STRUCTURE_DRIFT_RATIO,
+    STRUCTURE_FRAGMENTATION_COUNT,
     TIMELINE_ANOMALY_TOTAL,
     TIMELINE_SPAN_SECONDS,
     Counter,

@@ -350,6 +350,41 @@ def _epochs_block(snap: dict, registry: Registry) -> dict:
     }
 
 
+def _structure_block(snap: dict) -> dict:
+    """The structure observatory's sidecar block (ISSUE 16), derived
+    PURELY from the registry like every block here: the container-format
+    census, actual/optimal serialized bytes + drift ratio, the run
+    fragmentation p99 and epoch-delta accretion depth gauges, and the
+    maintenance tier's volume (passes by outcome, reclaimed bytes,
+    rewritten keys, pass wall time) — the rb_top structure panel's
+    ``--from`` data."""
+    def _gauge(name):
+        m = snap.get(name)
+        if m is not None:
+            for s in m["samples"]:
+                if not s["labels"]:
+                    return s["value"]
+        return None
+    bytes_by_kind = _counter_map(snap, _registry.STRUCTURE_BYTES)
+    wall = None
+    m = snap.get(_registry.SERVE_MAINTAIN_SECONDS)
+    if m is not None:
+        for s in m["samples"]:
+            if not s["labels"]:
+                wall = {"count": s["count"], "sum": round(s["sum"], 6)}
+    return {
+        "containers": _counter_map(snap, _registry.STRUCTURE_CONTAINERS),
+        "bytes": bytes_by_kind,
+        "drift_ratio": _gauge(_registry.STRUCTURE_DRIFT_RATIO),
+        "fragmentation_p99": _gauge(_registry.STRUCTURE_FRAGMENTATION_COUNT),
+        "accretion_depth": _gauge(_registry.STRUCTURE_ACCRETION_COUNT),
+        "passes": _counter_map(snap, _registry.SERVE_MAINTAIN_TOTAL),
+        "reclaimed_bytes": _gauge(_registry.SERVE_MAINTAIN_RECLAIMED_BYTES_TOTAL),
+        "rewritten_keys": _gauge(_registry.SERVE_MAINTAIN_KEYS_TOTAL),
+        "pass_wall": wall,
+    }
+
+
 def _health_block(snap: dict) -> dict:
     """The health sentinel's sidecar block (ISSUE 12), derived PURELY
     from the registry gauges (like the regret block) so a ``--from``
@@ -414,6 +449,10 @@ def sidecar_snapshot(registry: Optional[Registry] = None) -> dict:
         # epoch ledger (ISSUE 15): current epoch, mutation-log depth,
         # flip volume + stage decomposition, per-tenant freshness
         "epochs": _epochs_block(snap, _reg(registry)),
+        # structure observatory (ISSUE 16): container-format census,
+        # bytes-vs-optimal drift, fragmentation/accretion gauges, and
+        # the maintenance tier's pass volume + reclaimed bytes
+        "structure": _structure_block(snap),
         "registry": snap,
     }
 

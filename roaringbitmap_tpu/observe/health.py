@@ -47,14 +47,18 @@ serving-p99-breach     worst per-tenant windowed serving p99 s  0.5   2.0
 tenant-saturation      worst per-tenant shed fraction per tick  0.25  0.75
 freshness-lag-breach   worst windowed ingest->queryable p99 s   2.0   10.0
 epoch-flip-stall       mutation-log depth with no epoch flip    4     64
+structure-drift        actual/optimal serialized-bytes ratio    1.3   2.0
+delta-accretion        epoch-delta batches since maintenance    8     64
 ====================== ======================================== ===== =====
 
 Actuations (the sentinel's closed-loop half — see ``observe.sentinel``):
 ``costmodel-drift`` actuates ``"refit"`` (the ``cost/`` facade's
-``refit_all``, ROADMAP item 4's auto-trigger); the rest actuate
-``"alert"`` (a structured instant + decision entry on the fire
-transition); any rule reaching CRITICAL additionally triggers a one-shot
-flight bundle (``observe.bundle``).
+``refit_all``, ROADMAP item 4's auto-trigger); ``structure-drift`` and
+``delta-accretion`` actuate ``"maintain"`` (a priced background
+compaction pass under its own cooldown — serve/maintain.py, ISSUE 16);
+the rest actuate ``"alert"`` (a structured instant + decision entry on
+the fire transition); any rule reaching CRITICAL additionally triggers
+a one-shot flight bundle (``observe.bundle``).
 """
 
 from __future__ import annotations
@@ -569,5 +573,27 @@ DEFAULT_RULES: Tuple[Rule, ...] = (
         _epoch_flip_stall,
         warn=4.0, critical=64.0, fire_after=2, clear_after=2,
         actuation="alert",
+    ),
+    # the two structure-observatory rules (ISSUE 16): corpus shape joins
+    # the judged signals — both actuate a priced maintenance pass
+    # (serve/maintain.py) under the sentinel's maintain cooldown;
+    # appended so every earlier rule keeps its table position
+    Rule(
+        "structure-drift",
+        "watched working sets' actual serialized bytes over the "
+        "size-rule optimum (1.0 = every container in its cheapest "
+        "format; sustained ingest without maintenance drifts it up)",
+        lambda s: s.gauge_max_abs(_registry.STRUCTURE_DRIFT_RATIO),
+        warn=1.3, critical=2.0, fire_after=2, clear_after=2,
+        actuation="maintain",
+    ),
+    Rule(
+        "delta-accretion",
+        "epoch-delta batches folded into the corpus since the last "
+        "maintenance pass settled them (unbounded accretion = unbounded "
+        "rewrite debt)",
+        lambda s: s.gauge_max_abs(_registry.STRUCTURE_ACCRETION_COUNT),
+        warn=8.0, critical=64.0, fire_after=2, clear_after=2,
+        actuation="maintain",
     ),
 )

@@ -141,6 +141,28 @@ SERVE_INGEST_TOTAL = "rb_tpu_serve_ingest_total"
 SERVE_EPOCH_FLIP_TOTAL = "rb_tpu_serve_epoch_flip_total"
 SERVE_MUTLOG_COUNT = "rb_tpu_serve_mutlog_count"
 SERVE_EPOCH_COUNT = "rb_tpu_serve_epoch_count"
+# structure observatory (ISSUE 16): corpus-shape telemetry maintained
+# incrementally at the mutators (observe/structure.py). The census gauge
+# counts live containers by format — label VALUES come from the declared
+# frozen format set (structure.FORMATS, the Chambi et al. container
+# model: array | bitmap | run; the metric-naming rule enforces the
+# declared-collection spelling like tenant names). Drift is the ratio of
+# actual serialized bytes to the size-rule-optimal bytes (1.0 = every
+# container in its cheapest format); fragmentation is the p99
+# runs-per-run-container; accretion is the epoch-delta depth (batches
+# accreted since the last maintenance pass). The maintenance tier
+# (serve/maintain.py) prices every pass (compacted | rode | aborted |
+# noop), measures the pass wall, and accounts reclaimed serialized bytes
+# plus rewritten chunk keys
+STRUCTURE_CONTAINERS = "rb_tpu_structure_containers"
+STRUCTURE_DRIFT_RATIO = "rb_tpu_structure_drift_ratio"
+STRUCTURE_FRAGMENTATION_COUNT = "rb_tpu_structure_fragmentation_count"
+STRUCTURE_ACCRETION_COUNT = "rb_tpu_structure_accretion_count"
+STRUCTURE_BYTES = "rb_tpu_structure_bytes"
+SERVE_MAINTAIN_TOTAL = "rb_tpu_serve_maintain_total"
+SERVE_MAINTAIN_SECONDS = "rb_tpu_serve_maintain_seconds"
+SERVE_MAINTAIN_RECLAIMED_BYTES_TOTAL = "rb_tpu_serve_maintain_reclaimed_bytes_total"
+SERVE_MAINTAIN_KEYS_TOTAL = "rb_tpu_serve_maintain_keys_total"
 
 # upper bucket bounds (seconds) for wall-time histograms: host phases span
 # ~100 µs packing steps to multi-second CPU folds; +Inf is implicit

@@ -38,6 +38,13 @@ about a verdict:
     each authority's provenance ("refit-from-traffic") and moved cells
     land in the actuation log, and the columnar model persists through
     ``RB_TPU_COLUMNAR_CAL`` exactly as a manual refit would.
+  - ``"maintain"`` (structure-drift / delta-accretion, ISSUE 16): while
+    the rule is at WARN or worse, one priced background maintenance
+    pass (``serve.maintain.run_pass``) — the pass itself still decides
+    compact-vs-ride through the compaction authority, so the sentinel
+    schedules work, it never forces it. Guarded by its own cooldown
+    (``RB_TPU_SENTINEL_MAINTAIN_COOLDOWN_S``, default 30 s) so a
+    stubborn drift cannot turn the corpus into a rewrite storm.
   - ``"alert"``: on the fire transition, a structured
     ``sentinel.alert`` recorder instant + decision-log entry carrying
     the rule, value, and threshold — once per episode, not per tick
@@ -66,11 +73,12 @@ from . import timeline as _timeline
 DEFAULT_INTERVAL_S = 5.0
 DEFAULT_REFIT_COOLDOWN_S = 60.0
 DEFAULT_BUNDLE_COOLDOWN_S = 300.0
+DEFAULT_MAINTAIN_COOLDOWN_S = 30.0
 
 _ACTUATION_TOTAL = _registry.counter(
     _registry.HEALTH_ACTUATION_TOTAL,
     "Sentinel closed-loop actuations by rule and kind "
-    "(refit | alert | bundle)",
+    "(refit | maintain | alert | bundle)",
     ("rule", "kind"),
 )
 
@@ -94,6 +102,7 @@ class Sentinel:
         clock=time.monotonic,
         refit_cooldown_s: Optional[float] = None,
         bundle_cooldown_s: Optional[float] = None,
+        maintain_cooldown_s: Optional[float] = None,
     ):
         self.rules: Tuple[_health.Rule, ...] = tuple(
             _health.DEFAULT_RULES if rules is None else rules
@@ -107,6 +116,13 @@ class Sentinel:
             _env_float("RB_TPU_SENTINEL_BUNDLE_COOLDOWN_S", DEFAULT_BUNDLE_COOLDOWN_S)
             if bundle_cooldown_s is None else float(bundle_cooldown_s)
         )
+        self.maintain_cooldown_s = (
+            _env_float(
+                "RB_TPU_SENTINEL_MAINTAIN_COOLDOWN_S",
+                DEFAULT_MAINTAIN_COOLDOWN_S,
+            )
+            if maintain_cooldown_s is None else float(maintain_cooldown_s)
+        )
         self._lock = threading.Lock()  # leaf: guards the fields below only
         self._states: Dict[str, _health.RuleState] = {  # guarded-by: self._lock
             r.name: _health.RuleState() for r in self.rules
@@ -117,6 +133,7 @@ class Sentinel:
         self._actuations: "deque[dict]" = deque(maxlen=64)  # guarded-by: self._lock
         self._last_refit: Optional[float] = None  # guarded-by: self._lock
         self._last_bundle: Optional[float] = None  # guarded-by: self._lock
+        self._last_maintain: Optional[float] = None  # guarded-by: self._lock
 
     # -- the tick -----------------------------------------------------------
 
@@ -143,6 +160,7 @@ class Sentinel:
                 probe_errors[rule.name] = f"{type(e).__name__}: {e}"
         alerts: List[dict] = []
         refit_due: Optional[str] = None
+        maintain_due: Optional[str] = None
         bundle_due: Optional[List[str]] = None
         with self._lock:
             self._tick_no += 1
@@ -175,6 +193,17 @@ class Sentinel:
                 ):
                     self._last_refit = now
                     refit_due = rule.name
+                if (
+                    rule.actuation == "maintain"
+                    and st.level >= _health.WARN
+                    and maintain_due is None
+                    and (
+                        self._last_maintain is None
+                        or now - self._last_maintain >= self.maintain_cooldown_s
+                    )
+                ):
+                    self._last_maintain = now
+                    maintain_due = rule.name
             prev_status = self._status
             self._status = status
             self._prev_sums.update(snap.sums)
@@ -201,6 +230,8 @@ class Sentinel:
             actuated.append(self._actuate_alert(now, tick_no, a))
         if refit_due is not None:
             actuated.append(self._actuate_refit(now, tick_no, refit_due))
+        if maintain_due is not None:
+            actuated.append(self._actuate_maintain(now, tick_no, maintain_due))
         if bundle_due is not None:
             actuated.append(self._actuate_bundle(now, tick_no, bundle_due, evals))
         if actuated:
@@ -280,6 +311,32 @@ class Sentinel:
         _decisions.record_decision(
             "sentinel.actuate", "refit", rule=rule_name,
             error=entry.get("error"),
+        )
+        return entry
+
+    def _actuate_maintain(self, now, tick_no, rule_name: str) -> dict:
+        from . import decisions as _decisions
+
+        _ACTUATION_TOTAL.inc(1, (rule_name, "maintain"))
+        entry = {
+            "tick": tick_no, "ts": now, "kind": "maintain", "rule": rule_name,
+        }
+        try:
+            from ..serve import maintain as _maintain
+
+            record = _maintain.run_pass(reason=f"sentinel:{rule_name}")
+            entry["outcome"] = record.get("outcome")
+            entry["reclaimed_bytes"] = record.get("reclaimed_bytes")
+            entry["rewritten_keys"] = record.get("rewritten_keys")
+        except Exception as e:  # rb-ok: exception-hygiene -- a failed pass leaves the uncompacted epoch in place; the failure is recorded in the actuation log and the structure rules stay firing
+            entry["error"] = f"{type(e).__name__}: {e}"
+        _timeline.instant(
+            "sentinel.maintain", "health", rule=rule_name,
+            outcome=entry.get("outcome"),
+        )
+        _decisions.record_decision(
+            "sentinel.actuate", "maintain", rule=rule_name,
+            pass_outcome=entry.get("outcome"), error=entry.get("error"),
         )
         return entry
 
@@ -385,6 +442,7 @@ class Sentinel:
             self._actuations.clear()
             self._last_refit = None
             self._last_bundle = None
+            self._last_maintain = None
 
 
 # The process-wide sentinel (the thread, the inline hook, rb_top, and the
@@ -467,6 +525,7 @@ def configure(
     inline_interval_s: Optional[float] = None,
     refit_cooldown_s: Optional[float] = None,
     bundle_cooldown_s: Optional[float] = None,
+    maintain_cooldown_s: Optional[float] = None,
 ) -> None:
     """Runtime overrides for the process sentinel: arm/disarm the inline
     pacing hook and adjust the actuation cooldowns."""
@@ -481,6 +540,8 @@ def configure(
         SENTINEL.refit_cooldown_s = float(refit_cooldown_s)
     if bundle_cooldown_s is not None:
         SENTINEL.bundle_cooldown_s = float(bundle_cooldown_s)
+    if maintain_cooldown_s is not None:
+        SENTINEL.maintain_cooldown_s = float(maintain_cooldown_s)
 
 
 def _init_from_env() -> None:

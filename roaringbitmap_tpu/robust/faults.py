@@ -60,6 +60,7 @@ SITES: Tuple[str, ...] = (
     "columnar.device",   # columnar device-tier entry (columnar/device.py)
     "native.entry",      # native C tier entry probe (native/__init__.py)
     "pack_cache.budget", # resident pack-cache byte-budget admission
+    "serve.maintain",    # background maintenance/compaction pass (serve/maintain.py)
 )
 
 _FAULT_TOTAL = _observe.counter(

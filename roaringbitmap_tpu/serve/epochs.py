@@ -78,6 +78,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from ..observe import decisions as _decisions
 from ..observe import outcomes as _outcomes
 from ..observe import registry as _registry
+from ..observe import structure as _structure
 from ..observe import timeline as _timeline
 from ..observe.histogram import latency_histogram
 from ..robust import errors as _rerrors
@@ -257,10 +258,27 @@ class EpochStore:
 
     # -- the flip ------------------------------------------------------------
 
-    def flip(self, reason: str = "manual", now: Optional[float] = None) -> dict:
+    def flip(
+        self,
+        reason: str = "manual",
+        now: Optional[float] = None,
+        rewrite=None,
+    ) -> dict:
         """Publish a new epoch from the pending mutation log. Returns the
         flip record (also appended to the lineage ledger when the flip
-        publishes): ``outcome`` is one of :data:`FLIP_OUTCOMES`."""
+        publishes): ``outcome`` is one of :data:`FLIP_OUTCOMES`.
+
+        ``rewrite`` turns the flip into a **compaction** (ISSUE 16): a
+        callable run inside the repack stage's writer-exclusive window,
+        after the drained batches are applied — it may rewrite corpus
+        containers IN PLACE as long as every rewrite is bit-identical
+        (a compaction is just a flip whose batches are rewrites; the
+        maintenance pass audits identity per container). It returns
+        ``(touched_indices, stats_dict)``; the indices join the batch
+        set for the working-set refresh and the stats land on the
+        lineage record as ``record["rewrite"]``. A rewrite flip
+        publishes even when the mutation log is empty — the new epoch
+        IS the compacted corpus."""
         try:
             _faults.fault_point("epoch.flip")
         except Exception as e:
@@ -317,7 +335,7 @@ class EpochStore:
                 )
                 return {"outcome": "stalled", "epoch": epoch, "reason": reason}
             try:
-                if not batches:
+                if not batches and rewrite is None:
                     _FLIP_TOTAL.inc(1, ("noop",))
                     return {"outcome": "noop", "epoch": epoch, "reason": reason}
                 # ---- repack: writer stream + O(k) delta per working set ----
@@ -328,6 +346,14 @@ class EpochStore:
                     merged = _ingest.merge_batches(batches)
                     touched = sorted(merged)
                     _ingest.apply_merged(self.corpus, merged)
+                    rewrite_stats = None
+                    if rewrite is not None:
+                        # the compaction body: runs AFTER the drained
+                        # batches land so it re-selects the post-merge
+                        # containers, BEFORE the working-set refresh so
+                        # the pack cache sees the rewritten rows
+                        rewritten, rewrite_stats = rewrite(self.corpus)
+                        touched = sorted(set(touched) | set(rewritten))
                     delta = self._repack_working_sets(touched)
                 # ---- publish: bump epoch, lineage, freshness ----
                 with _timeline.stage(
@@ -346,11 +372,18 @@ class EpochStore:
                         "delta": delta,
                         "ts": now,
                     }
+                    if rewrite_stats is not None:
+                        record["rewrite"] = rewrite_stats
                     with self._cond:
                         self._epoch = epoch + 1
                         self._lineage.append(record)
                     _EPOCH_COUNT.set(epoch + 1)
                     _ingest.observe_freshness(batches, now=self._clock())
+                    if batches:
+                        # the structure observatory's accretion-depth
+                        # gauge: delta batches folded into the corpus
+                        # since the last maintenance pass settled it
+                        _structure.LEDGER.accrete(len(batches))
             finally:
                 # ---- reclaim: reopen admission (parked readers wake
                 # under the new epoch), settle state on EVERY exit path —

@@ -214,8 +214,12 @@ def merge_batches(
 def apply_merged(corpus: Sequence, merged: Dict[int, np.ndarray]) -> int:
     """Stream pre-merged per-bitmap values into the corpus through the
     sorted-stream writer surface (``BitmapWriter(into=...)``), one writer
-    per touched bitmap. MUST only run inside the flip's writer-exclusive
-    window (no readers admitted). Returns the number of touched bitmaps."""
+    per touched bitmap, with per-container format re-selection on the
+    touched keys (``optimise_runs`` — the serving-path ``runOptimize``
+    gap, ISSUE 16: without it sustained ingest lands every write-hot
+    chunk as a fragmented array/bitmap forever). MUST only run inside
+    the flip's writer-exclusive window (no readers admitted). Returns
+    the number of touched bitmaps."""
     from ..models.writer import BitmapWriter
 
     for idx, values in merged.items():
@@ -224,7 +228,7 @@ def apply_merged(corpus: Sequence, merged: Dict[int, np.ndarray]) -> int:
                 f"mutation batch touches bitmap {idx} outside the corpus "
                 f"(size {len(corpus)})"
             )
-        w = BitmapWriter(into=corpus[idx])
+        w = BitmapWriter(into=corpus[idx], optimise_runs=True)
         w.add_many(values)
         w.flush()
     return len(merged)

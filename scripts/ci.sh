@@ -26,7 +26,7 @@ if [[ "${1:-}" != "--fast" ]]; then
   python -m pytest tests/ -q
 fi
 
-step "fuzz smoke (500 iterations x 29 invariant families)"
+step "fuzz smoke (500 iterations x 30 invariant families)"
 python -m roaringbitmap_tpu.fuzz 500 > /tmp/ci_fuzz.log 2>&1 \
   || { tail -20 /tmp/ci_fuzz.log; exit 1; }
 tail -1 /tmp/ci_fuzz.log
@@ -572,7 +572,8 @@ if h.get("cwd_clean") is not True or any(h.get("rules", {}).values()):
 need_rules = {"costmodel-drift", "routing-regret", "breaker-stuck-open",
               "outcome-anomaly-burst", "hbm-accounting-drift", "compile-storm",
               "fusion-queue-stall", "serving-p99-breach", "tenant-saturation",
-              "freshness-lag-breach", "epoch-flip-stall"}
+              "freshness-lag-breach", "epoch-flip-stall", "structure-drift",
+              "delta-accretion"}
 if set(h.get("rules", {})) != need_rules:
     raise SystemExit("committed rule table changed: %r" % sorted(h.get("rules", {})))
 side = json.load(open("/tmp/ci_bench_metrics.json"))
@@ -624,17 +625,20 @@ hd = json.load(open(os.path.join(path, "health.json")))
 if hd["rules"]["ci-forced-red"]["level"] != 2 or not hd["rules"]["ci-forced-red"]["history"]:
     raise SystemExit("bundle health.json lacks the red rule state/history")
 cal = json.load(open(os.path.join(path, "calibration.json")))
-if set(cal.get("authorities", {})) != {"columnar-cutoff", "device-breakeven",
+if set(cal.get("authorities", {})) != {"columnar-cutoff", "compaction",
+                                       "device-breakeven",
                                        "epoch-flip", "fusion-batch",
                                        "pack-residency",
                                        "planner-cardinality", "serve-admission"}:
-    raise SystemExit("bundle calibration.json lacks the seven authorities: %r"
+    raise SystemExit("bundle calibration.json lacks the eight authorities: %r"
                      % sorted(cal.get("authorities", {})))
 obs = json.load(open(os.path.join(path, "observatory.json")))
 if "serving" not in obs:
     raise SystemExit("bundle observatory.json lacks the serving panel")
 if "epochs" not in obs:
     raise SystemExit("bundle observatory.json lacks the epoch panel")
+if "structure" not in obs:
+    raise SystemExit("bundle observatory.json lacks the structure panel")
 new_cwd = sorted(set(os.listdir(".")) - cwd_before)
 if new_cwd:
     raise SystemExit("forced red tick wrote into the CWD: %r" % new_cwd)
@@ -1022,7 +1026,137 @@ print("epoch metric names ok (suffixes + declared label sets; fault site + "
       "seventh authority registered; epoch-id label clause armed)"
 )'
 
-step "rb_top observatory report (schema rb_tpu_top/6, ISSUE 9 + 11 + 12 + 13 + 14 + 15)"
+step "structure soak: maintained vs unmaintained twin, priced compaction, drift demo (ISSUE 16)"
+# the bench must commit meta.soak: the sustained-ingest soak ran a
+# maintained corpus and an unmaintained twin through identical drift
+# windows; the maintained side must hold drift <=1.1x while the twin
+# degrades past 1.5x, every round's serving window must be bit-exact vs
+# the epoch-replay oracle with zero torn reads (including the final
+# round, whose pass runs CONCURRENT with serving), the ledger's
+# incremental books must reconcile against a from-scratch census, the
+# serve.maintain site must join priced (unforced) outcomes with regret
+# <=5% and a traffic-refit compaction curve, and the seeded drift demo
+# must fire structure-drift -> actuate one pass under cooldown -> green
+python -c '
+import json
+m = json.load(open("/tmp/ci_bench.json"))["meta"]
+sk = m.get("soak")
+if not isinstance(sk, dict):
+    raise SystemExit("bench meta lacks the soak block")
+need = {"host", "rounds", "requests_per_round", "drift_spans_per_round",
+        "maintained", "twin", "torn_reads", "bitexact",
+        "ledger_census_reconciled", "compaction_decision", "drift_demo"}
+missing = need - set(sk)
+if missing:
+    raise SystemExit("soak block lacks %s" % sorted(missing))
+rounds = sk["rounds"]
+if not len(rounds) >= 3:
+    raise SystemExit("soak ran only %d rounds" % len(rounds))
+for row in rounds:
+    mt = row["maintained"]
+    if mt.get("torn_reads") != 0:
+        raise SystemExit("soak round %s saw torn reads: %r" % (row.get("round"), mt))
+    if mt.get("pass", {}).get("outcome") != "compacted":
+        raise SystemExit("soak round %s pass did not compact: %r"
+                         % (row.get("round"), mt.get("pass")))
+    if not row["twin"].get("drift_ratio", 0) > mt.get("drift_ratio", 0):
+        raise SystemExit("soak round %s twin did not drift past maintained: %r"
+                         % (row.get("round"), row))
+if not any(r["maintained"]["pass"].get("concurrent") for r in rounds):
+    raise SystemExit("no soak pass ran concurrent with the serving window")
+mend = sk["maintained"]["drift_ratio_end"]
+tend = sk["twin"]["drift_ratio_end"]
+if not mend <= 1.1:
+    raise SystemExit("maintained corpus drifted to %sx (budget 1.1x)" % mend)
+if not tend >= 1.5:
+    raise SystemExit("unmaintained twin failed to degrade (%sx) — drift "
+                     "injection is not exercising the maintainer" % tend)
+if sk["torn_reads"] != 0 or sk["bitexact"] is not True:
+    raise SystemExit("soak was not torn-free bit-exact: %r"
+                     % {"torn": sk["torn_reads"], "bitexact": sk["bitexact"]})
+if sk["ledger_census_reconciled"] is not True:
+    raise SystemExit("structure ledger books diverged from the census")
+cd = sk["compaction_decision"]
+if not cd.get("joins", 0) > 0:
+    raise SystemExit("no priced serve.maintain outcomes joined: %r" % cd)
+if not (0.0 <= cd.get("regret", 1) <= 0.05):
+    raise SystemExit("compaction regret %s blew the 5%% budget" % cd.get("regret"))
+if cd.get("refit", {}).get("provenance") != "refit-from-traffic":
+    raise SystemExit("compaction curve never refit from traffic: %r" % cd)
+dd = sk["drift_demo"]
+if dd.get("rule") != "structure-drift" or dd.get("ticks_to_actuate") is None:
+    raise SystemExit("drift demo did not fire structure-drift: %r" % dd)
+if dd.get("pass_outcome") != "compacted" or not dd.get("reclaimed_bytes", 0) > 0:
+    raise SystemExit("drift demo actuation did not compact: %r" % dd)
+if dd.get("passes_under_cooldown") != 1:
+    raise SystemExit("maintain cooldown did not hold to one pass: %r" % dd)
+if dd.get("status_end") != "green":
+    raise SystemExit("drift demo did not clear green: %r" % dd.get("status_end"))
+side = json.load(open("/tmp/ci_bench_metrics.json"))
+sst = side.get("structure")
+if not isinstance(sst, dict):
+    raise SystemExit("metrics sidecar lacks the structure block")
+smissing = {"containers", "bytes", "drift_ratio", "accretion_depth",
+            "passes"} - set(sst)
+if smissing:
+    raise SystemExit("sidecar structure block lacks %s" % sorted(smissing))
+print("soak ok (%d rounds; maintained %sx vs twin %sx; torn 0 bit-exact; "
+      "books reconciled; %d priced joins regret %s err %s; drift demo "
+      "%sx -> %s in %s ticks, %d pass under cooldown -> %s)"
+      % (len(rounds), mend, tend, cd["joins"], cd["regret"],
+         cd.get("error_ratio_geomean"), dd.get("drift_ratio_seeded"),
+         dd.get("pass_outcome"), dd.get("ticks_to_actuate"),
+         dd.get("passes_under_cooldown"), dd.get("status_end")))'
+# the structure metric names must pass the naming convention with the
+# CONTAINERS suffix clause, the serve.maintain fault site and eighth
+# authority must be registered, and the two sentinel rules must carry
+# the maintain actuation
+JAX_PLATFORMS=cpu python -c '
+from roaringbitmap_tpu import cost, observe
+from roaringbitmap_tpu.robust import faults
+for name, suffix in ((observe.STRUCTURE_CONTAINERS, "_containers"),
+                     (observe.STRUCTURE_BYTES, "_bytes"),
+                     (observe.STRUCTURE_DRIFT_RATIO, "_ratio"),
+                     (observe.STRUCTURE_FRAGMENTATION_COUNT, "_count"),
+                     (observe.STRUCTURE_ACCRETION_COUNT, "_count"),
+                     (observe.SERVE_MAINTAIN_TOTAL, "_total"),
+                     (observe.SERVE_MAINTAIN_SECONDS, "_seconds"),
+                     (observe.SERVE_MAINTAIN_RECLAIMED_BYTES_TOTAL, "_total"),
+                     (observe.SERVE_MAINTAIN_KEYS_TOTAL, "_total")):
+    if not (name.startswith("rb_tpu_") and name.endswith(suffix)):
+        raise SystemExit("structure metric violates naming convention: %r" % name)
+import roaringbitmap_tpu.serve  # registers the maintenance metrics
+import roaringbitmap_tpu.observe.structure as structure_mod
+cn = observe.REGISTRY.get(observe.STRUCTURE_CONTAINERS)
+if cn is None or cn.labelnames != ("format",):
+    raise SystemExit("container census label set is not the declared (format,)")
+by = observe.REGISTRY.get(observe.STRUCTURE_BYTES)
+if by is None or by.labelnames != ("kind",):
+    raise SystemExit("structure bytes label set is not the declared (kind,)")
+if set(structure_mod.FORMATS) != {"array", "bitmap", "run"}:
+    raise SystemExit("declared container-format set drifted: %r"
+                     % sorted(structure_mod.FORMATS))
+if "serve.maintain" not in faults.SITES:
+    raise SystemExit("serve.maintain fault site not registered")
+if "compaction" not in cost.names():
+    raise SystemExit("compaction authority not registered in the cost facade")
+from roaringbitmap_tpu.observe import health
+rules = {r.name: r for r in health.DEFAULT_RULES}
+for rn in ("structure-drift", "delta-accretion"):
+    if rn not in rules:
+        raise SystemExit("rule table lacks %s" % rn)
+    if rules[rn].actuation != "maintain":
+        raise SystemExit("rule %s does not actuate maintain: %r"
+                         % (rn, rules[rn].actuation))
+from roaringbitmap_tpu.analysis.rules.metrics import _FORMAT_VALUE
+if not (_FORMAT_VALUE.search("format") and _FORMAT_VALUE.search("fmt")
+        and _FORMAT_VALUE.search("container_format")):
+    raise SystemExit("metric-naming rule lost the container-format clause")
+print("structure metric names ok (suffixes + declared label sets; fault site + "
+      "eighth authority registered; maintain actuation wired; format clause armed)"
+)'
+
+step "rb_top observatory report (schema rb_tpu_top/7, ISSUE 9 + 11 + 12 + 13 + 14 + 15 + 16)"
 # the snapshot CLI must produce a schema-valid JSON report with every
 # panel populated from its in-process demo workload — incl. the regret
 # panel (per-site joins from the decision-outcome ledger), the health
@@ -1030,17 +1164,18 @@ step "rb_top observatory report (schema rb_tpu_top/6, ISSUE 9 + 11 + 12 + 13 + 1
 # fusion panel (window occupancy + shared-subexpression hit ratio from
 # the demo's fused window), and the epoch panel (current epoch, mutlog
 # depth, freshness, flip stages, lineage from the demo's read-write
-# window)
+# window), and the structure panel (container census, drift ratio,
+# maintenance-pass rows from the demo's forced pass)
 JAX_PLATFORMS=cpu RB_TPU_ARTIFACT_DIR=/tmp/ci_artifacts \
   python scripts/rb_top.py --demo --json > /tmp/ci_rb_top.json
 python -c '
 import json
 r = json.load(open("/tmp/ci_rb_top.json"))
-if r.get("schema") != "rb_tpu_top/6":
+if r.get("schema") != "rb_tpu_top/7":
     raise SystemExit("rb_top: bad schema %r" % r.get("schema"))
 need = {"schema", "generated_utc", "source", "counters", "latency",
         "locks", "breakers", "cache", "decisions_tail", "regret", "health",
-        "fusion", "serving", "epochs"}
+        "fusion", "serving", "epochs", "structure"}
 missing = need - set(r)
 if missing:
     raise SystemExit("rb_top report lacks %s" % sorted(missing))
@@ -1075,6 +1210,26 @@ if not (fu.get("occupancy") and fu["occupancy"] >= 2):
     raise SystemExit("rb_top fusion occupancy not a real window: %r" % fu)
 if not (fu.get("dedup_hit_ratio") and fu["dedup_hit_ratio"] > 0):
     raise SystemExit("rb_top demo shared subexpression never deduped: %r" % fu)
+st = r["structure"]
+sneed = {"containers", "bytes", "drift_ratio", "accretion_depth", "passes",
+         "last_pass", "authority"}
+smiss = sneed - set(st)
+if smiss:
+    raise SystemExit("rb_top structure panel lacks %s" % sorted(smiss))
+if not sum((st.get("containers") or {}).values()) > 0:
+    raise SystemExit("rb_top structure census saw no containers: %r"
+                     % st.get("containers"))
+if not ((st.get("bytes") or {}).get("actual", 0) > 0
+        and st["bytes"].get("optimal", 0) > 0):
+    raise SystemExit("rb_top structure byte census empty: %r" % st.get("bytes"))
+if not st.get("drift_ratio", 0) > 0:
+    raise SystemExit("rb_top structure drift ratio missing: %r" % st)
+if not st.get("passes", {}).get("compacted", 0) >= 1:
+    raise SystemExit("rb_top demo maintenance pass never compacted: %r"
+                     % st.get("passes"))
+lp = st.get("last_pass") or {}
+if lp.get("outcome") != "compacted" or not lp.get("rewritten_keys", 0) > 0:
+    raise SystemExit("rb_top last maintenance pass malformed: %r" % lp)
 if not r["locks"]:
     raise SystemExit("rb_top demo recorded no lock waits")
 if not r["counters"]["compile"]:

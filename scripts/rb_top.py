@@ -40,14 +40,22 @@ epoch, live mutation-log depth, per-tenant freshness p50/p99
 outcome, and the live EpochStore's lineage tail (flip regret rides the
 regret panel under the ``epoch.flip`` site).
 
-``--json`` emits the machine-readable report (schema ``rb_tpu_top/6``:
-the ``epochs`` key landed in /6, ``serving`` in /5, ``fusion`` in /4,
-``health`` in /3, ``regret`` in /2; scripts/ci.sh validates it).
+Since ISSUE 16 the report carries the **structure panel**: the
+container-format census over the watched working sets, the
+actual-vs-optimal serialized-bytes drift ratio, run fragmentation p99,
+epoch-delta accretion depth, the last maintenance pass's outcome +
+reclaimed bytes, and the compaction authority's provenance (pass regret
+rides the regret panel under the ``serve.maintain`` site).
+
+``--json`` emits the machine-readable report (schema ``rb_tpu_top/7``:
+the ``structure`` key landed in /7, ``epochs`` in /6, ``serving`` in
+/5, ``fusion`` in /4, ``health`` in /3, ``regret`` in /2;
+scripts/ci.sh validates it).
 Breaker states, the decision log, the outcome ledger, sentinel rule
 states, and epoch lineage are process-local, so a sidecar-sourced
 report carries the sidecar's registry view of them (counter totals + the
-``regret``/``health``/``fusion``/``epochs`` blocks derived in export.py)
-rather than live states.
+``regret``/``health``/``fusion``/``epochs``/``structure`` blocks
+derived in export.py) rather than live states.
 """
 
 from __future__ import annotations
@@ -61,7 +69,7 @@ import time
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
-SCHEMA = "rb_tpu_top/6"
+SCHEMA = "rb_tpu_top/7"
 
 
 def _live_report(tail: int) -> dict:
@@ -103,6 +111,9 @@ def _live_report(tail: int) -> dict:
         # epoch ledger (ISSUE 15): current epoch, mutlog depth, freshness
         # p50/p99, flip stage breakdown, live lineage tail
         "epochs": insights.epochs(),
+        # structure observatory (ISSUE 16): format census, drift ratio,
+        # fragmentation/accretion, last maintenance pass, authority
+        "structure": insights.structure(),
     }
 
 
@@ -158,6 +169,9 @@ def _sidecar_report(path: str, tail: int) -> dict:
         # the sidecar's registry-derived epochs block (export.py; lineage
         # is process-local and absent from a sidecar rendering)
         "epochs": side.get("epochs", {}),
+        # the sidecar's registry-derived structure block (export.py; the
+        # live ledger stats and last-pass record are process-local)
+        "structure": side.get("structure", {}),
     }
 
 
@@ -226,6 +240,18 @@ def _demo_workload() -> None:
         bms, rw_profiles, threads=2, window=4, epoch_store=es
     )
     rw_harness.run(build_requests(bms, rw_profiles, 12, seed=13))
+    # a watched working set + one forced maintenance pass so the
+    # structure panel reports a real census and pass record (ISSUE 16);
+    # a dense drift span first (full chunks held as 8 KiB bitmap
+    # containers that the size rule wants as runs) so the pass actually
+    # rewrites containers instead of auditing an already-optimal corpus
+    from roaringbitmap_tpu.observe import structure as _structure
+    from roaringbitmap_tpu.serve import maintain as _maintain
+
+    bms[0] |= RoaringBitmap(np.arange(0x400 << 16, (0x400 << 16) + 2 * 65536))
+    _structure.LEDGER.watch("demo", bms)
+    _structure.LEDGER.refresh()
+    _maintain.run_pass(store=es, reason="demo", force=True)
     # a couple of sentinel ticks so the health panel reports a judged
     # status (hysteresis needs consecutive evaluations), not "never ran"
     from roaringbitmap_tpu.observe import sentinel
@@ -434,6 +460,37 @@ def _render_console(r: dict) -> str:
              f"delta_rows={rec.get('delta', {}).get('delta_rows')}")
         )
     section("epochs (ingest & freshness)", ep_rows)
+    # structure panel (ISSUE 16): format census, bytes-vs-optimal drift
+    # ratio, fragmentation p99, accretion depth, the last maintenance
+    # pass, the compaction authority's provenance — pass regret rides the
+    # regret panel above under the serve.maintain site
+    st = r.get("structure", {}) or {}
+    st_rows = []
+    for fmt, v in sorted((st.get("containers") or {}).items()):
+        st_rows.append((f"containers[{fmt}]", v))
+    for kind, v in sorted((st.get("bytes") or {}).items()):
+        st_rows.append((f"bytes[{kind}]", v))
+    if st.get("drift_ratio") is not None:
+        st_rows.append(("drift ratio (actual/optimal)", st["drift_ratio"]))
+    if st.get("fragmentation_p99") is not None:
+        st_rows.append(("run fragmentation p99", st["fragmentation_p99"]))
+    if st.get("accretion_depth") is not None:
+        st_rows.append(("delta accretion depth", st["accretion_depth"]))
+    for outcome, v in sorted((st.get("passes") or {}).items()):
+        st_rows.append((f"passes[{outcome}]", v))
+    if st.get("reclaimed_bytes"):
+        st_rows.append(("reclaimed bytes", st["reclaimed_bytes"]))
+    lp = st.get("last_pass")
+    if isinstance(lp, dict) and lp:
+        st_rows.append(
+            ("last pass",
+             f"{lp.get('outcome')} keys={lp.get('rewritten_keys')} "
+             f"reclaimed={lp.get('reclaimed_bytes')}B "
+             f"anomalies={lp.get('anomalies')} wall={lp.get('wall_s')}s")
+        )
+    if st.get("authority"):
+        st_rows.append(("authority", st["authority"]))
+    section("structure (corpus shape & compaction)", st_rows)
     dec_rows = [
         (d.get("trace") or "-",
          f"{d['site']}: {d['decision']} {d.get('inputs', '')}")

@@ -1065,6 +1065,56 @@ def test_live_epoch_tree_is_clean_under_epoch_rule():
     assert [f for f in res.findings] == []
 
 
+FORMAT_LABEL_SRC = """from roaringbitmap_tpu import observe
+_ST_CONTAINERS = observe.gauge("rb_tpu_st_containers", "", ("format",))
+FORMATS = {"array": "array"}
+def census(fmt, container_format):
+    _ST_CONTAINERS.set(1, (FORMATS[fmt],))
+    _ST_CONTAINERS.set(1, ("run",))
+    _ST_CONTAINERS.set(1, (fmt,))
+    _ST_CONTAINERS.set(1, (container_format,))
+"""
+
+
+def test_metric_label_values_format_needs_declared_set(tmp_path):
+    # ISSUE 16 satellite: container-format label VALUES must come from
+    # the declared frozen format set — the FORMATS[fmt] subscript (line
+    # 5, the declared-collection escape) and a literal "run" (line 6)
+    # pass; the bare fmt / container_format variables (lines 7-8) are
+    # flagged with the format-set-pointing message
+    res = _run_snippet(tmp_path, FORMAT_LABEL_SRC, rules=["metric-naming"])
+    assert {f.line for f in res.findings} == {7, 8}
+    assert all("declared frozen" in f.message for f in res.findings)
+
+
+def test_metric_naming_containers_census_suffix(tmp_path):
+    # ISSUE 16 satellite: _CONTAINERS is a shaped census-gauge suffix
+    # (a live-object count by declared format) — a cross-module constant
+    # wearing it is accepted, an unshaped census name is still flagged
+    src = """from roaringbitmap_tpu import observe
+from somewhere import STRUCTURE_CONTAINERS, STRUCTURE_CENSUS
+A = observe.gauge(STRUCTURE_CONTAINERS, "shaped: validated at definition", ("format",))
+B = observe.gauge(STRUCTURE_CENSUS, "unshaped name: unverifiable")
+"""
+    res = _run_snippet(tmp_path, src, rules=["metric-naming"])
+    assert [f.line for f in res.findings] == [4]
+
+
+def test_live_structure_tree_is_clean_under_format_rule():
+    # the structure observatory itself must pass the discipline it
+    # motivated: census label values are spelled FORMATS[fmt], the
+    # maintenance tier's outcome labels are declared literals
+    import roaringbitmap_tpu.observe.structure as ostr
+    import roaringbitmap_tpu.serve.maintain as smnt
+
+    from roaringbitmap_tpu.analysis import run_checks
+
+    res = run_checks(
+        [ostr.__file__, smnt.__file__], rules=["metric-naming"],
+    )
+    assert [f for f in res.findings] == []
+
+
 def test_live_tree_has_no_unbounded_label_values():
     # the rule runs over the real package in test_live_tree_is_clean-style
     # gates elsewhere; pin here that the columnar fold labels (the one

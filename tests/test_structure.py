@@ -1,0 +1,487 @@
+"""Structure observatory + background compaction tests (ISSUE 16): the
+incremental corpus-shape ledger (O(dirty) refresh reconciling with the
+full census, drift targets, accretion depth), the priced maintenance
+pass (bit-identity audit, the serve.maintain fault site failing CLOSED,
+compact-vs-ride pricing, the outcome join + refit), the EIGHTH cost
+authority's round-trip, the two new sentinel rules firing -> actuating
+a pass -> clearing, the serving-path runOptimize regression (satellite:
+BitmapWriter merge + apply_merged re-select formats), the sidecar /
+insights structure block, and the fuzz family 30 seed pin."""
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import cost, insights, observe
+from roaringbitmap_tpu.cost import compaction as compaction_cost
+from roaringbitmap_tpu.models.roaring import RoaringBitmap
+from roaringbitmap_tpu.models.writer import BitmapWriter
+from roaringbitmap_tpu.observe import export as obs_export
+from roaringbitmap_tpu.observe import health, outcomes, sentinel
+from roaringbitmap_tpu.observe import structure as structure_mod
+from roaringbitmap_tpu.parallel import store
+from roaringbitmap_tpu.robust import faults
+from roaringbitmap_tpu.robust import ladder as ladder_mod
+from roaringbitmap_tpu.robust.errors import TransientDeviceError
+from roaringbitmap_tpu.serve import EpochStore
+from roaringbitmap_tpu.serve import maintain as maintain_mod
+from roaringbitmap_tpu.serve import slo
+
+LEDGER = structure_mod.LEDGER
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Every test starts from a clean ledger/model/fault/sentinel state
+    and leaves none behind."""
+    slo.reset()
+    outcomes.reset()
+    faults.clear()
+    LEDGER.reset()
+    maintain_mod.reset()
+    compaction_cost.MODEL.reset()
+    sentinel.SENTINEL.reset()
+    ladder_mod.LADDER.reset()
+    yield
+    slo.reset()
+    outcomes.reset()
+    faults.clear()
+    LEDGER.reset()
+    maintain_mod.reset()
+    compaction_cost.MODEL.reset()
+    sentinel.SENTINEL.reset()
+    ladder_mod.LADDER.reset()
+    store.PACK_CACHE.close()
+
+
+def _corpus(n=4, seed=3, card=1500):
+    rng = np.random.default_rng(seed)
+    return [
+        RoaringBitmap(
+            np.sort(rng.choice(1 << 18, card, replace=False)).astype(np.uint32)
+        )
+        for _ in range(n)
+    ]
+
+
+def _drift(corpus, lo=50000, hi=58000):
+    """Append a contiguous run to every bitmap: the touched containers
+    become run-compressible but stay in their mutated array/bitmap
+    format until something re-runs format selection."""
+    for bm in corpus:
+        bm |= RoaringBitmap(np.arange(lo, hi))
+
+
+def _declare(name="st-t"):
+    slo.TENANTS.declare(name, quota_qps=1e6, burst=1e6)
+    return name
+
+
+# ---------------------------------------------------------------------------
+# the incremental structure ledger
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_incremental_refresh_reconciles_with_full_census():
+    corpus = _corpus()
+    LEDGER.watch("ws", corpus)
+    LEDGER.refresh()
+    # mutate a few keys through attributed mutators, then drift one set
+    corpus[0].add(123456)
+    corpus[1] |= RoaringBitmap(np.arange(9000, 12000))
+    s = LEDGER.refresh()
+    c = LEDGER.census()
+    assert s["containers"] == c["containers"]
+    assert s["actual_bytes"] == c["actual_bytes"]
+    assert s["optimal_bytes"] == c["optimal_bytes"]
+    assert s["drift_ratio"] == c["drift_ratio"]
+
+
+def test_ledger_refresh_is_o_dirty_not_o_corpus(monkeypatch):
+    corpus = _corpus()
+    LEDGER.watch("ws", corpus)
+    LEDGER.refresh()
+    calls = []
+    real = structure_mod._measure
+    monkeypatch.setattr(
+        structure_mod, "_measure", lambda ct: calls.append(1) or real(ct)
+    )
+    # a clean refresh measures nothing at all
+    LEDGER.refresh()
+    assert calls == []
+    # one dirty key re-measures one container, not the corpus
+    corpus[0].add(42)
+    LEDGER.refresh()
+    assert len(calls) == 1
+
+
+def test_ledger_drift_targets_price_excess_bytes():
+    corpus = _corpus()
+    LEDGER.watch("ws", corpus)
+    _drift(corpus)
+    s = LEDGER.refresh()
+    targets = LEDGER.drift_targets()
+    assert targets, "run-compressible containers must surface as targets"
+    assert all(excess > 0 for _, _, excess in targets)
+    assert s["drift_ratio"] > 1.05
+    # the gauges exported what the books say
+    snap = observe.REGISTRY.snapshot()
+    drift = snap[observe.STRUCTURE_DRIFT_RATIO]["samples"][0]["value"]
+    assert drift == s["drift_ratio"]
+
+
+def test_ledger_accretion_depth_tracks_and_settles():
+    corpus = _corpus(2)
+    LEDGER.watch("ws", corpus)
+    LEDGER.accrete(3)
+    LEDGER.accrete(2)
+    assert LEDGER.refresh()["accretion_depth"] == 5
+    LEDGER.settle_accretion()
+    assert LEDGER.refresh()["accretion_depth"] == 0
+
+
+def test_ledger_wholesale_mutation_triggers_full_rescan():
+    corpus = _corpus(2)
+    LEDGER.watch("ws", corpus)
+    LEDGER.refresh()
+    # a wholesale mutation (mark_all_dirty path) must not desync books
+    corpus[0].high_low_container.mark_all_dirty()
+    corpus[0].add(777)
+    s = LEDGER.refresh()
+    c = LEDGER.census()
+    assert s["containers"] == c["containers"]
+    assert s["actual_bytes"] == c["actual_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# the priced maintenance pass
+# ---------------------------------------------------------------------------
+
+
+def test_forced_pass_compacts_bit_identically_and_reclaims():
+    corpus = _corpus()
+    es = EpochStore(corpus)
+    LEDGER.watch("ws", corpus)
+    _drift(corpus)
+    LEDGER.refresh()
+    before = [bm.to_array() for bm in corpus]
+    rec = maintain_mod.run_pass(store=es, reason="test", force=True)
+    assert rec["outcome"] == "compacted"
+    assert rec["rewritten_keys"] > 0
+    assert rec["reclaimed_bytes"] > 0
+    assert rec["anomalies"] == 0
+    assert rec["flip"]["outcome"] == "flipped"
+    for bm, want in zip(corpus, before):
+        assert np.array_equal(bm.to_array(), want)
+    # the compaction collapsed the drift the ledger saw
+    assert LEDGER.refresh()["drift_ratio"] <= 1.05
+    assert maintain_mod.last_pass()["outcome"] == "compacted"
+
+
+def test_pass_rides_when_drift_is_cheaper_than_the_pass():
+    corpus = _corpus()
+    es = EpochStore(corpus)
+    LEDGER.watch("ws", corpus)
+    LEDGER.refresh()
+    # no drift, no log: ride (0 us) beats the pass overhead
+    rec = maintain_mod.run_pass(store=es, reason="test")
+    assert rec["outcome"] == "rode"
+    assert rec["est_us"]["ride"] < rec["est_us"]["compact"]
+
+
+def test_pass_compacts_when_ride_cost_exceeds_pass_cost():
+    corpus = _corpus()
+    es = EpochStore(corpus)
+    LEDGER.watch("ws", corpus)
+    _drift(corpus, lo=0, hi=120000)  # massive excess bytes
+    LEDGER.refresh()
+    LEDGER.accrete(10)  # deep accretion scales the ride cost
+    rec = maintain_mod.run_pass(store=es, reason="test")
+    assert rec["outcome"] == "compacted"
+    assert rec["est_us"]["ride"] >= rec["est_us"]["compact"]
+
+
+def test_pass_noop_without_store_or_watch():
+    assert maintain_mod.run_pass(store=None)["outcome"] == "noop"
+    es = EpochStore(_corpus(2))
+    assert maintain_mod.run_pass(store=es)["outcome"] == "noop"
+
+
+def test_pass_fault_fails_closed_to_uncompacted_epoch():
+    corpus = _corpus()
+    es = EpochStore(corpus)
+    LEDGER.watch("ws", corpus)
+    _drift(corpus)
+    LEDGER.refresh()
+    before = [bm.serialize() for bm in corpus]
+    epoch_before = es.stats()["epoch"]
+    with faults.inject("serve.maintain", TransientDeviceError, every=1):
+        rec = maintain_mod.run_pass(store=es, reason="test", force=True)
+    assert rec["outcome"] == "aborted"
+    assert es.stats()["epoch"] == epoch_before
+    for bm, want in zip(corpus, before):
+        assert bm.serialize() == want
+    # the degrade edge is recorded, and the next clean pass recovers
+    deg = observe.REGISTRY.get(observe.DEGRADE_TOTAL)
+    assert deg.get(("serve.maintain", "compact", "ride")) >= 1
+    rec2 = maintain_mod.run_pass(store=es, reason="test", force=True)
+    assert rec2["outcome"] == "compacted"
+
+
+def test_pass_joins_outcome_and_refit_consumes_it():
+    corpus = _corpus()
+    es = EpochStore(corpus)
+    LEDGER.watch("ws", corpus)
+    _drift(corpus)
+    LEDGER.refresh()
+    rec = maintain_mod.run_pass(store=es, reason="test", force=True)
+    assert rec["outcome"] == "compacted"
+    samples = [
+        s for s in outcomes.LEDGER.tail(32)
+        if s.get("site") == "serve.maintain"
+    ]
+    assert samples, "a taken pass must join its measured wall"
+    assert samples[-1]["engine"] == "compact"
+    report = compaction_cost.MODEL.refit_from_outcomes(
+        samples=samples, min_samples=1
+    )
+    assert report["provenance"] == "refit-from-traffic"
+
+
+# ---------------------------------------------------------------------------
+# the eighth cost authority
+# ---------------------------------------------------------------------------
+
+
+def test_compaction_authority_registered_with_full_protocol():
+    assert "compaction" in cost.names()
+    a = cost.authority("compaction")
+    assert a.provenance() == "default"
+    curves = a.curves()
+    assert curves["coeffs"]["drift_us_per_kb"] > 0
+    assert set(curves["refit_keys"]) == {
+        "pass_overhead_us", "rewrite_key_us", "merge_batch_us",
+    }
+    state = cost.calibration_state()
+    assert "compaction" in state["authorities"]
+
+
+def test_compaction_refit_moves_toward_truth_exchange_rate_pinned():
+    samples = [
+        {"site": "serve.maintain", "engine": "compact",
+         "predicted_us": 100.0, "measured_s": 0.0004}
+        for _ in range(4)
+    ]
+    before = dict(compaction_cost.MODEL.coeffs)
+    report = compaction_cost.MODEL.refit_from_outcomes(samples=samples)
+    assert set(report["moved"]) == {
+        "pass_overhead_us", "rewrite_key_us", "merge_batch_us",
+    }
+    after = compaction_cost.MODEL.coeffs
+    assert after["pass_overhead_us"] == pytest.approx(
+        before["pass_overhead_us"] * 4.0
+    )
+    # the declared let-it-ride exchange rate NEVER moves on refit
+    assert after["drift_us_per_kb"] == before["drift_us_per_kb"]
+    bad = [{"site": "serve.maintain", "engine": "compact",
+            "predicted_us": -1.0, "measured_s": 0.001}] * 3
+    report2 = compaction_cost.MODEL.refit_from_outcomes(samples=bad)
+    assert report2["rejected"] == 3 and not report2["moved"]
+
+
+def test_compaction_model_state_roundtrip_and_foreign_rejection():
+    compaction_cost.MODEL.refit_from_outcomes(samples=[
+        {"site": "serve.maintain", "engine": "compact",
+         "predicted_us": 100.0, "measured_s": 0.0002}
+        for _ in range(2)
+    ])
+    d = compaction_cost.MODEL.to_dict()
+    m2 = compaction_cost.CompactionModel()
+    assert m2.from_dict(d) is True
+    assert m2.coeffs == compaction_cost.MODEL.coeffs
+    assert m2.from_dict({"schema": "other/1"}) is False
+    assert m2.from_dict({"schema": compaction_cost.SCHEMA,
+                         "coeffs": {"pass_overhead_us": 1e12}}) is False
+
+
+# ---------------------------------------------------------------------------
+# sentinel rules: fire -> actuate a pass -> clear
+# ---------------------------------------------------------------------------
+
+
+def test_structure_drift_rule_fires_actuates_pass_and_clears():
+    corpus = _corpus()
+    es = EpochStore(corpus)
+    import roaringbitmap_tpu.serve.epochs as epochs_mod
+    assert epochs_mod.current_store() is es
+    LEDGER.watch("ws", corpus)
+    _drift(corpus, lo=0, hi=190000)
+    s = LEDGER.refresh()
+    assert s["drift_ratio"] >= 2.0, "setup must reach the critical band"
+    rules = tuple(
+        r for r in health.DEFAULT_RULES
+        if r.name in ("structure-drift", "delta-accretion")
+    )
+    assert len(rules) == 2
+    assert all(r.actuation == "maintain" for r in rules)
+    sen = sentinel.Sentinel(
+        rules=rules, clock=lambda: 0.0, maintain_cooldown_s=30.0,
+    )
+    r1 = sen.tick(now=0.0)
+    assert r1["actuated"] == []  # fire_after=2: first sight arms only
+    r2 = sen.tick(now=1.0)
+    # critical drift turns the process red, so a flight bundle may ride
+    # along — the maintain actuation is the one under test
+    maintains = [a for a in r2["actuated"] if a["kind"] == "maintain"]
+    assert len(maintains) == 1
+    act = maintains[0]
+    assert act["rule"] == "structure-drift"
+    assert act["outcome"] == "compacted"
+    assert "error" not in act
+    # the pass collapsed the drift: the rule clears over the next window
+    sen.tick(now=2.0)
+    r4 = sen.tick(now=3.0)
+    assert r4["rules"]["structure-drift"]["level"] == health.OK
+    assert r4["status_name"] == "green"
+    # still green + cooldown: no second pass was scheduled
+    assert sum(
+        1 for a in sen.actuations() if a["kind"] == "maintain"
+    ) == 1
+
+
+def test_delta_accretion_rule_reads_the_depth_gauge():
+    corpus = _corpus(2)
+    LEDGER.watch("ws", corpus)
+    LEDGER.accrete(9)  # warn band (>= 8)
+    LEDGER.refresh()
+    rule = next(
+        r for r in health.DEFAULT_RULES if r.name == "delta-accretion"
+    )
+    snap = health.snapshot(refresh_hbm=False)
+    assert rule.probe(snap) == 9.0
+    assert rule.band(rule.probe(snap)) == health.WARN
+    LEDGER.settle_accretion()
+    LEDGER.refresh()
+    snap2 = health.snapshot(refresh_hbm=False)
+    assert rule.band(rule.probe(snap2)) == health.OK
+
+
+def test_maintain_actuation_cooldown(monkeypatch):
+    calls = []
+    monkeypatch.setattr(
+        maintain_mod, "run_pass",
+        lambda **kw: calls.append(kw) or {"outcome": "compacted"},
+    )
+    dial = [5.0]
+    rule = health.Rule("r", "", lambda s: dial[0], warn=1.0, critical=100.0,
+                       fire_after=1, clear_after=1, actuation="maintain")
+    sen = sentinel.Sentinel(
+        rules=(rule,), clock=lambda: 0.0, maintain_cooldown_s=60.0,
+    )
+    sen.tick(now=0.0)
+    assert len(calls) == 1
+    assert calls[0]["reason"] == "sentinel:r"
+    sen.tick(now=1.0)
+    sen.tick(now=59.0)
+    assert len(calls) == 1, "pass re-ran inside its cooldown"
+    sen.tick(now=61.0)
+    assert len(calls) == 2
+
+
+def test_maintain_actuation_failure_is_recorded_not_fatal(monkeypatch):
+    def boom(**kw):
+        raise RuntimeError("pass broke")
+
+    monkeypatch.setattr(maintain_mod, "run_pass", boom)
+    rule = health.Rule("r", "", lambda s: 5.0, warn=1.0, critical=100.0,
+                       fire_after=1, clear_after=1, actuation="maintain")
+    sen = sentinel.Sentinel(rules=(rule,), clock=lambda: 0.0)
+    r = sen.tick(now=0.0)
+    acts = [a for a in r["actuated"] if a["kind"] == "maintain"]
+    assert len(acts) == 1
+    assert "pass broke" in acts[0]["error"]
+
+
+# ---------------------------------------------------------------------------
+# satellite: the serving-path runOptimize gap
+# ---------------------------------------------------------------------------
+
+
+def test_writer_merge_reselects_formats_when_optimising_runs():
+    base = RoaringBitmap(np.array([1, 5, 9], np.uint32))
+    w = BitmapWriter(into=base, optimise_runs=True)
+    w.add_many(np.arange(100, 5000, dtype=np.uint32))
+    w.flush()
+    assert base.high_low_container.get_container_at_index(0).TYPE == "run"
+    # default path unchanged: Java-parity merge keeps the or_ result
+    base2 = RoaringBitmap(np.array([1, 5, 9], np.uint32))
+    w2 = BitmapWriter(into=base2)
+    w2.add_many(np.arange(100, 5000, dtype=np.uint32))
+    w2.flush()
+    assert base2.high_low_container.get_container_at_index(0).TYPE != "run"
+
+
+def test_apply_merged_ingest_lands_run_heavy_batches_as_runs():
+    t = _declare()
+    corpus = _corpus(2)
+    es = EpochStore(corpus)
+    es.submit(t, {0: np.arange(600000, 640000)})
+    flip = es.flip()
+    assert flip["outcome"] == "flipped"
+    hlc = corpus[0].high_low_container
+    key = 600000 >> 16
+    i = hlc.get_index(key)
+    assert i >= 0
+    assert hlc.get_container_at_index(i).TYPE == "run", (
+        "serving-path ingest must re-run format selection on touched keys"
+    )
+
+
+def test_flip_with_rewrite_publishes_without_batches():
+    corpus = _corpus(2)
+    es = EpochStore(corpus)
+    epoch_before = es.stats()["epoch"]
+
+    def rewrite(live):
+        return {0}, {"rewritten_keys": 1}
+
+    flip = es.flip(rewrite=rewrite)
+    assert flip["outcome"] == "flipped"
+    assert flip["rewrite"] == {"rewritten_keys": 1}
+    assert es.stats()["epoch"] == epoch_before + 1
+    # a plain empty flip is still a noop
+    assert es.flip()["outcome"] == "noop"
+
+
+# ---------------------------------------------------------------------------
+# export / insights / fuzz pin
+# ---------------------------------------------------------------------------
+
+
+def test_sidecar_structure_block_and_insights():
+    corpus = _corpus()
+    es = EpochStore(corpus)
+    LEDGER.watch("ws", corpus)
+    _drift(corpus)
+    LEDGER.refresh()
+    maintain_mod.run_pass(store=es, reason="test", force=True)
+    side = obs_export.sidecar_snapshot()
+    st = side["structure"]
+    assert sum(st["containers"].values()) > 0
+    assert set(st["containers"]) <= {"array", "bitmap", "run"}
+    assert st["drift_ratio"] is not None
+    assert st["passes"].get("compacted", 0) >= 1
+    assert st["reclaimed_bytes"] and st["reclaimed_bytes"] > 0
+    live = insights.structure()
+    assert live["last_pass"]["outcome"] == "compacted"
+    assert live["authority"] == "default"
+    assert live["ledger_live"]["working_sets"] == 1
+    obs = insights.observatory()
+    assert "structure" in obs
+
+
+def test_fuzz_family_30_seed_pin():
+    from roaringbitmap_tpu import fuzz
+
+    fuzz.verify_compaction_invariance(
+        "compaction-vs-identity-oracle", iterations=3, seed=60
+    )
