@@ -45,6 +45,9 @@ from typing import Dict, List, Optional
 SCHEMA = "rb_tpu_epoch_cost/1"
 
 ENGINES = ("flip", "accumulate")
+# the durable half (ISSUE 17): persist-now writes the published epoch's
+# frozen artifact to disk; skip leaves it memory-only and exposed
+PERSIST_ENGINES = ("persist", "skip")
 
 # structural-prior defaults (µs): a flip drains readers (condition
 # round-trip), streams the merged values through the writer, and patches
@@ -60,12 +63,28 @@ DEFAULT_COEFFS = {
     # 10% ingest-tax budget at serving load, eager enough that the
     # freshness-lag-breach rule (2 s warn) never has to page first
     "staleness_us_per_s": 10000.0,
+    # durable persist (ISSUE 17): an atomic snapshot pays a fixed
+    # tmp-dir + manifest + rename overhead plus a per-KiB serialize +
+    # write + fsync rate; joined durable.persist outcomes refit both
+    "persist_overhead_us": 5000.0,
+    "persist_kb_us": 30.0,
+    # declared exchange rate, never refit: each published-but-unpersisted
+    # epoch is worth 20 ms of persist work per flip tick — a crash loses
+    # exactly the unpersisted suffix, so exposure scales with how many
+    # epochs of lineage sit only in RAM. Policy, not physics (operators
+    # tune it against their durability SLO; the epoch-persist-stall
+    # sentinel rule is the backstop when the rate is set too patient)
+    "durability_us_per_epoch": 20000.0,
 }
 # refit clamps (the house admission-model discipline)
 MAX_STEP = 8.0
 MAX_SCALE = 256.0
 # the refit learns these; staleness_us_per_s stays declared
 REFIT_KEYS = ("flip_overhead_us", "repack_value_us", "drain_reader_us")
+# persist-side host constants, refit from a SEPARATE durable.persist
+# ratio pool (disk bandwidth and flip wall drift independently);
+# durability_us_per_epoch stays declared
+PERSIST_REFIT_KEYS = ("persist_overhead_us", "persist_kb_us")
 
 
 class EpochFlipModel:
@@ -94,6 +113,27 @@ class EpochFlipModel:
             3,
         )
 
+    def predict_persist_us(self, artifact_kb: float) -> float:
+        """Predicted persist wall (µs) for snapshotting an epoch whose
+        frozen artifact is ``artifact_kb`` KiB — what the
+        ``durable.persist`` decision records as ``est_us["persist"]``
+        and the outcome join scores against the measured wall."""
+        c = self.coeffs
+        return round(
+            c["persist_overhead_us"]
+            + max(0.0, float(artifact_kb)) * c["persist_kb_us"],
+            3,
+        )
+
+    def exposure_cost_us(self, epochs_behind: int) -> float:
+        """The skip side: published-but-unpersisted lineage priced at the
+        declared durability exchange rate. Scales with the unpersisted
+        suffix depth — a crash loses exactly those epochs' warm state."""
+        c = self.coeffs
+        return round(
+            max(0, int(epochs_behind)) * c["durability_us_per_epoch"], 3
+        )
+
     def staleness_cost_us(self, staleness_s: float, depth: int = 1) -> float:
         """The accumulate side: pending staleness priced at the declared
         exchange rate, scaled by the number of waiting batches (more
@@ -118,10 +158,19 @@ class EpochFlipModel:
             from ..observe import outcomes as _outcomes
 
             samples = _outcomes.tail()
-        ratios: List[float] = []
+        # two independent ratio pools: flip walls and persist walls are
+        # different hardware (CPU drain/stream vs disk write + fsync)
+        pools: Dict[str, List[float]] = {"flip": [], "persist": []}
         rejected = 0
         for s in samples:
-            if s.get("site") != "epoch.flip" or s.get("engine") != "flip":
+            if s.get("site") == "epoch.flip" and s.get("engine") == "flip":
+                pool = pools["flip"]
+            elif (
+                s.get("site") == "durable.persist"
+                and s.get("engine") == "persist"
+            ):
+                pool = pools["persist"]
+            else:
                 continue
             predicted = s.get("predicted_us")
             measured_s = s.get("measured_s")
@@ -141,14 +190,20 @@ class EpochFlipModel:
             if not (2.0 ** -20 <= r <= 2.0 ** 20):
                 rejected += 1  # corrupt telemetry, not bias
                 continue
-            ratios.append(r)
+            pool.append(r)
         moved: Dict[str, dict] = {}
         with self._lock:
             coeffs = dict(self.coeffs)
-            if len(ratios) >= min_samples:
+            for pool_name, keys in (
+                ("flip", REFIT_KEYS),
+                ("persist", PERSIST_REFIT_KEYS),
+            ):
+                ratios = pools[pool_name]
+                if len(ratios) < min_samples:
+                    continue
                 step = math.exp(sum(math.log(r) for r in ratios) / len(ratios))
                 step = min(MAX_STEP, max(1.0 / MAX_STEP, step))
-                for key in REFIT_KEYS:
+                for key in keys:
                     default = DEFAULT_COEFFS[key]
                     new = coeffs[key] * step
                     new = min(default * MAX_SCALE, max(default / MAX_SCALE, new))
@@ -173,16 +228,25 @@ class EpochFlipModel:
         naturally re-bases as new flips join."""
         from ..observe import outcomes as _outcomes
 
-        logs: List[float] = []
+        logs: Dict[str, List[float]] = {"flip": [], "persist": []}
         for s in _outcomes.tail():
-            if s.get("site") != "epoch.flip" or s.get("engine") != "flip":
+            if s.get("site") == "epoch.flip" and s.get("engine") == "flip":
+                pool = logs["flip"]
+            elif (
+                s.get("site") == "durable.persist"
+                and s.get("engine") == "persist"
+            ):
+                pool = logs["persist"]
+            else:
                 continue
             err = s.get("error_ratio")  # predicted / measured
             if err and err > 0:
-                logs.append(math.log(1.0 / err))
-        if not logs:
-            return {}
-        return {"flip": round(math.exp(sum(logs) / len(logs)), 4)}
+                pool.append(math.log(1.0 / err))
+        return {
+            engine: round(math.exp(sum(pool) / len(pool)), 4)
+            for engine, pool in logs.items()
+            if pool
+        }
 
     # -- one persistence lifecycle (cost facade protocol) --------------------
 
@@ -226,6 +290,8 @@ class EpochFlipModel:
                 "coeffs": dict(self.coeffs),
                 "engines": list(ENGINES),
                 "refit_keys": list(REFIT_KEYS),
+                "persist_engines": list(PERSIST_ENGINES),
+                "persist_refit_keys": list(PERSIST_REFIT_KEYS),
             }
 
 

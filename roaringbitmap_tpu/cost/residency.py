@@ -18,6 +18,15 @@ partitions); today it already powers the drift view — a cache whose
 re-pack costs are drifting up is one whose budget no longer fits the
 traffic, and the sentinel surfaces that through the same facade as every
 other authority.
+
+ISSUE 17 adds the fourth residency rung: **mapped-but-not-resident**.
+When a durable epoch artifact is on disk, an evicted working set can be
+re-admitted from the mmap (zero-copy deserialize + pack) instead of a
+cold host repack — a cheaper return path the eviction policy prices via
+``readmit_estimate``. The per-kind ``readmit_s`` EWMA learns from joined
+``durable.readmit`` samples (recovery.py records a readmit decision per
+working set and joins its measured wall), exactly parallel to the
+evict-regret ``repack_s`` curve it competes against.
 """
 
 from __future__ import annotations
@@ -38,6 +47,7 @@ class ResidencyModel:
     def __init__(self):
         self._lock = threading.Lock()
         self.repack_s: Dict[str, float] = {}  # guarded-by: self._lock
+        self.readmit_s: Dict[str, float] = {}  # guarded-by: self._lock
         self.samples: Dict[str, int] = {}  # guarded-by: self._lock
         self.provenance = "static"  # guarded-by: self._lock
         self.backend: Optional[str] = None  # guarded-by: self._lock
@@ -53,12 +63,16 @@ class ResidencyModel:
 
         with self._lock:
             repack = {k: round(v, 6) for k, v in sorted(self.repack_s.items())}
+            readmit = {
+                k: round(v, 6) for k, v in sorted(self.readmit_s.items())
+            }
         view = {
             # the ship coefficient is SHARED with the columnar calibration
             # (one curve, two consumers — the unification ROADMAP item 4
             # asked for), not a second measurement that could disagree
             "ship_us_per_row": _costmodel.MODEL.ship_us_per_row,
             "repack_s": repack,
+            "readmit_s": readmit,
         }
         try:
             from ..parallel import store as _store
@@ -76,6 +90,14 @@ class ResidencyModel:
         authority's pricing exactly like the other three (ISSUE 12)."""
         with self._lock:
             return self.repack_s.get(kind)
+
+    def readmit_estimate(self, kind: str) -> Optional[float]:
+        """The learned mmap re-admit cost (seconds) for one cache kind —
+        the cheaper return path a mapped-rung demotion prices against
+        the cold ``repack_estimate`` (None until ``durable.readmit``
+        traffic has taught the curve)."""
+        with self._lock:
+            return self.readmit_s.get(kind)
 
     def drift(self) -> Dict[str, float]:
         """Latest-sample vs EWMA ratio per kind — a kind whose newest
@@ -105,10 +127,15 @@ class ResidencyModel:
         moved: Dict[str, dict] = {}
         rejected = 0
         by_kind: Dict[str, List[float]] = {}
+        readmit_by_kind: Dict[str, List[float]] = {}
         with self._lock:
             seen = self._seen_seq
         max_seq = seen
-        for e in _evict_samples(samples):
+        for e in _ledger_samples(samples):
+            if e.get("site") == "durable.readmit":
+                pool = readmit_by_kind
+            else:
+                pool = by_kind
             seq = e.get("seq")
             if seq is not None:
                 if seq <= seen:
@@ -123,32 +150,41 @@ class ResidencyModel:
             if not math.isfinite(s) or s <= 0:
                 rejected += 1
                 continue
-            by_kind.setdefault(str(kind), []).append(s)
+            pool.setdefault(str(kind), []).append(s)
         with self._lock:
             self._seen_seq = max(self._seen_seq, max_seq)
-            for kind, ss in sorted(by_kind.items()):
-                if len(ss) < min_samples:
-                    continue
-                old = self.repack_s.get(kind)
-                cur = old
-                for s in ss:
-                    if cur is None or cur <= 0:
-                        cur = s
-                    else:
-                        cur = math.exp(
-                            (1 - _ALPHA) * math.log(cur) + _ALPHA * math.log(s)
-                        )
-                cur = round(cur, 9)
-                self.samples[kind] = self.samples.get(kind, 0) + len(ss)
-                if cur != old:
-                    self.repack_s[kind] = cur
-                    moved[kind] = {"from": old, "to": cur, "samples": len(ss)}
+            for curve, pool, label in (
+                (self.repack_s, by_kind, ""),
+                (self.readmit_s, readmit_by_kind, "readmit:"),
+            ):
+                for kind, ss in sorted(pool.items()):
+                    if len(ss) < min_samples:
+                        continue
+                    old = curve.get(kind)
+                    cur = old
+                    for s in ss:
+                        if cur is None or cur <= 0:
+                            cur = s
+                        else:
+                            cur = math.exp(
+                                (1 - _ALPHA) * math.log(cur)
+                                + _ALPHA * math.log(s)
+                            )
+                    cur = round(cur, 9)
+                    key = label + kind
+                    self.samples[key] = self.samples.get(key, 0) + len(ss)
+                    if cur != old:
+                        curve[kind] = cur
+                        moved[key] = {
+                            "from": old, "to": cur, "samples": len(ss)
+                        }
             if moved:
                 self.provenance = "refit-from-traffic"
                 self.backend = _current_backend()
             prov = self.provenance
         return {"moved": moved, "rejected": rejected, "provenance": prov,
-                "samples": sum(len(s) for s in by_kind.values())}
+                "samples": sum(len(s) for s in by_kind.values())
+                + sum(len(s) for s in readmit_by_kind.values())}
 
     def to_dict(self) -> dict:
         with self._lock:
@@ -156,6 +192,7 @@ class ResidencyModel:
                 "schema": SCHEMA,
                 "backend": self.backend,
                 "repack_s": {k: v for k, v in sorted(self.repack_s.items())},
+                "readmit_s": {k: v for k, v in sorted(self.readmit_s.items())},
                 "samples": dict(self.samples),
                 "provenance": self.provenance,
             }
@@ -180,8 +217,20 @@ class ResidencyModel:
             if not (math.isfinite(v) and v > 0):
                 return False
             clean[str(kind)] = v
+        # readmit_s is absent from pre-ISSUE-17 persisted states — an
+        # empty curve, not a schema break
+        clean_readmit: Dict[str, float] = {}
+        for kind, v in (d.get("readmit_s") or {}).items():
+            try:
+                v = float(v)
+            except (TypeError, ValueError):
+                return False
+            if not (math.isfinite(v) and v > 0):
+                return False
+            clean_readmit[str(kind)] = v
         with self._lock:
             self.repack_s = clean
+            self.readmit_s = clean_readmit
             self.samples = {
                 str(k): int(v) for k, v in (d.get("samples") or {}).items()
             }
@@ -192,6 +241,7 @@ class ResidencyModel:
     def reset(self) -> None:
         with self._lock:
             self.repack_s = {}
+            self.readmit_s = {}
             self.samples = {}
             self.provenance = "static"
             self.backend = None
@@ -213,6 +263,18 @@ def _evict_samples(samples: Optional[List[dict]] = None) -> List[dict]:
     from ..observe import outcomes as _outcomes
 
     return [e for e in _outcomes.tail() if e.get("site") == "pack_cache.evict"]
+
+
+def _ledger_samples(samples: Optional[List[dict]] = None) -> List[dict]:
+    """Both curves' joined samples: evict-regret AND mmap re-admits."""
+    if samples is not None:
+        return list(samples)
+    from ..observe import outcomes as _outcomes
+
+    return [
+        e for e in _outcomes.tail()
+        if e.get("site") in ("pack_cache.evict", "durable.readmit")
+    ]
 
 
 MODEL = ResidencyModel()

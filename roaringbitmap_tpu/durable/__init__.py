@@ -1,0 +1,36 @@
+"""Durable epochs (ISSUE 17): the on-disk half of the epoch store.
+
+* :mod:`.format` — the frozen mmap corpus artifact (zero-copy portable
+  payloads + key directory; serves reads with no parse step).
+* :mod:`.store` — atomic priced persistence of published epochs
+  (tmp-dir + fsync + rename, manifest-last with sha256; fault site
+  ``durable.persist``; the ``durable.persist`` decision under the epoch
+  cost authority).
+* :mod:`.recovery` — crash recovery and warm restart: newest complete
+  manifest wins, torn artifacts are skipped and surfaced, PACK_CACHE
+  working sets re-admit lazily from the map.
+"""
+
+from .format import MappedCorpus, write_corpus
+from .recovery import Recovery, recover
+from .store import (
+    DEFAULT_KEEP,
+    PERSIST_OUTCOMES,
+    PERSIST_STAGES,
+    SCHEMA,
+    DurableStore,
+    current_store,
+)
+
+__all__ = [
+    "DEFAULT_KEEP",
+    "DurableStore",
+    "MappedCorpus",
+    "PERSIST_OUTCOMES",
+    "PERSIST_STAGES",
+    "Recovery",
+    "SCHEMA",
+    "current_store",
+    "recover",
+    "write_corpus",
+]

@@ -892,6 +892,177 @@ def verify_compaction_invariance(
             smaintain.reset()
 
 
+def _durable_plan(plan_seed: int):
+    """The seeded deterministic workload family 31 replays on BOTH sides
+    of the process boundary: the parent derives the oracle from the same
+    plan the (killed) child executed, so nothing needs to survive the
+    crash except the durable artifacts under test."""
+    rng = np.random.default_rng(plan_seed)
+    bms = [random_bitmap(rng) for _ in range(int(rng.integers(3, 6)))]
+    muts = [
+        {
+            int(rng.integers(0, len(bms))): rng.integers(
+                0, 1 << 18, size=int(rng.integers(1, 16))
+            )
+        }
+        for _ in range(int(rng.integers(2, 5)))
+    ]
+    return bms, muts
+
+
+def _durable_child(root: str, plan_seed: int, kill_hit: int) -> None:
+    """Family 31's subprocess body: replay the seeded plan (one submit +
+    one flip per batch, every flip force-persisted) and die WITHOUT
+    UNWINDING at the ``kill_hit``-th ``durable.persist`` crash point — a
+    simulated power cut at exactly that persist stage (``os._exit`` from
+    the injected exception's constructor, so no ``finally`` blocks, no
+    fsyncs, no atexit handlers run). ``kill_hit=0`` runs to completion.
+    Prints ``PERSISTED <epoch>`` after each completed persist; the
+    parent's recovery floor."""
+    import contextlib
+    import os as _os
+
+    from .durable import DurableStore
+    from .robust import faults as rfaults
+    from .serve import slo as sslo
+    from .serve.epochs import EpochStore
+
+    class _PowerCut(BaseException):
+        def __init__(self, *args):
+            _os._exit(137)
+
+    bms, muts = _durable_plan(plan_seed)
+    sslo.TENANTS.declare("fz-durable", quota_qps=1e6, burst=1e6)
+    es = EpochStore(bms)
+    ds = DurableStore(root)
+    ctx = (
+        rfaults.inject("durable.persist", _PowerCut, after=kill_hit - 1)
+        if kill_hit
+        else contextlib.nullcontext()
+    )
+    with ctx:
+        for m in muts:
+            es.submit("fz-durable", m)
+            es.flip(reason="fuzz-durable")
+            # persist() directly (not the priced maybe_persist) so the
+            # crash-point schedule is deterministic: exactly 5 hits per
+            # flip, and the chosen kill_hit lands in a known stage
+            ds.persist(es, reason="fuzz-durable")
+            print(f"PERSISTED {es.current()}", flush=True)
+
+
+def verify_durable_crash_invariance(
+    name: str,
+    iterations: Optional[int] = None,
+    seed: Optional[int] = None,
+) -> None:
+    """Fuzz family 31 (ISSUE 17): a process killed at ANY persist crash
+    point must recover bit-exactly to the last PUBLISHED epoch — never a
+    torn one, never an older one than a persist that completed. Each
+    iteration spawns a subprocess replaying a seeded plan
+    (:func:`_durable_plan`) whose persist is killed without unwinding at
+    a random ``durable.persist`` hit (``os._exit`` mid-stage; hit 0 is
+    the clean control run). The parent then recovers from the child's
+    root and checks, against the family-29-style deterministic replay
+    oracle (epoch *k* = seed corpus + the first *k* mutation batches):
+
+    * recovery epoch >= every epoch the child logged as persisted
+      (durability floor: a completed persist survives the crash), and
+      <= the plan's flip count (no invented epochs);
+    * the recovered mapped corpus is bit-exact with the oracle replay at
+      the recovered epoch (zero torn artifacts served);
+    * a clean child (kill_hit 0) exits 0 and recovers its final epoch."""
+    import shutil
+    import subprocess
+    import sys as _sys
+    import tempfile
+
+    from .durable import recover as _drecover
+    from .serve import ingest as singest
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    child_code = (
+        "import sys; from roaringbitmap_tpu.fuzz import _durable_child; "
+        "_durable_child(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))"
+    )
+    rng = np.random.default_rng(seed)
+    for it in range(iterations or default_iterations()):
+        plan_seed = int(rng.integers(0, 1 << 16))
+        bms, muts = _durable_plan(plan_seed)
+        n_flips = len(muts)
+        # 5 crash points per persist call x one persist per flip; 0 = the
+        # clean control run (child must then exit 0 with the final epoch)
+        kill_hit = int(rng.integers(0, 5 * n_flips + 1))
+        root = tempfile.mkdtemp(prefix="fz_durable_")
+        try:
+            env = dict(os.environ)
+            env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+            env.setdefault("JAX_PLATFORMS", "cpu")
+            proc = subprocess.run(
+                [_sys.executable, "-c", child_code,
+                 root, str(plan_seed), str(kill_hit)],
+                capture_output=True, text=True, env=env, timeout=300,
+            )
+            logged = [
+                int(line.split()[1])
+                for line in proc.stdout.splitlines()
+                if line.startswith("PERSISTED ")
+            ]
+            if kill_hit == 0 and proc.returncode != 0:
+                raise InvarianceFailure(
+                    name, bms,
+                    detail=f"clean child (seed={plan_seed}) exited "
+                    f"{proc.returncode}: {proc.stderr[-500:]}",
+                )
+            last_logged = max(logged) if logged else 0
+            rec = _drecover(root)
+            if rec is None:
+                if last_logged:
+                    raise InvarianceFailure(
+                        name, bms,
+                        detail=f"DURABILITY LOST: child persisted epoch "
+                        f"{last_logged} (seed={plan_seed}, "
+                        f"kill_hit={kill_hit}) but recovery found nothing",
+                    )
+                continue  # killed before the first publish: legal
+            if not last_logged <= rec.epoch <= n_flips:
+                raise InvarianceFailure(
+                    name, bms,
+                    detail=f"recovered epoch {rec.epoch} outside "
+                    f"[{last_logged}, {n_flips}] (seed={plan_seed}, "
+                    f"kill_hit={kill_hit})",
+                )
+            oracle = [b.clone() for b in bms]
+            singest.apply_batches(
+                oracle,
+                [singest.MutationBatch("fz-durable", m)
+                 for m in muts[: rec.epoch]],
+            )
+            got = rec.corpus.bitmaps()
+            torn = len(got) != len(oracle) or any(
+                g.to_mutable() != w for g, w in zip(got, oracle)
+            )
+            # release the zero-copy views before closing the map (close
+            # fails loudly while exported buffers are alive — by design)
+            del got
+            if torn:
+                raise InvarianceFailure(
+                    name, bms,
+                    detail=f"TORN EPOCH: recovered corpus at epoch "
+                    f"{rec.epoch} is not bit-exact with the replay oracle "
+                    f"(seed={plan_seed}, kill_hit={kill_hit})",
+                )
+            if kill_hit == 0 and rec.epoch != n_flips:
+                raise InvarianceFailure(
+                    name, bms,
+                    detail=f"clean run recovered epoch {rec.epoch}, "
+                    f"wanted the final epoch {n_flips} (seed={plan_seed})",
+                )
+            rec.corpus.close()
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+
+
 def random_expression(rng, leaves: List[RoaringBitmap], max_depth: int = 4):
     """Random query DAG over the given leaf bitmaps: every node kind
     (and/or/xor/n-ary andnot/not-over-explicit-universe/threshold), biased
@@ -1303,6 +1474,18 @@ def run_campaign(iterations: Optional[int] = None, verbose: bool = True) -> dict
             seed=60,
         ),
         actual=max(1, n // 8),
+    )
+    # ISSUE 17: a subprocess killed WITHOUT UNWINDING at a random
+    # durable.persist crash point must recover bit-exactly to the last
+    # published epoch vs the deterministic replay oracle (derated hard:
+    # every iteration pays a full interpreter spawn + import)
+    _run(
+        "crash-at-any-flip-stage-vs-recovery-oracle",
+        lambda: verify_durable_crash_invariance(
+            "crash-at-any-flip-stage-vs-recovery-oracle",
+            iterations=max(1, n // 64), seed=61,
+        ),
+        actual=max(1, n // 64),
     )
     return results
 

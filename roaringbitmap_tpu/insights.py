@@ -354,6 +354,26 @@ def structure() -> dict:
     return block
 
 
+def durable() -> dict:
+    """Durable-epoch rollup (ISSUE 17): persisted vs serving epoch,
+    artifact bytes, persist/recovery/demotion volume — all
+    registry-derived — plus the live :class:`durable.DurableStore`'s
+    stats and the last recovery's provenance (which directory won, how
+    many torn artifacts were skipped). Process-local detail rides here
+    and in flight bundles, never the registry. The rb_top durable panel
+    renders exactly this."""
+    from . import observe
+    from .durable import recovery as _recovery
+    from .durable import store as _dstore
+    from .observe import export as _export
+
+    block = _export._durable_block(observe.REGISTRY.snapshot())
+    live = _dstore.current_store()
+    block["store_live"] = live.stats() if live is not None else None
+    block["recovery_last"] = _recovery.LAST
+    return block
+
+
 def cost_authorities() -> dict:
     """The unified cost facade's view (ISSUE 12): every pricing
     authority's curves, provenance, and live drift — ROADMAP item 4's
@@ -398,6 +418,10 @@ def observatory() -> dict:
         # maintenance-pass state, so a red episode's bundle carries the
         # corpus shape that triggered the structure-drift rule
         "structure": structure(),
+        # durable epochs (ISSUE 17): persisted vs serving epoch, artifact
+        # bytes, recovery provenance — so a red episode's bundle carries
+        # which frozen snapshot (if any) a restart would recover to
+        "durable": durable(),
     }
 
 

@@ -110,8 +110,14 @@ class MutableRoaringBitmap(RoaringBitmap):
         return ImmutableView(self)
 
     @staticmethod
-    def deserialize(data) -> "MutableRoaringBitmap":
-        return MutableRoaringBitmap._adopt(RoaringBitmap.deserialize(data))
+    def deserialize(data, copy: bool = True) -> "MutableRoaringBitmap":
+        """``copy=False`` builds zero-copy container views over ``data``
+        (serialization.read_into's frozen-consumer contract): sound only
+        when the result will not be mutated — a mutable twin built over a
+        read-only mmap raises on the first in-place word patch."""
+        return MutableRoaringBitmap._adopt(
+            RoaringBitmap.deserialize(data, copy=copy)
+        )
 
     # -- mixed-operand pairwise algebra (ImmutableRoaringBitmap statics) ---
     @staticmethod

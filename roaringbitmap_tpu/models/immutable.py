@@ -159,7 +159,12 @@ class ImmutableRoaringBitmap:
 
     def __init__(self, source: Source, offset: int = 0):
         if isinstance(source, np.ndarray):
-            source = source.tobytes()
+            # contiguous arrays map zero-copy (ISSUE 17: tobytes() copied
+            # the whole buffer, defeating the mapped design for ndarray
+            # sources — e.g. a durable artifact's frombuffer slice)
+            source = (
+                source.data if source.flags["C_CONTIGUOUS"] else source.tobytes()
+            )
         buf = memoryview(source).cast("B")[offset:]
         self._buf = buf
         pos = 0

@@ -1254,10 +1254,10 @@ class RoaringBitmap:
         return len(data)
 
     @staticmethod
-    def deserialize(data) -> "RoaringBitmap":
+    def deserialize(data, copy: bool = True) -> "RoaringBitmap":
         from ..serialization import deserialize
 
-        return deserialize(data)
+        return deserialize(data, copy=copy)
 
     @classmethod
     def deserialize_from(cls, stream) -> "RoaringBitmap":

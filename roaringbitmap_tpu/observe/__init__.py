@@ -32,6 +32,15 @@ from .registry import (
     DECISION_TOTAL,
     DEFAULT_TIME_BUCKETS,
     DEGRADE_TOTAL,
+    DURABLE_ARTIFACT_BYTES,
+    DURABLE_DEMOTE_TOTAL,
+    DURABLE_EPOCH_COUNT,
+    DURABLE_PENDING_COUNT,
+    DURABLE_PERSIST_BYTES_TOTAL,
+    DURABLE_PERSIST_STAGE_SECONDS,
+    DURABLE_PERSIST_TOTAL,
+    DURABLE_PERSIST_WALL_SECONDS,
+    DURABLE_RECOVERY_TOTAL,
     FAULT_INJECTED_TOTAL,
     FUSION_BATCH_SECONDS,
     FUSION_BATCH_TOTAL,
@@ -213,6 +222,15 @@ __all__ = [
     "QUERY_LATENCY_SECONDS",
     "COLUMNAR_CLASS_SECONDS",
     "DEGRADE_TOTAL",
+    "DURABLE_ARTIFACT_BYTES",
+    "DURABLE_DEMOTE_TOTAL",
+    "DURABLE_EPOCH_COUNT",
+    "DURABLE_PENDING_COUNT",
+    "DURABLE_PERSIST_BYTES_TOTAL",
+    "DURABLE_PERSIST_STAGE_SECONDS",
+    "DURABLE_PERSIST_TOTAL",
+    "DURABLE_PERSIST_WALL_SECONDS",
+    "DURABLE_RECOVERY_TOTAL",
     "BREAKER_TRANSITIONS_TOTAL",
     "RETRY_TOTAL",
     "FAULT_INJECTED_TOTAL",

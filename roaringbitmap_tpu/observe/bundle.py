@@ -139,6 +139,11 @@ def _collect_sections(health_dump: Optional[dict]) -> Dict[str, str]:
             # stale the log is, and the lineage that led here — the
             # freshness-lag-breach / epoch-flip-stall episodes' context
             "epochs": _insights.epochs(),
+            # durable panel (ISSUE 17): which frozen epoch (if any) a
+            # restart would recover to, plus torn-skip provenance — the
+            # epoch-persist-stall / recovery-manifest-torn episodes'
+            # context
+            "durable": _insights.durable(),
         }
 
     sections["observatory.json"] = _json_or_error(_observatory)

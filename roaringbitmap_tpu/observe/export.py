@@ -385,6 +385,46 @@ def _structure_block(snap: dict) -> dict:
     }
 
 
+def _durable_block(snap: dict) -> dict:
+    """The durable-epoch sidecar block (ISSUE 17), derived PURELY from
+    the registry like every block here: the last persisted vs serving
+    epoch, the frozen artifact's bytes, persist volume by outcome +
+    cumulative bytes, the persist stage latency decomposition, pending
+    (unpersisted) epoch depth, last persist wall seconds, recovery
+    outcome volume, and residency demotions by rung. Recovery
+    PROVENANCE (which directory, torn-skip list) is process-local and
+    rides ``insights.durable()`` / flight bundles, never the registry —
+    paths are unbounded label values and must not mint series."""
+    def _gauge(name):
+        m = snap.get(name)
+        if m is not None:
+            for s in m["samples"]:
+                if not s["labels"]:
+                    return s["value"]
+        return None
+    stages: dict = {}
+    m = snap.get(_registry.DURABLE_PERSIST_STAGE_SECONDS)
+    if m is not None:
+        for s in m["samples"]:
+            if s["labels"]:
+                stages[s["labels"]["stage"]] = {
+                    "count": s["count"],
+                    "sum": round(s["sum"], 6),
+                }
+    return {
+        "epoch": _gauge(_registry.DURABLE_EPOCH_COUNT),
+        "serving_epoch": _gauge(_registry.SERVE_EPOCH_COUNT),
+        "pending_epochs": _gauge(_registry.DURABLE_PENDING_COUNT),
+        "artifact_bytes": _gauge(_registry.DURABLE_ARTIFACT_BYTES),
+        "persist_wall_s": _gauge(_registry.DURABLE_PERSIST_WALL_SECONDS),
+        "persists": _counter_map(snap, _registry.DURABLE_PERSIST_TOTAL),
+        "persist_bytes": _gauge(_registry.DURABLE_PERSIST_BYTES_TOTAL),
+        "persist_stages": stages,
+        "recoveries": _counter_map(snap, _registry.DURABLE_RECOVERY_TOTAL),
+        "demotions": _counter_map(snap, _registry.DURABLE_DEMOTE_TOTAL),
+    }
+
+
 def _health_block(snap: dict) -> dict:
     """The health sentinel's sidecar block (ISSUE 12), derived PURELY
     from the registry gauges (like the regret block) so a ``--from``
@@ -453,6 +493,9 @@ def sidecar_snapshot(registry: Optional[Registry] = None) -> dict:
         # bytes-vs-optimal drift, fragmentation/accretion gauges, and
         # the maintenance tier's pass volume + reclaimed bytes
         "structure": _structure_block(snap),
+        # durable epochs (ISSUE 17): persisted vs serving epoch, artifact
+        # bytes, persist outcome/stage volume, recovery + demotion volume
+        "durable": _durable_block(snap),
         "registry": snap,
     }
 

@@ -49,6 +49,8 @@ freshness-lag-breach   worst windowed ingest->queryable p99 s   2.0   10.0
 epoch-flip-stall       mutation-log depth with no epoch flip    4     64
 structure-drift        actual/optimal serialized-bytes ratio    1.3   2.0
 delta-accretion        epoch-delta batches since maintenance    8     64
+epoch-persist-stall    persist backlog with no completed persist 4    64
+recovery-manifest-torn torn artifacts skipped by recovery       0.5   1
 ====================== ======================================== ===== =====
 
 Actuations (the sentinel's closed-loop half — see ``observe.sentinel``):
@@ -462,6 +464,37 @@ def _epoch_flip_stall(s: Snapshot) -> float:
     return depth if drained == 0 else 0.0
 
 
+def _epoch_persist_stall(s: Snapshot) -> float:
+    """Published epochs pending durability while NO persist completed
+    since the last tick (ISSUE 17 — the durability twin of
+    epoch-flip-stall): badness is the persist-backlog gauge, judged
+    against the persist counter's per-tick movement. A backlog the
+    priced skip verdict is deliberately carrying is healthy patience; a
+    growing backlog with a wedged (or perpetually aborting) persist
+    loop is warm state a crash will erase."""
+    depth = s.gauge_max_abs(_registry.DURABLE_PENDING_COUNT)
+    if depth <= 0:
+        return 0.0
+    persists = s.labeled_counter_delta(_registry.DURABLE_PERSIST_TOTAL)
+    completed = sum(
+        d for (outcome,), d in persists.items() if outcome == "persisted"
+    )
+    return depth if completed == 0 else 0.0
+
+
+def _recovery_manifest_torn(s: Snapshot) -> float:
+    """Torn durable artifacts skipped by recovery since the last tick
+    (ISSUE 17): a torn manifest means a crash landed mid-persist on a
+    non-atomic filesystem — or worse, bit rot — and the restart silently
+    fell back to an OLDER epoch. Any occurrence goes straight to red
+    (one tick, critical), and the critical transition's flight bundle
+    carries the durable panel with the recovery provenance."""
+    torn = s.labeled_counter_delta(_registry.DURABLE_RECOVERY_TOTAL)
+    return float(sum(
+        d for (outcome,), d in torn.items() if outcome == "torn"
+    ))
+
+
 def _fusion_queue_stall(s: Snapshot) -> float:
     """Queries parked in the fusion window queue while NO batch drained
     since the last tick (ISSUE 13 — the ~5-line serving-shaped rule the
@@ -595,5 +628,28 @@ DEFAULT_RULES: Tuple[Rule, ...] = (
         lambda s: s.gauge_max_abs(_registry.STRUCTURE_ACCRETION_COUNT),
         warn=8.0, critical=64.0, fire_after=2, clear_after=2,
         actuation="maintain",
+    ),
+    # the two durable-epoch rules (ISSUE 17): crash exposure and
+    # recovery integrity join the judged signals; appended so every
+    # earlier rule keeps its table position
+    Rule(
+        "epoch-persist-stall",
+        "published epochs pending durability while no persist completed "
+        "since the last tick (wedged or perpetually aborting persist "
+        "loop — warm state a crash will erase; a priced skip backlog "
+        "that is still draining is healthy patience)",
+        _epoch_persist_stall,
+        warn=4.0, critical=64.0, fire_after=2, clear_after=2,
+        actuation="alert",
+    ),
+    Rule(
+        "recovery-manifest-torn",
+        "torn durable artifacts skipped during recovery since the last "
+        "tick (restart silently fell back to an older epoch) — any "
+        "occurrence is red, and the flight bundle carries the durable "
+        "panel's recovery provenance",
+        _recovery_manifest_torn,
+        warn=0.5, critical=1.0, fire_after=1, clear_after=1,
+        actuation="alert",
     ),
 )

@@ -163,6 +163,29 @@ SERVE_MAINTAIN_TOTAL = "rb_tpu_serve_maintain_total"
 SERVE_MAINTAIN_SECONDS = "rb_tpu_serve_maintain_seconds"
 SERVE_MAINTAIN_RECLAIMED_BYTES_TOTAL = "rb_tpu_serve_maintain_reclaimed_bytes_total"
 SERVE_MAINTAIN_KEYS_TOTAL = "rb_tpu_serve_maintain_keys_total"
+# durable epochs (ISSUE 17): the on-disk half of the epoch store
+# (durable/). Persist volume by outcome (persisted | skipped = priced
+# skip verdict | aborted = fault, epoch stays memory-only), the persist
+# stage latency decomposition (the declared durable/store.py
+# PERSIST_STAGES set), persisted-artifact bytes written, the newest
+# persisted epoch id and artifact size as gauge VALUES (epoch ids are
+# unbounded and never label values — the epoch-ledger discipline), the
+# persist backlog gauge (published epochs not yet durable — the
+# epoch-persist-stall rule's signal), the last completed persist wall,
+# recovery volume by outcome (recovered | torn = manifest failed
+# verification and was skipped | empty = no complete artifact), and
+# eviction demotions by residency rung (mapped = the working set stays
+# re-admittable from the persisted map | discard = cold repack on
+# return)
+DURABLE_PERSIST_TOTAL = "rb_tpu_durable_persist_total"
+DURABLE_PERSIST_STAGE_SECONDS = "rb_tpu_durable_persist_stage_seconds"
+DURABLE_PERSIST_BYTES_TOTAL = "rb_tpu_durable_persist_bytes_total"
+DURABLE_EPOCH_COUNT = "rb_tpu_durable_epoch_count"
+DURABLE_ARTIFACT_BYTES = "rb_tpu_durable_artifact_bytes"
+DURABLE_PENDING_COUNT = "rb_tpu_durable_pending_count"
+DURABLE_PERSIST_WALL_SECONDS = "rb_tpu_durable_persist_wall_seconds"
+DURABLE_RECOVERY_TOTAL = "rb_tpu_durable_recovery_total"
+DURABLE_DEMOTE_TOTAL = "rb_tpu_durable_demote_total"
 
 # upper bucket bounds (seconds) for wall-time histograms: host phases span
 # ~100 µs packing steps to multi-second CPU folds; +Inf is implicit

@@ -116,6 +116,13 @@ _PACK_EVICTED_BYTES = _observe.counter(
     "Bytes released by byte-budget LRU eviction",
     ("kind",),
 )
+_DEMOTE_TOTAL = _observe.counter(
+    _observe.DURABLE_DEMOTE_TOTAL,
+    "Evictions by residency rung (mapped = working set stays "
+    "re-admittable from the persisted epoch mmap | discard = cold "
+    "repack on return — the pre-durable behavior)",
+    ("rung",),
+)
 _PACK_RESIDENT = _observe.gauge(
     _observe.PACK_CACHE_RESIDENT_BYTES,
     "Bytes currently resident in the pack cache by entry kind",
@@ -1550,6 +1557,38 @@ def _repack_estimate_s(kind: str):
         return None
 
 
+def _readmit_estimate_s(kind: str):
+    """The residency authority's learned mmap re-admit cost for ``kind``
+    (ISSUE 17, the mapped rung) — the cheaper return path a demotion
+    prices against the cold repack. Same never-fail contract as
+    :func:`_repack_estimate_s`."""
+    try:
+        from ..cost import residency as _residency
+
+        return _residency.MODEL.readmit_estimate(kind)
+    except Exception:  # rb-ok: exception-hygiene -- the eviction itself must proceed unpriced rather than fail on a diagnostics import/path error
+        return None
+
+
+# ISSUE 17: the durable store's demotion probe. Installed once a
+# persisted epoch artifact exists; it answers whether an evicted entry
+# of ``kind`` remains re-admittable from the epoch mmap. With a probe
+# answering True, eviction DEMOTES to the residency ladder's fourth
+# rung (mapped-but-not-resident: device bytes freed, payload still one
+# zero-copy readmit away) instead of discarding outright. None = no
+# durable artifact; every eviction is a discard, the pre-durable
+# behavior.
+_DEMOTE_PROBE = None
+
+
+def set_demotion_probe(probe) -> None:
+    """Install (or clear with ``None``) the mapped-rung demotion probe
+    — ``probe(kind) -> bool``. durable/store.py installs it after the
+    first completed persist."""
+    global _DEMOTE_PROBE
+    _DEMOTE_PROBE = probe
+
+
 class PackCache:
     """Process-wide device-resident working-set cache (ISSUE 4 tentpole).
 
@@ -2023,8 +2062,22 @@ class PackCache:
             self._bytes -= e.nbytes  # rb-ok: lock-discipline -- caller holds self._lock
             self.evictions += 1  # rb-ok: lock-discipline -- caller holds self._lock
             _PACK_EVICTED_BYTES.inc(e.nbytes, (e.kind,))
+            # ISSUE 17: with a durable epoch artifact on disk the evicted
+            # bytes demote to the mapped rung (re-admittable from the
+            # mmap at the readmit curve's price) instead of discarding —
+            # the residency ladder's fourth rung
+            probe = _DEMOTE_PROBE
+            mapped = False
+            if probe is not None:
+                try:
+                    mapped = bool(probe(e.kind))
+                except Exception:  # rb-ok: exception-hygiene -- a broken probe must not turn evictions into failures; fall back to the discard rung
+                    mapped = False
+            rung = "mapped" if mapped else "discard"
+            _DEMOTE_TOTAL.inc(1, (rung,))
             _timeline.instant(
-                "pack_cache.evict", "cache", kind=e.kind, bytes=e.nbytes
+                "pack_cache.evict", "cache", kind=e.kind, bytes=e.nbytes,
+                rung=rung,
             )
             # the residency authority's learned re-pack cost prices this
             # eviction (ISSUE 12): the evict-regret join then scores the
@@ -2032,14 +2085,25 @@ class PackCache:
             # the other pricing authorities' verdicts
             est_repack_s = _repack_estimate_s(e.kind)
             evict_inputs = {"kind": e.kind, "bytes": e.nbytes,
-                            "target_bytes": target}
+                            "target_bytes": target, "rung": rung}
             if est_repack_s:
                 evict_inputs["est_us"] = {
                     "repack": round(est_repack_s * 1e6, 1),
                     "rebuild": round(est_repack_s * 1e6, 1),
                 }
+            if mapped:
+                # the demotion's priced return path: the learned mmap
+                # readmit cost (None until durable.readmit traffic
+                # taught the curve)
+                est_readmit_s = _readmit_estimate_s(e.kind)
+                if est_readmit_s:
+                    evict_inputs.setdefault("est_us", {})["readmit"] = round(
+                        est_readmit_s * 1e6, 1
+                    )
             seq = _decisions.record_decision(
-                "pack_cache.evict", "lru", outcome=True, **evict_inputs
+                "pack_cache.evict",
+                "demote-mapped" if mapped else "lru",
+                outcome=True, **evict_inputs,
             )
             ident = ("agg", e.key[1], tuple(_fp_ident(fp) for fp in e.fps)) \
                 if e.kind == "agg" else None

@@ -61,6 +61,7 @@ SITES: Tuple[str, ...] = (
     "native.entry",      # native C tier entry probe (native/__init__.py)
     "pack_cache.budget", # resident pack-cache byte-budget admission
     "serve.maintain",    # background maintenance/compaction pass (serve/maintain.py)
+    "durable.persist",   # atomic epoch snapshot persist (durable/store.py)
 )
 
 _FAULT_TOTAL = _observe.counter(

@@ -29,6 +29,7 @@ arrays straight from the parsed container payloads.
 from __future__ import annotations
 
 import struct
+import sys
 from typing import Union
 
 import numpy as np
@@ -159,11 +160,16 @@ def _need(buf: memoryview, pos: int, n: int) -> None:
         )
 
 
-def deserialize(data: Union[bytes, bytearray, memoryview, np.ndarray]) -> RoaringBitmap:
+def deserialize(
+    data: Union[bytes, bytearray, memoryview, np.ndarray], copy: bool = True
+) -> RoaringBitmap:
     """Parse the portable format (RoaringArray.deserialize,
-    RoaringArray.java:276/361/547), validating untrusted input."""
+    RoaringArray.java:276/361/547), validating untrusted input.
+
+    ``copy=False`` keeps container payloads as zero-copy views into
+    ``data`` (see :func:`read_into`) — the mmap consumers' contract."""
     bm = RoaringBitmap()
-    read_into(bm, data)
+    read_into(bm, data, copy=copy)
     return bm
 
 
@@ -235,10 +241,30 @@ def read_from_stream(bm: RoaringBitmap, stream) -> int:
     return read_into(bm, b"".join(chunks))
 
 
-def read_into(bm: RoaringBitmap, data) -> int:
-    """Fill ``bm`` from serialized bytes; returns bytes consumed."""
+def read_into(bm: RoaringBitmap, data, copy: bool = True) -> int:
+    """Fill ``bm`` from serialized bytes; returns bytes consumed.
+
+    ``copy=False`` (ISSUE 17 satellite) builds the containers as
+    **zero-copy views** into ``data`` — the ``np.frombuffer(...).astype``
+    default path silently copies every payload (astype always
+    materializes), which defeats serving straight off an mmap. The view
+    path accepts read-only buffers (an ``mmap.ACCESS_READ`` map, a bytes
+    object) and produces read-only numpy arrays, so it is an explicit
+    opt-in for FROZEN consumers (``durable.format.MappedCorpus``, the
+    recovery path): mutating a container built this way (e.g.
+    ``BitmapContainer.add`` patches ``words`` in place) raises numpy's
+    read-only error instead of corrupting the backing file. Big-endian
+    hosts fall back to copying — a byte-swapped view would feed the
+    container kernels non-native dtypes."""
+    if copy or sys.byteorder != "little":
+        copy = True
     if isinstance(data, np.ndarray):
-        data = data.tobytes()
+        # tobytes() copies even when the array is already contiguous
+        # bytes; the view path wraps the existing buffer
+        if copy:
+            data = data.tobytes()
+        else:
+            data = data.data if data.flags["C_CONTIGUOUS"] else data.tobytes()
     buf = memoryview(data).cast("B")
     pos = 0
     _need(buf, pos, 4)
@@ -294,9 +320,9 @@ def read_into(bm: RoaringBitmap, data) -> int:
             (n_runs,) = struct.unpack_from("<H", buf, pos)
             pos += 2
             _need(buf, pos, 4 * n_runs)
-            pairs = np.frombuffer(buf, dtype="<u2", count=2 * n_runs, offset=pos).astype(
-                np.uint16
-            )
+            pairs = np.frombuffer(buf, dtype="<u2", count=2 * n_runs, offset=pos)
+            if copy:
+                pairs = pairs.astype(np.uint16)
             pos += 4 * n_runs
             starts, lengths = pairs[0::2], pairs[1::2]
             if n_runs and not _bits.validate_runs_u16(pairs):
@@ -305,9 +331,9 @@ def read_into(bm: RoaringBitmap, data) -> int:
             c: Container = RunContainer(starts, lengths)
         elif card > ARRAY_MAX_SIZE:
             _need(buf, pos, 8192)
-            words = np.frombuffer(buf, dtype="<u8", count=1024, offset=pos).astype(
-                np.uint64
-            )
+            words = np.frombuffer(buf, dtype="<u8", count=1024, offset=pos)
+            if copy:
+                words = words.astype(np.uint64)
             pos += 8192
             actual = _bits.cardinality_of_words(words)
             if actual != card:
@@ -317,9 +343,9 @@ def read_into(bm: RoaringBitmap, data) -> int:
             c = BitmapContainer(words, card)
         else:
             _need(buf, pos, 2 * card)
-            values = np.frombuffer(buf, dtype="<u2", count=card, offset=pos).astype(
-                np.uint16
-            )
+            values = np.frombuffer(buf, dtype="<u2", count=card, offset=pos)
+            if copy:
+                values = values.astype(np.uint16)
             pos += 2 * card
             if card > 1 and not _bits.validate_sorted_u16(values):
                 raise InvalidRoaringFormat("array container values not sorted/unique")

@@ -186,8 +186,36 @@ class EpochStore:
         self._working_sets: List[Tuple[int, ...]] = [  # guarded-by: self._cond
             tuple(range(len(self.corpus)))
         ]
+        # the attached durable store (ISSUE 17): when set, every
+        # published flip runs its priced persist verdict post-publish
+        self._durable = None
         _EPOCH_COUNT.set(0)
         _CURRENT = weakref.ref(self)
+
+    # -- durable attachment (ISSUE 17) ---------------------------------------
+
+    def attach_durable(self, durable) -> None:
+        """Attach a ``durable.DurableStore``: after every published
+        flip, its :meth:`~..durable.store.DurableStore.on_flip` hook
+        refreshes the persist backlog gauge and runs the priced
+        persist-now-vs-skip verdict. Detach with ``None``."""
+        self._durable = durable
+
+    def restore(self, epoch: int, lineage: Sequence[dict]) -> None:
+        """Resume this store at a recovered epoch (durable/recovery.py):
+        the epoch counter jumps to the persisted value and the lineage
+        ledger is rehydrated, so replay oracles and the observatory see
+        an unbroken history across the restart. Only valid before the
+        first flip (a freshly constructed store)."""
+        with self._cond:
+            if self._epoch != 0 or self._lineage:
+                raise ValueError(
+                    "restore() requires a freshly constructed store"
+                )
+            self._epoch = int(epoch)
+            for rec in lineage:
+                self._lineage.append(dict(rec))
+        _EPOCH_COUNT.set(int(epoch))
 
     # -- reader admission ----------------------------------------------------
 
@@ -398,6 +426,15 @@ class EpochStore:
                         self._cond.notify_all()
         record["wall_s"] = round(time.perf_counter() - t_flip, 6)
         _FLIP_TOTAL.inc(1, ("flipped",))
+        durable = self._durable
+        if durable is not None:
+            # post-publish durability hook (ISSUE 17): the persist
+            # verdict is priced and fails CLOSED inside the durable
+            # store (only FATAL propagates), so an aborted persist
+            # leaves this flip's record — and the published epoch —
+            # untouched in memory
+            durable_rec = durable.on_flip(self, record)
+            record["durable"] = durable_rec.get("outcome")
         return record
 
     def _repack_working_sets(self, touched: List[int]) -> dict:

@@ -26,7 +26,7 @@ if [[ "${1:-}" != "--fast" ]]; then
   python -m pytest tests/ -q
 fi
 
-step "fuzz smoke (500 iterations x 30 invariant families)"
+step "fuzz smoke (500 iterations x 31 invariant families)"
 python -m roaringbitmap_tpu.fuzz 500 > /tmp/ci_fuzz.log 2>&1 \
   || { tail -20 /tmp/ci_fuzz.log; exit 1; }
 tail -1 /tmp/ci_fuzz.log
@@ -1156,7 +1156,221 @@ print("structure metric names ok (suffixes + declared label sets; fault site + "
       "eighth authority registered; maintain actuation wired; format clause armed)"
 )'
 
-step "rb_top observatory report (schema rb_tpu_top/7, ISSUE 9 + 11 + 12 + 13 + 14 + 15 + 16)"
+step "durable epochs: restart twin rows, kill-walk recovery, sha256 re-verify (ISSUE 17)"
+# the bench must commit meta.durable: persist walls attributed to the
+# four named stages (>=90%), and the restart twin — warm (recover:
+# manifest discovery + sha256 re-verify + mmap + hot-set readmit off
+# zero-copy views) must beat cold (full deserialize copy=True before
+# the identical hot-set pack) on the SAME artifact, bit-exact
+python -c '
+import json
+m = json.load(open("/tmp/ci_bench.json"))["meta"]
+du = m.get("durable")
+if not isinstance(du, dict):
+    raise SystemExit("bench meta lacks the durable block")
+need = {"corpus_bitmaps", "hot_set_bitmaps", "flips_persisted",
+        "artifact_bytes", "persist_wall_s", "persist_stage_attr_pct",
+        "persist_stages_s", "warm_restart_s", "cold_restart_s",
+        "warm_vs_cold", "bitexact", "recovery", "readmit"}
+missing = need - set(du)
+if missing:
+    raise SystemExit("durable block lacks %s" % sorted(missing))
+if not du["persist_stage_attr_pct"] >= 90.0:
+    raise SystemExit("persist stages attribute only %s%% of the persist wall"
+                     % du["persist_stage_attr_pct"])
+if set(du["persist_stages_s"]) != {"snapshot", "lineage", "manifest",
+                                   "publish"}:
+    raise SystemExit("persist stage set drifted: %r"
+                     % sorted(du["persist_stages_s"]))
+if not du["warm_restart_s"] < du["cold_restart_s"]:
+    raise SystemExit("warm restart %ss did not beat cold deserialize+pack %ss"
+                     % (du["warm_restart_s"], du["cold_restart_s"]))
+if du["bitexact"] is not True:
+    raise SystemExit("restart twin was not bit-exact")
+rec = du["recovery"]
+if rec.get("torn_skipped") != 0 or not rec.get("epoch", 0) > 0:
+    raise SystemExit("bench recovery row is not clean: %r" % rec)
+if not du["readmit"].get("joins", 0) > 0:
+    raise SystemExit("no priced durable.readmit outcomes joined: %r"
+                     % du["readmit"])
+if not du["artifact_bytes"] > 0 or not du["persist_wall_s"] > 0:
+    raise SystemExit("durable artifact rows are empty: %r"
+                     % {k: du[k] for k in ("artifact_bytes",
+                                           "persist_wall_s")})
+side = json.load(open("/tmp/ci_bench_metrics.json"))
+sdu = side.get("durable")
+if not isinstance(sdu, dict):
+    raise SystemExit("metrics sidecar lacks the durable block")
+smissing = {"epoch", "serving_epoch", "pending_epochs", "artifact_bytes",
+            "persists", "persist_stages", "recoveries",
+            "demotions"} - set(sdu)
+if smissing:
+    raise SystemExit("sidecar durable block lacks %s" % sorted(smissing))
+print("durable rows ok (%d bitmaps -> %d B artifact; persist %ss, %s%% "
+      "attributed; warm %ss vs cold %ss = %sx; %d readmit joins)"
+      % (du["corpus_bitmaps"], du["artifact_bytes"], du["persist_wall_s"],
+         du["persist_stage_attr_pct"], du["warm_restart_s"],
+         du["cold_restart_s"], du["warm_vs_cold"],
+         du["readmit"]["joins"]))'
+# the deterministic kill-walk: one seeded plan, a child process killed
+# WITHOUT UNWINDING (os._exit mid-stage) at each of the five
+# durable.persist crash points in turn, plus the clean control run.
+# Every recovery must be bit-exact vs the replay oracle at the
+# recovered epoch, never lose a completed persist, and the torn-newest
+# fallback must serve the previous epoch after a one-byte corruption
+# (fuzz family 31 runs the same family at random hits; this walk is
+# the exhaustive five-point schedule)
+JAX_PLATFORMS=cpu python -c '
+import os, shutil, subprocess, sys, tempfile
+from roaringbitmap_tpu.durable import recover
+from roaringbitmap_tpu.durable import recovery as drecovery
+from roaringbitmap_tpu.fuzz import _durable_plan
+from roaringbitmap_tpu.serve import ingest as singest
+
+plan_seed = 7
+bms, muts = _durable_plan(plan_seed)
+n_flips = len(muts)
+child = ("import sys; from roaringbitmap_tpu.fuzz import _durable_child; "
+         "_durable_child(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))")
+env = dict(os.environ)
+env["JAX_PLATFORMS"] = "cpu"
+env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
+
+def oracle_at(k):
+    ob = [b.clone() for b in bms]
+    singest.apply_batches(
+        ob, [singest.MutationBatch("fz-durable", m) for m in muts[:k]]
+    )
+    return ob
+
+def check_bitexact(rec, where):
+    want = oracle_at(rec.epoch)
+    got = rec.corpus.bitmaps()
+    torn = len(got) != len(want) or any(
+        g.to_mutable() != w for g, w in zip(got, want)
+    )
+    del got
+    if torn:
+        raise SystemExit("%s: recovered corpus diverges from the replay "
+                         "oracle at epoch %d" % (where, rec.epoch))
+
+clean_root = newest_dir = None
+recovered_at = {}
+for kill_hit in range(0, 6):
+    root = tempfile.mkdtemp(prefix="ci_durable_")
+    proc = subprocess.run(
+        [sys.executable, "-c", child, root, str(plan_seed), str(kill_hit)],
+        capture_output=True, text=True, env=env, timeout=300,
+    )
+    logged = [int(l.split()[1]) for l in proc.stdout.splitlines()
+              if l.startswith("PERSISTED ")]
+    if kill_hit == 0:
+        if proc.returncode != 0:
+            raise SystemExit("clean child failed: %s" % proc.stderr[-400:])
+    elif proc.returncode != 137:
+        raise SystemExit("killed child (hit %d) exited %d, expected the "
+                         "os._exit(137) power cut"
+                         % (kill_hit, proc.returncode))
+    last_logged = max(logged) if logged else 0
+    rec = recover(root)
+    if rec is None:
+        if last_logged:
+            raise SystemExit("DURABILITY LOST at kill hit %d: child "
+                             "persisted epoch %d, recovery found nothing"
+                             % (kill_hit, last_logged))
+        recovered_at[kill_hit] = None
+        shutil.rmtree(root)
+        continue
+    if not last_logged <= rec.epoch <= n_flips:
+        raise SystemExit("kill hit %d recovered epoch %d outside "
+                         "[%d, %d]" % (kill_hit, rec.epoch,
+                                       last_logged, n_flips))
+    check_bitexact(rec, "kill hit %d" % kill_hit)
+    recovered_at[kill_hit] = rec.epoch
+    if kill_hit == 0:
+        if rec.epoch != n_flips:
+            raise SystemExit("clean run recovered epoch %d, wanted the "
+                             "final %d" % (rec.epoch, n_flips))
+        man = drecovery.verify_manifest(rec.dir)
+        if man["epoch"] != n_flips:
+            raise SystemExit("re-verified manifest names epoch %r"
+                             % man.get("epoch"))
+        clean_root, newest_dir = root, rec.dir
+        rec.close()
+    else:
+        rec.close()
+        shutil.rmtree(root)
+# hits 1-4 kill the first persist before its publish: nothing may be on
+# disk; hit 5 lands after the rename, so epoch 1 must have survived
+for hit in (1, 2, 3, 4):
+    if recovered_at[hit] is not None:
+        raise SystemExit("kill hit %d published epoch %r before the "
+                         "rename" % (hit, recovered_at[hit]))
+if recovered_at[5] != 1:
+    raise SystemExit("kill hit 5 (post-publish) lost epoch 1: %r"
+                     % recovered_at[5])
+# torn-newest fallback: one flipped byte in the newest corpus must fail
+# the sha256 re-verification and recovery must serve the previous epoch
+with open(os.path.join(newest_dir, "corpus.rbd"), "r+b") as f:
+    f.seek(-1, 2)
+    b = f.read(1)
+    f.seek(-1, 2)
+    f.write(bytes([b[0] ^ 0xFF]))
+try:
+    drecovery.verify_manifest(newest_dir)
+    raise SystemExit("sha256 re-verification accepted a corrupted corpus")
+except ValueError:
+    pass
+rec2 = recover(clean_root)
+if rec2 is None or rec2.epoch != n_flips - 1:
+    raise SystemExit("torn newest artifact did not fall back to epoch %d: "
+                     "%r" % (n_flips - 1, drecovery.LAST))
+if (drecovery.LAST or {}).get("torn_skipped") != 1:
+    raise SystemExit("torn fallback not surfaced in provenance: %r"
+                     % drecovery.LAST)
+check_bitexact(rec2, "torn fallback")
+rec2.close()
+shutil.rmtree(clean_root)
+print("durable kill-walk ok (plan seed %d, %d flips; hits 1-4 fail closed, "
+      "hit 5 survives publish; clean run recovers epoch %d; corrupted "
+      "newest falls back to epoch %d with torn_skipped=1)"
+      % (plan_seed, n_flips, n_flips, n_flips - 1))'
+# the durable metric names must pass the naming convention, the
+# durable.persist fault site and the two sentinel rules must be
+# registered, and the persist-stage label set must be the declared four
+JAX_PLATFORMS=cpu python -c '
+from roaringbitmap_tpu import observe
+from roaringbitmap_tpu.durable import PERSIST_STAGES
+from roaringbitmap_tpu.robust import faults
+for name, suffix in ((observe.DURABLE_PERSIST_TOTAL, "_total"),
+                     (observe.DURABLE_PERSIST_STAGE_SECONDS, "_seconds"),
+                     (observe.DURABLE_PERSIST_WALL_SECONDS, "_seconds"),
+                     (observe.DURABLE_PERSIST_BYTES_TOTAL, "_total"),
+                     (observe.DURABLE_EPOCH_COUNT, "_count"),
+                     (observe.DURABLE_ARTIFACT_BYTES, "_bytes"),
+                     (observe.DURABLE_PENDING_COUNT, "_count"),
+                     (observe.DURABLE_RECOVERY_TOTAL, "_total"),
+                     (observe.DURABLE_DEMOTE_TOTAL, "_total")):
+    if not (name.startswith("rb_tpu_") and name.endswith(suffix)):
+        raise SystemExit("durable metric violates naming convention: %r" % name)
+import roaringbitmap_tpu.durable  # registers the persist metrics
+st = observe.REGISTRY.get(observe.DURABLE_PERSIST_STAGE_SECONDS)
+if st is None or st.labelnames != ("stage",):
+    raise SystemExit("persist stage label set is not the declared (stage,)")
+if PERSIST_STAGES != ("snapshot", "lineage", "manifest", "publish"):
+    raise SystemExit("declared persist stage set drifted: %r"
+                     % (PERSIST_STAGES,))
+if "durable.persist" not in faults.SITES:
+    raise SystemExit("durable.persist fault site not registered")
+from roaringbitmap_tpu.observe import health
+rules = {r.name: r for r in health.DEFAULT_RULES}
+for rn in ("epoch-persist-stall", "recovery-manifest-torn"):
+    if rn not in rules:
+        raise SystemExit("rule table lacks %s" % rn)
+print("durable metric names ok (suffixes + stage label set; fault site + "
+      "both sentinel rules registered)")'
+
+step "rb_top observatory report (schema rb_tpu_top/8, ISSUE 9 + 11 + 12 + 13 + 14 + 15 + 16 + 17)"
 # the snapshot CLI must produce a schema-valid JSON report with every
 # panel populated from its in-process demo workload — incl. the regret
 # panel (per-site joins from the decision-outcome ledger), the health
@@ -1164,18 +1378,20 @@ step "rb_top observatory report (schema rb_tpu_top/7, ISSUE 9 + 11 + 12 + 13 + 1
 # fusion panel (window occupancy + shared-subexpression hit ratio from
 # the demo's fused window), and the epoch panel (current epoch, mutlog
 # depth, freshness, flip stages, lineage from the demo's read-write
-# window), and the structure panel (container census, drift ratio,
-# maintenance-pass rows from the demo's forced pass)
+# window), the structure panel (container census, drift ratio,
+# maintenance-pass rows from the demo's forced pass), and the durable
+# panel (persisted epoch, stage walls, recovery provenance from the
+# demo's persisted flip + recovery scan)
 JAX_PLATFORMS=cpu RB_TPU_ARTIFACT_DIR=/tmp/ci_artifacts \
   python scripts/rb_top.py --demo --json > /tmp/ci_rb_top.json
 python -c '
 import json
 r = json.load(open("/tmp/ci_rb_top.json"))
-if r.get("schema") != "rb_tpu_top/7":
+if r.get("schema") != "rb_tpu_top/8":
     raise SystemExit("rb_top: bad schema %r" % r.get("schema"))
 need = {"schema", "generated_utc", "source", "counters", "latency",
         "locks", "breakers", "cache", "decisions_tail", "regret", "health",
-        "fusion", "serving", "epochs", "structure"}
+        "fusion", "serving", "epochs", "structure", "durable"}
 missing = need - set(r)
 if missing:
     raise SystemExit("rb_top report lacks %s" % sorted(missing))
@@ -1230,6 +1446,23 @@ if not st.get("passes", {}).get("compacted", 0) >= 1:
 lp = st.get("last_pass") or {}
 if lp.get("outcome") != "compacted" or not lp.get("rewritten_keys", 0) > 0:
     raise SystemExit("rb_top last maintenance pass malformed: %r" % lp)
+du = r["durable"]
+if not (du.get("epoch") and du["epoch"] == du.get("serving_epoch")):
+    raise SystemExit("rb_top durable panel not caught up: %r"
+                     % {k: du.get(k) for k in ("epoch", "serving_epoch")})
+if not du.get("persists", {}).get("persisted"):
+    raise SystemExit("rb_top demo persisted no epoch: %r" % du.get("persists"))
+if not du.get("artifact_bytes", 0) > 0:
+    raise SystemExit("rb_top durable artifact bytes missing: %r" % du)
+for stage in ("snapshot", "lineage", "manifest", "publish"):
+    if not (du.get("persist_stages", {}).get(stage, {}).get("count", 0) >= 1):
+        raise SystemExit("rb_top durable persist stage %r unrecorded" % stage)
+if not du.get("recoveries", {}).get("recovered"):
+    raise SystemExit("rb_top demo recovery scan found nothing: %r"
+                     % du.get("recoveries"))
+rl = du.get("recovery_last") or {}
+if not (rl.get("epoch") == du["epoch"] and rl.get("torn_skipped") == 0):
+    raise SystemExit("rb_top durable recovery provenance malformed: %r" % rl)
 if not r["locks"]:
     raise SystemExit("rb_top demo recorded no lock waits")
 if not r["counters"]["compile"]:

@@ -69,7 +69,7 @@ import time
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
-SCHEMA = "rb_tpu_top/7"
+SCHEMA = "rb_tpu_top/8"
 
 
 def _live_report(tail: int) -> dict:
@@ -114,6 +114,9 @@ def _live_report(tail: int) -> dict:
         # structure observatory (ISSUE 16): format census, drift ratio,
         # fragmentation/accretion, last maintenance pass, authority
         "structure": insights.structure(),
+        # durable epochs (ISSUE 17): persisted vs serving epoch, artifact
+        # bytes, persist stage walls, recovery provenance, demotions
+        "durable": insights.durable(),
     }
 
 
@@ -172,6 +175,9 @@ def _sidecar_report(path: str, tail: int) -> dict:
         # the sidecar's registry-derived structure block (export.py; the
         # live ledger stats and last-pass record are process-local)
         "structure": side.get("structure", {}),
+        # the sidecar's registry-derived durable block (export.py; the
+        # live store stats and recovery provenance are process-local)
+        "durable": side.get("durable", {}),
     }
 
 
@@ -252,6 +258,21 @@ def _demo_workload() -> None:
     _structure.LEDGER.watch("demo", bms)
     _structure.LEDGER.refresh()
     _maintain.run_pass(store=es, reason="demo", force=True)
+    # one persisted flip + a recovery scan so the durable panel reports a
+    # real frozen epoch, stage walls, and provenance (ISSUE 17)
+    import tempfile
+
+    from roaringbitmap_tpu import durable as _durable
+
+    droot = tempfile.mkdtemp(prefix="rb_top_durable_")
+    dstore = _durable.DurableStore(droot)
+    _DEMO_KEEPALIVE.append(dstore)
+    es.attach_durable(dstore)
+    es.submit("demo-writer", {0: [4243, 4244]})
+    es.flip(reason="demo-durable")
+    rec = _durable.recover(droot)
+    if rec is not None:
+        _DEMO_KEEPALIVE.append(rec)
     # a couple of sentinel ticks so the health panel reports a judged
     # status (hysteresis needs consecutive evaluations), not "never ran"
     from roaringbitmap_tpu.observe import sentinel
@@ -491,6 +512,47 @@ def _render_console(r: dict) -> str:
     if st.get("authority"):
         st_rows.append(("authority", st["authority"]))
     section("structure (corpus shape & compaction)", st_rows)
+    # durable panel (ISSUE 17): persisted vs serving epoch, the frozen
+    # artifact's size, persist volume + last wall, the persist stage
+    # decomposition, recovery provenance, and residency demotions
+    du = r.get("durable", {}) or {}
+    du_rows = []
+    if du.get("epoch") is not None or du.get("serving_epoch") is not None:
+        du_rows.append(
+            ("epoch (persisted/serving)",
+             f"{du.get('epoch')}/{du.get('serving_epoch')}")
+        )
+    if du.get("pending_epochs") is not None:
+        du_rows.append(("pending epochs", du["pending_epochs"]))
+    if du.get("artifact_bytes") is not None:
+        du_rows.append(("artifact bytes", du["artifact_bytes"]))
+    if du.get("persist_wall_s") is not None:
+        du_rows.append(("last persist wall", f"{du['persist_wall_s']}s"))
+    for outcome, v in sorted((du.get("persists") or {}).items()):
+        du_rows.append((f"persists[{outcome}]", v))
+    for stage_name, row in sorted((du.get("persist_stages") or {}).items()):
+        du_rows.append(
+            (f"stage[{stage_name}]",
+             f"n={row.get('count')} sum={row.get('sum')}s")
+        )
+    for outcome, v in sorted((du.get("recoveries") or {}).items()):
+        du_rows.append((f"recoveries[{outcome}]", v))
+    for rung, v in sorted((du.get("demotions") or {}).items()):
+        du_rows.append((f"demotions[{rung}]", v))
+    sl = du.get("store_live")
+    if isinstance(sl, dict) and sl:
+        du_rows.append(
+            ("store", f"root={sl.get('root')} keep={sl.get('keep')} "
+             f"persists={sl.get('persists')}")
+        )
+    rl = du.get("recovery_last")
+    if isinstance(rl, dict) and rl:
+        du_rows.append(
+            ("recovered from",
+             f"{rl.get('dir')} epoch={rl.get('epoch')} "
+             f"torn_skipped={rl.get('torn_skipped')} wall={rl.get('wall_s')}s")
+        )
+    section("durable (frozen epochs & recovery)", du_rows)
     dec_rows = [
         (d.get("trace") or "-",
          f"{d['site']}: {d['decision']} {d.get('inputs', '')}")

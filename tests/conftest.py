@@ -23,6 +23,14 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`; slow marks subprocess-heavy tests
+    # (e.g. the durable kill-test family pin) that ci.sh runs separately
+    config.addinivalue_line(
+        "markers", "slow: deselected by the tier-1 `-m 'not slow'` run"
+    )
+
+
 # ---------------------------------------------------------------------------
 # Seeded shape-diverse bitmap generator — the reference's fake-data oracle
 # (SeededTestData.java:13 seed 0xfeef1f0; rleRegion/denseRegion/sparseRegion

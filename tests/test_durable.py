@@ -1,0 +1,548 @@
+"""Durable epochs (ISSUE 17): the frozen mmap corpus format (round-trip,
+structural validation, zero-copy contract), atomic priced persistence
+(crash points failing closed, idempotent re-persist, the priced verdict
++ outcome join), crash recovery (newest complete manifest wins, torn
+artifacts skipped with provenance), warm restart (resume + lazy
+PACK_CACHE readmit teaching the residency readmit curve), the fourth
+residency rung (demote-to-mapped), the two new sentinel rules, the
+sidecar/insights durable blocks, the fuzz family 31 seed pin, and the
+zero-copy serialization satellite's regression pins."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import insights, observe
+from roaringbitmap_tpu import serialization
+from roaringbitmap_tpu.cost import epoch as epoch_cost
+from roaringbitmap_tpu.cost import residency as residency_cost
+from roaringbitmap_tpu.durable import (
+    DurableStore,
+    MappedCorpus,
+    PERSIST_STAGES,
+    Recovery,
+    recover,
+    write_corpus,
+)
+from roaringbitmap_tpu.durable import format as dformat
+from roaringbitmap_tpu.durable import recovery as drecovery
+from roaringbitmap_tpu.durable import store as dstore_mod
+from roaringbitmap_tpu.models.immutable import ImmutableRoaringBitmap
+from roaringbitmap_tpu.models.roaring import RoaringBitmap
+from roaringbitmap_tpu.observe import export as obs_export
+from roaringbitmap_tpu.observe import health, outcomes
+from roaringbitmap_tpu.parallel import store as pstore
+from roaringbitmap_tpu.robust import faults
+from roaringbitmap_tpu.robust import ladder as ladder_mod
+from roaringbitmap_tpu.robust.errors import TransientDeviceError
+from roaringbitmap_tpu.serialization import InvalidRoaringFormat
+from roaringbitmap_tpu.serve import EpochStore, slo
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    slo.reset()
+    outcomes.reset()
+    faults.clear()
+    ladder_mod.LADDER.reset()
+    epoch_cost.MODEL.reset()
+    residency_cost.MODEL.reset()
+    pstore.set_demotion_probe(None)
+    yield
+    slo.reset()
+    outcomes.reset()
+    faults.clear()
+    ladder_mod.LADDER.reset()
+    epoch_cost.MODEL.reset()
+    residency_cost.MODEL.reset()
+    pstore.set_demotion_probe(None)
+    pstore.PACK_CACHE.close()
+
+
+def _corpus(n=4, seed=3, card=1200):
+    rng = np.random.default_rng(seed)
+    return [
+        RoaringBitmap(
+            np.sort(rng.choice(1 << 18, card, replace=False)).astype(np.uint32)
+        )
+        for _ in range(n)
+    ]
+
+
+def _epoch_store(bms, tenant="t-dur"):
+    slo.TENANTS.declare(tenant, quota_qps=1e6, burst=1e6)
+    return EpochStore(bms)
+
+
+def _flip_once(es, tenant="t-dur", idx=0, values=(7, 11, 13)):
+    es.submit(tenant, {idx: list(values)})
+    return es.flip(reason="test")
+
+
+# ---------------------------------------------------------------------------
+# the frozen corpus format
+# ---------------------------------------------------------------------------
+
+
+def test_corpus_round_trip_mixed_sources(tmp_path):
+    bms = _corpus()
+    path = str(tmp_path / "corpus.rbd")
+    # mixed inputs: heap bitmaps, pre-serialized blobs, mapped bitmaps
+    stats = write_corpus(path, [bms[0], bms[1].serialize(), bms[2], bms[3]])
+    assert stats["n"] == 4
+    assert stats["artifact_bytes"] == os.path.getsize(path)
+    mc = MappedCorpus(path)
+    assert len(mc) == 4
+    for i, want in enumerate(bms):
+        assert bytes(mc.payload(i)) == want.serialize()
+        assert mc.bitmap(i).to_mutable() == want
+        # the directory keeps every payload word-aligned for the
+        # zero-copy u64 views
+        assert mc._dir[i][0] % dformat.ALIGN == 0
+    # a mapped bitmap re-persists as its backing slice, byte-identical
+    path2 = str(tmp_path / "corpus2.rbd")
+    write_corpus(path2, mc.bitmaps())
+    with open(path, "rb") as f1, open(path2, "rb") as f2:
+        assert f1.read() == f2.read()
+    mc.close()
+
+
+def test_corpus_rejects_structural_corruption(tmp_path):
+    bms = _corpus(n=2)
+    path = str(tmp_path / "corpus.rbd")
+    write_corpus(path, bms)
+    raw = bytearray(open(path, "rb").read())
+    bad_magic = bytearray(raw)
+    bad_magic[:4] = b"NOPE"
+    bad = str(tmp_path / "bad.rbd")
+    open(bad, "wb").write(bytes(bad_magic))
+    with pytest.raises(InvalidRoaringFormat):
+        MappedCorpus(bad)
+    open(bad, "wb").write(bytes(raw[: len(raw) // 2]))
+    with pytest.raises(InvalidRoaringFormat):
+        MappedCorpus(bad)
+    # an out-of-bounds directory entry is structural, not content
+    torn = bytearray(raw)
+    dformat.DIRENT.pack_into(torn, dformat.HEADER.size, len(raw) + 8, 64)
+    open(bad, "wb").write(bytes(torn))
+    with pytest.raises(InvalidRoaringFormat):
+        MappedCorpus(bad)
+
+
+def test_mapped_bitmaps_serve_zero_copy(tmp_path):
+    bms = _corpus(n=2, card=9000)  # dense enough for bitmap containers
+    path = str(tmp_path / "corpus.rbd")
+    write_corpus(path, bms)
+    mc = MappedCorpus(path)
+    got = mc.bitmap(0)
+    # serialize() is the backing slice, not a re-encode
+    assert got.serialize() == bms[0].serialize()
+    hlc = got.high_low_container
+    for c in hlc.containers:
+        arr = getattr(c, "words", None)
+        if arr is None:
+            arr = getattr(c, "values", None)
+        if arr is not None and arr.size:
+            assert not arr.flags["WRITEABLE"], (
+                "mapped container payloads must be read-only views"
+            )
+
+
+# ---------------------------------------------------------------------------
+# atomic persistence
+# ---------------------------------------------------------------------------
+
+
+def test_persist_publishes_and_is_idempotent(tmp_path):
+    bms = _corpus()
+    es = _epoch_store(bms)
+    ds = DurableStore(str(tmp_path))
+    _flip_once(es)
+    rec = ds.persist(es)
+    assert rec["outcome"] == "persisted" and rec["fresh"] is True
+    assert rec["epoch"] == 1 and os.path.isdir(rec["dir"])
+    manifest = json.load(open(os.path.join(rec["dir"], "MANIFEST.json")))
+    assert manifest["schema"] == dstore_mod.SCHEMA
+    assert set(manifest["files"]) == {"corpus.rbd", "lineage.json"}
+    again = ds.persist(es)
+    assert again["outcome"] == "persisted" and again["fresh"] is False
+    assert ds.pending_epochs(es) == 0
+
+
+def test_persist_aborts_fail_closed_at_every_crash_point(tmp_path):
+    """A non-fatal fault at ANY of the five crash points aborts the
+    persist memory-only: no published dir appears, no tmp dir leaks
+    past the next persist, and the aborted outcome is counted."""
+    bms = _corpus(n=2)
+    counter = observe.REGISTRY.get(observe.DURABLE_PERSIST_TOTAL)
+    for crash_at in range(4):  # points 1-4 precede the rename
+        faults.clear()
+        es = _epoch_store(bms, tenant=f"t-ab{crash_at}")
+        ds = DurableStore(str(tmp_path / f"ab{crash_at}"))
+        _flip_once(es, tenant=f"t-ab{crash_at}")
+        before = counter.get(("aborted",))
+        with faults.inject(
+            "durable.persist", TransientDeviceError, after=crash_at
+        ):
+            rec = ds.persist(es)
+        assert rec["outcome"] == "aborted", f"crash point {crash_at + 1}"
+        assert counter.get(("aborted",)) == before + 1
+        assert not os.path.isdir(
+            os.path.join(ds.root, dstore_mod.epoch_dir_name(1))
+        ), f"crash point {crash_at + 1} published a torn epoch"
+        # the abort leaves pending exposure for the stall rule, and the
+        # next clean persist sweeps any tmp orphan and publishes
+        assert ds.pending_epochs(es) > 0
+        rec2 = ds.persist(es)
+        assert rec2["outcome"] == "persisted"
+        assert not [
+            d for d in os.listdir(ds.root) if d.startswith(".tmp-")
+        ], "tmp orphan survived the next persist"
+
+
+def test_persist_raises_fatal(tmp_path):
+    bms = _corpus(n=2)
+    es = _epoch_store(bms)
+    ds = DurableStore(str(tmp_path))
+    _flip_once(es)
+    # a ValueError classifies FATAL: a deterministic misconfiguration
+    # must surface, never degrade to memory-only silently
+    with faults.inject("durable.persist", ValueError, after=0):
+        with pytest.raises(ValueError):
+            ds.persist(es)
+
+
+def test_priced_verdict_and_outcome_join(tmp_path):
+    bms = _corpus(n=2)
+    es = _epoch_store(bms)
+    ds = DurableStore(str(tmp_path))
+    _flip_once(es)
+    # small corpus + the declared 20ms/epoch exposure rate => persist
+    rec = ds.maybe_persist(es)
+    assert rec["outcome"] == "persisted"
+    assert ds.maybe_persist(es)["outcome"] == "noop"
+    # the measured wall joined the durable.persist decision site
+    tail = outcomes.LEDGER.tail(16)
+    joined = [t for t in tail if t["site"] == "durable.persist"]
+    assert joined and joined[-1]["engine"] == "persist"
+    # an artificially huge predicted wall flips the verdict to skip
+    es2 = _epoch_store(_corpus(n=2, seed=9), tenant="t-skip")
+    ds2 = DurableStore(str(tmp_path / "skip"))
+    _flip_once(es2, tenant="t-skip")
+    old = epoch_cost.MODEL.coeffs["persist_overhead_us"]
+    try:
+        epoch_cost.MODEL.coeffs["persist_overhead_us"] = 1e12
+        rec2 = ds2.maybe_persist(es2)
+    finally:
+        epoch_cost.MODEL.coeffs["persist_overhead_us"] = old
+    assert rec2["outcome"] == "skipped" and rec2["pending"] > 0
+
+
+def test_flip_hook_attaches_persistence(tmp_path):
+    bms = _corpus(n=2)
+    es = _epoch_store(bms)
+    ds = DurableStore(str(tmp_path))
+    es.attach_durable(ds)
+    rec = _flip_once(es)
+    assert rec["durable"] == "persisted"
+    assert ds.stats()["persisted_epoch"] == 1
+
+
+def test_gc_keeps_newest_artifacts(tmp_path):
+    bms = _corpus(n=2)
+    es = _epoch_store(bms)
+    ds = DurableStore(str(tmp_path), keep=2)
+    for i in range(4):
+        _flip_once(es, values=(100 + i,))
+        ds.persist(es)
+    kept = sorted(d for d in os.listdir(ds.root) if d.startswith("epoch_"))
+    assert kept == [
+        dstore_mod.epoch_dir_name(3), dstore_mod.epoch_dir_name(4)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# recovery + warm restart
+# ---------------------------------------------------------------------------
+
+
+def test_recover_newest_and_resume(tmp_path):
+    bms = _corpus()
+    es = _epoch_store(bms)
+    ds = DurableStore(str(tmp_path), keep=3)
+    _flip_once(es, values=(1, 2))
+    ds.persist(es)
+    _flip_once(es, values=(3, 4))
+    ds.persist(es)
+    rec = recover(str(tmp_path))
+    assert isinstance(rec, Recovery) and rec.epoch == 2
+    for i, live in enumerate(es.corpus):
+        assert rec.corpus.bitmap(i).to_mutable() == live
+    assert drecovery.LAST["epoch"] == 2
+    assert drecovery.LAST["torn_skipped"] == 0
+    resumed = rec.resume_store()
+    assert resumed.current() == 2
+    assert [r["epoch"] for r in resumed.lineage()] == [
+        r["epoch"] for r in rec.lineage
+    ]
+    # the resumed store keeps flipping from where the crash left off
+    slo.TENANTS.declare("t-resume", quota_qps=1e6, burst=1e6)
+    resumed.submit("t-resume", {0: [99]})
+    assert resumed.flip(reason="post-resume")["epoch"] == 3
+
+
+def test_recover_skips_torn_artifact(tmp_path):
+    bms = _corpus(n=2)
+    es = _epoch_store(bms)
+    ds = DurableStore(str(tmp_path), keep=3)
+    _flip_once(es, values=(1,))
+    ds.persist(es)
+    _flip_once(es, values=(2,))
+    ds.persist(es)
+    # corrupt one payload byte of the NEWEST artifact: sha256 mismatch
+    newest = os.path.join(str(tmp_path), dstore_mod.epoch_dir_name(2))
+    corpus_path = os.path.join(newest, "corpus.rbd")
+    raw = bytearray(open(corpus_path, "rb").read())
+    raw[-1] ^= 0xFF
+    open(corpus_path, "wb").write(bytes(raw))
+    with pytest.raises(ValueError):
+        drecovery.verify_manifest(newest)
+    torn_counter = observe.REGISTRY.get(observe.DURABLE_RECOVERY_TOTAL)
+    before = torn_counter.get(("torn",))
+    rec = recover(str(tmp_path))
+    assert rec is not None and rec.epoch == 1, (
+        "recovery must fall back to the parent epoch"
+    )
+    assert torn_counter.get(("torn",)) == before + 1
+    assert drecovery.LAST["torn_skipped"] == 1
+
+
+def test_recover_empty_root(tmp_path):
+    assert recover(str(tmp_path)) is None
+    assert drecovery.LAST["epoch"] is None
+
+
+def test_readmit_warms_cache_and_teaches_curve(tmp_path):
+    bms = _corpus(n=3)
+    es = _epoch_store(bms)
+    ds = DurableStore(str(tmp_path))
+    _flip_once(es)
+    ds.persist(es)
+    rec = recover(str(tmp_path))
+    assert residency_cost.MODEL.readmit_estimate("agg") is None
+    out = rec.readmit()
+    assert out["working_sets"] == 1
+    est = residency_cost.MODEL.readmit_estimate("agg")
+    assert est is not None and est > 0, (
+        "the readmit join must teach the residency readmit curve"
+    )
+    # the warmed pack is a cache hit for the mapped working set
+    hits = observe.REGISTRY.get(observe.PACK_CACHE_HITS_TOTAL)
+    before = hits.get(("agg",))
+    pstore.packed_for(rec.corpus.bitmaps())
+    assert hits.get(("agg",)) == before + 1
+
+
+# ---------------------------------------------------------------------------
+# the fourth residency rung
+# ---------------------------------------------------------------------------
+
+
+def test_eviction_demotes_to_mapped_only_with_probe():
+    demote = observe.REGISTRY.get(observe.DURABLE_DEMOTE_TOTAL)
+
+    def _fill(cache, n=3):
+        rng = np.random.default_rng(17)
+        sets = [
+            [
+                RoaringBitmap(
+                    np.sort(
+                        rng.choice(1 << 18, 1500, replace=False)
+                    ).astype(np.uint32)
+                )
+                for _ in range(2)
+            ]
+            for _ in range(n)
+        ]
+        return [cache.get_packed(s) for s in sets]
+
+    cache = pstore.PackCache(max_bytes=1 << 60)
+    packs = _fill(cache)
+    before_discard = demote.get(("discard",))
+    cache.configure(max_bytes=packs[0].words.nbytes + 1)
+    assert demote.get(("discard",)) > before_discard
+    cache.close()
+    # with a durable map covering the corpus, the same eviction demotes
+    # to the mapped rung instead of discarding
+    pstore.set_demotion_probe(lambda kind: True)
+    cache = pstore.PackCache(max_bytes=1 << 60)
+    packs = _fill(cache)
+    before_mapped = demote.get(("mapped",))
+    cache.configure(max_bytes=packs[0].words.nbytes + 1)
+    assert demote.get(("mapped",)) > before_mapped
+    cache.close()
+
+
+def test_persist_installs_demotion_probe(tmp_path):
+    assert pstore._DEMOTE_PROBE is None
+    es = _epoch_store(_corpus(n=2))
+    ds = DurableStore(str(tmp_path))
+    _flip_once(es)
+    ds.persist(es)
+    assert pstore._DEMOTE_PROBE is not None
+    assert pstore._DEMOTE_PROBE("agg") is True
+
+
+# ---------------------------------------------------------------------------
+# sentinel rules + observability panels
+# ---------------------------------------------------------------------------
+
+
+def test_rule_registry_pins():
+    names = [r.name for r in health.DEFAULT_RULES]
+    assert "epoch-persist-stall" in names
+    assert "recovery-manifest-torn" in names
+    stall = next(r for r in health.DEFAULT_RULES
+                 if r.name == "epoch-persist-stall")
+    assert (stall.warn, stall.critical) == (4.0, 64.0)
+    torn = next(r for r in health.DEFAULT_RULES
+                if r.name == "recovery-manifest-torn")
+    assert (torn.warn, torn.critical) == (0.5, 1.0)
+    assert torn.fire_after == 1, "any torn artifact must go red in one tick"
+
+
+def test_persist_stall_rule_fires_on_backlog_without_persists(tmp_path):
+    stall = next(r for r in health.DEFAULT_RULES
+                 if r.name == "epoch-persist-stall")
+    s0 = health.snapshot(refresh_hbm=False)
+    es = _epoch_store(_corpus(n=2))
+    ds = DurableStore(str(tmp_path))
+    # a deep backlog with zero completed persists in the window
+    observe.REGISTRY.get(observe.DURABLE_PENDING_COUNT).set(70)
+    s1 = health.snapshot(prev_sums=s0.sums, refresh_hbm=False)
+    assert stall.probe(s1) >= 64.0
+    # a completed persist in the window clears the signal
+    _flip_once(es)
+    ds.persist(es)
+    s2 = health.snapshot(prev_sums=s1.sums, refresh_hbm=False)
+    assert stall.probe(s2) == 0.0
+
+
+def test_sidecar_and_insights_durable_blocks(tmp_path):
+    es = _epoch_store(_corpus(n=2))
+    ds = DurableStore(str(tmp_path))
+    es.attach_durable(ds)
+    _flip_once(es)
+    recover(str(tmp_path))
+    side = obs_export.sidecar_snapshot()
+    blk = side["durable"]
+    assert blk["epoch"] == 1 and blk["pending_epochs"] == 0
+    assert blk["persists"].get("persisted", 0) >= 1
+    assert blk["recoveries"].get("recovered", 0) >= 1
+    assert set(blk["persist_stages"]) <= set(PERSIST_STAGES)
+    live = insights.durable()
+    assert live["store_live"]["persisted_epoch"] == 1
+    assert live["recovery_last"]["epoch"] == 1
+    assert "durable" in insights.observatory()
+
+
+def test_metric_and_site_name_pins():
+    assert observe.DURABLE_PERSIST_TOTAL == "rb_tpu_durable_persist_total"
+    assert observe.DURABLE_RECOVERY_TOTAL == "rb_tpu_durable_recovery_total"
+    assert observe.DURABLE_DEMOTE_TOTAL == "rb_tpu_durable_demote_total"
+    assert observe.DURABLE_PENDING_COUNT == "rb_tpu_durable_pending_count"
+    assert "durable.persist" in faults.SITES
+    assert PERSIST_STAGES == ("snapshot", "lineage", "manifest", "publish")
+
+
+# ---------------------------------------------------------------------------
+# fuzz family 31 seed pin
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_fuzz_family_31_seed_pin():
+    from roaringbitmap_tpu import fuzz
+
+    fuzz.verify_durable_crash_invariance(
+        "crash-at-any-flip-stage-vs-recovery-oracle", iterations=3, seed=61
+    )
+
+
+# ---------------------------------------------------------------------------
+# zero-copy serialization satellite (regression pins)
+# ---------------------------------------------------------------------------
+
+
+def _payload_arrays(bm):
+    """Every container payload array (array content, bitmap words, run
+    starts/lengths) of a deserialized bitmap."""
+    out = []
+    for c in bm.high_low_container.containers:
+        for attr in ("content", "words", "starts", "lengths"):
+            arr = getattr(c, attr, None)
+            if arr is not None and getattr(arr, "size", 0):
+                out.append(arr)
+    return out
+
+
+def _mixed_bm(seed=5):
+    """One chunk dense (bitmap container), one sparse (array container)."""
+    rng = np.random.default_rng(seed)
+    dense = rng.choice(1 << 16, 9000, replace=False)
+    sparse = (1 << 16) + rng.choice(1 << 16, 500, replace=False)
+    return RoaringBitmap(
+        np.sort(np.concatenate([dense, sparse])).astype(np.uint32)
+    )
+
+
+def test_deserialize_copy_false_shares_memory():
+    want = _mixed_bm()
+    blob = np.frombuffer(want.serialize(), dtype=np.uint8)
+    zc = serialization.deserialize(blob, copy=False)
+    assert zc == want
+    arrays = _payload_arrays(zc)
+    assert arrays and all(np.shares_memory(a, blob) for a in arrays), (
+        "copy=False must build every payload as a view into the source"
+    )
+    # the default path must NOT alias the caller's buffer
+    cp = serialization.deserialize(blob, copy=True)
+    assert not any(np.shares_memory(a, blob) for a in _payload_arrays(cp))
+
+
+def test_zero_copy_views_are_read_only():
+    want = _mixed_bm()
+    zc = serialization.deserialize(want.serialize(), copy=False)
+    dense = [
+        c for c in zc.high_low_container.containers if hasattr(c, "words")
+    ]
+    assert dense, "the mixed bitmap must hold a bitmap container"
+    with pytest.raises(ValueError):
+        dense[0].words[0] = np.uint64(1)
+
+
+def test_serial_bytes_counter_same_on_both_paths():
+    blob = _mixed_bm().serialize()
+    c = observe.REGISTRY.get(observe.SERIAL_BYTES_TOTAL)
+    before = c.get(("deserialize",))
+    serialization.deserialize(blob, copy=True)
+    copied = c.get(("deserialize",)) - before
+    serialization.deserialize(blob, copy=False)
+    viewed = c.get(("deserialize",)) - before - copied
+    assert copied == viewed == len(blob), (
+        "both paths consume (and count) the same serialized bytes"
+    )
+
+
+def test_immutable_over_ndarray_is_zero_copy():
+    want = _mixed_bm()
+    blob = np.frombuffer(want.serialize(), dtype=np.uint8)
+    imm = ImmutableRoaringBitmap(blob)
+    assert imm.to_mutable() == want
+    assert bytes(imm.serialize()) == want.serialize()
+    arrays = _payload_arrays(imm)
+    assert arrays and all(np.shares_memory(a, blob) for a in arrays), (
+        "an ndarray-backed immutable must not copy its source"
+    )
