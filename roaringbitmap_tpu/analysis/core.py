@@ -217,8 +217,45 @@ class Checker:
         raise NotImplementedError
 
 
+class ProjectChecker:
+    """One whole-program contract rule (ISSUE 18). Unlike :class:`Checker`,
+    which sees one file, a ProjectChecker receives the
+    :class:`~roaringbitmap_tpu.analysis.project.ProjectContext` — the full
+    parsed tree plus the extracted implicit registries — and emits findings
+    anchored to whatever file each contract leg lives in. Pragma
+    suppression works exactly like the lexical tier: a finding anchored at
+    ``path:line`` is waived by ``# rb-ok: <rule>`` on that line (the
+    anchored file's FileContext carries the pragma map)."""
+
+    rule_id: str = "abstract-contract"
+    description: str = ""
+    severity: str = "error"
+
+    def finding(self, project, path: str, line: int, message: str,
+                col: int = 0, end_line: int = 0,
+                suppress_pragma: bool = False) -> Finding:
+        ctx = project.files.get(path)
+        snippet = ctx.line_text(line).strip() if ctx is not None else ""
+        return Finding(
+            rule=self.rule_id,
+            path=path,
+            line=line,
+            col=col,
+            severity=self.severity,
+            message=message,
+            snippet=snippet,
+            end_line=end_line or line,
+            pragma_proof=suppress_pragma,
+        )
+
+    def check_project(self, project) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
 # rule-id -> checker class; rules/__init__.py populates this at import
 CHECKERS: Dict[str, type] = {}
+# rule-id -> ProjectChecker class (the contract tier, ISSUE 18)
+CONTRACT_CHECKERS: Dict[str, type] = {}
 
 
 def register(cls: type) -> type:
@@ -229,9 +266,28 @@ def register(cls: type) -> type:
     return cls
 
 
+def register_contract(cls: type) -> type:
+    """Class decorator adding a ProjectChecker to the contract registry.
+    The two tiers share one rule-id namespace so ``--rules`` selection and
+    the per-rule findings counter stay unambiguous."""
+    if cls.rule_id in CONTRACT_CHECKERS and CONTRACT_CHECKERS[cls.rule_id] is not cls:
+        raise ValueError(f"duplicate contract rule id {cls.rule_id!r}")
+    if cls.rule_id in CHECKERS:
+        raise ValueError(
+            f"contract rule id {cls.rule_id!r} collides with a lexical rule"
+        )
+    CONTRACT_CHECKERS[cls.rule_id] = cls
+    return cls
+
+
 def all_rule_ids() -> List[str]:
     _load_rules()
     return sorted(CHECKERS)
+
+
+def all_contract_rule_ids() -> List[str]:
+    _load_rules()
+    return sorted(CONTRACT_CHECKERS)
 
 
 def _load_rules() -> None:
@@ -306,6 +362,39 @@ def run_checks(
                     result.suppressed += 1
                 else:
                     result.findings.append(f)
+    result.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return result
+
+
+def run_contract_checks(
+    project,
+    rules: Optional[Sequence[str]] = None,
+) -> RunResult:
+    """Run the contract tier (default: every registered ProjectChecker)
+    over an already-built ProjectContext. Pragma suppression consults the
+    FileContext of whatever file each finding is anchored in, so the two
+    tiers share one waiver mechanism (and one baseline format)."""
+    _load_rules()
+    wanted = list(rules) if rules else sorted(CONTRACT_CHECKERS)
+    unknown = [r for r in wanted if r not in CONTRACT_CHECKERS]
+    if unknown:
+        raise ValueError(
+            f"unknown contract rule(s) {unknown}; known: {sorted(CONTRACT_CHECKERS)}"
+        )
+    result = RunResult(files=len(project.files))
+    result.parse_errors.extend(project.parse_errors)
+    for rid in wanted:
+        checker = CONTRACT_CHECKERS[rid]()
+        for f in checker.check_project(project):
+            ctx = project.files.get(f.path)
+            if (
+                ctx is not None
+                and not f.pragma_proof
+                and ctx.suppressed(f.rule, f.line, f.end_line)
+            ):
+                result.suppressed += 1
+            else:
+                result.findings.append(f)
     result.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return result
 

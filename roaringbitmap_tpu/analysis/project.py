@@ -1,0 +1,561 @@
+"""ProjectContext: the whole-program view behind the contract tier
+(ISSUE 18 tentpole).
+
+The lexical tier (rules/) sees one file at a time; the contracts it
+cannot see are exactly the framework's *implicit registries* — cross-file
+name sets that must stay in lockstep:
+
+* ``robust/faults.SITES`` ↔ the ``fault_point()`` guards, ladder routes,
+  and fuzz/ci-chaos exercise that make a declared site real;
+* ``record_decision(..., outcome=True)`` sites ↔ the ``resolve()`` joins
+  that keep the decision–outcome economy honest;
+* the ``cost/`` facade's ``AUTHORITIES`` ↔ the state-lifecycle protocol,
+  the facade's own doc table, and the docs surface;
+* ``observe/registry.py``'s ``rb_tpu_*`` name constants ↔ their
+  registrations and consumers;
+* ``observe/health.py``'s ``DEFAULT_RULES`` ↔ its committed docstring
+  threshold table;
+* ``RB_TPU_*`` env knobs ↔ the KNOBS.md table;
+* ``donate_argnums`` jits ↔ every caller's use of the consumed buffer.
+
+This module parses the package tree ONCE (reusing FileContext, so
+pragmas/guards ride along), extracts each registry with narrow AST
+walks, and hands the result to every ProjectChecker. A module-level
+mtime-keyed cache makes repeated builds (the CLI, tests, ci.sh --fast
+--diff runs) free; the cache is thread-safe (tests hammer it).
+
+Pure stdlib, like the rest of analysis/ — building a ProjectContext
+never imports the framework.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import FileContext, dotted_name, terminal_name
+
+# package-relative anchor files for the registry extractors; a rename
+# shows up as an extraction failure (empty registry), which the contract
+# rules report loudly rather than passing vacuously
+FAULTS_MODULE = os.path.join("robust", "faults.py")
+FACADE_MODULE = os.path.join("cost", "facade.py")
+REGISTRY_MODULE = os.path.join("observe", "registry.py")
+HEALTH_MODULE = os.path.join("observe", "health.py")
+
+# calls that read an env knob: os.environ.get / os.getenv / environ[...]
+# plus the tree's typed wrappers (_env_int / _env_float / ...)
+_ENV_CALL_TERMINALS = {"get", "getenv", "pop", "setdefault"}
+
+# the Authority protocol (cost/facade.py): a facade-registered authority
+# must define every method itself — the base raises, so an inherited slot
+# means save_state()/load_state() (the RB_TPU_COST_STATE lifecycle) or a
+# sentinel-actuated refit would blow up at runtime on that authority
+AUTHORITY_PROTOCOL = (
+    "curves", "provenance", "refit_from_outcomes", "state", "load_state",
+    "reset",
+)
+
+
+class DonationSite:
+    """One call to a donating jit: the argument expressions sitting in
+    donated positions, resolved by the caller-side rule."""
+
+    __slots__ = ("path", "line", "func", "donated_args")
+
+    def __init__(self, path: str, line: int, func: str, donated_args):
+        self.path = path
+        self.line = line
+        self.func = func
+        self.donated_args = donated_args
+
+
+class DecisionSite:
+    """One ``record_decision(...)`` call: its site literal (None when
+    dynamic), whether it asked for an outcome join, and the AST call."""
+
+    __slots__ = ("path", "line", "site", "outcome", "call")
+
+    def __init__(self, path: str, line: int, site: Optional[str],
+                 outcome: Optional[bool], call: ast.Call):
+        self.path = path
+        self.line = line
+        self.site = site
+        self.outcome = outcome  # None == non-constant expression
+        self.call = call
+
+
+class AuthorityInfo:
+    __slots__ = ("name", "class_name", "line", "methods", "registered")
+
+    def __init__(self, name: str, class_name: str, line: int,
+                 methods: Set[str], registered: bool):
+        self.name = name
+        self.class_name = class_name
+        self.line = line
+        self.methods = methods
+        self.registered = registered
+
+
+class ProjectContext:
+    """Parsed whole-program view: every package file's FileContext plus
+    the extracted implicit registries. Build once per tree state (see
+    :func:`get_project`); all fields are read-only after construction."""
+
+    def __init__(self, root: str, package: str = "roaringbitmap_tpu"):
+        self.root = os.path.abspath(root)
+        self.package = package
+        self.files: Dict[str, FileContext] = {}
+        self.parse_errors: List[str] = []
+        self._text_cache: Dict[str, str] = {}
+        self._text_lock = threading.Lock()
+        self._parse_tree()
+
+        # -- registries (each a narrow walk over the parsed files) --
+        self.fault_sites: Dict[str, int] = {}
+        self._extract_fault_sites()
+        self.fault_guards: Dict[str, List[Tuple[str, int]]] = {}
+        self.ladder_routes: Dict[str, List[Tuple[str, int]]] = {}
+        self.decision_sites: List[DecisionSite] = []
+        self.knobs: Dict[str, List[Tuple[str, int]]] = {}
+        self.donating: Dict[str, Tuple[int, ...]] = {}
+        self.metric_constants: Dict[str, Tuple[str, int]] = {}
+        self.metric_registrations: List[Tuple[str, int, str, Optional[str],
+                                              Optional[Tuple[str, ...]]]] = []
+        self.metric_const_uses: Dict[str, Set[str]] = {}
+        # the constant table must exist before the use-collecting walk —
+        # uses are only recorded for known constant names
+        registry_ctx = self.file("observe", "registry.py")
+        if registry_ctx is not None:
+            self._extract_metric_constants(registry_ctx)
+        self._walk_files()
+        self.authorities: List[AuthorityInfo] = []
+        self._extract_authorities()
+        self.sentinel_rules: Dict[str, int] = {}
+        self.sentinel_doc_rules: Dict[str, int] = {}
+        self._extract_sentinel_rules()
+
+    # ------------------------------------------------------------------
+    # parsing
+    # ------------------------------------------------------------------
+
+    def _parse_tree(self) -> None:
+        pkg_dir = os.path.join(self.root, self.package)
+        for dirpath, dirnames, filenames in os.walk(pkg_dir):
+            dirnames[:] = [
+                d for d in dirnames if d not in ("__pycache__", ".git")
+            ]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, self.root)
+                try:
+                    with open(path, "r", encoding="utf-8") as f:
+                        source = f.read()
+                    self.files[rel] = FileContext(path, source, relpath=rel)
+                except (OSError, SyntaxError, ValueError) as e:
+                    self.parse_errors.append(f"{rel}: {e}")
+
+    def pkg_path(self, *parts: str) -> str:
+        """Root-relative path of a package file (the files-dict key)."""
+        return os.path.join(self.package, *parts)
+
+    def file(self, *parts: str) -> Optional[FileContext]:
+        return self.files.get(self.pkg_path(*parts))
+
+    def text(self, relpath: str) -> str:
+        """Raw text of any repo file (docs, scripts, tests) — the
+        extractors' non-Python drift surfaces. Missing file -> ''. Cached
+        per ProjectContext build (thread-safe: rules may run parallel)."""
+        with self._text_lock:
+            if relpath in self._text_cache:
+                return self._text_cache[relpath]
+        try:
+            with open(os.path.join(self.root, relpath), encoding="utf-8") as f:
+                content = f.read()
+        except OSError:
+            content = ""
+        with self._text_lock:
+            self._text_cache[relpath] = content
+        return content
+
+    def exercise_text(self) -> str:
+        """The fault-exercise surface: the fuzz harness + the tests tree +
+        ci.sh (the ci-chaos gate arms every site via RB_TPU_FAULTS)."""
+        parts = [
+            self.text(self.pkg_path("fuzz.py")),
+            self.text(os.path.join("scripts", "ci.sh")),
+        ]
+        tests_dir = os.path.join(self.root, "tests")
+        if os.path.isdir(tests_dir):
+            for fn in sorted(os.listdir(tests_dir)):
+                if fn.endswith(".py"):
+                    parts.append(self.text(os.path.join("tests", fn)))
+        return "\n".join(parts)
+
+    # ------------------------------------------------------------------
+    # extractors
+    # ------------------------------------------------------------------
+
+    def _extract_fault_sites(self) -> None:
+        """``SITES: Tuple[str, ...] = ("store.ship", ...)`` in
+        robust/faults.py — each element's own line is the anchor every
+        per-site contract finding (and waiver pragma) attaches to."""
+        ctx = self.file("robust", "faults.py")
+        if ctx is None:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            names = [t.id for t in targets if isinstance(t, ast.Name)]
+            if "SITES" not in names or node.value is None:
+                continue
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(
+                        elt.value, str
+                    ):
+                        self.fault_sites[elt.value] = elt.lineno
+            return
+
+    def _walk_files(self) -> None:
+        """One pass over every file's AST collecting the call-shaped
+        registries: fault guards, ladder routes, decision sites, env-knob
+        reads, donate-decorated jits, and metric constant uses."""
+        for rel, ctx in self.files.items():
+            in_registry = rel == self.pkg_path("observe", "registry.py")
+            for node in ast.walk(ctx.tree):
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    donated = _donate_argnums(node)
+                    if donated is not None:
+                        self.donating[node.name] = donated
+                if isinstance(node, (ast.Attribute, ast.Name)):
+                    t = terminal_name(node)
+                    if t and t in self.metric_const_uses:
+                        self.metric_const_uses[t].add(rel)
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                t = terminal_name(func)
+                if t == "fault_point":
+                    site = _str_arg(node, 0)
+                    if site is not None:
+                        self.fault_guards.setdefault(site, []).append(
+                            (rel, node.lineno)
+                        )
+                elif t in ("run", "note_degrade", "retry"):
+                    # LADDER.run(site, ...) / LADDER.note_degrade(site, ...)
+                    # / ladder.retry(site, ...): the degradation routes
+                    recv = _receiver_terminal(func)
+                    if recv in ("LADDER", "ladder", "_ladder") or (
+                        t == "retry" and recv in ("ladder", "_ladder", None)
+                    ):
+                        site = _str_arg(node, 0)
+                        if site is not None:
+                            self.ladder_routes.setdefault(site, []).append(
+                                (rel, node.lineno)
+                            )
+                elif t == "record_decision":
+                    site = _str_arg(node, 0)
+                    outcome: Optional[bool] = False
+                    for kw in node.keywords:
+                        if kw.arg == "outcome":
+                            if isinstance(kw.value, ast.Constant):
+                                outcome = bool(kw.value.value)
+                            else:
+                                outcome = None  # dynamic
+                    self.decision_sites.append(
+                        DecisionSite(rel, node.lineno, site, outcome, node)
+                    )
+                # env knob reads: os.environ.get("RB_TPU_X"),
+                # os.getenv("RB_TPU_X"), _env_int("RB_TPU_X", ...), and
+                # os.environ["RB_TPU_X"] is handled via Subscript below
+                dn = dotted_name(func) or ""
+                is_env_call = (
+                    ("environ" in dn and t in _ENV_CALL_TERMINALS)
+                    or t == "getenv"
+                    or (t or "").startswith("_env")
+                )
+                if is_env_call:
+                    for arg in node.args:
+                        knob = _rb_knob(arg)
+                        if knob is not None:
+                            self.knobs.setdefault(knob, []).append(
+                                (rel, arg.lineno)
+                            )
+                # metric registrations: counter/gauge/histogram(name, ...)
+                if t in ("counter", "gauge", "histogram") and node.args:
+                    self._record_registration(rel, node, in_registry)
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.Subscript) and "environ" in (
+                    dotted_name(node.value) or ""
+                ):
+                    knob = _rb_knob(node.slice)
+                    if knob is not None:
+                        self.knobs.setdefault(knob, []).append(
+                            (rel, node.lineno)
+                        )
+
+    def _extract_metric_constants(self, ctx: FileContext) -> None:
+        for node in ast.iter_child_nodes(ctx.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not (
+                isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+                and node.value.value.startswith("rb_tpu_")
+            ):
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id.isupper():
+                    self.metric_constants[t.id] = (
+                        node.value.value, node.lineno
+                    )
+                    self.metric_const_uses.setdefault(t.id, set())
+
+    def _record_registration(
+        self, rel: str, node: ast.Call, in_registry: bool
+    ) -> None:
+        """(path, line, kind, name, labels): kind is 'const' (first arg is
+        a Name/Attribute — resolved against the constant table when it
+        matches), 'literal' (an inline rb_tpu_ string), or 'dynamic'."""
+        first = node.args[0]
+        labels: Optional[Tuple[str, ...]] = None
+        label_arg = node.args[2] if len(node.args) > 2 else None
+        for kw in node.keywords:
+            if kw.arg in ("labelnames", "labels"):
+                label_arg = kw.value
+        if isinstance(label_arg, (ast.Tuple, ast.List)):
+            if all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in label_arg.elts
+            ):
+                labels = tuple(e.value for e in label_arg.elts)
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            if first.value.startswith("rb_tpu_") and not in_registry:
+                self.metric_registrations.append(
+                    (rel, node.lineno, "literal", first.value, labels)
+                )
+        elif isinstance(first, (ast.Name, ast.Attribute)):
+            const = terminal_name(first)
+            self.metric_registrations.append(
+                (rel, node.lineno, "const", const, labels)
+            )
+
+    def _extract_authorities(self) -> None:
+        """cost/facade.py: every ``class XAuthority(Authority)`` with its
+        ``name`` class attr and defined protocol methods, plus whether it
+        is instantiated inside the ``AUTHORITIES`` dict literal."""
+        ctx = self.file("cost", "facade.py")
+        if ctx is None:
+            return
+        registered_classes: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                if any(
+                    isinstance(t, ast.Name) and t.id == "AUTHORITIES"
+                    for t in targets
+                ) and node.value is not None:
+                    for call in ast.walk(node.value):
+                        if isinstance(call, ast.Call) and isinstance(
+                            call.func, ast.Name
+                        ):
+                            registered_classes.add(call.func.id)
+        for node in ast.iter_child_nodes(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            bases = {terminal_name(b) for b in node.bases}
+            if "Authority" not in bases:
+                continue
+            name = None
+            name_line = node.lineno
+            methods: Set[str] = set()
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    methods.add(item.name)
+                elif isinstance(item, (ast.Assign, ast.AnnAssign)):
+                    targets = (
+                        item.targets
+                        if isinstance(item, ast.Assign)
+                        else [item.target]
+                    )
+                    if any(
+                        isinstance(t, ast.Name) and t.id == "name"
+                        for t in targets
+                    ) and isinstance(item.value, ast.Constant):
+                        name = item.value.value
+                        name_line = item.lineno
+            if name:
+                self.authorities.append(
+                    AuthorityInfo(
+                        name, node.name, name_line, methods,
+                        node.name in registered_classes,
+                    )
+                )
+
+    def _extract_sentinel_rules(self) -> None:
+        """observe/health.py: the ``DEFAULT_RULES`` tuple's ``Rule(...)``
+        names, and the committed docstring threshold table's row names —
+        the two must agree (sentinel-table-drift)."""
+        ctx = self.file("observe", "health.py")
+        if ctx is None:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            if not any(
+                isinstance(t, ast.Name) and t.id == "DEFAULT_RULES"
+                for t in targets
+            ):
+                continue
+            if node.value is None:
+                continue
+            for call in ast.walk(node.value):
+                if not (
+                    isinstance(call, ast.Call)
+                    and terminal_name(call.func) == "Rule"
+                ):
+                    continue
+                name = _str_arg(call, 0)
+                if name is None:
+                    for kw in call.keywords:
+                        if kw.arg == "name" and isinstance(
+                            kw.value, ast.Constant
+                        ):
+                            name = kw.value.value
+                if name:
+                    self.sentinel_rules[name] = call.lineno
+        doc = ast.get_docstring(ctx.tree, clean=False) or ""
+        for off, line in enumerate(doc.splitlines()):
+            stripped = line.strip()
+            # a table row: a rule-shaped name followed by >=2 spaces of
+            # description ("costmodel-drift       geomean ...")
+            parts = stripped.split()
+            if (
+                len(parts) >= 2
+                and "  " in stripped
+                and _rule_shaped(parts[0])
+            ):
+                # +2: docstring body starts on the line after the opener
+                self.sentinel_doc_rules.setdefault(parts[0], off + 2)
+
+
+def _rule_shaped(word: str) -> bool:
+    return (
+        "-" in word
+        and word.replace("-", "").isalnum()
+        and word == word.lower()
+        and not word.startswith("rb")
+    )
+
+
+def _str_arg(call: ast.Call, idx: int) -> Optional[str]:
+    if len(call.args) > idx and isinstance(call.args[idx], ast.Constant):
+        v = call.args[idx].value
+        if isinstance(v, str):
+            return v
+    return None
+
+
+def _receiver_terminal(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return terminal_name(func.value)
+    return None
+
+
+def _rb_knob(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        v = node.value
+        if v.startswith("RB_TPU_") and v.replace("_", "").isalnum():
+            return v
+    return None
+
+
+def _donate_argnums(fn: ast.AST) -> Optional[Tuple[int, ...]]:
+    """Donated positional indices from a ``@functools.partial(jax.jit,
+    donate_argnums=(0,))`` / ``@jax.jit(..., donate_argnums=...)``
+    decorator, else None."""
+    for dec in getattr(fn, "decorator_list", ()):
+        if not isinstance(dec, ast.Call):
+            continue
+        for kw in dec.keywords:
+            if kw.arg != "donate_argnums":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                idxs = tuple(
+                    e.value
+                    for e in v.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)
+                )
+                if idxs:
+                    return idxs
+    return None
+
+
+# ---------------------------------------------------------------------------
+# build cache: (root, package) -> (stamp, ProjectContext)
+# ---------------------------------------------------------------------------
+
+_CACHE_LOCK = threading.Lock()
+_CACHE: Dict[Tuple[str, str], Tuple[Tuple, ProjectContext]] = {}
+
+
+def _tree_stamp(root: str, package: str) -> Tuple:
+    """(path, mtime_ns, size) for every package .py file — cheap enough
+    to recompute per call, and any edit (or add/remove) changes it."""
+    out = []
+    pkg_dir = os.path.join(root, package)
+    for dirpath, dirnames, filenames in os.walk(pkg_dir):
+        dirnames[:] = [d for d in dirnames if d not in ("__pycache__", ".git")]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                p = os.path.join(dirpath, fn)
+                try:
+                    st = os.stat(p)
+                    out.append((p, st.st_mtime_ns, st.st_size))
+                except OSError:
+                    out.append((p, -1, -1))
+    return tuple(out)
+
+
+def get_project(root: str, package: str = "roaringbitmap_tpu") -> ProjectContext:
+    """Cached ProjectContext for the tree rooted at ``root``: reused while
+    no package file's (mtime, size) changes, rebuilt otherwise. Safe to
+    call from concurrent threads — a stale double-build races benignly
+    (last writer wins; both are equivalent)."""
+    root = os.path.abspath(root)
+    key = (root, package)
+    stamp = _tree_stamp(root, package)
+    with _CACHE_LOCK:
+        hit = _CACHE.get(key)
+        if hit is not None and hit[0] == stamp:
+            return hit[1]
+    project = ProjectContext(root, package=package)
+    with _CACHE_LOCK:
+        _CACHE[key] = (stamp, project)
+    return project
+
+
+def invalidate_cache() -> None:
+    with _CACHE_LOCK:
+        _CACHE.clear()

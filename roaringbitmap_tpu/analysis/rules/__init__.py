@@ -1,9 +1,19 @@
-"""The five project-native rules. Importing this package registers every
-checker in ``core.CHECKERS``; add a module here (with ``@register``) to
-grow the rule set."""
+"""The project-native rules, two tiers. Importing this package registers
+every checker: lexical rules in ``core.CHECKERS`` (one file at a time),
+contract/dataflow rules in ``core.CONTRACT_CHECKERS`` (whole-program,
+over a ProjectContext — ISSUE 18). Add a module here (with ``@register``
+or ``@register_contract``) to grow either set."""
 
+# lexical tier (per-file)
 from . import dtype  # noqa: F401
 from . import exceptions  # noqa: F401
 from . import locks  # noqa: F401
 from . import metrics  # noqa: F401
 from . import trace_safety  # noqa: F401
+
+# contract tier (whole-program, ISSUE 18)
+from . import decision_contract  # noqa: F401
+from . import donation  # noqa: F401
+from . import epochpin  # noqa: F401
+from . import fault_contract  # noqa: F401
+from . import registry_contracts  # noqa: F401

@@ -1,0 +1,129 @@
+"""use-after-donation: a buffer passed to a ``donate_argnums`` jit is
+dead after the call — reading the variable again without re-binding is
+the PR 8 aliasing bug the runtime only catches with a deleted-buffer
+error, and only when the race actually lands (ISSUE 18 dataflow tier).
+
+The ProjectContext maps every ``@functools.partial(jax.jit,
+donate_argnums=(...))``-decorated function to its donated positions
+(today: ``scatter_rows_donated`` donates arg 0). This rule then runs a
+CFG-based forward may-analysis per function, in every file:
+
+* a call to a donating function puts the ``ast.Name`` argument sitting
+  in a donated position into the *may-donated* state after that
+  statement;
+* any re-binding of the name (assignment, for-target, with-as) kills the
+  state — ``d = scatter_rows_donated(d, ...)`` is the blessed idiom;
+* a Name load while may-donated → finding. The loop back edge is what
+  catches the subtle case: a donation late in a loop body reaches the
+  body's top on the next iteration unless the loop re-binds first.
+
+Scope is the function's own statements; a nested closure is analyzed as
+its own function (free-variable flows across closures are out of scope —
+the ``_gather_guard`` epoch machinery handles that dynamic race).
+Intentional metadata reads of a consumed buffer (``d.is_deleted()``)
+carry ``# rb-ok: use-after-donation`` with the reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from .. import cfg as _cfg
+from ..core import Finding, ProjectChecker, register_contract
+from ..project import ProjectContext
+
+
+def _donated_names(
+    stmt: ast.stmt, donating: Dict[str, Tuple[int, ...]]
+) -> Set[str]:
+    """Names donated by calls evaluated at this CFG node."""
+    out: Set[str] = set()
+    for root in _cfg.header_expr_nodes(stmt):
+        for node in ast.walk(root):
+            if not isinstance(node, ast.Call):
+                continue
+            t = _terminal(node.func)
+            positions = donating.get(t or "")
+            if not positions:
+                continue
+            for pos in positions:
+                if pos < len(node.args) and isinstance(
+                    node.args[pos], ast.Name
+                ):
+                    out.add(node.args[pos].id)
+    return out
+
+
+def _terminal(node: ast.AST):
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+@register_contract
+class UseAfterDonation(ProjectChecker):
+    rule_id = "use-after-donation"
+    description = (
+        "a variable passed in a donate_argnums position is dead after "
+        "the call until re-bound"
+    )
+    severity = "error"
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        donating = project.donating
+        if not donating:
+            return
+        for rel, ctx in sorted(project.files.items()):
+            # cheap pre-filter: no donating callee name in the source text
+            if not any(name in ctx.source for name in donating):
+                continue
+            for fn in _cfg.functions(ctx.tree):
+                yield from self._check_function(project, rel, fn, donating)
+
+    def _check_function(
+        self,
+        project: ProjectContext,
+        rel: str,
+        fn: ast.AST,
+        donating: Dict[str, Tuple[int, ...]],
+    ) -> Iterable[Finding]:
+        graph = _cfg.CFG(fn)
+        if not any(_donated_names(s, donating) for s in graph.stmts):
+            return
+        # transfer: OUT = (IN - KILL) ∪ (GEN - KILL). Subtracting the
+        # kill from the gen makes the blessed idiom
+        # `d = scatter_rows_donated(d, ...)` leave d NOT donated (the
+        # name is re-bound to the fresh result in the same statement),
+        # while `x = scatter_rows_donated(d, ...)` leaves d donated.
+        ins = _cfg.may_reach(
+            graph,
+            gen=lambda s: _donated_names(s, donating) - _cfg.bound_names(s),
+            kill=_cfg.bound_names,
+        )
+        flagged: Set[Tuple[str, int]] = set()
+        for i, stmt in enumerate(graph.stmts):
+            state = ins[i]
+            if not state:
+                continue
+            # IN is the state *before* the statement evaluates, and reads
+            # evaluate before any re-binding — so every load of a
+            # may-donated name is a use-after, re-binding or not
+            for load in _cfg.name_loads(stmt):
+                name = load.id
+                if name in state:
+                    key = (name, load.lineno)
+                    if key in flagged:
+                        continue
+                    flagged.add(key)
+                    yield self.finding(
+                        project, rel, load.lineno,
+                        f"`{name}` was donated to a donate_argnums jit on "
+                        "a path reaching this read and never re-bound — "
+                        "the buffer is consumed; reading it raises a "
+                        "deleted-buffer error at runtime",
+                        col=load.col_offset,
+                        end_line=load.lineno,
+                    )

@@ -30,6 +30,17 @@ Reads are deliberately out of scope: the codebase uses double-checked
 locking (native/__init__.py) and lock-free snapshots-by-copy, which a read
 check would flag wholesale. Aliasing (``st = self._series[k]; st[...] = v``)
 is also out of scope — keep mutations syntactically on the guarded name.
+
+**May-hold propagation (ISSUE 18 upgrade).** A helper that writes guarded
+state is legal when *every* intra-module call site holds the lock — the
+classic locked-region-helper pattern that previously needed a pragma.
+The rule now computes, per function, the greatest-fixpoint intersection
+of the lock sets held at its call sites (``entry ⊇ ∩ site-locks ∪
+caller-entry``), and a write passes when the lock is held lexically OR
+at every entry. The propagation is sound in the removing direction only:
+a function whose reference escapes as a value (callback, decorator,
+multiple same-named defs) gets the empty entry set, so it behaves
+exactly like the lexical rule.
 """
 
 from __future__ import annotations
@@ -194,6 +205,88 @@ def _scope_info(fn: ast.AST) -> Tuple[Set[str], Set[str]]:
     return global_decls, rebinds - global_decls
 
 
+def _may_hold_entries(tree: ast.AST, universe: Set[str]) -> Dict[int, Set[str]]:
+    """id(function node) -> locks held at EVERY intra-module call site
+    (greatest-fixpoint intersection). Functions that escape as values
+    (callbacks, decorators), share a name with another def, or have no
+    visible call site get ∅ — the propagation only ever removes findings
+    relative to the lexical rule.
+
+    A call site counts when it is a bare ``helper(...)`` or a
+    ``self._helper(...)`` / ``cls._helper(...)`` method call — the
+    intra-module shapes. Anything else (``module.fn(...)``) may target a
+    different module's name and is ignored."""
+    defs: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+    # unique, undecorated defs are propagation candidates
+    candidates = {
+        name: nodes[0]
+        for name, nodes in defs.items()
+        if len(nodes) == 1 and not nodes[0].decorator_list
+    }
+    escaped: Set[str] = set()
+    # (callee name) -> [(caller node or None, lexical locks at site)]
+    sites: Dict[str, List[Tuple[Optional[ast.AST], Tuple[str, ...]]]] = {}
+    call_funcs = set()
+    for node, locks, funcs in ParentedVisit(tree):
+        if isinstance(node, ast.Call):
+            call_funcs.add(id(node.func))
+            name = None
+            if isinstance(node.func, ast.Name):
+                name = node.func.id
+            elif isinstance(node.func, ast.Attribute) and isinstance(
+                node.func.value, ast.Name
+            ) and node.func.value.id in ("self", "cls"):
+                name = node.func.attr
+            if name in candidates:
+                sites.setdefault(name, []).append(
+                    (funcs[-1] if funcs else None, locks)
+                )
+    for node, _locks, _funcs in ParentedVisit(tree):
+        # a bare reference to a candidate outside call position = escape
+        if (
+            isinstance(node, ast.Name)
+            and isinstance(node.ctx, ast.Load)
+            and node.id in candidates
+            and id(node) not in call_funcs
+        ):
+            escaped.add(node.id)
+        elif (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.ctx, ast.Load)
+            and node.attr in candidates
+            and id(node) not in call_funcs
+            and isinstance(node.value, ast.Name)
+            and node.value.id in ("self", "cls")
+        ):
+            escaped.add(node.attr)
+    entry: Dict[int, Set[str]] = {}
+    for name, fn in candidates.items():
+        if name in escaped or name not in sites:
+            entry[id(fn)] = set()
+        else:
+            entry[id(fn)] = set(universe)
+    changed = True
+    while changed:
+        changed = False
+        for name, fn in candidates.items():
+            if name in escaped or name not in sites:
+                continue
+            acc: Optional[Set[str]] = None
+            for caller, locks in sites[name]:
+                held = set(locks)
+                if caller is not None:
+                    held |= entry.get(id(caller), set())
+                acc = held if acc is None else (acc & held)
+            acc = acc or set()
+            if acc != entry[id(fn)]:
+                entry[id(fn)] = acc
+                changed = True
+    return entry
+
+
 @register
 class LockDiscipline(Checker):
     rule_id = "lock-discipline"
@@ -214,6 +307,8 @@ class LockDiscipline(Checker):
         if not guarded:
             return
 
+        universe = {lock for lock, _decl in guarded.values()}
+        entry_holds = _may_hold_entries(ctx.tree, universe)
         decl_cache: Dict[int, Tuple[Set[str], Set[str]]] = {}
         for node, locks, funcs in ParentedVisit(ctx.tree):
             if not funcs:
@@ -243,6 +338,10 @@ class LockDiscipline(Checker):
                 if kind == "attr" and in_init:
                     continue  # construction happens-before publication
                 if lock in locks:
+                    continue
+                # may-hold propagation: every intra-module call site of
+                # the enclosing helper holds the lock
+                if lock in entry_holds.get(id(funcs[-1]), ()):
                     continue
                 yield self.finding(
                     ctx,

@@ -841,14 +841,14 @@ def verify_compaction_invariance(
                 for muts in write_muts:
                     try:
                         b = es.submit("fz-writer", muts)
-                    except Exception:
-                        b = None  # rb-ok: exception-hygiene -- an injected fault at submit leaves the batch unsubmitted; the twin replays only PUBLISHED lineage, so a lost batch stays consistent
+                    except Exception:  # rb-ok: exception-hygiene -- an injected fault at submit leaves the batch unsubmitted; the twin replays only PUBLISHED lineage, so a lost batch stays consistent
+                        b = None
                     if b is not None:
                         submitted[b.batch_id] = b
                     try:
                         es.flip(reason="fuzz")
-                    except Exception:
-                        pass  # rb-ok: exception-hygiene -- an aborted flip (injected epoch.flip fault) keeps the old epoch; the lineage replay below only sees published flips
+                    except Exception:  # rb-ok: exception-hygiene -- an aborted flip (injected epoch.flip fault) keeps the old epoch; the lineage replay below only sees published flips
+                        pass
                     rec = smaintain.run_pass(
                         store=es, reason="fuzz", force=True,
                     )

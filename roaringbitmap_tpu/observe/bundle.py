@@ -139,6 +139,10 @@ def _collect_sections(health_dump: Optional[dict]) -> Dict[str, str]:
             # stale the log is, and the lineage that led here — the
             # freshness-lag-breach / epoch-flip-stall episodes' context
             "epochs": _insights.epochs(),
+            # structure panel (ISSUE 16): format census + drift ratio +
+            # maintenance-pass state — the structure-drift /
+            # delta-accretion episodes' context
+            "structure": _insights.structure(),
             # durable panel (ISSUE 17): which frozen epoch (if any) a
             # restart would recover to, plus torn-skip provenance — the
             # epoch-persist-stall / recovery-manifest-torn episodes'

@@ -425,6 +425,25 @@ def _durable_block(snap: dict) -> dict:
     }
 
 
+def _analysis_block(snap: dict) -> dict:
+    """The static-analysis sidecar block (ISSUE 18), derived PURELY from
+    the registry like every block here: per-rule finding counts from the
+    two analyzer tiers — ``rb_tpu_analysis_findings_total{rule}`` (the
+    lexical per-file rules) and
+    ``rb_tpu_analysis_contract_findings_total{rule}`` (the whole-program
+    contract tier). scripts/analyze.py materializes a zero series for
+    every rule it ran, so an empty map means "analyzer never ran in this
+    process" while an explicit ``{rule: 0}`` means "ran and found
+    nothing" — rb_top's analysis panel leans on that distinction."""
+    lexical = _counter_map(snap, _registry.ANALYSIS_FINDINGS_TOTAL)
+    contracts = _counter_map(snap, _registry.ANALYSIS_CONTRACT_FINDINGS_TOTAL)
+    return {
+        "lexical": lexical,
+        "contracts": contracts,
+        "total": int(sum(lexical.values()) + sum(contracts.values())),
+    }
+
+
 def _health_block(snap: dict) -> dict:
     """The health sentinel's sidecar block (ISSUE 12), derived PURELY
     from the registry gauges (like the regret block) so a ``--from``
@@ -496,6 +515,9 @@ def sidecar_snapshot(registry: Optional[Registry] = None) -> dict:
         # durable epochs (ISSUE 17): persisted vs serving epoch, artifact
         # bytes, persist outcome/stage volume, recovery + demotion volume
         "durable": _durable_block(snap),
+        # static analysis (ISSUE 18): per-rule finding counts from the
+        # lexical and whole-program contract tiers of scripts/analyze.py
+        "analysis": _analysis_block(snap),
         "registry": snap,
     }
 

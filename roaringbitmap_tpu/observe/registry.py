@@ -59,6 +59,7 @@ SPAN_SECONDS = "rb_tpu_span_seconds"
 QUERY_CACHE_TOTAL = "rb_tpu_query_cache_total"
 QUERY_PLAN_TOTAL = "rb_tpu_query_plan_total"
 ANALYSIS_FINDINGS_TOTAL = "rb_tpu_analysis_findings_total"
+ANALYSIS_CONTRACT_FINDINGS_TOTAL = "rb_tpu_analysis_contract_findings_total"
 # timeline / latency instrumentation (ISSUE 6): the flight recorder's span
 # feed plus the per-stage latency histograms over the marshal pipeline
 TIMELINE_SPAN_SECONDS = "rb_tpu_timeline_span_seconds"

@@ -1846,7 +1846,7 @@ class PackCache:
             if e is not None and e.kind == "agg":
                 ident = ("agg", e.key[1], tuple(_fp_ident(fp) for fp in e.fps))
                 if self._ident.get(ident) == key:
-                    del self._ident[ident]  # rb-ok: lock-discipline -- inside the with self._lock block above
+                    del self._ident[ident]
             self._drop(key)
 
     def close(self) -> None:
@@ -2016,10 +2016,10 @@ class PackCache:
 
     def _drop(self, key: tuple) -> None:
         # caller holds self._lock (private helper of the locked regions)
-        e = self._entries.pop(key, None)  # rb-ok: lock-discipline -- caller holds self._lock; helper of _store's locked region only
+        e = self._entries.pop(key, None)
         if e is None:
             return
-        self._bytes -= e.nbytes  # rb-ok: lock-discipline -- caller holds self._lock
+        self._bytes -= e.nbytes
         self._release(e)
 
     def _release(self, e: _PackEntry) -> None:
@@ -2058,9 +2058,9 @@ class PackCache:
             if e.pins:
                 continue
             unpinned -= 1
-            del self._entries[key]  # rb-ok: lock-discipline -- caller holds self._lock; helper of the locked store/configure/unpin regions only
-            self._bytes -= e.nbytes  # rb-ok: lock-discipline -- caller holds self._lock
-            self.evictions += 1  # rb-ok: lock-discipline -- caller holds self._lock
+            del self._entries[key]
+            self._bytes -= e.nbytes
+            self.evictions += 1
             _PACK_EVICTED_BYTES.inc(e.nbytes, (e.kind,))
             # ISSUE 17: with a durable epoch artifact on disk the evicted
             # bytes demote to the mapped rung (re-admittable from the
@@ -2108,14 +2108,14 @@ class PackCache:
             ident = ("agg", e.key[1], tuple(_fp_ident(fp) for fp in e.fps)) \
                 if e.kind == "agg" else None
             if ident is not None and self._ident.get(ident) == key:
-                del self._ident[ident]  # rb-ok: lock-discipline -- caller holds self._lock
+                del self._ident[ident]
             if seq is not None:
                 # remember the eviction by its identity (agg: the gen
                 # tuple, so a delta-mutated return still matches) for the
                 # miss-side regret join
-                self._evicted_seqs[ident if ident is not None else key] = seq  # rb-ok: lock-discipline -- caller holds self._lock
+                self._evicted_seqs[ident if ident is not None else key] = seq
                 while len(self._evicted_seqs) > self._EVICTED_SEQS_CAP:
-                    self._evicted_seqs.popitem(last=False)  # rb-ok: lock-discipline -- caller holds self._lock
+                    self._evicted_seqs.popitem(last=False)
             self._release(e)
 
     def _try_delta(self, e, bitmaps, keys_filter, new_fps):

@@ -49,9 +49,9 @@ from .errors import ResourceExhausted, TransientDeviceError, simulated_oom
 # a typo'd site would silently never fire.
 SITES: Tuple[str, ...] = (
     "store.ship",        # host->HBM transfer of packed rows (store.py)
-    "store.hbm",         # HBM allocation during the ship (OOM simulation)
+    "store.hbm",         # HBM allocation during the ship (OOM simulation)  # rb-ok: fault-site-contract -- no route of its own: an HBM fault surfaces inside the ship transfer, so it rides store.ship's re-ship/degrade ladder route
     "store.expand",      # device-side payload expansion + overlap lane (ISSUE 8)
-    "ops.dispatch",      # device reduce dispatch (store run closures, ops/)
+    "ops.dispatch",      # device reduce dispatch (store run closures, ops/)  # rb-ok: fault-site-contract -- no route of its own: dispatch faults propagate into the aggregation run and ride the "agg" ladder site's degrade/retry route
     "query.exec",        # query executor device-engine step dispatch
     "query.fusion",      # fused micro-batch execution (query/fusion.py)
     "serve.admit",       # serving-tier admission verdict (serve/admission.py)

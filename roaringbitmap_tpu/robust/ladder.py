@@ -175,7 +175,7 @@ class Ladder:
         # caller holds self._lock (private helper of the locked regions)
         b = self._breakers.get((site, tier))
         if b is None:
-            b = self._breakers[(site, tier)] = Breaker(  # rb-ok: lock-discipline -- caller holds self._lock; helper of run/record_* locked regions only
+            b = self._breakers[(site, tier)] = Breaker(
                 self.trip_after, self.cooldown_s
             )
         return b

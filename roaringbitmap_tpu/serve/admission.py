@@ -211,7 +211,7 @@ class AdmissionController:
         # break the leaf claim the witness hammer pins
         b = self._buckets.get(tenant)
         if b is None:
-            b = self._buckets[tenant] = _Bucket(quota["quota_qps"], quota["burst"], now)  # rb-ok: lock-discipline -- caller holds self._cond; helper of admit()'s locked verdict region only
+            b = self._buckets[tenant] = _Bucket(quota["quota_qps"], quota["burst"], now)
         elif b.rate != quota["quota_qps"] or b.burst != quota["burst"]:
             # the registry documents declare() as idempotent-with-update:
             # a live quota change must reach the cached bucket, or the

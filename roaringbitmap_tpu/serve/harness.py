@@ -423,7 +423,7 @@ class LoadHarness:
                 "run_serial replays read-only schedules; use "
                 "run_serial_epochs for a read-write schedule"
             )
-        return [_exec.execute(r.expr, cache=None) for r in requests]
+        return [_exec.execute(r.expr, cache=None) for r in requests]  # rb-ok: epoch-pin -- serial oracle: replays a read-only schedule against a quiesced corpus with no concurrent flips, so there is no epoch to pin
 
     @staticmethod
     def run_serial_epochs(
@@ -474,7 +474,7 @@ class LoadHarness:
         ]
         for rec in lineage + [None]:  # None = the final (current) epoch
             for pos in by_epoch.get(epoch, ()):
-                results[pos] = _exec.execute(
+                results[pos] = _exec.execute(  # rb-ok: epoch-pin -- serial oracle: single-threaded lineage replay on a clone store; flips are applied between steps by this loop itself, never concurrently
                     clone_requests[pos].expr, cache=None
                 )
             if rec is None:

@@ -1,22 +1,31 @@
 #!/usr/bin/env python
-"""Project-native static analysis CLI (ISSUE 3) — the analysis half of the
-reference's per-push gate (.github/workflows/java-all-versions.yml runs
-checkstyle-style analysis beside the JDK test matrix; scripts/ci.sh runs
-this beside pytest).
+"""Project-native static analysis CLI (ISSUE 3; whole-program contract
+tier ISSUE 18) — the analysis half of the reference's per-push gate
+(.github/workflows/java-all-versions.yml runs checkstyle-style analysis
+beside the JDK test matrix; scripts/ci.sh runs this beside pytest).
 
 Usage::
 
-    python scripts/analyze.py                  # report findings, exit 0
+    python scripts/analyze.py                  # lexical tier, report, exit 0
     python scripts/analyze.py --check          # exit 1 on non-baselined findings
+    python scripts/analyze.py --contracts      # + whole-program contract tier
+    python scripts/analyze.py --diff origin/main  # lexical tier over changed
+                                               # files only (contracts, when
+                                               # requested, always whole-tree)
     python scripts/analyze.py --json           # machine-readable output
     python scripts/analyze.py --update-baseline
-    python scripts/analyze.py --rules lock-discipline,metric-naming pkg/dir
+    python scripts/analyze.py --rules lock-discipline,epoch-pin
+    python scripts/analyze.py --write-knobs    # regenerate KNOBS.md
+    python scripts/analyze.py --check-knobs    # exit 1 when KNOBS.md drifted
 
 Default scan root is the ``roaringbitmap_tpu`` package. The baseline
 (ANALYSIS_BASELINE.json) holds fingerprints of accepted findings so
-pre-existing debt never blocks while anything new fails CI. Per-rule
-finding counts are reported into the observe registry
-(``rb_tpu_analysis_findings_total{rule}``) for the metrics sidecar.
+pre-existing debt never blocks while anything new fails CI — both tiers
+share it (and the ``# rb-ok:`` pragma mechanism). Per-rule finding
+counts are reported into the observe registry
+(``rb_tpu_analysis_findings_total{rule}`` for the lexical tier,
+``rb_tpu_analysis_contract_findings_total{rule}`` for the contract tier)
+for the metrics sidecar.
 """
 
 from __future__ import annotations
@@ -24,23 +33,62 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
 from roaringbitmap_tpu import observe
-from roaringbitmap_tpu.analysis import all_rule_ids, baseline, fingerprints, run_checks
-from roaringbitmap_tpu.analysis.core import CHECKERS
+from roaringbitmap_tpu.analysis import (
+    all_contract_rule_ids,
+    all_rule_ids,
+    baseline,
+    fingerprints,
+    get_project,
+    knobs as knobs_mod,
+    run_checks,
+    run_contract_checks,
+)
+from roaringbitmap_tpu.analysis.core import CHECKERS, CONTRACT_CHECKERS
 
 DEFAULT_PATHS = [os.path.join(REPO_ROOT, "roaringbitmap_tpu")]
 DEFAULT_BASELINE = os.path.join(REPO_ROOT, baseline.DEFAULT_BASELINE_NAME)
+KNOBS_PATH = os.path.join(REPO_ROOT, knobs_mod.KNOBS_DOC)
 
 _FINDINGS_TOTAL = observe.counter(
     observe.ANALYSIS_FINDINGS_TOTAL,
     "Static-analysis findings by rule (includes baselined)",
     ("rule",),
 )
+_CONTRACT_FINDINGS_TOTAL = observe.counter(
+    observe.ANALYSIS_CONTRACT_FINDINGS_TOTAL,
+    "Whole-program contract-analysis findings by rule (includes baselined)",
+    ("rule",),
+)
+
+
+def _changed_files(ref: str):
+    """Package .py files changed vs ``ref`` (git diff --name-only), as
+    absolute paths. Deleted files are skipped. Returns None on git
+    failure — the caller falls back loudly, not silently."""
+    try:
+        out = subprocess.run(
+            ["git", "diff", "--name-only", ref, "--", "roaringbitmap_tpu"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if out.returncode != 0:
+        return None
+    paths = []
+    for line in out.stdout.splitlines():
+        line = line.strip()
+        if line.endswith(".py"):
+            ap = os.path.join(REPO_ROOT, line)
+            if os.path.isfile(ap):
+                paths.append(ap)
+    return paths
 
 
 def main(argv=None) -> int:
@@ -48,6 +96,11 @@ def main(argv=None) -> int:
     ap.add_argument("paths", nargs="*", help="files/dirs (default: the package)")
     ap.add_argument("--check", action="store_true",
                     help="exit 1 if any non-baselined finding exists")
+    ap.add_argument("--contracts", action="store_true",
+                    help="also run the whole-program contract tier")
+    ap.add_argument("--diff", metavar="REF", default=None,
+                    help="lexical tier over files changed vs REF only "
+                         "(contract tier, when requested, stays whole-tree)")
     ap.add_argument("--json", action="store_true", help="JSON output")
     ap.add_argument("--rules", default=None,
                     help="comma-separated rule ids (default: all)")
@@ -56,35 +109,125 @@ def main(argv=None) -> int:
                     help="baseline file (default: %(default)s)")
     ap.add_argument("--update-baseline", action="store_true",
                     help="accept every current finding into the baseline")
+    ap.add_argument("--write-knobs", action="store_true",
+                    help="regenerate KNOBS.md from the knob extractor")
+    ap.add_argument("--check-knobs", action="store_true",
+                    help="exit 1 when KNOBS.md drifted from the tree")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         for rid in all_rule_ids():
             print(f"{rid}: {CHECKERS[rid].description}")
+        for rid in all_contract_rule_ids():
+            print(f"{rid}: {CONTRACT_CHECKERS[rid].description}  [contract]")
         return 0
 
-    rules = [r.strip() for r in args.rules.split(",")] if args.rules else None
+    if args.write_knobs or args.check_knobs:
+        project = get_project(REPO_ROOT)
+        try:
+            rendered = knobs_mod.render(project)
+        except ValueError as e:
+            print(f"analyze: {e}", file=sys.stderr)
+            return 2
+        if args.write_knobs:
+            with open(KNOBS_PATH, "w", encoding="utf-8") as f:
+                f.write(rendered)
+            print(f"wrote {os.path.relpath(KNOBS_PATH, REPO_ROOT)} "
+                  f"({len(project.knobs)} knobs)")
+            return 0
+        try:
+            with open(KNOBS_PATH, encoding="utf-8") as f:
+                current = f.read()
+        except OSError:
+            current = ""
+        if current != rendered:
+            print("analyze: KNOBS.md has drifted from the tree — run "
+                  "scripts/analyze.py --write-knobs", file=sys.stderr)
+            return 1
+        print(f"KNOBS.md is current ({len(project.knobs)} knobs)")
+        return 0
+
+    lex_rules = None
+    contract_rules = None
+    if args.rules:
+        all_rule_ids()  # side effect: lazily registers both checker tiers
+        wanted = [r.strip() for r in args.rules.split(",") if r.strip()]
+        lex_rules = [r for r in wanted if r in CHECKERS] or None
+        contract_rules = [r for r in wanted if r in CONTRACT_CHECKERS] or None
+        unknown = [
+            r for r in wanted
+            if r not in CHECKERS and r not in CONTRACT_CHECKERS
+        ]
+        if unknown:
+            print(
+                f"analyze: unknown rule(s) {unknown}; known: "
+                f"{all_rule_ids() + all_contract_rule_ids()}",
+                file=sys.stderr,
+            )
+            return 2
+        if contract_rules and not args.contracts:
+            args.contracts = True
+        if lex_rules is None:
+            # contract-only selection: skip the lexical tier entirely
+            lex_rules = []
+
     paths = args.paths or DEFAULT_PATHS
+    if args.diff is not None:
+        changed = _changed_files(args.diff)
+        if changed is None:
+            print(f"analyze: git diff vs {args.diff!r} failed; falling back "
+                  "to a full scan", file=sys.stderr)
+        else:
+            paths = changed
+
     try:
-        result = run_checks(paths, rules=rules, root=REPO_ROOT)
-    except ValueError as e:  # unknown rule id
+        if lex_rules == [] or not paths:
+            from roaringbitmap_tpu.analysis import RunResult
+            result = RunResult()
+        else:
+            result = run_checks(paths, rules=lex_rules or None, root=REPO_ROOT)
+    except ValueError as e:  # unknown rule id / bad path
         print(f"analyze: {e}", file=sys.stderr)
         return 2
 
-    for rid in rules or all_rule_ids():
+    ran_contracts = []
+    if args.contracts:
+        project = get_project(REPO_ROOT)
+        try:
+            cres = run_contract_checks(project, rules=contract_rules)
+        except ValueError as e:
+            print(f"analyze: {e}", file=sys.stderr)
+            return 2
+        ran_contracts = contract_rules or all_contract_rule_ids()
+        result.findings.extend(cres.findings)
+        result.suppressed += cres.suppressed
+        result.files = max(result.files, cres.files)
+        for e in cres.parse_errors:
+            if e not in result.parse_errors:
+                result.parse_errors.append(e)
+        result.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+
+    ran_lexical = (
+        (lex_rules or all_rule_ids()) if (lex_rules != [] and paths) else []
+    )
+    for rid in ran_lexical:
         # inc(0) still materializes the series, so the sidecar shows a
         # clean rule as an explicit zero rather than an absence
         _FINDINGS_TOTAL.inc(
             sum(1 for f in result.findings if f.rule == rid), (rid,)
         )
+    for rid in ran_contracts:
+        _CONTRACT_FINDINGS_TOTAL.inc(
+            sum(1 for f in result.findings if f.rule == rid), (rid,)
+        )
 
     if args.update_baseline:
-        if args.paths or args.rules:
+        if args.paths or args.rules or args.diff is not None:
             # a scoped run sees only a subset of findings; dumping it would
             # silently drop accepted fingerprints outside the scope and
             # break the next full --check
             print("analyze: --update-baseline requires a full default run "
-                  "(no path or --rules arguments)", file=sys.stderr)
+                  "(no path, --rules, or --diff arguments)", file=sys.stderr)
             return 2
         if result.parse_errors:
             # an unparsed file was never scanned: its findings are unknown,
@@ -111,7 +254,7 @@ def main(argv=None) -> int:
         old_ids = {id(f) for f in old}
         out = {
             "files": result.files,
-            "rules": rules or all_rule_ids(),
+            "rules": list(ran_lexical) + list(ran_contracts),
             "suppressed": result.suppressed,
             "parse_errors": result.parse_errors,
             "findings": [
@@ -130,11 +273,12 @@ def main(argv=None) -> int:
             print(f"{f.render()}  [baselined]")
         for e in result.parse_errors:
             print(f"parse error: {e}", file=sys.stderr)
+        tiers = "lexical" + ("+contracts" if ran_contracts else "")
         print(
             f"analyze: {len(result.findings)} finding(s) "
             f"({len(new)} new, {len(old)} baselined, "
             f"{result.suppressed} pragma-suppressed) across "
-            f"{result.files} files"
+            f"{result.files} files [{tiers}]"
         )
 
     if result.parse_errors:

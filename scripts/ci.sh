@@ -15,11 +15,40 @@ export PYTHONPATH=$PWD:${PYTHONPATH:-}
 t0=$SECONDS
 step() { echo; echo "=== ci: $1 (t+$((SECONDS - t0))s)"; }
 
-step "static analysis (dtype/trace-safety/lock-discipline/exception-hygiene/metric-naming)"
+step "static analysis (lexical + whole-program contract tiers, ISSUE 18)"
 # the analysis half of the reference's per-push gate: zero non-baselined
-# findings or the push fails (runs in --fast mode too — it's seconds).
-# scripts/analyze.py also reports rb_tpu_analysis_findings_total in-process.
-JAX_PLATFORMS=cpu python scripts/analyze.py --check
+# findings across BOTH tiers (per-file lexical rules + the ProjectContext
+# contract/dataflow rules) or the push fails (runs in --fast mode too —
+# it's seconds). scripts/analyze.py also reports the two per-rule finding
+# counters (rb_tpu_analysis[_contract]_findings_total) in-process.
+JAX_PLATFORMS=cpu python scripts/analyze.py --check --contracts
+
+step "knob table drift (KNOBS.md vs the tree's RB_TPU_* reads)"
+JAX_PLATFORMS=cpu python scripts/analyze.py --check-knobs
+
+if [[ "${1:-}" == "--fast" ]]; then
+  step "analyze --diff wall-time budget (incremental pre-push path)"
+  # the --diff mode is the editor-loop entry point: lexical tier over the
+  # files changed vs HEAD only (contracts stay whole-tree). Assert it
+  # stays interactive — a full ProjectContext build + a scoped lexical
+  # pass in well under 10 s on this tree (~seconds of margin: the budget
+  # catches an accidental O(files^2) extractor, not scheduler jitter)
+  JAX_PLATFORMS=cpu python - <<'EOF'
+import subprocess, sys, time
+t0 = time.monotonic()
+p = subprocess.run(
+    [sys.executable, "scripts/analyze.py", "--check", "--contracts",
+     "--diff", "HEAD"], capture_output=True, text=True)
+wall = time.monotonic() - t0
+sys.stdout.write(p.stdout)
+sys.stderr.write(p.stderr)
+if p.returncode != 0:
+    raise SystemExit(f"analyze --diff failed (exit {p.returncode})")
+if wall > 10.0:
+    raise SystemExit(f"analyze --diff took {wall:.1f}s (budget 10s)")
+print(f"analyze --diff ok in {wall:.2f}s (budget 10s)")
+EOF
+fi
 
 if [[ "${1:-}" != "--fast" ]]; then
   step "pytest (full suite incl. Mosaic block-rule checks)"
@@ -573,7 +602,8 @@ need_rules = {"costmodel-drift", "routing-regret", "breaker-stuck-open",
               "outcome-anomaly-burst", "hbm-accounting-drift", "compile-storm",
               "fusion-queue-stall", "serving-p99-breach", "tenant-saturation",
               "freshness-lag-breach", "epoch-flip-stall", "structure-drift",
-              "delta-accretion"}
+              "delta-accretion", "epoch-persist-stall",
+              "recovery-manifest-torn"}
 if set(h.get("rules", {})) != need_rules:
     raise SystemExit("committed rule table changed: %r" % sorted(h.get("rules", {})))
 side = json.load(open("/tmp/ci_bench_metrics.json"))
@@ -1370,7 +1400,7 @@ for rn in ("epoch-persist-stall", "recovery-manifest-torn"):
 print("durable metric names ok (suffixes + stage label set; fault site + "
       "both sentinel rules registered)")'
 
-step "rb_top observatory report (schema rb_tpu_top/8, ISSUE 9 + 11 + 12 + 13 + 14 + 15 + 16 + 17)"
+step "rb_top observatory report (schema rb_tpu_top/9, ISSUE 9 + 11 + 12 + 13 + 14 + 15 + 16 + 17 + 18)"
 # the snapshot CLI must produce a schema-valid JSON report with every
 # panel populated from its in-process demo workload — incl. the regret
 # panel (per-site joins from the decision-outcome ledger), the health
@@ -1387,11 +1417,11 @@ JAX_PLATFORMS=cpu RB_TPU_ARTIFACT_DIR=/tmp/ci_artifacts \
 python -c '
 import json
 r = json.load(open("/tmp/ci_rb_top.json"))
-if r.get("schema") != "rb_tpu_top/8":
+if r.get("schema") != "rb_tpu_top/9":
     raise SystemExit("rb_top: bad schema %r" % r.get("schema"))
 need = {"schema", "generated_utc", "source", "counters", "latency",
         "locks", "breakers", "cache", "decisions_tail", "regret", "health",
-        "fusion", "serving", "epochs", "structure", "durable"}
+        "fusion", "serving", "epochs", "structure", "durable", "analysis"}
 missing = need - set(r)
 if missing:
     raise SystemExit("rb_top report lacks %s" % sorted(missing))

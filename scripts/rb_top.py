@@ -69,7 +69,7 @@ import time
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
-SCHEMA = "rb_tpu_top/8"
+SCHEMA = "rb_tpu_top/9"
 
 
 def _live_report(tail: int) -> dict:
@@ -117,6 +117,10 @@ def _live_report(tail: int) -> dict:
         # durable epochs (ISSUE 17): persisted vs serving epoch, artifact
         # bytes, persist stage walls, recovery provenance, demotions
         "durable": insights.durable(),
+        # static analysis (ISSUE 18): per-rule finding counts from the
+        # lexical and whole-program contract tiers, when the analyzer ran
+        # in this process (or is present in the sidecar registry)
+        "analysis": side["analysis"],
     }
 
 
@@ -178,6 +182,8 @@ def _sidecar_report(path: str, tail: int) -> dict:
         # the sidecar's registry-derived durable block (export.py; the
         # live store stats and recovery provenance are process-local)
         "durable": side.get("durable", {}),
+        # the sidecar's registry-derived analysis block (export.py)
+        "analysis": side.get("analysis", {}),
     }
 
 
@@ -553,6 +559,18 @@ def _render_console(r: dict) -> str:
              f"torn_skipped={rl.get('torn_skipped')} wall={rl.get('wall_s')}s")
         )
     section("durable (frozen epochs & recovery)", du_rows)
+    # analysis panel (ISSUE 18): per-rule finding counts from the last
+    # analyzer run that exported into this registry — zeros are shown
+    # (rule ran, found nothing); absent rules never ran in this process
+    an = r.get("analysis", {}) or {}
+    an_rows = []
+    for rule, v in sorted((an.get("lexical") or {}).items()):
+        an_rows.append((rule, v))
+    for rule, v in sorted((an.get("contracts") or {}).items()):
+        an_rows.append((f"{rule} [contract]", v))
+    if an_rows:
+        an_rows.append(("total findings", an.get("total", 0)))
+    section("analysis (static-analysis findings)", an_rows)
     dec_rows = [
         (d.get("trace") or "-",
          f"{d['site']}: {d['decision']} {d.get('inputs', '')}")

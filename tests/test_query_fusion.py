@@ -540,7 +540,7 @@ def test_rb_top_report_carries_fusion_panel():
     finally:
         sys.path.pop(0)
     r = rb_top.report(tail=4)
-    assert r["schema"] == "rb_tpu_top/6"
+    assert r["schema"] == "rb_tpu_top/9"
     assert "fusion" in r
     rendered = rb_top._render_console(r)
     assert "fusion (cross-query micro-batching)" in rendered

@@ -413,14 +413,15 @@ def test_seeded_drift_auto_refit_e2e(tmp_path, monkeypatch):
 
 
 # ---------------------------------------------------------------------------
-# cost facade: seven authorities, one protocol, one state lifecycle
+# cost facade: all authorities, one protocol, one state lifecycle
 # ---------------------------------------------------------------------------
 
 
 def test_cost_facade_registers_all_authorities():
     assert cost.names() == [
-        "columnar-cutoff", "device-breakeven", "epoch-flip", "fusion-batch",
-        "pack-residency", "planner-cardinality", "serve-admission",
+        "columnar-cutoff", "compaction", "device-breakeven", "epoch-flip",
+        "fusion-batch", "pack-residency", "planner-cardinality",
+        "serve-admission",
     ]
     state = cost.calibration_state()
     assert state["schema"] == cost.STATE_SCHEMA
