@@ -34,7 +34,7 @@ from __future__ import annotations
 
 import math
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 SCHEMA = "rb_tpu_fusion_cost/1"
 
@@ -42,11 +42,17 @@ ENGINES = ("fused", "per-query")
 
 # structural-prior defaults (µs): a solo plan step costs about one
 # columnar-engine call's fixed overhead; a merged tier costs one such
-# dispatch plus a small per-step concat/slice tax
+# dispatch plus a small per-step concat/slice tax. ``slack_penalty`` is
+# the latency-penalty term (ISSUE 19): every predicted µs past a
+# request's slack counts this many extra µs in the joint window-vs-solo
+# verdict — dimensionless, policy-shaped, deliberately NOT refit-scaled
+# (the refit learns execution constants; how much an SLO breach hurts is
+# a declared preference, not a measurable).
 DEFAULT_COEFFS = {
     "solo_step_us": 120.0,
     "tier_us": 150.0,
     "merge_step_us": 25.0,
+    "slack_penalty": 4.0,
 }
 # refit clamps, the CARD_MODEL discipline: one window cannot invert the
 # verdict ordering outright, and coefficients stay in a sane decade band
@@ -83,6 +89,68 @@ class FusionBatchModel:
         est = self.estimate(steps, tiers)
         return "fused" if est["fused"] <= est["per-query"] else "per-query"
 
+    # -- the joint latency-priced verdict (ISSUE 19) -------------------------
+
+    def hedge_estimate(
+        self, steps: int, queue_depth: int, wait_us: float
+    ) -> Dict[str, float]:
+        """Predicted completion wall (µs) for ONE request against a
+        forming window: ``window`` = the remaining window hold
+        (``wait_us``) plus the fused estimate of the window it would
+        join (``queue_depth`` earlier members approximated at this
+        request's step count, merge classes collapsing to one tier per
+        step-class); ``solo`` = this request's own per-query curve.
+        These are the RAW curves — the est_us dict the ``fusion.hedge``
+        decision records and the outcome join prices, so regret rows
+        measure curve error, not penalty policy."""
+        steps = max(1, int(steps))
+        n = max(0, int(queue_depth)) + 1
+        window_exec = self.estimate(steps * n, steps)["fused"]
+        return {
+            "window": round(max(0.0, float(wait_us)) + window_exec, 3),
+            "solo": self.estimate(steps, steps)["per-query"],
+        }
+
+    def choose_dispatch(
+        self, steps: int, queue_depth: int, wait_us: float, slack_us: float
+    ) -> Tuple[str, Dict[str, float]]:
+        """The joint priced batch-vs-solo verdict for one request with
+        ``slack_us`` of latency budget left: each path's raw completion
+        estimate plus the latency penalty (``slack_penalty`` extra µs per
+        predicted µs past the slack) — device efficiency and the
+        tenant's declared budget priced in ONE comparison. Returns
+        ``(verdict, raw_est)`` with verdict ``"solo"`` when hedging out
+        of the window is the cheaper priced outcome."""
+        est = self.hedge_estimate(steps, queue_depth, wait_us)
+        pen = self.coeffs["slack_penalty"]
+        slack_us = float(slack_us)
+        priced = {
+            path: us + pen * max(0.0, us - slack_us)
+            for path, us in est.items()
+        }
+        # the window keeps ties: hedging duplicates dispatch overhead the
+        # window exists to amortize, so solo must WIN, not draw
+        verdict = "solo" if priced["solo"] < priced["window"] else "window"
+        return verdict, est
+
+    def window_for_budget(
+        self, budget_us: float, steps_per_query: float = 2.0
+    ) -> int:
+        """Largest window size whose predicted fused wall fits inside
+        ``budget_us`` under the CURRENT (possibly refitted) curves — the
+        serving-p99-pressure actuation's shrink/regrow bound. Structural
+        shape: a window of ``w`` average queries runs ``w *
+        steps_per_query`` merged steps over ``~steps_per_query`` tiers
+        (merge classes collapse across queries), so
+        ``fused(w) = steps_per_query * tier_us + w * steps_per_query *
+        merge_step_us``; floor 2 (a 1-window is just solo dispatch)."""
+        c = self.coeffs
+        fixed = steps_per_query * c["tier_us"]
+        per_q = steps_per_query * c["merge_step_us"]
+        if float(budget_us) <= fixed or per_q <= 0:
+            return 2
+        return max(2, int((float(budget_us) - fixed) / per_q))
+
     # -- refit from the decision-outcome ledger ------------------------------
 
     def refit_from_outcomes(
@@ -102,9 +170,17 @@ class FusionBatchModel:
         ratios: Dict[str, List[float]] = {}
         rejected = 0
         for s in samples:
-            if s.get("site") != "fusion.batch":
+            site = s.get("site")
+            if site not in ("fusion.batch", "fusion.hedge"):
                 continue
             engine = s.get("engine")
+            if site == "fusion.hedge":
+                # a hedged solo dispatch measures exactly the per-query
+                # curve (ISSUE 19); window-verdict joins are queue-wait
+                # dominated (policy, not curve) and don't refit anything
+                if engine != "solo":
+                    continue
+                engine = "per-query"
             predicted = s.get("predicted_us")
             measured_s = s.get("measured_s")
             if engine not in ENGINES:

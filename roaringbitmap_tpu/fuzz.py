@@ -486,7 +486,16 @@ def verify_fusion_invariance(
     serial execution bit-exactly (the ladder's batch rung), and no
     exception may escape. The oracle is computed mid-schedule inside
     ``faults.suspended()`` with the serial executor (itself pinned
-    against naive evaluation by family ``query-planner-vs-naive``)."""
+    against naive evaluation by family ``query-planner-vs-naive``).
+
+    Mixed latency classes (ISSUE 19): each iteration ALSO drives the
+    same query set through a live :class:`FusionExecutor` with
+    alternating ``interactive``/``batch`` slack declarations, so the
+    SLO-priced submit path — deadline-aware window close, the
+    ``fusion.hedge`` verdict, and hedged solo dispatch through the
+    in-flight table (fault site ``"query.hedge"``, which must degrade
+    back to the window rung bit-exactly) — is fuzzed under the same
+    schedules as the plain batch entry."""
     from contextlib import ExitStack
 
     from .query import Q, ResultCache, execute, fusion
@@ -527,6 +536,28 @@ def verify_fusion_invariance(
                             name, bms,
                             detail=f"fused query {gi} diverged from the "
                             f"serial oracle (schedule={sched})",
+                        )
+                # the SLO-priced submit path under the same schedule:
+                # alternating latency classes, tight interactive slack so
+                # the hedge verdict actually fires solo dispatches
+                with fusion.FusionExecutor(
+                    cache=ResultCache(max_entries=64)
+                ) as execu:
+                    futs = [
+                        execu.submit(
+                            q,
+                            slack_ms=(5.0, 1000.0)[qi % 2],
+                            latency_class=("interactive", "batch")[qi % 2],
+                        )
+                        for qi, q in enumerate(queries)
+                    ]
+                    hedged = [f.result() for f in futs]
+                for gi, (g, w) in enumerate(zip(hedged, want)):
+                    if g != w:
+                        raise InvarianceFailure(
+                            name, bms,
+                            detail=f"SLO-priced submit query {gi} diverged "
+                            f"from the serial oracle (schedule={sched})",
                         )
         except InvarianceFailure:
             raise

@@ -286,6 +286,17 @@ def fusion_counters() -> dict:
 
     block = _export._fusion_block(observe.REGISTRY.snapshot())
     block["inflight_live"] = _inflight.TABLE.stats()
+    # the live window auto-tune state (ISSUE 19): effective vs base vs
+    # floor — effective < base means the serving-p99-pressure actuation
+    # has shrunk the window and not yet regrown it
+    from .query import fusion as _q_fusion
+
+    block["window_state"] = {
+        "effective": _q_fusion.config.window,
+        "base": _q_fusion.config.window_base,
+        "min": _q_fusion.config.window_min,
+        "hedge_enabled": _q_fusion.config.hedge,
+    }
     return block
 
 

@@ -241,10 +241,20 @@ def _fusion_block(snap: dict) -> dict:
         for s in g["samples"]:
             if not s["labels"]:
                 depth = s["value"]
+    window = None
+    g = snap.get(_registry.FUSION_WINDOW_COUNT)
+    if g is not None:
+        for s in g["samples"]:
+            if not s["labels"]:
+                window = s["value"]
     n_batches = float(sum(batches.values()))
     executed = float(steps.get("executed", 0))
     deduped = float(steps.get("deduped", 0))
     planned = executed + deduped
+    # hedge verdict volume (ISSUE 19): solo = hedged solo dispatches,
+    # window = priced window verdicts; the rate is solo over all verdicts
+    hedges = _counter_map(snap, _registry.FUSION_HEDGE_TOTAL)
+    verdicts = float(sum(hedges.values()))
     return {
         "batches": batches,
         "queries": queries,
@@ -253,6 +263,10 @@ def _fusion_block(snap: dict) -> dict:
         "dedup_hit_ratio": round(deduped / planned, 4) if planned else None,
         "inflight": _counter_map(snap, _registry.QUERY_INFLIGHT_TOTAL),
         "queue_depth": depth,
+        "hedges": hedges,
+        "hedge_rate": round(float(hedges.get("solo", 0)) / verdicts, 4)
+        if verdicts else None,
+        "window": window,
     }
 
 
@@ -279,6 +293,9 @@ def _serving_block(snap: dict, registry: Registry) -> dict:
         (_registry.SERVE_QPS, "qps"),
         (_registry.SERVE_SATURATION_RATIO, "saturation"),
         (_registry.SERVE_TENANT_BYTES, "bytes"),
+        # declared p99 budget (ISSUE 19): the latency-class contract the
+        # pressure rule and the rb_top latency panel judge p99 against
+        (_registry.SERVE_SLO_BUDGET_SECONDS, "slo_budget_s"),
     ):
         m = snap.get(name)
         if m is None:

@@ -51,6 +51,7 @@ structure-drift        actual/optimal serialized-bytes ratio    1.3   2.0
 delta-accretion        epoch-delta batches since maintenance    8     64
 epoch-persist-stall    persist backlog with no completed persist 4    64
 recovery-manifest-torn torn artifacts skipped by recovery       0.5   1
+serving-p99-pressure   worst tenant p99 / declared p99 budget   1.0   2.0
 ====================== ======================================== ===== =====
 
 Actuations (the sentinel's closed-loop half — see ``observe.sentinel``):
@@ -58,6 +59,9 @@ Actuations (the sentinel's closed-loop half — see ``observe.sentinel``):
 ``refit_all``, ROADMAP item 4's auto-trigger); ``structure-drift`` and
 ``delta-accretion`` actuate ``"maintain"`` (a priced background
 compaction pass under its own cooldown — serve/maintain.py, ISSUE 16);
+``serving-p99-pressure`` actuates ``"autotune"`` (the fusion executor's
+window bounds re-derived from the fusion authority's refitted curves
+under its own cooldown — query/fusion.py ``autotune_window``, ISSUE 19);
 the rest actuate ``"alert"`` (a structured instant + decision entry on
 the fire transition); any rule reaching CRITICAL additionally triggers
 a one-shot flight bundle (``observe.bundle``).
@@ -267,18 +271,29 @@ class Snapshot:
         return out
 
     def histogram_delta_quantile(self, name: str, q: float) -> Optional[float]:
-        """Windowed quantile over a histogram's per-tick movement: for
-        each labeled series, rebuild the bucket counts observed SINCE the
-        previous tick (cumulative-``le`` diffs against the prev-sums
-        channel) and estimate the ``q``-quantile by the same
-        cumulative-walk + in-bucket interpolation as LatencyHistogram;
-        returns the max over series, or None when no series moved (first
-        tick, idle window) — cumulative histograms would otherwise pin a
-        breach forever after one bad burst."""
+        """Windowed quantile over a histogram's per-tick movement —
+        the max over series, or None when no series moved (first tick,
+        idle window). See :meth:`histogram_delta_quantiles`."""
+        per = self.histogram_delta_quantiles(name, q)
+        return max(per.values()) if per else None
+
+    def histogram_delta_quantiles(
+        self, name: str, q: float
+    ) -> Dict[Tuple[str, ...], float]:
+        """Per-series windowed quantile over a histogram's per-tick
+        movement: for each labeled series, rebuild the bucket counts
+        observed SINCE the previous tick (cumulative-``le`` diffs against
+        the prev-sums channel) and estimate the ``q``-quantile by the
+        same cumulative-walk + in-bucket interpolation as
+        LatencyHistogram; a series that did not move this tick (first
+        tick, idle window) is omitted — cumulative histograms would
+        otherwise pin a breach forever after one bad burst. The sums
+        writes are idempotent, so probes may call this and
+        :meth:`histogram_delta_quantile` on the same name in one tick."""
+        out: Dict[Tuple[str, ...], float] = {}
         m = self.metrics.get(name)
         if m is None:
-            return None
-        worst: Optional[float] = None
+            return out
         for s in m.get("samples", ()):
             lv = [s["labels"][n] for n in m.get("labelnames", [])]
             skey = name + "|" + "|".join(lv)
@@ -323,8 +338,8 @@ class Snapshot:
                         lo = bounds[i - 1] if i > 0 else 0.0
                         est = lo + (hi - lo) * ((rank - below) / n)
                     break
-            worst = est if worst is None else max(worst, est)
-        return worst
+            out[tuple(lv)] = est
+        return out
 
     def gauge_max_abs(self, name: str) -> float:
         m = self.metrics.get(name)
@@ -508,6 +523,39 @@ def _fusion_queue_stall(s: Snapshot) -> float:
     return depth if s.counter_delta(_registry.FUSION_BATCH_TOTAL) == 0 else 0.0
 
 
+def _serving_p99_pressure(s: Snapshot) -> Optional[float]:
+    """Worst per-tenant ratio of windowed serving p99 over that tenant's
+    DECLARED p99 budget (ISSUE 19): 1.0 means some tenant's tail just
+    consumed its whole SLO. Unlike ``serving-p99-breach`` (one absolute
+    band for everyone), this judges each tenant against its own declared
+    latency class, so an interactive tenant at 30 ms fires while a batch
+    tenant at 300 ms stays green — and it actuates the fusion-window
+    auto-tune instead of an alert, because the batching window is the
+    knob that trades this exact tail for throughput. Tenants without a
+    declared budget (no ``rb_tpu_serve_slo_budget_seconds`` series) are
+    not judged."""
+    m = s.metrics.get(_registry.SERVE_SLO_BUDGET_SECONDS)
+    if m is None:
+        return None
+    budgets: Dict[str, float] = {}
+    for smp in m.get("samples", ()):
+        tenant = smp.get("labels", {}).get("tenant", "")
+        v = float(smp.get("value", 0))
+        if tenant and v > 0:
+            budgets[tenant] = v
+    if not budgets:
+        return None
+    per = s.histogram_delta_quantiles(_registry.SERVE_LATENCY_SECONDS, 0.99)
+    worst: Optional[float] = None
+    for (tenant, _phase), p99 in per.items():
+        budget = budgets.get(tenant)
+        if budget is None:
+            continue
+        ratio = p99 / budget
+        worst = ratio if worst is None else max(worst, ratio)
+    return worst
+
+
 DEFAULT_RULES: Tuple[Rule, ...] = (
     Rule(
         "costmodel-drift",
@@ -651,5 +699,18 @@ DEFAULT_RULES: Tuple[Rule, ...] = (
         _recovery_manifest_torn,
         warn=0.5, critical=1.0, fire_after=1, clear_after=1,
         actuation="alert",
+    ),
+    # the SLO-pressure rule (ISSUE 19): each tenant judged against its
+    # OWN declared p99 budget, actuating the fusion-window auto-tune —
+    # the knob that trades exactly this tail for throughput; appended so
+    # every earlier rule keeps its table position
+    Rule(
+        "serving-p99-pressure",
+        "worst per-tenant windowed serving p99 over that tenant's "
+        "declared p99 budget (1.0 = the tail consumed the whole SLO) — "
+        "actuates the fusion-window auto-tune under cooldown",
+        _serving_p99_pressure,
+        warn=1.0, critical=2.0, fire_after=2, clear_after=2,
+        actuation="autotune",
     ),
 )

@@ -112,6 +112,14 @@ FUSION_STEPS_TOTAL = "rb_tpu_fusion_steps_total"
 FUSION_BATCH_SECONDS = "rb_tpu_fusion_batch_seconds"
 FUSION_QUEUED_COUNT = "rb_tpu_fusion_queued_count"
 QUERY_INFLIGHT_TOTAL = "rb_tpu_query_inflight_total"
+# tail-latency engineering (ISSUE 19): per-request joint priced
+# batch-vs-solo verdicts against the tenant's declared p99 budget
+# (window = rode the forming window, solo = hedged solo dispatch through
+# the in-flight dedup table), and the live effective window bound the
+# serving-p99-pressure actuation auto-tunes from the fusion authority's
+# refitted curves
+FUSION_HEDGE_TOTAL = "rb_tpu_fusion_hedge_total"
+FUSION_WINDOW_COUNT = "rb_tpu_fusion_window_count"
 # serving tier (ISSUE 14): per-tenant request latency by phase
 # (queue = admission wall incl. any backpressure wait, execute = query
 # execution), rolling per-tenant QPS, admission verdicts, live queue
@@ -127,6 +135,11 @@ SERVE_QUEUE_COUNT = "rb_tpu_serve_queue_count"
 SERVE_INFLIGHT_COUNT = "rb_tpu_serve_inflight_count"
 SERVE_SATURATION_RATIO = "rb_tpu_serve_saturation_ratio"
 SERVE_TENANT_BYTES = "rb_tpu_serve_tenant_bytes"
+# per-tenant declared latency SLO (ISSUE 19): the p99 budget each tenant
+# declared with its latency class — exported so the serving-p99-pressure
+# rule and the rb_top latency panel judge measured p99 against DECLARED
+# budget instead of a blanket threshold
+SERVE_SLO_BUDGET_SECONDS = "rb_tpu_serve_slo_budget_seconds"
 # epoch ledger / streaming ingestion (ISSUE 15): ingest->queryable lag per
 # tenant (observed at epoch publish, per drained mutation batch), flip
 # stage decomposition (the declared FLIP_STAGES set in serve/epochs.py:

@@ -45,6 +45,15 @@ about a verdict:
     schedules work, it never forces it. Guarded by its own cooldown
     (``RB_TPU_SENTINEL_MAINTAIN_COOLDOWN_S``, default 30 s) so a
     stubborn drift cannot turn the corpus into a rewrite storm.
+  - ``"autotune"`` (serving-p99-pressure, ISSUE 19): while the rule is
+    at WARN or worse, re-derive the fusion executor's window bound from
+    the fusion authority's refitted curves against the tightest declared
+    interactive p99 budget (``query.fusion.autotune_window``) — the
+    static window knob becomes a refittable policy that shrinks under
+    tail pressure and regrows toward its configured base once curves or
+    traffic recover. Guarded by its own cooldown
+    (``RB_TPU_SENTINEL_AUTOTUNE_COOLDOWN_S``, default 30 s) so the
+    window cannot thrash batch-to-batch.
   - ``"alert"``: on the fire transition, a structured
     ``sentinel.alert`` recorder instant + decision-log entry carrying
     the rule, value, and threshold — once per episode, not per tick
@@ -74,11 +83,12 @@ DEFAULT_INTERVAL_S = 5.0
 DEFAULT_REFIT_COOLDOWN_S = 60.0
 DEFAULT_BUNDLE_COOLDOWN_S = 300.0
 DEFAULT_MAINTAIN_COOLDOWN_S = 30.0
+DEFAULT_AUTOTUNE_COOLDOWN_S = 30.0
 
 _ACTUATION_TOTAL = _registry.counter(
     _registry.HEALTH_ACTUATION_TOTAL,
     "Sentinel closed-loop actuations by rule and kind "
-    "(refit | maintain | alert | bundle)",
+    "(refit | maintain | autotune | alert | bundle)",
     ("rule", "kind"),
 )
 
@@ -103,6 +113,7 @@ class Sentinel:
         refit_cooldown_s: Optional[float] = None,
         bundle_cooldown_s: Optional[float] = None,
         maintain_cooldown_s: Optional[float] = None,
+        autotune_cooldown_s: Optional[float] = None,
     ):
         self.rules: Tuple[_health.Rule, ...] = tuple(
             _health.DEFAULT_RULES if rules is None else rules
@@ -123,6 +134,13 @@ class Sentinel:
             )
             if maintain_cooldown_s is None else float(maintain_cooldown_s)
         )
+        self.autotune_cooldown_s = (
+            _env_float(
+                "RB_TPU_SENTINEL_AUTOTUNE_COOLDOWN_S",
+                DEFAULT_AUTOTUNE_COOLDOWN_S,
+            )
+            if autotune_cooldown_s is None else float(autotune_cooldown_s)
+        )
         self._lock = threading.Lock()  # leaf: guards the fields below only
         self._states: Dict[str, _health.RuleState] = {  # guarded-by: self._lock
             r.name: _health.RuleState() for r in self.rules
@@ -134,6 +152,7 @@ class Sentinel:
         self._last_refit: Optional[float] = None  # guarded-by: self._lock
         self._last_bundle: Optional[float] = None  # guarded-by: self._lock
         self._last_maintain: Optional[float] = None  # guarded-by: self._lock
+        self._last_autotune: Optional[float] = None  # guarded-by: self._lock
 
     # -- the tick -----------------------------------------------------------
 
@@ -161,6 +180,7 @@ class Sentinel:
         alerts: List[dict] = []
         refit_due: Optional[str] = None
         maintain_due: Optional[str] = None
+        autotune_due: Optional[str] = None
         bundle_due: Optional[List[str]] = None
         with self._lock:
             self._tick_no += 1
@@ -204,6 +224,17 @@ class Sentinel:
                 ):
                     self._last_maintain = now
                     maintain_due = rule.name
+                if (
+                    rule.actuation == "autotune"
+                    and st.level >= _health.WARN
+                    and autotune_due is None
+                    and (
+                        self._last_autotune is None
+                        or now - self._last_autotune >= self.autotune_cooldown_s
+                    )
+                ):
+                    self._last_autotune = now
+                    autotune_due = rule.name
             prev_status = self._status
             self._status = status
             self._prev_sums.update(snap.sums)
@@ -232,6 +263,8 @@ class Sentinel:
             actuated.append(self._actuate_refit(now, tick_no, refit_due))
         if maintain_due is not None:
             actuated.append(self._actuate_maintain(now, tick_no, maintain_due))
+        if autotune_due is not None:
+            actuated.append(self._actuate_autotune(now, tick_no, autotune_due))
         if bundle_due is not None:
             actuated.append(self._actuate_bundle(now, tick_no, bundle_due, evals))
         if actuated:
@@ -340,6 +373,33 @@ class Sentinel:
         )
         return entry
 
+    def _actuate_autotune(self, now, tick_no, rule_name: str) -> dict:
+        from . import decisions as _decisions
+
+        _ACTUATION_TOTAL.inc(1, (rule_name, "autotune"))
+        entry = {
+            "tick": tick_no, "ts": now, "kind": "autotune", "rule": rule_name,
+        }
+        try:
+            from ..query import fusion as _fusion
+
+            record = _fusion.autotune_window(reason=f"sentinel:{rule_name}")
+            entry["verdict"] = record.get("verdict")
+            entry["window_from"] = record.get("window_from")
+            entry["window_to"] = record.get("window_to")
+            entry["budget_ms"] = record.get("budget_ms")
+        except Exception as e:  # rb-ok: exception-hygiene -- a failed auto-tune leaves the current window bounds in place; the failure is recorded in the actuation log and the pressure rule stays firing
+            entry["error"] = f"{type(e).__name__}: {e}"
+        _timeline.instant(
+            "sentinel.autotune", "health", rule=rule_name,
+            verdict=entry.get("verdict"), window_to=entry.get("window_to"),
+        )
+        _decisions.record_decision(
+            "sentinel.actuate", "autotune", rule=rule_name,
+            tune_verdict=entry.get("verdict"), error=entry.get("error"),
+        )
+        return entry
+
     def _actuate_bundle(self, now, tick_no, red_rules, evals) -> dict:
         from . import bundle as _bundle
         from . import decisions as _decisions
@@ -443,6 +503,7 @@ class Sentinel:
             self._last_refit = None
             self._last_bundle = None
             self._last_maintain = None
+            self._last_autotune = None
 
 
 # The process-wide sentinel (the thread, the inline hook, rb_top, and the
@@ -526,6 +587,7 @@ def configure(
     refit_cooldown_s: Optional[float] = None,
     bundle_cooldown_s: Optional[float] = None,
     maintain_cooldown_s: Optional[float] = None,
+    autotune_cooldown_s: Optional[float] = None,
 ) -> None:
     """Runtime overrides for the process sentinel: arm/disarm the inline
     pacing hook and adjust the actuation cooldowns."""
@@ -542,6 +604,8 @@ def configure(
         SENTINEL.bundle_cooldown_s = float(bundle_cooldown_s)
     if maintain_cooldown_s is not None:
         SENTINEL.maintain_cooldown_s = float(maintain_cooldown_s)
+    if autotune_cooldown_s is not None:
+        SENTINEL.autotune_cooldown_s = float(autotune_cooldown_s)
 
 
 def _init_from_env() -> None:

@@ -137,6 +137,21 @@ _QUEUED_COUNT = _observe.gauge(
     "Queries currently waiting across every live fusion window queue "
     "(the fusion-queue-stall sentinel rule's depth signal)",
 )
+_HEDGE_TOTAL = _observe.counter(
+    _observe.FUSION_HEDGE_TOTAL,
+    "Joint priced batch-vs-solo verdicts for budgeted requests (window "
+    "= rode the forming window, solo = hedged solo dispatch through the "
+    "in-flight dedup table because the window would blow the tenant's "
+    "p99 budget)",
+    ("verdict",),
+)
+_WINDOW_COUNT = _observe.gauge(
+    _observe.FUSION_WINDOW_COUNT,
+    "Effective fusion window bound (queries per drained batch) — the "
+    "serving-p99-pressure actuation auto-tunes this between "
+    "RB_TPU_FUSION_WINDOW_MIN and the configured base from the fusion "
+    "authority's refitted curves",
+)
 
 # per-executor queue depths folded into ONE gauge value: a process may
 # run several FusionExecutors (per tenant, per cache), and letting each
@@ -168,30 +183,102 @@ def _env_flag(name: str, default: bool) -> bool:
 
 class config:
     """Fusion dispatch knobs (env-seeded, runtime-overridable via
-    :func:`configure`). ``window`` bounds how many queries one drained
-    batch coalesces; ``max_wait_ms`` bounds how long the serving drain
-    loop holds an open window for stragglers."""
+    :func:`configure`). ``window`` is the EFFECTIVE window bound (queries
+    one drained batch coalesces) — a refittable policy since ISSUE 19:
+    the ``serving-p99-pressure`` actuation moves it between
+    ``window_min`` and ``window_base`` from the fusion authority's
+    refitted curves (:func:`autotune_window`). ``max_wait_ms`` bounds how
+    long the drain loop holds an open window for stragglers — a member's
+    declared slack can only CLOSE the window earlier, never extend it.
+    ``hedge`` arms the solo bypass for interactive requests whose priced
+    verdict says the forming window would blow their budget."""
 
     enabled: bool = _env_flag("RB_TPU_FUSION", True)
     window: int = max(2, int(os.environ.get("RB_TPU_FUSION_WINDOW") or 8))
+    window_base: int = window
+    window_min: int = max(2, int(os.environ.get("RB_TPU_FUSION_WINDOW_MIN") or 2))
     max_wait_ms: float = float(os.environ.get("RB_TPU_FUSION_LATENCY_MS") or 2.0)
+    hedge: bool = _env_flag("RB_TPU_FUSION_HEDGE", True)
+
+
+_WINDOW_COUNT.set(config.window)
 
 
 def configure(
     enabled: Optional[bool] = None,
     window: Optional[int] = None,
     max_wait_ms: Optional[float] = None,
+    window_min: Optional[int] = None,
+    hedge: Optional[bool] = None,
 ) -> None:
     if enabled is not None:
         config.enabled = bool(enabled)
     if window is not None:
         if window < 2:
             raise ValueError(f"fusion window must be >= 2, got {window}")
+        # an explicit window is a new BASE: the auto-tuner shrinks from
+        # (and regrows back to) whatever the operator last declared
         config.window = int(window)
+        config.window_base = int(window)
+        _WINDOW_COUNT.set(config.window)
     if max_wait_ms is not None:
         if max_wait_ms < 0:
             raise ValueError(f"max_wait_ms must be >= 0, got {max_wait_ms}")
         config.max_wait_ms = float(max_wait_ms)
+    if window_min is not None:
+        if window_min < 2:
+            raise ValueError(f"window_min must be >= 2, got {window_min}")
+        config.window_min = int(window_min)
+    if hedge is not None:
+        config.hedge = bool(hedge)
+
+
+def autotune_window(
+    budget_ms: Optional[float] = None, reason: str = "manual"
+) -> dict:
+    """Recompute the effective window bound from the fusion authority's
+    CURRENT (refitted) curves against the tightest declared interactive
+    p99 budget (ISSUE 19 leg 4 — the ``serving-p99-pressure``
+    actuation's body, PR 12's drift→refit actuation shape). Shrinks when
+    the curves say a full base window cannot fit inside the budget,
+    regrows toward ``config.window_base`` when they say it can (or when
+    no interactive tenant is declared — nothing to protect). Returns the
+    tuning record; the verdict lands in the decision log as
+    ``fusion.autotune``."""
+    if budget_ms is None:
+        try:
+            from ..serve import slo as _slo
+
+            budget_ms = min(
+                (
+                    _slo.TENANTS.p99_budget_ms(t)
+                    for t in _slo.TENANTS.names()
+                    if _slo.TENANTS.latency_class(t) == "interactive"
+                ),
+                default=None,
+            )
+        except Exception:  # rb-ok: exception-hygiene -- the auto-tuner must stay a no-op when the serve tier is absent/torn down mid-process-exit; the window simply holds its current bound
+            budget_ms = None
+    frm = config.window
+    if budget_ms is None:
+        target = config.window_base
+    else:
+        target = _fusion_cost.MODEL.window_for_budget(float(budget_ms) * 1e3)
+        target = min(config.window_base, max(config.window_min, target))
+    verdict = (
+        "shrink" if target < frm else ("regrow" if target > frm else "hold")
+    )
+    config.window = target
+    _WINDOW_COUNT.set(target)
+    _decisions.record_decision(
+        "fusion.autotune", verdict, window_from=frm, window_to=target,
+        budget_ms=budget_ms, reason=reason,
+        provenance=_fusion_cost.MODEL.provenance,
+    )
+    return {
+        "verdict": verdict, "window_from": frm, "window_to": target,
+        "budget_ms": budget_ms, "reason": reason,
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -628,15 +715,35 @@ def _merged_threshold_device(ready, results) -> List[RoaringBitmap]:
 
 
 # ---------------------------------------------------------------------------
-# the serving window (submit -> future, latency/size-bounded drain)
+# the serving window (submit -> future, latency/size/deadline-bounded drain)
 # ---------------------------------------------------------------------------
+
+
+def window_close_at(
+    t_open: float, max_wait_s: float, deadlines: Sequence[Optional[float]]
+) -> float:
+    """When the open window must close (ISSUE 19): the straggler bound
+    (``t_open + max_wait_s``) pulled EARLIER by the tightest member
+    deadline — a member's slack can only close the window sooner, never
+    hold it open longer. Pure arithmetic so the fake-clock tests pin
+    "never held past its slack" with no threads or clocks at all."""
+    close = t_open + max_wait_s
+    for d in deadlines:
+        if d is not None and d < close:
+            close = d
+    return close
 
 
 class FusionExecutor:
     """Micro-batching front door: ``submit()`` enqueues and returns a
     future; the drain loop coalesces up to ``window`` queries (or
-    whatever arrived within ``max_wait_ms`` of the window opening) and
-    executes the batch through :func:`execute_fused`. One drain thread,
+    whatever arrived within ``max_wait_ms`` of the window opening, or —
+    since ISSUE 19 — whatever fits before the tightest member deadline)
+    and executes the batch through :func:`execute_fused`. A budgeted
+    submit (``tenant``/``slack_ms``) records the joint priced
+    window-vs-solo verdict (``fusion.hedge``); an interactive request
+    the verdict prices out of the window dispatches solo in the caller
+    thread through the in-flight dedup table instead. One drain thread,
     lazily started; ``close()`` drains what is queued and stops."""
 
     def __init__(
@@ -647,6 +754,10 @@ class FusionExecutor:
         mode: Optional[str] = None,
         deadline_s: Optional[float] = None,
     ):
+        # an explicit window pins this executor; None tracks config.window
+        # live, so the serving-p99-pressure auto-tune reaches running
+        # executors, not just future ones
+        self._window_override = window is not None
         self.window = int(window) if window is not None else config.window
         self.max_wait_s = (
             float(max_wait_ms) if max_wait_ms is not None else config.max_wait_ms
@@ -659,13 +770,134 @@ class FusionExecutor:
         self._closed = False  # guarded-by: self._cond
         self._thread: Optional[threading.Thread] = None  # guarded-by: self._cond
         self.batches = 0
+        self.hedges = 0
 
-    def submit(self, query: Union[Expr, Plan]) -> "Future[RoaringBitmap]":
+    def _target_window(self) -> int:
+        if self._window_override:
+            return self.window
+        return max(2, config.window)
+
+    @staticmethod
+    def _slack_for(
+        tenant: Optional[str], slack_ms: Optional[float],
+        latency_class: Optional[str],
+    ) -> Tuple[Optional[float], Optional[str]]:
+        """Resolve the request's latency budget: explicit args win, else
+        the tenant's declared SLO from the serve-tier registry (lazily
+        imported — the query layer must work without the serve tier)."""
+        if slack_ms is None and tenant is not None:
+            try:
+                from ..serve import slo as _slo
+
+                slack_ms = _slo.TENANTS.p99_budget_ms(tenant)
+                if latency_class is None:
+                    latency_class = _slo.TENANTS.latency_class(tenant)
+            except KeyError:
+                return None, None
+        if slack_ms is None:
+            return None, None
+        return float(slack_ms) / 1e3, latency_class
+
+    def submit(
+        self,
+        query: Union[Expr, Plan],
+        tenant: Optional[str] = None,
+        slack_ms: Optional[float] = None,
+        latency_class: Optional[str] = None,
+    ) -> "Future[RoaringBitmap]":
         fut: "Future[RoaringBitmap]" = Future()
+        t_enq = time.perf_counter()
+        slack_s, cls = self._slack_for(tenant, slack_ms, latency_class)
+        deadline = (t_enq + slack_s) if slack_s is not None else None
+        seq = None
+        if slack_s is not None and config.enabled:
+            verdict, seq = self._hedge_verdict(query, t_enq, slack_s, cls)
+            if verdict == "solo":
+                return self._dispatch_solo(query, fut, deadline, seq)
+            if seq is not None:
+                _HEDGE_TOTAL.inc(1, ("window",))
+        self._enqueue(query, fut, t_enq, deadline, seq)
+        return fut
+
+    def _hedge_verdict(self, query, t_enq, slack_s, cls):
+        """The per-request JOINT priced decision (ISSUE 19): predicted
+        window completion (deadline-bounded hold + fused estimate of the
+        forming batch) vs this request's own solo curve, each penalized
+        past the slack — one comparison covering device efficiency AND
+        the declared budget. Only latency-gold (interactive) requests act
+        on a solo verdict; everyone budgeted records it."""
+        try:
+            plan = query if isinstance(query, Plan) else _exec._memo_plan(
+                query, self.mode
+            )
+            steps = max(1, len(plan.steps))
+        except Exception:  # rb-ok: exception-hygiene -- a plan error must surface on the window path (the future), not turn the hedge pricing probe into the request's failure point
+            return "window", None
+        with self._cond:
+            depth = len(self._queue)
+            t_open = self._queue[0][2] if self._queue else t_enq
+            deadlines = [e[3] for e in self._queue]
+        close_at = window_close_at(t_open, self.max_wait_s, deadlines)
+        # the deadline-aware drain would close our window by our own
+        # slack anyway: the hold we'd pay is bounded by both
+        wait_us = max(0.0, (min(close_at, t_enq + slack_s) - t_enq)) * 1e6
+        verdict, est = _fusion_cost.MODEL.choose_dispatch(
+            steps, depth, wait_us, slack_s * 1e6
+        )
+        hedged = verdict == "solo" and cls == "interactive" and config.hedge
+        recorded = "solo" if hedged else "window"
+        seq = _decisions.record_decision(
+            "fusion.hedge", recorded, outcome=_outcomes.enabled(),
+            est_us=est, latency_class=cls, slack_ms=round(slack_s * 1e3, 3),
+            depth=depth, steps=steps, priced=verdict,
+        )
+        return recorded, seq
+
+    def _run_solo(self, query) -> RoaringBitmap:
+        """The hedge's solo rung (fault-injectable at ``query.hedge``):
+        the serial executor in the caller thread — its claim/join loop
+        rides the SAME in-flight dedup table as the fused path."""
+        _faults.fault_point("query.hedge")
+        return _exec.execute(
+            query, cache=self.cache, mode=self.mode,
+            deadline_s=self.deadline_s,
+        )
+
+    def _dispatch_solo(self, query, fut, deadline, seq):
+        """Hedged solo dispatch: bypass the window, execute in the caller
+        thread through the serial executor — whose claim/join loop rides
+        the SAME in-flight dedup table, so a shared subexpression already
+        pending under a fused window still joins that result instead of
+        recomputing. Degradation rung: a failing solo path falls back to
+        the window (losing the latency hedge, keeping the answer)."""
+        self.hedges += 1
+        _HEDGE_TOTAL.inc(1, ("solo",))
+
+        def _window_fallback() -> RoaringBitmap:
+            f2: "Future[RoaringBitmap]" = Future()
+            self._enqueue(query, f2, time.perf_counter(), deadline, None)
+            return f2.result()
+
+        try:
+            val = _ladder.LADDER.run(
+                "query.hedge",
+                [
+                    ("solo", lambda: self._run_solo(query)),
+                    ("window", _window_fallback),
+                ],
+                outcome_seq=seq, outcome_site="fusion.hedge",
+            )
+        except Exception as e:  # rb-ok: exception-hygiene -- both rungs failed: the error belongs to this caller's future, exactly like a drained-batch failure
+            fut.set_exception(e)
+        else:
+            fut.set_result(val)
+        return fut
+
+    def _enqueue(self, query, fut, t_enq, deadline, seq) -> None:
         with self._cond:
             if self._closed:
                 raise RuntimeError("FusionExecutor is closed")
-            self._queue.append((query, fut, time.perf_counter()))
+            self._queue.append((query, fut, t_enq, deadline, seq))
             _publish_depth(id(self), len(self._queue))
             if self._thread is None:
                 self._thread = threading.Thread(
@@ -673,7 +905,6 @@ class FusionExecutor:
                 )
                 self._thread.start()
             self._cond.notify_all()
-        return fut
 
     def map(self, queries: Sequence[Union[Expr, Plan]]) -> List[RoaringBitmap]:
         """Submit all, wait for all — per-query latencies still land in
@@ -689,31 +920,47 @@ class FusionExecutor:
                 if not self._queue and self._closed:
                     return
                 t_open = self._queue[0][2]
-                while len(self._queue) < self.window and not self._closed:
-                    remaining = self.max_wait_s - (time.perf_counter() - t_open)
+                while len(self._queue) < self._target_window() and not self._closed:
+                    # deadline-aware close (ISSUE 19): the tightest
+                    # member slack pulls the close earlier than the
+                    # straggler bound; a submit arriving mid-wait
+                    # re-evaluates via notify_all
+                    close_at = window_close_at(
+                        t_open, self.max_wait_s,
+                        [e[3] for e in self._queue],
+                    )
+                    remaining = close_at - time.perf_counter()
                     if remaining <= 0:
                         break
                     self._cond.wait(remaining)
                 batch = [
                     self._queue.popleft()
-                    for _ in range(min(self.window, len(self._queue)))
+                    for _ in range(min(self._target_window(), len(self._queue)))
                 ]
                 _publish_depth(id(self), len(self._queue))
             now = time.perf_counter()
-            for _q, _fut, t_enq in batch:
+            for _q, _fut, t_enq, _dl, _seq in batch:
                 _BATCH_SECONDS.observe(now - t_enq, ("queued",))
             try:
                 outs = execute_fused(
-                    [q for q, _f, _t in batch],
+                    [q for q, _f, _t, _dl, _seq in batch],
                     cache=self.cache, mode=self.mode, deadline_s=self.deadline_s,
                 )
             except Exception as e:  # rb-ok: exception-hygiene -- a fatal batch error belongs to the submitting callers (their futures), not the drain thread, which must survive to serve the next window
-                for _q, fut, _t in batch:
+                for _q, fut, _t, _dl, _seq in batch:
                     fut.set_exception(e)
             else:
                 self.batches += 1
-                for (_q, fut, _t), val in zip(batch, outs):
+                done = time.perf_counter()
+                for (_q, fut, t_enq, _dl, seq), val in zip(batch, outs):
                     fut.set_result(val)
+                    if seq is not None:
+                        # the window-verdict half of the fusion.hedge
+                        # join: measured enqueue->result wall vs the
+                        # predicted window completion
+                        _outcomes.resolve(
+                            seq, "fusion.hedge", done - t_enq, engine="window",
+                        )
 
     def queue_depth(self) -> int:
         with self._cond:

@@ -54,6 +54,7 @@ SITES: Tuple[str, ...] = (
     "ops.dispatch",      # device reduce dispatch (store run closures, ops/)  # rb-ok: fault-site-contract -- no route of its own: dispatch faults propagate into the aggregation run and ride the "agg" ladder site's degrade/retry route
     "query.exec",        # query executor device-engine step dispatch
     "query.fusion",      # fused micro-batch execution (query/fusion.py)
+    "query.hedge",       # hedged solo dispatch of an SLO-priced request (query/fusion.py)
     "serve.admit",       # serving-tier admission verdict (serve/admission.py)
     "epoch.flip",        # epoch flip of the streaming ingest log (serve/epochs.py)
     "columnar.kernel",   # columnar native batch-kernel entry (kernels.py)

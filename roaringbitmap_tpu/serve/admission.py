@@ -324,10 +324,20 @@ class AdmissionController:
                 seq, "serve.admit", time.perf_counter() - t0, engine="admit",
             )
             return Ticket(self, canon, "admit", True, 0.0)
-        # queue verdict: wait for an in-flight slot (bounded)
+        # queue verdict: wait for an in-flight slot (bounded). An
+        # interactive tenant's wait is additionally clamped to its
+        # declared p99 budget (ISSUE 19): queueing past the whole SLO
+        # just delivers a guaranteed breach — shedding at the budget
+        # lets the caller retry or degrade while the answer could still
+        # matter. Other classes keep the plain capacity timeout.
+        wait_budget_s = self.queue_timeout_s
+        if quota.get("latency_class") == "interactive":
+            budget_ms = quota.get("p99_budget_ms")
+            if budget_ms:
+                wait_budget_s = min(wait_budget_s, float(budget_ms) / 1e3)
         granted = False
         if wait:
-            deadline = time.perf_counter() + self.queue_timeout_s
+            deadline = time.perf_counter() + wait_budget_s
             with self._cond:
                 while True:
                     if self._inflight < self.max_inflight:

@@ -68,7 +68,13 @@ class TenantProfile:
     requests are stamped mutation batches into the epoch store's ingest
     log instead of queries (``write_values`` values per batch, drawn into
     the touched bitmap's existing chunk keys so the flip's repack stays
-    on the O(k) delta path)."""
+    on the O(k) delta path).
+
+    ``latency_class``/``p99_budget_ms`` declare the tenant's latency SLO
+    (ISSUE 19): the class default budget unless overridden — what the
+    fusion hedge verdict, the interactive admission clamp, and the
+    serving-p99-pressure rule judge this tenant against. The default
+    ``batch`` keeps pre-existing all-batch schedules byte-identical."""
 
     name: str
     weight: float = 1.0
@@ -77,6 +83,8 @@ class TenantProfile:
     mix: Optional[Callable] = None  # (rng, corpus, shared) -> Expr
     writes: float = 0.0
     write_values: int = 8
+    latency_class: str = _slo.DEFAULT_LATENCY_CLASS
+    p99_budget_ms: Optional[float] = None
 
 
 @dataclass
@@ -104,6 +112,17 @@ class TenantStats:
 
     def quantile_ms(self, phase: str, q: float) -> Optional[float]:
         vals = sorted(self.queue_s if phase == "queue" else self.execute_s)
+        if not vals:
+            return None
+        i = min(len(vals) - 1, int(q * len(vals)))
+        return round(vals[i] * 1e3, 3)
+
+    def total_quantile_ms(self, q: float) -> Optional[float]:
+        """End-to-end (queue + execute) latency quantile — what a
+        tenant's declared p99 budget is judged against (the two phase
+        lists are appended pairwise under the stats lock, so zipping
+        them reconstructs per-request totals)."""
+        vals = sorted(a + b for a, b in zip(self.queue_s, self.execute_s))
         if not vals:
             return None
         i = min(len(vals) - 1, int(q * len(vals)))
@@ -239,7 +258,10 @@ class LoadHarness:
         if epoch_store is None and any(p.writes > 0 for p in self.profiles):
             raise ValueError("writer tenants need an epoch_store")
         for p in self.profiles:
-            TENANTS.declare(p.name, quota_qps=p.quota_qps, burst=p.burst)
+            TENANTS.declare(
+                p.name, quota_qps=p.quota_qps, burst=p.burst,
+                latency_class=p.latency_class, p99_budget_ms=p.p99_budget_ms,
+            )
 
     # -- the drive -----------------------------------------------------------
 
@@ -341,7 +363,13 @@ class LoadHarness:
                                     if span is not None:  # off-mode: no span
                                         span.attr(epoch=tk.epoch)
                                 if executor is not None:
-                                    out = executor.submit(req.expr).result()
+                                    # the tenant rides along so the
+                                    # executor can price the request's
+                                    # slack against its declared SLO
+                                    # (ISSUE 19)
+                                    out = executor.submit(
+                                        req.expr, tenant=req.tenant
+                                    ).result()
                                 else:
                                     out = _exec.execute(req.expr, cache=cache)
                         execute_s = time.perf_counter() - t1
@@ -407,7 +435,7 @@ class LoadHarness:
         return HarnessReport(
             requests, results, stats, wall_s,
             epochs=epochs, batch_ids=batch_ids, lineage=lineage,
-            epoch_start=epoch_start,
+            epoch_start=epoch_start, profiles=self.profiles,
         )
 
     def run_serial(self, requests: Sequence[Request]) -> List[object]:
@@ -505,7 +533,8 @@ class HarnessReport:
     exactly what :meth:`LoadHarness.run_serial_epochs` replays."""
 
     def __init__(self, requests, results, stats, wall_s,
-                 epochs=None, batch_ids=None, lineage=None, epoch_start=0):
+                 epochs=None, batch_ids=None, lineage=None, epoch_start=0,
+                 profiles=None):
         self.requests = requests
         self.results = results
         self.stats = stats
@@ -516,6 +545,7 @@ class HarnessReport:
         )
         self.lineage = lineage or []
         self.epoch_start = int(epoch_start)
+        self.profiles = list(profiles) if profiles is not None else []
 
     @property
     def served(self) -> int:
@@ -537,8 +567,18 @@ class HarnessReport:
         QPS, and harness-side p50/p99 per phase (the registry histograms
         carry the same answer — tests pin the two within one bucket
         ratio)."""
+        by_name = {p.name: p for p in self.profiles}
         out = {}
         for tenant, st in sorted(self.stats.items()):
+            prof = by_name.get(tenant)
+            budget_ms = None
+            if prof is not None:
+                budget_ms = (
+                    prof.p99_budget_ms
+                    if prof.p99_budget_ms is not None
+                    else _slo.LATENCY_CLASSES[prof.latency_class]
+                )
+            total_p99 = st.total_quantile_ms(0.99)
             out[tenant] = {
                 "served": st.served,
                 "shed": st.shed,
@@ -549,5 +589,52 @@ class HarnessReport:
                 "queue_p99_ms": st.quantile_ms("queue", 0.99),
                 "execute_p50_ms": st.quantile_ms("execute", 0.5),
                 "execute_p99_ms": st.quantile_ms("execute", 0.99),
+                "latency_class": prof.latency_class if prof else None,
+                "p99_budget_ms": budget_ms,
+                "total_p99_ms": total_p99,
+                "slo_ok": (
+                    None if budget_ms is None or total_p99 is None
+                    else bool(total_p99 <= budget_ms)
+                ),
+            }
+        return out
+
+    def class_rows(self) -> Dict[str, dict]:
+        """Per-latency-class rollup (ISSUE 19): tenants pooled by their
+        declared class, end-to-end p50/p99 over the pooled per-request
+        totals, and the tightest budget in the class — the frontier
+        gate's `every tenant's p99 holds its declared SLO` is judged per
+        tenant in :meth:`tenant_rows`; this is the workload-level view
+        (interactive vs batch) the rb_top latency panel renders."""
+        pooled: Dict[str, TenantStats] = {}
+        budgets: Dict[str, float] = {}
+        members: Dict[str, List[str]] = {}
+        for p in self.profiles:
+            st = self.stats.get(p.name)
+            if st is None:
+                continue
+            agg = pooled.setdefault(p.latency_class, TenantStats())
+            agg.served += st.served
+            agg.shed += st.shed
+            agg.queue_s.extend(st.queue_s)
+            agg.execute_s.extend(st.execute_s)
+            budget = (
+                p.p99_budget_ms if p.p99_budget_ms is not None
+                else _slo.LATENCY_CLASSES[p.latency_class]
+            )
+            prev = budgets.get(p.latency_class)
+            budgets[p.latency_class] = (
+                budget if prev is None else min(prev, budget)
+            )
+            members.setdefault(p.latency_class, []).append(p.name)
+        out = {}
+        for cls, agg in sorted(pooled.items()):
+            out[cls] = {
+                "tenants": sorted(members[cls]),
+                "served": agg.served,
+                "shed": agg.shed,
+                "budget_ms": budgets[cls],
+                "p50_ms": agg.total_quantile_ms(0.5),
+                "p99_ms": agg.total_quantile_ms(0.99),
             }
         return out

